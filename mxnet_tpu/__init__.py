@@ -1,0 +1,52 @@
+"""mxnet_tpu — a TPU-native deep learning framework with the
+capabilities of Apache MXNet (reference: szha/mxnet).
+
+Compute substrate: JAX/XLA (PJRT) — imperative NDArray ops dispatch
+asynchronously through JAX eager; hybridized Gluon blocks compile to
+single whole-graph XLA programs; data parallelism rides ICI/DCN via
+jax.sharding meshes and XLA collectives. See SURVEY.md at the repo root
+for the capability map against the reference.
+
+Typical usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import np, npx, autograd, gluon
+"""
+from __future__ import annotations
+
+import jax as _jax
+
+# Full-precision parity with the reference (float64/int64 arrays are
+# first-class there). Creation-op defaults remain float32, like the
+# reference, so TPU hot paths stay in f32/bf16.
+_jax.config.update("jax_enable_x64", True)
+
+from .base import MXNetError, __version__  # noqa: E402,F401
+from .context import (  # noqa: E402,F401
+    Context, cpu, cpu_pinned, gpu, tpu, num_gpus, num_tpus,
+    current_context, default_context, gpu_memory_info,
+)
+from . import engine  # noqa: E402,F401
+from .ndarray.ndarray import NDArray, waitall  # noqa: E402,F401
+from . import ndarray  # noqa: E402,F401
+from . import ndarray as nd  # noqa: E402,F401
+from . import numpy  # noqa: E402,F401
+from . import numpy as np  # noqa: E402,F401
+from . import numpy_extension  # noqa: E402,F401
+from . import numpy_extension as npx  # noqa: E402,F401
+from . import autograd  # noqa: E402,F401
+from .utils_io import save, load  # noqa: E402,F401
+from .base import set_np, reset_np, is_np_array, is_np_shape  # noqa: E402,F401
+
+# Subsystem modules land incrementally during the build; import what exists.
+import importlib as _importlib
+
+for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
+             "kvstore", "parallel", "profiler", "runtime", "test_utils",
+             "util", "recordio", "image", "io", "amp", "random"):
+    try:
+        globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
+    except ModuleNotFoundError as _e:
+        if f"mxnet_tpu.{_mod}" not in str(_e):
+            raise
+del _importlib, _mod

@@ -1,0 +1,120 @@
+"""AMP — automatic mixed precision (parity: python/mxnet/amp/).
+
+The reference monkey-patches op namespaces to insert amp_cast ops by
+FP16/FP32 lists (amp/amp.py:308) and runs a graph ReducePrecision pass.
+TPU-native AMP is simpler and stronger: bfloat16 is the native MXU
+dtype and needs NO loss scaling (same exponent range as fp32). So:
+
+- `amp.init(target_dtype='bfloat16')` flips a process-wide autocast
+  flag consulted by the cast-list wrappers below.
+- `convert_hybrid_block(net)` casts parameters of matmul/conv-heavy
+  layers to bf16 while keeping norms/softmax in fp32 (the reference's
+  FP16_FP32_FUNCS split, amp/lists/symbol_fp16.py).
+- `LossScaler` implements dynamic scaling for fp16 parity
+  (amp/loss_scaler.py) — needed only if a user insists on float16.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from . import lists  # noqa: F401
+from .loss_scaler import LossScaler  # noqa: F401
+
+_state = {"active": False, "target_dtype": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP (parity: amp.init). target_dtype: 'bfloat16'|'float16'."""
+    if isinstance(target_dtype, str):
+        assert target_dtype in ("bfloat16", "float16")
+    _state["active"] = True
+    _state["target_dtype"] = str(target_dtype)
+
+
+def is_active():
+    return _state["active"]
+
+
+def target_dtype():
+    return jnp.bfloat16 if _state["target_dtype"] != "float16" else jnp.float16
+
+
+def amp_cast(x, dtype):
+    """Insert a cast (parity: amp_cast op)."""
+    return x.astype(dtype)
+
+
+def amp_multicast(*args, cast_narrow=False):
+    """Cast args to their widest (or narrowest) common dtype (parity:
+    amp_multicast)."""
+    dts = [a.dtype for a in args]
+    widths = [onp.dtype(d).itemsize for d in dts]
+    pick = dts[int(onp.argmin(widths))] if cast_narrow else \
+        dts[int(onp.argmax(widths))]
+    return [a.astype(pick) for a in args]
+
+
+def init_trainer(trainer):
+    """Hook the trainer for dynamic loss scaling (fp16 only)."""
+    trainer._amp_loss_scaler = LossScaler()
+    return trainer
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p.grad_req != "null" and p._data is not None and \
+                p._data._grad is not None:
+            g = p.grad()
+            g._install(g._data * inv)
+
+
+def scale_loss(loss, trainer):
+    """Context manager scaling the loss (parity: amp.scale_loss)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _scope():
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            yield loss
+            return
+        if isinstance(loss, (list, tuple)):
+            yield [l * scaler.loss_scale for l in loss]
+        else:
+            yield loss * scaler.loss_scale
+
+    return _scope()
+
+
+def convert_model(net, target_dtype="bfloat16", excluded_sym_names=None):
+    return convert_hybrid_block(net, target_dtype)
+
+
+def convert_hybrid_block(net, target_dtype="bfloat16",
+                         excluded_layers=None):
+    """Cast compute-heavy layers' params to the low-precision dtype,
+    keeping normalization layers in fp32 (parity: ReducePrecision pass
+    lists). Returns the same net, modified in place."""
+    from ..gluon import nn as gnn
+    keep_fp32 = (gnn.BatchNorm, gnn.LayerNorm, gnn.GroupNorm,
+                 gnn.InstanceNorm)
+    if excluded_layers:
+        keep_fp32 = keep_fp32 + tuple(excluded_layers)
+
+    def _cast(block):
+        if isinstance(block, keep_fp32):
+            return
+        for p in block._reg_params.values():
+            if p._data is not None and onp.issubdtype(
+                    onp.dtype(p.dtype), onp.floating):
+                p.cast(target_dtype)
+
+    net.apply(_cast)
+    return net

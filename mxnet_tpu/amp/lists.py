@@ -1,0 +1,26 @@
+"""AMP op lists (parity: python/mxnet/amp/lists/symbol_fp16.py).
+
+Names refer to this framework's op surface; the split mirrors the
+reference's FP16_FUNCS / FP32_FUNCS / WIDEST_TYPE_CASTS.
+"""
+
+# Compute-bound ops that should run in the low-precision dtype (MXU).
+TARGET_DTYPE_OPS = [
+    "fully_connected", "convolution", "deconvolution", "matmul", "dot",
+    "einsum", "tensordot", "batch_dot", "rnn",
+]
+
+# Numerically sensitive ops pinned to fp32.
+FP32_OPS = [
+    "softmax", "log_softmax", "masked_softmax", "batch_norm", "layer_norm",
+    "group_norm", "instance_norm", "l2_normalization", "norm", "mean", "sum",
+    "exp", "log", "erfinv", "gamma", "gammaln", "ctc_loss", "var", "std",
+]
+
+# Ops that take multiple inputs and should cast to the widest dtype.
+WIDEST_TYPE_CASTS = [
+    "add", "subtract", "multiply", "true_divide", "maximum", "minimum",
+    "where", "concatenate", "stack",
+]
+
+CONDITIONAL_FP32_OPS = []

@@ -1,0 +1,40 @@
+"""Dynamic loss scaler (parity: python/mxnet/amp/loss_scaler.py).
+
+Only needed for float16; bfloat16 training runs unscaled on TPU.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax.numpy as jnp
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """Check grads for inf/nan (parity: multi_all_finite kernel)."""
+        for p in params:
+            if p.grad_req == "null" or p._data is None or \
+                    p._data._grad is None:
+                continue
+            g = p._data._grad._data
+            if not bool(jnp.isfinite(jnp.asarray(g, jnp.float32)).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      2.0 ** 24)
+                self._unskipped = 0
+        return self.loss_scale

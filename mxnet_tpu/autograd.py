@@ -1,0 +1,398 @@
+"""Define-by-run autograd.
+
+Capability parity with the reference's imperative autograd
+(python/mxnet/autograd.py + src/imperative/imperative.cc:204,405):
+``record``/``pause`` scopes, ``train_mode``/``predict_mode``,
+``mark_variables``/``attach_grad``, ``backward`` with head gradients and
+grad_req 'write'/'add', ``grad()`` returning gradients functionally, and
+a user-extensible ``Function`` (custom differentiable ops).
+
+TPU-native design: instead of building an nnvm graph and running a
+gradient *pass* (src/nnvm/gradient.cc:61), every recorded op captures
+its VJP via ``jax.vjp`` at invoke time. The VJP closure's residuals are
+device-resident — exactly the activations the reference retains via
+GetBackwardDependency (imperative.cc:158). ``backward`` is then a
+reverse topological sweep calling the captured VJPs; each VJP call is
+eager JAX (async-dispatched), so backward overlaps with itself the same
+way the reference's engine-pushed backward ops do.
+
+Higher-order gradients (``create_graph=True``): the captured VJP hides
+the dependence of residuals on inputs, so for create_graph we *replay*
+the op — calling ``jax.vjp`` again under the active tape so the
+backward computation itself is recorded. Nodes keep their forward
+callable + inputs precisely for this (mirrors the reference keeping the
+forward graph alive for grad-of-grad).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import engine
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+
+
+_state = _State()
+
+
+# ---------------------------------------------------------------------------
+# scopes
+# ---------------------------------------------------------------------------
+class _RecordingScope:
+    def __init__(self, recording: bool, training: Optional[bool]):
+        self._recording = recording
+        self._training = training
+        self._prev = None
+
+    def __enter__(self):
+        self._prev = (_state.recording, _state.training)
+        _state.recording = self._recording
+        if self._training is not None:
+            _state.training = self._training
+        return self
+
+    def __exit__(self, *exc):
+        _state.recording, _state.training = self._prev
+        return False
+
+
+def record(train_mode: bool = True):
+    """Scope in which executed ops are recorded for backward()."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    """Scope in which recording is suspended."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(_state.recording, True)
+
+
+def predict_mode():
+    return _RecordingScope(_state.recording, False)
+
+
+def is_recording() -> bool:
+    return _state.recording
+
+
+def is_training() -> bool:
+    return _state.training
+
+
+def set_recording(is_record: bool) -> bool:
+    prev, _state.recording = _state.recording, is_record
+    return prev
+
+
+def set_training(train: bool) -> bool:
+    prev, _state.training = _state.training, train
+    return prev
+
+
+# ---------------------------------------------------------------------------
+# tape
+# ---------------------------------------------------------------------------
+class Node:
+    """One recorded op. Holds the captured VJP and (for create_graph
+    replay) the forward callable + strong refs to the input arrays."""
+
+    __slots__ = ("name", "fn", "vjp_fn", "inputs", "out_meta", "n_out", "__weakref__")
+
+    def __init__(self, name, fn, vjp_fn, inputs, outputs):
+        self.name = name
+        self.fn = fn
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list[NDArray] (diff inputs only)
+        # (shape, dtype) of every output so missing head-grads can be zeros
+        self.out_meta = [(o.shape, o.dtype) for o in outputs]
+        self.n_out = len(outputs)
+
+
+def _on_tape(arr) -> bool:
+    """True if this array participates in the current tape."""
+    return arr._node is not None or arr._grad_req != "null"
+
+
+def _record(name, fn, vjp_fn, inputs, outputs):
+    node = Node(name, fn, vjp_fn, inputs, outputs)
+    for i, o in enumerate(outputs):
+        o._node = (node, i)
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to arrays (parity: autograd.mark_variables)."""
+    from .ndarray.ndarray import NDArray
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if isinstance(gradients, NDArray):
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+        v._node = None
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+def _toposort(heads):
+    """Reverse-topological order of Nodes reachable from head arrays."""
+    order: List[Node] = []
+    visited = set()
+    # iterative DFS (deep imperative graphs would blow Python's stack)
+    stack = []
+    for h in heads:
+        if h._node is not None:
+            stack.append((h._node[0], False))
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            if inp._node is not None and id(inp._node[0]) not in visited:
+                stack.append((inp._node[0], False))
+    order.reverse()
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True,
+             variables=None, create_graph=False):
+    """Run backward from ``heads``.
+
+    If ``variables`` is None, gradients are accumulated into the
+    ``.grad`` buffers of marked arrays (grad_req 'write' overwrites,
+    'add' accumulates). Otherwise gradients w.r.t. ``variables`` are
+    returned and ``.grad`` buffers are untouched (parity:
+    autograd.grad, python/mxnet/autograd.py:245-335).
+    """
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray) or head_grads is None:
+        head_grads = [head_grads]
+
+    # cotangent accumulator keyed by (id(node), out_index); leaf grads
+    # keyed by id(array). In create_graph mode cotangents stay NDArrays
+    # so the backward computation itself is recorded on the live tape.
+    ct = {}
+    leaf_ct = {}
+    id2arr = {}
+
+    if create_graph:
+        def _acc(key, val, store):
+            if not isinstance(val, NDArray):
+                val = NDArray(engine.track(val))
+            cur = store.get(key)
+            store[key] = val if cur is None else cur + val
+    else:
+        def _acc(key, val, store):
+            cur = store.get(key)
+            store[key] = val if cur is None else jnp.add(cur, val)
+
+    for h, hg in zip(heads, head_grads):
+        if h._node is None and h._grad_req == "null":
+            raise ValueError(
+                "cannot differentiate a head that is not on the tape; "
+                "wrap the forward in autograd.record() and/or attach_grad()"
+            )
+        g = hg._data if isinstance(hg, NDArray) else (
+            jnp.ones(h.shape, h.dtype) if hg is None else jnp.asarray(hg)
+        )
+        if h._node is not None:
+            _acc((id(h._node[0]), h._node[1]), g, ct)
+        else:
+            _acc(id(h), g, leaf_ct)
+            id2arr[id(h)] = h
+
+    order = _toposort(heads)
+
+    with _RecordingScope(create_graph, train_mode):
+        for node in order:
+            cts = []
+            any_ct = False
+            for i, (shp, dt) in enumerate(node.out_meta):
+                c = ct.pop((id(node), i), None)
+                if c is None:
+                    c = jnp.zeros(shp, dt)
+                else:
+                    any_ct = True
+                cts.append(c)
+            if not any_ct:
+                continue
+            if create_graph:
+                in_grads = _replay_vjp(node, cts)
+            else:
+                if node.vjp_fn is None:
+                    raise RuntimeError(
+                        f"backward through op {node.name!r} failed: the "
+                        "graph has already been freed by a previous "
+                        "backward(). Pass retain_graph=True to backward() "
+                        "to backprop through the same graph twice.")
+                in_grads = node.vjp_fn(tuple(cts))
+            if not retain_graph and not create_graph:
+                node.vjp_fn = None  # free residuals eagerly
+            for inp, g in zip(node.inputs, in_grads):
+                if g is None:
+                    continue
+                if inp._node is not None:
+                    _acc((id(inp._node[0]), inp._node[1]), g, ct)
+                elif inp._grad_req != "null" or (variables is not None and
+                                                 any(inp is v for v in variables)):
+                    _acc(id(inp), g, leaf_ct)
+                    id2arr[id(inp)] = inp
+
+    if variables is not None:
+        out = []
+        for v in variables:
+            g = leaf_ct.get(id(v))
+            if g is None:
+                out.append(NDArray(engine.track(jnp.zeros(v.shape, v.dtype)),
+                                   ctx=v.ctx))
+            elif isinstance(g, NDArray):
+                out.append(g)
+            else:
+                out.append(NDArray(engine.track(g), ctx=v.ctx))
+        return out
+
+    # write into .grad buffers
+    for aid, g in leaf_ct.items():
+        arr = id2arr[aid]
+        if arr._grad is None:
+            continue
+        if isinstance(g, NDArray):
+            g = g._data
+        if arr._grad_req == "add":
+            arr._grad._data = engine.track(jnp.add(arr._grad._data, g))
+        else:
+            arr._grad._data = engine.track(jnp.asarray(g, arr._grad.dtype))
+        arr._fresh_grad = True
+    return None
+
+
+def _replay_vjp(node, cts):
+    """Re-run jax.vjp for this node under the live tape (create_graph).
+
+    Returns NDArray gradients whose tape nodes capture the dependence on
+    the original inputs, enabling grad-of-grad.
+    """
+    from .ops import apply_op
+    from .ndarray.ndarray import NDArray
+
+    if node.fn is None:
+        raise NotImplementedError(
+            f"create_graph through op {node.name!r} is not supported (no "
+            "replayable forward function)")
+    n_in = len(node.inputs)
+
+    def replay(*arrs):
+        ins, cots = arrs[:n_in], arrs[n_in:]
+        _, vjp_fn = jax.vjp(node.fn, *ins)
+        grads = vjp_fn(tuple(cots))
+        return tuple(grads)
+
+    ct_arrays = [c if isinstance(c, NDArray) else NDArray(engine.track(c))
+                 for c in cts]
+    out = apply_op(replay, *(list(node.inputs) + ct_arrays),
+                   nout=n_in, name=f"backward_{node.name}")
+    return list(out) if isinstance(out, tuple) else [out]
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient computation (parity: mx.autograd.grad)."""
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+    return backward(heads, head_grads=head_grads, retain_graph=retain_graph,
+                    train_mode=train_mode, variables=variables,
+                    create_graph=create_graph)
+
+
+def get_symbol(x):
+    """Parity shim: the reference returns the recorded Symbol for an array
+    (c_api autograd). This framework's graph IR is the jaxpr; expose it."""
+    return None
+
+
+# ---------------------------------------------------------------------------
+# custom Function (parity: mx.autograd.Function, autograd.py:389-519)
+# ---------------------------------------------------------------------------
+class Function:
+    """User-defined differentiable function.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays. Call the
+    instance inside autograd.record(); saved state may be stashed on
+    ``self`` between forward and backward (e.g. via save_for_backward).
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (tuple, list))
+        outs = (outputs,) if single else tuple(outputs)
+
+        if is_recording() and any(
+            isinstance(i, NDArray) and _on_tape(i) for i in inputs
+        ):
+            func = self
+            nd_inputs = [i for i in inputs if isinstance(i, NDArray)]
+
+            def vjp_fn(cotangent):
+                cts = cotangent  # always a tuple (uniform convention)
+                with pause():
+                    ct_nd = [NDArray(c) for c in cts]
+                    in_grads = func.backward(*ct_nd)
+                if not isinstance(in_grads, (tuple, list)):
+                    in_grads = (in_grads,)
+                # grads returned for every input; keep NDArray positions
+                gs = [g._data if isinstance(g, NDArray) else g
+                      for g in in_grads]
+                nd_gs = [g for g, i in zip(gs, inputs)
+                         if isinstance(i, NDArray)]
+                return tuple(nd_gs) if len(nd_gs) == len(nd_inputs) else tuple(gs)
+
+            _record(type(self).__name__, None, vjp_fn, nd_inputs, list(outs))
+        return outputs

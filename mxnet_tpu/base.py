@@ -1,0 +1,75 @@
+"""Base utilities for mxnet_tpu.
+
+TPU-native re-imagining of the reference's base layer
+(python/mxnet/base.py in szha/mxnet). There is no C handle table or
+ctypes `check_call` here: the compute substrate is JAX/XLA, so "handles"
+are jax.Array objects and errors are ordinary Python exceptions raised
+either at dispatch time (shape/dtype errors) or at synchronization
+points (device-side errors) — see engine.py for the async-error story.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__version__ = "0.1.0"
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+_FLOAT_DTYPES = (onp.float16, onp.float32, onp.float64)
+
+# dtype aliases accepted everywhere a dtype can be passed.
+_DTYPE_ALIASES = {
+    "float": "float32",
+    "double": "float64",
+    "half": "float16",
+    "int": "int32",
+    "long": "int64",
+    "bool_": "bool",
+}
+
+
+def resolve_dtype(dtype):
+    """Normalize a user-provided dtype to a numpy dtype object.
+
+    Accepts numpy dtypes, python types, strings, and ml_dtypes names
+    (e.g. 'bfloat16' resolves through jax.numpy).
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        dtype = _DTYPE_ALIASES.get(dtype, dtype)
+        if dtype == "bfloat16":
+            import jax.numpy as jnp
+
+            return onp.dtype(jnp.bfloat16)
+    try:
+        return onp.dtype(dtype)
+    except TypeError:
+        # jax dtypes like jnp.bfloat16 class
+        return onp.dtype(getattr(dtype, "dtype", dtype))
+
+
+def is_np_shape():
+    """NumPy-shape semantics are always on in this framework.
+
+    The reference has a global toggle (mxnet.util.set_np_shape) because its
+    legacy mx.nd API used 0 to mean "unknown dim". This framework is
+    NumPy-semantics from day one; the toggle exists for API parity only.
+    """
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001 - parity signature
+    """Parity shim: numpy semantics are always active."""
+    return None
+
+
+def reset_np():
+    return None

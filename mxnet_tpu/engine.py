@@ -1,0 +1,121 @@
+"""Execution engine shim.
+
+The reference implements a 2.6k-LoC threaded dependency engine
+(src/engine/threaded_engine.h: ThreadedVar read/write queues, per-device
+worker pools, exception capture on vars). On TPU, that machinery is
+provided by the runtime itself:
+
+- **Async dispatch**: JAX enqueues every op on the device stream and
+  returns immediately; a jax.Array is a future. That is exactly the
+  reference's "push returns, NDArray var not ready" contract
+  (engine.h:204 PushAsync).
+- **Dependency ordering**: data dependencies are carried by the arrays
+  themselves; PJRT orders execution on the stream. Read/write hazards
+  cannot arise because arrays are immutable — an in-place NDArray update
+  installs a *new* buffer (see ndarray.py), which is the functional
+  equivalent of the reference's var-version bump.
+- **Exception propagation**: device-side errors surface when a buffer is
+  awaited, matching the reference's var-attached exceptions re-thrown at
+  WaitForVar/WaitForAll (threaded_engine.h:64,189,270).
+
+What remains for us is the *control surface*: waitall / wait_to_read,
+a synchronous debug mode (parity: MXNET_ENGINE_TYPE=NaiveEngine,
+src/engine/engine.cc:32-58), and a bulk/fusion hint scope. Env var
+``MXTPU_ENGINE_TYPE=NaiveEngine`` (or ``NaiveEngine`` in set_engine_type)
+makes every op block on completion, giving deterministic, debuggable
+stepping like the reference's NaiveEngine (src/engine/naive_engine.cc).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import weakref
+
+import jax
+
+# Live-array registry so waitall() can block on everything in flight.
+# jax arrays are weakref-able but not hashable, so key weakrefs by id;
+# the weakref callback drops entries as arrays are garbage collected.
+_live_arrays: dict = {}
+_live_lock = threading.Lock()
+
+_engine_type = os.environ.get("MXTPU_ENGINE_TYPE", os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice"))
+
+
+def set_engine_type(name: str):
+    """'NaiveEngine' -> synchronous execution; anything else -> async."""
+    global _engine_type
+    _engine_type = name
+
+
+def engine_type() -> str:
+    return _engine_type
+
+
+def is_naive() -> bool:
+    return _engine_type == "NaiveEngine"
+
+
+def track(data):
+    """Register a raw jax value for waitall(); returns the value.
+
+    In naive (synchronous) mode, blocks until the value is ready so
+    errors surface at the faulting op — the debug contract of the
+    reference's NaiveEngine.
+    """
+    if is_naive():
+        return jax.block_until_ready(data)
+    if isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+        key = id(data)
+
+        def _drop(_ref, _key=key):
+            _live_arrays.pop(_key, None)
+
+        with _live_lock:
+            _live_arrays[key] = weakref.ref(data, _drop)
+    return data
+
+
+def waitall():
+    """Block until all pushed work has finished (parity: mx.nd.waitall).
+
+    Re-raises the first deferred device error, like the reference's
+    WaitForAll → Throw path.
+    """
+    with _live_lock:
+        arrays = [r() for r in _live_arrays.values()]
+        _live_arrays.clear()
+    err = None
+    for a in arrays:
+        if a is None:
+            continue
+        try:
+            jax.block_until_ready(a)
+        except Exception as e:  # keep draining; report the first error
+            if err is None:
+                err = e
+    if err is not None:
+        raise err
+
+
+def wait_to_read(data):
+    """Block until one value is ready; re-raise its deferred error."""
+    return jax.block_until_ready(data)
+
+
+class bulk:
+    """Hint scope for op bulking (parity: Engine bulk API, engine.h:310).
+
+    The reference batches engine pushes to cut scheduling overhead.
+    Under JAX, op-by-op dispatch is already cheap and real fusion comes
+    from hybridize()/jit; this scope is a no-op kept for source parity.
+    """
+
+    def __init__(self, size: int = 0):
+        self.size = size
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False

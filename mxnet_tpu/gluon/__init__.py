@@ -1,0 +1,23 @@
+"""Gluon — the imperative/hybrid neural network API
+(parity: python/mxnet/gluon)."""
+from .block import Block, HybridBlock, CachedOp  # noqa: F401
+from .parameter import (  # noqa: F401
+    Parameter, Constant, ParameterDict, DeferredInitializationError,
+)
+from .trainer import Trainer  # noqa: F401
+from . import nn  # noqa: F401
+from . import loss  # noqa: F401
+from . import data  # noqa: F401
+from . import metric  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import split_and_load, split_data, clip_global_norm  # noqa: F401
+
+
+def __getattr__(name):
+    # heavier submodules load lazily (rnn, model_zoo, contrib, probability)
+    import importlib
+    if name in ("rnn", "model_zoo", "contrib", "probability"):
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

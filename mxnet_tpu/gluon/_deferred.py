@@ -1,0 +1,50 @@
+"""Deferred-compute trace context for hybridize.
+
+Parity: the reference's deferred-compute mode
+(python/mxnet/_deferred_compute.py; C++ DCInfo imperative.h:95) records
+imperative ops into an nnvm graph. Here the recorder IS jax tracing —
+the only extra state we must carry is the list of *stateful* updates
+(BatchNorm running stats, etc.) discovered while tracing, so the
+compiled program can thread them as explicit outputs.
+"""
+from __future__ import annotations
+
+import threading
+
+
+class _TLS(threading.local):
+    def __init__(self):
+        self.ctx = None
+
+
+_tls = _TLS()
+
+
+def is_tracing() -> bool:
+    return _tls.ctx is not None
+
+
+def register_state_update(nd, new_tracer):
+    """Called from NDArray._stateful_update while tracing."""
+    if _tls.ctx is None:
+        raise RuntimeError(
+            "stateful update escaped the hybridize trace scope; this is a "
+            "framework bug")
+    _tls.ctx.state_updates.append((nd, new_tracer))
+
+
+class trace_scope:
+    """Active while a CachedOp traces block.forward."""
+
+    def __init__(self):
+        self.state_updates = []  # [(NDArray, tracer)]
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = _tls.ctx
+        _tls.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._saved
+        return False

@@ -1,0 +1,12 @@
+"""gluon.data — datasets, samplers, loaders."""
+from .dataset import (  # noqa: F401
+    Dataset, SimpleDataset, ArrayDataset, RecordFileDataset,
+)
+from .sampler import (  # noqa: F401
+    Sampler, SequentialSampler, RandomSampler, BatchSampler, FilterSampler,
+    IntervalSampler,
+)
+from .dataloader import (  # noqa: F401
+    DataLoader, default_batchify_fn, default_mp_batchify_fn,
+)
+from . import vision  # noqa: F401

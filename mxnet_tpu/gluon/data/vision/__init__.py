@@ -1,0 +1,5 @@
+from .datasets import (  # noqa: F401
+    MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
+    ImageFolderDataset,
+)
+from . import transforms  # noqa: F401

@@ -1,0 +1,207 @@
+"""Vision datasets (parity: gluon/data/vision/datasets.py).
+
+MNIST/FashionMNIST/CIFAR read the standard file formats from a local
+root (default ~/.mxnet/datasets/...). This environment has no network
+egress, so download=True raises with instructions instead of fetching.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as onp
+
+from ..dataset import Dataset, ArrayDataset
+
+
+def _data_root():
+    return os.environ.get("MXNET_HOME",
+                          os.path.join(os.path.expanduser("~"), ".mxnet"))
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._root = os.path.expanduser(root)
+        self._data = None
+        self._label = None
+        if not os.path.isdir(self._root):
+            os.makedirs(self._root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        from ....numpy import array
+        img = array(self._data[idx])
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST (files: train-images-idx3-ubyte.gz etc. under root)."""
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_data_root(), "datasets", "mnist")
+        self._base = "train" if train else "t10k"
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        img_file = os.path.join(self._root,
+                                f"{self._base}-images-idx3-ubyte.gz")
+        lbl_file = os.path.join(self._root,
+                                f"{self._base}-labels-idx1-ubyte.gz")
+        for f in (img_file, lbl_file):
+            if not os.path.exists(f):
+                raise FileNotFoundError(
+                    f"{f} not found. This environment has no network "
+                    "access; place the standard MNIST idx-ubyte.gz files "
+                    f"under {self._root} manually.")
+        with gzip.open(lbl_file, "rb") as fin:
+            struct.unpack(">II", fin.read(8))
+            label = onp.frombuffer(fin.read(), dtype=onp.uint8) \
+                .astype(onp.int32)
+        with gzip.open(img_file, "rb") as fin:
+            _, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = onp.frombuffer(fin.read(), dtype=onp.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = data
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=None, train=True, transform=None):
+        root = root or os.path.join(_data_root(), "datasets", "fashion-mnist")
+        MNIST.__init__(self, root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches."""
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_data_root(), "datasets", "cifar10")
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-10-batches-py")
+        if not os.path.isdir(base):
+            tar = os.path.join(self._root, "cifar-10-python.tar.gz")
+            if os.path.exists(tar):
+                with tarfile.open(tar) as t:
+                    t.extractall(self._root)
+            else:
+                raise FileNotFoundError(
+                    f"{base} not found and no network access; place "
+                    "cifar-10-python.tar.gz (or its extracted batches) "
+                    f"under {self._root}.")
+        data, labels = [], []
+        for name in self._batches():
+            with open(os.path.join(base, name), "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            data.append(batch["data"].reshape(-1, 3, 32, 32))
+            labels.extend(batch["labels"])
+        self._data = onp.concatenate(data).transpose(0, 2, 3, 1)
+        self._label = onp.asarray(labels, dtype=onp.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=None, fine_label=True, train=True, transform=None):
+        self._train = train
+        self._fine = fine_label
+        root = root or os.path.join(_data_root(), "datasets", "cifar100")
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        base = os.path.join(self._root, "cifar-100-python")
+        if not os.path.isdir(base):
+            tar = os.path.join(self._root, "cifar-100-python.tar.gz")
+            if os.path.exists(tar):
+                with tarfile.open(tar) as t:
+                    t.extractall(self._root)
+            else:
+                raise FileNotFoundError(
+                    f"{base} not found and no network access; place "
+                    f"cifar-100-python.tar.gz under {self._root}.")
+        name = "train" if self._train else "test"
+        with open(os.path.join(base, name), "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        self._data = batch["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        key = "fine_labels" if self._fine else "coarse_labels"
+        self._label = onp.asarray(batch[key], dtype=onp.int32)
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels packed in a RecordIO file (parity:
+    gluon.data.vision.ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        self._record = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __len__(self):
+        return len(self._record)
+
+    def __getitem__(self, idx):
+        from ....recordio import unpack_img
+        record = self._record[idx]
+        header, img = unpack_img(record, iscolor=self._flag)
+        from ....numpy import array
+        img = array(img)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageFolderDataset(Dataset):
+    """A folder-of-class-folders image dataset (parity:
+    gluon.data.vision.ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        img = imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,225 @@
+"""Vision transforms (parity: gluon/data/vision/transforms.py).
+
+Transforms operate on HWC uint8/float NDArray images (reference
+convention) and compose with Dataset.transform_first.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .... import numpy as np
+from ....ndarray.ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import Sequential, HybridSequential
+
+
+class Compose(Sequential):
+    """Sequentially composed transforms."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (parity: ToTensor)."""
+
+    def forward(self, x):
+        x = x.astype("float32") / 255.0
+        if x.ndim == 3:
+            return x.transpose(2, 0, 1)
+        return x.transpose(0, 3, 1, 2)
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32)
+        self._std = onp.asarray(std, dtype=onp.float32)
+
+    def forward(self, x):
+        mean = np.array(self._mean.reshape(-1, 1, 1)
+                        if self._mean.ndim else self._mean)
+        std = np.array(self._std.reshape(-1, 1, 1)
+                       if self._std.ndim else self._std)
+        return (x - mean) / std
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        from ....image import imresize
+        if isinstance(self._size, int):
+            h, w = x.shape[0], x.shape[1]
+            if self._keep:
+                if h < w:
+                    new_h, new_w = self._size, int(w * self._size / h)
+                else:
+                    new_h, new_w = int(h * self._size / w), self._size
+            else:
+                new_h = new_w = self._size
+        else:
+            new_w, new_h = self._size
+        return imresize(x, new_w, new_h)
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[0], x.shape[1]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        w, h = self._size
+        if self._pad:
+            p = self._pad
+            x = np.pad(x, ((p, p), (p, p), (0, 0)), mode="constant")
+        H, W = x.shape[0], x.shape[1]
+        y0 = onp.random.randint(0, max(H - h, 0) + 1)
+        x0 = onp.random.randint(0, max(W - w, 0) + 1)
+        return x[y0:y0 + h, x0:x0 + w]
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4., 4 / 3.),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from ....image import imresize
+        H, W = x.shape[0], x.shape[1]
+        area = H * W
+        for _ in range(10):
+            target_area = onp.random.uniform(*self._scale) * area
+            log_ratio = (onp.log(self._ratio[0]), onp.log(self._ratio[1]))
+            aspect = onp.exp(onp.random.uniform(*log_ratio))
+            w = int(round(onp.sqrt(target_area * aspect)))
+            h = int(round(onp.sqrt(target_area / aspect)))
+            if w <= W and h <= H:
+                x0 = onp.random.randint(0, W - w + 1)
+                y0 = onp.random.randint(0, H - h + 1)
+                crop = x[y0:y0 + h, x0:x0 + w]
+                return imresize(crop, self._size[0], self._size[1])
+        return imresize(x, self._size[0], self._size[1])
+
+
+class RandomFlipLeftRight(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            return np.flip(x, axis=1)
+        return x
+
+
+class RandomFlipTopBottom(Block):
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.rand() < self._p:
+            return np.flip(x, axis=0)
+        return x
+
+
+class RandomBrightness(Block):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._b, self._b)
+        return x * alpha
+
+
+class RandomContrast(Block):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._c, self._c)
+        gray = np.mean(x, axis=tuple(range(x.ndim)))
+        return x * alpha + gray * (1 - alpha)
+
+
+class RandomSaturation(Block):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + onp.random.uniform(-self._s, self._s)
+        coef = np.array(onp.array([0.299, 0.587, 0.114],
+                                  dtype=onp.float32).reshape(1, 1, 3))
+        gray = np.sum(x * coef, axis=2, keepdims=True)
+        return x * alpha + gray * (1 - alpha)
+
+
+class RandomColorJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._transforms = []
+        if brightness:
+            self._transforms.append(RandomBrightness(brightness))
+        if contrast:
+            self._transforms.append(RandomContrast(contrast))
+        if saturation:
+            self._transforms.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        order = onp.random.permutation(len(self._transforms))
+        for i in order:
+            x = self._transforms[i](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], dtype=onp.float32)
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], dtype=onp.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        a = onp.random.normal(0, self._alpha, size=(3,)).astype(onp.float32)
+        rgb = (self._eigvec * a * self._eigval).sum(axis=1)
+        return x + np.array(rgb.reshape(1, 1, 3))

@@ -1,0 +1,300 @@
+"""Loss layers (parity: python/mxnet/gluon/loss.py, 15 classes)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import numpy as np
+from .. import numpy_extension as npx
+from .block import HybridBlock
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(pred, label):
+    if pred.shape != label.shape:
+        label = label.reshape(pred.shape)
+    return label
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__()
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+    def _mean_per_sample(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return np.mean(loss, axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = np.square(label - pred)
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = np.abs(label - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """BCE with optional logits input (parity: SigmoidBCELoss)."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(pred, label)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = npx.relu(pred) - pred * label + \
+                    npx.activation(-np.abs(pred), act_type="softrelu")
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = pred - pred * label + log_weight * (
+                    npx.activation(-np.abs(pred), act_type="softrelu")
+                    + npx.relu(-pred))
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(np.log(pred + eps) * label
+                         + np.log(1. - pred + eps) * (1. - label))
+            else:
+                loss = -(np.log(pred + eps) * label * pos_weight
+                         + np.log(1. - pred + eps) * (1. - label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Parity: gluon.loss.SoftmaxCrossEntropyLoss (a.k.a. SoftmaxCELoss)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -npx.pick(pred, label, axis=self._axis, keepdims=False)
+        else:
+            label = _reshape_like(pred, label)
+            loss = -np.sum(pred * label, axis=self._axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = npx.log_softmax(pred, axis=self._axis)
+        loss = label * (np.log(label + 1e-12) - pred)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification loss (parity:
+    src/operator/contrib/ctc_loss; layout TNC like the reference).
+    Lowered to optax.ctc_loss (XLA-compiled alpha recursion)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None):
+        assert layout in ("NTC", "TNC")
+        assert label_layout in ("NT", "TN")
+        super().__init__(weight, 0 if label_layout == "NT" else 1)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        import optax
+        from ..ops import apply_op
+        if self._layout == "TNC":
+            pred = np.moveaxis(pred, 0, 1)  # -> NTC
+        if self._label_layout == "TN":
+            label = label.T
+        n, t = pred.shape[0], pred.shape[1]
+        if pred_lengths is None:
+            logit_pad = np.zeros((n, t))
+        else:
+            idx = np.arange(t).reshape(1, t)
+            logit_pad = (idx >= pred_lengths.reshape(-1, 1)).astype("float32")
+        if label_lengths is None:
+            lbl_pad = (label == 0).astype("float32")  # 0 = padding (parity)
+        else:
+            li = np.arange(label.shape[1]).reshape(1, -1)
+            lbl_pad = (li >= label_lengths.reshape(-1, 1)).astype("float32")
+
+        def f(p, lb, lp, lbp):
+            return optax.ctc_loss(p, lp, lb.astype("int32"), lbp,
+                                  blank_id=0)
+
+        loss = apply_op(f, pred, label, logit_pad, lbl_pad, name="ctc_loss")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = np.abs(label - pred)
+        loss = np.where(loss > self._rho,
+                        loss - 0.5 * self._rho,
+                        (0.5 / self._rho) * np.square(loss))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = npx.relu(self._margin - pred * label)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        loss = np.square(npx.relu(self._margin - pred * label))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed"):
+        super().__init__(weight, batch_axis)
+        self._label_format = label_format
+        if label_format not in ("signed", "binary"):
+            raise ValueError(f"unexpected label_format {label_format}")
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(pred, label)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = npx.relu(pred) - pred * label + \
+            npx.activation(-np.abs(pred), act_type="softrelu")
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_per_sample(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(pred, positive)
+        negative = _reshape_like(pred, negative)
+        loss = np.sum(np.square(positive - pred) - np.square(negative - pred),
+                      axis=tuple(range(1, pred.ndim)))
+        loss = npx.relu(loss + self._margin)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False):
+        super().__init__(weight, batch_axis)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        label = _reshape_like(pred, label)
+        if self._from_logits:
+            loss = np.exp(pred) - label * pred
+        else:
+            loss = pred - label * np.log(pred + epsilon)
+        if self._compute_full:
+            stirling = label * np.log(label + 1e-12) - label + \
+                0.5 * np.log(2 * onp.pi * (label + 1e-12))
+            stirling = np.where(label <= 1, np.zeros_like(stirling), stirling)
+            loss = loss + stirling
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return np.mean(loss)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0):
+        super().__init__(weight, batch_axis)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        input1 = _reshape_like(input1, input2)
+        cos = np.sum(input1 * input2, axis=-1) / (
+            np.sqrt(np.sum(np.square(input1), axis=-1)) *
+            np.sqrt(np.sum(np.square(input2), axis=-1)) + 1e-12)
+        label = label.reshape(cos.shape)
+        loss = np.where(label == 1, 1.0 - cos,
+                        npx.relu(cos - self._margin))
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (parity: gluon.loss.SDMLLoss)."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0):
+        super().__init__(weight, batch_axis)
+        self.kl_loss = KLDivLoss(from_logits=True)
+        self.smoothing_parameter = smoothing_parameter
+
+    def forward(self, x1, x2):
+        batch_size = x1.shape[0]
+        # negative pairwise L2 distances as logits
+        d = np.sum(np.square(x1.expand_dims(1) - x2.expand_dims(0)), axis=-1)
+        logits = -np.sqrt(d + 1e-12)
+        labels = (np.eye(batch_size) * (1 - self.smoothing_parameter)
+                  + (1 - np.eye(batch_size)) *
+                  self.smoothing_parameter / (batch_size - 1))
+        log_prob = npx.log_softmax(logits, axis=-1)
+        return self.kl_loss(log_prob, labels.as_in_context(log_prob.ctx))

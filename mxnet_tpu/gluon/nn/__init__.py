@@ -1,0 +1,17 @@
+"""gluon.nn — neural network layers."""
+from .basic_layers import (  # noqa: F401
+    Sequential, HybridSequential, Dense, Activation, Dropout, BatchNorm,
+    SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten,
+    Identity, Lambda, HybridLambda, Concatenate, HybridConcatenate,
+    Concurrent, HybridConcurrent,
+)
+from .conv_layers import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose, MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D,
+    AvgPool3D, GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
+    GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D, ReflectionPad2D,
+)
+from .activations import (  # noqa: F401
+    LeakyReLU, PReLU, ELU, SELU, GELU, SiLU, Swish, Mish,
+)
+from ..block import Block, HybridBlock  # noqa: F401

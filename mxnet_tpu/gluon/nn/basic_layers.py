@@ -1,0 +1,356 @@
+"""Basic Gluon layers (parity: python/mxnet/gluon/nn/basic_layers.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ... import numpy as np
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+
+class Sequential(Block):
+    """Stack of Blocks executed sequentially."""
+
+    def __init__(self, *blocks):
+        super().__init__()
+        for b in blocks:
+            self.add(b)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for child in self._children.values():
+            x = child(x, *args)
+            args = ()
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(Sequential, HybridBlock):
+    def __init__(self, *blocks):
+        HybridBlock.__init__(self)
+        for b in blocks:
+            self.add(b)
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (parity: gluon.nn.Dense)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0):
+        super().__init__()
+        self._units = units
+        self._flatten = flatten
+        self.act = Activation(activation) if activation is not None else None
+        self.weight = Parameter("weight", shape=(units, in_units),
+                                dtype=dtype, init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(units,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+
+    def forward(self, x):
+        if not self.weight._shape_known():
+            in_units = int(onp.prod(x.shape[1:])) if self._flatten \
+                else x.shape[-1]
+            self.weight._infer_shape((self._units, in_units))
+        out = npx.fully_connected(
+            x, self.weight.data(), self.bias.data() if self.bias is not None
+            else None, num_hidden=self._units,
+            no_bias=self.bias is None, flatten=self._flatten)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} -> "
+                f"{self._units}, "
+                f"{'linear' if self.act is None else self.act._act_type})")
+
+
+class Activation(HybridBlock):
+    def __init__(self, activation):
+        super().__init__()
+        self._act_type = activation
+
+    def forward(self, x):
+        return npx.activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class Dropout(HybridBlock):
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def forward(self, x):
+        if self._rate > 0:
+            return npx.dropout(x, p=self._rate, axes=self._axes)
+        return x
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization (parity: gluon.nn.BatchNorm)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__()
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer,
+                               differentiable=scale,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer,
+                              differentiable=center,
+                              allow_deferred_init=True)
+        self.running_mean = Parameter("running_mean", shape=(in_channels,),
+                                      init=running_mean_initializer,
+                                      differentiable=False,
+                                      allow_deferred_init=True)
+        self.running_var = Parameter("running_var", shape=(in_channels,),
+                                     init=running_variance_initializer,
+                                     differentiable=False,
+                                     allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if not p._shape_known():
+                p._infer_shape((ch,))
+        return npx.batch_norm(
+            x, self.gamma.data(), self.beta.data(),
+            self.running_mean.data(), self.running_var.data(),
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, momentum={self._momentum}, "
+                f"eps={self._epsilon}, in_channels={self.gamma.shape[0]})")
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm (parity: gluon.contrib
+    SyncBatchNorm). On TPU, batch statistics are computed over the
+    global (mesh-sharded) batch automatically when the model runs under
+    pjit — XLA inserts the cross-replica reductions — so this is
+    BatchNorm with the same signature."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels)
+
+
+class LayerNorm(HybridBlock):
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, differentiable=scale,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, differentiable=center,
+                              allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p._infer_shape((ch,))
+        return npx.layer_norm(x, self.gamma.data(), self.beta.data(),
+                              axis=self._axis, eps=self._epsilon)
+
+    def __repr__(self):
+        return f"LayerNorm(axis={self._axis}, eps={self._epsilon})"
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, differentiable=scale,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, differentiable=center,
+                              allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p._infer_shape((ch,))
+        return npx.group_norm(x, self.gamma.data(), self.beta.data(),
+                              num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0):
+        super().__init__()
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter("gamma", shape=(in_channels,),
+                               init=gamma_initializer, differentiable=scale,
+                               allow_deferred_init=True)
+        self.beta = Parameter("beta", shape=(in_channels,),
+                              init=beta_initializer, differentiable=center,
+                              allow_deferred_init=True)
+
+    def forward(self, x):
+        ch = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if not p._shape_known():
+                p._infer_shape((ch,))
+        if self._axis != 1:
+            x = x.swapaxes(1, self._axis)
+        out = npx.instance_norm(x, self.gamma.data(), self.beta.data(),
+                                eps=self._epsilon)
+        if self._axis != 1:
+            out = out.swapaxes(1, self._axis)
+        return out
+
+
+class Embedding(HybridBlock):
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False):
+        super().__init__()
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter("weight", shape=(input_dim, output_dim),
+                                dtype=dtype, init=weight_initializer)
+
+    def forward(self, x):
+        return npx.embedding(x, self.weight.data(),
+                             input_dim=self._input_dim,
+                             output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Lambda(Block):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            self._func = getattr(np, function)
+            self._func_name = function
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "custom")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"Lambda({self._func_name})"
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function):
+        super().__init__()
+        if isinstance(function, str):
+            self._func = getattr(np, function, None) or getattr(npx, function)
+            self._func_name = function
+        else:
+            self._func = function
+            self._func_name = getattr(function, "__name__", "custom")
+
+    def forward(self, *args):
+        return self._func(*args)
+
+    def __repr__(self):
+        return f"HybridLambda({self._func_name})"
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (parity:
+    gluon.contrib.Concurrent)."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        out = [child(x) for child in self._children.values()]
+        return np.concatenate(out, axis=self.axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        out = [child(x) for child in self._children.values()]
+        return np.concatenate(out, axis=self.axis)
+
+
+# aliases matching gluon.contrib naming
+Concurrent = Concatenate
+HybridConcurrent = HybridConcatenate

@@ -1,0 +1,294 @@
+"""Convolution and pooling layers (parity: gluon/nn/conv_layers.py)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+from .basic_layers import Activation
+
+
+def _pair(v, n):
+    if isinstance(v, (int, onp.integer)):
+        return (int(v),) * n
+    return tuple(int(x) for x in v)
+
+
+class _Conv(HybridBlock):
+    def __init__(self, channels, kernel_size, strides, padding, dilation,
+                 groups, layout, in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 op_name="convolution", adj=None, dtype="float32"):
+        super().__init__()
+        ndim = len(kernel_size)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._kernel = kernel_size
+        self._stride = strides
+        self._pad = padding
+        self._dilate = dilation
+        self._groups = groups
+        self._layout = layout
+        self._op_name = op_name
+        self._adj = adj
+        if op_name == "convolution":
+            if layout.startswith("NC"):
+                wshape = (channels, in_channels // groups if in_channels else 0) \
+                    + kernel_size
+            else:
+                wshape = (channels,) + kernel_size + \
+                    (in_channels // groups if in_channels else 0,)
+        else:  # deconvolution: weight (in_ch, out_ch/groups, *k)
+            if layout.startswith("NC"):
+                wshape = (in_channels if in_channels else 0,
+                          channels // groups) + kernel_size
+            else:
+                wshape = (in_channels if in_channels else 0,) + kernel_size + \
+                    (channels // groups,)
+        self.weight = Parameter("weight", shape=wshape, dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias else None
+        self.act = Activation(activation) if activation is not None else None
+
+    def _infer_weight(self, x):
+        if self.weight._shape_known():
+            return
+        ch_axis = 1 if self._layout.startswith("NC") else x.ndim - 1
+        in_ch = x.shape[ch_axis]
+        shape = list(self.weight.shape)
+        if self._op_name == "convolution":
+            if self._layout.startswith("NC"):
+                shape[1] = in_ch // self._groups
+            else:
+                shape[-1] = in_ch // self._groups
+        else:
+            shape[0] = in_ch
+        self.weight._infer_shape(tuple(shape))
+        self._in_channels = in_ch
+
+    def forward(self, x):
+        self._infer_weight(x)
+        bias = self.bias.data() if self.bias is not None else None
+        if self._op_name == "convolution":
+            out = npx.convolution(x, self.weight.data(), bias,
+                                  kernel=self._kernel, stride=self._stride,
+                                  dilate=self._dilate, pad=self._pad,
+                                  num_filter=self._channels,
+                                  num_group=self._groups,
+                                  no_bias=bias is None, layout=self._layout)
+        else:
+            out = npx.deconvolution(x, self.weight.data(), bias,
+                                    kernel=self._kernel, stride=self._stride,
+                                    dilate=self._dilate, pad=self._pad,
+                                    adj=self._adj or 0,
+                                    num_filter=self._channels,
+                                    num_group=self._groups,
+                                    no_bias=bias is None, layout=self._layout)
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._in_channels or None} -> "
+                f"{self._channels}, kernel_size={self._kernel}, "
+                f"stride={self._stride}, padding={self._pad})")
+
+
+class Conv1D(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 dilation=1, groups=1, layout="NCW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
+                         _pair(padding, 1), _pair(dilation, 1), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype=dtype)
+
+
+class Conv2D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 dilation=(1, 1), groups=1, layout="NCHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
+                         _pair(padding, 2), _pair(dilation, 2), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype=dtype)
+
+
+class Conv3D(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), dilation=(1, 1, 1), groups=1,
+                 layout="NCDHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
+                         _pair(padding, 3), _pair(dilation, 3), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer, dtype=dtype)
+
+
+class Conv1DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=1, padding=0,
+                 output_padding=0, dilation=1, groups=1, layout="NCW",
+                 in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 1), _pair(strides, 1),
+                         _pair(padding, 1), _pair(dilation, 1), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 1), dtype=dtype)
+
+
+class Conv2DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1), padding=(0, 0),
+                 output_padding=(0, 0), dilation=(1, 1), groups=1,
+                 layout="NCHW", in_channels=0, activation=None, use_bias=True,
+                 weight_initializer=None, bias_initializer="zeros",
+                 dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 2), _pair(strides, 2),
+                         _pair(padding, 2), _pair(dilation, 2), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 2), dtype=dtype)
+
+
+class Conv3DTranspose(_Conv):
+    def __init__(self, channels, kernel_size, strides=(1, 1, 1),
+                 padding=(0, 0, 0), output_padding=(0, 0, 0),
+                 dilation=(1, 1, 1), groups=1, layout="NCDHW", in_channels=0,
+                 activation=None, use_bias=True, weight_initializer=None,
+                 bias_initializer="zeros", dtype="float32"):
+        super().__init__(channels, _pair(kernel_size, 3), _pair(strides, 3),
+                         _pair(padding, 3), _pair(dilation, 3), groups,
+                         layout, in_channels, activation, use_bias,
+                         weight_initializer, bias_initializer,
+                         op_name="deconvolution",
+                         adj=_pair(output_padding, 3), dtype=dtype)
+
+
+class _Pooling(HybridBlock):
+    def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
+                 pool_type, layout, count_include_pad=None):
+        super().__init__()
+        self._pool_size = pool_size
+        self._strides = strides if strides is not None else pool_size
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._global_pool = global_pool
+        self._pool_type = pool_type
+        self._layout = layout
+        self._count_include_pad = count_include_pad
+
+    def forward(self, x):
+        return npx.pooling(
+            x, kernel=self._pool_size, pool_type=self._pool_type,
+            stride=self._strides, pad=self._padding,
+            global_pool=self._global_pool,
+            pooling_convention="full" if self._ceil_mode else "valid",
+            count_include_pad=(self._count_include_pad
+                               if self._count_include_pad is not None
+                               else True),
+            layout=self._layout)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}(size={self._pool_size}, "
+                f"stride={self._strides}, padding={self._padding}, "
+                f"ceil_mode={self._ceil_mode})")
+
+
+class MaxPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False):
+        super().__init__(_pair(pool_size, 1), strides and _pair(strides, 1),
+                         _pair(padding, 1), ceil_mode, False, "max", layout)
+
+
+class MaxPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False):
+        super().__init__(_pair(pool_size, 2), strides and _pair(strides, 2),
+                         _pair(padding, 2), ceil_mode, False, "max", layout)
+
+
+class MaxPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False):
+        super().__init__(_pair(pool_size, 3), strides and _pair(strides, 3),
+                         _pair(padding, 3), ceil_mode, False, "max", layout)
+
+
+class AvgPool1D(_Pooling):
+    def __init__(self, pool_size=2, strides=None, padding=0, layout="NCW",
+                 ceil_mode=False, count_include_pad=True):
+        super().__init__(_pair(pool_size, 1), strides and _pair(strides, 1),
+                         _pair(padding, 1), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool2D(_Pooling):
+    def __init__(self, pool_size=(2, 2), strides=None, padding=0,
+                 layout="NCHW", ceil_mode=False, count_include_pad=True):
+        super().__init__(_pair(pool_size, 2), strides and _pair(strides, 2),
+                         _pair(padding, 2), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class AvgPool3D(_Pooling):
+    def __init__(self, pool_size=(2, 2, 2), strides=None, padding=0,
+                 layout="NCDHW", ceil_mode=False, count_include_pad=True):
+        super().__init__(_pair(pool_size, 3), strides and _pair(strides, 3),
+                         _pair(padding, 3), ceil_mode, False, "avg", layout,
+                         count_include_pad)
+
+
+class GlobalMaxPool1D(_Pooling):
+    def __init__(self, layout="NCW"):
+        super().__init__((1,), None, (0,), False, True, "max", layout)
+
+
+class GlobalMaxPool2D(_Pooling):
+    def __init__(self, layout="NCHW"):
+        super().__init__((1, 1), None, (0, 0), False, True, "max", layout)
+
+
+class GlobalMaxPool3D(_Pooling):
+    def __init__(self, layout="NCDHW"):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "max",
+                         layout)
+
+
+class GlobalAvgPool1D(_Pooling):
+    def __init__(self, layout="NCW"):
+        super().__init__((1,), None, (0,), False, True, "avg", layout)
+
+
+class GlobalAvgPool2D(_Pooling):
+    def __init__(self, layout="NCHW"):
+        super().__init__((1, 1), None, (0, 0), False, True, "avg", layout)
+
+
+class GlobalAvgPool3D(_Pooling):
+    def __init__(self, layout="NCDHW"):
+        super().__init__((1, 1, 1), None, (0, 0, 0), False, True, "avg",
+                         layout)
+
+
+class ReflectionPad2D(HybridBlock):
+    def __init__(self, padding=0):
+        super().__init__()
+        self._padding = _pair(padding, 4) if not isinstance(padding, int) \
+            else (padding,) * 4
+
+    def forward(self, x):
+        p = self._padding
+        from ... import numpy as np
+        return np.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])),
+                      mode="reflect")

@@ -1,0 +1,299 @@
+"""Gluon Parameter (parity: python/mxnet/gluon/parameter.py:47).
+
+A Parameter owns one NDArray (plus its gradient buffer via
+NDArray.attach_grad). Deferred initialization is kept: a Parameter may
+be created with unknown dims (0 entries in shape); the owning layer
+infers the full shape at first forward — eagerly or during a hybridize
+trace — and the parameter then materializes with its initializer.
+
+Multi-device replication differs from the reference by design: instead
+of per-ctx replica lists (`list_data`), data parallelism shards the
+*batch* over a jax mesh while parameters live replicated/sharded as a
+single logical jax array (see parallel/ and gluon/trainer.py). The
+list_* APIs therefore return single-element lists for compatibility.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import initializer
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from ..base import resolve_dtype
+
+
+class DeferredInitializationError(RuntimeError):
+    """Error for unfinished deferred initialization."""
+
+
+class Parameter:
+    def __init__(self, name="weight", grad_req="write", shape=None,
+                 dtype=onp.float32, lr_mult=1.0, wd_mult=1.0, init=None,
+                 allow_deferred_init=False, differentiable=True, stype="default",
+                 grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = resolve_dtype(dtype) if dtype is not None else None
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self._allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._grad_req = grad_req
+        self._data: NDArray | None = None
+        self._deferred_init = None  # (init, ctx, default_init)
+        self._structured_name = None  # set by Block registration
+        # sharding spec over the global mesh; None = replicated
+        self.sharding = None
+
+    # -- naming --------------------------------------------------------
+    @property
+    def name(self):
+        return self._structured_name or self._name
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self._shape}, "
+                f"dtype={onp.dtype(self.dtype).name if self.dtype else None})")
+
+    # -- grad_req ------------------------------------------------------
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        if not self._differentiable:
+            req = "null"
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data.drop_grad()
+            else:
+                self._data.attach_grad(req)
+
+    # -- shape ---------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        assert len(self._shape) == len(new_shape) and all(
+            j in (0, i) or i in (0, -1) for i, j in zip(self._shape, new_shape)), \
+            f"Expected shape {new_shape} is incompatible with given shape " \
+            f"{self._shape} for Parameter {self.name}"
+        self._shape = tuple(new_shape)
+
+    def _shape_known(self):
+        return self._shape is not None and all(
+            s > 0 for s in self._shape)
+
+    def _infer_shape(self, new_shape):
+        """Merge inferred dims and finish deferred init if pending."""
+        merged = tuple(
+            int(n) if s in (0, -1) else int(s)
+            for s, n in zip(self._shape, new_shape)
+        ) if self._shape else tuple(int(n) for n in new_shape)
+        self._shape = merged
+        if self._deferred_init is not None and self._shape_known():
+            self._finish_deferred_init()
+
+    # -- initialization ------------------------------------------------
+    def initialize(self, init=None, device=None, ctx=None,
+                   default_init=initializer.Uniform(), force_reinit=False):
+        ctx = ctx or device or current_context()
+        if isinstance(ctx, (list, tuple)):
+            ctx = ctx[0]  # replication handled by the mesh layer
+        if self._data is not None and not force_reinit:
+            return
+        self._deferred_init = (init, ctx, default_init)
+        if self._shape_known():
+            self._finish_deferred_init()
+        elif not self._allow_deferred_init:
+            raise ValueError(
+                f"Cannot initialize Parameter {self.name} because it has "
+                f"invalid shape: {self._shape}. Set allow_deferred_init=True "
+                "or specify in_units/in_channels.")
+
+    def _finish_deferred_init(self):
+        from .. import autograd
+        if self._deferred_init is None:
+            return
+        init, ctx, default_init = self._deferred_init
+        self._deferred_init = None
+        with autograd.pause():
+            from ..numpy import zeros
+            data = zeros(self._shape, dtype=self.dtype, ctx=ctx)
+            desc = initializer.InitDesc(self.name)
+            explicit = init if init is not None else self.init
+            if explicit is not None:
+                # A param-specific initializer wins over name dispatch
+                # (parity: InitDesc attrs['__init__'] routing).
+                initializer.create(explicit)._init_weight(desc, data)
+            else:
+                initializer.create(default_init)(desc, data)
+            self._init_impl(data)
+
+    def _init_impl(self, data):
+        self._data = data
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    # -- accessors -----------------------------------------------------
+    def _check_initialized(self):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name} has not been initialized yet "
+                    "because initialization was deferred. Actual "
+                    "initialization happens during the first forward pass. "
+                    "Please pass one batch of data through the network "
+                    "before accessing Parameters.")
+            raise RuntimeError(
+                f"Parameter {self.name} has not been initialized. You "
+                "should initialize parameters with Block.initialize().")
+
+    def data(self, ctx=None):
+        self._check_initialized()
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def grad(self, ctx=None):
+        self._check_initialized()
+        if self._data._grad is None:
+            raise RuntimeError(
+                f"Cannot get gradient array for Parameter {self.name} "
+                "because grad_req='null'")
+        return self._data._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.ctx]
+
+    def set_data(self, data):
+        if isinstance(data, NDArray):
+            self.shape = data.shape
+            if self._data is None:
+                if self._deferred_init is not None and self._shape_known():
+                    self._finish_deferred_init()
+                else:
+                    self._init_impl(data.astype(self.dtype)
+                                    if self.dtype else data)
+                    return
+            self._check_initialized()
+            self._data._install(
+                data.astype(self._data.dtype, copy=False)._data)
+        else:
+            from ..numpy import array
+            self.set_data(array(data))
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def reset_ctx(self, ctx):
+        self._check_initialized()
+        self._data = self._data.as_in_context(ctx)
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+
+    reset_device = reset_ctx
+
+    def cast(self, dtype):
+        self.dtype = resolve_dtype(dtype)
+        if self._data is not None:
+            grad_req = self._grad_req
+            data = self._data.astype(self.dtype)
+            self._data = data
+            if grad_req != "null":
+                self._data.attach_grad(grad_req)
+
+    def var(self):
+        raise NotImplementedError(
+            "symbol variables do not exist in this framework; use "
+            "hybridize() for graph capture")
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        return self
+
+
+class Constant(Parameter):
+    """A constant parameter (not updated by the trainer; parity:
+    gluon.Constant)."""
+
+    def __init__(self, value, name="const"):
+        if not isinstance(value, onp.ndarray):
+            value = onp.asarray(
+                value.asnumpy() if isinstance(value, NDArray) else value)
+        self.value = value
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=initializer.InitWithArray(value),
+                         differentiable=False)
+
+
+class ParameterDict(dict):
+    """Dict of Parameters with batched operations (compat helper)."""
+
+    def initialize(self, init=None, device=None, ctx=None,
+                   default_init=initializer.Uniform(), force_reinit=False,
+                   verbose=False):
+        for p in self.values():
+            p.initialize(init=init, device=device, ctx=ctx,
+                         default_init=default_init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def save(self, filename, strip_prefix=""):
+        from .. import utils_io
+        arg_dict = {}
+        for name, param in self.items():
+            weight = param.data()
+            if not name.startswith(strip_prefix):
+                raise ValueError(f"Prefix '{strip_prefix}' is to be striped "
+                                 f"before saving, but Parameter's name "
+                                 f"'{name}' does not start with it")
+            arg_dict[name[len(strip_prefix):]] = weight
+        utils_io.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from .. import utils_io
+        loaded = utils_io.load(filename)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self:
+                assert name in loaded, \
+                    f"Parameter '{name}' is missing in file '{filename}'"
+        for name, val in loaded.items():
+            if name not in self:
+                if not ignore_extra:
+                    raise ValueError(
+                        f"Parameter '{name}' loaded from file '{filename}' "
+                        "is not present in this ParameterDict")
+                continue
+            self[name].set_data(val)

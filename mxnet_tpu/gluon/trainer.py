@@ -1,0 +1,176 @@
+"""Gluon Trainer (parity: python/mxnet/gluon/trainer.py:47-541).
+
+Applies an Optimizer to a set of Parameters. Differences from the
+reference, by TPU design (SURVEY.md §2.3):
+
+- Gradients live on single logical arrays (possibly mesh-sharded), so
+  `allreduce_grads` lowers to an XLA collective via the KVStore backend
+  instead of device-loop reduce (CommDevice, src/kvstore/comm.h:452).
+- `update_on_kvstore` exists for API parity; the 'dist_async' parameter
+  -server path sends gradients to the PS backend like the reference's
+  KVStoreDist (src/kvstore/kvstore_dist.h:445).
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt
+from ..ndarray.ndarray import NDArray
+from .parameter import Parameter
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, dict):
+            params = [params[k] for k in sorted(params.keys())]
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, p in enumerate(params):
+            if not isinstance(p, Parameter):
+                raise ValueError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(p)}.")
+            self._param2idx[id(p)] = i
+            self._params.append(p)
+        self._compression_params = compression_params
+        self._contains_sparse_weight = False
+        self._contains_sparse_grad = False
+
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._states = [None] * len(self._params)
+        self._states_initialized = [False] * len(self._params)
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                "optimizer_params must be None if optimizer is an " \
+                "Optimizer instance"
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+
+    def _init_kvstore(self):
+        from .. import kvstore as kvs
+        config = self._kvstore_params
+        kv = config["kvstore"]
+        if kv is None or kv is False:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        elif isinstance(kv, str):
+            self._kvstore = kvs.create(kv)
+            self._update_on_kvstore = bool(config["update_on_kvstore"]) \
+                if config["update_on_kvstore"] is not None else \
+                self._kvstore.is_update_on_kvstore_default
+            if self._compression_params is not None:
+                self._kvstore.set_gradient_compression(
+                    self._compression_params)
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        else:
+            self._kvstore = kv
+            self._update_on_kvstore = bool(config["update_on_kvstore"] or False)
+        self._kv_initialized = True
+
+    # -- properties ----------------------------------------------------
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- the step ------------------------------------------------------
+    def _check_and_init(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale by 1/batch_size, allreduce, update."""
+        self._check_and_init()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self):
+        self._check_and_init()
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._data is not None:
+                self._kvstore.pushpull(i, param.grad(), out=param.grad(),
+                                       priority=-i)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        self._check_and_init()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if not ignore_stale_grad and not param._data._fresh_grad:
+                import warnings
+                warnings.warn(
+                    f"Gradient of Parameter `{param.name}` on context "
+                    f"{param.list_ctx()[0]} has not been updated by "
+                    "backward since last `step`. This could mean a bug in "
+                    "your model that made it only use a subset of the "
+                    "Parameters for the last iteration, call step with "
+                    "ignore_stale_grad=True to suppress this warning")
+            if not self._states_initialized[i]:
+                self._states[i] = \
+                    self._optimizer.create_state_multi_precision(
+                        i, param.data())
+                self._states_initialized[i] = True
+            self._optimizer.update_multi_precision(
+                [i], [param.data()], [param.grad()], [self._states[i]])
+            self._states[i] = self._optimizer._last_states[i]
+            param.data()._fresh_grad = False
+
+    # -- state io ------------------------------------------------------
+    def save_states(self, fname):
+        import pickle
+        import numpy as onp
+        import jax
+        host = jax.tree_util.tree_map(
+            lambda x: onp.asarray(x) if isinstance(x, jax.Array) else x,
+            self._states)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": host,
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count":
+                             self._optimizer._index_update_count}, f)
+
+    def load_states(self, fname):
+        import pickle
+        import numpy as onp
+        import jax
+        import jax.numpy as jnp
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._states = jax.tree_util.tree_map(
+            lambda x: jnp.asarray(x) if isinstance(x, onp.ndarray) else x,
+            blob["states"])
+        self._states_initialized = [True] * len(self._states)
+        self._optimizer.num_update = blob["num_update"]
+        self._optimizer.begin_num_update = blob["num_update"]
+        self._optimizer._index_update_count = blob["index_update_count"]

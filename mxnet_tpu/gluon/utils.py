@@ -1,0 +1,93 @@
+"""gluon.utils (parity: python/mxnet/gluon/utils.py)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split a batch along batch_axis into num_slice slices."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}. Use a batch size "
+            f"that's a multiple of {num_slice} or set even_split=False to "
+            "allow uneven partitioning of data.")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split batch and load each slice onto a context (the reference's
+    multi-GPU idiom; on TPU prefer mesh-sharded global batches via
+    parallel.shard_batch, kept for API parity)."""
+    from ..numpy import array
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the sum of their 2-norms is <= max_norm."""
+    import jax.numpy as jnp
+    assert len(arrays) > 0
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(
+        jnp.asarray(a._data, jnp.float32))) for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not onp.isfinite(total_f):
+        import warnings
+        warnings.warn(
+            UserWarning("nan or inf is detected. Clipping results will be "
+                        "undefined."), stacklevel=2)
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._install(a._data * scale)
+    return total_f if check_isfinite else NDArray(total)
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    """The build environment has no network egress; look for the file
+    locally and fail with a clear message otherwise."""
+    fname = url.split("/")[-1]
+    target = path if path is not None else fname
+    if os.path.isdir(str(target)):
+        target = os.path.join(target, fname)
+    if os.path.exists(target) and not overwrite:
+        return str(target)
+    raise RuntimeError(
+        f"download({url}) is unavailable: no network egress in this "
+        f"environment. Place the file at {target} manually.")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    return all(s > 0 for s in shape)

@@ -1,0 +1,193 @@
+"""Image API (parity: python/mxnet/image/image.py essentials).
+
+The reference decodes with OpenCV inside C++ (src/io/image_aug_default.cc);
+here decode is PIL (releases the GIL) and resize-class ops run either on
+host (PIL, for uint8 pipelines) or on device via jax.image for
+differentiable use.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+
+import numpy as onp
+
+from .ndarray.ndarray import NDArray
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file to an HWC uint8 NDArray (parity: mx.image.imread)."""
+    from .numpy import array
+    img = _pil().open(filename)
+    img = img.convert("RGB" if flag else "L")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if not to_rgb and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]
+    return array(arr)
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode a jpeg/png byte buffer (parity: mx.image.imdecode)."""
+    from .numpy import array
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    img = _pil().open(_io.BytesIO(bytes(buf)))
+    img = img.convert("RGB" if flag else "L")
+    arr = onp.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if not to_rgb and arr.shape[2] == 3:
+        arr = arr[:, :, ::-1]
+    return array(arr)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image (parity: mx.image.imresize)."""
+    from .numpy import array
+    if isinstance(src, NDArray):
+        arr = src.asnumpy()
+    else:
+        arr = onp.asarray(src)
+    dtype = arr.dtype
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil_in = arr.squeeze(-1) if squeeze else arr
+    resample = {0: _pil().NEAREST, 1: _pil().BILINEAR, 2: _pil().BICUBIC,
+                3: _pil().NEAREST, 4: _pil().LANCZOS}.get(interp,
+                                                          _pil().BILINEAR)
+    img = _pil().fromarray(pil_in.astype(onp.uint8)
+                           if dtype != onp.uint8 else pil_in)
+    img = img.resize((w, h), resample)
+    out = onp.asarray(img)
+    if squeeze:
+        out = out[:, :, None]
+    return array(out.astype(dtype))
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h),
+                      size if (new_w > w or new_h > h) else None, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = onp.random.randint(0, w - new_w + 1)
+    y0 = onp.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h,
+                      size if (new_w, new_h) != size else None, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[0], src.shape[1]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class ImageIter:
+    """Iterator over images packed in RecordIO or listed in a .lst
+    (parity: mx.image.ImageIter — python-side loop; the C++ threaded
+    variant is src_native/)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 shuffle=False, aug_list=None, **kwargs):
+        from .recordio import MXIndexedRecordIO
+        assert path_imgrec or path_imglist
+        self.batch_size = batch_size
+        self.data_shape = data_shape
+        self.shuffle = shuffle
+        self.aug_list = aug_list or []
+        self._rec = None
+        self._list = None
+        if path_imgrec:
+            idx = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
+            self._rec = MXIndexedRecordIO(idx, path_imgrec, "r")
+            self._keys = list(self._rec.keys)
+        else:
+            self._list = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    self._list.append((float(parts[1]),
+                                       os.path.join(path_root or "",
+                                                    parts[-1])))
+            self._keys = list(range(len(self._list)))
+        self.reset()
+
+    def reset(self):
+        self._order = list(self._keys)
+        if self.shuffle:
+            onp.random.shuffle(self._order)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .numpy import stack, array
+        from .recordio import unpack_img
+        if self._cursor + self.batch_size > len(self._order):
+            raise StopIteration
+        imgs, labels = [], []
+        for i in range(self._cursor, self._cursor + self.batch_size):
+            key = self._order[i]
+            if self._rec is not None:
+                header, img = unpack_img(self._rec.read_idx(key), iscolor=1)
+                label = header.label
+            else:
+                label, path = self._list[key]
+                img = imread(path).asnumpy()
+            img = imresize(array(img), self.data_shape[2],
+                           self.data_shape[1])
+            for aug in self.aug_list:
+                img = aug(img)
+            imgs.append(img.astype("float32").transpose(2, 0, 1))
+            labels.append(label)
+        self._cursor += self.batch_size
+        return stack(imgs), array(onp.asarray(labels, dtype=onp.float32))
+
+    next = __next__
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, **kwargs):
+    """Build a standard augmentation list (parity: mx.image.CreateAugmenter)."""
+    augs = []
+    if rand_mirror:
+        from .gluon.data.vision.transforms import RandomFlipLeftRight
+        augs.append(RandomFlipLeftRight())
+    if mean is not None or std is not None:
+        from .gluon.data.vision.transforms import Normalize
+        augs.append(Normalize(mean if mean is not None else 0.0,
+                              std if std is not None else 1.0))
+    return augs

@@ -1,0 +1,7 @@
+"""mx.init — alias of mx.initializer (parity with the reference)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import (  # noqa: F401
+    Initializer, InitDesc, Zero, Zeros, One, Ones, Constant, Uniform,
+    Normal, Orthogonal, Xavier, MSRAPrelu, Bilinear, LSTMBias, Mixed,
+    register, create,
+)

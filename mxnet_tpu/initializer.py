@@ -1,0 +1,282 @@
+"""Weight initializers (parity: python/mxnet/initializer.py, 14 classes).
+
+Each initializer fills an NDArray in place via `init(desc, arr)`. Name-
+based dispatch (bias→zero, gamma→one, ...) mirrors the reference's
+Initializer.__call__ legacy path and is used by gluon Parameter when no
+explicit init is given.
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as onp
+
+from .ndarray.ndarray import NDArray
+
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    if isinstance(name, Initializer):
+        return name
+    if callable(name) and not isinstance(name, str):
+        return name
+    return _REGISTRY[name.lower()](**kwargs)
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint passed to initializers."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        """Name-dispatched initialization (legacy parity)."""
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(str(desc))
+        init = desc.attrs.get("__init__", "")
+        if init:
+            create(init)._init_weight(desc, arr)
+            return
+        name = str(desc)
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+
+    # helpers ----------------------------------------------------------
+    @staticmethod
+    def _set(arr: NDArray, value):
+        arr[:] = value
+
+    def _init_weight(self, desc, arr):
+        raise NotImplementedError
+
+    def _init_default(self, desc, arr):
+        self._init_weight(desc, arr)
+
+    def _init_bias(self, desc, arr):
+        self._set(arr, 0.0)
+
+    def _init_gamma(self, desc, arr):
+        self._set(arr, 1.0)
+
+    def _init_beta(self, desc, arr):
+        self._set(arr, 0.0)
+
+    def _init_zero(self, desc, arr):
+        self._set(arr, 0.0)
+
+    def _init_one(self, desc, arr):
+        self._set(arr, 1.0)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, desc, arr):
+        self._set(arr, 0.0)
+
+
+Zeros = Zero
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, desc, arr):
+        self._set(arr, 1.0)
+
+
+Ones = One
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, desc, arr):
+        if isinstance(self.value, NDArray):
+            arr[:] = self.value
+        else:
+            self._set(arr, self.value)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, desc, arr):
+        from .numpy import random
+        arr[:] = random.uniform(-self.scale, self.scale, size=arr.shape)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, desc, arr):
+        from .numpy import random
+        arr[:] = random.normal(0.0, self.sigma, size=arr.shape)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, desc, arr):
+        from .numpy import random
+        nout = arr.shape[0]
+        nin = int(onp.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = random.uniform(-1.0, 1.0, size=(nout, nin)).asnumpy()
+        else:
+            tmp = random.normal(0.0, 1.0, size=(nout, nin)).asnumpy()
+        u, _, v = onp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(arr.dtype)
+
+
+@register
+class Xavier(Initializer):
+    """Parity: initializer.Xavier (a.k.a. Glorot)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, desc, arr):
+        from .numpy import random
+        shape = arr.shape
+        hw_scale = 1.0
+        if len(shape) < 2:
+            raise ValueError(
+                f"Xavier initializer needs >=2D weight, got {shape} for {desc}")
+        if len(shape) > 2:
+            hw_scale = float(onp.prod(shape[2:]))
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("Incorrect factor type")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            arr[:] = random.uniform(-scale, scale, size=shape)
+        elif self.rnd_type == "gaussian":
+            arr[:] = random.normal(0.0, scale, size=shape)
+        else:
+            raise ValueError("Unknown random type")
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, desc, arr):
+        weight = onp.zeros(arr.shape, dtype=onp.float32)
+        shape = arr.shape
+        f = shape[3] // 2
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        size = int(onp.prod(shape))
+        for i in range(size):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, others 0 (parity: LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, desc, arr):
+        b = onp.zeros(arr.shape, dtype=onp.float32)
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr[:] = b
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+@register
+class InitWithArray(Initializer):
+    def __init__(self, arr):
+        super().__init__()
+        self.arr = arr
+
+    def _init_weight(self, desc, arr):
+        arr[:] = self.arr
+
+
+Load = InitWithArray
+
+
+@register
+class Mixed(Initializer):
+    """Pattern-dispatched initializer list (parity: initializer.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                init(desc, arr)
+                return
+        raise ValueError(f"Parameter name {desc} did not match any pattern")

@@ -1,0 +1,308 @@
+"""mx.io — legacy DataIter interface (parity: python/mxnet/io/io.py).
+
+DataBatch/DataIter/NDArrayIter/ResizeIter/PrefetchingIter. The C++
+MXDataIter pipeline of the reference (src/io/iter_image_recordio_2.cc)
+maps to ImageIter + the native loader; Gluon DataLoader is the
+preferred path.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+import threading
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+
+DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
+DataDesc.__new__.__defaults__ = (onp.float32, "NCHW")
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None:
+            assert isinstance(data, (list, tuple)), "Data must be list of NDArrays"
+        if label is not None:
+            assert isinstance(label, (list, tuple)), "Label must be list of NDArrays"
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+    def __str__(self):
+        data_shapes = [d.shape for d in self.data]
+        if self.label:
+            label_shapes = [l.shape for l in self.label]
+        else:
+            label_shapes = None
+        return f"{type(self).__name__}: data shapes: {data_shapes} " \
+               f"label shapes: {label_shapes}"
+
+
+class DataIter:
+    """Base iterator (parity: io.py:179)."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+class NDArrayIter(DataIter):
+    """Iterate over ndarray/dict data (parity: io.py NDArrayIter)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        assert last_batch_handle in ("pad", "discard", "roll_over")
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.idx = onp.arange(self.data[0][1].shape[0])
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.num_data = self.idx.shape[0]
+        self.cursor = -batch_size
+        self._roll_over_idx = onp.array([], dtype=onp.int64)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, tuple([self.batch_size] + list(v.shape[1:])),
+                         v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            onp.random.shuffle(self.idx)
+        # roll_over: the previous epoch's remainder leads this epoch
+        # (parity: io.py NDArrayIter roll_over semantics)
+        if self.last_batch_handle == "roll_over" and len(self._roll_over_idx):
+            self._order = onp.concatenate([self._roll_over_idx, self.idx])
+            self._roll_over_idx = onp.array([], dtype=onp.int64)
+        else:
+            self._order = self.idx
+        self._epoch_size = self._order.shape[0]
+        self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.cursor >= self._epoch_size:
+            return False
+        remaining = self._epoch_size - self.cursor
+        if remaining < self.batch_size:
+            if self.last_batch_handle == "discard":
+                return False
+            if self.last_batch_handle == "roll_over":
+                self._roll_over_idx = self._order[self.cursor:]
+                return False
+        return True
+
+    def _slice(self, arrays):
+        from ..numpy import array
+        start = self.cursor
+        end = min(start + self.batch_size, self._epoch_size)
+        out = []
+        for _, arr in arrays:
+            sel = self._order[start:end]
+            batch = arr[sel]
+            if end - start < self.batch_size:
+                # 'pad': wrap around to the epoch start; getpad() reports it
+                pad = self.batch_size - (end - start)
+                batch = onp.concatenate([batch, arr[self._order[:pad]]],
+                                        axis=0)
+            out.append(array(batch))
+        return out
+
+    def getdata(self):
+        return self._slice(self.data)
+
+    def getlabel(self):
+        return self._slice(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        if self.last_batch_handle == "pad" and end > self._epoch_size:
+            return end - self._epoch_size
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        assert allow_empty
+        return []
+    if isinstance(data, NDArray):
+        data = data.asnumpy()
+    if isinstance(data, onp.ndarray):
+        return [(default_name, data)]
+    if isinstance(data, (list, tuple)):
+        return [(f"{default_name}_{i}" if len(data) > 1 else default_name,
+                 d.asnumpy() if isinstance(d, NDArray) else onp.asarray(d))
+                for i, d in enumerate(data)]
+    if isinstance(data, dict):
+        return [(k, v.asnumpy() if isinstance(v, NDArray) else onp.asarray(v))
+                for k, v in sorted(data.items())]
+    raise TypeError(f"Invalid data type {type(data)}")
+
+
+class ResizeIter(DataIter):
+    """Resize the epoch length of an iterator (parity: io.py ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (parity: io.py:995 PrefetchingIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        super().__init__(len(iters))
+        self.iters = iters
+        self.n_iter = len(iters)
+        self.rename_data = rename_data
+        self.rename_label = rename_label
+        self.batch_size = iters[0].batch_size
+        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
+        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
+        for e in self.data_taken:
+            e.set()
+        self.started = True
+        self.current_batch = [None for _ in range(self.n_iter)]
+        self.next_batch = [None for _ in range(self.n_iter)]
+
+        def prefetch_func(self, i):
+            while True:
+                self.data_taken[i].wait()
+                if not self.started:
+                    break
+                try:
+                    self.next_batch[i] = self.iters[i].next()
+                except StopIteration:
+                    self.next_batch[i] = None
+                self.data_taken[i].clear()
+                self.data_ready[i].set()
+
+        self.prefetch_threads = [
+            threading.Thread(target=prefetch_func, args=[self, i],
+                             daemon=True)
+            for i in range(self.n_iter)]
+        for t in self.prefetch_threads:
+            t.start()
+
+    def __del__(self):
+        self.started = False
+        for e in self.data_taken:
+            e.set()
+
+    def reset(self):
+        for e in self.data_ready:
+            e.wait()
+        for i in self.iters:
+            i.reset()
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+
+    def iter_next(self):
+        for e in self.data_ready:
+            e.wait()
+        if self.next_batch[0] is None:
+            return False
+        self.current_batch = self.next_batch[0]
+        for e in self.data_ready:
+            e.clear()
+        for e in self.data_taken:
+            e.set()
+        return True
+
+    def next(self):
+        if self.iter_next():
+            return self.current_batch
+        raise StopIteration
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad

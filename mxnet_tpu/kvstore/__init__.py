@@ -1,0 +1,30 @@
+"""KVStore — the data-parallel communication interface.
+
+Parity with the reference's KVStore stack (SURVEY.md §2.3):
+
+- `KVStoreBase` registry (python/mxnet/kvstore/base.py:74,245) so
+  third-party backends (Horovod/BytePS-style) stay pluggable.
+- 'local'/'device' (src/kvstore/comm.h CommCPU/CommDevice): single-
+  process aggregation. On TPU a gradient is ONE logical jax array —
+  possibly sharded over the local mesh — so "reduce over devices" is
+  either a no-op (already a global array; XLA inserted psum during
+  backward under pjit) or an explicit jitted sum when the user passes
+  per-device replica lists (the reference's imperative multi-device
+  pattern).
+- 'dist_sync'/'dist_device_sync' (src/kvstore/kvstore_dist.h): multi-
+  host synchronous data parallel → XLA collectives over DCN via
+  jax.distributed + the same mesh machinery (parallel/).
+- 'dist_async' (kvstore_dist_server.h): a real parameter-server service
+  (no XLA analog) — see kvstore/dist_async.py (socket-based PS).
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase  # noqa: F401
+from .kvstore import KVStore, KVStoreLocal  # noqa: F401
+
+
+def create(name="local"):
+    """Create a KVStore (parity: mx.kv.create, src/kvstore/kvstore.cc:42)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    return KVStoreBase.create(name)

@@ -1,0 +1,73 @@
+"""KVStoreBase registry (parity: python/mxnet/kvstore/base.py)."""
+from __future__ import annotations
+
+
+class KVStoreBase:
+    """Abstract interface + backend registry."""
+
+    kv_registry = {}
+
+    # capability names (parity)
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create(name):
+        name = name.lower()
+        # dist aliases resolve to the same class with flags
+        registry = KVStoreBase.kv_registry
+        if name in registry:
+            return registry[name]()
+        for prefix, cls_name in (("dist_async", "dist_async"),
+                                 ("dist", "dist"),
+                                 ("nccl", "device"),
+                                 ("p3", "dist")):
+            if name.startswith(prefix) and cls_name in registry:
+                return registry[cls_name](mode=name)
+        raise ValueError(f"unknown KVStore type {name!r}; registered: "
+                         f"{sorted(registry)}")
+
+    # -- interface -----------------------------------------------------
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    def is_capable(self, capability):
+        return False
+
+    @property
+    def type(self):
+        return type(self).__name__.lower()
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError

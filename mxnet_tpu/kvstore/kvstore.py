@@ -1,0 +1,157 @@
+"""Single-process KVStore backends: 'local' and 'device'.
+
+Parity: src/kvstore/kvstore_local.h (+ comm.h CommCPU/CommDevice).
+The reference reduces per-GPU gradient replicas with hand-written
+device-to-device copies; here a value is either
+
+- one logical jax array (already global — possibly sharded over the
+  local mesh, in which case cross-device reduction happened inside the
+  XLA program during backward), or
+- a list of per-device NDArrays (the reference's imperative multi-
+  device pattern) which we elementwise-sum with a jitted tree reduce
+  and broadcast back.
+
+Optimizer state updates ("update_on_kvstore") run on device via the
+fused jitted optimizer steps.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import engine
+from ..ndarray.ndarray import NDArray
+from .base import KVStoreBase
+from ..optimizer import Optimizer, Updater
+
+
+@functools.lru_cache(maxsize=None)
+def _sum_n(n):
+    return jax.jit(lambda *xs: functools.reduce(jnp.add, xs))
+
+
+@KVStoreBase.register
+class KVStoreLocal(KVStoreBase):
+    """'local': aggregation in the default memory space."""
+
+    def __init__(self, mode="local"):
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._mode = mode
+
+    is_update_on_kvstore_default = True
+
+    # -- helpers -------------------------------------------------------
+    @staticmethod
+    def _reduce(value):
+        if isinstance(value, (list, tuple)):
+            if len(value) == 1:
+                return value[0]._data
+            return _sum_n(len(value))(*[v._data for v in value])
+        return value._data
+
+    @staticmethod
+    def _assign(out, data):
+        if isinstance(out, (list, tuple)):
+            for o in out:
+                o._install(jax.device_put(data, o.ctx.jax_device))
+        else:
+            out._install(data)
+
+    # -- API -----------------------------------------------------------
+    def init(self, key, value):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.init(k, v)
+            return
+        v = value[0] if isinstance(value, (list, tuple)) else value
+        self._store[key] = jnp.array(v._data)
+
+    def push(self, key, value, priority=0):
+        if isinstance(key, (list, tuple)):
+            for k, v in zip(key, value):
+                self.push(k, v, priority)
+            return
+        agg = self._reduce(value)
+        if self._updater is not None and key in self._store:
+            w = NDArray(self._store[key])
+            g = NDArray(agg)
+            self._updater(key, g, w)
+            self._store[key] = w._data
+        else:
+            self._store[key] = agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if isinstance(key, (list, tuple)):
+            for k, o in zip(key, out):
+                self.pull(k, o, priority)
+            return
+        data = self._store[key]
+        self._assign(out, data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i], None if out is None else out[i],
+                              priority)
+            return
+        if self._updater is not None and key in self._store and out is None:
+            self.push(key, value, priority)
+            return
+        agg = self._reduce(value)
+        if out is None:
+            self._store[key] = agg
+        else:
+            self._assign(out, agg)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        # sparse storage defers to a later round; dense pull is correct
+        self.pull(key, out, priority)
+
+    # -- optimizer offload ---------------------------------------------
+    def is_capable(self, capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    def set_optimizer(self, optimizer):
+        assert isinstance(optimizer, Optimizer)
+        self._optimizer = optimizer
+        self._updater = Updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, "Cannot save states for distributed training"
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, "Cannot load states for distributed training"
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+@KVStoreBase.register
+class KVStore(KVStoreLocal):
+    """'device': aggregation stays on accelerator memory (parity:
+    CommDevice, src/kvstore/comm.h:452; the NCCL variant collapses into
+    the same XLA path on TPU)."""
+
+    def __init__(self, mode="device"):
+        super().__init__(mode)
+
+    is_update_on_kvstore_default = False
+
+
+# registry aliases (create('local') / create('device') / create('nccl'))
+KVStoreBase.kv_registry["local"] = KVStoreLocal
+KVStoreBase.kv_registry["device"] = KVStore
+KVStoreBase.kv_registry["nccl"] = KVStore

@@ -1,0 +1,21 @@
+"""mx.nd — the NDArray namespace.
+
+In the reference, mx.nd (legacy) and mx.np (NumPy semantics) are
+separate op namespaces with different default semantics. This framework
+is NumPy-semantics throughout, so mx.nd is the same function set plus
+the NDArray type and serialization entry points — kept so reference
+scripts using mx.nd.* keep working.
+"""
+from .ndarray import NDArray, waitall  # noqa: F401
+
+
+def __getattr__(name):
+    # Delegate op lookups to the numpy namespace (lazy to avoid cycles).
+    from .. import numpy as _np
+    from .. import utils_io as _io
+
+    if name == "save":
+        return _io.save
+    if name == "load":
+        return _io.load
+    return getattr(_np, name)

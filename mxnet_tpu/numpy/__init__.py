@@ -1,0 +1,1150 @@
+"""mx.np — the NumPy-semantics array API.
+
+Capability parity with the reference's `mxnet.numpy`
+(python/mxnet/numpy/multiarray.py, 12k LoC of generated+handwritten
+wrappers over _npi ops). Here every function lowers to a JAX/jnp
+expression through `ops.apply_op`, which handles async dispatch, context
+inference, and autograd VJP capture. Conventions:
+
+- NDArray positional args are differentiable; static attributes (axis,
+  shape, ...) are closed over.
+- Default dtypes follow the reference (float32 for creation ops unless
+  the input carries a dtype), not NumPy's float64.
+- ``out=`` is honored by installing the result into the target buffer.
+"""
+from __future__ import annotations
+
+import builtins
+import math as _math
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import resolve_dtype
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from ..ops import apply_op
+from .. import engine
+
+# re-exported names
+ndarray = NDArray
+pi = onp.pi
+e = onp.e
+euler_gamma = onp.euler_gamma
+inf = onp.inf
+nan = onp.nan
+newaxis = None
+PZERO = 0.0
+NZERO = -0.0
+
+float16 = onp.float16
+float32 = onp.float32
+float64 = onp.float64
+bfloat16 = jnp.bfloat16
+int8 = onp.int8
+int16 = onp.int16
+int32 = onp.int32
+int64 = onp.int64
+uint8 = onp.uint8
+uint16 = onp.uint16
+uint32 = onp.uint32
+uint64 = onp.uint64
+bool_ = onp.bool_
+
+_default_float = onp.float32
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+def _coerce(x):
+    """Lift array-likes to NDArray; leave NDArray and scalars alone."""
+    if isinstance(x, NDArray) or x is None:
+        return x
+    if isinstance(x, (bool, int, float, complex)) or onp.isscalar(x):
+        return x
+    return array(x)
+
+
+def _set_out(out, r):
+    if out is None:
+        return r
+    if isinstance(r, NDArray):
+        out._inplace(r)
+    else:
+        out._install(jnp.asarray(r, out.dtype))
+    return out
+
+
+def _binary(jfn, a, b, out=None, name=None):
+    a, b = _coerce(a), _coerce(b)
+    if isinstance(a, NDArray) and isinstance(b, NDArray):
+        r = apply_op(jfn, a, b, name=name)
+    elif isinstance(a, NDArray):
+        r = apply_op(lambda x: jfn(x, b), a, name=name)
+    elif isinstance(b, NDArray):
+        r = apply_op(lambda y: jfn(a, y), b, name=name)
+    else:
+        r = NDArray(engine.track(jfn(a, b)))
+    return _set_out(out, r)
+
+
+def _unary(jfn, a, out=None, name=None):
+    a = _coerce(a)
+    if isinstance(a, NDArray):
+        r = apply_op(jfn, a, name=name)
+    else:
+        r = NDArray(engine.track(jfn(a)))
+    return _set_out(out, r)
+
+
+def _npx():
+    from .. import numpy_extension
+    return numpy_extension
+
+
+def _mkbin(jfn, name):
+    def f(x1, x2, out=None, **kwargs):
+        return _binary(jfn, x1, x2, out=out, name=name)
+    f.__name__ = name
+    return f
+
+
+def _mkunary(jfn, name):
+    def f(x, out=None, **kwargs):
+        return _unary(jfn, x, out=out, name=name)
+    f.__name__ = name
+    return f
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+def array(object, dtype=None, ctx=None, device=None):
+    """Create an array. Default dtype is float32 for untyped input
+    (reference semantics), preserved dtype for typed ndarray input."""
+    ctx = ctx or device or current_context()
+    if isinstance(object, NDArray):
+        data = object._data
+        if dtype is not None:
+            data = jnp.asarray(data, resolve_dtype(dtype))
+        return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
+    if dtype is None:
+        probe = onp.asarray(object)
+        if isinstance(object, onp.ndarray) or isinstance(object, onp.generic):
+            dtype = probe.dtype  # typed input keeps its dtype
+        else:
+            # python scalars/lists default to float32 (reference semantics:
+            # mx.np.array([1, 2]) is float32)
+            dtype = _default_float
+        npdata = probe.astype(dtype) if probe.dtype != dtype else probe
+    else:
+        npdata = onp.asarray(object)
+        dtype = resolve_dtype(dtype)
+    data = jax.device_put(jnp.asarray(npdata, dtype), ctx.jax_device)
+    return NDArray(engine.track(data), ctx=ctx)
+
+
+def asarray(a, dtype=None, ctx=None):
+    if isinstance(a, NDArray) and dtype is None:
+        return a
+    return array(a, dtype=dtype, ctx=ctx)
+
+
+def _creation(maker, shape, dtype, ctx, order=None):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    if isinstance(shape, (int, onp.integer)):
+        shape = (int(shape),)
+    data = jax.device_put(maker(tuple(int(s) for s in shape), dtype),
+                          ctx.jax_device)
+    return NDArray(engine.track(data), ctx=ctx)
+
+
+def zeros(shape, dtype=None, order="C", ctx=None, device=None):
+    return _creation(jnp.zeros, shape, dtype, ctx or device)
+
+
+def ones(shape, dtype=None, order="C", ctx=None, device=None):
+    return _creation(jnp.ones, shape, dtype, ctx or device)
+
+
+def empty(shape, dtype=None, order="C", ctx=None, device=None):
+    return _creation(jnp.zeros, shape, dtype, ctx or device)
+
+
+def full(shape, fill_value, dtype=None, order="C", ctx=None, out=None, device=None):
+    if dtype is None:
+        if isinstance(fill_value, (bool,)):
+            dtype = onp.bool_
+        elif isinstance(fill_value, int):
+            dtype = onp.int64
+        else:
+            dtype = _default_float
+    r = _creation(lambda s, d: jnp.full(s, fill_value, d), shape, dtype,
+                  ctx or device)
+    return _set_out(out, r)
+
+
+def zeros_like(a, dtype=None, order="C", ctx=None, out=None):
+    return _unary(lambda x: jnp.zeros_like(x, dtype=resolve_dtype(dtype)), a, out=out,
+                  name="zeros_like")
+
+
+def ones_like(a, dtype=None, order="C", ctx=None, out=None):
+    return _unary(lambda x: jnp.ones_like(x, dtype=resolve_dtype(dtype)), a, out=out,
+                  name="ones_like")
+
+
+def full_like(a, fill_value, dtype=None, order="C", ctx=None, out=None):
+    return _unary(lambda x: jnp.full_like(x, fill_value, dtype=resolve_dtype(dtype)),
+                  a, out=out, name="full_like")
+
+
+def empty_like(prototype, dtype=None, order="C", subok=False):
+    return zeros_like(prototype, dtype=dtype)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
+    ctx = ctx or device or current_context()
+    if dtype is None:
+        dtype = _default_float  # reference semantics: arange defaults float32
+    data = jax.device_put(jnp.arange(start, stop, step, resolve_dtype(dtype)),
+                          ctx.jax_device)
+    return NDArray(engine.track(data), ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
+             axis=0, ctx=None):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
+                       dtype=dtype, axis=axis)
+    if retstep:
+        data, step = out
+        return (NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx),
+                float(step))
+    return NDArray(engine.track(jax.device_put(out, ctx.jax_device)), ctx=ctx)
+
+
+def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
+             axis=0, ctx=None):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    data = jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                        dtype=dtype, axis=axis)
+    return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    data = jax.device_put(jnp.eye(N, M, k, dtype), ctx.jax_device)
+    return NDArray(engine.track(data), ctx=ctx)
+
+
+def identity(n, dtype=None, ctx=None):
+    return eye(n, dtype=dtype, ctx=ctx)
+
+
+def meshgrid(*xi, indexing="xy", **kwargs):
+    arrs = [_coerce(x) for x in xi]
+    outs = apply_op(lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing)),
+                    *arrs, nout=len(arrs), name="meshgrid")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def tril(m, k=0):
+    return _unary(lambda x: jnp.tril(x, k), m, name="tril")
+
+
+def triu(m, k=0):
+    return _unary(lambda x: jnp.triu(x, k), m, name="triu")
+
+
+def tri(N, M=None, k=0, dtype=None, ctx=None):
+    ctx = ctx or current_context()
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    return NDArray(engine.track(jnp.tri(N, M, k, dtype)), ctx=ctx)
+
+
+def diag(v, k=0):
+    return _unary(lambda x: jnp.diag(x, k), v, name="diag")
+
+
+def diagflat(v, k=0):
+    return _unary(lambda x: jnp.diagflat(x, k), v, name="diagflat")
+
+
+def diagonal(a, offset=0, axis1=0, axis2=1):
+    return _unary(lambda x: jnp.diagonal(x, offset, axis1, axis2), a,
+                  name="diagonal")
+
+
+def diag_indices_from(arr):
+    idx = onp.diag_indices(arr.shape[0], arr.ndim)
+    return tuple(array(i, dtype=onp.int64) for i in idx)
+
+
+def tril_indices(n, k=0, m=None):
+    idx = onp.tril_indices(n, k, m)
+    return tuple(array(i, dtype=onp.int64) for i in idx)
+
+
+def indices(dimensions, dtype=None, ctx=None):
+    ctx = ctx or current_context()
+    data = jnp.indices(dimensions, dtype=resolve_dtype(dtype) or onp.int64)
+    return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
+
+
+def copy(a):
+    return _unary(lambda x: x, a, name="copy")
+
+
+def ascontiguousarray(a, dtype=None):
+    return asarray(a, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary
+# ---------------------------------------------------------------------------
+add = _mkbin(jnp.add, "add")
+subtract = _mkbin(jnp.subtract, "subtract")
+multiply = _mkbin(jnp.multiply, "multiply")
+divide = _mkbin(jnp.true_divide, "divide")
+true_divide = _mkbin(jnp.true_divide, "true_divide")
+floor_divide = _mkbin(jnp.floor_divide, "floor_divide")
+mod = _mkbin(jnp.mod, "mod")
+remainder = _mkbin(jnp.remainder, "remainder")
+fmod = _mkbin(jnp.fmod, "fmod")
+power = _mkbin(jnp.power, "power")
+float_power = _mkbin(lambda a, b: jnp.power(jnp.asarray(a, jnp.float64), b),
+                     "float_power")
+maximum = _mkbin(jnp.maximum, "maximum")
+minimum = _mkbin(jnp.minimum, "minimum")
+fmax = _mkbin(jnp.fmax, "fmax")
+fmin = _mkbin(jnp.fmin, "fmin")
+hypot = _mkbin(jnp.hypot, "hypot")
+arctan2 = _mkbin(jnp.arctan2, "arctan2")
+logaddexp = _mkbin(jnp.logaddexp, "logaddexp")
+logaddexp2 = _mkbin(jnp.logaddexp2, "logaddexp2")
+copysign = _mkbin(jnp.copysign, "copysign")
+nextafter = _mkbin(jnp.nextafter, "nextafter")
+ldexp = _mkbin(lambda a, b: jnp.ldexp(a, jnp.asarray(b, jnp.int32)), "ldexp")
+heaviside = _mkbin(jnp.heaviside, "heaviside")
+bitwise_and = _mkbin(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _mkbin(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _mkbin(jnp.bitwise_xor, "bitwise_xor")
+left_shift = _mkbin(jnp.left_shift, "left_shift")
+right_shift = _mkbin(jnp.right_shift, "right_shift")
+gcd = _mkbin(jnp.gcd, "gcd")
+lcm = _mkbin(jnp.lcm, "lcm")
+
+equal = _mkbin(jnp.equal, "equal")
+not_equal = _mkbin(jnp.not_equal, "not_equal")
+less = _mkbin(jnp.less, "less")
+less_equal = _mkbin(jnp.less_equal, "less_equal")
+greater = _mkbin(jnp.greater, "greater")
+greater_equal = _mkbin(jnp.greater_equal, "greater_equal")
+logical_and = _mkbin(jnp.logical_and, "logical_and")
+logical_or = _mkbin(jnp.logical_or, "logical_or")
+logical_xor = _mkbin(jnp.logical_xor, "logical_xor")
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary
+# ---------------------------------------------------------------------------
+negative = _mkunary(jnp.negative, "negative")
+positive = _mkunary(lambda x: x, "positive")
+abs = _mkunary(jnp.abs, "abs")
+absolute = abs
+fabs = _mkunary(jnp.fabs, "fabs")
+sign = _mkunary(jnp.sign, "sign")
+rint = _mkunary(jnp.rint, "rint")
+ceil = _mkunary(jnp.ceil, "ceil")
+floor = _mkunary(jnp.floor, "floor")
+trunc = _mkunary(jnp.trunc, "trunc")
+fix = _mkunary(jnp.fix, "fix")
+square = _mkunary(jnp.square, "square")
+sqrt = _mkunary(jnp.sqrt, "sqrt")
+cbrt = _mkunary(jnp.cbrt, "cbrt")
+reciprocal = _mkunary(jnp.reciprocal, "reciprocal")
+exp = _mkunary(jnp.exp, "exp")
+exp2 = _mkunary(jnp.exp2, "exp2")
+expm1 = _mkunary(jnp.expm1, "expm1")
+log = _mkunary(jnp.log, "log")
+log2 = _mkunary(jnp.log2, "log2")
+log10 = _mkunary(jnp.log10, "log10")
+log1p = _mkunary(jnp.log1p, "log1p")
+sin = _mkunary(jnp.sin, "sin")
+cos = _mkunary(jnp.cos, "cos")
+tan = _mkunary(jnp.tan, "tan")
+arcsin = _mkunary(jnp.arcsin, "arcsin")
+arccos = _mkunary(jnp.arccos, "arccos")
+arctan = _mkunary(jnp.arctan, "arctan")
+sinh = _mkunary(jnp.sinh, "sinh")
+cosh = _mkunary(jnp.cosh, "cosh")
+tanh = _mkunary(jnp.tanh, "tanh")
+arcsinh = _mkunary(jnp.arcsinh, "arcsinh")
+arccosh = _mkunary(jnp.arccosh, "arccosh")
+arctanh = _mkunary(jnp.arctanh, "arctanh")
+degrees = _mkunary(jnp.degrees, "degrees")
+radians = _mkunary(jnp.radians, "radians")
+deg2rad = _mkunary(jnp.deg2rad, "deg2rad")
+rad2deg = _mkunary(jnp.rad2deg, "rad2deg")
+invert = _mkunary(jnp.invert, "invert")
+bitwise_not = invert
+logical_not = _mkunary(jnp.logical_not, "logical_not")
+isnan = _mkunary(jnp.isnan, "isnan")
+isinf = _mkunary(jnp.isinf, "isinf")
+isneginf = _mkunary(jnp.isneginf, "isneginf")
+isposinf = _mkunary(jnp.isposinf, "isposinf")
+isfinite = _mkunary(jnp.isfinite, "isfinite")
+signbit = _mkunary(jnp.signbit, "signbit")
+conjugate = _mkunary(jnp.conjugate, "conjugate")
+conj = conjugate
+real = _mkunary(jnp.real, "real")
+imag = _mkunary(jnp.imag, "imag")
+angle = _mkunary(jnp.angle, "angle")
+
+
+def around(a, decimals=0, out=None):
+    return _unary(lambda x: jnp.round(x, decimals), a, out=out, name="around")
+
+
+round = around
+round_ = around
+
+
+def clip(a, a_min=None, a_max=None, out=None):
+    return _unary(lambda x: jnp.clip(x, a_min, a_max), a, out=out, name="clip")
+
+
+def nan_to_num(x, copy=True, nan=0.0, posinf=None, neginf=None):
+    return _unary(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf,
+                                           neginf=neginf), x, name="nan_to_num")
+
+
+def interp(x, xp, fp, left=None, right=None):
+    x, xp, fp = _coerce(x), _coerce(xp), _coerce(fp)
+    return apply_op(lambda a, b, c: jnp.interp(a, b, c, left=left, right=right),
+                    x, xp, fp, name="interp")
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+def _norm_axis(axis):
+    if isinstance(axis, list):
+        return tuple(axis)
+    return axis
+
+
+def _mkreduce(jfn, name, has_dtype=True):
+    if has_dtype:
+        def f(a, axis=None, dtype=None, out=None, keepdims=False, **kw):
+            return _unary(lambda x: jfn(x, axis=_norm_axis(axis),
+                                        dtype=resolve_dtype(dtype),
+                                        keepdims=keepdims), a, out=out, name=name)
+    else:
+        def f(a, axis=None, out=None, keepdims=False, **kw):
+            return _unary(lambda x: jfn(x, axis=_norm_axis(axis),
+                                        keepdims=keepdims), a, out=out, name=name)
+    f.__name__ = name
+    return f
+
+
+sum = _mkreduce(jnp.sum, "sum")
+prod = _mkreduce(jnp.prod, "prod")
+mean = _mkreduce(jnp.mean, "mean")
+nansum = _mkreduce(jnp.nansum, "nansum")
+nanprod = _mkreduce(jnp.nanprod, "nanprod")
+nanmean = _mkreduce(jnp.nanmean, "nanmean")
+max = _mkreduce(jnp.max, "max", has_dtype=False)
+min = _mkreduce(jnp.min, "min", has_dtype=False)
+amax = max
+amin = min
+nanmax = _mkreduce(jnp.nanmax, "nanmax", has_dtype=False)
+nanmin = _mkreduce(jnp.nanmin, "nanmin", has_dtype=False)
+all = _mkreduce(jnp.all, "all", has_dtype=False)
+any = _mkreduce(jnp.any, "any", has_dtype=False)
+
+
+def std(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    return _unary(lambda x: jnp.std(x, axis=_norm_axis(axis),
+                                    dtype=resolve_dtype(dtype), ddof=ddof,
+                                    keepdims=keepdims), a, out=out, name="std")
+
+
+def var(a, axis=None, dtype=None, out=None, ddof=0, keepdims=False):
+    return _unary(lambda x: jnp.var(x, axis=_norm_axis(axis),
+                                    dtype=resolve_dtype(dtype), ddof=ddof,
+                                    keepdims=keepdims), a, out=out, name="var")
+
+
+def ptp(a, axis=None, out=None, keepdims=False):
+    return _unary(lambda x: jnp.ptp(x, axis=_norm_axis(axis), keepdims=keepdims),
+                  a, out=out, name="ptp")
+
+
+def argmax(a, axis=None, out=None):
+    return _unary(lambda x: jnp.argmax(x, axis=axis), a, out=out, name="argmax")
+
+
+def argmin(a, axis=None, out=None):
+    return _unary(lambda x: jnp.argmin(x, axis=axis), a, out=out, name="argmin")
+
+
+def nanargmax(a, axis=None):
+    return _unary(lambda x: jnp.nanargmax(x, axis=axis), a, name="nanargmax")
+
+
+def nanargmin(a, axis=None):
+    return _unary(lambda x: jnp.nanargmin(x, axis=axis), a, name="nanargmin")
+
+
+def cumsum(a, axis=None, dtype=None, out=None):
+    return _unary(lambda x: jnp.cumsum(x, axis=axis, dtype=resolve_dtype(dtype)),
+                  a, out=out, name="cumsum")
+
+
+def cumprod(a, axis=None, dtype=None):
+    return _unary(lambda x: jnp.cumprod(x, axis=axis, dtype=resolve_dtype(dtype)),
+                  a, name="cumprod")
+
+
+def median(a, axis=None, out=None, keepdims=False):
+    return _unary(lambda x: jnp.median(x, axis=_norm_axis(axis),
+                                       keepdims=keepdims), a, out=out,
+                  name="median")
+
+
+def nanmedian(a, axis=None, keepdims=False):
+    return _unary(lambda x: jnp.nanmedian(x, axis=_norm_axis(axis),
+                                          keepdims=keepdims), a, name="nanmedian")
+
+
+def quantile(a, q, axis=None, out=None, interpolation="linear", keepdims=False):
+    method = interpolation
+    return _binary(lambda x, qq: jnp.quantile(x, qq, axis=_norm_axis(axis),
+                                              method=method, keepdims=keepdims),
+                   a, q, out=out, name="quantile")
+
+
+def percentile(a, q, axis=None, out=None, interpolation="linear", keepdims=False):
+    method = interpolation
+    return _binary(lambda x, qq: jnp.percentile(x, qq, axis=_norm_axis(axis),
+                                                method=method, keepdims=keepdims),
+                   a, q, out=out, name="percentile")
+
+
+def average(a, axis=None, weights=None, returned=False):
+    a = _coerce(a)
+    if weights is None:
+        r = mean(a, axis=axis)
+        if returned:
+            cnt = a.size if axis is None else a.shape[axis]
+            return r, full(r.shape, float(cnt))
+        return r
+    a, weights = _coerce(a), _coerce(weights)
+    r = apply_op(lambda x, w: jnp.average(x, axis=_norm_axis(axis), weights=w),
+                 a, weights, name="average")
+    if returned:
+        s = sum(weights, axis=axis)
+        return r, broadcast_to(s, r.shape) if s.shape != r.shape else s
+    return r
+
+
+def count_nonzero(a, axis=None):
+    return _unary(lambda x: jnp.count_nonzero(x, axis=_norm_axis(axis)), a,
+                  name="count_nonzero")
+
+
+def bincount(x, weights=None, minlength=0):
+    x = _coerce(x)
+    n = int(x.max().item()) + 1 if x.size else 0
+    length = builtins.max(n, minlength)
+    if weights is None:
+        return _unary(lambda v: jnp.bincount(v, length=length), x, name="bincount")
+    weights = _coerce(weights)
+    return apply_op(lambda v, w: jnp.bincount(v, weights=w, length=length),
+                    x, weights, name="bincount")
+
+
+def histogram(a, bins=10, range=None, weights=None, density=None):
+    a = _coerce(a)
+    if isinstance(weights, NDArray):
+        weights = weights.asnumpy()
+    if isinstance(bins, NDArray):
+        bins = bins.asnumpy()
+    hist, edges = onp.histogram(a.asnumpy(), bins, range=range,
+                                weights=weights, density=density)
+    return array(hist), array(edges)
+
+
+# ---------------------------------------------------------------------------
+# shape manipulation
+# ---------------------------------------------------------------------------
+def reshape(a, newshape, order="C"):
+    if isinstance(newshape, (int, onp.integer)):
+        newshape = (int(newshape),)
+    newshape = tuple(int(s) for s in newshape)
+    return _unary(lambda x: jnp.reshape(x, newshape), a, name="reshape")
+
+
+def transpose(a, axes=None):
+    return _unary(lambda x: jnp.transpose(x, axes), a, name="transpose")
+
+
+def permute_dims(a, axes=None):
+    return transpose(a, axes)
+
+
+def swapaxes(a, axis1, axis2):
+    return _unary(lambda x: jnp.swapaxes(x, axis1, axis2), a, name="swapaxes")
+
+
+def moveaxis(a, source, destination):
+    return _unary(lambda x: jnp.moveaxis(x, source, destination), a,
+                  name="moveaxis")
+
+
+def rollaxis(a, axis, start=0):
+    return _unary(lambda x: jnp.rollaxis(x, axis, start), a, name="rollaxis")
+
+
+def expand_dims(a, axis):
+    return _unary(lambda x: jnp.expand_dims(x, axis), a, name="expand_dims")
+
+
+def squeeze(a, axis=None):
+    return _unary(lambda x: jnp.squeeze(x, axis), a, name="squeeze")
+
+
+def ravel(a, order="C"):
+    return reshape(a, (-1,))
+
+
+def atleast_1d(*arys):
+    res = [_unary(jnp.atleast_1d, a, name="atleast_1d") for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_2d(*arys):
+    res = [_unary(jnp.atleast_2d, a, name="atleast_2d") for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def atleast_3d(*arys):
+    res = [_unary(jnp.atleast_3d, a, name="atleast_3d") for a in arys]
+    return res[0] if len(res) == 1 else res
+
+
+def broadcast_to(array_, shape):
+    shape = (shape,) if isinstance(shape, (int, onp.integer)) else tuple(shape)
+    return _unary(lambda x: jnp.broadcast_to(x, shape), array_,
+                  name="broadcast_to")
+
+
+def broadcast_arrays(*args):
+    arrs = [_coerce(a) for a in args]
+    return list(apply_op(lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *arrs,
+                         nout=len(arrs), name="broadcast_arrays"))
+
+
+def concatenate(seq, axis=0, out=None):
+    arrs = [_coerce(a) for a in seq]
+    if axis is None:
+        r = apply_op(lambda *xs: jnp.concatenate([jnp.ravel(x) for x in xs]),
+                     *arrs, name="concatenate")
+    else:
+        r = apply_op(lambda *xs: jnp.concatenate(xs, axis=axis), *arrs,
+                     name="concatenate")
+    return _set_out(out, r)
+
+
+concat = concatenate
+
+
+def stack(arrays, axis=0, out=None):
+    arrs = [_coerce(a) for a in arrays]
+    r = apply_op(lambda *xs: jnp.stack(xs, axis=axis), *arrs, name="stack")
+    return _set_out(out, r)
+
+
+def vstack(tup):
+    arrs = [_coerce(a) for a in tup]
+    return apply_op(lambda *xs: jnp.vstack(xs), *arrs, name="vstack")
+
+
+row_stack = vstack
+
+
+def hstack(tup):
+    arrs = [_coerce(a) for a in tup]
+    return apply_op(lambda *xs: jnp.hstack(xs), *arrs, name="hstack")
+
+
+def dstack(tup):
+    arrs = [_coerce(a) for a in tup]
+    return apply_op(lambda *xs: jnp.dstack(xs), *arrs, name="dstack")
+
+
+def column_stack(tup):
+    arrs = [_coerce(a) for a in tup]
+    return apply_op(lambda *xs: jnp.column_stack(xs), *arrs, name="column_stack")
+
+
+def _split_impl(jfn, ary, indices_or_sections, axis):
+    if isinstance(indices_or_sections, NDArray):
+        indices_or_sections = tuple(int(i) for i in
+                                    indices_or_sections.asnumpy())
+    elif isinstance(indices_or_sections, (list, tuple)):
+        indices_or_sections = tuple(int(i) for i in indices_or_sections)
+    if isinstance(indices_or_sections, tuple):
+        nout = len(indices_or_sections) + 1
+    else:
+        nout = int(indices_or_sections)
+    outs = apply_op(lambda x: tuple(jfn(x, indices_or_sections, axis)),
+                    ary, nout=nout, name="split")
+    return list(outs) if isinstance(outs, tuple) else [outs]
+
+
+def split(ary, indices_or_sections, axis=0):
+    return _split_impl(jnp.split, _coerce(ary), indices_or_sections, axis)
+
+
+def array_split(ary, indices_or_sections, axis=0):
+    ary = _coerce(ary)
+    if isinstance(indices_or_sections, int):
+        n = ary.shape[axis]
+        k = indices_or_sections
+        sizes = [(n // k) + (1 if i < n % k else 0) for i in builtins.range(k)]
+        idx, acc = [], 0
+        for s in sizes[:-1]:
+            acc += s
+            idx.append(acc)
+        indices_or_sections = tuple(idx)
+    return _split_impl(jnp.split, ary, indices_or_sections, axis)
+
+
+def hsplit(ary, indices_or_sections):
+    ary = _coerce(ary)
+    axis = 0 if ary.ndim == 1 else 1
+    return _split_impl(jnp.split, ary, indices_or_sections, axis)
+
+
+def vsplit(ary, indices_or_sections):
+    return _split_impl(jnp.split, _coerce(ary), indices_or_sections, 0)
+
+
+def dsplit(ary, indices_or_sections):
+    return _split_impl(jnp.split, _coerce(ary), indices_or_sections, 2)
+
+
+def tile(A, reps):
+    return _unary(lambda x: jnp.tile(x, reps), A, name="tile")
+
+
+def repeat(a, repeats, axis=None):
+    return _unary(lambda x: jnp.repeat(x, repeats, axis=axis), a, name="repeat")
+
+
+def flip(m, axis=None):
+    return _unary(lambda x: jnp.flip(x, axis=axis), m, name="flip")
+
+
+def fliplr(m):
+    return _unary(jnp.fliplr, m, name="fliplr")
+
+
+def flipud(m):
+    return _unary(jnp.flipud, m, name="flipud")
+
+
+def rot90(m, k=1, axes=(0, 1)):
+    return _unary(lambda x: jnp.rot90(x, k, axes), m, name="rot90")
+
+
+def roll(a, shift, axis=None):
+    return _unary(lambda x: jnp.roll(x, shift, axis=axis), a, name="roll")
+
+
+def pad(array_, pad_width, mode="constant", **kwargs):
+    return _unary(lambda x: jnp.pad(x, pad_width, mode=mode, **kwargs),
+                  array_, name="pad")
+
+
+def append(arr, values, axis=None):
+    return _binary(lambda a, b: jnp.append(a, b, axis=axis), arr, values,
+                   name="append")
+
+
+def insert(arr, obj, values, axis=None):
+    arr = _coerce(arr)
+    if isinstance(obj, NDArray):
+        obj = obj.asnumpy()
+    return _binary(lambda a, v: jnp.insert(a, obj, v, axis=axis), arr,
+                   _coerce(values), name="insert")
+
+
+def delete(arr, obj, axis=None):
+    arr = _coerce(arr)
+    if isinstance(obj, NDArray):
+        obj = obj.asnumpy()
+    return _unary(lambda a: jnp.delete(a, obj, axis=axis), arr, name="delete")
+
+
+def trim_zeros(filt, trim="fb"):
+    return array(onp.trim_zeros(onp.asarray(_coerce(filt).asnumpy()), trim))
+
+
+def unique(ar, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    # dynamic output shape: runs on host (parity: reference computes on CPU)
+    res = onp.unique(_coerce(ar).asnumpy(), return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(array(r) for r in res)
+    return array(res)
+
+
+def resize(a, new_shape):
+    return array(onp.resize(_coerce(a).asnumpy(), new_shape))
+
+
+# ---------------------------------------------------------------------------
+# sorting / searching / indexing
+# ---------------------------------------------------------------------------
+def sort(a, axis=-1, kind=None, order=None):
+    return _unary(lambda x: jnp.sort(x, axis=axis), a, name="sort")
+
+
+def argsort(a, axis=-1, kind=None, order=None):
+    return _unary(lambda x: jnp.argsort(x, axis=axis), a, name="argsort")
+
+
+def lexsort(keys, axis=-1):
+    arrs = [_coerce(k) for k in keys]
+    return apply_op(lambda *xs: jnp.lexsort(xs, axis=axis), *arrs,
+                    name="lexsort")
+
+
+def partition(a, kth, axis=-1):
+    return _unary(lambda x: jnp.partition(x, kth, axis=axis), a,
+                  name="partition")
+
+
+def argpartition(a, kth, axis=-1):
+    return _unary(lambda x: jnp.argpartition(x, kth, axis=axis), a,
+                  name="argpartition")
+
+
+def searchsorted(a, v, side="left", sorter=None):
+    return _binary(lambda x, y: jnp.searchsorted(x, y, side=side), a, v,
+                   name="searchsorted")
+
+
+def where(condition, x=None, y=None):
+    condition = _coerce(condition)
+    if x is None and y is None:
+        return nonzero(condition)
+    x, y = _coerce(x), _coerce(y)
+    parts = [condition, x, y]
+    nd = [p for p in parts if isinstance(p, NDArray)]
+
+    def f(*ds):
+        it = iter(ds)
+        vals = [next(it) if isinstance(p, NDArray) else p for p in parts]
+        return jnp.where(*vals)
+
+    return apply_op(f, *nd, name="where")
+
+
+def nonzero(a):
+    # dynamic output shape: evaluate on host
+    res = onp.nonzero(_coerce(a).asnumpy())
+    return tuple(array(r, dtype=onp.int64) for r in res)
+
+
+def flatnonzero(a):
+    res = onp.flatnonzero(_coerce(a).asnumpy())
+    return array(res, dtype=onp.int64)
+
+
+def argwhere(a):
+    return array(onp.argwhere(_coerce(a).asnumpy()), dtype=onp.int64)
+
+
+def take(a, indices, axis=None, mode="clip", out=None):
+    a = _coerce(a)
+    if mode == "raise":
+        # bounds checking requires a host sync; the reference's np.take
+        # also rejects 'raise' (src/operator/numpy/np_take)
+        raise NotImplementedError(
+            "take with mode='raise' is not supported on accelerators; "
+            "use mode='clip' or mode='wrap'")
+    jmode = {"clip": "clip", "wrap": "wrap"}.get(mode, "clip")
+    if isinstance(indices, NDArray):
+        r = apply_op(lambda x, i: jnp.take(x, i, axis=axis, mode=jmode),
+                     a, indices, name="take")
+    else:
+        r = _unary(lambda x: jnp.take(x, jnp.asarray(indices), axis=axis,
+                                      mode=jmode), a, name="take")
+    return _set_out(out, r)
+
+
+def take_along_axis(arr, indices, axis):
+    return apply_op(lambda x, i: jnp.take_along_axis(x, i, axis=axis),
+                    _coerce(arr), _coerce(indices), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis):
+    r = apply_op(lambda x, i, v: jnp.put_along_axis(x, i, v, axis=axis,
+                                                    inplace=False),
+                 _coerce(arr), _coerce(indices), _coerce(values),
+                 name="put_along_axis")
+    arr._inplace(r)
+    return None
+
+
+def compress(condition, a, axis=None):
+    cond = _coerce(condition).asnumpy().astype(bool)
+    return _unary(lambda x: jnp.compress(cond, x, axis=axis), a,
+                  name="compress")
+
+
+def extract(condition, arr):
+    cond = _coerce(condition).asnumpy().astype(bool)
+    return array(onp.extract(cond, _coerce(arr).asnumpy()))
+
+
+def tril_indices_from(arr, k=0):
+    return tril_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def may_share_memory(a, b, max_work=None):
+    return False
+
+
+def shares_memory(a, b, max_work=None):
+    return False
+
+
+def ndim(a):
+    return _coerce(a).ndim if isinstance(_coerce(a), NDArray) else onp.ndim(a)
+
+
+def shape(a):
+    a = _coerce(a)
+    return a.shape if isinstance(a, NDArray) else onp.shape(a)
+
+
+def size(a, axis=None):
+    a = _coerce(a)
+    if axis is None:
+        return a.size
+    return a.shape[axis]
+
+
+# ---------------------------------------------------------------------------
+# linear algebra (top-level)
+# ---------------------------------------------------------------------------
+def dot(a, b, out=None):
+    return _binary(jnp.dot, a, b, out=out, name="dot")
+
+
+def matmul(a, b, out=None):
+    return _binary(jnp.matmul, a, b, out=out, name="matmul")
+
+
+def vdot(a, b):
+    return _binary(jnp.vdot, a, b, name="vdot")
+
+
+def inner(a, b):
+    return _binary(jnp.inner, a, b, name="inner")
+
+
+def outer(a, b):
+    return _binary(jnp.outer, a, b, name="outer")
+
+
+def tensordot(a, b, axes=2):
+    return _binary(lambda x, y: jnp.tensordot(x, y, axes=axes), a, b,
+                   name="tensordot")
+
+
+def kron(a, b):
+    return _binary(jnp.kron, a, b, name="kron")
+
+
+def cross(a, b, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    return _binary(lambda x, y: jnp.cross(x, y, axisa, axisb, axisc, axis),
+                   a, b, name="cross")
+
+
+def trace(a, offset=0, axis1=0, axis2=1, dtype=None, out=None):
+    return _unary(lambda x: jnp.trace(x, offset, axis1, axis2,
+                                      resolve_dtype(dtype)), a, out=out,
+                  name="trace")
+
+
+def einsum(subscripts, *operands, **kwargs):
+    arrs = [_coerce(o) for o in operands]
+    return apply_op(lambda *xs: jnp.einsum(subscripts, *xs), *arrs,
+                    name="einsum")
+
+
+def matrix_power(a, n):
+    return _unary(lambda x: jnp.linalg.matrix_power(x, n), a,
+                  name="matrix_power")
+
+
+def vander(x, N=None, increasing=False):
+    return _unary(lambda v: jnp.vander(v, N=N, increasing=increasing), x,
+                  name="vander")
+
+
+# ---------------------------------------------------------------------------
+# logic
+# ---------------------------------------------------------------------------
+def allclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    r = _binary(lambda x, y: jnp.allclose(x, y, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), a, b,
+                name="allclose")
+    return bool(r.item()) if isinstance(r, NDArray) else bool(r)
+
+
+def isclose(a, b, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return _binary(lambda x, y: jnp.isclose(x, y, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan), a, b,
+                   name="isclose")
+
+
+def array_equal(a1, a2, equal_nan=False):
+    a1, a2 = _coerce(a1), _coerce(a2)
+    s1 = a1.shape if isinstance(a1, NDArray) else onp.shape(a1)
+    s2 = a2.shape if isinstance(a2, NDArray) else onp.shape(a2)
+    if s1 != s2:
+        return False
+    r = _binary(lambda x, y: jnp.array_equal(x, y, equal_nan=equal_nan),
+                a1, a2, name="array_equal")
+    return bool(r.item()) if isinstance(r, NDArray) else bool(r)
+
+
+def array_equiv(a1, a2):
+    try:
+        r = _binary(lambda x, y: jnp.array_equiv(x, y), a1, a2,
+                    name="array_equiv")
+    except Exception:
+        return False
+    return bool(r.item()) if isinstance(r, NDArray) else bool(r)
+
+
+# ---------------------------------------------------------------------------
+# misc numerical
+# ---------------------------------------------------------------------------
+def diff(a, n=1, axis=-1, prepend=None, append=None):
+    kw = {}
+    if prepend is not None:
+        kw["prepend"] = _coerce(prepend)._data if isinstance(_coerce(prepend), NDArray) else prepend
+    if append is not None:
+        kw["append"] = _coerce(append)._data if isinstance(_coerce(append), NDArray) else append
+    return _unary(lambda x: jnp.diff(x, n=n, axis=axis, **kw), a, name="diff")
+
+
+def ediff1d(ary, to_end=None, to_begin=None):
+    return _unary(lambda x: jnp.ediff1d(x, to_end=to_end, to_begin=to_begin),
+                  ary, name="ediff1d")
+
+
+def gradient(f, *varargs, axis=None, edge_order=1):
+    f = _coerce(f)
+    res = onp.gradient(f.asnumpy(), *varargs, axis=axis, edge_order=edge_order)
+    if isinstance(res, list):
+        return [array(r) for r in res]
+    return array(res)
+
+
+def convolve(a, v, mode="full"):
+    return _binary(lambda x, y: jnp.convolve(x, y, mode=mode), a, v,
+                   name="convolve")
+
+
+def correlate(a, v, mode="valid"):
+    return _binary(lambda x, y: jnp.correlate(x, y, mode=mode), a, v,
+                   name="correlate")
+
+
+def cov(m, y=None, rowvar=True, bias=False, ddof=None, fweights=None,
+        aweights=None):
+    m = _coerce(m)
+    if y is not None:
+        return apply_op(lambda x, yy: jnp.cov(x, yy, rowvar=rowvar, bias=bias,
+                                              ddof=ddof), m, _coerce(y),
+                        name="cov")
+    return _unary(lambda x: jnp.cov(x, rowvar=rowvar, bias=bias, ddof=ddof),
+                  m, name="cov")
+
+
+def corrcoef(x, y=None, rowvar=True):
+    x = _coerce(x)
+    if y is not None:
+        return apply_op(lambda a, b: jnp.corrcoef(a, b, rowvar=rowvar), x,
+                        _coerce(y), name="corrcoef")
+    return _unary(lambda a: jnp.corrcoef(a, rowvar=rowvar), x, name="corrcoef")
+
+
+def polyval(p, x):
+    return _binary(lambda pp, xx: jnp.polyval(pp, xx), p, x, name="polyval")
+
+
+# expose submodules
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+
+# dtype utilities
+finfo = onp.finfo
+iinfo = onp.iinfo
+dtype = onp.dtype
+
+
+def result_type(*arrays_and_dtypes):
+    vals = [a.dtype if isinstance(a, NDArray) else a for a in arrays_and_dtypes]
+    return jnp.result_type(*vals)
+
+
+def promote_types(t1, t2):
+    return jnp.promote_types(t1, t2)
+
+
+def can_cast(from_, to, casting="safe"):
+    if isinstance(from_, NDArray):
+        from_ = from_.dtype
+    return onp.can_cast(from_, to, casting=casting)
+
+
+def get_include():
+    return onp.get_include()
+
+
+def save(file, arr):
+    """np.save parity (the reference routes through src/serialization/cnpy.cc)."""
+    onp.save(file, arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr))
+
+
+def savez(file, *args, **kwds):
+    args = [a.asnumpy() if isinstance(a, NDArray) else a for a in args]
+    kwds = {k: (v.asnumpy() if isinstance(v, NDArray) else v)
+            for k, v in kwds.items()}
+    onp.savez(file, *args, **kwds)
+
+
+def load(file, allow_pickle=False):
+    res = onp.load(file, allow_pickle=allow_pickle)
+    if isinstance(res, onp.lib.npyio.NpzFile):
+        return {k: array(res[k]) for k in res.files}
+    return array(res)

@@ -1,0 +1,127 @@
+"""mx.np.linalg — NumPy-semantics linear algebra.
+
+Parity with the reference's `mxnet.numpy.linalg`
+(src/operator/numpy/linalg/* kernels; python/mxnet/numpy/linalg.py).
+Decompositions lower to jax.numpy.linalg, which XLA executes on TPU
+(QR/SVD/eigh run via MXU-backed blocked algorithms; CPU fallback is
+automatic for the few unsupported ones on the host platform).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from ..ops import apply_op
+
+
+def _c(x):
+    from . import _coerce
+    return _coerce(x)
+
+
+def _u(fn, a, name, nout=1):
+    return apply_op(fn, _c(a), nout=nout, name=name)
+
+
+def norm(x, ord=None, axis=None, keepdims=False):
+    return _u(lambda a: jnp.linalg.norm(a, ord=ord, axis=axis,
+                                        keepdims=keepdims), x, "norm")
+
+
+def svd(a):
+    """Returns (U, L, Vt) like the reference's np.linalg.svd (note: the
+    reference returns UT/L/V in gufunc layout; we follow numpy (U, S, Vh))."""
+    return _u(lambda x: tuple(jnp.linalg.svd(x, full_matrices=False)), a,
+              "svd", nout=3)
+
+
+def cholesky(a, upper=False):
+    def f(x):
+        L = jnp.linalg.cholesky(x)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return _u(f, a, "cholesky")
+
+
+def qr(a, mode="reduced"):
+    return _u(lambda x: tuple(jnp.linalg.qr(x, mode=mode)), a, "qr", nout=2)
+
+
+def inv(a):
+    return _u(jnp.linalg.inv, a, "inv")
+
+
+def pinv(a, rcond=1e-15, hermitian=False):
+    return _u(lambda x: jnp.linalg.pinv(x, rcond=rcond,
+                                        hermitian=hermitian), a, "pinv")
+
+
+def det(a):
+    return _u(jnp.linalg.det, a, "det")
+
+
+def slogdet(a):
+    return _u(lambda x: tuple(jnp.linalg.slogdet(x)), a, "slogdet", nout=2)
+
+
+def solve(a, b):
+    return apply_op(jnp.linalg.solve, _c(a), _c(b), name="solve")
+
+
+def lstsq(a, b, rcond="warn"):
+    rc = None if rcond in ("warn", None) else rcond
+    outs = apply_op(lambda x, y: tuple(jnp.linalg.lstsq(x, y, rcond=rc)),
+                    _c(a), _c(b), nout=4, name="lstsq")
+    return outs
+
+
+def tensorinv(a, ind=2):
+    return _u(lambda x: jnp.linalg.tensorinv(x, ind=ind), a, "tensorinv")
+
+
+def tensorsolve(a, b, axes=None):
+    return apply_op(lambda x, y: jnp.linalg.tensorsolve(x, y, axes=axes),
+                    _c(a), _c(b), name="tensorsolve")
+
+
+def eig(a):
+    # general eig is CPU-only in XLA; route via host (parity: the
+    # reference's LAPACK geev also runs on CPU)
+    import numpy as onp
+    from . import array
+    w, v = onp.linalg.eig(_c(a).asnumpy())
+    return array(w.real if onp.isrealobj(w) or not onp.iscomplexobj(w) else w), \
+        array(v.real if not onp.iscomplexobj(v) else v)
+
+
+def eigh(a, UPLO="L"):
+    return _u(lambda x: tuple(jnp.linalg.eigh(x, UPLO=UPLO)), a, "eigh",
+              nout=2)
+
+
+def eigvals(a):
+    import numpy as onp
+    from . import array
+    w = onp.linalg.eigvals(_c(a).asnumpy())
+    return array(w.real if not onp.iscomplexobj(w) else w)
+
+
+def eigvalsh(a, UPLO="L"):
+    return _u(lambda x: jnp.linalg.eigvalsh(x, UPLO=UPLO), a, "eigvalsh")
+
+
+def matrix_rank(M, tol=None, hermitian=False):
+    return _u(lambda x: jnp.linalg.matrix_rank(x, tol=tol), M, "matrix_rank")
+
+
+def matrix_power(a, n):
+    return _u(lambda x: jnp.linalg.matrix_power(x, n), a, "matrix_power")
+
+
+def multi_dot(arrays):
+    arrs = [_c(a) for a in arrays]
+    return apply_op(lambda *xs: jnp.linalg.multi_dot(xs), *arrs,
+                    name="multi_dot")
+
+
+def cond(x, p=None):
+    return _u(lambda a: jnp.linalg.cond(a, p=p), x, "cond")

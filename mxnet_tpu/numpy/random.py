@@ -1,0 +1,288 @@
+"""mx.np.random — stateful-looking RNG over JAX's functional PRNG.
+
+Parity with the reference's `mxnet.numpy.random`
+(python/mxnet/numpy/random.py; kernels src/operator/numpy/random/*).
+A global key is split per call (see random_state.py); inside a
+hybridize trace, keys are derived from a traced key so compiled graphs
+resample per invocation like the reference's stateful samplers do.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .. import engine
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..random_state import next_key, seed as _seed
+from ..base import resolve_dtype
+
+_default_float = onp.float32
+
+
+def seed(seed_value):
+    _seed(int(seed_value))
+
+
+def _make(sample_fn, size, ctx=None, dtype=None):
+    """Run a jax.random sampler with a fresh key."""
+    shape = () if size is None else (
+        (size,) if isinstance(size, (int, onp.integer)) else tuple(size))
+    key = next_key()
+    data = sample_fn(key, shape)
+    if dtype is not None:
+        data = jnp.asarray(data, resolve_dtype(dtype))
+    ctx = ctx or current_context()
+    if not isinstance(data, jax.core.Tracer):
+        data = jax.device_put(data, ctx.jax_device)
+    return NDArray(engine.track(data), ctx=ctx)
+
+
+def _val(x):
+    return x._data if isinstance(x, NDArray) else x
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None,
+            device=None):
+    dtype = dtype or _default_float
+    if size is None:
+        try:
+            size = jnp.broadcast_shapes(onp.shape(_val(low)), onp.shape(_val(high)))
+        except Exception:
+            size = ()
+    low, high = _val(low), _val(high)
+    r = _make(lambda k, s: jax.random.uniform(
+        k, s, dtype=resolve_dtype(dtype), minval=low, maxval=high),
+        size, ctx or device)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None,
+           device=None):
+    dtype = dtype or _default_float
+    if size is None:
+        try:
+            size = jnp.broadcast_shapes(onp.shape(_val(loc)), onp.shape(_val(scale)))
+        except Exception:
+            size = ()
+    loc, scale = _val(loc), _val(scale)
+    r = _make(lambda k, s: loc + scale * jax.random.normal(
+        k, s, dtype=resolve_dtype(dtype)), size, ctx or device)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def randn(*size, dtype=None, ctx=None):
+    return normal(0.0, 1.0, size=size or None, dtype=dtype, ctx=ctx)
+
+
+def rand(*size, dtype=None, ctx=None):
+    return uniform(0.0, 1.0, size=size or None, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
+    if high is None:
+        low, high = 0, low
+    dtype = resolve_dtype(dtype) if dtype is not None else onp.int64
+    r = _make(lambda k, s: jax.random.randint(k, s, int(low), int(high),
+                                              dtype=dtype), size, ctx)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    if isinstance(a, NDArray):
+        arr = a._data
+    elif isinstance(a, (int, onp.integer)):
+        arr = jnp.arange(int(a))
+    else:
+        arr = jnp.asarray(a)
+    pp = _val(p) if p is not None else None
+    r = _make(lambda k, s: jax.random.choice(k, arr, shape=s, replace=replace,
+                                             p=pp), size, ctx)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def permutation(x, ctx=None):
+    if isinstance(x, (int, onp.integer)):
+        return _make(lambda k, s: jax.random.permutation(k, int(x)), None, ctx)
+    xv = _val(x) if isinstance(x, NDArray) else jnp.asarray(x)
+    return _make(lambda k, s: jax.random.permutation(k, xv), None, ctx)
+
+
+def shuffle(x):
+    """In-place shuffle along the first axis (parity: mx.np.random.shuffle)."""
+    key = next_key()
+    x._install(jax.random.permutation(key, x._data, axis=0))
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    a, b = _val(a), _val(b)
+    return _make(lambda k, s: jax.random.beta(k, a, b, shape=s or None),
+                 size, ctx, dtype or _default_float)
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    sh, sc = _val(shape), _val(scale)
+    r = _make(lambda k, s: jax.random.gamma(k, sh, shape=s or None) * sc,
+              size, ctx, dtype or _default_float)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    sc = _val(scale)
+    r = _make(lambda k, s: jax.random.exponential(k, s) * sc, size, ctx,
+              dtype or _default_float)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    lo, sc = _val(loc), _val(scale)
+    r = _make(lambda k, s: lo + sc * jax.random.laplace(k, s), size, ctx,
+              dtype or _default_float)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    lo, sc = _val(loc), _val(scale)
+    r = _make(lambda k, s: lo + sc * jax.random.logistic(k, s), size, ctx,
+              _default_float)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    lo, sc = _val(loc), _val(scale)
+    r = _make(lambda k, s: lo + sc * jax.random.gumbel(k, s), size, ctx,
+              _default_float)
+    if out is not None:
+        out._inplace(r)
+        return out
+    return r
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
+    m, sg = _val(mean), _val(sigma)
+    return _make(lambda k, s: jnp.exp(m + sg * jax.random.normal(k, s)),
+                 size, ctx, _default_float)
+
+
+def pareto(a, size=None, ctx=None):
+    av = _val(a)
+    return _make(lambda k, s: jax.random.pareto(k, av, shape=s or None) - 1.0,
+                 size, ctx, _default_float)
+
+
+def power(a, size=None, ctx=None):
+    av = _val(a)
+    return _make(lambda k, s: jnp.power(jax.random.uniform(k, s), 1.0 / av),
+                 size, ctx, _default_float)
+
+
+def rayleigh(scale=1.0, size=None, ctx=None):
+    sc = _val(scale)
+    return _make(
+        lambda k, s: sc * jnp.sqrt(-2.0 * jnp.log1p(-jax.random.uniform(k, s))),
+        size, ctx, _default_float)
+
+
+def weibull(a, size=None, ctx=None):
+    av = _val(a)
+    return _make(lambda k, s: jax.random.weibull_min(k, 1.0, av, shape=s or None),
+                 size, ctx, _default_float)
+
+
+def chisquare(df, size=None, dtype=None, ctx=None):
+    d = _val(df)
+    return _make(lambda k, s: 2.0 * jax.random.gamma(k, d / 2.0, shape=s or None),
+                 size, ctx, dtype or _default_float)
+
+
+def f(dfnum, dfden, size=None, ctx=None):
+    n, d = _val(dfnum), _val(dfden)
+
+    def sampler(k, s):
+        k1, k2 = jax.random.split(k)
+        num = 2.0 * jax.random.gamma(k1, n / 2.0, shape=s or None) / n
+        den = 2.0 * jax.random.gamma(k2, d / 2.0, shape=s or None) / d
+        return num / den
+
+    return _make(sampler, size, ctx, _default_float)
+
+
+def binomial(n, p, size=None, ctx=None):
+    nv, pv = _val(n), _val(p)
+    return _make(lambda k, s: jax.random.binomial(k, nv, pv, shape=s or None),
+                 size, ctx, _default_float)
+
+
+def negative_binomial(n, p, size=None, ctx=None):
+    nv, pv = _val(n), _val(p)
+
+    def sampler(k, s):
+        k1, k2 = jax.random.split(k)
+        lam = jax.random.gamma(k1, nv, shape=s or None) * (1 - pv) / pv
+        return jax.random.poisson(k2, lam)
+
+    return _make(sampler, size, ctx, _default_float)
+
+
+def poisson(lam=1.0, size=None, ctx=None):
+    lv = _val(lam)
+    return _make(lambda k, s: jax.random.poisson(k, lv, shape=s or None),
+                 size, ctx, _default_float)
+
+
+def geometric(p, size=None, ctx=None):
+    pv = _val(p)
+    return _make(lambda k, s: jax.random.geometric(k, pv, shape=s or None),
+                 size, ctx, _default_float)
+
+
+def multinomial(n, pvals, size=None):
+    pv = _val(pvals) if isinstance(pvals, NDArray) else jnp.asarray(pvals)
+
+    def sampler(k, s):
+        shape = s if s else ()
+        return jax.random.multinomial(k, n, pv, shape=shape + pv.shape[:-1]
+                                      if shape else None)
+
+    return _make(sampler, size, None, onp.int64)
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    m = _val(mean) if isinstance(mean, NDArray) else jnp.asarray(mean)
+    c = _val(cov) if isinstance(cov, NDArray) else jnp.asarray(cov)
+    return _make(lambda k, s: jax.random.multivariate_normal(
+        k, m, c, shape=s or None), size, None, _default_float)
+
+
+def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None):
+    if prob is not None:
+        pv = _val(prob)
+    else:
+        pv = jax.nn.sigmoid(_val(logit))
+    return _make(lambda k, s: jax.random.bernoulli(k, pv, shape=s or None),
+                 size, ctx, dtype or _default_float)

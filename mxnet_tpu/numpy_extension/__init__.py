@@ -1,0 +1,453 @@
+"""mx.npx — NumPy-extension operators (NN ops, framework specials).
+
+Parity with the reference's `mxnet.numpy_extension`
+(python/mxnet/numpy_extension/ + ndarray/numpy/_op.py npx section):
+activations, softmax family, convolution/pooling/norm wrappers, dropout,
+embedding/one_hot/pick/topk, sequence ops, and framework toggles
+(set_np & co are no-ops: numpy semantics are always on).
+
+These wrap ops/nn.py raw-jax kernels through apply_op, so they are
+differentiable, async, and trace-transparently under hybridize.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import set_np, reset_np, is_np_array, is_np_shape  # noqa: F401
+from ..ndarray.ndarray import NDArray
+from ..ops import apply_op
+from ..ops import nn as _nn
+from ..random_state import next_key
+from .. import autograd as _ag
+
+from . import random  # noqa: E402,F401  (npx.random: bernoulli etc.)
+
+
+def _c(x):
+    from ..numpy import _coerce
+    return _coerce(x)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+def activation(data, act_type="relu", **kwargs):
+    return apply_op(lambda x: _nn.activation(x, act_type), _c(data),
+                    name=f"activation_{act_type}")
+
+
+def relu(data, **kwargs):
+    return apply_op(jax.nn.relu, _c(data), name="relu")
+
+
+def sigmoid(data, **kwargs):
+    return apply_op(jax.nn.sigmoid, _c(data), name="sigmoid")
+
+
+def log_sigmoid(data, **kwargs):
+    return apply_op(jax.nn.log_sigmoid, _c(data), name="log_sigmoid")
+
+
+def softsign(data, **kwargs):
+    return apply_op(jax.nn.soft_sign, _c(data), name="softsign")
+
+
+def softplus(data, **kwargs):
+    return apply_op(jax.nn.softplus, _c(data), name="softplus")
+
+
+def mish(data, **kwargs):
+    return apply_op(lambda x: x * jnp.tanh(jax.nn.softplus(x)), _c(data),
+                    name="mish")
+
+
+def gelu(data, approximate=False, **kwargs):
+    return apply_op(lambda x: jax.nn.gelu(x, approximate=approximate),
+                    _c(data), name="gelu")
+
+
+def silu(data, **kwargs):
+    return apply_op(jax.nn.silu, _c(data), name="silu")
+
+
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kwargs):
+    if gamma is not None:
+        return apply_op(
+            lambda x, g: _nn.leaky_relu(x, g, act_type=act_type, slope=slope),
+            _c(data), _c(gamma), name="leaky_relu")
+    return apply_op(
+        lambda x: _nn.leaky_relu(x, None, act_type=act_type, slope=slope),
+        _c(data), name="leaky_relu")
+
+
+def hard_sigmoid(data, alpha=0.2, beta=0.5, **kwargs):
+    return apply_op(lambda x: jnp.clip(alpha * x + beta, 0.0, 1.0), _c(data),
+                    name="hard_sigmoid")
+
+
+def hard_swish(data, **kwargs):
+    return apply_op(lambda x: x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0), _c(data),
+                    name="hard_swish")
+
+
+# ---------------------------------------------------------------------------
+# softmax family
+# ---------------------------------------------------------------------------
+def softmax(data, length=None, axis=-1, temperature=None, use_length=False,
+            dtype=None, **kwargs):
+    if use_length and length is not None:
+        r = apply_op(lambda x, ln: _nn.softmax(x, axis=axis,
+                                               temperature=temperature,
+                                               length=ln),
+                     _c(data), _c(length), name="softmax")
+    else:
+        r = apply_op(lambda x: _nn.softmax(x, axis=axis,
+                                           temperature=temperature),
+                     _c(data), name="softmax")
+    return r.astype(dtype) if dtype is not None else r
+
+
+def log_softmax(data, axis=-1, length=None, temperature=None, use_length=False,
+                dtype=None, **kwargs):
+    if use_length and length is not None:
+        r = apply_op(lambda x, ln: _nn.log_softmax(x, axis=axis,
+                                                   temperature=temperature,
+                                                   length=ln),
+                     _c(data), _c(length), name="log_softmax")
+    else:
+        r = apply_op(lambda x: _nn.log_softmax(x, axis=axis,
+                                               temperature=temperature),
+                     _c(data), name="log_softmax")
+    return r.astype(dtype) if dtype is not None else r
+
+
+def masked_softmax(data, mask=None, axis=-1, temperature=1.0, **kwargs):
+    if mask is None:
+        return softmax(data, axis=axis, temperature=temperature)
+    return apply_op(lambda x, m: _nn.masked_softmax(x, m.astype(bool),
+                                                    axis=axis,
+                                                    temperature=temperature),
+                    _c(data), _c(mask), name="masked_softmax")
+
+
+def masked_log_softmax(data, mask=None, axis=-1, temperature=1.0, **kwargs):
+    if mask is None:
+        return log_softmax(data, axis=axis, temperature=temperature)
+
+    def f(x, m):
+        m = m.astype(bool)
+        neg = -1e30 if x.dtype == jnp.bfloat16 else -jnp.inf
+        x = jnp.where(m, x, neg)
+        return jnp.where(m, jax.nn.log_softmax(x / temperature
+                                               if temperature != 1.0 else x,
+                                               axis=axis), neg)
+
+    return apply_op(f, _c(data), _c(mask), name="masked_log_softmax")
+
+
+def softmin(data, axis=-1, **kwargs):
+    return apply_op(lambda x: _nn.softmin(x, axis=axis), _c(data),
+                    name="softmin")
+
+
+# ---------------------------------------------------------------------------
+# layers
+# ---------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kwargs):
+    if no_bias or bias is None:
+        return apply_op(lambda a, w: _nn.fully_connected(a, w, None, flatten),
+                        _c(x), _c(weight), name="fully_connected")
+    return apply_op(lambda a, w, b: _nn.fully_connected(a, w, b, flatten),
+                    _c(x), _c(weight), _c(bias), name="fully_connected")
+
+
+def convolution(data=None, weight=None, bias=None, kernel=None, stride=1,
+                dilate=1, pad=0, num_filter=1, num_group=1, no_bias=False,
+                layout="NCHW", **kwargs):
+    if no_bias or bias is None:
+        return apply_op(
+            lambda x, w: _nn.convolution(x, w, None, kernel, stride, dilate,
+                                         pad, num_group, layout),
+            _c(data), _c(weight), name="convolution")
+    return apply_op(
+        lambda x, w, b: _nn.convolution(x, w, b, kernel, stride, dilate, pad,
+                                        num_group, layout),
+        _c(data), _c(weight), _c(bias), name="convolution")
+
+
+def deconvolution(data=None, weight=None, bias=None, kernel=None, stride=1,
+                  dilate=1, pad=0, adj=0, num_filter=1, num_group=1,
+                  no_bias=True, target_shape=None, layout="NCHW", **kwargs):
+    if no_bias or bias is None:
+        return apply_op(
+            lambda x, w: _nn.deconvolution(x, w, None, stride, dilate, pad,
+                                           adj, num_group, target_shape,
+                                           layout),
+            _c(data), _c(weight), name="deconvolution")
+    return apply_op(
+        lambda x, w, b: _nn.deconvolution(x, w, b, stride, dilate, pad, adj,
+                                          num_group, target_shape, layout),
+        _c(data), _c(weight), _c(bias), name="deconvolution")
+
+
+def pooling(data, kernel=1, pool_type="max", stride=None, pad=0,
+            global_pool=False, pooling_convention="valid",
+            count_include_pad=True, p_value=2, layout="NCHW", **kwargs):
+    return apply_op(
+        lambda x: _nn.pooling(x, kernel, pool_type, stride, pad, global_pool,
+                              pooling_convention, count_include_pad, p_value,
+                              layout),
+        _c(data), name="pooling")
+
+
+def batch_norm(x, gamma, beta, running_mean, running_var, eps=1e-5,
+               momentum=0.9, fix_gamma=False, use_global_stats=False,
+               output_mean_var=False, axis=1, **kwargs):
+    """Functional batch norm. In training mode (autograd.is_training()),
+    uses batch statistics and UPDATES running_mean/var in place (parity
+    with the reference's aux-state mutation, src/operator/nn/batch_norm.cc).
+    """
+    x, gamma, beta = _c(x), _c(gamma), _c(beta)
+    if fix_gamma:
+        gamma = type(gamma)(jnp.ones_like(gamma._data))
+    use_batch_stats = _ag.is_training() and not use_global_stats
+    if use_batch_stats:
+        out, mean, var = apply_op(
+            lambda a, g, b: _nn.batch_norm_train(a, g, b, axis=axis, eps=eps),
+            x, gamma, beta, nout=3, name="batch_norm")
+        # running-stat update is NOT part of the differentiable graph
+        with _ag.pause():
+            m = momentum
+            running_mean._stateful_update(
+                lambda old, new: m * old + (1 - m) * new, mean)
+            running_var._stateful_update(
+                lambda old, new: m * old + (1 - m) * new, var)
+        if output_mean_var:
+            return out, mean, var
+        return out
+    out = apply_op(
+        lambda a, g, b, mm, mv: _nn.batch_norm_inference(a, g, b, mm, mv,
+                                                         axis=axis, eps=eps),
+        x, gamma, beta, _c(running_mean), _c(running_var), name="batch_norm")
+    if output_mean_var:
+        return out, running_mean, running_var
+    return out
+
+
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, **kwargs):
+    return apply_op(lambda x, g, b: _nn.layer_norm(x, g, b, axis=axis, eps=eps),
+                    _c(data), _c(gamma), _c(beta), name="layer_norm")
+
+
+def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kwargs):
+    return apply_op(
+        lambda x, g, b: _nn.group_norm(x, g, b, num_groups=num_groups, eps=eps),
+        _c(data), _c(gamma), _c(beta), name="group_norm")
+
+
+def instance_norm(data, gamma, beta, eps=1e-5, **kwargs):
+    return apply_op(lambda x, g, b: _nn.instance_norm(x, g, b, eps=eps),
+                    _c(data), _c(gamma), _c(beta), name="instance_norm")
+
+
+def rms_norm(data, gamma, axis=-1, eps=1e-6, **kwargs):
+    return apply_op(lambda x, g: _nn.rms_norm(x, g, axis=axis, eps=eps),
+                    _c(data), _c(gamma), name="rms_norm")
+
+
+def l2_normalization(data, eps=1e-10, mode="instance", **kwargs):
+    return apply_op(lambda x: _nn.l2_normalization(x, eps=eps, mode=mode),
+                    _c(data), name="l2_normalization")
+
+
+def dropout(data, p=0.5, axes=None, mode="training", cudnn_off=None, **kwargs):
+    """Dropout. Active only under autograd.train_mode (parity:
+    src/operator/nn/dropout.cc 'training' mode semantics)."""
+    if p <= 0.0 or (mode == "training" and not _ag.is_training()):
+        return _c(data)
+    key = next_key()
+    return apply_op(lambda x: _nn.dropout(x, key, p=p, axes=axes), _c(data),
+                    name="dropout")
+
+
+def embedding(data, weight, input_dim=None, output_dim=None, dtype=None,
+              sparse_grad=False, **kwargs):
+    return apply_op(lambda i, w: _nn.embedding(i, w), _c(data), _c(weight),
+                    name="embedding")
+
+
+def one_hot(data, depth, on_value=1.0, off_value=0.0, dtype="float32",
+            **kwargs):
+    return apply_op(
+        lambda i: _nn.one_hot(i, depth, on_value, off_value, dtype),
+        _c(data), name="one_hot")
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False,
+         dtype="float32", **kwargs):
+    nout = 2 if ret_typ == "both" else 1
+    return apply_op(
+        lambda x: _nn.topk(x, k=k, axis=axis, ret_typ=ret_typ,
+                           is_ascend=is_ascend, dtype=dtype),
+        _c(data), nout=nout, name="topk")
+
+
+def pick(data, index, axis=-1, mode="clip", keepdims=False, **kwargs):
+    return apply_op(
+        lambda x, i: _nn.pick(x, i, axis=axis, mode=mode, keepdims=keepdims),
+        _c(data), _c(index), name="pick")
+
+
+def gamma(data, **kwargs):
+    return apply_op(lambda x: jnp.exp(jax.lax.lgamma(x)), _c(data),
+                    name="gamma")
+
+
+def gammaln(data, **kwargs):
+    return apply_op(jax.lax.lgamma, _c(data), name="gammaln")
+
+
+def erf(data, **kwargs):
+    return apply_op(jax.lax.erf, _c(data), name="erf")
+
+
+def erfinv(data, **kwargs):
+    return apply_op(jax.lax.erf_inv, _c(data), name="erfinv")
+
+
+def digamma(data, **kwargs):
+    return apply_op(jax.lax.digamma, _c(data), name="digamma")
+
+
+def rsqrt(data, **kwargs):
+    return apply_op(jax.lax.rsqrt, _c(data), name="rsqrt")
+
+
+def rcbrt(data, **kwargs):
+    return apply_op(lambda x: 1.0 / jnp.cbrt(x), _c(data), name="rcbrt")
+
+
+def index_add(data, indices, values, **kwargs):
+    return apply_op(lambda x, i, v: x.at[tuple(i)].add(v),
+                    _c(data), _c(indices), _c(values), name="index_add")
+
+
+def index_update(data, indices, values, **kwargs):
+    return apply_op(lambda x, i, v: x.at[tuple(i)].set(v),
+                    _c(data), _c(indices), _c(values), name="index_update")
+
+
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kwargs):
+    if sequence_length is None:
+        return apply_op(
+            lambda x: _nn.sequence_mask(x, None, False, value, axis),
+            _c(data), name="sequence_mask")
+    return apply_op(
+        lambda x, ln: _nn.sequence_mask(x, ln, use_sequence_length, value,
+                                        axis),
+        _c(data), _c(sequence_length), name="sequence_mask")
+
+
+def sequence_last(data, sequence_length=None, use_sequence_length=False,
+                  axis=0, **kwargs):
+    if sequence_length is None:
+        return apply_op(lambda x: _nn.sequence_last(x, None, False, axis),
+                        _c(data), name="sequence_last")
+    return apply_op(
+        lambda x, ln: _nn.sequence_last(x, ln, use_sequence_length, axis),
+        _c(data), _c(sequence_length), name="sequence_last")
+
+
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0, **kwargs):
+    if sequence_length is None:
+        return apply_op(lambda x: _nn.sequence_reverse(x, None, False, axis),
+                        _c(data), name="sequence_reverse")
+    return apply_op(
+        lambda x, ln: _nn.sequence_reverse(x, ln, use_sequence_length, axis),
+        _c(data), _c(sequence_length), name="sequence_reverse")
+
+
+def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kwargs):
+    def f(x):
+        if axis is None:
+            n = 1
+            for s in x.shape:
+                n *= s
+            return (start + step * jnp.arange(n, dtype=x.dtype)).reshape(x.shape)
+        n = x.shape[axis]
+        return start + step * jnp.arange(n, dtype=x.dtype)
+    return apply_op(f, _c(data), name="arange_like")
+
+
+def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None, **kwargs):
+    return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), _c(lhs),
+                    _c(rhs), name="broadcast_like")
+
+
+def shape_array(data, **kwargs):
+    from ..numpy import array
+    return array(onp.asarray(_c(data).shape), dtype=onp.int64)
+
+
+def reshape_like(lhs, rhs, **kwargs):
+    return apply_op(lambda a, b: jnp.reshape(a, b.shape), _c(lhs), _c(rhs),
+                    name="reshape_like")
+
+
+def slice_axis(data, axis, begin, end, **kwargs):
+    return _c(data).slice_axis(axis, begin, end)
+
+
+def gather_nd(data, indices, **kwargs):
+    return apply_op(lambda x, i: x[tuple(i.astype(jnp.int32))], _c(data),
+                    _c(indices), name="gather_nd")
+
+
+def scatter_nd(data, indices, shape, **kwargs):
+    def f(d, i):
+        out = jnp.zeros(shape, d.dtype)
+        return out.at[tuple(i.astype(jnp.int32))].set(d)
+    return apply_op(f, _c(data), _c(indices), name="scatter_nd")
+
+
+def smooth_l1(data, scalar=1.0, **kwargs):
+    def f(x):
+        s2 = scalar * scalar
+        return jnp.where(jnp.abs(x) < 1.0 / s2, 0.5 * s2 * jnp.square(x),
+                         jnp.abs(x) - 0.5 / s2)
+    return apply_op(f, _c(data), name="smooth_l1")
+
+
+def num_gpus():
+    from ..context import num_gpus as _n
+    return _n()
+
+
+def current_device():
+    from ..context import current_context
+    return current_context()
+
+
+def waitall():
+    from .. import engine
+    engine.waitall()
+
+
+def load(fname):
+    from ..utils_io import load as _load
+    return _load(fname)
+
+
+def save(fname, data):
+    from ..utils_io import save as _save
+    return _save(fname, data)
+
+
+# control flow (npx.foreach / while_loop / cond) lives in its own module
+from .control_flow import foreach, while_loop, cond  # noqa: E402,F401

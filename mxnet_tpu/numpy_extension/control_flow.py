@@ -1,0 +1,171 @@
+"""Control-flow operators: npx.foreach / while_loop / cond.
+
+Parity with the reference's control-flow ops
+(src/operator/npx_control_flow.cc; python/mxnet/numpy_extension/
+control_flow.py). TPU-native mapping:
+
+- In eager mode these run as plain Python control flow over NDArrays —
+  the reference's imperative path does the same (subgraphs executed
+  step-by-step through the engine).
+- Inside a hybridize trace, they lower to lax.scan / lax.while_loop /
+  lax.cond so the compiled graph is a single XLA program with
+  structured control flow (no unrolling, compiler-friendly).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray
+
+
+def _is_tracing(*arrays):
+    return any(isinstance(a._data, jax.core.Tracer) for a in arrays
+               if isinstance(a, NDArray))
+
+
+def _wrap(x):
+    return NDArray(x) if not isinstance(x, NDArray) else x
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap(v) for v in x)
+    return x
+
+
+def _rewrap(x):
+    if isinstance(x, (list, tuple)):
+        return type(x)(_rewrap(v) for v in x)
+    return NDArray(x) if isinstance(x, jax.Array) else x
+
+
+def foreach(body, data, init_states):
+    """Run `body(data_slice, states) -> (out, new_states)` over axis 0.
+
+    Returns (stacked_outputs, final_states).
+    """
+    single_data = isinstance(data, NDArray)
+    datas = (data,) if single_data else tuple(data)
+    states_is_list = isinstance(init_states, (list, tuple))
+    states = list(init_states) if states_is_list else [init_states]
+
+    if _is_tracing(*datas, *states):
+        def scan_body(carry, xs):
+            st = _rewrap(list(carry))
+            sl = _rewrap(xs)
+            out, new_st = body(sl[0] if single_data else list(sl),
+                               st if states_is_list else st[0])
+            if not isinstance(new_st, (list, tuple)):
+                new_st = [new_st]
+            return tuple(_unwrap(new_st)), _unwrap(out)
+
+        carry, ys = lax.scan(scan_body, tuple(_unwrap(states)),
+                             tuple(_unwrap(datas)))
+        final = _rewrap(list(carry))
+        outs = _rewrap(ys)
+        return outs, (final if states_is_list else final[0])
+
+    # eager: python loop (ops recorded op-by-op for autograd)
+    from ..numpy import stack
+    n = datas[0].shape[0]
+    outputs = []
+    cur = list(states)
+    for i in range(n):
+        sl = [d[i] for d in datas]
+        out, new_st = body(sl[0] if single_data else sl,
+                           cur if states_is_list else cur[0])
+        if not isinstance(new_st, (list, tuple)):
+            new_st = [new_st]
+        cur = list(new_st)
+        outputs.append(out)
+    if isinstance(outputs[0], (list, tuple)):
+        outs = type(outputs[0])(
+            stack([o[j] for o in outputs], axis=0)
+            for j in range(len(outputs[0])))
+    else:
+        outs = stack(outputs, axis=0)
+    return outs, (cur if states_is_list else cur[0])
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Parity: npx.while_loop. `cond(loop_vars)->bool-array`,
+    `func(loop_vars)->(step_output, new_loop_vars)`.
+
+    In eager mode returns (stacked_outputs, final_vars); outputs are
+    stacked over executed steps. In trace mode, step outputs are not
+    supported (dynamic count) — use foreach for scan-style collection.
+    """
+    vars_is_list = isinstance(loop_vars, (list, tuple))
+    cur = list(loop_vars) if vars_is_list else [loop_vars]
+
+    if _is_tracing(*cur):
+        def body_fn(vs):
+            st = _rewrap(list(vs))
+            out, new_vars = func(st if vars_is_list else st[0])
+            if out is not None and out != []:
+                raise ValueError(
+                    "while_loop step outputs are not supported inside a "
+                    "hybridized graph (dynamic shape); return [] and carry "
+                    "state via loop_vars")
+            if not isinstance(new_vars, (list, tuple)):
+                new_vars = [new_vars]
+            return tuple(_unwrap(new_vars))
+
+        def cond_fn(vs):
+            st = _rewrap(list(vs))
+            c = cond(st if vars_is_list else st[0])
+            return _unwrap(c).reshape(())
+
+        final = lax.while_loop(cond_fn, body_fn, tuple(_unwrap(cur)))
+        final = _rewrap(list(final))
+        return [], (final if vars_is_list else final[0])
+
+    from ..numpy import stack
+    outputs = []
+    steps = 0
+    while bool(cond(cur if vars_is_list else cur[0]).item() if
+               isinstance(cond(cur if vars_is_list else cur[0]), NDArray)
+               else cond(cur if vars_is_list else cur[0])):
+        out, new_vars = func(cur if vars_is_list else cur[0])
+        if not isinstance(new_vars, (list, tuple)):
+            new_vars = [new_vars]
+        cur = list(new_vars)
+        if out is not None and out != []:
+            outputs.append(out)
+        steps += 1
+        if max_iterations is not None and steps >= max_iterations:
+            break
+    if outputs:
+        if isinstance(outputs[0], (list, tuple)):
+            outs = [stack([o[j] for o in outputs], axis=0)
+                    for j in range(len(outputs[0]))]
+        else:
+            outs = stack(outputs, axis=0)
+    else:
+        outs = []
+    return outs, (cur if vars_is_list else cur[0])
+
+
+def cond(pred, then_func, else_func, inputs=None):
+    """Parity: npx.cond. pred may be a boolean NDArray."""
+    if inputs is None:
+        inputs = []
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+
+    if isinstance(pred, NDArray) and isinstance(pred._data, jax.core.Tracer):
+        def tf(vs):
+            return _unwrap(then_func(*_rewrap(list(vs))))
+
+        def ef(vs):
+            return _unwrap(else_func(*_rewrap(list(vs))))
+
+        out = lax.cond(pred._data.reshape(()).astype(bool), tf, ef,
+                       tuple(_unwrap(list(ins))))
+        return _rewrap(out)
+
+    p = bool(pred.item()) if isinstance(pred, NDArray) else bool(pred)
+    return then_func(*ins) if p else else_func(*ins)

@@ -1,0 +1,7 @@
+"""npx.random — extension samplers (parity: mxnet.numpy_extension.random)."""
+from __future__ import annotations
+
+from ..numpy.random import (  # noqa: F401
+    seed, bernoulli, uniform, normal, randint, gamma, exponential,
+    multinomial,
+)

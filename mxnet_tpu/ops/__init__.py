@@ -1,0 +1,128 @@
+"""Operator invocation machinery.
+
+This is the TPU-native replacement for the reference's imperative dispatch
+chain (python op wrapper → FFI → Imperative::Invoke → engine push →
+FCompute kernel; see SURVEY.md §3.1 and src/imperative/imperative.cc:98).
+
+Design: an "operator" here is a plain Python callable over raw
+``jax.Array`` values, already closed over its static attributes (axis,
+kernel size, ...). ``apply_op`` is the single funnel every frontend op
+goes through. It:
+
+1. unwraps NDArray arguments to raw jax values,
+2. dispatches eagerly through JAX (async: returns futures immediately —
+   the engine contract of the reference, engine.py),
+3. when autograd is recording and a differentiable input is on the tape,
+   captures the op's VJP (``jax.vjp``) at invoke time — the residuals it
+   stores are the moral equivalent of the reference's retained
+   forward buffers (Imperative::RecordOp, imperative.cc:204),
+4. wraps outputs back into NDArrays on the right context.
+
+Shape/dtype inference (the reference's SetShapeType,
+imperative_utils.h:169) is performed by JAX's eager dispatch itself;
+kernel selection/fusion is XLA's job. There is deliberately no
+per-op jit here: eager JAX dispatch already lowers each primitive to a
+cached compiled kernel, and *graph-level* fusion happens when a model is
+hybridized (one whole-graph XLA program, see gluon/block.py).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as onp
+
+from .. import engine
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _needs_grad_dtype(dt) -> bool:
+    """Cotangents only exist for inexact dtypes."""
+    return onp.issubdtype(onp.dtype(dt), onp.inexact) or str(dt) == "bfloat16"
+
+
+def apply_op(fn, *args, nout: int = 1, ctx=None, name: str = None):
+    """Invoke ``fn`` over mixed NDArray / raw arguments.
+
+    Positional NDArray arguments are the differentiable inputs; all
+    static attributes must already be closed over in ``fn``.
+
+    Returns a single NDArray (nout==1) or a tuple of NDArrays.
+    """
+    from ..ndarray.ndarray import NDArray  # local: avoid import cycle
+    from .. import autograd
+
+    datas = []
+    nd_positions = []
+    for i, a in enumerate(args):
+        if isinstance(a, NDArray):
+            datas.append(a._data)
+            nd_positions.append(i)
+        else:
+            datas.append(a)
+
+    record = autograd.is_recording() and any(
+        autograd._on_tape(args[i]) for i in nd_positions
+    )
+
+    if record:
+        # Differentiate w.r.t. float NDArray inputs only.
+        diff_idx = [
+            i
+            for i in nd_positions
+            if _needs_grad_dtype(datas[i].dtype)
+        ]
+        if diff_idx:
+            def closed(*diff_datas):
+                # Always return a tuple so every VJP takes a tuple
+                # cotangent (uniform backward calling convention).
+                full = list(datas)
+                for j, d in zip(diff_idx, diff_datas):
+                    full[j] = d
+                out = fn(*full)
+                return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+            outs, vjp_fn = jax.vjp(closed, *[datas[i] for i in diff_idx])
+            # Int-valued outputs (argmax of a diff op etc.) can't carry
+            # cotangents; if none of the outputs are inexact, drop the tape.
+            if any(_needs_grad_dtype(o.dtype) for o in outs):
+                wrapped = _wrap_outputs(outs, args, nd_positions, ctx)
+                autograd._record(
+                    name or getattr(fn, "__name__", "op"),
+                    closed,
+                    vjp_fn,
+                    [args[i] for i in diff_idx],
+                    wrapped,
+                )
+                return wrapped[0] if nout == 1 and len(wrapped) == 1 else tuple(wrapped)
+            # fall through: treat as non-differentiable
+            return _finish(outs, args, nd_positions, ctx, nout)
+
+    out = fn(*datas)
+    outs = out if isinstance(out, (tuple, list)) else (out,)
+    return _finish(outs, args, nd_positions, ctx, nout)
+
+
+def _infer_ctx(args, nd_positions, ctx):
+    if ctx is not None:
+        return ctx
+    for i in nd_positions:
+        return args[i].ctx
+    from ..context import current_context
+
+    return current_context()
+
+
+def _wrap_outputs(outs, args, nd_positions, ctx):
+    from ..ndarray.ndarray import NDArray
+
+    octx = _infer_ctx(args, nd_positions, ctx)
+    return [NDArray(engine.track(o), ctx=octx) for o in outs]
+
+
+def _finish(outs, args, nd_positions, ctx, nout):
+    wrapped = _wrap_outputs(outs, args, nd_positions, ctx)
+    if nout == 1 and len(wrapped) == 1:
+        return wrapped[0]
+    return tuple(wrapped)

@@ -1,0 +1,490 @@
+"""Neural-network operators over raw jax arrays.
+
+TPU-native equivalents of the reference's src/operator/nn/* kernels
+(convolution.cc, pooling.cc, batch_norm.cc, softmax, dropout, fully
+connected, layer/group/instance norm). Instead of hand-written
+CUDA/oneDNN kernels these lower to XLA HLO: convolutions and matmuls
+map directly onto the MXU via lax.conv_general_dilated / dot_general in
+(optionally) bfloat16; elementwise epilogues fuse into them during XLA
+compilation. All functions are pure: stateful pieces (BN running stats,
+dropout RNG) are threaded explicitly by the callers in gluon/ and npx.
+
+Layout note: the reference defaults to NCHW/OIHW. XLA:TPU internally
+prefers NHWC and will transpose as needed; we accept both via `layout`
+and default to NCHW for API parity. The Gluon conv layers expose
+`layout='NHWC'` for peak TPU throughput.
+"""
+from __future__ import annotations
+
+import math
+from typing import Sequence, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _tuplize(v, n):
+    if isinstance(v, (int, onp.integer)):
+        return (int(v),) * n
+    t = tuple(int(x) for x in v)
+    if len(t) == 1:
+        return t * n
+    return t
+
+
+# ---------------------------------------------------------------------------
+# dense / conv / pooling
+# ---------------------------------------------------------------------------
+def fully_connected(x, weight, bias=None, flatten=True):
+    """y = x @ W^T + b (parity: src/operator/nn/fully_connected.cc).
+
+    weight layout: (out_units, in_units) — reference layout.
+    """
+    if flatten and x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    y = lax.dot_general(x, weight, (((x.ndim - 1,), (1,)), ((), ())))
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+def _conv_dims(layout: str):
+    """(lhs_spec, rhs_spec, out_spec) for lax.conv_general_dilated."""
+    if layout in ("NCHW", "NCW", "NCDHW"):
+        n = len(layout) - 2
+        spatial = "DHW"[-n:] if layout.startswith("NCD") else ("W" if n == 1 else "HW")
+        lhs = "NC" + spatial
+        rhs = "OI" + spatial
+        out = "NC" + spatial
+    else:  # NHWC family
+        n = len(layout) - 2
+        spatial = layout[1:-1]
+        lhs = "N" + spatial + "C"
+        rhs = "O" + spatial + "I"
+        out = "N" + spatial + "C"
+    return lhs, rhs, out
+
+
+def convolution(x, weight, bias=None, kernel=None, stride=1, dilate=1, pad=0,
+                num_group=1, layout="NCHW"):
+    """N-D convolution (parity: src/operator/nn/convolution.cc).
+
+    weight layout matches the reference: (out_ch, in_ch/groups, *kernel)
+    for NCHW; (out_ch, *kernel, in_ch/groups) for NHWC.
+    """
+    nsp = x.ndim - 2
+    stride = _tuplize(stride, nsp)
+    dilate = _tuplize(dilate, nsp)
+    pad = _tuplize(pad, nsp)
+    lhs, rhs, out = _conv_dims(layout)
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs, out))
+    y = lax.conv_general_dilated(
+        x, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+    )
+    if bias is not None:
+        if layout.startswith("NC"):
+            y = y + bias.reshape((1, -1) + (1,) * nsp)
+        else:
+            y = y + bias
+    return y
+
+
+def deconvolution(x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
+                  num_group=1, target_shape=None, layout="NCHW"):
+    """Transposed convolution (parity: src/operator/nn/deconvolution.cc).
+
+    weight layout (reference): (in_ch, out_ch/groups, *kernel).
+    """
+    nsp = x.ndim - 2
+    stride = _tuplize(stride, nsp)
+    dilate = _tuplize(dilate, nsp)
+    pad = _tuplize(pad, nsp)
+    adj = _tuplize(adj, nsp)
+    # Implement as gradient of convolution: lax.conv_transpose with
+    # explicit padding chosen to mimic the reference's output size:
+    #   out = (in-1)*stride - 2*pad + dilate*(k-1) + 1 + adj
+    if layout.startswith("NC"):
+        kshape = weight.shape[2:]
+    else:
+        kshape = weight.shape[1:-1]
+    pads = []
+    for i in range(nsp):
+        k = (kshape[i] - 1) * dilate[i] + 1
+        lo = k - 1 - pad[i]
+        hi = k - 1 - pad[i] + adj[i]
+        pads.append((lo, hi))
+    lhs, rhs, out = _conv_dims(layout)
+    # conv_transpose wants IO spatial weight; reference deconv weight is
+    # (in, out/g, *k) which matches "IO" + spatial.
+    if layout.startswith("NC"):
+        rhs_spec = "IO" + rhs[2:]
+    else:
+        rhs_spec = "I" + rhs[1:-1] + "O"
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs_spec, out))
+    if num_group != 1:
+        # grouped deconv: split channels, run per group, concat
+        cax = 1 if layout.startswith("NC") else x.ndim - 1
+        xs = jnp.split(x, num_group, axis=cax)
+        ws = jnp.split(weight, num_group, axis=0)
+        ys = [lax.conv_transpose(xg, wg, strides=stride, padding=pads,
+                                 rhs_dilation=dilate, dimension_numbers=dn,
+                                 transpose_kernel=False)
+              for xg, wg in zip(xs, ws)]
+        y = jnp.concatenate(ys, axis=cax)
+    else:
+        y = lax.conv_transpose(x, weight, strides=stride, padding=pads,
+                               rhs_dilation=dilate, dimension_numbers=dn,
+                               transpose_kernel=False)
+    if bias is not None:
+        if layout.startswith("NC"):
+            y = y + bias.reshape((1, -1) + (1,) * nsp)
+        else:
+            y = y + bias
+    return y
+
+
+def pooling(x, kernel=1, pool_type="max", stride=None, pad=0,
+            global_pool=False, pooling_convention="valid",
+            count_include_pad=True, p_value=2, layout="NCHW"):
+    """Pooling (parity: src/operator/nn/pooling.cc)."""
+    nsp = x.ndim - 2
+    channel_last = not layout.startswith("NC")
+    if global_pool:
+        axes = tuple(range(1, 1 + nsp)) if channel_last else \
+            tuple(range(2, 2 + nsp))
+        if pool_type == "max":
+            return jnp.max(x, axis=axes, keepdims=True)
+        if pool_type == "avg":
+            return jnp.mean(x, axis=axes, keepdims=True)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p_value), axis=axes,
+                                 keepdims=True), 1.0 / p_value)
+    kernel = _tuplize(kernel, nsp)
+    stride = _tuplize(stride if stride is not None else kernel, nsp)
+    pad = _tuplize(pad, nsp)
+
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    if pooling_convention == "full":
+        # ceil instead of floor for output size: pad extra on the high side
+        new_pads = list(pads)
+        off = 1 if channel_last else 2
+        for i in range(nsp):
+            in_sz = x.shape[off + i]
+            k, s, p = kernel[i], stride[i], pad[i]
+            out_full = int(math.ceil((in_sz + 2 * p - k) / s)) + 1
+            needed = (out_full - 1) * s + k - in_sz - p
+            new_pads[off + i] = (p, max(needed, p))
+        pads = tuple(new_pads)
+    elif pooling_convention == "same":
+        new_pads = list(pads)
+        off = 1 if channel_last else 2
+        for i in range(nsp):
+            in_sz = x.shape[off + i]
+            k, s = kernel[i], stride[i]
+            out_same = int(math.ceil(in_sz / s))
+            total = max((out_same - 1) * s + k - in_sz, 0)
+            new_pads[off + i] = (total // 2, total - total // 2)
+        pads = tuple(new_pads)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else \
+            jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(x, 0.0 if jnp.issubdtype(x.dtype, jnp.floating)
+                              else 0, lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return s
+        if count_include_pad:
+            denom = 1
+            for k in kernel:
+                denom *= k
+            return s / denom
+        ones = jnp.ones(x.shape, x.dtype)
+        cnt = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return s / cnt
+    if pool_type == "lp":
+        s = lax.reduce_window(jnp.power(jnp.abs(x), p_value), 0.0, lax.add,
+                              window, strides, pads)
+        return jnp.power(s, 1.0 / p_value)
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def batch_norm_train(x, gamma, beta, axis=1, eps=1e-5):
+    """Returns (out, batch_mean, batch_var). Caller updates running stats.
+
+    Parity: src/operator/nn/batch_norm.cc forward-train. var is the
+    biased (population) variance like the reference.
+    """
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    compute_dtype = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    xc = x.astype(compute_dtype)
+    mean = jnp.mean(xc, axis=axes)
+    var = jnp.var(xc, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    inv = lax.rsqrt(var + eps).reshape(shape)
+    out = (xc - mean.reshape(shape)) * inv
+    out = out * gamma.astype(compute_dtype).reshape(shape) + \
+        beta.astype(compute_dtype).reshape(shape)
+    return out.astype(x.dtype), mean, var
+
+
+def batch_norm_inference(x, gamma, beta, moving_mean, moving_var, axis=1,
+                         eps=1e-5):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    compute_dtype = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    xc = x.astype(compute_dtype)
+    inv = lax.rsqrt(moving_var.astype(compute_dtype) + eps).reshape(shape)
+    out = (xc - moving_mean.astype(compute_dtype).reshape(shape)) * inv
+    out = out * gamma.astype(compute_dtype).reshape(shape) + \
+        beta.astype(compute_dtype).reshape(shape)
+    return out.astype(x.dtype)
+
+
+def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    """Parity: src/operator/nn/layer_norm.cc."""
+    mean = jnp.mean(x, axis=axis, keepdims=True)
+    var = jnp.var(x, axis=axis, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    if axis < 0:
+        axis += x.ndim
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):
+    """Parity: src/operator/nn/group_norm.cc. Layout NC+spatial."""
+    n, c = x.shape[0], x.shape[1]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + x.shape[2:])
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = ((xg - mean) * lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1] * x.ndim
+    shape[1] = c
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def instance_norm(x, gamma, beta, eps=1e-5):
+    """Parity: src/operator/instance_norm.cc. Layout NC+spatial."""
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = [1] * x.ndim
+    shape[1] = x.shape[1]
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+def l2_normalization(x, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / norm
+
+
+def rms_norm(x, gamma, axis=-1, eps=1e-6):
+    """RMSNorm (no reference analog; standard for modern LLM blocks)."""
+    ms = jnp.mean(jnp.square(x), axis=axis, keepdims=True)
+    return x * lax.rsqrt(ms + eps) * gamma
+
+
+# ---------------------------------------------------------------------------
+# activations / softmax
+# ---------------------------------------------------------------------------
+def activation(x, act_type):
+    if act_type == "relu":
+        return jax.nn.relu(x)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(x)
+    if act_type == "log_sigmoid":
+        return jax.nn.log_sigmoid(x)
+    if act_type == "tanh":
+        return jnp.tanh(x)
+    if act_type == "softrelu":
+        return jax.nn.softplus(x)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(x)
+    if act_type == "mish":
+        return x * jnp.tanh(jax.nn.softplus(x))
+    raise ValueError(f"unknown activation {act_type!r}")
+
+
+def leaky_relu(x, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+               upper_bound=0.334):
+    """Parity: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu)."""
+    if act_type == "leaky":
+        return jnp.where(x >= 0, x, slope * x)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < x.ndim and g.ndim == 1:
+            shape = [1] * x.ndim
+            if x.ndim > 1:
+                shape[1] = g.shape[0] if g.shape[0] != 1 else 1
+            g = g.reshape(shape)
+        return jnp.where(x >= 0, x, g * x)
+    if act_type == "elu":
+        return jnp.where(x >= 0, x, slope * jnp.expm1(x))
+    if act_type == "selu":
+        return jax.nn.selu(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "gelu_tanh":
+        return jax.nn.gelu(x, approximate=True)
+    if act_type == "silu":
+        return jax.nn.silu(x)
+    raise ValueError(f"unknown leaky_relu act_type {act_type!r}")
+
+
+def softmax(x, axis=-1, temperature=None, length=None):
+    """Parity: src/operator/nn/softmax.cc (with optional length masking)."""
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        x = _mask_by_length(x, length, axis)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, temperature=None, length=None):
+    if temperature is not None and temperature != 1.0:
+        x = x / temperature
+    if length is not None:
+        x = _mask_by_length(x, length, axis)
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def _mask_by_length(x, length, axis):
+    ax = axis % x.ndim
+    idx = jnp.arange(x.shape[ax])
+    idx = idx.reshape((1,) * ax + (-1,) + (1,) * (x.ndim - ax - 1))
+    ln = length.reshape(length.shape + (1,) * (x.ndim - length.ndim))
+    mask = idx < ln
+    return jnp.where(mask, x, -jnp.inf)
+
+
+def masked_softmax(x, mask=None, axis=-1, temperature=1.0):
+    if temperature != 1.0:
+        x = x / temperature
+    if mask is not None:
+        x = jnp.where(mask, x, -1e30 if x.dtype == jnp.bfloat16 else -jnp.inf)
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# dropout / misc
+# ---------------------------------------------------------------------------
+def dropout(x, key, p=0.5, axes=None):
+    """Parity: src/operator/nn/dropout.cc. Inverted dropout; `axes`
+    broadcasts the mask (spatial dropout)."""
+    if p <= 0.0:
+        return x
+    shape = list(x.shape)
+    if axes:
+        for ax in axes:
+            shape[ax] = 1
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, tuple(shape))
+    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+
+
+def embedding(indices, weight, sparse_grad=False):
+    """Parity: src/operator/tensor/indexing_op.cc Embedding."""
+    return jnp.take(weight, indices.astype(jnp.int32), axis=0)
+
+
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    return jax.nn.one_hot(indices, depth, dtype=jnp.dtype(dtype)) * \
+        (on_value - off_value) + off_value
+
+
+def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    ax = axis % x.ndim
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "indices":
+        return idx.astype(jnp.dtype(dtype))
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idx.astype(jnp.dtype(dtype))
+    raise ValueError(f"unsupported ret_typ {ret_typ!r}")
+
+
+def pick(x, index, axis=-1, mode="clip", keepdims=False):
+    """Parity: src/operator/tensor/broadcast_reduce_op_index.cc pick."""
+    ax = axis % x.ndim
+    idx = jnp.expand_dims(index.astype(jnp.int32), ax)
+    out = jnp.take_along_axis(x, idx, axis=ax)
+    return out if keepdims else jnp.squeeze(out, axis=ax)
+
+
+def sequence_mask(x, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    """Parity: src/operator/sequence_mask.cc (time-major by default)."""
+    if not use_sequence_length or sequence_length is None:
+        return x
+    t = x.shape[axis]
+    idx = jnp.arange(t)
+    idx = idx.reshape((-1, 1) if axis == 0 else (1, -1))
+    ln = sequence_length.reshape((1, -1) if axis == 0 else (-1, 1))
+    mask = idx < ln
+    mask = mask.reshape(mask.shape + (1,) * (x.ndim - 2))
+    return jnp.where(mask, x, value)
+
+
+def sequence_last(x, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * x.ndim
+        idx[axis] = -1
+        return x[tuple(idx)]
+    ln = (sequence_length - 1).astype(jnp.int32)
+    xm = jnp.moveaxis(x, axis, 0)
+    batch = jnp.arange(xm.shape[1])
+    return xm[ln, batch]
+
+
+def sequence_reverse(x, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(x, axis=axis)
+    xm = jnp.moveaxis(x, axis, 0)
+    t = xm.shape[0]
+    idx = jnp.arange(t).reshape(-1, 1)
+    ln = sequence_length.reshape(1, -1).astype(jnp.int32)
+    rev_idx = jnp.where(idx < ln, ln - 1 - idx, idx)
+    out = jnp.take_along_axis(
+        xm, rev_idx.reshape(rev_idx.shape + (1,) * (xm.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)

@@ -1,0 +1,162 @@
+"""parallel — device meshes, sharding, and collectives.
+
+This is the TPU-native replacement for the reference's communication
+stack (SURVEY.md §2.3): CommDevice/NCCL/ps-lite collapse into XLA
+collectives over a named jax.sharding.Mesh. The mesh axes convention:
+
+- 'dp' — data parallel (batch sharding; gradient psum rides ICI)
+- 'tp' — tensor/model parallel (weight sharding)
+- 'pp' — pipeline stages (lax.scan over stages / shard_map)
+- 'sp' — sequence/context parallel (long-context; ring attention)
+- 'ep' — expert parallel (MoE all-to-all)
+
+`set_mesh`/`get_mesh` hold the process-global mesh (like the
+reference's global kvstore). `shard`/`replicate` produce
+NamedShardings; `shard_batch`/`shard_params` place NDArrays.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import numpy as onp
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..ndarray.ndarray import NDArray
+from .. import engine
+
+P = PartitionSpec
+
+_global_mesh: Optional[Mesh] = None
+
+AXIS_DP = "dp"
+AXIS_TP = "tp"
+AXIS_PP = "pp"
+AXIS_SP = "sp"
+AXIS_EP = "ep"
+
+
+def make_mesh(shape=None, axis_names=None, devices=None) -> Mesh:
+    """Build a Mesh. Default: all local devices on a 1-D 'dp' axis."""
+    devices = devices if devices is not None else jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+        axis_names = axis_names or (AXIS_DP,)
+    axis_names = tuple(axis_names or
+                       (AXIS_DP, AXIS_TP, AXIS_PP, AXIS_SP, AXIS_EP)[:len(shape)])
+    arr = onp.asarray(devices).reshape(shape)
+    return Mesh(arr, axis_names)
+
+
+def set_mesh(mesh: Mesh):
+    global _global_mesh
+    _global_mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _global_mesh
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    global _global_mesh
+    prev = _global_mesh
+    _global_mesh = mesh
+    try:
+        yield mesh
+    finally:
+        _global_mesh = prev
+
+
+def sharding(spec: PartitionSpec, mesh: Mesh = None) -> NamedSharding:
+    mesh = mesh or _global_mesh
+    if mesh is None:
+        raise RuntimeError("no mesh set; call parallel.set_mesh first")
+    return NamedSharding(mesh, spec)
+
+
+def replicate(value: NDArray, mesh: Mesh = None) -> NDArray:
+    """Replicate an array over the mesh (parity: kvstore broadcast)."""
+    s = sharding(P(), mesh)
+    value._install(jax.device_put(value._data, s))
+    return value
+
+
+def shard_batch(value: NDArray, axis=0, mesh: Mesh = None,
+                axis_name=AXIS_DP) -> NDArray:
+    """Shard the batch axis over the 'dp' mesh axis."""
+    spec = [None] * value.ndim
+    spec[axis] = axis_name
+    s = sharding(P(*spec), mesh)
+    value._install(jax.device_put(value._data, s))
+    return value
+
+
+def shard_params(params, rules=None, mesh: Mesh = None):
+    """Place gluon Parameters onto the mesh.
+
+    rules: list of (regex, PartitionSpec); first match wins; default
+    replicated. Parameter.sharding records the spec for pjit wiring.
+    """
+    import re
+    mesh = mesh or _global_mesh
+    compiled = [(re.compile(pat), spec) for pat, spec in (rules or [])]
+    for name, p in params.items():
+        spec = P()
+        for pat, s in compiled:
+            if pat.search(name):
+                spec = s
+                break
+        p.sharding = spec
+        if p._data is not None:
+            p._data._install(jax.device_put(p._data._data,
+                                            NamedSharding(mesh, spec)))
+
+
+def allreduce(value: NDArray, op="sum", mesh: Mesh = None,
+              axis_name=AXIS_DP) -> NDArray:
+    """Explicit cross-device reduction of a per-device-sharded array.
+
+    Under pjit/global arrays, reductions happen inside the compiled
+    program; this helper exists for the imperative KVStore path: it
+    sums the shards of an array sharded on axis 0 and returns the
+    replicated result (parity: kvstore push+pull).
+    """
+    mesh = mesh or _global_mesh
+    data = value._data
+    rep = sharding(P(), mesh)
+    out = jax.jit(lambda x: x, out_shardings=rep)(data)
+    value._install(out)
+    return value
+
+
+def num_partitions(mesh: Mesh = None, axis_name=AXIS_DP) -> int:
+    mesh = mesh or _global_mesh
+    if mesh is None:
+        return 1
+    return mesh.shape.get(axis_name, 1)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def initialize_distributed(coordinator_address=None, num_processes=None,
+                           process_id=None):
+    """Multi-host init (parity: the reference's DMLC_* env bootstrap →
+    jax.distributed; DCN collectives then ride the same mesh)."""
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs = dict(coordinator_address=coordinator_address,
+                      num_processes=num_processes, process_id=process_id)
+    jax.distributed.initialize(**kwargs)

@@ -1,0 +1,153 @@
+"""Profiler (parity: python/mxnet/profiler.py over src/profiler/).
+
+The reference emits chrome://tracing JSON from its engine hooks. On TPU
+the equivalent timeline comes from the XLA/PJRT profiler (Xprof): we
+wrap jax.profiler — traces are written as TensorBoard/Xprof protobufs
+AND a chrome-trace .json.gz (viewable at chrome://tracing or Perfetto),
+which covers the reference's `profile_all` surface. Python-side scopes
+map to jax.profiler.TraceAnnotation so custom Task/Frame markers land
+in the same timeline.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+_config = {
+    "filename": "profile.json",
+    "profile_all": False,
+    "profile_symbolic": True,
+    "profile_imperative": True,
+    "profile_memory": True,
+    "profile_api": True,
+    "aggregate_stats": False,
+}
+_state = {"running": False, "dir": None}
+
+
+def set_config(**kwargs):
+    """Parity: mx.profiler.set_config (filename→output directory stem)."""
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    set_config(filename=filename)
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+def start(profile_process="worker"):
+    if _state["running"]:
+        return
+    logdir = os.path.splitext(_config["filename"])[0] + "_xprof"
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    _state["running"] = True
+    _state["dir"] = logdir
+
+
+def stop(profile_process="worker"):
+    if not _state["running"]:
+        return
+    jax.profiler.stop_trace()
+    _state["running"] = False
+
+
+def dump(finished=True, profile_process="worker"):
+    stop()
+
+
+def dumps(reset=False, format="table", sort_by="total", ascending=False):
+    return f"profiler traces under {_state['dir']}" if _state["dir"] else ""
+
+
+def pause(profile_process="worker"):
+    stop()
+
+
+def resume(profile_process="worker"):
+    start()
+
+
+class Task:
+    """Named scope (parity: mx.profiler.Task)."""
+
+    def __init__(self, domain=None, name="task"):
+        self.name = name
+        self._ann = None
+
+    def start(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+
+    def stop(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Frame(Task):
+    pass
+
+
+class Event(Task):
+    pass
+
+
+class Counter:
+    def __init__(self, domain=None, name="counter", value=None):
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.increment(v)
+        return self
+
+    def __isub__(self, v):
+        self.decrement(v)
+        return self
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_frame(self, name):
+        return Frame(self, name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_event(self, name):
+        return Event(self, name)
+
+
+class Scope(Task):
+    """Annotation scope also used by memory profiling in the reference."""

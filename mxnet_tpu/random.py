@@ -1,0 +1,20 @@
+"""mx.random (parity: python/mxnet/random.py) — seeds + legacy sampler
+aliases delegating to mx.np.random."""
+from __future__ import annotations
+
+from .numpy.random import (  # noqa: F401
+    uniform, normal, randint, poisson, exponential, gamma,
+    multinomial, shuffle, randn, beta, laplace,
+)
+from .random_state import seed as _seed
+
+
+def seed(seed_state, ctx="all"):
+    _seed(int(seed_state))
+
+
+negative_binomial = None
+try:
+    from .numpy.random import negative_binomial  # noqa: F401
+except Exception:
+    pass

@@ -1,0 +1,66 @@
+"""Global RNG state.
+
+The reference threads RNG through per-device Resource random generators
+(mshadow Random, requested via ResourceRequest::kRandom). JAX is
+functional: randomness is an explicit PRNG key. For the *imperative* API
+(mx.np.random.*) we keep a global key that is split per call —
+user-visible behavior matches the reference's stateful
+`mx.np.random.seed(n)` semantics.
+
+When a model is being traced for hybridize (see gluon/block.py), random
+ops must NOT bake a concrete key into the graph (every call would replay
+the same mask). The tracer installs a *trace key* here; `next_key()`
+then folds a per-call counter into that traced key so each random op in
+the graph gets a distinct, run-time-fresh subkey.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+
+class _RngState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.trace_key = None   # set while tracing a CachedOp
+        self.trace_counter = 0
+
+
+_state = _RngState()
+_lock = threading.Lock()
+
+
+def seed(seed_value: int):
+    """Seed the global generator (parity: mx.np.random.seed)."""
+    _state.key = jax.random.PRNGKey(seed_value)
+    _state.trace_counter = 0
+
+
+def next_key():
+    """A fresh PRNG key; trace-aware (see module docstring)."""
+    if _state.trace_key is not None:
+        _state.trace_counter += 1
+        return jax.random.fold_in(_state.trace_key, _state.trace_counter)
+    with _lock:
+        _state.key, sub = jax.random.split(_state.key)
+    return sub
+
+
+class trace_rng:
+    """Scope used by the hybridize tracer: random ops derive keys from
+    the given (traced) key instead of the global concrete state."""
+
+    def __init__(self, key):
+        self._key = key
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = (_state.trace_key, _state.trace_counter)
+        _state.trace_key = self._key
+        _state.trace_counter = 0
+        return self
+
+    def __exit__(self, *exc):
+        _state.trace_key, _state.trace_counter = self._saved
+        return False

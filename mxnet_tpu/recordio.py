@@ -1,0 +1,225 @@
+"""RecordIO (parity: python/mxnet/recordio.py + dmlc-core recordio).
+
+Binary-compatible with the reference's format so datasets packed by the
+reference's im2rec tooling load unchanged:
+
+- Records framed with magic 0xced7230a + length word; payload padded to
+  4 bytes (dmlc-core/include/dmlc/recordio.h).
+- `IRHeader` (flag, label, id, id2) image-record header struct packed
+  ahead of the payload (python/mxnet/recordio.py IRHeader).
+- `MXIndexedRecordIO` pairs the .rec with a text .idx of
+  "key\\tbyte-offset" lines.
+
+A native (C++) reader with mmap + threaded decode backs the high-
+throughput path (src_native/); this module is the portable
+reference implementation and the writer.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+from collections import namedtuple
+
+import numpy as onp
+
+_MAGIC = 0xced7230a
+_LENGTH_MASK = (1 << 29) - 1
+
+IRHeader = namedtuple("HEADER", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential reader/writer (parity: mx.recordio.MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self.fhandle = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fhandle = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fhandle = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise ValueError("Invalid flag %s" % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.fhandle.close()
+            self.is_open = False
+            self.pid = None
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        is_open = self.is_open
+        self.close()
+        d = dict(self.__dict__)
+        d["is_open"] = is_open
+        d.pop("fhandle", None)
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.fhandle = None
+        is_open = d.get("is_open", False)
+        self.is_open = False
+        if is_open:
+            self.open()
+
+    def _check_pid(self, allow_reset=False):
+        if self.pid != os.getpid():
+            if allow_reset:
+                self.reset()
+            else:
+                raise RuntimeError("Forbidden operation in a forked process")
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self._check_pid(allow_reset=False)
+        header = struct.pack("<II", _MAGIC, len(buf) & _LENGTH_MASK)
+        self.fhandle.write(header)
+        self.fhandle.write(buf)
+        pad = (4 - (len(buf) % 4)) % 4
+        if pad:
+            self.fhandle.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid(allow_reset=True)
+        header = self.fhandle.read(8)
+        if len(header) < 8:
+            return None
+        magic, length = struct.unpack("<II", header)
+        if magic != _MAGIC:
+            raise RuntimeError(f"Invalid magic number {magic:#x} in {self.uri}")
+        length &= _LENGTH_MASK
+        buf = self.fhandle.read(length)
+        pad = (4 - (length % 4)) % 4
+        if pad:
+            self.fhandle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.fhandle.tell()
+
+    def seek(self, pos):
+        assert not self.writable
+        self.fhandle.seek(pos)
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Random-access reader/writer with .idx (parity:
+    mx.recordio.MXIndexedRecordIO)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self.writable:
+            with open(self.idx_path, "w") as fout:
+                for key in self.keys:
+                    fout.write(f"{key}\t{self.idx[key]}\n")
+        super().close()
+
+    def __getstate__(self):
+        d = super().__getstate__()
+        return d
+
+    def seek(self, idx):
+        super().seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack an IRHeader + payload (parity: mx.recordio.pack)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (onp.ndarray, list, tuple)):
+        label = onp.asarray(header.label, dtype=onp.float32)
+        header = header._replace(flag=label.size, label=0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                       header.id2) + s
+
+
+def unpack(s: bytes):
+    """Unpack to (IRHeader, payload) (parity: mx.recordio.unpack)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = onp.frombuffer(s[:header.flag * 4], dtype=onp.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image (HWC uint8 numpy) and pack it."""
+    import io as _io
+    from PIL import Image
+    arr = img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+    pil = Image.fromarray(arr.astype(onp.uint8).squeeze())
+    buf = _io.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    pil.save(buf, format=fmt, quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1):
+    """Unpack + decode an image record to (IRHeader, HWC ndarray)."""
+    import io as _io
+    from PIL import Image
+    header, payload = unpack(s)
+    pil = Image.open(_io.BytesIO(payload))
+    if iscolor == 0:
+        pil = pil.convert("L")
+    elif iscolor == 1:
+        pil = pil.convert("RGB")
+    img = onp.asarray(pil)
+    return header, img

@@ -1,0 +1,70 @@
+"""Runtime feature detection (parity: python/mxnet/runtime.py over
+src/libinfo.cc)."""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"✔ {self.name}" if self.enabled else f"✖ {self.name}"
+
+
+def _detect():
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    devices = jax.devices()
+    feats = OrderedDict()
+    feats["TPU"] = backend not in ("cpu",)
+    feats["CUDA"] = False
+    feats["CUDNN"] = False
+    feats["NCCL"] = False
+    feats["XLA"] = True
+    feats["PJRT"] = True
+    feats["PALLAS"] = True
+    feats["BF16"] = True
+    feats["INT64_TENSOR_SIZE"] = True
+    feats["OPENMP"] = True
+    feats["DIST_KVSTORE"] = True
+    feats["F16C"] = True
+    feats["MKLDNN"] = False
+    feats["ONEDNN"] = False
+    feats["TENSORRT"] = False
+    feats["OPENCV"] = False
+    feats["PROFILER"] = True
+    feats["DEVICE_COUNT"] = len(devices) > 0
+    return feats
+
+
+class LibInfo:
+    def features(self):
+        return [Feature(k, v) for k, v in _detect().items()]
+
+
+def feature_list():
+    return LibInfo().features()
+
+
+class Features(OrderedDict):
+    instance = None
+
+    def __init__(self):
+        super().__init__([(f.name, f) for f in feature_list()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"Feature '{feature_name}' is unknown, "
+                               f"known features are: {list(self.keys())}")
+        return self[feature_name].enabled

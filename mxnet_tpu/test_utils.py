@@ -1,0 +1,198 @@
+"""Test utilities (parity: python/mxnet/test_utils.py).
+
+Key pieces the reference's test strategy relies on (SURVEY.md §4):
+``assert_almost_equal`` with per-dtype default tolerances, the finite-
+difference ``check_numeric_gradient``, ``default_context``, and random
+array helpers. The cpu-vs-gpu ``check_consistency`` harness becomes
+cpu-vs-tpu here.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .context import Context, cpu, current_context, default_context  # noqa: F401
+from .ndarray.ndarray import NDArray
+from . import autograd
+from . import numpy as mxnp
+
+_rng = onp.random.RandomState(1234)
+
+default_dtype = onp.float32
+
+
+def default_rtols():
+    return {onp.dtype(onp.float16): 1e-2,
+            onp.dtype(onp.float32): 1e-4,
+            onp.dtype(onp.float64): 1e-6,
+            onp.dtype(bool): 0,
+            onp.dtype(onp.int32): 0,
+            onp.dtype(onp.int64): 0}
+
+
+def default_atols():
+    return {onp.dtype(onp.float16): 1e-1,
+            onp.dtype(onp.float32): 1e-3,
+            onp.dtype(onp.float64): 1e-20,
+            onp.dtype(bool): 0,
+            onp.dtype(onp.int32): 0,
+            onp.dtype(onp.int64): 0}
+
+
+def _to_numpy(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def find_max_violation(a, b, rtol, atol):
+    diff = onp.abs(a - b)
+    tol = atol + rtol * onp.abs(b)
+    viol = diff - tol
+    idx = onp.unravel_index(onp.argmax(viol), viol.shape) if viol.size else ()
+    return idx, float(diff[idx]) if viol.size else 0.0
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False, use_broadcast=True, mismatches=(10, 10)):
+    a_np, b_np = _to_numpy(a), _to_numpy(b)
+    if rtol is None:
+        rtol = default_rtols().get(onp.dtype(a_np.dtype), 1e-5)
+    if atol is None:
+        atol = default_atols().get(onp.dtype(a_np.dtype), 1e-8)
+    try:
+        onp.testing.assert_allclose(a_np, b_np, rtol=rtol, atol=atol,
+                                    equal_nan=equal_nan)
+    except AssertionError as exc:
+        raise AssertionError(
+            f"{names[0]} and {names[1]} differ beyond rtol={rtol} "
+            f"atol={atol}:\n{exc}") from None
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return onp.array_equal(_to_numpy(a), _to_numpy(b))
+
+
+def rand_ndarray(shape, dtype=onp.float32, ctx=None, low=-1.0, high=1.0):
+    return mxnp.array(_rng.uniform(low, high, size=shape).astype(dtype),
+                      ctx=ctx)
+
+
+def random_arrays(*shapes):
+    arrays = [_rng.standard_normal(size=s).astype(onp.float32) for s in shapes]
+    if len(arrays) == 1:
+        return arrays[0]
+    return arrays
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_rng.randint(1, dim0 + 1), _rng.randint(1, dim1 + 1),
+            _rng.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_rng.randint(1, dim + 1, size=num_dim))
+
+
+def effective_dtype(x):
+    return onp.dtype(x.dtype)
+
+
+def check_numeric_gradient(f, inputs, grad_outputs=None, eps=1e-4,
+                           rtol=1e-2, atol=1e-4, dtype=onp.float64):
+    """Finite-difference gradient check of a python function over
+    NDArrays (parity: mxnet.test_utils.check_numeric_gradient, adapted
+    to the functional frontend: `f(*inputs) -> NDArray scalar-or-array`).
+
+    Compares autograd gradients with central differences.
+    """
+    inputs = [mxnp.array(_to_numpy(x), dtype=dtype) for x in inputs]
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = f(*inputs)
+        if grad_outputs is None:
+            loss = out.sum()
+        else:
+            loss = (out * mxnp.array(grad_outputs, dtype=dtype)).sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    def fval(arrs):
+        o = f(*[mxnp.array(a, dtype=dtype) for a in arrs])
+        if grad_outputs is None:
+            return float(o.sum().item())
+        return float((o * mxnp.array(grad_outputs, dtype=dtype)).sum().item())
+
+    raw = [x.asnumpy().astype(onp.float64) for x in inputs]
+    for k, base in enumerate(raw):
+        num = onp.zeros_like(base)
+        flat = base.reshape(-1)
+        nflat = num.reshape(-1)
+        for i in range(flat.size):
+            orig = flat[i]
+            flat[i] = orig + eps
+            fp = fval(raw)
+            flat[i] = orig - eps
+            fm = fval(raw)
+            flat[i] = orig
+            nflat[i] = (fp - fm) / (2 * eps)
+        onp.testing.assert_allclose(
+            analytic[k], num, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch for input {k}")
+
+
+def check_consistency(f, inputs, ctx_list=None, rtol=1e-3, atol=1e-4):
+    """Run f on each context and compare outputs (parity: the reference's
+    cpu-vs-gpu check_consistency, here cpu-vs-tpu)."""
+    from .context import cpu, tpu, num_gpus
+    if ctx_list is None:
+        ctx_list = [cpu()] + ([tpu()] if num_gpus() > 0 else [])
+    outs = []
+    for ctx in ctx_list:
+        ins = [x.as_in_context(ctx) for x in inputs]
+        outs.append(_to_numpy(f(*ins)))
+    for o in outs[1:]:
+        onp.testing.assert_allclose(outs[0], o, rtol=rtol, atol=atol)
+    return outs
+
+
+def discard_stderr(func):
+    return func
+
+
+def set_default_device(ctx):
+    Context._default_ctx.value = ctx
+
+
+def environment(name, value):
+    import os
+    from contextlib import contextmanager
+
+    @contextmanager
+    def _scope():
+        old = os.environ.get(name)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = value
+        try:
+            yield
+        finally:
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+
+    return _scope()

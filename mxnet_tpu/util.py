@@ -1,0 +1,90 @@
+"""mx.util parity shims.
+
+The reference's np-shape/np-array toggles exist because its legacy
+mx.nd semantics differ from NumPy. This framework is NumPy-semantics
+everywhere, so the decorators/scopes are identity-pass-throughs kept for
+source compatibility (python/mxnet/util.py).
+"""
+from __future__ import annotations
+
+import functools
+
+
+class _NoopScope:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __call__(self, func):
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            return func(*args, **kwargs)
+        return wrapper
+
+
+def np_shape(active=True):
+    return _NoopScope()
+
+
+def np_array(active=True):
+    return _NoopScope()
+
+
+def use_np_shape(func):
+    return func
+
+
+def use_np_array(func):
+    return func
+
+
+def use_np(func):
+    return func
+
+
+def use_np_default_dtype(func):
+    return func
+
+
+def is_np_shape():
+    return True
+
+
+def is_np_array():
+    return True
+
+
+def set_np(shape=True, array=True, dtype=False):
+    return None
+
+
+def reset_np():
+    return None
+
+
+def get_gpu_count():
+    from .context import num_gpus
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    from .context import gpu_memory_info
+    return gpu_memory_info(gpu_dev_id)
+
+
+def getenv(name):
+    import os
+    v = os.environ.get(name)
+    return v
+
+
+def setenv(name, value):
+    import os
+    os.environ[name] = value
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    from .numpy import array
+    return array(source_array, ctx=ctx, dtype=dtype)

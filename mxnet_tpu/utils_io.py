@@ -1,0 +1,39 @@
+"""NDArray serialization: mx.nd.save / mx.nd.load parity.
+
+The reference uses a custom binary format (magic+version header,
+NDArray::Save/Load, src/ndarray/ndarray.cc:1729,1852) plus .npy/.npz via
+src/serialization/cnpy.cc. Here the container format IS .npz (zip of
+.npy members) — portable, inspectable, and loadable by plain NumPy.
+A dict saves keys verbatim; a list saves under reserved keys
+``__list_N`` preserving order.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+
+def save(fname, data):
+    from .numpy import array  # noqa: F401
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        payload = {f"__list_{i}": d.asnumpy() for i, d in enumerate(data)}
+    elif isinstance(data, dict):
+        payload = {k: v.asnumpy() for k, v in data.items()}
+    else:
+        raise TypeError(f"cannot save {type(data)}")
+    with open(fname, "wb") as f:
+        onp.savez(f, **payload)
+
+
+def load(fname):
+    from .numpy import array
+
+    with onp.load(fname, allow_pickle=False) as npz:
+        keys = list(npz.files)
+        if keys and all(k.startswith("__list_") for k in keys):
+            keys.sort(key=lambda k: int(k[len("__list_"):]))
+            return [array(npz[k]) for k in keys]
+        return {k: array(npz[k]) for k in keys}

@@ -1,0 +1,29 @@
+"""Test configuration.
+
+Tests run on a virtual 8-device CPU mesh (the driver validates real
+multi-chip separately via __graft_entry__.dryrun_multichip). The axon
+TPU plugin ignores JAX_PLATFORMS, so we also force the platform via
+jax.config before mxnet_tpu import.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    """Deterministic per-test seeding (parity: the reference's seed
+    fixture in tests/python/unittest/common.py)."""
+    import mxnet_tpu as mx
+    mx.np.random.seed(0)
+    import numpy as onp
+    onp.random.seed(0)
+    yield

@@ -1,0 +1,173 @@
+"""Autograd tests (model: tests/python/unittest/test_autograd.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd
+from mxnet_tpu.test_utils import check_numeric_gradient, assert_almost_equal
+
+
+def test_simple_backward():
+    x = np.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x + 2 * x).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.array([1, 2, 3]) + 2)
+
+
+def test_chain_and_fanout():
+    x = np.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 3
+        b = a * a + a
+        c = (b + a).sum()
+    c.backward()
+    # c = 9x^2 + 6x; dc/dx = 18x + 6 = 42
+    onp.testing.assert_allclose(x.grad.asnumpy(), [42.0], rtol=1e-5)
+
+
+def test_grad_req_add_and_zero_grad():
+    x = np.array([1., 2.])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 3 * 2 * onp.array([1, 2]))
+    x.zero_grad()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0, 0])
+
+
+def test_head_gradient():
+    x = np.array([1., 2., 3.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(np.array([1., 10., 100.]))
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2., 20., 200.])
+
+
+def test_retain_graph():
+    x = np.array([3.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    g1 = x.grad.asnumpy().copy()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), g1)
+
+
+def test_detach_and_pause():
+    x = np.array([2.])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # only d(z)/dx through second factor = y = 4
+        with autograd.pause():
+            w = x * 100  # not recorded
+        out = z.sum()
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_autograd_grad_function():
+    x = np.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    (gx,) = autograd.grad(y, [x])
+    onp.testing.assert_allclose(gx.asnumpy(), 3 * onp.array([1., 4.]),
+                                rtol=1e-5)
+    # .grad buffers untouched by autograd.grad
+    onp.testing.assert_allclose(x.grad.asnumpy(), [0., 0.])
+
+
+def test_mark_variables():
+    x = np.array([5.])
+    g = np.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = (x * 4).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.])
+
+
+def test_training_modes():
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_training() and autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training() and not autograd.is_recording()
+
+
+def test_dropout_respects_mode():
+    x = np.ones((100,))
+    out_eval = npx.dropout(x, p=0.5)
+    onp.testing.assert_allclose(out_eval.asnumpy(), onp.ones(100))
+    with autograd.record(train_mode=True):
+        out_train = npx.dropout(x, p=0.5)
+    a = out_train.asnumpy()
+    assert (a == 0).sum() > 10 and (a > 1.5).sum() > 10
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = npx.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = np.array([0.5, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-onp.array([0.5, -1.0])))
+    onp.testing.assert_allclose(x.grad.asnumpy(), s * (1 - s), rtol=1e-5)
+
+
+def test_numeric_gradient_elemwise():
+    check_numeric_gradient(lambda x: np.tanh(x) * x,
+                           [onp.random.randn(3, 4)])
+
+
+def test_numeric_gradient_matmul():
+    check_numeric_gradient(lambda a, b: (a @ b).sum(),
+                           [onp.random.randn(3, 4), onp.random.randn(4, 2)])
+
+
+def test_numeric_gradient_softmax():
+    check_numeric_gradient(
+        lambda x: (npx.log_softmax(x) * np.array([[1., 0., 0.],
+                                                  [0., 1., 0.]])).sum(),
+        [onp.random.randn(2, 3)])
+
+
+def test_higher_order_create_graph():
+    x = np.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True, retain_graph=True)
+        z = gx.sum()
+    z.backward()
+    # d2y/dx2 = 6x = 12
+    onp.testing.assert_allclose(x.grad.asnumpy(), [12.0], rtol=1e-4)
+
+
+def test_exception_at_sync_point():
+    # shape errors surface at dispatch (eager); device errors at wait.
+    a = np.ones((2, 3))
+    b = np.ones((4, 5))
+    with pytest.raises(Exception):
+        (a @ b).wait_to_read()

@@ -1,0 +1,185 @@
+"""Hybridize/CachedOp tests (model: test_gluon.py hybrid sections +
+CachedOp semantics, src/imperative/cached_op.cc)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _mlp():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_hybridize_matches_eager():
+    net = _mlp()
+    x = np.random.uniform(size=(3, 8))
+    y_eager = net(x).asnumpy()
+    net.hybridize()
+    y_hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(y_eager, y_hybrid, rtol=1e-5, atol=1e-6)
+    # second call hits the compiled cache
+    y2 = net(x * 2).asnumpy()
+    assert y2.shape == (3, 4)
+
+
+def test_hybridize_deferred_init():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="tanh"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = np.random.uniform(size=(5, 3))
+    out = net(x)
+    assert out.shape == (5, 2)
+    assert net[0].weight.shape == (6, 3)
+
+
+def test_hybridize_backward_matches_eager():
+    net = _mlp()
+    x = np.random.uniform(size=(4, 8))
+
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {k: p.grad().asnumpy().copy()
+                   for k, p in net.collect_params().items()}
+
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, p in net.collect_params().items():
+        onp.testing.assert_allclose(p.grad().asnumpy(), eager_grads[k],
+                                    rtol=1e-4, atol=1e-5,
+                                    err_msg=f"grad mismatch for {k}")
+
+
+def test_hybridize_input_gradient():
+    net = _mlp()
+    net.hybridize()
+    x = np.random.uniform(size=(2, 8))
+    x.attach_grad()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert onp.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_hybridize_shape_change_recompiles():
+    net = _mlp()
+    net.hybridize()
+    out1 = net(np.ones((2, 8)))
+    out2 = net(np.ones((7, 8)))
+    assert out1.shape == (2, 4) and out2.shape == (7, 4)
+    assert len(net._cached_op._entries) == 2
+
+
+def test_hybridize_batchnorm_state_updates():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.BatchNorm())
+    net.initialize()
+    net.hybridize()
+    x = np.random.normal(5.0, 2.0, size=(16, 3))
+    rm0 = None
+    with autograd.record():
+        net(x)
+    bn = net[1]
+    rm0 = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    rm1 = bn.running_mean.data().asnumpy()
+    # running stats keep moving between hybridized calls
+    assert not onp.allclose(rm0, rm1)
+    # eval path uses the running stats without updating them
+    y = net(x)
+    onp.testing.assert_allclose(bn.running_mean.data().asnumpy(), rm1)
+
+
+def test_hybridize_dropout_resamples():
+    net = nn.HybridSequential()
+    net.add(nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = np.ones((64,))
+    with autograd.record():
+        a = net(x).asnumpy()
+        b = net(x).asnumpy()
+    assert (a != b).any(), "dropout mask must differ between calls"
+    # eval mode: identity
+    onp.testing.assert_allclose(net(x).asnumpy(), onp.ones(64))
+
+
+def test_hybridize_training_with_trainer():
+    onp.random.seed(1)
+    w_true = onp.array([[1.5], [-2.0]])
+    X = onp.random.randn(64, 2).astype(onp.float32)
+    Y = (X @ w_true).astype(onp.float32)
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.L2Loss()
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(np.array(X)), np.array(Y)).mean()
+        l.backward()
+        trainer.step(1)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(), w_true.T,
+                                atol=0.05)
+
+
+def test_export(tmp_path):
+    net = _mlp()
+    net.hybridize()
+    net(np.ones((1, 8)))
+    params_file, hlo_file = net.export(str(tmp_path / "model"))
+    import os
+    assert os.path.exists(params_file)
+    if hlo_file:
+        assert os.path.getsize(hlo_file) > 0
+
+
+def test_multi_output_forward():
+    class TwoHead(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.a = nn.Dense(3, in_units=4)
+            self.b = nn.Dense(2, in_units=4)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    net = TwoHead()
+    net.initialize()
+    net.hybridize()
+    ya, yb = net(np.ones((2, 4)))
+    assert ya.shape == (2, 3) and yb.shape == (2, 2)
+    with autograd.record():
+        ya, yb = net(np.ones((2, 4)))
+        loss = ya.sum() + (yb * 2).sum()
+    loss.backward()
+    # dloss/dW_b = 2 * sum_batch(x) = 2 * 2 = 4 for all-ones input
+    assert onp.abs(net.b.weight.grad().asnumpy() - 4.0).max() < 1e-5
+
+
+def test_control_flow_foreach_in_hybrid():
+    class Cumulate(nn.HybridBlock):
+        def forward(self, x):
+            def body(v, state):
+                new = state + v
+                return new, new
+            outs, final = npx.foreach(body, x, np.zeros(x.shape[1:]))
+            return outs
+
+    net = Cumulate()
+    net.initialize()
+    x = np.array(onp.arange(6).reshape(3, 2).astype(onp.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    onp.testing.assert_allclose(eager, onp.cumsum(x.asnumpy(), axis=0))
+    onp.testing.assert_allclose(hybrid, eager)

@@ -1,0 +1,160 @@
+"""NDArray semantics tests (model: tests/python/unittest/test_ndarray.py
+and test_numpy_ndarray.py in the reference)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+
+
+def test_creation_defaults():
+    a = np.array([1, 2, 3])
+    assert a.dtype == onp.float32  # reference semantics: default f32
+    b = np.array(onp.array([1, 2, 3], dtype=onp.int64))
+    assert b.dtype == onp.int64
+    z = np.zeros((2, 3))
+    assert z.shape == (2, 3) and z.dtype == onp.float32
+    f = np.full((2, 2), 7, dtype="int32")
+    assert f.asnumpy().tolist() == [[7, 7], [7, 7]]
+    r = np.arange(5)
+    assert r.dtype == onp.float32
+    assert np.linspace(0, 1, 5).shape == (5,)
+    assert np.eye(3).asnumpy().trace() == 3.0
+
+
+def test_arithmetic_and_broadcast():
+    a = np.array([[1., 2.], [3., 4.]])
+    b = np.array([10., 20.])
+    onp.testing.assert_allclose((a + b).asnumpy(), [[11, 22], [13, 24]])
+    onp.testing.assert_allclose((a * 2 + 1).asnumpy(), [[3, 5], [7, 9]])
+    onp.testing.assert_allclose((2 ** a).asnumpy(), [[2, 4], [8, 16]])
+    onp.testing.assert_allclose((a @ a).asnumpy(),
+                                onp.array([[1, 2], [3, 4]]) @
+                                onp.array([[1, 2], [3, 4]]))
+    onp.testing.assert_allclose((a / b).asnumpy(), [[0.1, 0.1], [0.3, 0.2]])
+    assert ((a > 2).asnumpy() == [[False, False], [True, True]]).all()
+
+
+def test_inplace_ops_bump_version():
+    a = np.ones((3,))
+    v0 = a._version
+    a += 1
+    assert a._version == v0 + 1
+    onp.testing.assert_allclose(a.asnumpy(), [2, 2, 2])
+    a *= 3
+    onp.testing.assert_allclose(a.asnumpy(), [6, 6, 6])
+
+
+def test_indexing():
+    a = np.arange(12).reshape(3, 4)
+    assert a[1, 2].item() == 6
+    onp.testing.assert_allclose(a[1].asnumpy(), [4, 5, 6, 7])
+    onp.testing.assert_allclose(a[:, 1].asnumpy(), [1, 5, 9])
+    onp.testing.assert_allclose(a[1:, :2].asnumpy(), [[4, 5], [8, 9]])
+    # boolean mask
+    m = a[a > 5]
+    onp.testing.assert_allclose(m.asnumpy(), [6, 7, 8, 9, 10, 11])
+    # integer fancy indexing
+    idx = np.array([0, 2], dtype="int64")
+    onp.testing.assert_allclose(a[idx].asnumpy(), [[0, 1, 2, 3],
+                                                   [8, 9, 10, 11]])
+    # negative step
+    onp.testing.assert_allclose(a[::-1][0].asnumpy(), [8, 9, 10, 11])
+
+
+def test_setitem():
+    a = np.zeros((3, 3))
+    a[1, 1] = 5
+    assert a[1, 1].item() == 5
+    a[0] = np.ones((3,))
+    onp.testing.assert_allclose(a[0].asnumpy(), [1, 1, 1])
+    a[:, 2] = 7
+    onp.testing.assert_allclose(a[:, 2].asnumpy(), [7, 7, 7])
+    with pytest.raises(Exception):
+        a[0] = onp.ones((4,))
+
+
+def test_astype_copyto_context():
+    a = np.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.copyto(mx.cpu())
+    assert c.ctx == mx.cpu()
+    d = a.as_in_context(mx.cpu())
+    assert d.ctx.device_type in ("cpu",)
+
+
+def test_scalar_conversions():
+    a = np.array([3.5])
+    assert float(a) == 3.5
+    assert a.item() == 3.5
+    assert int(np.array([7], dtype="int64").reshape(())) == 7
+    with pytest.raises(ValueError):
+        bool(np.array([1., 2.]))
+
+
+def test_reductions_match_numpy():
+    x = onp.random.randn(4, 5).astype(onp.float32)
+    a = np.array(x)
+    onp.testing.assert_allclose(a.sum().item(), x.sum(), rtol=1e-5)
+    onp.testing.assert_allclose(a.mean(axis=1).asnumpy(), x.mean(axis=1),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(a.max(axis=0).asnumpy(), x.max(axis=0))
+    onp.testing.assert_allclose(a.std().item(), x.std(), rtol=1e-4)
+    assert a.argmax().item() == x.argmax()
+
+
+def test_shape_ops():
+    a = np.arange(24).reshape(2, 3, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose(1, 0, 2).shape == (3, 2, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+    assert np.expand_dims(a, 0).shape == (1, 2, 3, 4)
+    assert np.squeeze(np.ones((1, 3, 1))).shape == (3,)
+    assert np.concatenate([a, a], axis=1).shape == (2, 6, 4)
+    assert np.stack([a, a]).shape == (2, 2, 3, 4)
+    parts = np.split(np.arange(10), 5)
+    assert len(parts) == 5 and parts[0].shape == (2,)
+    assert np.tile(np.ones((2,)), 3).shape == (6,)
+    assert np.flip(np.arange(3)).asnumpy().tolist() == [2, 1, 0]
+    assert np.broadcast_to(np.ones((1, 3)), (4, 3)).shape == (4, 3)
+
+
+def test_waitall_and_engine():
+    a = np.random.uniform(size=(64, 64))
+    b = a @ a
+    mx.waitall()
+    assert b.shape == (64, 64)
+    # naive (synchronous) engine mode
+    mx.engine.set_engine_type("NaiveEngine")
+    try:
+        c = a + 1
+        assert c.shape == (64, 64)
+    finally:
+        mx.engine.set_engine_type("ThreadedEnginePerDevice")
+
+
+def test_save_load(tmp_path):
+    f = str(tmp_path / "arrs")
+    d = {"w": np.ones((2, 2)), "b": np.zeros((3,))}
+    mx.nd.save(f, d)
+    loaded = mx.nd.load(f)
+    assert set(loaded) == {"w", "b"}
+    onp.testing.assert_allclose(loaded["w"].asnumpy(), 1)
+    lst = [np.ones((2,)), np.arange(3)]
+    mx.nd.save(f, lst)
+    loaded = mx.nd.load(f)
+    assert isinstance(loaded, list) and len(loaded) == 2
+
+
+def test_topk_pick_onehot():
+    from mxnet_tpu import npx
+    x = np.array([[1., 3., 2.], [0., -1., 5.]])
+    idx = npx.topk(x, k=1)
+    assert idx.asnumpy().astype(int).ravel().tolist() == [1, 2]
+    vals, ids = npx.topk(x, k=2, ret_typ="both")
+    assert vals.shape == (2, 2)
+    p = npx.pick(x, np.array([1, 2]))
+    onp.testing.assert_allclose(p.asnumpy(), [3., 5.])
+    oh = npx.one_hot(np.array([0, 2]), 3)
+    onp.testing.assert_allclose(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])

@@ -1,0 +1,146 @@
+"""Regression tests for review findings."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_sgld_noise_through_trainer():
+    """SGLD's custom update() must not be bypassed by the base jitted step."""
+    net = nn.Dense(1, in_units=4, use_bias=False)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgld",
+                            {"learning_rate": 0.01})
+    w0 = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        l = net(np.ones((1, 4))).sum()
+    l.backward()
+    trainer.step(1)
+    w1 = net.weight.data().asnumpy()
+    g = onp.ones((1, 4))  # d(sum(w.x))/dw for x=ones
+    plain_sgd = w0 - 0.01 * g
+    half_step = w0 - 0.005 * g
+    # SGLD = half-lr gradient step + Langevin noise: must differ from a
+    # noiseless plain-SGD step and from the exact noiseless half step.
+    assert not onp.allclose(w1, plain_sgd, atol=1e-7)
+    assert not onp.allclose(w1, half_step, atol=1e-7)
+    assert onp.abs(w1 - half_step).max() < 1.0  # noise is O(sqrt(lr))
+
+
+def test_cast_invalidates_cached_graph():
+    net = nn.Dense(3, in_units=4)
+    net.initialize()
+    net.hybridize()
+    x = np.ones((2, 4))
+    out32 = net(x)
+    assert out32.dtype == onp.float32
+    net.cast("float64")
+    out64 = net(x.astype("float64"))
+    assert out64.dtype == onp.float64
+    onp.testing.assert_allclose(out64.asnumpy(), out32.asnumpy(), rtol=1e-6)
+
+
+def test_param_cast_direct_invalidates():
+    net = nn.Dense(2, in_units=2, use_bias=False)
+    net.initialize()
+    net.hybridize()
+    x = np.ones((1, 2))
+    net(x)
+    # rebind parameter data directly (reset_ctx-style rebind)
+    net.weight.cast("float64")
+    out = net(x.astype("float64"))
+    assert out.dtype == onp.float64
+
+
+def test_histogram_weights():
+    h, edges = np.histogram(np.array([0.5, 0.5, 1.5]), bins=2, range=(0, 2),
+                            weights=np.array([10., 10., 10.]))
+    onp.testing.assert_allclose(h.asnumpy(), [20., 10.])
+
+
+def test_average_returned_on_list():
+    r, cnt = np.average([1.0, 2.0, 3.0], returned=True)
+    assert abs(float(r.item()) - 2.0) < 1e-6
+    assert float(cnt.item()) == 3.0
+
+
+def test_accuracy_n1_labels():
+    from mxnet_tpu.gluon import metric
+    acc = metric.Accuracy()
+    acc.update(np.array([[1], [0]]), np.array([[0.2, 0.8], [0.9, 0.1]]))
+    assert acc.get()[1] == 1.0
+
+
+def test_setattr_deregisters():
+    net = nn.Sequential()
+    net.fc = nn.Dense(4, in_units=3)
+    assert "fc" in net._children
+    net.fc = None
+    assert "fc" not in net._children
+    assert len(net.collect_params()) == 0
+    p = gluon.Parameter("w", shape=(1,))
+    net.w = p
+    assert "w" in net._reg_params
+    net.w = 5
+    assert "w" not in net._reg_params
+
+
+def test_mark_variables_single_array():
+    x = np.array([[1., 2.], [3., 4.]])
+    g = np.zeros((2, 2))
+    autograd.mark_variables(x, g)
+    with autograd.record():
+        y = (x * 2).sum()
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * onp.ones((2, 2)))
+
+
+def test_take_mode_raise_rejected():
+    import pytest
+    with pytest.raises(NotImplementedError):
+        np.take(np.array([1., 2., 3.]), [5], mode="raise")
+
+
+def test_double_backward_error_message():
+    import pytest
+    x = np.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError, match="retain_graph"):
+        y.backward()
+
+
+def test_prefetcher_thread_released_on_early_break():
+    import threading
+    import gc
+    import time
+    ds = gluon.data.ArrayDataset(onp.random.randn(64, 2).astype(onp.float32))
+    before = threading.active_count()
+    for _ in range(5):
+        loader = gluon.data.DataLoader(ds, batch_size=4, prefetch=2)
+        for _batch in loader:
+            break
+    gc.collect()
+    time.sleep(0.5)
+    after = threading.active_count()
+    assert after - before <= 1, (before, after)
+
+
+def test_ndarrayiter_roll_over():
+    import mxnet_tpu.io as mio
+    data = onp.arange(10).reshape(10, 1).astype(onp.float32)
+    it = mio.NDArrayIter(data, batch_size=4, last_batch_handle="roll_over")
+    epoch1 = [b.data[0].asnumpy() for b in it]
+    assert len(epoch1) == 2  # 8 samples used, 2 rolled over
+    it.reset()
+    epoch2 = [b.data[0].asnumpy() for b in it]
+    # epoch2 starts with the 2 rolled-over samples: 10 + 2 = 12 -> 3 batches
+    assert len(epoch2) == 3
+    assert epoch2[0][:2].ravel().tolist() == [8.0, 9.0]
+    # pad mode reports pad count
+    it2 = mio.NDArrayIter(data, batch_size=4, last_batch_handle="pad")
+    pads = [b.pad for b in it2]
+    assert pads == [0, 0, 2]

@@ -1,0 +1,101 @@
+"""End-to-end training slice (BASELINE.json config 1: Gluon MLP,
+imperative autograd, single device) — with a synthetic MNIST-like
+dataset since the sandbox has no network egress.
+
+Model: the reference's example/gluon/mnist flow —
+DataLoader → net(x) under autograd.record → loss.backward → trainer.step.
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _synthetic_mnist(n=512, num_classes=10, seed=0):
+    """Linearly-separable-ish 28x28 'digit' images."""
+    rng = onp.random.RandomState(seed)
+    protos = rng.randn(num_classes, 28 * 28).astype(onp.float32)
+    labels = rng.randint(0, num_classes, size=n)
+    imgs = protos[labels] + 0.3 * rng.randn(n, 28 * 28).astype(onp.float32)
+    return imgs.reshape(n, 28, 28, 1), labels.astype(onp.int32)
+
+
+def test_mlp_mnist_imperative():
+    X, Y = _synthetic_mnist()
+    dataset = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(dataset, batch_size=64, shuffle=True)
+
+    net = nn.Sequential()
+    net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(3):
+        metric.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            metric.update(label, out)
+    name, acc = metric.get()
+    assert acc > 0.95, f"epoch-3 train accuracy too low: {acc}"
+
+
+def test_cnn_mnist_hybridized():
+    X, Y = _synthetic_mnist(n=256)
+    X = X.transpose(0, 3, 1, 2)  # NCHW
+    dataset = gluon.data.ArrayDataset(X, Y)
+    loader = gluon.data.DataLoader(dataset, batch_size=64, shuffle=True,
+                                   last_batch="discard")
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, kernel_size=3, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Flatten(),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.005})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    first = last = None
+    for epoch in range(5):
+        total, count = 0.0, 0
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label).mean()
+            loss.backward()
+            trainer.step(1)
+            total += float(loss.item())
+            count += 1
+        avg = total / count
+        if first is None:
+            first = avg
+        last = avg
+    assert last < first * 0.7, (first, last)
+
+
+def test_validation_eval_mode():
+    X, Y = _synthetic_mnist(n=128)
+    net = nn.HybridSequential()
+    net.add(nn.Flatten(), nn.Dense(32, activation="relu"), nn.Dropout(0.5),
+            nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+    data = np.array(X)
+    # eval mode must be deterministic (dropout off)
+    o1 = net(data).asnumpy()
+    o2 = net(data).asnumpy()
+    onp.testing.assert_allclose(o1, o2)

@@ -1,0 +1,9 @@
+"""Model zoo (parity: python/mxnet/gluon/model_zoo/__init__.py).
+
+Pretrained-weight download is not available in this offline build;
+`model_store` loads weights from a local directory instead
+(MXNET_TPU_MODEL_DIR), keeping the reference's get_model_file API.
+"""
+from . import model_store  # noqa: F401
+from . import vision  # noqa: F401
+from .vision import get_model  # noqa: F401

@@ -1,0 +1,45 @@
+"""Local model-weight store (parity: gluon/model_zoo/model_store.py).
+
+The reference downloads sha1-pinned .params files from an S3 bucket
+(model_store.py:75 get_model_file). This build runs with zero egress,
+so the store resolves weights from a local directory instead:
+
+    MXNET_TPU_MODEL_DIR (default ~/.mxnet_tpu/models)/<name>.params
+
+`purge` keeps its reference semantics against that directory.
+"""
+import os
+import errno
+
+
+def data_dir():
+    return os.environ.get("MXNET_TPU_MODEL_DIR",
+                          os.path.join(os.path.expanduser("~"),
+                                       ".mxnet_tpu", "models"))
+
+
+def get_model_file(name, root=None):
+    """Return the path of a locally available pretrained weight file.
+
+    Raises FileNotFoundError (with guidance) when the file is absent —
+    the offline equivalent of the reference's failed download.
+    """
+    root = root if root is not None else data_dir()
+    path = os.path.join(root, f"{name}.params")
+    if os.path.exists(path):
+        return path
+    raise FileNotFoundError(
+        errno.ENOENT,
+        f"Pretrained weights for '{name}' not found at {path}. This "
+        "offline build cannot download weights; place a .params file "
+        "(flat dict saved with mxnet_tpu save) there or set "
+        "MXNET_TPU_MODEL_DIR.", path)
+
+
+def purge(root=None):
+    root = root if root is not None else data_dir()
+    if not os.path.isdir(root):
+        return
+    for f in os.listdir(root):
+        if f.endswith(".params"):
+            os.remove(os.path.join(root, f))

@@ -1,0 +1,124 @@
+"""DenseNet 121/161/169/201 (parity: gluon/model_zoo/vision/densenet.py)."""
+from __future__ import annotations
+
+from .... import numpy as _np
+from ....context import current_context
+from ... import nn
+from ...block import HybridBlock
+from ..model_store import get_model_file
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+
+def _bn_axis(layout):
+    return 1 if layout.startswith("NC") else 3
+
+
+class _DenseLayer(HybridBlock):
+    def __init__(self, growth_rate, bn_size, dropout, layout, dtype):
+        super().__init__()
+        ax = _bn_axis(layout)
+        self._concat_axis = ax
+        self.body = nn.HybridSequential()
+        self.body.add(nn.BatchNorm(axis=ax))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1,
+                                use_bias=False, layout=layout, dtype=dtype))
+        self.body.add(nn.BatchNorm(axis=ax))
+        self.body.add(nn.Activation("relu"))
+        self.body.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1,
+                                use_bias=False, layout=layout, dtype=dtype))
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.body(x)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return _np.concatenate([x, out], axis=self._concat_axis)
+
+
+def _make_dense_block(num_layers, bn_size, growth_rate, dropout, layout,
+                      dtype):
+    block = nn.HybridSequential()
+    for _ in range(num_layers):
+        block.add(_DenseLayer(growth_rate, bn_size, dropout, layout, dtype))
+    return block
+
+
+def _make_transition(num_output_features, layout, dtype):
+    out = nn.HybridSequential()
+    out.add(nn.BatchNorm(axis=_bn_axis(layout)))
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False,
+                      layout=layout, dtype=dtype))
+    out.add(nn.AvgPool2D(pool_size=2, strides=2, layout=layout))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, layout="NCHW",
+                 dtype="float32"):
+        super().__init__()
+        ax = _bn_axis(layout)
+        self.features = nn.HybridSequential()
+        self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
+                                    strides=2, padding=3, use_bias=False,
+                                    layout=layout, dtype=dtype))
+        self.features.add(nn.BatchNorm(axis=ax))
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1,
+                                       layout=layout))
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            self.features.add(_make_dense_block(
+                num_layers, bn_size, growth_rate, dropout, layout, dtype))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                num_features //= 2
+                self.features.add(_make_transition(num_features, layout,
+                                                   dtype))
+        self.features.add(nn.BatchNorm(axis=ax))
+        self.features.add(nn.Activation("relu"))
+        self.features.add(nn.GlobalAvgPool2D(layout=layout))
+        self.features.add(nn.Flatten())
+        self.output = nn.Dense(classes, dtype=dtype)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+densenet_spec = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+def get_densenet(num_layers, pretrained=False, ctx=None, root=None,
+                 **kwargs):
+    num_init_features, growth_rate, block_config = densenet_spec[num_layers]
+    net = DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+    if pretrained:
+        net.load_parameters(get_model_file(f"densenet{num_layers}",
+                                           root=root),
+                            device=ctx or current_context())
+    return net
+
+
+def densenet121(**kwargs):
+    return get_densenet(121, **kwargs)
+
+
+def densenet161(**kwargs):
+    return get_densenet(161, **kwargs)
+
+
+def densenet169(**kwargs):
+    return get_densenet(169, **kwargs)
+
+
+def densenet201(**kwargs):
+    return get_densenet(201, **kwargs)

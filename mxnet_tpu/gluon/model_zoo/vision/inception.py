@@ -1,0 +1,188 @@
+"""Inception V3 (parity: gluon/model_zoo/vision/inception.py)."""
+from __future__ import annotations
+
+from .... import numpy as _np
+from ....context import current_context
+from ... import nn
+from ...block import HybridBlock
+from ..model_store import get_model_file
+
+__all__ = ["Inception3", "inception_v3"]
+
+
+def _bn_axis(layout):
+    return 1 if layout.startswith("NC") else 3
+
+
+def _make_basic_conv(layout, dtype, **kwargs):
+    out = nn.HybridSequential()
+    out.add(nn.Conv2D(use_bias=False, layout=layout, dtype=dtype, **kwargs))
+    out.add(nn.BatchNorm(axis=_bn_axis(layout), epsilon=0.001))
+    out.add(nn.Activation("relu"))
+    return out
+
+
+def _make_branch(use_pool, layout, dtype, *conv_settings):
+    out = nn.HybridSequential()
+    if use_pool == "avg":
+        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1,
+                             layout=layout))
+    elif use_pool == "max":
+        out.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
+    setting_names = ["channels", "kernel_size", "strides", "padding"]
+    for setting in conv_settings:
+        kwargs = {name: value for name, value in zip(setting_names, setting)
+                  if value is not None}
+        out.add(_make_basic_conv(layout, dtype, **kwargs))
+    return out
+
+
+class _Concurrent(HybridBlock):
+    """Run children on the same input, concat outputs on channel axis."""
+
+    def __init__(self, axis):
+        super().__init__()
+        self._axis = axis
+        self._order = []
+
+    def add(self, block):
+        name = str(len(self._order))
+        self._order.append(name)
+        setattr(self, f"branch{name}", block)
+
+    def forward(self, x):
+        outs = [getattr(self, f"branch{n}")(x) for n in self._order]
+        return _np.concatenate(outs, axis=self._axis)
+
+
+def _make_A(pool_features, layout, dtype):
+    ax = _bn_axis(layout)
+    out = _Concurrent(ax)
+    out.add(_make_branch(None, layout, dtype, (64, 1, None, None)))
+    out.add(_make_branch(None, layout, dtype, (48, 1, None, None),
+                         (64, 5, None, 2)))
+    out.add(_make_branch(None, layout, dtype, (64, 1, None, None),
+                         (96, 3, None, 1), (96, 3, None, 1)))
+    out.add(_make_branch("avg", layout, dtype,
+                         (pool_features, 1, None, None)))
+    return out
+
+
+def _make_B(layout, dtype):
+    ax = _bn_axis(layout)
+    out = _Concurrent(ax)
+    out.add(_make_branch(None, layout, dtype, (384, 3, 2, None)))
+    out.add(_make_branch(None, layout, dtype, (64, 1, None, None),
+                         (96, 3, None, 1), (96, 3, 2, None)))
+    out.add(_make_branch("max", layout, dtype))
+    return out
+
+
+def _make_C(channels_7x7, layout, dtype):
+    ax = _bn_axis(layout)
+    out = _Concurrent(ax)
+    out.add(_make_branch(None, layout, dtype, (192, 1, None, None)))
+    out.add(_make_branch(None, layout, dtype,
+                         (channels_7x7, 1, None, None),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0))))
+    out.add(_make_branch(None, layout, dtype,
+                         (channels_7x7, 1, None, None),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (channels_7x7, (1, 7), None, (0, 3)),
+                         (channels_7x7, (7, 1), None, (3, 0)),
+                         (192, (1, 7), None, (0, 3))))
+    out.add(_make_branch("avg", layout, dtype, (192, 1, None, None)))
+    return out
+
+
+def _make_D(layout, dtype):
+    ax = _bn_axis(layout)
+    out = _Concurrent(ax)
+    out.add(_make_branch(None, layout, dtype, (192, 1, None, None),
+                         (320, 3, 2, None)))
+    out.add(_make_branch(None, layout, dtype, (192, 1, None, None),
+                         (192, (1, 7), None, (0, 3)),
+                         (192, (7, 1), None, (3, 0)),
+                         (192, 3, 2, None)))
+    out.add(_make_branch("max", layout, dtype))
+    return out
+
+
+class _ExpandedBranch(HybridBlock):
+    """A branch whose tail splits into two parallel convs (E blocks)."""
+
+    def __init__(self, stem, tails, axis):
+        super().__init__()
+        self.stem = stem
+        self._n_tails = len(tails)
+        for i, t in enumerate(tails):
+            setattr(self, f"tail{i}", t)
+        self._axis = axis
+
+    def forward(self, x):
+        x = self.stem(x)
+        outs = [getattr(self, f"tail{i}")(x) for i in range(self._n_tails)]
+        return _np.concatenate(outs, axis=self._axis)
+
+
+def _make_E(layout, dtype):
+    ax = _bn_axis(layout)
+    out = _Concurrent(ax)
+    out.add(_make_branch(None, layout, dtype, (320, 1, None, None)))
+    out.add(_ExpandedBranch(
+        _make_branch(None, layout, dtype, (384, 1, None, None)),
+        [_make_branch(None, layout, dtype, (384, (1, 3), None, (0, 1))),
+         _make_branch(None, layout, dtype, (384, (3, 1), None, (1, 0)))],
+        ax))
+    out.add(_ExpandedBranch(
+        _make_branch(None, layout, dtype, (448, 1, None, None),
+                     (384, 3, None, 1)),
+        [_make_branch(None, layout, dtype, (384, (1, 3), None, (0, 1))),
+         _make_branch(None, layout, dtype, (384, (3, 1), None, (1, 0)))],
+        ax))
+    out.add(_make_branch("avg", layout, dtype, (192, 1, None, None)))
+    return out
+
+
+class Inception3(HybridBlock):
+    def __init__(self, classes=1000, layout="NCHW", dtype="float32"):
+        super().__init__()
+        self.features = nn.HybridSequential()
+        self.features.add(_make_basic_conv(layout, dtype, channels=32,
+                                           kernel_size=3, strides=2))
+        self.features.add(_make_basic_conv(layout, dtype, channels=32,
+                                           kernel_size=3))
+        self.features.add(_make_basic_conv(layout, dtype, channels=64,
+                                           kernel_size=3, padding=1))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
+        self.features.add(_make_basic_conv(layout, dtype, channels=80,
+                                           kernel_size=1))
+        self.features.add(_make_basic_conv(layout, dtype, channels=192,
+                                           kernel_size=3))
+        self.features.add(nn.MaxPool2D(pool_size=3, strides=2, layout=layout))
+        self.features.add(_make_A(32, layout, dtype))
+        self.features.add(_make_A(64, layout, dtype))
+        self.features.add(_make_A(64, layout, dtype))
+        self.features.add(_make_B(layout, dtype))
+        self.features.add(_make_C(128, layout, dtype))
+        self.features.add(_make_C(160, layout, dtype))
+        self.features.add(_make_C(160, layout, dtype))
+        self.features.add(_make_C(192, layout, dtype))
+        self.features.add(_make_D(layout, dtype))
+        self.features.add(_make_E(layout, dtype))
+        self.features.add(_make_E(layout, dtype))
+        self.features.add(nn.AvgPool2D(pool_size=8, layout=layout))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.Dense(classes, dtype=dtype)
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    net = Inception3(**kwargs)
+    if pretrained:
+        net.load_parameters(get_model_file("inceptionv3", root=root),
+                            device=ctx or current_context())
+    return net

@@ -1,0 +1,102 @@
+"""SqueezeNet 1.0/1.1 (parity: gluon/model_zoo/vision/squeezenet.py)."""
+from __future__ import annotations
+
+from .... import numpy as _np
+from ....context import current_context
+from ... import nn
+from ...block import HybridBlock
+from ..model_store import get_model_file
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(HybridBlock):
+    def __init__(self, squeeze_channels, expand1x1_channels,
+                 expand3x3_channels, layout, dtype):
+        super().__init__()
+        self._concat_axis = 1 if layout.startswith("NC") else 3
+        self.squeeze = nn.Conv2D(squeeze_channels, kernel_size=1,
+                                 activation="relu", layout=layout,
+                                 dtype=dtype)
+        self.expand1x1 = nn.Conv2D(expand1x1_channels, kernel_size=1,
+                                   activation="relu", layout=layout,
+                                   dtype=dtype)
+        self.expand3x3 = nn.Conv2D(expand3x3_channels, kernel_size=3,
+                                   padding=1, activation="relu",
+                                   layout=layout, dtype=dtype)
+
+    def forward(self, x):
+        x = self.squeeze(x)
+        return _np.concatenate([self.expand1x1(x), self.expand3x3(x)],
+                               axis=self._concat_axis)
+
+
+class SqueezeNet(HybridBlock):
+    def __init__(self, version, classes=1000, layout="NCHW",
+                 dtype="float32"):
+        super().__init__()
+        assert version in ("1.0", "1.1"), \
+            "Unsupported SqueezeNet version 1.0 or 1.1 expected"
+        self.features = nn.HybridSequential()
+        if version == "1.0":
+            self.features.add(nn.Conv2D(96, kernel_size=7, strides=2,
+                                        activation="relu", layout=layout,
+                                        dtype=dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(16, 64, 64, layout, dtype))
+            self.features.add(_Fire(16, 64, 64, layout, dtype))
+            self.features.add(_Fire(32, 128, 128, layout, dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(32, 128, 128, layout, dtype))
+            self.features.add(_Fire(48, 192, 192, layout, dtype))
+            self.features.add(_Fire(48, 192, 192, layout, dtype))
+            self.features.add(_Fire(64, 256, 256, layout, dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(64, 256, 256, layout, dtype))
+        else:
+            self.features.add(nn.Conv2D(64, kernel_size=3, strides=2,
+                                        activation="relu", layout=layout,
+                                        dtype=dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(16, 64, 64, layout, dtype))
+            self.features.add(_Fire(16, 64, 64, layout, dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(32, 128, 128, layout, dtype))
+            self.features.add(_Fire(32, 128, 128, layout, dtype))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                           ceil_mode=True, layout=layout))
+            self.features.add(_Fire(48, 192, 192, layout, dtype))
+            self.features.add(_Fire(48, 192, 192, layout, dtype))
+            self.features.add(_Fire(64, 256, 256, layout, dtype))
+            self.features.add(_Fire(64, 256, 256, layout, dtype))
+        self.features.add(nn.Dropout(0.5))
+        self.output = nn.HybridSequential()
+        self.output.add(nn.Conv2D(classes, kernel_size=1, activation="relu",
+                                  layout=layout, dtype=dtype))
+        self.output.add(nn.GlobalAvgPool2D(layout=layout))
+        self.output.add(nn.Flatten())
+
+    def forward(self, x):
+        return self.output(self.features(x))
+
+
+def get_squeezenet(version, pretrained=False, ctx=None, root=None, **kwargs):
+    net = SqueezeNet(version, **kwargs)
+    if pretrained:
+        net.load_parameters(get_model_file(f"squeezenet{version}",
+                                           root=root),
+                            device=ctx or current_context())
+    return net
+
+
+def squeezenet1_0(**kwargs):
+    return get_squeezenet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs):
+    return get_squeezenet("1.1", **kwargs)

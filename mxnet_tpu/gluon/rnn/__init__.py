@@ -1,0 +1,7 @@
+"""Recurrent layers and cells (parity: python/mxnet/gluon/rnn)."""
+from .rnn_layer import RNN, LSTM, GRU  # noqa: F401
+from .rnn_cell import (  # noqa: F401
+    RecurrentCell, HybridRecurrentCell, RNNCell, LSTMCell, GRUCell,
+    SequentialRNNCell, HybridSequentialRNNCell, DropoutCell, ModifierCell,
+    ZoneoutCell, ResidualCell, BidirectionalCell,
+)

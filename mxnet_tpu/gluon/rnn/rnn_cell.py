@@ -1,0 +1,469 @@
+"""Recurrent cells (parity: gluon/rnn/rnn_cell.py).
+
+Cell math matches the fused npx.rnn conventions (LSTM gates [i,f,g,o];
+GRU linear-before-reset) so cell-based and fused models are
+numerically interchangeable. `unroll` is a static Python loop; under
+hybridize the whole unrolled graph compiles to one XLA program (the
+TPU-preferred form for short sequences — long sequences should use the
+fused layers, which lax.scan over time).
+"""
+from __future__ import annotations
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
+           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
+           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+def _cells_state_info(cells, batch_size):
+    return sum([c.state_info(batch_size) for c in cells], [])
+
+
+def _cells_begin_state(cells, **kwargs):
+    return sum([c.begin_state(**kwargs) for c in cells], [])
+
+
+def _format_sequence(length, inputs, layout, merge, in_layout=None):
+    """Normalize inputs to a list of per-step arrays or a merged array."""
+    assert layout in ("TNC", "NTC")
+    batch_axis = layout.find("N")
+    axis = layout.find("T")
+    if isinstance(inputs, (list, tuple)):
+        batch_size = inputs[0].shape[batch_axis - 1 if batch_axis > axis
+                                     else batch_axis]
+        if merge:
+            merged = np.stack(list(inputs), axis=axis)
+            return merged, axis, batch_size
+        return list(inputs), axis, batch_size
+    batch_size = inputs.shape[batch_axis]
+    if merge is False:
+        seq = [np.squeeze(s, axis=axis)
+               for s in np.split(inputs, inputs.shape[axis], axis=axis)]
+        return seq, axis, batch_size
+    return inputs, axis, batch_size
+
+
+class RecurrentCell(HybridBlock):
+    """Abstract base for recurrent cells."""
+
+    def __init__(self):
+        super().__init__()
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=np.zeros, **kwargs):
+        assert not self._modified, \
+            "After applying modifier cells the base cell cannot be called " \
+            "directly. Call the modifier cell instead."
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            if info is not None:
+                info = dict(info)
+                info.update(kwargs)
+            else:
+                info = kwargs
+            shape = info.pop("shape")
+            info.pop("__layout__", None)
+            states.append(func(shape, **info))
+        return states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return super().__call__(inputs, states)
+
+    def forward(self, inputs, states):
+        raise NotImplementedError
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell `length` steps (parity: rnn_cell.py unroll)."""
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = begin_state if begin_state is not None else \
+            self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        all_states = []
+        for i in range(length):
+            output, states = self(inputs[i], states)
+            outputs.append(output)
+            if valid_length is not None:
+                all_states.append(states)
+        if valid_length is not None:
+            # select the state at the last valid step per sequence
+            stacked = [np.stack([s[j] for s in all_states], axis=0)
+                       for j in range(len(states))]
+            idx = (valid_length - 1).astype("int32")
+            batch = np.arange(batch_size).astype("int32")
+            states = [s[idx, batch] for s in stacked]
+            outputs = [
+                np.where(np.expand_dims(valid_length > i, -1).astype(
+                    outputs[i].dtype) > 0, outputs[i],
+                    np.zeros_like(outputs[i]))
+                for i in range(length)]
+        merged, _, _ = _format_sequence(
+            length, outputs, layout,
+            merge_outputs if merge_outputs is not None else True)
+        return merged, states
+
+
+HybridRecurrentCell = RecurrentCell
+
+
+class RNNCell(RecurrentCell):
+    """Elman RNN cell: h' = act(W_i2h x + b_i2h + W_h2h h + b_h2h)."""
+
+    def __init__(self, hidden_size, activation="tanh",
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def _infer(self, inputs):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight._infer_shape((self._hidden_size,
+                                          inputs.shape[-1]))
+
+    def forward(self, inputs, states):
+        self._infer(inputs)
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=self._hidden_size)
+        h2h = npx.fully_connected(states[0], self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=self._hidden_size)
+        output = npx.activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(RecurrentCell):
+    """LSTM cell, gate order [i, f, g, o] (cuDNN/reference layout)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self._activation = activation
+        self._recurrent_activation = recurrent_activation
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(4 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(4 * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstm"
+
+    def forward(self, inputs, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight._infer_shape((4 * self._hidden_size,
+                                          inputs.shape[-1]))
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=4 * self._hidden_size)
+        h2h = npx.fully_connected(states[0], self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        in_gate, forget_gate, in_transform, out_gate = np.split(gates, 4,
+                                                                axis=-1)
+        in_gate = npx.activation(in_gate,
+                                 act_type=self._recurrent_activation)
+        forget_gate = npx.activation(forget_gate,
+                                     act_type=self._recurrent_activation)
+        in_transform = npx.activation(in_transform,
+                                      act_type=self._activation)
+        out_gate = npx.activation(out_gate,
+                                  act_type=self._recurrent_activation)
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * npx.activation(next_c,
+                                           act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(RecurrentCell):
+    """GRU cell (linear-before-reset, matching the fused kernel)."""
+
+    def __init__(self, hidden_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0):
+        super().__init__()
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = Parameter("i2h_weight",
+                                    shape=(3 * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(3 * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter("i2h_bias", shape=(3 * hidden_size,),
+                                  init=i2h_bias_initializer,
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter("h2h_bias", shape=(3 * hidden_size,),
+                                  init=h2h_bias_initializer,
+                                  allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def forward(self, inputs, states):
+        if not self.i2h_weight._shape_known():
+            self.i2h_weight._infer_shape((3 * self._hidden_size,
+                                          inputs.shape[-1]))
+        prev_h = states[0]
+        i2h = npx.fully_connected(inputs, self.i2h_weight.data(),
+                                  self.i2h_bias.data(),
+                                  num_hidden=3 * self._hidden_size)
+        h2h = npx.fully_connected(prev_h, self.h2h_weight.data(),
+                                  self.h2h_bias.data(),
+                                  num_hidden=3 * self._hidden_size)
+        i2h_r, i2h_z, i2h_n = np.split(i2h, 3, axis=-1)
+        h2h_r, h2h_z, h2h_n = np.split(h2h, 3, axis=-1)
+        reset_gate = npx.activation(i2h_r + h2h_r, act_type="sigmoid")
+        update_gate = npx.activation(i2h_z + h2h_z, act_type="sigmoid")
+        next_h_tmp = npx.activation(i2h_n + reset_gate * h2h_n,
+                                    act_type="tanh")
+        next_h = (1.0 - update_gate) * next_h_tmp + update_gate * prev_h
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells sequentially, feeding each output to the next."""
+
+    def __init__(self):
+        super().__init__()
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info(self._children.values(), batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state(self._children.values(),
+                                  batch_size=batch_size, **kwargs)
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, state = cell(inputs, states[p:p + n])
+            next_states.extend(state)
+            p += n
+        return inputs, next_states
+
+
+HybridSequentialRNNCell = SequentialRNNCell
+
+
+class DropoutCell(RecurrentCell):
+    """Apply dropout on the input (parity: rnn_cell.DropoutCell)."""
+
+    def __init__(self, rate, axes=()):
+        super().__init__()
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def _alias(self):
+        return "dropout"
+
+    def forward(self, inputs, states):
+        if self._rate > 0:
+            inputs = npx.dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ModifierCell(RecurrentCell):
+    """Base for cells that wrap another cell's behavior."""
+
+    def __init__(self, base_cell):
+        assert not base_cell._modified, \
+            "Cell %s is already modified." % str(base_cell)
+        base_cell._modified = True
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, func=np.zeros, **kwargs):
+        assert not self._modified
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size=batch_size, func=func,
+                                           **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ZoneoutCell(ModifierCell):
+    """Zoneout regularization (Krueger et al.)."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        assert not isinstance(base_cell, BidirectionalCell), \
+            "BidirectionalCell doesn't support zoneout. " \
+            "Apply ZoneoutCell to the cells underneath instead."
+        super().__init__(base_cell)
+        self._zoneout_outputs = zoneout_outputs
+        self._zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def _alias(self):
+        return "zoneout"
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        next_output, next_states = self.base_cell(inputs, states)
+        p_outputs, p_states = self._zoneout_outputs, self._zoneout_states
+
+        def mask(p, like):
+            return npx.dropout(np.ones_like(like), p=p)
+
+        prev_output = self._prev_output if self._prev_output is not None \
+            else np.zeros_like(next_output)
+        output = np.where(mask(p_outputs, next_output) > 0, next_output,
+                          prev_output) if p_outputs != 0.0 else next_output
+        new_states = [np.where(mask(p_states, ns) > 0, ns, s)
+                      for ns, s in zip(next_states, states)] \
+            if p_states != 0.0 else next_states
+        self._prev_output = output
+        return output, new_states
+
+
+class ResidualCell(ModifierCell):
+    """Adds residual connection: output = base(input) + input."""
+
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """Run two cells over the sequence in opposite directions
+    (only usable through `unroll`)."""
+
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError(
+            "Bidirectional cannot be stepped. Please use unroll")
+
+    def state_info(self, batch_size=0):
+        return _cells_state_info([self.l_cell, self.r_cell], batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        assert not self._modified
+        return _cells_begin_state([self.l_cell, self.r_cell],
+                                  batch_size=batch_size, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        inputs, axis, batch_size = _format_sequence(length, inputs, layout,
+                                                    False)
+        begin_state = begin_state if begin_state is not None else \
+            self.begin_state(batch_size)
+        n_l = len(self.l_cell.state_info())
+        l_outputs, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:n_l], layout, merge_outputs=False,
+            valid_length=valid_length)
+        rev_inputs = list(reversed(inputs))
+        r_outputs, r_states = self.r_cell.unroll(
+            length, rev_inputs, begin_state[n_l:], layout,
+            merge_outputs=False, valid_length=None)
+        r_outputs = list(reversed(r_outputs))
+        if valid_length is not None:
+            # re-reverse respecting lengths: pack then sequence_reverse
+            stacked = np.stack(r_outputs, axis=0)
+            stacked = npx.sequence_reverse(
+                npx.sequence_reverse(stacked, use_sequence_length=False),
+                sequence_length=valid_length, use_sequence_length=True)
+            r_outputs = [np.squeeze(s, axis=0) for s in
+                         np.split(stacked, length, axis=0)]
+        outputs = [np.concatenate([l, r], axis=-1)
+                   for l, r in zip(l_outputs, r_outputs)]
+        merged, _, _ = _format_sequence(
+            length, outputs, layout,
+            merge_outputs if merge_outputs is not None else True)
+        return merged, l_states + r_states

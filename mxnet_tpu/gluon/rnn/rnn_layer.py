@@ -1,0 +1,216 @@
+"""Fused recurrent layers RNN/LSTM/GRU (parity: gluon/rnn/rnn_layer.py).
+
+Parameter naming ({l|r}{layer}_{i2h|h2h}_{weight|bias}) and the flat
+parameter concatenation order follow the reference (rnn_layer.py:71-94,
+:203-214) so checkpoints map 1:1. Execution is npx.rnn → ops.nn.rnn:
+one whole-sequence MXU matmul per layer + lax.scan recurrence.
+"""
+from __future__ import annotations
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from ...context import current_context
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, projection_size=None,
+                 h2r_weight_initializer=None, lstm_state_clip_min=None,
+                 lstm_state_clip_max=None, lstm_state_clip_nan=False,
+                 dtype="float32", use_sequence_length=False):
+        super().__init__()
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be 'TNC' or 'NTC'"
+        if projection_size:
+            raise NotImplementedError(
+                "LSTMP projection is not supported in this build")
+        self._hidden_size = hidden_size
+        self._projection_size = None
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._lstm_state_clip_min = lstm_state_clip_min
+        self._lstm_state_clip_max = lstm_state_clip_max
+        self._lstm_state_clip_nan = lstm_state_clip_nan
+        self._dtype = dtype
+        self._use_sequence_length = use_sequence_length
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4,
+                       "gru": 3}[mode]
+
+        ng, ni, nh = self._gates, input_size, hidden_size
+        for i in range(num_layers):
+            for j in ["l", "r"][:self._dir]:
+                for g, shape, init in (
+                        ("i2h_weight", (ng * nh, ni),
+                         i2h_weight_initializer),
+                        ("h2h_weight", (ng * nh, nh),
+                         h2h_weight_initializer),
+                        ("i2h_bias", (ng * nh,), i2h_bias_initializer),
+                        ("h2h_bias", (ng * nh,), h2h_bias_initializer)):
+                    name = f"{j}{i}_{g}"
+                    setattr(self, name, Parameter(
+                        name, shape=shape, init=init, dtype=dtype,
+                        allow_deferred_init=True))
+            ni = nh * self._dir
+
+    def __repr__(self):
+        s = "{name}({mapping}, {_layout}"
+        if self._num_layers != 1:
+            s += ", num_layers={_num_layers}"
+        if self._dropout != 0:
+            s += ", dropout={_dropout}"
+        if self._dir == 2:
+            s += ", bidirectional"
+        s += ")"
+        shape = self.l0_i2h_weight.shape
+        mapping = f"{shape[1] if shape[1] else None} -> {self._hidden_size}"
+        return s.format(name=type(self).__name__, mapping=mapping,
+                        **self.__dict__)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def infer_shape(self, inputs, *args):
+        assert inputs.ndim == 3, \
+            "Input should be rank-3 [seq_len, batch, input_size]"
+        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        for i in range(self._num_layers):
+            for j in ["l", "r"][:self._dir]:
+                p = getattr(self, f"{j}{i}_i2h_weight")
+                if not p._shape_known():
+                    p._infer_shape((self._gates * self._hidden_size, ni))
+            ni = self._hidden_size * self._dir
+
+    def begin_state(self, batch_size=0, func=np.zeros, **kwargs):
+        states = []
+        for info in self.state_info(batch_size):
+            kwargs.update(info)
+            shape = kwargs.pop("shape")
+            kwargs.pop("__layout__", None)
+            states.append(func(shape, **kwargs))
+        return states
+
+    def forward(self, inputs, states=None, sequence_length=None):
+        self.infer_shape(inputs)
+        batch_axis = 0 if self._layout == "NTC" else 1
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size,
+                                      dtype=str(inputs.dtype))
+        if isinstance(states, NDArray):
+            states = [states]
+        for info, state in zip(self.state_info(batch_size), states):
+            if state.shape != info["shape"]:
+                raise ValueError(
+                    f"Invalid recurrent state shape. Expecting "
+                    f"{info['shape']}, got {state.shape}.")
+        out, out_states = self._forward_kernel(inputs, states,
+                                               sequence_length)
+        return out if skip_states else (out, out_states)
+
+    def _forward_kernel(self, inputs, states, sequence_length):
+        if self._layout == "NTC":
+            inputs = np.swapaxes(inputs, 0, 1)
+        # flat parameter vector in the reference/cuDNN order:
+        # all weights (layer-major, direction, i2h then h2h), then all
+        # biases in the same order (rnn_layer.py:203-214)
+        parts = [getattr(self, f"{d}{layer}_{g}_{t}").data().reshape(-1)
+                 for t in ("weight", "bias")
+                 for layer in range(self._num_layers)
+                 for d in ["l", "r"][:self._dir]
+                 for g in ("i2h", "h2h")]
+        params = np.concatenate(parts, axis=0)
+
+        rnn_args = list(states)
+        if self._use_sequence_length:
+            rnn_args.append(sequence_length)
+        rnn_out = npx.rnn(
+            inputs, params, *rnn_args,
+            use_sequence_length=self._use_sequence_length,
+            state_size=self._hidden_size, num_layers=self._num_layers,
+            bidirectional=self._dir == 2, p=self._dropout,
+            state_outputs=True, mode=self._mode,
+            lstm_state_clip_min=self._lstm_state_clip_min,
+            lstm_state_clip_max=self._lstm_state_clip_max,
+            lstm_state_clip_nan=self._lstm_state_clip_nan)
+        if self._mode == "lstm":
+            outputs, out_states = rnn_out[0], [rnn_out[1], rnn_out[2]]
+        else:
+            outputs, out_states = rnn_out[0], [rnn_out[1]]
+        if self._layout == "NTC":
+            outputs = np.swapaxes(outputs, 0, 1)
+        return outputs, out_states
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN with tanh or ReLU non-linearity."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "rnn_" + activation, dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """Multi-layer LSTM."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 projection_size=None, h2r_weight_initializer=None,
+                 state_clip_min=None, state_clip_max=None,
+                 state_clip_nan=False, dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "lstm", projection_size, h2r_weight_initializer,
+                         state_clip_min, state_clip_max, state_clip_nan,
+                         dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size,
+                 self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """Multi-layer GRU (linear-before-reset, cuDNN convention)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 dtype="float32", **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size,
+                         i2h_weight_initializer, h2h_weight_initializer,
+                         i2h_bias_initializer, h2h_bias_initializer,
+                         "gru", dtype=dtype, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -451,3 +451,53 @@ def save(fname, data):
 
 # control flow (npx.foreach / while_loop / cond) lives in its own module
 from .control_flow import foreach, while_loop, cond  # noqa: E402,F401
+
+
+def rnn(data, parameters, *args, use_sequence_length=False, state_size=None,
+        projection_size=None, num_layers=1, bidirectional=False, p=0.0,
+        state_outputs=True, mode="lstm",
+        lstm_state_clip_min=None, lstm_state_clip_max=None,
+        lstm_state_clip_nan=False, **kwargs):
+    """Fused multi-layer RNN/LSTM/GRU (parity: npx.rnn →
+    src/operator/rnn-inl.h). args = state[, state_cell][, seq_length].
+
+    Returns [output, h_n(, c_n)] when state_outputs else output.
+    """
+    args = list(args)
+    seq_len = None
+    if use_sequence_length:
+        seq_len = _c(args.pop())
+    state = _c(args[0])
+    state_cell = _c(args[1]) if mode == "lstm" else None
+    data, parameters = _c(data), _c(parameters)
+
+    train = _ag.is_training()
+    key = next_key() if (train and p > 0.0) else None
+
+    def fn(*datas):
+        d, prm, st = datas[0], datas[1], datas[2]
+        i = 3
+        st_c = None
+        if mode == "lstm":
+            st_c = datas[i]
+            i += 1
+        sl = datas[i] if seq_len is not None else None
+        return _nn.rnn(
+            d, prm, st, state_cell=st_c, sequence_length=sl, mode=mode,
+            state_size=state_size, num_layers=num_layers,
+            bidirectional=bidirectional, p=p, key=key, train=train,
+            projection_size=projection_size,
+            lstm_state_clip_min=lstm_state_clip_min,
+            lstm_state_clip_max=lstm_state_clip_max,
+            lstm_state_clip_nan=lstm_state_clip_nan)
+
+    op_args = [data, parameters, state]
+    if mode == "lstm":
+        op_args.append(state_cell)
+    if seq_len is not None:
+        op_args.append(seq_len)
+    nout = 3 if mode == "lstm" else 2
+    outs = apply_op(fn, *op_args, nout=nout, name=f"rnn_{mode}")
+    if state_outputs:
+        return list(outs)
+    return outs[0]

@@ -332,6 +332,12 @@ def activation(x, act_type):
         return jax.nn.soft_sign(x)
     if act_type == "mish":
         return x * jnp.tanh(jax.nn.softplus(x))
+    if act_type == "relu6":
+        return jax.nn.relu6(x)
+    if act_type == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if act_type == "silu" or act_type == "swish":
+        return jax.nn.silu(x)
     raise ValueError(f"unknown activation {act_type!r}")
 
 
@@ -488,3 +494,175 @@ def sequence_reverse(x, sequence_length=None, use_sequence_length=False,
     out = jnp.take_along_axis(
         xm, rev_idx.reshape(rev_idx.shape + (1,) * (xm.ndim - 2)), axis=0)
     return jnp.moveaxis(out, 0, axis)
+
+
+# ---------------------------------------------------------------------------
+# fused RNN (parity: src/operator/rnn-inl.h — multi-layer, bidirectional,
+# variable-length RNN/LSTM/GRU with the cuDNN flat-parameter layout)
+# ---------------------------------------------------------------------------
+# TPU design: the input projection for ALL timesteps is one large matmul
+# (T*N, I)x(I, G*H) that XLA tiles onto the MXU; only the hidden-to-
+# hidden recurrence runs under lax.scan. Gate conventions follow the
+# reference/cuDNN: LSTM gates [i, f, g, o]; GRU gates [r, z, n] with
+# "linear before reset" (reset applied after the h2h matmul).
+
+_RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+    """Length of the flat parameter vector (parity: the reference's
+    GetRnnParamSize, src/operator/rnn-inl.h)."""
+    g = _RNN_GATES[mode]
+    d = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else state_size * d
+        size += d * g * state_size * (in_size + state_size  # weights
+                                      + 2)                  # both biases
+    return size
+
+
+def _rnn_unpack(params, mode, input_size, state_size, num_layers,
+                bidirectional):
+    """Split the flat vector into per-(layer, direction) weight/bias
+    arrays: all weights first, then all biases (cuDNN layout)."""
+    g = _RNN_GATES[mode]
+    d = 2 if bidirectional else 1
+    h = state_size
+    pos = 0
+    weights, biases = [], []
+    for layer in range(num_layers):
+        in_size = input_size if layer == 0 else h * d
+        for _ in range(d):
+            wi = params[pos:pos + g * h * in_size].reshape(g * h, in_size)
+            pos += g * h * in_size
+            wh = params[pos:pos + g * h * h].reshape(g * h, h)
+            pos += g * h * h
+            weights.append((wi, wh))
+    for layer in range(num_layers):
+        for _ in range(d):
+            bi = params[pos:pos + g * h]
+            pos += g * h
+            bh = params[pos:pos + g * h]
+            pos += g * h
+            biases.append((bi, bh))
+    return weights, biases
+
+
+def _rnn_layer_scan(mode, xp, bh, h0, c0, wh, mask, clip_min, clip_max,
+                    clip_nan):
+    """Scan one direction of one layer.
+
+    xp: (T, N, G*H) precomputed input projection (+ i2h bias; for
+    rnn/lstm also + h2h bias). bh: h2h bias, used separately only by
+    GRU's linear-before-reset candidate. mask: (T, N, 1) or None.
+    """
+    h_dim = h0.shape[-1]
+
+    def step(carry, inp):
+        if mask is None:
+            x_t, m_t = inp, None
+        else:
+            x_t, m_t = inp
+        if mode == "lstm":
+            h, c = carry
+            gates = x_t + h @ wh.T
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c_new = f * c + i * g
+            if clip_min is not None and clip_max is not None:
+                if clip_nan:
+                    c_new = jnp.nan_to_num(c_new, nan=0.0)
+                c_new = jnp.clip(c_new, clip_min, clip_max)
+            h_new = o * jnp.tanh(c_new)
+            if m_t is not None:
+                h_new = jnp.where(m_t, h_new, h)
+                c_new = jnp.where(m_t, c_new, c)
+            out = h_new if m_t is None else jnp.where(m_t, h_new,
+                                                      jnp.zeros_like(h_new))
+            return (h_new, c_new), out
+        h = carry
+        if mode == "gru":
+            hh = h @ wh.T + bh
+            xr, xz, xn = jnp.split(x_t, 3, axis=-1)
+            hr, hz, hn = jnp.split(hh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h_new = (1.0 - z) * n + z * h
+        else:
+            pre = x_t + h @ wh.T
+            h_new = jnp.tanh(pre) if mode == "rnn_tanh" else jax.nn.relu(pre)
+        if m_t is not None:
+            h_new = jnp.where(m_t, h_new, h)
+            out = jnp.where(m_t, h_new, jnp.zeros_like(h_new))
+        else:
+            out = h_new
+        return h_new, out
+
+    carry0 = (h0, c0) if mode == "lstm" else h0
+    xs = xp if mask is None else (xp, mask)
+    carry, ys = jax.lax.scan(step, carry0, xs)
+    if mode == "lstm":
+        return ys, carry[0], carry[1]
+    return ys, carry, jnp.zeros((0, h0.shape[0], h_dim), xp.dtype)
+
+
+def rnn(data, params, state, state_cell=None, sequence_length=None,
+        mode="lstm", state_size=None, num_layers=1, bidirectional=False,
+        p=0.0, key=None, train=False, projection_size=None,
+        lstm_state_clip_min=None, lstm_state_clip_max=None,
+        lstm_state_clip_nan=False):
+    """Fused multi-layer RNN. data (T, N, I); state (L*D, N, H);
+    returns (output (T, N, H*D), h_n, [c_n])."""
+    if projection_size:
+        raise NotImplementedError("LSTMP projection is not supported yet")
+    g = _RNN_GATES[mode]
+    d = 2 if bidirectional else 1
+    t_len, batch, input_size = data.shape
+    h = state_size if state_size is not None else state.shape[-1]
+    weights, biases = _rnn_unpack(params, mode, input_size, h, num_layers,
+                                  bidirectional)
+
+    mask = None
+    if sequence_length is not None:
+        mask = (jnp.arange(t_len)[:, None] <
+                sequence_length[None, :].astype(jnp.int32))[..., None]
+
+    x = data
+    h_outs, c_outs = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for di in range(d):
+            idx = layer * d + di
+            wi, wh = weights[idx]
+            bi, bh = biases[idx]
+            xin = x
+            if di == 1:
+                xin = sequence_reverse(
+                    x, sequence_length,
+                    use_sequence_length=sequence_length is not None)
+            # whole-sequence input projection: the MXU-sized matmul
+            xp = xin @ wi.T + bi
+            if mode != "gru":
+                xp = xp + bh
+            ys, hn, cn = _rnn_layer_scan(
+                mode, xp, bh, state[idx], 
+                state_cell[idx] if state_cell is not None else None,
+                wh, mask, lstm_state_clip_min, lstm_state_clip_max,
+                lstm_state_clip_nan)
+            if di == 1:
+                ys = sequence_reverse(
+                    ys, sequence_length,
+                    use_sequence_length=sequence_length is not None)
+            dir_outs.append(ys)
+            h_outs.append(hn)
+            c_outs.append(cn)
+        x = dir_outs[0] if d == 1 else jnp.concatenate(dir_outs, axis=-1)
+        if train and p > 0.0 and layer < num_layers - 1 and key is not None:
+            x = dropout(x, jax.random.fold_in(key, layer), p=p)
+    h_n = jnp.stack(h_outs)
+    if mode == "lstm":
+        return x, h_n, jnp.stack(c_outs)
+    return x, h_n

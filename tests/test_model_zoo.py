@@ -1,0 +1,57 @@
+"""Model zoo construction + forward shapes (model: the reference's
+tests/python/unittest/test_gluon_model_zoo.py, shrunk inputs)."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+@pytest.mark.parametrize("name", [
+    "resnet18_v1", "resnet18_v2", "mobilenet0.25", "mobilenetv2_0.25",
+    "squeezenet1.1",
+])
+def test_zoo_forward(name):
+    net = vision.get_model(name)
+    net.initialize()
+    x = np.random.uniform(size=(1, 3, 64, 64))
+    y = net(x)
+    assert y.shape == (1, 1000)
+
+
+def test_zoo_classes_kwarg():
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    y = net(np.random.uniform(size=(2, 3, 32, 32)))
+    assert y.shape == (2, 10)
+
+
+def test_zoo_nhwc_layout():
+    net = vision.get_model("resnet18_v1", layout="NHWC")
+    net.initialize()
+    y = net(np.random.uniform(size=(1, 32, 32, 3)))
+    assert y.shape == (1, 1000)
+
+
+def test_zoo_train_backward():
+    net = vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    x = np.random.uniform(size=(2, 3, 32, 32))
+    loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+    label = np.array([1, 2])
+    with mx.autograd.record():
+        loss = loss_fn(net(x), label)
+    loss.backward()
+    g = net.features[0].weight.grad()
+    assert float(np.abs(g).sum()) > 0
+
+
+def test_zoo_unknown_name():
+    with pytest.raises(ValueError):
+        vision.get_model("resnet1999")
+
+
+def test_get_model_via_module():
+    net = mx.gluon.model_zoo.get_model("squeezenet1.1", classes=4)
+    net.initialize()
+    assert net(np.random.uniform(size=(1, 3, 64, 64))).shape == (1, 4)

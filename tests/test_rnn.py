@@ -1,0 +1,144 @@
+"""RNN layers/cells (model: the reference's tests/python/unittest/
+test_gluon_rnn.py — cell-vs-fused consistency, shapes, varlen)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx, gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.ops import nn as opsnn
+
+
+def test_rnn_param_size():
+    # LSTM, 2 layers, input 10, hidden 20, unidirectional:
+    # L0: 4*20*(10+20+2), L1: 4*20*(20+20+2)
+    assert opsnn.rnn_param_size("lstm", 10, 20, 2, False) == \
+        4 * 20 * (10 + 20 + 2) + 4 * 20 * (20 + 20 + 2)
+
+
+@pytest.mark.parametrize("mode,layer_cls,cell_cls", [
+    ("lstm", rnn.LSTM, rnn.LSTMCell),
+    ("gru", rnn.GRU, rnn.GRUCell),
+])
+def test_fused_matches_cell(mode, layer_cls, cell_cls):
+    T, N, I, H = 4, 2, 3, 5
+    layer = layer_cls(H, input_size=I)
+    layer.initialize()
+    x = np.random.uniform(size=(T, N, I))
+    out = layer(x)  # TNC
+    assert out.shape == (T, N, H)
+
+    cell = cell_cls(H, input_size=I)
+    cell.initialize()
+    # copy fused params into the cell
+    for g in ("i2h_weight", "h2h_weight", "i2h_bias", "h2h_bias"):
+        getattr(cell, g).set_data(getattr(layer, f"l0_{g}").data())
+    states = cell.begin_state(N)
+    outs = []
+    h = states
+    for t in range(T):
+        o, h = cell(x[t], h)
+        outs.append(o.asnumpy())
+    onp.testing.assert_allclose(out.asnumpy(), onp.stack(outs), rtol=2e-5,
+                                atol=2e-5)
+
+
+def test_lstm_shapes_bidirectional():
+    layer = rnn.LSTM(7, num_layers=2, bidirectional=True, input_size=4)
+    layer.initialize()
+    x = np.random.uniform(size=(6, 3, 4))
+    out, states = layer(x, layer.begin_state(3))
+    assert out.shape == (6, 3, 14)
+    assert states[0].shape == (4, 3, 7)
+    assert states[1].shape == (4, 3, 7)
+
+
+def test_ntc_layout():
+    layer = rnn.GRU(5, layout="NTC", input_size=3)
+    layer.initialize()
+    x = np.random.uniform(size=(2, 6, 3))
+    out = layer(x)
+    assert out.shape == (2, 6, 5)
+
+
+def test_rnn_backward():
+    layer = rnn.LSTM(5, num_layers=2, input_size=3)
+    layer.initialize()
+    x = np.random.uniform(size=(4, 2, 3))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = layer(x)
+        loss = out.sum()
+    loss.backward()
+    assert x.grad.shape == x.shape
+    assert float(np.abs(x.grad).sum()) > 0
+    for name, p in layer.collect_params().items():
+        assert p.grad() is not None, name
+
+
+def test_rnn_varlen():
+    T, N, I, H = 6, 3, 2, 4
+    layer = rnn.GRU(H, input_size=I, use_sequence_length=True)
+    layer.initialize()
+    x = np.random.uniform(size=(T, N, I))
+    sl = np.array([6, 3, 1])
+    out, states = layer(x, layer.begin_state(N), sequence_length=sl)
+    o = out.asnumpy()
+    assert abs(o[4, 1]).sum() == 0 and abs(o[2, 1]).sum() > 0
+    # final state of seq 1 equals output at its last valid step
+    onp.testing.assert_allclose(states[0].asnumpy()[0, 1], o[2, 1],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_rnn_hybridize():
+    layer = rnn.LSTM(5, input_size=3)
+    layer.initialize()
+    x = np.random.uniform(size=(4, 2, 3))
+    ref = layer(x).asnumpy()
+    layer.hybridize()
+    out = layer(x).asnumpy()
+    onp.testing.assert_allclose(ref, out, rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_cell_unroll():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.DropoutCell(0.0))
+    stack.add(rnn.LSTMCell(4, input_size=4))
+    stack.initialize()
+    x = np.random.uniform(size=(2, 5, 3))  # NTC
+    out, states = stack.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 4)
+    assert len(states) == 4
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.GRUCell(3, input_size=3))
+    cell.initialize()
+    x = np.random.uniform(size=(2, 3))
+    states = cell.begin_state(2)
+    out, _ = cell(x, states)
+    inner_out, _ = cell.base_cell(x, states)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                (inner_out + x).asnumpy(), rtol=1e-6)
+
+
+def test_bidirectional_cell_unroll():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                                 rnn.LSTMCell(4, input_size=3))
+    cell.initialize()
+    x = np.random.uniform(size=(2, 5, 3))
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 5, 8)
+    assert len(states) == 4
+
+
+def test_cell_unroll_valid_length():
+    cell = rnn.GRUCell(4, input_size=3)
+    cell.initialize()
+    x = np.random.uniform(size=(3, 5, 3))
+    vl = np.array([5, 2, 4])
+    out, states = cell.unroll(5, x, layout="NTC", merge_outputs=True,
+                              valid_length=vl)
+    o = out.asnumpy()
+    assert abs(o[1, 3]).sum() == 0 and abs(o[1, 1]).sum() > 0

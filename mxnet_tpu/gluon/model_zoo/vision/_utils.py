@@ -1,0 +1,7 @@
+"""Shared helpers for the vision model zoo."""
+
+
+def bn_axis(layout):
+    """Channel axis for BatchNorm/concat given a conv data layout
+    string ('NCHW' → 1, 'NHWC' → 3, 'NCW' → 1, ...)."""
+    return layout.find("C")

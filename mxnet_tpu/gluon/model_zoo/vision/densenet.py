@@ -10,9 +10,7 @@ from ..model_store import get_model_file
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201"]
 
-
-def _bn_axis(layout):
-    return 1 if layout.startswith("NC") else 3
+from ._utils import bn_axis as _bn_axis
 
 
 class _DenseLayer(HybridBlock):

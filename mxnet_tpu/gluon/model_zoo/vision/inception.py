@@ -9,9 +9,7 @@ from ..model_store import get_model_file
 
 __all__ = ["Inception3", "inception_v3"]
 
-
-def _bn_axis(layout):
-    return 1 if layout.startswith("NC") else 3
+from ._utils import bn_axis as _bn_axis
 
 
 def _make_basic_conv(layout, dtype, **kwargs):

@@ -16,9 +16,7 @@ __all__ = ["MobileNet", "MobileNetV2",
            "mobilenet_v2_0_25",
            "get_mobilenet", "get_mobilenet_v2"]
 
-
-def _bn_axis(layout):
-    return 1 if layout.startswith("NC") else 3
+from ._utils import bn_axis as _bn_axis
 
 
 def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
@@ -120,8 +118,8 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
-        if version_suffix in ("1", "0.5"):
-            version_suffix += ".0"
+        if version_suffix == "1":
+            version_suffix = "1.0"
         net.load_parameters(
             get_model_file(f"mobilenet{version_suffix}", root=root),
             device=ctx or current_context())
@@ -133,8 +131,8 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
         version_suffix = f"{multiplier:.2f}".rstrip("0").rstrip(".")
-        if version_suffix in ("1", "0.5"):
-            version_suffix += ".0"
+        if version_suffix == "1":
+            version_suffix = "1.0"
         net.load_parameters(
             get_model_file(f"mobilenetv2_{version_suffix}", root=root),
             device=ctx or current_context())

@@ -20,9 +20,7 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
            "get_resnet"]
 
-
-def _bn_axis(layout):
-    return 1 if layout.startswith("NC") else len(layout) - 1
+from ._utils import bn_axis as _bn_axis
 
 
 def _conv3x3(channels, stride, in_channels, layout, dtype):

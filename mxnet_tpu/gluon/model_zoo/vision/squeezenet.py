@@ -6,6 +6,7 @@ from ....context import current_context
 from ... import nn
 from ...block import HybridBlock
 from ..model_store import get_model_file
+from ._utils import bn_axis as _bn_axis
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -14,7 +15,7 @@ class _Fire(HybridBlock):
     def __init__(self, squeeze_channels, expand1x1_channels,
                  expand3x3_channels, layout, dtype):
         super().__init__()
-        self._concat_axis = 1 if layout.startswith("NC") else 3
+        self._concat_axis = _bn_axis(layout)
         self.squeeze = nn.Conv2D(squeeze_channels, kernel_size=1,
                                  activation="relu", layout=layout,
                                  dtype=dtype)

@@ -5,6 +5,7 @@ from ....context import current_context
 from ... import nn
 from ...block import HybridBlock
 from ..model_store import get_model_file
+from ._utils import bn_axis as _bn_axis
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn",
            "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg"]
@@ -15,7 +16,7 @@ class VGG(HybridBlock):
                  layout="NCHW", dtype="float32"):
         super().__init__()
         assert len(layers) == len(filters)
-        ax = 1 if layout.startswith("NC") else 3
+        ax = _bn_axis(layout)
         self.features = nn.HybridSequential()
         for i, num in enumerate(layers):
             for _ in range(num):

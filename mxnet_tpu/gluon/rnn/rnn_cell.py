@@ -448,17 +448,28 @@ class BidirectionalCell(RecurrentCell):
         l_outputs, l_states = self.l_cell.unroll(
             length, inputs, begin_state[:n_l], layout, merge_outputs=False,
             valid_length=valid_length)
-        rev_inputs = list(reversed(inputs))
+        if valid_length is None:
+            rev_inputs = list(reversed(inputs))
+        else:
+            # Reverse each sequence within its valid length so the
+            # reverse cell never consumes padding before real data
+            # (parity: _reverse_sequences, reference rnn_cell.py:93-106).
+            # sequence_reverse keeps the padded tail in place, so the
+            # r_cell sees real data at steps 0..len-1, padding after.
+            stacked = npx.sequence_reverse(
+                np.stack(inputs, axis=0), sequence_length=valid_length,
+                use_sequence_length=True)
+            rev_inputs = [np.squeeze(s, axis=0) for s in
+                          np.split(stacked, length, axis=0)]
         r_outputs, r_states = self.r_cell.unroll(
             length, rev_inputs, begin_state[n_l:], layout,
-            merge_outputs=False, valid_length=None)
-        r_outputs = list(reversed(r_outputs))
-        if valid_length is not None:
-            # re-reverse respecting lengths: pack then sequence_reverse
-            stacked = np.stack(r_outputs, axis=0)
+            merge_outputs=False, valid_length=valid_length)
+        if valid_length is None:
+            r_outputs = list(reversed(r_outputs))
+        else:
             stacked = npx.sequence_reverse(
-                npx.sequence_reverse(stacked, use_sequence_length=False),
-                sequence_length=valid_length, use_sequence_length=True)
+                np.stack(r_outputs, axis=0), sequence_length=valid_length,
+                use_sequence_length=True)
             r_outputs = [np.squeeze(s, axis=0) for s in
                          np.split(stacked, length, axis=0)]
         outputs = [np.concatenate([l, r], axis=-1)

@@ -83,7 +83,7 @@ class _RNNLayer(HybridBlock):
     def infer_shape(self, inputs, *args):
         assert inputs.ndim == 3, \
             "Input should be rank-3 [seq_len, batch, input_size]"
-        ni = inputs.shape[2] if self._layout == "TNC" else inputs.shape[2]
+        ni = inputs.shape[2]
         for i in range(self._num_layers):
             for j in ["l", "r"][:self._dir]:
                 p = getattr(self, f"{j}{i}_i2h_weight")

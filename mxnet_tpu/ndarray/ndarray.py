@@ -224,7 +224,12 @@ class NDArray:
         raise TypeError(f"copyto expects Context or NDArray, got {type(other)}")
 
     def copy(self):
-        return NDArray(self._data, ctx=self._ctx)
+        # A genuinely distinct buffer: jax arrays are immutable, so an
+        # alias would normally do — but fused-step buffer donation
+        # (parallel/train_step.py) can invalidate donated buffers, and a
+        # copy() result must survive that.
+        return NDArray(engine.track(jnp.array(self._data, copy=True)),
+                       ctx=self._ctx)
 
     # ------------------------------------------------------------------
     # autograd attachment

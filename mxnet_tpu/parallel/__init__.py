@@ -160,3 +160,6 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         kwargs = dict(coordinator_address=coordinator_address,
                       num_processes=num_processes, process_id=process_id)
     jax.distributed.initialize(**kwargs)
+
+
+from .train_step import TrainStep  # noqa: E402,F401

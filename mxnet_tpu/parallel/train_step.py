@@ -1,0 +1,293 @@
+"""Fused training step — forward + backward + optimizer in ONE XLA program.
+
+The reference overlaps backward with gradient pushes through engine
+dependencies (SURVEY.md §3.4: priority = -key so push(layer N) overlaps
+backward(layer N-1)). On TPU the equivalent — and stronger — guarantee
+comes from compiling the whole training step into a single XLA program:
+XLA's latency-hiding scheduler overlaps the gradient all-reduce over the
+'dp' mesh axis with remaining backward compute, and buffer donation
+makes the parameter/optimizer-state update fully in-place.
+
+This is the throughput path used by bench.py and the multi-chip
+dryrun; the imperative Trainer path (gluon/trainer.py) remains for
+step-by-step parity with the reference's
+`autograd.record → backward → trainer.step` flow.
+"""
+from __future__ import annotations
+
+import re
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import autograd
+from .. import engine
+from ..ndarray.ndarray import NDArray
+from ..random_state import next_key, trace_rng
+from ..gluon import _deferred
+from ..gluon.block import _flatten_arrays, _rebuild, CachedOp
+from . import get_mesh, AXIS_DP
+
+
+def _as_tuple(x):
+    if isinstance(x, (list, tuple)):
+        return tuple(x)
+    return (x,)
+
+
+class TrainStep:
+    """Compile `loss_fn(net(data), label)` + grad + optimizer update into
+    one jitted, donation-friendly XLA program, optionally sharded over a
+    `jax.sharding.Mesh`.
+
+    Parameters
+    ----------
+    net : HybridBlock (or any Block whose forward is trace-safe)
+    loss_fn : callable(out, label) -> NDArray loss (gluon.loss.* works)
+    optimizer : mxnet_tpu.optimizer.Optimizer instance or name string
+    mesh : optional Mesh; defaults to parallel.get_mesh()
+    batch_axis : mesh axis name the leading batch dim is sharded over
+    param_rules : list of (regex, PartitionSpec) giving tensor-parallel
+        placements by parameter name; unmatched params are replicated.
+    """
+
+    def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
+                 mesh=None, batch_axis=AXIS_DP, param_rules=None,
+                 donate=True):
+        from .. import optimizer as opt_mod
+        self.net = net
+        self.loss_fn = loss_fn
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self.optimizer = optimizer
+        self._explicit_mesh = mesh
+        self.batch_axis = batch_axis
+        self.param_rules = [(re.compile(pat), spec)
+                            for pat, spec in (param_rules or [])]
+        self.donate = donate
+        self._entries = {}
+        self._opt_states = None  # shared across signatures: a shape
+        self._mp_flags = None    # change (last odd batch) must NOT
+        #                          reset Adam/momentum accumulators
+
+    # -- helpers -------------------------------------------------------
+    @property
+    def mesh(self):
+        return self._explicit_mesh or get_mesh()
+
+    def _spec_for(self, name):
+        for pat, spec in self.param_rules:
+            if pat.search(name):
+                return spec
+        return P()
+
+    # -- build ---------------------------------------------------------
+    def _build(self, data_leaves, data_spec, label_leaves, label_spec):
+        net, loss_fn = self.net, self.loss_fn
+        params_dict = net.collect_params()
+        if any(p._data is None for p in params_dict.values()):
+            CachedOp(net)._abstract_init(list(data_leaves),
+                                         data_spec)
+            params_dict = net.collect_params()
+
+        names = list(params_dict.keys())
+        params = [params_dict[n] for n in names]
+        diff_idx = [i for i, p in enumerate(params)
+                    if p.grad_req != "null"]
+        frozen_idx = [i for i, p in enumerate(params)
+                      if p.grad_req == "null"]
+        diff_nds = [params[i].data() for i in diff_idx]
+        frozen_nds = [params[i].data() for i in frozen_idx]
+        all_nds = diff_nds + frozen_nds
+
+        opt = self.optimizer
+        if self._opt_states is None:
+            self._opt_states = [
+                opt.create_state_multi_precision(k, diff_nds[k])
+                for k in range(len(diff_idx))]
+            self._mp_flags = [opt._use_mp(w) for w in diff_nds]
+        states = self._opt_states
+        mp_flags = self._mp_flags
+
+        out_box = {}
+
+        def forward_loss(key, diff_datas, frozen_datas,
+                         input_datas, label_datas):
+            saved = [nd._data for nd in all_nds]
+            scope = _deferred.trace_scope()
+            rec = autograd._RecordingScope(False, True)
+            with scope, rec, trace_rng(key):
+                for nd, d in zip(diff_nds, diff_datas):
+                    nd._data = d
+                for nd, d in zip(frozen_nds, frozen_datas):
+                    nd._data = d
+                try:
+                    in_nds = [NDArray(d, ctx=l.ctx)
+                              for d, l in zip(input_datas, data_leaves)]
+                    lab_nds = [NDArray(d, ctx=l.ctx)
+                               for d, l in zip(label_datas, label_leaves)]
+                    args = _rebuild(data_spec, in_nds)
+                    out = net.forward(*args)
+                    labels = _rebuild(label_spec, lab_nds)
+                    if loss_fn is not None:
+                        loss = loss_fn(out, *labels)
+                    else:
+                        loss = out
+                    if loss.ndim > 0:
+                        loss = loss.mean()
+                finally:
+                    for nd, s in zip(all_nds, saved):
+                        nd._data = s
+            out_box["aux_targets"] = [nd for nd, _ in scope.state_updates]
+            aux = tuple(t for _, t in scope.state_updates)
+            return loss._data, aux
+
+        opt_cls = type(opt)
+        n_diff = len(diff_nds)
+
+        def step_fn(key, diff_datas, frozen_datas, opt_states, hypers,
+                    input_datas, label_datas):
+            def loss_f(dd):
+                return forward_loss(key, dd, frozen_datas,
+                                    input_datas, label_datas)
+
+            (loss, aux), grads = jax.value_and_grad(
+                loss_f, has_aux=True)(diff_datas)
+            new_ws, new_ss = [], []
+            for k in range(n_diff):
+                w, g, s, h = (diff_datas[k], grads[k], opt_states[k],
+                              hypers[k])
+                if mp_flags[k]:
+                    nw, ns = opt_cls._step_mp(w, g, s, h)
+                else:
+                    nw, ns = opt_cls._step(
+                        w, jnp.asarray(g, w.dtype), s, h)
+                new_ws.append(nw)
+                new_ss.append(ns)
+            return tuple(new_ws), tuple(new_ss), loss, aux
+
+        mesh = self.mesh
+        jit_kwargs = {}
+        if self.donate:
+            jit_kwargs["donate_argnums"] = (1, 3)
+        if mesh is not None:
+            rep = NamedSharding(mesh, P())
+            diff_sh = []
+            for k, i in enumerate(diff_idx):
+                spec = getattr(params[i], "sharding", None)
+                if spec is None:
+                    spec = self._spec_for(names[i])
+                diff_sh.append(NamedSharding(mesh, spec))
+            frozen_sh = []
+            for i in frozen_idx:
+                spec = getattr(params[i], "sharding", None)
+                if spec is None:
+                    spec = self._spec_for(names[i])
+                frozen_sh.append(NamedSharding(mesh, spec))
+            state_sh = []
+            for k in range(n_diff):
+                w = diff_nds[k]
+                wsh = diff_sh[k]
+                wshape = tuple(w.shape)
+
+                def leaf_sh(s, _wsh=wsh, _wshape=wshape):
+                    shp = getattr(s, "shape", None)
+                    return _wsh if shp is not None and tuple(shp) == _wshape \
+                        else rep
+                state_sh.append(jax.tree.map(leaf_sh, states[k]))
+
+            def batch_sh(leaf):
+                spec = [None] * leaf.ndim
+                if leaf.ndim > 0:
+                    spec[0] = self.batch_axis
+                return NamedSharding(mesh, P(*spec))
+
+            data_sh = tuple(batch_sh(l) for l in data_leaves)
+            label_sh = tuple(batch_sh(l) for l in label_leaves)
+            hyper_sh = [jax.tree.map(lambda _: rep, opt._hyper(k))
+                        for k in range(n_diff)]
+            jit_kwargs["in_shardings"] = (
+                rep, tuple(diff_sh), tuple(frozen_sh),
+                tuple(state_sh), hyper_sh, data_sh, label_sh)
+            # aux (BN stats) shardings: let XLA decide (None subtree)
+            jit_kwargs["out_shardings"] = (tuple(diff_sh),
+                                           tuple(state_sh), rep, None)
+            # place current param values onto the mesh
+            for k in range(n_diff):
+                d = diff_nds[k]._data
+                if not _placed_as(d, diff_sh[k]):
+                    diff_nds[k]._data = jax.device_put(d, diff_sh[k])
+                states[k] = jax.tree.map(
+                    lambda s, sh: jax.device_put(s, sh)
+                    if hasattr(s, "shape") else s,
+                    states[k], state_sh[k])
+            for j in range(len(frozen_nds)):
+                d = frozen_nds[j]._data
+                if not _placed_as(d, frozen_sh[j]):
+                    frozen_nds[j]._data = jax.device_put(d, frozen_sh[j])
+            self._data_sh = data_sh
+            self._label_sh = label_sh
+        else:
+            self._data_sh = self._label_sh = None
+
+        entry = {
+            "jit": jax.jit(step_fn, **jit_kwargs),
+            "params": params,
+            "diff_idx": diff_idx,
+            "diff_nds": diff_nds,
+            "frozen_nds": frozen_nds,
+            "out_box": out_box,
+            "data_spec": data_spec,
+            "label_spec": label_spec,
+        }
+        return entry
+
+    # -- call ----------------------------------------------------------
+    def __call__(self, data, label):
+        """Run one training step; returns the (scalar NDArray) loss."""
+        data_leaves, data_spec = _flatten_arrays(_as_tuple(data))
+        label_leaves, label_spec = _flatten_arrays(_as_tuple(label))
+        sig = (tuple((l.shape, str(l.dtype)) for l in data_leaves),
+               tuple((l.shape, str(l.dtype)) for l in label_leaves),
+               repr(data_spec), repr(label_spec))
+        entry = self._entries.get(sig)
+        if entry is None:
+            entry = self._build(data_leaves, data_spec,
+                                label_leaves, label_spec)
+            self._entries[sig] = entry
+        opt = self.optimizer
+        n_diff = len(entry["diff_nds"])
+        opt._update_count(list(range(n_diff)))
+        hypers = [opt._hyper(k) for k in range(n_diff)]
+
+        data_datas = [l._data for l in data_leaves]
+        label_datas = [l._data for l in label_leaves]
+        if self._data_sh is not None:
+            data_datas = [jax.device_put(d, sh) for d, sh in
+                          zip(data_datas, self._data_sh)]
+            label_datas = [jax.device_put(d, sh) for d, sh in
+                          zip(label_datas, self._label_sh)]
+
+        diff_datas = tuple(nd._data for nd in entry["diff_nds"])
+        new_ws, new_ss, loss, aux = entry["jit"](
+            next_key(), diff_datas, tuple(nd._data for nd in
+                                          entry["frozen_nds"]),
+            tuple(self._opt_states), hypers,
+            tuple(data_datas), tuple(label_datas))
+
+        for nd, nw in zip(entry["diff_nds"], new_ws):
+            nd._data = nw
+        self._opt_states = list(new_ss)
+        targets = entry["out_box"].get("aux_targets", [])
+        with autograd.pause():
+            for nd, new in zip(targets, aux):
+                nd._install(new)
+        return NDArray(engine.track(loss))
+
+
+def _placed_as(data, sh):
+    try:
+        return isinstance(data, jax.Array) and data.sharding == sh
+    except Exception:
+        return False

@@ -142,3 +142,25 @@ def test_cell_unroll_valid_length():
                               valid_length=vl)
     o = out.asnumpy()
     assert abs(o[1, 3]).sum() == 0 and abs(o[1, 1]).sum() > 0
+
+
+def test_bidirectional_valid_length_ignores_padding():
+    """Reverse direction must not consume padding before real data
+    (regression: plain reversed() fed padding into the r_cell first)."""
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(4, input_size=3),
+                                 rnn.LSTMCell(4, input_size=3))
+    cell.initialize()
+    x = np.random.uniform(size=(2, 5, 3))
+    vl = np.array([5, 2])
+    out, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True,
+                         valid_length=vl)
+    # same sequence content but different padding garbage → identical
+    # outputs at the valid steps
+    x2 = x.copy()
+    x2[1, 2:] = 777.0
+    out2, _ = cell.unroll(5, x2, layout="NTC", merge_outputs=True,
+                          valid_length=vl)
+    onp.testing.assert_allclose(out.asnumpy()[1, :2],
+                                out2.asnumpy()[1, :2], rtol=1e-5)
+    onp.testing.assert_allclose(out.asnumpy()[0], out2.asnumpy()[0],
+                                rtol=1e-5)

@@ -1,0 +1,83 @@
+"""Headline benchmark: ResNet-50 ImageNet-shape training throughput.
+
+Mirrors BASELINE.json config 2 (Gluon ResNet-50, hybridized/fused train
+step). Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+`vs_baseline` compares images/sec/chip against the published MXNet
+ResNet-50 fp32 per-V100 throughput (~360 images/sec/GPU on 8xV100 NCCL
+runs; BASELINE.json's "published" table is empty so the commonly cited
+NVIDIA/MXNet fp32 number is used as the denominator).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0
+
+
+def main():
+    import jax
+    # The axon TPU plugin registers itself regardless of JAX_PLATFORMS;
+    # honor an explicit platform request before any backend init so
+    # local CPU runs don't block on the TPU tunnel.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+
+    small = os.environ.get("BENCH_SMALL", "") not in ("", "0")
+    platform = jax.default_backend()
+    if platform == "cpu" and "BENCH_SMALL" not in os.environ:
+        small = True
+
+    n_dev = jax.local_device_count()
+    mesh = parallel.make_mesh((n_dev,), ("dp",))
+    parallel.set_mesh(mesh)
+
+    if small:
+        net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
+        batch, hw, warmup, iters = 2 * n_dev, 32, 1, 3
+    else:
+        net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
+        batch, hw, warmup, iters = 128 * n_dev, 224, 5, 20
+    net.initialize()
+    net.cast("bfloat16")
+
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "multi_precision": True},
+        mesh=mesh, batch_axis="dp")
+
+    data = mx.np.random.uniform(size=(batch, hw, hw, 3), dtype="bfloat16")
+    label = mx.np.zeros((batch,), dtype="int32")
+
+    for _ in range(warmup):
+        loss = step(data, label)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(data, label)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    ips = batch * iters / dt
+    ips_per_chip = ips / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip"
+        if not small else "resnet18_small_train_images_per_sec_per_chip",
+        "value": round(ips_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

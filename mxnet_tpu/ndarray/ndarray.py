@@ -280,6 +280,11 @@ class NDArray:
         """
         import jax as _jax
         newd = fn(self._data, new._data if isinstance(new, NDArray) else new)
+        # aux state must keep its dtype: stats math may upcast (e.g.
+        # bf16 nets accumulate in f32) and a dtype flip would retrace
+        # every compiled step that threads this buffer through.
+        if newd.dtype != self._data.dtype:
+            newd = jnp.asarray(newd, self._data.dtype)
         if isinstance(newd, _jax.core.Tracer):
             from ..gluon import _deferred
             _deferred.register_state_update(self, newd)

@@ -111,6 +111,11 @@ class TrainStep:
         mp_flags = self._mp_flags
 
         out_box = {}
+        # capture only the contexts — closing over the leaf NDArrays
+        # would pin the build-time batch buffers in HBM for the
+        # lifetime of this cached entry
+        data_ctxs = [l.ctx for l in data_leaves]
+        label_ctxs = [l.ctx for l in label_leaves]
 
         def forward_loss(key, diff_datas, frozen_datas,
                          input_datas, label_datas):
@@ -123,10 +128,10 @@ class TrainStep:
                 for nd, d in zip(frozen_nds, frozen_datas):
                     nd._data = d
                 try:
-                    in_nds = [NDArray(d, ctx=l.ctx)
-                              for d, l in zip(input_datas, data_leaves)]
-                    lab_nds = [NDArray(d, ctx=l.ctx)
-                               for d, l in zip(label_datas, label_leaves)]
+                    in_nds = [NDArray(d, ctx=c)
+                              for d, c in zip(input_datas, data_ctxs)]
+                    lab_nds = [NDArray(d, ctx=c)
+                               for d, c in zip(label_datas, label_ctxs)]
                     args = _rebuild(data_spec, in_nds)
                     out = net.forward(*args)
                     labels = _rebuild(label_spec, lab_nds)
@@ -226,12 +231,12 @@ class TrainStep:
                 d = frozen_nds[j]._data
                 if not _placed_as(d, frozen_sh[j]):
                     frozen_nds[j]._data = jax.device_put(d, frozen_sh[j])
-            self._data_sh = data_sh
-            self._label_sh = label_sh
         else:
-            self._data_sh = self._label_sh = None
+            data_sh = label_sh = None
 
         entry = {
+            "data_sh": data_sh,
+            "label_sh": label_sh,
             "jit": jax.jit(step_fn, **jit_kwargs),
             "params": params,
             "diff_idx": diff_idx,
@@ -263,11 +268,11 @@ class TrainStep:
 
         data_datas = [l._data for l in data_leaves]
         label_datas = [l._data for l in label_leaves]
-        if self._data_sh is not None:
+        if entry["data_sh"] is not None:
             data_datas = [jax.device_put(d, sh) for d, sh in
-                          zip(data_datas, self._data_sh)]
+                          zip(data_datas, entry["data_sh"])]
             label_datas = [jax.device_put(d, sh) for d, sh in
-                          zip(label_datas, self._label_sh)]
+                          zip(label_datas, entry["label_sh"])]
 
         diff_datas = tuple(nd._data for nd in entry["diff_nds"])
         new_ws, new_ss, loss, aux = entry["jit"](

@@ -22,7 +22,9 @@ import jax
 
 class _RngState(threading.local):
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        # Lazy: PRNGKey creation initializes the JAX backend; importing
+        # the library must not (callers may still select a platform).
+        self.key = None
         self.trace_key = None   # set while tracing a CachedOp
         self.trace_counter = 0
 
@@ -43,6 +45,8 @@ def next_key():
         _state.trace_counter += 1
         return jax.random.fold_in(_state.trace_key, _state.trace_counter)
     with _lock:
+        if _state.key is None:
+            _state.key = jax.random.PRNGKey(0)
         _state.key, sub = jax.random.split(_state.key)
     return sub
 

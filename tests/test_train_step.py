@@ -77,5 +77,5 @@ def test_train_step_mesh_dp_tp():
     assert losses[-1] < losses[0] * 0.7
     # parameter really landed sharded over tp
     w = net[0].weight.data()._data
-    assert len(set(d.id for d in w.sharding.device_set)) == 8 or \
-        len(w.sharding.device_set) > 1
+    assert w.sharding.spec == P("tp", None)
+    assert len(set(d.id for d in w.sharding.device_set)) == 8

@@ -43,10 +43,13 @@ import importlib as _importlib
 
 for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "kvstore", "parallel", "profiler", "runtime", "test_utils",
-             "util", "recordio", "image", "io", "amp", "random"):
+             "util", "recordio", "image", "io", "amp", "random", "symbol"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:
         if f"mxnet_tpu.{_mod}" not in str(_e):
             raise
 del _importlib, _mod
+
+if "symbol" in globals():
+    sym = globals()["symbol"]

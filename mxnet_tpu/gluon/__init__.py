@@ -1,6 +1,7 @@
 """Gluon — the imperative/hybrid neural network API
 (parity: python/mxnet/gluon)."""
 from .block import Block, HybridBlock, CachedOp  # noqa: F401
+from .symbol_block import SymbolBlock  # noqa: F401
 from .parameter import (  # noqa: F401
     Parameter, Constant, ParameterDict, DeferredInitializationError,
 )

@@ -24,6 +24,7 @@
 """
 from __future__ import annotations
 
+import os
 from collections import OrderedDict
 
 import numpy as onp
@@ -281,7 +282,7 @@ class _HookHandle:
 
 class _CachedEntry:
     __slots__ = ("fwd", "fwd_vjp", "bwd", "out_spec", "aux_targets",
-                 "param_nds", "params")
+                 "param_nds", "params", "in_spec")
 
 
 class CachedOp:
@@ -332,6 +333,7 @@ class CachedOp:
             return tuple(l._data for l in out_leaves), aux
 
         entry = _CachedEntry()
+        entry.in_spec = spec
         entry.params = params
         entry.param_nds = param_nds
         entry.fwd = jax.jit(raw_fn)
@@ -471,33 +473,70 @@ class HybridBlock(Block):
         """Serialize for deployment: params + compiled-graph artifact.
 
         The reference writes `-symbol.json` + `-NNNN.params`
-        (block.py:1471). Here the graph IR is StableHLO: we export the
-        jitted forward's StableHLO text next to the params so external
-        runtimes (or later rounds' SymbolBlock) can reload it.
+        (block.py:1471), reloaded by SymbolBlock.imports (:1670). Here
+        the graph IR is StableHLO via jax.export: `-symbol.mxir` holds
+        the serialized program, `-symbol.json` a manifest, and
+        SymbolBlock.imports reloads the pair. A human-readable
+        `-symbol.stablehlo` dump is written alongside.
+
+        Requires one prior hybridized forward (the reference likewise
+        exports the first cached graph).
         """
+        import json as _json
+        from jax import export as jax_export
+
         params_file = f"{path}-{epoch:04d}.params"
         self.save_parameters(params_file)
+        if self._cached_op is None or not self._cached_op._entries:
+            raise RuntimeError(
+                "export requires a hybridized forward call first "
+                "(net.hybridize(); net(x))")
+        # export the INFERENCE graph: a training-mode entry would bake
+        # dropout masks / batch statistics into the artifact
+        sig = entry = None
+        for s, e in self._cached_op._entries.items():
+            if not s[2]:  # signature = (shapes, spec, training)
+                sig, entry = s, e
+                break
+        if entry is None:
+            tsig, tentry = next(iter(self._cached_op._entries.items()))
+            probe_leaves = [NDArray(jax.numpy.zeros(s, onp.dtype(d)))
+                            for s, d in tsig[0]]
+            entry = self._cached_op._build(probe_leaves, tentry.in_spec,
+                                           training=False)
+            sig = (tsig[0], tsig[1], False)
+            self._cached_op._entries[sig] = entry
+        shapes = sig[0]
+        key = jax.random.PRNGKey(0)
+        params = [nd._data for nd in entry.param_nds]
+
+        ins = tuple(jax.ShapeDtypeStruct(s, onp.dtype(d))
+                    for s, d in shapes)
+        pspecs = tuple(jax.ShapeDtypeStruct(p.shape, p.dtype)
+                       for p in params)
+        jitted = jax.jit(lambda p, i: entry.fwd(key, p, i)[0])
+        exported = jax_export.export(jitted)(pspecs, ins)
+        mxir_file = f"{path}-symbol.mxir"
+        with open(mxir_file, "wb") as f:
+            f.write(exported.serialize())
         hlo_file = f"{path}-symbol.stablehlo"
-        entry = None
-        if self._cached_op is not None and self._cached_op._entries:
-            entry = next(iter(self._cached_op._entries.values()))
-        if entry is not None:
-            try:
-                import inspect  # noqa: F401
-                # lower with the shapes of the first cached signature
-                sig = next(iter(self._cached_op._entries.keys()))
-                shapes = sig[0]
-                import jax.numpy as jnp
-                key = jax.random.PRNGKey(0)
-                params = [nd._data for nd in entry.param_nds]
-                ins = [jnp.zeros(s, dtype=onp.dtype(d)) for s, d in shapes]
-                lowered = jax.jit(
-                    lambda p, i: entry.fwd(key, p, i)).lower(params, ins)
-                with open(hlo_file, "w") as f:
-                    f.write(lowered.as_text())
-            except Exception:
-                hlo_file = None
-        return params_file, hlo_file
+        with open(hlo_file, "w") as f:
+            f.write(jitted.lower(pspecs, ins).as_text())
+        names = list(self.collect_params().keys())
+        manifest = {
+            "format": "jax.export",
+            "artifact": os.path.basename(mxir_file),
+            "params": os.path.basename(params_file),
+            "param_names": names,
+            "param_dtypes": [str(onp.dtype(p.dtype)) for p in params],
+            "n_outputs": len(exported.out_avals),
+            "input_shapes": [list(s) for s, _ in shapes],
+            "input_dtypes": [str(d) for _, d in shapes],
+        }
+        sym_file = f"{path}-symbol.json"
+        with open(sym_file, "w") as f:
+            _json.dump(manifest, f, indent=2)
+        return sym_file, params_file
 
     def forward(self, *args, **kwargs):
         raise NotImplementedError

@@ -1,0 +1,134 @@
+"""SymbolBlock — run a serialized graph as a Gluon block.
+
+Parity: python/mxnet/gluon/block.py:1638 (SymbolBlock) +
+`SymbolBlock.imports` (:1670), which reload a `HybridBlock.export`ed
+`-symbol.json` + `-NNNN.params` pair.
+
+Two artifact kinds are supported:
+- a Symbol DAG json (mx.sym `tojson`/`save`) — rebuilt as an op DAG
+  whose free variables (minus the declared inputs) become Parameters;
+- a jax.export manifest written by `HybridBlock.export` — the
+  deployment path: the compiled StableHLO program is deserialized and
+  invoked directly (the TPU equivalent of the reference's CachedOp
+  re-creation on import).
+"""
+from __future__ import annotations
+
+import json
+import os
+
+from .block import HybridBlock
+from .parameter import Parameter
+from ..ndarray.ndarray import NDArray
+from .. import engine
+
+
+class SymbolBlock(HybridBlock):
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__()
+        from ..symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if not isinstance(outputs, Symbol):
+            raise TypeError("outputs must be Symbol(s)")
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name if isinstance(i, Symbol) else str(i)
+                             for i in inputs]
+        self._sb_params = {}
+        params = params or {}
+        for name in outputs.list_arguments():
+            if name in self._input_names:
+                continue
+            p = Parameter(name, allow_deferred_init=True, dtype=None)
+            if name in params:
+                p.set_data(params[name])
+            self._sb_params[name] = p
+            # register under the symbol's own argument name (the
+            # reference keys SymbolBlock params by symbol name too)
+            self._reg_params[name] = p
+
+    def forward(self, *args):
+        bindings = {}
+        for name, a in zip(self._input_names, args):
+            bindings[name] = a
+        for name, p in self._sb_params.items():
+            bindings[name] = p.data()
+        outs = self._symbol._eval(bindings)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None,
+                allow_missing=False):
+        import mxnet_tpu as mx
+        with open(symbol_file) as f:
+            payload = json.load(f)
+        if payload.get("format") == "jax.export":
+            return _ExportedBlock(symbol_file, payload, param_file)
+        sym = mx.sym.load(symbol_file)
+        input_names = input_names if isinstance(input_names, (list, tuple)) \
+            else [input_names]
+        params = {}
+        if param_file:
+            params = {k: v for k, v in mx.load(param_file).items()}
+            # strip the reference's "arg:"/"aux:" prefixes if present
+            params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+        blk = SymbolBlock(sym, [mx.sym.var(n) if isinstance(n, str) else n
+                                for n in input_names], params=params)
+        if ctx is not None:
+            blk.reset_ctx(ctx)
+        return blk
+
+
+class _ExportedBlock(HybridBlock):
+    """A block backed by a deserialized jax.export program."""
+
+    def __init__(self, symbol_file, manifest, param_file=None):
+        super().__init__()
+        from jax import export as jax_export
+        base = os.path.dirname(os.path.abspath(symbol_file))
+        blob_path = manifest["artifact"]
+        if not os.path.isabs(blob_path):
+            blob_path = os.path.join(base, blob_path)
+        with open(blob_path, "rb") as f:
+            self._exported = jax_export.deserialize(bytearray(f.read()))
+        self._manifest = manifest
+        self._n_outputs = manifest.get("n_outputs", 1)
+        import jax.numpy as jnp
+        import mxnet_tpu as mx
+        pf = param_file or manifest.get("params")
+        if pf and not os.path.isabs(pf):
+            pf = os.path.join(base, pf)
+        self._param_values = []
+        if pf:
+            names = manifest.get("param_names")
+            if names is None:
+                raise ValueError(
+                    f"{symbol_file} has no param_names; cannot order "
+                    "positional parameters for the exported program")
+            loaded = mx.load(pf)
+            dtypes = manifest.get("param_dtypes") or [None] * len(names)
+            for n, dt in zip(names, dtypes):
+                v = loaded[n]
+                # .params files may round-trip through float32 (npz has
+                # no bf16); restore the program's expected dtype
+                if dt is not None and str(v.dtype) != dt:
+                    v = NDArray(jnp.asarray(v._data, dt))
+                self._param_values.append(v)
+        self._in_dtypes = manifest.get("input_dtypes")
+
+    def forward(self, *args):
+        import jax.numpy as jnp
+        datas = [a._data if isinstance(a, NDArray) else a for a in args]
+        if self._in_dtypes:
+            datas = [d if str(d.dtype) == dt else jnp.asarray(d, dt)
+                     for d, dt in zip(datas, self._in_dtypes)]
+        pvals = [p._data for p in self._param_values]
+        outs = self._exported.call(tuple(pvals), tuple(datas))
+        if isinstance(outs, tuple) and len(outs) == 2 and \
+                isinstance(outs[1], tuple) and not outs[1]:
+            outs = outs[0]  # (outputs, empty-aux) convention
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        nds = [NDArray(engine.track(o)) for o in outs]
+        return nds[0] if len(nds) == 1 else tuple(nds)

@@ -1,0 +1,22 @@
+"""mx.sym — legacy symbolic graph-building API.
+
+Capability parity with the reference's `mxnet.symbol`
+(python/mxnet/symbol/symbol.py, ~3.3k LoC over the nnvm C++ graph).
+TPU-native design: a Symbol is a small, JSON-serializable op DAG whose
+nodes name functions in the `mx.np`/`mx.npx` namespaces. Evaluation
+walks the DAG once under `jax.jit` tracing, so a bound Executor is ONE
+compiled XLA program — the reference needs CachedOp + graph passes +
+memory planning for the same effect (SURVEY.md §3.3); here that whole
+pipeline is XLA.
+
+Like the reference's 2.x line, the Executor shim delegates to the
+imperative autograd machinery for gradients
+(python/mxnet/executor.py:124 delegates to CachedOp + autograd).
+"""
+from . import _ops  # registers generated op wrappers  # noqa: F401
+from ._ops import *  # noqa: F401,F403
+# core names last so they win any collision with generated op wrappers
+from .symbol import (  # noqa: E402,F401
+    Symbol, var, Variable, Group, load, load_json, fromjson,
+    zeros, ones, full,
+)

@@ -1,0 +1,118 @@
+"""Symbol op wrappers, generated over the mx.np / mx.npx namespaces.
+
+The reference text-generates per-op Symbol functions from the nnvm
+registry at import (python/mxnet/symbol/register.py). Here the op
+table IS the numpy-API function table: a symbol node names a function
+in `mx.np` (or `mx.npx` with the "npx:" prefix) and stores its static
+kwargs; evaluation applies it to NDArrays (eagerly or under a jit
+trace — same funnel as every other op, ops/apply_op).
+"""
+from __future__ import annotations
+
+import sys
+
+from .symbol import Symbol, _compose
+
+# ops whose sym wrapper takes (data) or (lhs, rhs) positional Symbols;
+# everything else in kwargs is a static attr recorded on the node.
+_NP_OPS = [
+    # elementwise unary
+    "negative", "abs", "exp", "expm1", "log", "log2", "log10", "log1p",
+    "sqrt", "cbrt", "square", "reciprocal", "sign", "floor", "ceil",
+    "trunc", "rint", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    # binary
+    "add", "subtract", "multiply", "divide", "mod", "power", "maximum",
+    "minimum", "hypot", "arctan2", "copysign",
+    # comparison
+    "equal", "not_equal", "greater", "greater_equal", "less",
+    "less_equal", "logical_and", "logical_or", "logical_xor",
+    # reduce ("var" deliberately absent: mx.sym.var is the Variable
+    # constructor, as in the reference)
+    "sum", "mean", "prod", "max", "min", "argmax", "argmin", "std",
+    "norm",
+    # linalg / contraction
+    "dot", "matmul", "tensordot", "einsum",
+    # shape
+    "reshape", "transpose", "swapaxes", "expand_dims", "squeeze",
+    "concatenate", "stack", "split", "flip", "tile", "repeat",
+    "broadcast_to", "where", "clip", "take", "ravel",
+    # misc
+    "round", "floor_divide", "fmod", "absolute",
+]
+
+_NPX_OPS = [
+    "relu", "sigmoid", "log_sigmoid", "softmax", "log_softmax",
+    "leaky_relu", "activation", "fully_connected", "convolution",
+    "pooling", "batch_norm", "layer_norm", "dropout", "one_hot",
+    "pick", "topk", "batch_dot", "embedding", "rnn", "sequence_mask",
+    "gamma", "erf", "erfinv",
+]
+
+
+def _make_np(opname):
+    def wrapper(*inputs, name=None, **attrs):
+        syms = [x for x in inputs]
+        return _compose(opname, tuple(syms), name=name, **attrs)
+    wrapper.__name__ = opname
+    wrapper.__qualname__ = opname
+    wrapper.__doc__ = f"Symbolic version of mx.np.{opname}."
+    return wrapper
+
+
+def _make_npx(opname):
+    key = f"npx:{opname}"
+
+    def wrapper(*inputs, name=None, **attrs):
+        return _compose(key, tuple(inputs), name=name, **attrs)
+    wrapper.__name__ = opname
+    wrapper.__qualname__ = opname
+    wrapper.__doc__ = f"Symbolic version of mx.npx.{opname}."
+    return wrapper
+
+
+_this = sys.modules[__name__]
+__all__ = []
+for _op in _NP_OPS:
+    setattr(_this, _op, _make_np(_op))
+    __all__.append(_op)
+for _op in _NPX_OPS:
+    if not hasattr(_this, _op):
+        setattr(_this, _op, _make_npx(_op))
+        __all__.append(_op)
+
+_TABLE = None
+
+
+def op_table():
+    """name → callable over NDArrays (resolved lazily to avoid import
+    cycles; unknown names fail loudly at eval time)."""
+    global _TABLE
+    if _TABLE is None:
+        import mxnet_tpu as mx
+
+        table = {}
+        for op in _NP_OPS:
+            fn = getattr(mx.np, op, None)
+            if fn is None:
+                fn = getattr(mx.npx, op, None)
+            if fn is not None:
+                table[op] = fn
+        for op in _NPX_OPS:
+            fn = getattr(mx.npx, op, None)
+            if fn is not None:
+                table[f"npx:{op}"] = fn
+        table["_scalar"] = lambda value=None: value
+        table["_astype"] = lambda x, dtype=None: x.astype(dtype)
+        table["_flatten"] = lambda x: x.reshape((x.shape[0], -1)) \
+            if x.ndim > 1 else x
+        table["reshape"] = lambda x, newshape=None: x.reshape(
+            tuple(newshape))
+        table["zeros"] = lambda shape=None, dtype=None: mx.np.zeros(
+            tuple(shape), dtype=dtype)
+        table["ones"] = lambda shape=None, dtype=None: mx.np.ones(
+            tuple(shape), dtype=dtype)
+        table["full"] = lambda shape=None, value=None, dtype=None: \
+            mx.np.full(tuple(shape), value, dtype=dtype)
+        _TABLE = table
+    return _TABLE

@@ -1,0 +1,51 @@
+"""Executor — bound evaluation of a Symbol.
+
+Parity: python/mxnet/executor.py (the 2.x shim whose forward delegates
+to CachedOp and whose backward delegates to autograd). Here forward
+jits the DAG walk into one XLA program per input signature; backward
+runs the same imperative autograd used everywhere else.
+"""
+from __future__ import annotations
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req):
+        import mxnet_tpu as mx
+        self._symbol = symbol
+        self._ctx = ctx or mx.current_context()
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad) if args_grad else {}
+        self._grad_req = grad_req
+        self.outputs = []
+        self._recorded = None
+
+    def forward(self, is_train=False, **kwargs):
+        import mxnet_tpu as mx
+        from mxnet_tpu import autograd
+        self.arg_dict.update({k: v if isinstance(v, mx.NDArray)
+                              else mx.np.array(v)
+                              for k, v in kwargs.items()})
+        want_grad = is_train and self._grad_req != "null" and self.grad_dict
+        if want_grad:
+            for name in self.grad_dict:
+                self.arg_dict[name].attach_grad(self._grad_req)
+            with autograd.record():
+                outs = self._symbol._eval(self.arg_dict)
+            self._recorded = outs
+        else:
+            outs = self._symbol._eval(self.arg_dict)
+            self._recorded = None
+        self.outputs = outs
+        return outs
+
+    def backward(self, out_grads=None):
+        from mxnet_tpu import autograd
+        if self._recorded is None:
+            raise RuntimeError("call forward(is_train=True) before backward")
+        heads = self._recorded
+        autograd.backward(heads, head_grads=out_grads)
+        for name, g in self.grad_dict.items():
+            arr = self.arg_dict[name]
+            if arr.grad is not None:
+                g[:] = arr.grad
+        return [self.grad_dict.get(n) for n in self._symbol.list_arguments()]

@@ -1,0 +1,439 @@
+"""Symbol core: serializable op DAG + shape/type inference + Executor.
+
+Parity map (reference: python/mxnet/symbol/symbol.py over nnvm):
+- `Symbol` node DAG w/ named variables          symbol.py:60 (nnvm graph)
+- compose by calling op wrappers                 generated op modules
+- `infer_shape` / `infer_type`                   symbol.py:1132,1222 — here
+  via `jax.eval_shape` over the DAG (no FLOPs)
+- `tojson` / `load` round-trip                   symbol.py:1310 (nnvm JSON)
+- `bind/simple_bind` → Executor                  python/mxnet/executor.py —
+  forward is one jitted XLA program; backward via mx autograd
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+_SYM_VERSION = 1
+
+
+class _Node:
+    __slots__ = ("op", "name", "inputs", "attrs")
+
+    def __init__(self, op, name, inputs, attrs):
+        self.op = op          # "null" for variables, else op-table name
+        self.name = name
+        self.inputs = inputs  # list of (node_id, out_index)
+        self.attrs = attrs    # JSON-serializable static kwargs
+
+    def to_dict(self):
+        return {"op": self.op, "name": self.name,
+                "inputs": [list(i) for i in self.inputs],
+                "attrs": self.attrs}
+
+
+class Symbol:
+    """An output (or group of outputs) of a serializable op DAG."""
+
+    def __init__(self, nodes, outputs):
+        self._nodes = nodes            # list[_Node]; topo order
+        self._outputs = list(outputs)  # list[(node_id, out_index)]
+
+    # -- introspection -------------------------------------------------
+    @property
+    def name(self):
+        nid, idx = self._outputs[0]
+        return self._nodes[nid].name
+
+    def list_arguments(self):
+        seen, out = set(), []
+        for n in self._reachable():
+            node = self._nodes[n]
+            if node.op == "null" and node.name not in seen:
+                seen.add(node.name)
+                out.append(node.name)
+        return out
+
+    def list_inputs(self):
+        return self.list_arguments()
+
+    def list_outputs(self):
+        return [f"{self._nodes[nid].name}_output"
+                for nid, idx in self._outputs]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def get_internals(self):
+        outs = [(i, 0) for i, n in enumerate(self._nodes)]
+        return Symbol(self._nodes, outs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for i, n in enumerate(self._nodes):
+                if n.name == idx or f"{n.name}_output" == idx:
+                    return Symbol(self._nodes, [(i, 0)])
+            raise ValueError(f"no output named {idx!r}")
+        return Symbol(self._nodes, [self._outputs[idx]])
+
+    def __len__(self):
+        return len(self._outputs)
+
+    def __iter__(self):
+        return (self[i] for i in range(len(self)))
+
+    def _reachable(self):
+        stack = [nid for nid, _ in self._outputs]
+        seen = []
+        visited = set()
+        while stack:
+            nid = stack.pop()
+            if nid in visited:
+                continue
+            visited.add(nid)
+            seen.append(nid)
+            stack.extend(i for i, _ in self._nodes[nid].inputs)
+        return sorted(seen)
+
+    def __repr__(self):
+        return (f"<Symbol {self.name} "
+                f"args={self.list_arguments()}>")
+
+    # -- composition ---------------------------------------------------
+    def attr(self, key):
+        nid, _ = self._outputs[0]
+        return self._nodes[nid].attrs.get(key)
+
+    # arithmetic sugar (maps onto op-table entries)
+    def __add__(self, other):
+        return _compose("add", (self, other))
+
+    def __radd__(self, other):
+        return _compose("add", (other, self))
+
+    def __sub__(self, other):
+        return _compose("subtract", (self, other))
+
+    def __rsub__(self, other):
+        return _compose("subtract", (other, self))
+
+    def __mul__(self, other):
+        return _compose("multiply", (self, other))
+
+    def __rmul__(self, other):
+        return _compose("multiply", (other, self))
+
+    def __truediv__(self, other):
+        return _compose("divide", (self, other))
+
+    def __rtruediv__(self, other):
+        return _compose("divide", (other, self))
+
+    def __pow__(self, other):
+        return _compose("power", (self, other))
+
+    def __neg__(self):
+        return _compose("negative", (self,))
+
+    # method sugar mirroring NDArray methods
+    def sum(self, axis=None, keepdims=False):
+        return _compose("sum", (self,), axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return _compose("mean", (self,), axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return _compose("max", (self,), axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return _compose("min", (self,), axis=axis, keepdims=keepdims)
+
+    def reshape(self, shape):
+        return _compose("reshape", (self,), newshape=list(shape))
+
+    def transpose(self, axes=None):
+        return _compose("transpose", (self,), axes=axes)
+
+    def squeeze(self, axis=None):
+        return _compose("squeeze", (self,), axis=axis)
+
+    def astype(self, dtype):
+        return _compose("_astype", (self,), dtype=str(onp.dtype(dtype)))
+
+    def flatten(self):
+        return _compose("_flatten", (self,))
+
+    def dot(self, other):
+        return _compose("dot", (self, other))
+
+    # -- evaluation ----------------------------------------------------
+    def _eval(self, arg_arrays):
+        """Walk the DAG over NDArray inputs; returns list of NDArray."""
+        from . import _ops
+        vals = {}
+        for nid in self._topo():
+            node = self._nodes[nid]
+            if node.op == "null":
+                if node.name not in arg_arrays:
+                    raise ValueError(
+                        f"missing binding for argument {node.name!r}")
+                vals[nid] = (arg_arrays[node.name],)
+            else:
+                fn = _ops.op_table()[node.op]
+                ins = [vals[i][idx] for i, idx in node.inputs]
+                out = fn(*ins, **node.attrs)
+                vals[nid] = tuple(out) if isinstance(out, (tuple, list)) \
+                    else (out,)
+        return [vals[nid][idx] for nid, idx in self._outputs]
+
+    def _topo(self):
+        order, visited = [], set()
+
+        def visit(nid):
+            if nid in visited:
+                return
+            visited.add(nid)
+            for i, _ in self._nodes[nid].inputs:
+                visit(i)
+            order.append(nid)
+
+        for nid, _ in self._outputs:
+            visit(nid)
+        return order
+
+    def eval(self, ctx=None, **kwargs):
+        return self._eval(kwargs)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError("Symbol is not callable; use bind/eval or "
+                        "gluon.SymbolBlock")
+
+    # -- inference -----------------------------------------------------
+    def infer_shape(self, **kwarg_shapes):
+        arg_s, out_s, _ = self._infer(kwarg_shapes, want="shape")
+        return arg_s, out_s, []
+
+    def infer_shape_partial(self, **kwarg_shapes):
+        try:
+            return self.infer_shape(**kwarg_shapes)
+        except Exception:
+            return None, None, None
+
+    def infer_type(self, **kwarg_dtypes):
+        arg_t, out_t, _ = self._infer(kwarg_dtypes, want="dtype")
+        return arg_t, out_t, []
+
+    def _arg_decls(self):
+        """Declared per-variable shape/dtype attrs (var(shape=, dtype=))."""
+        decls = {}
+        for n in self._nodes:
+            if n.op == "null":
+                decls[n.name] = (n.attrs.get("__shape__"),
+                                 n.attrs.get("__dtype__"))
+        return decls
+
+    def _infer(self, kwargs, want):
+        import jax
+        args = self.list_arguments()
+        decls = self._arg_decls()
+        specs = {}
+        for a in args:
+            v = kwargs.get(a)
+            dshape, ddtype = decls.get(a, (None, None))
+            if want == "shape":
+                shape = tuple(v) if v is not None else (
+                    tuple(dshape) if dshape else None)
+                if shape is None:
+                    raise ValueError(f"shape of argument {a!r} unknown; "
+                                     f"pass {a}=<shape> or declare it on "
+                                     "the variable")
+                dt = onp.dtype(ddtype) if ddtype else onp.float32
+                specs[a] = jax.ShapeDtypeStruct(shape, dt)
+            else:
+                # type inference still evaluates abstractly, so shapes
+                # must come from var declarations for shape-sensitive
+                # graphs (the reference infers types shape-free; here
+                # XLA abstract eval needs real ranks)
+                shape = tuple(dshape) if dshape else (1,)
+                specs[a] = jax.ShapeDtypeStruct(
+                    shape, onp.dtype(v) if v is not None else (
+                        onp.dtype(ddtype) if ddtype else onp.float32))
+
+        from ..ndarray.ndarray import NDArray
+
+        names = list(specs.keys())
+
+        def raw(*datas):
+            nd_args = {n: NDArray(d) for n, d in zip(names, datas)}
+            outs = self._eval(nd_args)
+            return tuple(o._data for o in outs)
+
+        out_abs = jax.eval_shape(raw, *[specs[n] for n in names])
+        if want == "shape":
+            return ([tuple(specs[n].shape) for n in names],
+                    [tuple(o.shape) for o in out_abs], None)
+        return ([specs[n].dtype for n in names],
+                [o.dtype for o in out_abs], None)
+
+    # -- serialization -------------------------------------------------
+    def tojson(self):
+        reach = self._reachable()
+        remap = {nid: i for i, nid in enumerate(reach)}
+        nodes = []
+        for nid in reach:
+            n = self._nodes[nid]
+            d = n.to_dict()
+            d["inputs"] = [[remap[i], idx] for i, idx in n.inputs]
+            nodes.append(d)
+        return json.dumps({
+            "mxnet_tpu_symbol_version": _SYM_VERSION,
+            "nodes": nodes,
+            "arg_nodes": [remap[nid] for nid in reach
+                          if self._nodes[nid].op == "null"],
+            "heads": [[remap[nid], idx] for nid, idx in self._outputs],
+        }, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    # -- executor ------------------------------------------------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from .executor import Executor
+        return Executor(self, ctx, args or {}, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **kwarg_shapes):
+        import mxnet_tpu as mx
+        arg_shapes, _, _ = self.infer_shape(**kwarg_shapes)
+        names = self.list_arguments()
+        args = {n: mx.np.zeros(s) for n, s in zip(names, arg_shapes)}
+        grads = {n: mx.np.zeros(s) for n, s in zip(names, arg_shapes)} \
+            if grad_req != "null" else None
+        return self.bind(ctx, args, grads, grad_req)
+
+
+# ---------------------------------------------------------------------------
+# construction helpers
+# ---------------------------------------------------------------------------
+_name_counter = {}
+
+
+def _auto_name(op):
+    c = _name_counter.get(op, 0)
+    _name_counter[op] = c + 1
+    return f"{op}{c}"
+
+
+def var(name, shape=None, dtype=None, init=None, **kwargs):
+    """Create a symbolic variable (parity: mx.sym.var/Variable)."""
+    attrs = {}
+    if shape is not None:
+        attrs["__shape__"] = list(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(onp.dtype(dtype))
+    node = _Node("null", name, [], attrs)
+    return Symbol([node], [(0, 0)])
+
+
+Variable = var
+
+
+def _compose(op, inputs, name=None, **attrs):
+    """Build a new Symbol applying `op` to `inputs` (Symbols/scalars)."""
+    nodes = []
+    in_entries = []
+    remap_cache = {}
+
+    def merge(sym):
+        key = id(sym._nodes)
+        if key not in remap_cache:
+            base = len(nodes)
+            nodes.extend(sym._nodes)
+            remap = {}
+            for i in range(len(sym._nodes)):
+                n = nodes[base + i]
+                nodes[base + i] = _Node(
+                    n.op, n.name,
+                    [(base + j, idx) for j, idx in n.inputs], n.attrs)
+                remap[i] = base + i
+            remap_cache[key] = remap
+        return remap_cache[key]
+
+    # merge by name for variables: two graphs both using var('x') must
+    # share the leaf after composition
+    for x in inputs:
+        if isinstance(x, Symbol):
+            remap = merge(x)
+            nid, idx = x._outputs[0]
+            in_entries.append((remap[nid], idx))
+        else:
+            # scalar literal → attr-carrying constant node
+            cnode = _Node("_scalar", _auto_name("scalar"), [],
+                          {"value": x})
+            nodes.append(cnode)
+            in_entries.append((len(nodes) - 1, 0))
+
+    # unify variable leaves with identical names
+    by_name = {}
+    alias = {}
+    for i, n in enumerate(nodes):
+        if n.op == "null":
+            if n.name in by_name:
+                alias[i] = by_name[n.name]
+            else:
+                by_name[n.name] = i
+    if alias:
+        def fix(e):
+            return (alias.get(e[0], e[0]), e[1])
+        nodes = [_Node(n.op, n.name, [fix(e) for e in n.inputs], n.attrs)
+                 for n in nodes]
+        in_entries = [fix(e) for e in in_entries]
+
+    node = _Node(op, name or _auto_name(op), in_entries, attrs)
+    nodes = nodes + [node]
+    return Symbol(nodes, [(len(nodes) - 1, 0)])
+
+
+def Group(symbols):
+    outs = []
+    nodes = []
+    for s in symbols:
+        base = len(nodes)
+        nodes.extend(_Node(n.op, n.name,
+                           [(base + i, idx) for i, idx in n.inputs],
+                           n.attrs) for n in s._nodes)
+        outs.extend((base + nid, idx) for nid, idx in s._outputs)
+    return Symbol(nodes, outs)
+
+
+def fromjson(text):
+    d = json.loads(text)
+    nodes = [_Node(n["op"], n["name"],
+                   [tuple(i) for i in n["inputs"]], n.get("attrs", {}))
+             for n in d["nodes"]]
+    return Symbol(nodes, [tuple(h) for h in d["heads"]])
+
+
+load_json = fromjson
+
+
+def load(fname):
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+def zeros(shape, dtype=None, **kwargs):
+    return _compose("zeros", (), shape=list(shape),
+                    dtype=str(onp.dtype(dtype or onp.float32)))
+
+
+def ones(shape, dtype=None, **kwargs):
+    return _compose("ones", (), shape=list(shape),
+                    dtype=str(onp.dtype(dtype or onp.float32)))
+
+
+def full(shape, val, dtype=None, **kwargs):
+    return _compose("full", (), shape=list(shape), value=val,
+                    dtype=str(onp.dtype(dtype or onp.float32)))

@@ -1,0 +1,146 @@
+"""Symbol API tests (parity model: tests/python/unittest/test_symbol.py)."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_compose_and_eval():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = 2.0 * mx.sym.dot(a, b) + 1.0
+    assert c.list_arguments() == ["a", "b"]
+    x = mx.np.random.uniform(size=(3, 4))
+    y = mx.np.random.uniform(size=(4, 5))
+    out = c._eval({"a": x, "b": y})[0]
+    onp.testing.assert_allclose(
+        out.asnumpy(), 2.0 * (x.asnumpy() @ y.asnumpy()) + 1.0, rtol=1e-5)
+
+
+def test_shared_variable_unification():
+    a = mx.sym.var("a")
+    s = mx.sym.relu(a) + mx.sym.sigmoid(a)
+    assert s.list_arguments() == ["a"]
+
+
+def test_infer_shape_type():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = mx.sym.dot(a, b).sum()
+    arg_shapes, out_shapes, _ = c.infer_shape(a=(6, 3), b=(3, 7))
+    assert arg_shapes == [(6, 3), (3, 7)]
+    assert out_shapes == [()]
+
+
+def test_json_roundtrip(tmp_path):
+    a = mx.sym.var("a")
+    net = mx.sym.tanh(a * 3.0).mean()
+    f = str(tmp_path / "sym.json")
+    net.save(f)
+    net2 = mx.sym.load(f)
+    x = mx.np.random.uniform(size=(5, 5))
+    onp.testing.assert_allclose(net._eval({"a": x})[0].asnumpy(),
+                                net2._eval({"a": x})[0].asnumpy(), rtol=1e-6)
+
+
+def test_group_and_getitem():
+    a = mx.sym.var("a")
+    g = mx.sym.Group([mx.sym.relu(a), mx.sym.sigmoid(a)])
+    assert len(g) == 2
+    x = mx.np.random.uniform(size=(3,), low=-1)
+    outs = g._eval({"a": x})
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(),
+                                onp.maximum(x.asnumpy(), 0), rtol=1e-6)
+
+
+def test_executor_forward_backward():
+    a = mx.sym.var("a")
+    loss = (mx.sym.relu(a) ** 2.0).sum()
+    ex = loss.simple_bind(grad_req="write", a=(4, 4))
+    x = mx.np.random.uniform(size=(4, 4), low=-1, high=1)
+    ex.arg_dict["a"][:] = x
+    ex.forward(is_train=True)
+    ex.backward()
+    expect = 2 * onp.maximum(x.asnumpy(), 0)
+    onp.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), expect,
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_symbol_block():
+    data = mx.sym.var("data")
+    w = mx.sym.var("w")
+    out = mx.sym.relu(mx.sym.dot(data, w))
+    blk = gluon.SymbolBlock(
+        out, [data], params={"w": mx.np.random.uniform(size=(4, 8))})
+    x = mx.np.random.uniform(size=(2, 4))
+    y = blk(x)
+    assert y.shape == (2, 8)
+    assert "w" in blk.collect_params()
+
+
+def test_export_imports_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(5))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.random.uniform(size=(2, 4))
+    ref = net(x).asnumpy()
+    sym_file, params_file = net.export(str(tmp_path / "model"))
+    assert os.path.exists(sym_file) and os.path.exists(params_file)
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"])
+    onp.testing.assert_allclose(ref, blk(x).asnumpy(), rtol=2e-5)
+
+
+def test_export_bf16_roundtrip(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(5))
+    net.initialize()
+    net.cast("bfloat16")
+    net.hybridize()
+    x = mx.np.random.uniform(size=(2, 4), dtype="bfloat16")
+    ref = net(x).asnumpy()
+    sym_file, _ = net.export(str(tmp_path / "m"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"])
+    onp.testing.assert_allclose(ref.astype("float32"),
+                                blk(x).asnumpy().astype("float32"),
+                                rtol=2e-2)
+
+
+def test_export_prefers_inference_graph(tmp_path):
+    from mxnet_tpu import autograd
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dropout(0.9), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.random.uniform(size=(4, 6))
+    with autograd.record():
+        net(x)  # caches the training-mode entry first
+    sym_file, _ = net.export(str(tmp_path / "m"))
+    blk = gluon.SymbolBlock.imports(sym_file, ["data"])
+    # exported graph must be the eval graph: dropout off
+    onp.testing.assert_allclose(net(x).asnumpy(), blk(x).asnumpy(),
+                                rtol=2e-5, atol=1e-6)
+    import json
+    assert json.load(open(sym_file))["n_outputs"] == 1
+
+
+def test_infer_type_from_declared_shapes():
+    x = mx.sym.var("x", shape=(2, 3), dtype="float32")
+    w = mx.sym.var("w", shape=(5, 3))
+    o = mx.sym.dot(x, w.transpose())
+    arg_t, out_t, _ = o.infer_type()
+    assert out_t == [onp.dtype("float32")]
+    _, out_s, _ = o.infer_shape()
+    assert out_s == [(2, 5)]
+
+
+def test_export_requires_hybridized_forward(tmp_path):
+    net = nn.Dense(3)
+    net.initialize()
+    with pytest.raises(RuntimeError):
+        net.export(str(tmp_path / "m"))

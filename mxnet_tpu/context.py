@@ -74,17 +74,30 @@ class Context:
     # -- jax mapping ------------------------------------------------------
     @property
     def jax_device(self) -> jax.Device:
-        """The concrete jax.Device this context names."""
+        """The concrete jax.Device this context names.
+
+        Contexts name PROCESS-LOCAL devices (the reference's device ids
+        are per-worker too) — under multi-process jax, jax.devices()
+        lists the whole job's devices, most of them non-addressable."""
+        def _local(platform):
+            try:
+                return jax.local_devices(backend=platform)
+            except RuntimeError:
+                # backend not initialized/present: fall back to
+                # process-local devices of that platform
+                return [d for d in jax.local_devices()
+                        if d.platform == platform]
+
         if self.device_typeid == 2:
             plat = _accelerator_platform()
             if plat is None:
                 # No accelerator attached (e.g. CPU test meshes): tpu(i)
                 # degrades to the i-th host device so code is portable.
-                devs = jax.devices("cpu")
+                devs = _local("cpu")
             else:
-                devs = jax.devices(plat)
+                devs = _local(plat)
         else:
-            devs = jax.devices("cpu")
+            devs = _local("cpu")
         if self.device_id >= len(devs):
             raise ValueError(
                 f"context {self} out of range: only {len(devs)} "

@@ -83,6 +83,18 @@ class Trainer:
         else:
             self._kvstore = kv
             self._update_on_kvstore = bool(config["update_on_kvstore"] or False)
+            if self._update_on_kvstore:
+                if self._compression_params is not None:
+                    self._kvstore.set_gradient_compression(
+                        self._compression_params)
+                self._kvstore.set_optimizer(self._optimizer)
+        if self._kvstore is not None and self._update_on_kvstore:
+            # seed the store with the initial weights so the kvstore-side
+            # updater has something to update (parity: Trainer._init_params
+            # kv.init per key, gluon/trainer.py:188-277)
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null" and param._data is not None:
+                    self._kvstore.init(i, param.data())
         self._kv_initialized = True
 
     # -- properties ----------------------------------------------------
@@ -106,6 +118,24 @@ class Trainer:
         """rescale by 1/batch_size, allreduce, update."""
         self._check_and_init()
         self._optimizer.rescale_grad = self._scale / batch_size
+        if self._update_on_kvstore and self._kvstore is not None:
+            # optimizer runs where the weights live (parity: the
+            # reference's update_on_kvstore push-grad/pull-weight loop).
+            # A remote (parameter-server) optimizer was pickled with
+            # rescale_grad=1.0, so the batch rescale is applied to the
+            # gradient before the push; a local kvstore shares this
+            # process's optimizer object, which step() just rescaled.
+            remote = getattr(self._kvstore, "optimizer_on_remote", False)
+            for i, param in enumerate(self._params):
+                if param.grad_req == "null" or param._data is None:
+                    continue
+                grad = param.grad()
+                if remote:
+                    grad = grad * (self._scale / batch_size)
+                self._kvstore.push(i, grad, priority=-i)
+                self._kvstore.pull(i, out=param.data(), priority=-i)
+                param.data()._fresh_grad = False
+            return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
 

@@ -21,6 +21,9 @@ from __future__ import annotations
 
 from .base import KVStoreBase  # noqa: F401
 from .kvstore import KVStore, KVStoreLocal  # noqa: F401
+from .dist import KVStoreDistSync  # noqa: F401
+from .dist_async import KVStoreDistAsync, ParameterServer  # noqa: F401
+from .gradient_compression import GradientCompression  # noqa: F401
 
 
 def create(name="local"):

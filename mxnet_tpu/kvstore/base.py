@@ -19,14 +19,14 @@ class KVStoreBase:
     @staticmethod
     def create(name):
         name = name.lower()
-        # dist aliases resolve to the same class with flags
         registry = KVStoreBase.kv_registry
         if name in registry:
             return registry[name]()
-        for prefix, cls_name in (("dist_async", "dist_async"),
+        # dist aliases resolve to the registered class with mode flag
+        for prefix, cls_name in (("dist_async", "kvstoredistasync"),
                                  ("dist", "dist"),
-                                 ("nccl", "device"),
-                                 ("p3", "dist")):
+                                 ("p3", "dist"),
+                                 ("nccl", "device")):
             if name.startswith(prefix) and cls_name in registry:
                 return registry[cls_name](mode=name)
         raise ValueError(f"unknown KVStore type {name!r}; registered: "

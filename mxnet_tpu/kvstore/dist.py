@@ -1,0 +1,102 @@
+"""dist_sync — multi-host synchronous data parallelism.
+
+Parity: src/kvstore/kvstore_dist.h (sync mode: server aggregates when
+all NumWorkers() requests arrive, kvstore_dist_server.h:540-586).
+TPU-native replacement (SURVEY.md §2.3): there is no server — the
+cross-host reduction is an XLA collective over DCN. Each process's
+gradient becomes one shard of a global array laid out over a 'host'
+mesh axis; a jitted sum over that axis IS the synchronous barrier +
+reduce (XLA blocks until every participating process contributes).
+
+Bootstrap mirrors the reference's DMLC_* env wiring: call
+`mxnet_tpu.parallel.initialize_distributed()` (jax.distributed) in
+every process before creating a dist kvstore — tools/launch.py does
+this for the single-host "fake pod" test mode.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .base import KVStoreBase
+from .kvstore import KVStoreLocal
+
+
+@functools.lru_cache(maxsize=None)
+def _host_mesh():
+    """1-D mesh over each process's leader device — a kvstore value is
+    ONE logical array per process, so the cross-host reduce only needs
+    one device per host (multi-device sharding inside a host is the
+    TrainStep/pjit path, not the imperative kvstore path)."""
+    devs = jax.devices()
+    by_proc = {}
+    for d in devs:
+        by_proc.setdefault(d.process_index, d)
+    leaders = [by_proc[i] for i in sorted(by_proc)]
+    return Mesh(onp.asarray(leaders), ("host",))
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(mesh):
+    rep = NamedSharding(mesh, P())
+    return jax.jit(lambda stacked: jnp.sum(stacked, axis=0),
+                   out_shardings=rep)
+
+
+@KVStoreBase.register
+class KVStoreDistSync(KVStoreLocal):
+    """'dist_sync' / 'dist_device_sync' / 'dist_sync_device'."""
+
+    is_update_on_kvstore_default = False
+
+    def __init__(self, mode="dist_sync"):
+        super().__init__(mode)
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def _global_reduce(self, local_data):
+        n = jax.process_count()
+        if n == 1:
+            return local_data
+        mesh = _host_mesh()
+        dev = mesh.devices.ravel()[jax.process_index()]
+        local = jax.device_put(local_data[None], dev)
+        sharding = NamedSharding(mesh, P("host", *([None] *
+                                                   local_data.ndim)))
+        stacked = jax.make_array_from_single_device_arrays(
+            (n,) + tuple(local_data.shape), sharding, [local])
+        return _allreduce_fn(mesh)(stacked)
+
+    def _reduce(self, value, key=None):
+        local = KVStoreLocal._reduce(self, value, key)
+        return self._global_reduce(local)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if isinstance(key, (list, tuple)):
+            for i, k in enumerate(key):
+                self.pushpull(k, value[i], None if out is None else out[i],
+                              priority)
+            return
+        agg = self._reduce(value, key)
+        if out is None:
+            self._store[key] = agg
+        else:
+            self._assign(out, agg)
+
+
+# registry aliases
+KVStoreBase.kv_registry["dist"] = KVStoreDistSync
+KVStoreBase.kv_registry["dist_sync"] = KVStoreDistSync
+KVStoreBase.kv_registry["dist_device_sync"] = KVStoreDistSync
+KVStoreBase.kv_registry["dist_sync_device"] = KVStoreDistSync
+KVStoreBase.kv_registry["p3"] = KVStoreDistSync

@@ -46,13 +46,18 @@ class KVStoreLocal(KVStoreBase):
     is_update_on_kvstore_default = True
 
     # -- helpers -------------------------------------------------------
-    @staticmethod
-    def _reduce(value):
-        if isinstance(value, (list, tuple)):
-            if len(value) == 1:
-                return value[0]._data
-            return _sum_n(len(value))(*[v._data for v in value])
-        return value._data
+    def _reduce(self, value, key=None):
+        vals = list(value) if isinstance(value, (list, tuple)) else [value]
+        datas = [v._data for v in vals]
+        if self._compression is not None:
+            # quantize each replica with per-(key, replica) error
+            # feedback before aggregation (parity: compression happens
+            # before the push, gradient_compression.h)
+            datas = [self._compression.compress(key, j, d)
+                     for j, d in enumerate(datas)]
+        if len(datas) == 1:
+            return datas[0]
+        return _sum_n(len(datas))(*datas)
 
     @staticmethod
     def _assign(out, data):
@@ -76,7 +81,7 @@ class KVStoreLocal(KVStoreBase):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
-        agg = self._reduce(value)
+        agg = self._reduce(value, key)
         if self._updater is not None and key in self._store:
             w = NDArray(self._store[key])
             g = NDArray(agg)
@@ -102,7 +107,7 @@ class KVStoreLocal(KVStoreBase):
         if self._updater is not None and key in self._store and out is None:
             self.push(key, value, priority)
             return
-        agg = self._reduce(value)
+        agg = self._reduce(value, key)
         if out is None:
             self._store[key] = agg
         else:
@@ -126,7 +131,8 @@ class KVStoreLocal(KVStoreBase):
         self._updater = Updater(optimizer)
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(compression_params)
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, "Cannot save states for distributed training"

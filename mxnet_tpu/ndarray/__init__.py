@@ -7,6 +7,10 @@ the NDArray type and serialization entry points — kept so reference
 scripts using mx.nd.* keep working.
 """
 from .ndarray import NDArray, waitall  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import (  # noqa: F401
+    BaseSparseNDArray, RowSparseNDArray, CSRNDArray,
+)
 
 
 def __getattr__(name):

@@ -459,10 +459,10 @@ class NDArray:
     def tanh(self): return self._np().tanh(self)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise NotImplementedError(
-                "sparse storage types are not yet implemented on TPU")
-        return self
+        if stype == "default":
+            return self
+        from . import sparse as _sparse
+        return _sparse.cast_storage(self, stype)
 
     def slice_axis(self, axis, begin, end):
         idx = [slice(None)] * self.ndim

@@ -1,0 +1,406 @@
+"""Sparse NDArray storage types: row_sparse and CSR.
+
+Capability parity with the reference's sparse arrays
+(include/mxnet/ndarray.h:63-65 storage-type enum;
+python/mxnet/ndarray/sparse.py RowSparseNDArray/CSRNDArray) with a
+TPU-first execution strategy (SURVEY.md §7 "hard parts"): sparse
+layouts live as (values, indices[, indptr]) device arrays, and sparse
+kernels lower to gather / segment-sum / scatter-add — the XLA-friendly
+forms — rather than CUDA-style per-row kernels. Ops without a sparse
+implementation fall back to dense, mirroring the reference's
+storage-fallback dispatch (DispatchMode::kFComputeFallback,
+src/imperative/imperative_utils.h).
+
+Sparse autograd: like the reference, sparse arrays are leaf inputs of
+dense compute (a CSR/RSP input is densified by the fallback before a
+differentiable op); row_sparse *gradients* arise from
+Embedding(sparse_grad=True) and are handled by the optimizer's lazy
+update path.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .. import engine
+from ..base import resolve_dtype
+from ..context import current_context
+from .ndarray import NDArray
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux", "_shape")
+
+    # dense-materializing NumPy-API methods go through tostype
+    def asnumpy(self):
+        return self.todense().asnumpy()
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def astype(self, dtype, copy=True):
+        return self._replace_data(jnp.asarray(self._data,
+                                              resolve_dtype(dtype)))
+
+    def copy(self):
+        return self._replace_data(self._data)
+
+    def wait_to_read(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self.shape} "
+                f"dtype={self.dtype.name}>")
+
+    # arithmetic: scalar ops keep sparsity; array ops fall back dense
+    def __mul__(self, other):
+        if onp.isscalar(other):
+            return self._replace_data(self._data * other)
+        return self.todense() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if onp.isscalar(other):
+            return self._replace_data(self._data / other)
+        return self.todense() / other
+
+    def __neg__(self):
+        return self._replace_data(-self._data)
+
+    def __add__(self, other):
+        if isinstance(other, type(self)):
+            return add(self, other)
+        return self.todense() + other
+
+    def __radd__(self, other):
+        return self.todense() + other
+
+    def __sub__(self, other):
+        if isinstance(other, type(self)):
+            return add(self, other._replace_data(-other._data))
+        return self.todense() - other
+
+    def sum(self, *a, **k):
+        return self.todense().sum(*a, **k)
+
+    def mean(self, *a, **k):
+        return self.todense().mean(*a, **k)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows at `indices` hold `data`; all other rows are zero
+    (parity: python/mxnet/ndarray/sparse.py RowSparseNDArray)."""
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[0], ctx=self._ctx)
+
+    def _replace_data(self, new_data):
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, new_data, ctx=self._ctx)
+        out._aux = self._aux
+        out._shape = self._shape
+        return out
+
+    def todense(self) -> NDArray:
+        idx = self._aux[0]
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        dense = dense.at[idx].set(self._data)
+        return NDArray(engine.track(dense), ctx=self._ctx)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row matrix (parity: CSRNDArray)."""
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._aux[0], ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._aux[1], ctx=self._ctx)
+
+    def _replace_data(self, new_data):
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, new_data, ctx=self._ctx)
+        out._aux = self._aux
+        out._shape = self._shape
+        return out
+
+    def _row_ids(self):
+        """Per-nnz row id, computed as a gather-free searchsorted —
+        static nnz keeps this jittable."""
+        nnz = self._data.shape[0]
+        return jnp.searchsorted(self._aux[1],
+                                jnp.arange(nnz, dtype=jnp.int32),
+                                side="right") - 1
+
+    def todense(self) -> NDArray:
+        rows = self._row_ids()
+        cols = self._aux[0]
+        dense = jnp.zeros(self._shape, self._data.dtype)
+        dense = dense.at[rows, cols].add(self._data)
+        return NDArray(engine.track(dense), ctx=self._ctx)
+
+    def __getitem__(self, key):
+        if isinstance(key, int):
+            key = slice(key, key + 1)
+        if isinstance(key, slice):
+            dense = self.todense()[key]
+            return cast_storage(dense, "csr")
+        raise TypeError("CSRNDArray supports row slicing only")
+
+
+# ---------------------------------------------------------------------------
+# constructors
+# ---------------------------------------------------------------------------
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    """Build a RowSparseNDArray from (data, indices) or a dense source."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 2:
+        data, indices = arg1
+        data = jnp.asarray(getattr(data, "_data", data),
+                           resolve_dtype(dtype) if dtype else None)
+        indices = jnp.asarray(getattr(indices, "_data", indices),
+                              jnp.int64)
+        order = jnp.argsort(indices)
+        data, indices = data[order], indices[order]
+        if shape is None:
+            raise ValueError("shape required for (data, indices) input")
+        out = RowSparseNDArray.__new__(RowSparseNDArray)
+        NDArray.__init__(out, engine.track(data), ctx=ctx)
+        out._aux = [engine.track(indices)]
+        out._shape = tuple(shape)
+        return out
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(
+        jnp.asarray(arg1, resolve_dtype(dtype) if dtype else None), ctx=ctx)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    """Build a CSRNDArray from (data, indices, indptr) or dense."""
+    ctx = ctx or current_context()
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, (tuple, list)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = jnp.asarray(getattr(data, "_data", data),
+                           resolve_dtype(dtype) if dtype else None)
+        indices = jnp.asarray(getattr(indices, "_data", indices), jnp.int64)
+        indptr = jnp.asarray(getattr(indptr, "_data", indptr), jnp.int64)
+        if shape is None:
+            raise ValueError("shape required for (data, indices, indptr)")
+        out = CSRNDArray.__new__(CSRNDArray)
+        NDArray.__init__(out, engine.track(data), ctx=ctx)
+        out._aux = [engine.track(indices), engine.track(indptr)]
+        out._shape = tuple(shape)
+        return out
+    dense = arg1 if isinstance(arg1, NDArray) else NDArray(
+        jnp.asarray(arg1, resolve_dtype(dtype) if dtype else None), ctx=ctx)
+    return cast_storage(dense, "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dtype = resolve_dtype(dtype) if dtype else onp.float32
+    shape = (shape,) if isinstance(shape, int) else tuple(shape)
+    if stype == "row_sparse":
+        return row_sparse_array(
+            (jnp.zeros((0,) + shape[1:], dtype),
+             jnp.zeros((0,), jnp.int64)), shape=shape, ctx=ctx)
+    if stype == "csr":
+        return csr_matrix(
+            (jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int64),
+             jnp.zeros((shape[0] + 1,), jnp.int64)), shape=shape, ctx=ctx)
+    if stype == "default":
+        from .. import numpy as np_mod
+        return np_mod.zeros(shape, dtype=dtype, ctx=ctx)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+def empty(stype, shape, ctx=None, dtype=None):
+    return zeros(stype, shape, ctx=ctx, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# storage casts (parity: cast_storage op, src/operator/tensor/cast_storage*)
+# ---------------------------------------------------------------------------
+def cast_storage(arr, stype):
+    if isinstance(arr, BaseSparseNDArray):
+        if stype == arr.stype:
+            return arr
+        arr = arr.todense()
+    if stype == "default":
+        return arr
+    host = onp.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        nz_rows = onp.nonzero(host.reshape(host.shape[0], -1).any(axis=1))[0]
+        return row_sparse_array((host[nz_rows], nz_rows.astype(onp.int64)),
+                                shape=host.shape, ctx=arr.ctx,
+                                dtype=host.dtype)
+    if stype == "csr":
+        if host.ndim != 2:
+            raise ValueError("csr requires a 2-D array")
+        rows, cols = onp.nonzero(host)
+        data = host[rows, cols]
+        indptr = onp.zeros(host.shape[0] + 1, onp.int64)
+        onp.add.at(indptr, rows + 1, 1)
+        indptr = onp.cumsum(indptr)
+        return csr_matrix((data, cols.astype(onp.int64), indptr),
+                          shape=host.shape, ctx=arr.ctx, dtype=host.dtype)
+    raise ValueError(f"unknown stype {stype!r}")
+
+
+# ---------------------------------------------------------------------------
+# sparse ops
+# ---------------------------------------------------------------------------
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware matmul.
+
+    csr × dense lowers to gather + segment-sum (the reference's
+    dot(csr, dense) kernel, src/operator/tensor/dot-inl.h);
+    csr.T × dense lowers to scatter-add. row_sparse × dense gathers
+    the stored rows then scatter-adds into the output.
+    """
+    from ..ops import apply_op
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
+            not isinstance(rhs, BaseSparseNDArray):
+        cols = lhs._aux[0]
+        rows = lhs._row_ids()
+        n_rows, n_cols = lhs.shape
+
+        def csr_dot(data, r):
+            if transpose_b:
+                r = r.T
+            if not transpose_a:
+                # out[i,:] = sum_k data[k] * r[cols[k],:] for rows[k]==i
+                contrib = data[:, None] * r[cols]
+                return jax.ops.segment_sum(contrib, rows,
+                                           num_segments=n_rows)
+            contrib = data[:, None] * r[rows]
+            out = jnp.zeros((n_cols, r.shape[1]), data.dtype)
+            return out.at[cols].add(contrib)
+
+        return apply_op(csr_dot, lhs.data, rhs, name="sparse_dot_csr")
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray) and \
+            not isinstance(rhs, BaseSparseNDArray):
+        idx = lhs._aux[0]
+        n_rows = lhs.shape[0]
+
+        def rsp_dot(data, r):
+            if transpose_b:
+                r = r.T
+            if not transpose_a:
+                out = jnp.zeros((n_rows, r.shape[1]), data.dtype)
+                return out.at[idx].set(data @ r)
+            return data.T @ r[idx]
+
+        return apply_op(rsp_dot, lhs.data, rhs, name="sparse_dot_rsp")
+    # dense fallback
+    from .. import numpy as np_mod
+    ldense = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    rdense = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    if transpose_a:
+        ldense = ldense.T
+    if transpose_b:
+        rdense = rdense.T
+    return np_mod.dot(ldense, rdense)
+
+
+def add(lhs, rhs):
+    """Sparse + sparse of matching stype stays sparse."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, RowSparseNDArray):
+        assert lhs.shape == rhs.shape
+        idx = jnp.concatenate([lhs._aux[0], rhs._aux[0]])
+        dat = jnp.concatenate([lhs._data, rhs._data])
+        # unique pads with fill_value=shape[0], which sorts after every
+        # real row id, so the first n entries are the real rows
+        uniq, inv = jnp.unique(idx, return_inverse=True,
+                               size=idx.shape[0], fill_value=lhs.shape[0])
+        summed = jax.ops.segment_sum(dat, inv, num_segments=idx.shape[0])
+        n = int((uniq < lhs.shape[0]).sum())
+        return row_sparse_array((summed[:n], uniq[:n]),
+                                shape=lhs.shape, ctx=lhs.ctx)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return cast_storage(lhs.todense() + rhs.todense(), "csr")
+    return (lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs) + \
+        (rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs)
+
+
+elemwise_add = add
+
+
+def retain(rsp, row_ids):
+    """Keep only `row_ids` rows of a RowSparseNDArray (parity:
+    sparse_retain, used by the kvstore row_sparse_pull path)."""
+    if not isinstance(rsp, RowSparseNDArray):
+        raise TypeError("retain expects a RowSparseNDArray")
+    want = jnp.asarray(getattr(row_ids, "_data", row_ids), jnp.int64)
+    have = rsp._aux[0]
+    # membership via sorted search (have is sorted by construction)
+    pos = jnp.searchsorted(have, want)
+    pos = jnp.clip(pos, 0, have.shape[0] - 1) if have.shape[0] else pos
+    hit = (have.shape[0] > 0) & (have[pos] == want) \
+        if have.shape[0] else jnp.zeros(want.shape, bool)
+    data = rsp._data[pos] * hit[:, None].astype(rsp._data.dtype) \
+        if rsp._data.ndim > 1 else rsp._data[pos] * hit
+    return row_sparse_array((data, want), shape=rsp.shape, ctx=rsp.ctx)
+
+
+def norm(arr, ord=2):
+    return NDArray(engine.track(jnp.linalg.norm(arr._data.ravel(),
+                                                ord=ord)), ctx=arr.ctx)
+
+
+def array(source, ctx=None, dtype=None):
+    """Sparse-aware array constructor (parity: mx.nd.sparse.array)."""
+    if isinstance(source, BaseSparseNDArray):
+        return source
+    try:
+        import scipy.sparse as sps
+        if sps.issparse(source):
+            csr = source.tocsr()
+            return csr_matrix((csr.data, csr.indices.astype(onp.int64),
+                               csr.indptr.astype(onp.int64)),
+                              shape=csr.shape, ctx=ctx, dtype=dtype)
+    except ImportError:
+        pass
+    raise ValueError("use mx.np.array for dense sources")

@@ -365,7 +365,7 @@ rint = _mkunary(jnp.rint, "rint")
 ceil = _mkunary(jnp.ceil, "ceil")
 floor = _mkunary(jnp.floor, "floor")
 trunc = _mkunary(jnp.trunc, "trunc")
-fix = _mkunary(jnp.fix, "fix")
+fix = _mkunary(jnp.trunc, "fix")  # fix == trunc; jnp.fix is deprecated
 square = _mkunary(jnp.square, "square")
 sqrt = _mkunary(jnp.sqrt, "sqrt")
 cbrt = _mkunary(jnp.cbrt, "cbrt")

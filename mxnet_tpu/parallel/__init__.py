@@ -154,7 +154,23 @@ def process_count() -> int:
 def initialize_distributed(coordinator_address=None, num_processes=None,
                            process_id=None):
     """Multi-host init (parity: the reference's DMLC_* env bootstrap →
-    jax.distributed; DCN collectives then ride the same mesh)."""
+    jax.distributed; DCN collectives then ride the same mesh).
+
+    Falls back to the MXNET_TPU_COORDINATOR/NUM_PROCS/PROC_ID env vars
+    set by tools/launch.py local mode (the fake-pod test launcher)."""
+    import os
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("MXNET_TPU_COORDINATOR")
+        if coordinator_address is not None:
+            nproc = os.environ.get("MXNET_TPU_NUM_PROCS")
+            pid = os.environ.get("MXNET_TPU_PROC_ID")
+            if nproc is None or pid is None:
+                raise RuntimeError(
+                    "MXNET_TPU_COORDINATOR is set but MXNET_TPU_NUM_PROCS"
+                    "/MXNET_TPU_PROC_ID are not; all three are required "
+                    "(tools/launch.py sets them together)")
+            num_processes = int(nproc)
+            process_id = int(pid)
     kwargs = {}
     if coordinator_address is not None:
         kwargs = dict(coordinator_address=coordinator_address,

@@ -1,27 +1,72 @@
 """NDArray serialization: mx.nd.save / mx.nd.load parity.
 
 The reference uses a custom binary format (magic+version header,
-NDArray::Save/Load, src/ndarray/ndarray.cc:1729,1852) plus .npy/.npz via
-src/serialization/cnpy.cc. Here the container format IS .npz (zip of
-.npy members) — portable, inspectable, and loadable by plain NumPy.
-A dict saves keys verbatim; a list saves under reserved keys
-``__list_N`` preserving order.
+NDArray::Save/Load, src/ndarray/ndarray.cc:1729,1852 — with sparse
+support) plus .npy/.npz via src/serialization/cnpy.cc. Here the
+container format IS .npz (zip of .npy members) — portable,
+inspectable, and loadable by plain NumPy. A dict saves keys verbatim;
+a list saves under reserved keys ``__list_N`` preserving order.
+Sparse arrays expand to ``<key>:<field>`` members with a ``__sparse__``
+marker field carrying the stype.
 """
 from __future__ import annotations
 
 import numpy as onp
 
+_SP = "\x01sparse\x01"  # member-name separator unlikely in user keys
+
+
+def _encode(key, value, payload):
+    from .ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
+    if isinstance(value, BaseSparseNDArray):
+        payload[f"{key}{_SP}stype"] = onp.array(value.stype)
+        payload[f"{key}{_SP}shape"] = onp.array(value.shape, onp.int64)
+        payload[f"{key}{_SP}data"] = onp.asarray(value.data.asnumpy())
+        payload[f"{key}{_SP}indices"] = onp.asarray(value.indices.asnumpy())
+        if not isinstance(value, RowSparseNDArray):
+            payload[f"{key}{_SP}indptr"] = onp.asarray(
+                value.indptr.asnumpy())
+    else:
+        payload[key] = value.asnumpy()
+
+
+def _decode_groups(npz):
+    from .numpy import array
+    from .ndarray import sparse as sp
+
+    done = {}
+    grouped = {}
+    for k in npz.files:
+        if _SP in k:
+            base, field = k.split(_SP, 1)
+            grouped.setdefault(base, {})[field] = npz[k]
+        else:
+            done[k] = array(npz[k])
+    for base, fields in grouped.items():
+        stype = str(fields["stype"])
+        shape = tuple(int(s) for s in fields["shape"])
+        if stype == "row_sparse":
+            done[base] = sp.row_sparse_array(
+                (fields["data"], fields["indices"]), shape=shape)
+        else:
+            done[base] = sp.csr_matrix(
+                (fields["data"], fields["indices"], fields["indptr"]),
+                shape=shape)
+    return done
+
 
 def save(fname, data):
-    from .numpy import array  # noqa: F401
     from .ndarray.ndarray import NDArray
 
     if isinstance(data, NDArray):
         data = [data]
+    payload = {}
     if isinstance(data, (list, tuple)):
-        payload = {f"__list_{i}": d.asnumpy() for i, d in enumerate(data)}
+        for i, d in enumerate(data):
+            _encode(f"__list_{i}", d, payload)
     elif isinstance(data, dict):
-        payload = {k: v.asnumpy() for k, v in data.items()}
+        for k, v in data.items():
+            _encode(k, v, payload)
     else:
         raise TypeError(f"cannot save {type(data)}")
     with open(fname, "wb") as f:
@@ -29,11 +74,10 @@ def save(fname, data):
 
 
 def load(fname):
-    from .numpy import array
-
     with onp.load(fname, allow_pickle=False) as npz:
-        keys = list(npz.files)
+        done = _decode_groups(npz)
+        keys = list(done.keys())
         if keys and all(k.startswith("__list_") for k in keys):
             keys.sort(key=lambda k: int(k[len("__list_"):]))
-            return [array(npz[k]) for k in keys]
-        return {k: array(npz[k]) for k in keys}
+            return [done[k] for k in keys]
+        return done

@@ -1,0 +1,49 @@
+"""Multi-process dist_sync smoke test (parity:
+tests/nightly/dist_sync_kvstore.py, launched by tools/launch.py local
+mode). Each worker contributes rank+1; every worker must see the
+deterministic global sum (the reference's check_diff assertion)."""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+
+
+def main():
+    parallel.initialize_distributed()
+    rank = jax.process_index()
+    n = jax.process_count()
+    assert n == int(os.environ["MXNET_TPU_NUM_PROCS"]), \
+        (n, os.environ["MXNET_TPU_NUM_PROCS"])
+
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.rank == rank and kv.num_workers == n
+
+    shape = (8, 3)
+    g = mx.np.ones(shape) * (rank + 1)
+    out = mx.np.zeros(shape)
+    kv.pushpull(0, g, out=out)
+    expect = onp.full(shape, n * (n + 1) / 2.0, "float32")
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-6)
+
+    # second round with different values (store reuse)
+    g2 = mx.np.ones(shape) * (rank + 10)
+    kv.pushpull(1, g2, out=out)
+    expect2 = onp.full(shape, 10 * n + n * (n - 1) / 2.0, "float32")
+    onp.testing.assert_allclose(out.asnumpy(), expect2, rtol=1e-6)
+    print(f"worker {rank}/{n}: dist_sync OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

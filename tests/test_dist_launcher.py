@@ -1,0 +1,25 @@
+"""End-to-end multi-process dist_sync test through tools/launch.py
+(parity: `launch.py -n N --launcher local dist_sync_kvstore.py`,
+ci/docker/runtime_functions.sh:914-923)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.timeout(300)
+def test_launch_local_dist_sync():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one device per worker process
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(ROOT, "tests", "dist", "dist_sync_kvstore.py")],
+        env=env, capture_output=True, text=True, timeout=280)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert proc.stdout.count("dist_sync OK") == 2, \
+        proc.stdout + proc.stderr

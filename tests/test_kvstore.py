@@ -1,0 +1,197 @@
+"""KVStore tests (parity model: tests/python/unittest/test_kvstore.py,
+tests/nightly/dist_sync_kvstore.py run via the local launcher)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.kvstore import (KVStoreBase, ParameterServer,
+                               GradientCompression)
+
+
+def test_create_modes():
+    for mode in ("local", "device", "nccl", "dist_sync",
+                 "dist_device_sync"):
+        kv = mx.kvstore.create(mode)
+        assert kv is not None
+    with pytest.raises(ValueError):
+        mx.kvstore.create("bogus")
+
+
+def test_push_pull_aggregation():
+    kv = mx.kvstore.create("device")
+    shape = (4, 3)
+    kv.init(3, mx.np.ones(shape))
+    vals = [mx.np.ones(shape) * i for i in range(1, 5)]
+    kv.push(3, vals)
+    out = mx.np.zeros(shape)
+    kv.pull(3, out=out)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.full(shape, 10.0), rtol=1e-6)
+
+
+def test_pushpull_inplace():
+    kv = mx.kvstore.create("device")
+    g = mx.np.ones((5,)) * 3
+    kv.pushpull(0, g, out=g)
+    onp.testing.assert_allclose(g.asnumpy(), onp.full((5,), 3.0))
+
+
+def test_broadcast():
+    kv = mx.kvstore.create("local")
+    outs = [mx.np.zeros((2, 2)) for _ in range(3)]
+    kv.broadcast(7, mx.np.ones((2, 2)) * 5, out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), onp.full((2, 2), 5.0))
+
+
+def test_update_on_kvstore_optimizer():
+    kv = mx.kvstore.create("local")
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    kv.set_optimizer(opt)
+    assert kv.is_capable(KVStoreBase.OPTIMIZER)
+    w = mx.np.ones((3,))
+    kv.init(0, w)
+    kv.push(0, mx.np.ones((3,)))   # grad=1 → w -= 0.1
+    out = mx.np.zeros((3,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((3,), 0.9),
+                                rtol=1e-6)
+
+
+def test_gradient_compression_2bit():
+    gc = GradientCompression({"type": "2bit", "threshold": 0.5})
+    g = mx.np.array([0.26, -0.26, 0.0, 1.5])._data
+    q1 = gc.compress(0, 0, g)
+    # quantized values are in {-0.5, 0, 0.5}
+    assert set(onp.unique(onp.asarray(q1))) <= {-0.5, 0.0, 0.5}
+    # error feedback: the 0.26 residual accumulates and pushes the
+    # second-round quantization over the threshold
+    q2 = gc.compress(0, 0, g)
+    onp.testing.assert_allclose(onp.asarray(q2)[0], 0.5)
+    # no information is lost: residual + delivered == true total
+    total_q = onp.asarray(q1) + onp.asarray(q2)
+    res = onp.asarray(gc._residuals[(0, 0)])
+    onp.testing.assert_allclose(total_q + res, 2 * onp.asarray(g),
+                                rtol=1e-5)
+
+
+def test_gradient_compression_1bit():
+    gc = GradientCompression({"type": "1bit"})
+    g = mx.np.array([1.0, -1.0, 3.0, -3.0])._data
+    q = gc.compress(0, 0, g)
+    q = onp.asarray(q)
+    assert (q > 0).tolist() == [True, False, True, False]
+    assert len(onp.unique(onp.abs(q))) == 1  # single scale
+
+
+def test_kvstore_compression_in_reduce():
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    g = mx.np.array([2.0, 0.1, -2.0])
+    out = mx.np.zeros((3,))
+    kv.pushpull(0, g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, 0.0, -1.0])
+
+
+def test_dist_sync_single_process():
+    kv = mx.kvstore.create("dist_sync")
+    assert kv.rank == 0 and kv.num_workers == 1
+    g = mx.np.ones((4,)) * 2
+    out = mx.np.zeros((4,))
+    kv.pushpull(0, g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((4,), 2.0))
+
+
+def test_dist_async_parameter_server():
+    server = ParameterServer()
+    server.serve_background()
+    host, port = server.address
+    kv = mx.kvstore.KVStoreDistAsync(server_addr=f"{host}:{port}")
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    kv.set_optimizer(opt)
+    kv.init(0, mx.np.ones((3,)))
+    kv.push(0, mx.np.ones((3,)))   # server applies: w -= 0.5
+    out = mx.np.zeros((3,))
+    kv.pull(0, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((3,), 0.5),
+                                rtol=1e-6)
+    kv.close()
+    server.shutdown()
+
+
+def test_trainer_update_on_kvstore():
+    net = nn.Dense(1, use_bias=False)
+    net.initialize()
+    x = mx.np.ones((2, 4))
+    net(x)  # init shapes
+    w0 = net.weight.data().asnumpy().copy()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0}, kvstore="local",
+                       update_on_kvstore=True)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(1)
+    assert tr._update_on_kvstore
+    w1 = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(w1, w0 - x.asnumpy().sum(axis=0),
+                                rtol=1e-5)
+    # second step keeps flowing through the kvstore-held weights
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    tr.step(1)
+    w2 = net.weight.data().asnumpy()
+    onp.testing.assert_allclose(w2, w1 - x.asnumpy().sum(axis=0),
+                                rtol=1e-5)
+
+
+def test_trainer_dist_async_end_to_end():
+    server = ParameterServer()
+    server.serve_background()
+    host, port = server.address
+    kv = mx.kvstore.KVStoreDistAsync(server_addr=f"{host}:{port}")
+    net = nn.Dense(1, use_bias=False)
+    net.initialize()
+    x = mx.np.random.uniform(size=(8, 3))
+    y = (x.asnumpy() @ onp.array([[1.0], [2.0], [3.0]])).astype("float32")
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore=kv,
+                       update_on_kvstore=True)
+    tr._init_kvstore()
+    kv.set_optimizer(tr._optimizer)
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(100):
+        with autograd.record():
+            l = loss_fn(net(x), mx.np.array(y)).mean()
+        l.backward()
+        tr.step(1)
+        losses.append(float(l.item()))
+    assert losses[-1] < losses[0] * 0.1
+    kv.close()
+    server.shutdown()
+
+
+def test_custom_kvstore_registry():
+    @KVStoreBase.register
+    class MyStore(KVStoreBase):
+        def __init__(self, mode="mystore"):
+            self.data = {}
+
+        def pushpull(self, key, value, out=None, priority=0):
+            if out is not None:
+                out._install(value._data)
+
+        def broadcast(self, key, value, out, priority=0):
+            for o in (out if isinstance(out, list) else [out]):
+                o._install(value._data)
+
+    kv = mx.kvstore.create("mystore")
+    g = mx.np.ones((2,))
+    out = mx.np.zeros((2,))
+    kv.pushpull(0, g, out=out)
+    onp.testing.assert_allclose(out.asnumpy(), [1.0, 1.0])

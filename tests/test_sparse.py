@@ -1,0 +1,141 @@
+"""Sparse NDArray tests (parity model:
+tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+
+def _rand_dense(shape, density=0.3):
+    onp.random.seed(0)
+    d = onp.random.uniform(-1, 1, size=shape).astype("float32")
+    mask = onp.random.uniform(size=shape) < density
+    return d * mask
+
+
+def test_csr_roundtrip():
+    d = _rand_dense((6, 5))
+    csr = mx.nd.array(d).tostype("csr")
+    assert csr.stype == "csr"
+    assert csr.shape == (6, 5)
+    onp.testing.assert_allclose(csr.asnumpy(), d, rtol=1e-6)
+    back = csr.tostype("default")
+    onp.testing.assert_allclose(back.asnumpy(), d, rtol=1e-6)
+
+
+def test_row_sparse_roundtrip():
+    d = _rand_dense((8, 4))
+    d[2] = 0; d[5] = 0
+    rsp = mx.nd.array(d).tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    onp.testing.assert_allclose(rsp.asnumpy(), d, rtol=1e-6)
+    # stored rows are exactly the nonzero rows
+    nz = onp.nonzero(d.any(axis=1))[0]
+    onp.testing.assert_array_equal(rsp.indices.asnumpy(), nz)
+
+
+def test_csr_construct_from_triplet():
+    data = [1.0, 2.0, 3.0]
+    indices = [1, 0, 2]
+    indptr = [0, 1, 3, 3]
+    csr = sparse.csr_matrix((data, indices, indptr), shape=(3, 4))
+    expect = onp.zeros((3, 4), "float32")
+    expect[0, 1] = 1.0
+    expect[1, 0] = 2.0
+    expect[1, 2] = 3.0
+    onp.testing.assert_allclose(csr.asnumpy(), expect)
+
+
+def test_csr_dot():
+    d = _rand_dense((7, 5))
+    rhs = onp.random.uniform(size=(5, 3)).astype("float32")
+    csr = mx.nd.array(d).tostype("csr")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5)
+
+
+def test_csr_dot_transpose():
+    d = _rand_dense((7, 5))
+    rhs = onp.random.uniform(size=(7, 3)).astype("float32")
+    csr = mx.nd.array(d).tostype("csr")
+    out = sparse.dot(csr, mx.nd.array(rhs), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), d.T @ rhs, rtol=1e-5)
+
+
+def test_rsp_dot():
+    d = _rand_dense((6, 4))
+    d[1] = 0
+    rhs = onp.random.uniform(size=(4, 2)).astype("float32")
+    rsp = mx.nd.array(d).tostype("row_sparse")
+    out = sparse.dot(rsp, mx.nd.array(rhs))
+    onp.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5)
+
+
+def test_rsp_add():
+    a = _rand_dense((6, 3)); a[0] = 0; a[3] = 0
+    b = _rand_dense((6, 3)); b[1] = 0; b[3] = 0
+    ra = mx.nd.array(a).tostype("row_sparse")
+    rb = mx.nd.array(b).tostype("row_sparse")
+    s = ra + rb
+    assert s.stype == "row_sparse"
+    onp.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-5)
+
+
+def test_scalar_ops_keep_sparsity():
+    d = _rand_dense((5, 5))
+    csr = mx.nd.array(d).tostype("csr")
+    out = csr * 2.0
+    assert out.stype == "csr"
+    onp.testing.assert_allclose(out.asnumpy(), d * 2.0, rtol=1e-6)
+    out = -csr
+    assert out.stype == "csr"
+
+
+def test_retain():
+    d = _rand_dense((8, 3))
+    d[d.any(axis=1) == False] += 1  # noqa: E712  make all rows nonzero
+    rsp = mx.nd.array(d).tostype("row_sparse")
+    kept = sparse.retain(rsp, mx.nd.array([1, 4], dtype="int64"))
+    expect = onp.zeros_like(d)
+    expect[[1, 4]] = d[[1, 4]]
+    onp.testing.assert_allclose(kept.asnumpy(), expect, rtol=1e-6)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("csr", (4, 6))
+    assert z.stype == "csr" and z.shape == (4, 6)
+    assert onp.abs(z.asnumpy()).sum() == 0
+    z = sparse.zeros("row_sparse", (4, 6))
+    assert z.stype == "row_sparse"
+    assert onp.abs(z.asnumpy()).sum() == 0
+
+
+def test_save_load_sparse(tmp_path):
+    d = _rand_dense((6, 5))
+    csr = mx.nd.array(d).tostype("csr")
+    rsp = mx.nd.array(d).tostype("row_sparse")
+    dense = mx.nd.array(d)
+    f = str(tmp_path / "arrs.npz")
+    mx.save(f, {"c": csr, "r": rsp, "d": dense})
+    loaded = mx.load(f)
+    assert loaded["c"].stype == "csr"
+    assert loaded["r"].stype == "row_sparse"
+    onp.testing.assert_allclose(loaded["c"].asnumpy(), d, rtol=1e-6)
+    onp.testing.assert_allclose(loaded["r"].asnumpy(), d, rtol=1e-6)
+    onp.testing.assert_allclose(loaded["d"].asnumpy(), d, rtol=1e-6)
+
+
+def test_csr_row_slice():
+    d = _rand_dense((6, 5))
+    csr = mx.nd.array(d).tostype("csr")
+    sl = csr[2:5]
+    assert sl.stype == "csr"
+    onp.testing.assert_allclose(sl.asnumpy(), d[2:5], rtol=1e-6)
+
+
+def test_cast_storage_errors():
+    with pytest.raises(ValueError):
+        mx.nd.array(onp.zeros((2, 2, 2), "float32")).tostype("csr")
+    with pytest.raises(ValueError):
+        sparse.zeros("bogus", (2, 2))

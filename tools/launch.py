@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Fake-pod / cluster launcher (parity: reference tools/launch.py →
+dmlc_tracker local mode, ci/docker/runtime_functions.sh:914-923).
+
+Local mode spawns N worker processes on one machine:
+
+- `--kv-mode sync` (default): wires jax.distributed env
+  (MXNET_TPU_COORDINATOR/NUM_PROCS/PROC_ID); each worker calls
+  mxnet_tpu.parallel.initialize_distributed() and the 'dist_sync'
+  kvstore allreduces over the resulting multi-process mesh.
+- `--kv-mode async`: starts an in-process ParameterServer and exports
+  MXNET_TPU_PS_ADDR; workers use kvstore 'dist_async'.
+
+Example (the reference's smoke-test incantation):
+    python tools/launch.py -n 4 --launcher local python my_train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=1)
+    ap.add_argument("--launcher", default="local",
+                    choices=["local"])
+    ap.add_argument("--kv-mode", default="sync",
+                    choices=["sync", "async"])
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE for workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+
+    base_env = dict(os.environ)
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        base_env[k] = v
+
+    server = None
+    procs = []
+    try:
+        if args.kv_mode == "async":
+            sys.path.insert(0, os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+            from mxnet_tpu.kvstore import ParameterServer
+            server = ParameterServer()
+            server.serve_background()
+            host, port = server.address
+            base_env["MXNET_TPU_PS_ADDR"] = f"{host}:{port}"
+        else:
+            port = _free_port()
+            base_env["MXNET_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
+            base_env["MXNET_TPU_NUM_PROCS"] = str(args.num_workers)
+
+        for rank in range(args.num_workers):
+            env = dict(base_env)
+            env["MXNET_TPU_PROC_ID"] = str(rank)
+            env["DMLC_ROLE"] = "worker"  # reference-compat spelling
+            procs.append(subprocess.Popen(args.command, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        if server is not None:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

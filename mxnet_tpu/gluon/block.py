@@ -385,6 +385,28 @@ class CachedOp:
         key = next_key()
         param_datas = [nd._data for nd in entry.param_nds]
         input_datas = [l._data for l in leaves]
+
+        # mesh-aware hybridize: if a global mesh is active (e.g. an sp
+        # layer shard_maps inside the graph), operands must live on the
+        # mesh — replicate any that don't (no-op once installed)
+        from .. import parallel as _parallel
+        mesh = _parallel.get_mesh()
+        if mesh is not None and mesh.devices.size > 1:
+            import jax.numpy as _jnp  # noqa: F401
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            rep = NamedSharding(mesh, _P())
+
+            def place(d):
+                sh = getattr(d, "sharding", None)
+                if sh is not None and getattr(sh, "mesh", None) == mesh:
+                    return d
+                return jax.device_put(d, rep)
+
+            key = place(key)
+            param_datas = [place(d) for d in param_datas]
+            input_datas = [place(d) for d in input_datas]
+            for nd, d in zip(entry.param_nds, param_datas):
+                nd._data = d
         recording = autograd.is_recording() and (
             any(nd._grad_req != "null" for nd in entry.param_nds)
             or any(autograd._on_tape(l) for l in leaves))

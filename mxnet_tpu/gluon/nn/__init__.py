@@ -14,4 +14,7 @@ from .conv_layers import (  # noqa: F401
 from .activations import (  # noqa: F401
     LeakyReLU, PReLU, ELU, SELU, GELU, SiLU, Swish, Mish,
 )
+from .attention import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderCell,
+)
 from ..block import Block, HybridBlock  # noqa: F401

@@ -1,0 +1,89 @@
+"""Attention layers (long-context first-class).
+
+The reference keeps attention in downstream libraries (GluonNLP); here
+MultiHeadAttention and TransformerEncoderCell are in-tree because
+sequence parallelism shapes the core design (ops/attention.py: Pallas
+flash kernel + ring attention over the 'sp' mesh axis).
+"""
+from __future__ import annotations
+
+from ... import numpy_extension as npx
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout, LayerNorm
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self/cross attention over (batch, seq, embed) inputs.
+
+    sequence_parallel=True routes through ring attention when the
+    global mesh has an 'sp' axis (falls back to flash attention
+    otherwise), so the same model runs single-chip and sequence-
+    sharded without code changes.
+    """
+
+    def __init__(self, embed_dim, num_heads, dropout=0.0, use_bias=True,
+                 causal=False, sequence_parallel=False, dtype="float32"):
+        super().__init__()
+        assert embed_dim % num_heads == 0, \
+            "embed_dim must be divisible by num_heads"
+        self._embed_dim = embed_dim
+        self._num_heads = num_heads
+        self._head_dim = embed_dim // num_heads
+        self._causal = causal
+        self._sequence_parallel = sequence_parallel
+        self.q_proj = Dense(embed_dim, use_bias=use_bias, flatten=False,
+                            dtype=dtype)
+        self.k_proj = Dense(embed_dim, use_bias=use_bias, flatten=False,
+                            dtype=dtype)
+        self.v_proj = Dense(embed_dim, use_bias=use_bias, flatten=False,
+                            dtype=dtype)
+        self.out_proj = Dense(embed_dim, use_bias=use_bias, flatten=False,
+                              dtype=dtype)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self._num_heads,
+                         self._head_dim).transpose(0, 2, 1, 3)
+
+    def forward(self, query, key=None, value=None):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split(self.q_proj(query))
+        k = self._split(self.k_proj(key))
+        v = self._split(self.v_proj(value))
+        if self._sequence_parallel:
+            out = npx.ring_attention(q, k, v, causal=self._causal)
+        else:
+            out = npx.flash_attention(q, k, v, causal=self._causal)
+        b, h, s, d = out.shape
+        out = out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+        out = self.out_proj(out)
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return out
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Pre-norm transformer block: MHA + MLP (the bench/dryrun model)."""
+
+    def __init__(self, embed_dim, num_heads, hidden_dim=None, dropout=0.0,
+                 causal=False, sequence_parallel=False, dtype="float32"):
+        super().__init__()
+        hidden_dim = hidden_dim or 4 * embed_dim
+        self.ln1 = LayerNorm()
+        self.attn = MultiHeadAttention(
+            embed_dim, num_heads, dropout=dropout, causal=causal,
+            sequence_parallel=sequence_parallel, dtype=dtype)
+        self.ln2 = LayerNorm()
+        self.ffn1 = Dense(hidden_dim, activation="relu", flatten=False,
+                          dtype=dtype)
+        self.ffn2 = Dense(embed_dim, flatten=False, dtype=dtype)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = x + self.attn(self.ln1(x))
+        y = self.ffn2(self.ffn1(self.ln2(h)))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return h + y

@@ -51,6 +51,11 @@ class KVStoreBase:
     def set_optimizer(self, optimizer):
         raise NotImplementedError
 
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support gradient "
+            "compression")
+
     def is_capable(self, capability):
         return False
 

@@ -142,6 +142,7 @@ class KVStoreDistAsync(KVStoreBase):
             addr = (host, int(port))
         self._sock = socket.create_connection(addr)
         self._lock = threading.Lock()
+        self._compression = None
 
     def _rpc(self, **msg):
         with self._lock:
@@ -156,13 +157,23 @@ class KVStoreDistAsync(KVStoreBase):
         v = value[0] if isinstance(value, (list, tuple)) else value
         self._rpc(op="init", key=key, value=onp.asarray(v.asnumpy()))
 
+    def set_gradient_compression(self, compression_params):
+        """Worker-side error-feedback quantization before the wire
+        (parity: compression happens before ZPush in the reference)."""
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(compression_params)
+
     def push(self, key, value, priority=0):
         if isinstance(key, (list, tuple)):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
         vals = value if isinstance(value, (list, tuple)) else [value]
-        agg = onp.sum([onp.asarray(v.asnumpy()) for v in vals], axis=0)
+        datas = [v._data for v in vals]
+        if self._compression is not None:
+            datas = [self._compression.compress(key, j, d)
+                     for j, d in enumerate(datas)]
+        agg = onp.sum([onp.asarray(d) for d in datas], axis=0)
         self._rpc(op="push", key=key, value=agg)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):

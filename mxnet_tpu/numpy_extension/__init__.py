@@ -501,3 +501,34 @@ def rnn(data, parameters, *args, use_sequence_length=False, state_size=None,
     if state_outputs:
         return list(outs)
     return outs[0]
+
+
+# ---------------------------------------------------------------------------
+# attention (long-context first-class; see ops/attention.py)
+# ---------------------------------------------------------------------------
+def flash_attention(query, key, value, causal=False, scale=None):
+    """Blockwise (flash) attention over (B, H, S, D) NDArrays.
+
+    Pallas TPU kernel forward + rematerializing backward; jnp blockwise
+    reference elsewhere (ops/attention.py)."""
+    from ..ops import attention as _att
+
+    def fn(q, k, v):
+        return _att.flash_attention(q, k, v, causal, scale)
+
+    return apply_op(fn, _c(query), _c(key), _c(value),
+                    name="flash_attention")
+
+
+def ring_attention(query, key, value, causal=False, scale=None,
+                   axis_name="sp", mesh=None):
+    """Sequence-parallel ring attention over the 'sp' mesh axis."""
+    from ..ops import attention as _att
+
+    def fn(q, k, v):
+        return _att.ring_attention(q, k, v, mesh=mesh,
+                                   axis_name=axis_name, causal=causal,
+                                   scale=scale)
+
+    return apply_op(fn, _c(query), _c(key), _c(value),
+                    name="ring_attention")

@@ -1,0 +1,374 @@
+"""Attention kernels: Pallas flash attention + ring attention (sp).
+
+The reference has NO long-context support (SURVEY.md §5: "ring
+attention, context parallel — absent upstream"); these are first-class
+here because they shape the core design on TPU:
+
+- `flash_attention` — blockwise online-softmax attention. On TPU the
+  forward runs as a Pallas kernel (one q-block per grid step, KV
+  streamed through VMEM, fp32 accumulators — the MXU-friendly
+  formulation); backward recomputes attention blockwise (flash-style
+  rematerialization: O(S) memory, no S×S residuals).
+- `ring_attention` — sequence parallelism over the 'sp' mesh axis:
+  each device holds a sequence shard of Q/K/V; KV shards rotate
+  around the ring via `lax.ppermute` while every device accumulates
+  online-softmax partial results. Collective-permute overlaps with
+  the next block's compute under XLA's latency-hiding scheduler, so
+  the ring rides the ICI torus at full bandwidth.
+
+All shapes are (batch, heads, seq, head_dim).
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# blockwise reference (differentiable, fuses well under XLA)
+# ---------------------------------------------------------------------------
+def _attn_block(q, k, v, m_prev, l_prev, acc_prev, scale, mask=None):
+    """One online-softmax accumulation step.
+
+    q: (..., Sq, D); k/v: (..., Sk, D); m/l: (..., Sq); acc (..., Sq, D).
+    """
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = alpha * l_prev + p.sum(axis=-1)
+    acc_new = acc_prev * alpha[..., None] + jnp.einsum(
+        "...qk,...kd->...qd", p.astype(v.dtype), v).astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def mha_reference(q, k, v, causal=False, scale=None):
+    """Plain attention (for tests and tiny sequences)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = s.shape[-2], s.shape[-1]
+        row = lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col <= row + (sk - sq), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", p.astype(v.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU forward kernel
+# ---------------------------------------------------------------------------
+def _causal_valid(row, col, offset):
+    """End-aligned causal convention (matches mha_reference):
+    query row r may attend key col c iff c <= r + offset, offset =
+    seq_k - seq_q (so the LAST query sees the whole key)."""
+    return col <= row + offset
+
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale,
+                      causal, block_k, seq_k_padded, kv_len, offset):
+    """One (batch*head, q-block) grid step; stream KV through VMEM."""
+    import jax.experimental.pallas as pl
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, d)
+    bq, d = q.shape
+    nk = seq_k_padded // block_k
+    q_block = pl.program_id(1)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]   # (bk, d)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (bq, bk)
+        row = lax.broadcasted_iota(jnp.int32, (bq, block_k), 0) \
+            + q_block * bq
+        col = lax.broadcasted_iota(jnp.int32, (bq, block_k), 1) \
+            + j * block_k
+        valid = col < kv_len                           # padding mask
+        if causal:
+            valid = valid & _causal_valid(row, col, offset)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, acc0))
+    l_safe = jnp.where(l > 0, l, 1.0)                  # padded q rows
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _pad_seq(x, block):
+    s = x.shape[2]
+    pad = (-s) % block
+    if pad == 0:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, pad), (0, 0)))
+
+
+def flash_attention_pallas(q, k, v, causal=False, scale=None,
+                           block_q=128, block_k=128, interpret=False):
+    """Pallas forward (see pallas_guide.md patterns); any seq length
+    (inputs are block-padded, padding masked). Returns (out, lse)."""
+    import jax.experimental.pallas as pl
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = min(block_q, max(sq, 1))
+    block_k = min(block_k, max(sk, 1))
+    qp, kp, vp = _pad_seq(q, block_q), _pad_seq(k, block_k), \
+        _pad_seq(v, block_k)
+    sqp, skp = qp.shape[2], kp.shape[2]
+    qr = qp.reshape(b * h, sqp, d)
+    kr = kp.reshape(b * h, skp, d)
+    vr = vp.reshape(b * h, skp, d)
+
+    kernel = functools.partial(
+        _flash_fwd_kernel, scale=scale, causal=causal, block_k=block_k,
+        seq_k_padded=skp, kv_len=sk, offset=sk - sq)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, sqp // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, skp, d), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sqp, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sqp), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return (out.reshape(b, h, sqp, d)[:, :, :sq],
+            lse.reshape(b, h, sqp)[:, :, :sq])
+
+
+# ---------------------------------------------------------------------------
+# blockwise jnp forward (non-TPU path) — O(S·block) memory
+# ---------------------------------------------------------------------------
+def _blockwise_fwd(q, k, v, causal, scale, block=512):
+    sq, sk = q.shape[-2], k.shape[-2]
+    offset = sk - sq
+    kp, vp = _pad_seq(k, block), _pad_seq(v, block)
+    nb = kp.shape[-2] // block
+
+    def step(carry, j):
+        m, l, acc = carry
+        kj = lax.dynamic_slice_in_dim(kp, j * block, block, axis=-2)
+        vj = lax.dynamic_slice_in_dim(vp, j * block, block, axis=-2)
+        s = jnp.einsum("...qd,...kd->...qk", q, kj) \
+            .astype(jnp.float32) * scale
+        row = lax.broadcasted_iota(jnp.int32, (sq, block), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
+        valid = col < sk
+        if causal:
+            valid = valid & _causal_valid(row, col, offset)
+        s = jnp.where(valid, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + p.sum(axis=-1)
+        acc_new = acc * alpha[..., None] + jnp.einsum(
+            "...qk,...kd->...qd", p.astype(vj.dtype), vj
+        ).astype(jnp.float32)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full(q.shape[:-1], NEG_INF, jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], jnp.float32)
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    (m, l, acc), _ = lax.scan(step, (m0, l0, acc0), jnp.arange(nb))
+    l_safe = jnp.where(l > 0, l, 1.0)
+    return (acc / l_safe[..., None]).astype(q.dtype), m + jnp.log(l_safe)
+
+
+# ---------------------------------------------------------------------------
+# public flash_attention with blockwise (O(S·block)) backward
+# ---------------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, scale=None):
+    return _flash_fwd(q, k, v, causal, scale)[0]
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    if _use_pallas():
+        out, lse = flash_attention_pallas(q, k, v, causal=causal,
+                                          scale=scale_v)
+    else:
+        out, lse = _blockwise_fwd(q, k, v, causal, scale_v)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, res, do):
+    """Blockwise flash backward: rematerializes attention one KV (then
+    one Q) block at a time — no S×S residual ever materializes."""
+    q, k, v, o, lse = res
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    block = 512
+    sq, sk = q.shape[-2], k.shape[-2]
+    offset = sk - sq
+    do32 = do.astype(jnp.float32)
+    delta = (do32 * o.astype(jnp.float32)).sum(-1)          # (..., sq)
+
+    kp, vp = _pad_seq(k, block), _pad_seq(v, block)
+    nb_k = kp.shape[-2] // block
+
+    def dq_step(dq_acc, j):
+        kj = lax.dynamic_slice_in_dim(kp, j * block, block, axis=-2)
+        vj = lax.dynamic_slice_in_dim(vp, j * block, block, axis=-2)
+        s = jnp.einsum("...qd,...kd->...qk", q, kj) \
+            .astype(jnp.float32) * scale_v
+        row = lax.broadcasted_iota(jnp.int32, (sq, block), 0)
+        col = lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
+        valid = col < sk
+        if causal:
+            valid = valid & _causal_valid(row, col, offset)
+        p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
+        dp = jnp.einsum("...qd,...kd->...qk", do32,
+                        vj.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale_v
+        dq_acc = dq_acc + jnp.einsum("...qk,...kd->...qd", ds,
+                                     kj.astype(jnp.float32))
+        return dq_acc, None
+
+    dq, _ = lax.scan(dq_step, jnp.zeros(q.shape, jnp.float32),
+                     jnp.arange(nb_k))
+
+    qp = _pad_seq(q, block)
+    dop = _pad_seq(do32, block)
+    pad_q = qp.shape[-2] - sq
+    lsep = jnp.pad(lse, [(0, 0)] * (lse.ndim - 1) + [(0, pad_q)])
+    deltap = jnp.pad(delta, [(0, 0)] * (delta.ndim - 1) + [(0, pad_q)])
+    nb_q = qp.shape[-2] // block
+
+    def dkv_step(carry, i):
+        dk_acc, dv_acc = carry
+        qi = lax.dynamic_slice_in_dim(qp, i * block, block, axis=-2)
+        doi = lax.dynamic_slice_in_dim(dop, i * block, block, axis=-2)
+        lsei = lax.dynamic_slice_in_dim(lsep, i * block, block, axis=-1)
+        deltai = lax.dynamic_slice_in_dim(deltap, i * block, block,
+                                          axis=-1)
+        s = jnp.einsum("...qd,...kd->...qk", qi, k) \
+            .astype(jnp.float32) * scale_v
+        row = lax.broadcasted_iota(jnp.int32, (block, sk), 0) + i * block
+        col = lax.broadcasted_iota(jnp.int32, (block, sk), 1)
+        valid = row < sq
+        if causal:
+            valid = valid & _causal_valid(row, col, offset)
+        p = jnp.where(valid, jnp.exp(s - lsei[..., None]), 0.0)
+        dv_acc = dv_acc + jnp.einsum("...qk,...qd->...kd", p, doi)
+        dp = jnp.einsum("...qd,...kd->...qk", doi, v.astype(jnp.float32))
+        ds = p * (dp - deltai[..., None]) * scale_v
+        dk_acc = dk_acc + jnp.einsum("...qk,...qd->...kd", ds,
+                                     qi.astype(jnp.float32))
+        return (dk_acc, dv_acc), None
+
+    (dk, dv), _ = lax.scan(
+        dkv_step,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+        jnp.arange(nb_q))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (sequence parallel over 'sp')
+# ---------------------------------------------------------------------------
+def ring_attention_local(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Per-shard body to run under shard_map: q/k/v are the LOCAL
+    sequence shards (b, h, s_local, d)."""
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    s_local = q.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kv = carry
+        kc, vc = kv
+        src = (my - t) % n                      # whose KV shard this is
+        # global-position causal mask for this (q-shard, kv-shard) pair
+        if causal:
+            row = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 0) \
+                + my * s_local
+            col = lax.broadcasted_iota(jnp.int32, (s_local, s_local), 1) \
+                + src * s_local
+            mask = col <= row
+        else:
+            mask = None
+        m, l, acc = _attn_block(q, kc, vc, m, l, acc, scale, mask)
+        kv = jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm),
+                          (kc, vc))
+        return (m, l, acc, kv), None
+
+    # init carries FROM q so their device-variance matches the loop
+    # body's outputs (shard_map tracks varying-over-axis types)
+    m0 = jnp.full_like(q[..., 0], NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros_like(q[..., 0], dtype=jnp.float32)
+    acc0 = jnp.zeros_like(q, dtype=jnp.float32)
+    (m, l, acc, _), _ = lax.scan(step, (m0, l0, acc0, (k, v)),
+                                 jnp.arange(n))
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
+                   scale=None):
+    """Sequence-parallel attention: shards the sequence axis (2) of
+    q/k/v over `axis_name` and runs the ring. Returns the same global
+    array layout as the input."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    from .. import parallel
+
+    mesh = mesh or parallel.get_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        return flash_attention(q, k, v, causal, scale)
+    if q.shape[2] % mesh.shape[axis_name] != 0:
+        # sequence not divisible by the sp axis (e.g. a shape-inference
+        # probe with a tiny sequence): single-device attention is exact
+        return flash_attention(q, k, v, causal, scale)
+    if not isinstance(q, jax.core.Tracer):
+        # Eager call (e.g. the deferred-init shape probe): committing
+        # the output to the mesh would poison later eager ops that mix
+        # it with single-device weights. The ring engages inside jitted
+        # programs (hybridize / TrainStep) — the production path.
+        return flash_attention(q, k, v, causal, scale)
+    spec = P(None, None, axis_name, None)
+    fn = shard_map(
+        functools.partial(ring_attention_local, axis_name=axis_name,
+                          causal=causal, scale=scale),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

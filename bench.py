@@ -8,32 +8,71 @@ step). Prints ONE JSON line:
 ResNet-50 fp32 per-V100 throughput (~360 images/sec/GPU on 8xV100 NCCL
 runs; BASELINE.json's "published" table is empty so the commonly cited
 NVIDIA/MXNet fp32 number is used as the denominator).
+
+Robustness: the TPU (axon) backend can fail or hang during PJRT init.
+Backend init is therefore probed in a *subprocess* with a timeout and
+one retry; on failure the bench falls back to a small CPU run so a JSON
+line is always printed (with "platform" recording what actually ran).
+Errors still produce a machine-readable JSON line on stdout.
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+PROBE_ATTEMPTS = 2
+
+_PROBE_CODE = """
+import json, sys
+import jax
+devs = jax.devices()
+print(json.dumps({"platform": jax.default_backend(),
+                  "n_devices": len(devs)}))
+"""
 
 
-def main():
+def _probe_backend():
+    """Try TPU init in a child process (it can hang, not just fail).
+
+    Returns (platform, n_devices) of whatever backend came up, or None.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let jax auto-pick (tpu first)
+    for attempt in range(PROBE_ATTEMPTS):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", _PROBE_CODE], env=env,
+                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] backend probe attempt {attempt + 1} timed out "
+                  f"after {PROBE_TIMEOUT_S}s", file=sys.stderr, flush=True)
+            continue
+        if out.returncode == 0:
+            try:
+                info = json.loads(out.stdout.strip().splitlines()[-1])
+                return info["platform"], info["n_devices"]
+            except (ValueError, IndexError, KeyError):
+                pass
+        print(f"[bench] backend probe attempt {attempt + 1} failed "
+              f"(rc={out.returncode}): {out.stderr.strip()[-400:]}",
+              file=sys.stderr, flush=True)
+    return None
+
+
+def _force_cpu():
+    import tpu_platform
+    tpu_platform.force_cpu()
+
+
+def _run_bench(small: bool):
     import jax
-    # The axon TPU plugin registers itself regardless of JAX_PLATFORMS;
-    # honor an explicit platform request before any backend init so
-    # local CPU runs don't block on the TPU tunnel.
-    if os.environ.get("JAX_PLATFORMS"):
-        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
-
-    small = os.environ.get("BENCH_SMALL", "") not in ("", "0")
-    platform = jax.default_backend()
-    if platform == "cpu" and "BENCH_SMALL" not in os.environ:
-        small = True
 
     n_dev = jax.local_device_count()
     mesh = parallel.make_mesh((n_dev,), ("dp",))
@@ -60,6 +99,8 @@ def main():
     for _ in range(warmup):
         loss = step(data, label)
     loss.wait_to_read()
+    print(f"[bench] warmup done ({warmup} iters)", file=sys.stderr,
+          flush=True)
 
     t0 = time.perf_counter()
     for _ in range(iters):
@@ -68,7 +109,46 @@ def main():
     dt = time.perf_counter() - t0
 
     ips = batch * iters / dt
-    ips_per_chip = ips / n_dev
+    return ips / n_dev, n_dev, small
+
+
+def main():
+    # Honor an explicit platform request (local CPU runs) without
+    # probing: the axon TPU plugin registers regardless of
+    # JAX_PLATFORMS, so pin via jax.config before any backend init.
+    requested = os.environ.get("JAX_PLATFORMS")
+    platform = None
+    if requested:
+        import jax
+        jax.config.update("jax_platforms", requested)
+        platform = requested.split(",")[0]
+    else:
+        probed = _probe_backend()
+        if probed is None:
+            print("[bench] TPU backend unavailable; falling back to CPU "
+                  "small mode", file=sys.stderr, flush=True)
+            _force_cpu()
+            platform = "cpu"
+        else:
+            platform = probed[0]
+
+    small = os.environ.get("BENCH_SMALL", "") not in ("", "0")
+    if platform == "cpu" and "BENCH_SMALL" not in os.environ:
+        small = True
+
+    try:
+        ips_per_chip, n_dev, small = _run_bench(small)
+    except Exception as e:  # noqa: BLE001 — always emit a JSON line
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "error": f"{type(e).__name__}: {e}"[:500],
+        }))
+        return 1
+
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip"
         if not small else "resnet18_small_train_images_per_sec_per_chip",
@@ -76,7 +156,10 @@ def main():
         "unit": "images/sec/chip",
         "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP,
                              4),
+        "platform": platform,
+        "n_devices": n_dev,
     }))
+    return 0
 
 
 if __name__ == "__main__":

@@ -16,10 +16,15 @@ from __future__ import annotations
 
 import jax as _jax
 
-# Full-precision parity with the reference (float64/int64 arrays are
-# first-class there). Creation-op defaults remain float32, like the
-# reference, so TPU hot paths stay in f32/bf16.
-_jax.config.update("jax_enable_x64", True)
+# float64/int64 arrays are first-class in the reference, but a
+# process-global x64 flag inflates every trace/compile and risks silent
+# f64 on TPU hot paths (f64 is emulated there).  x64 is therefore
+# opt-in via MXTPU_ENABLE_X64=1; the default keeps JAX's f32 world,
+# which matches the reference's creation-op defaults (float32).
+import os as _os
+
+if _os.environ.get("MXTPU_ENABLE_X64", "") not in ("", "0"):
+    _jax.config.update("jax_enable_x64", True)
 
 from .base import MXNetError, __version__  # noqa: E402,F401
 from .context import (  # noqa: E402,F401

@@ -33,9 +33,9 @@ _NP_OPS = [
     "norm",
     # linalg / contraction
     "dot", "matmul", "tensordot", "einsum",
-    # shape
+    # shape ("split" gets a custom multi-output wrapper below)
     "reshape", "transpose", "swapaxes", "expand_dims", "squeeze",
-    "concatenate", "stack", "split", "flip", "tile", "repeat",
+    "concatenate", "stack", "flip", "tile", "repeat",
     "broadcast_to", "where", "clip", "take", "ravel",
     # misc
     "round", "floor_divide", "fmod", "absolute",
@@ -71,8 +71,33 @@ def _make_npx(opname):
     return wrapper
 
 
+def split(data, indices_or_sections, axis=0, name=None):
+    """Symbolic mx.np.split — a true multi-output Symbol.
+
+    Output arity is static (sections count or len(indices)+1), so the
+    node records it via __num_outputs__ and iteration/indexing sees all
+    pieces (parity: the reference's split yields N outputs).
+    """
+    if isinstance(indices_or_sections, int):
+        n_out = indices_or_sections
+        ios = indices_or_sections
+    else:
+        ios = list(indices_or_sections)
+        n_out = len(ios) + 1
+    return _compose("split", (data,), name=name,
+                    indices_or_sections=ios, axis=axis,
+                    __num_outputs__=n_out)
+
+
+def topk(data, k=1, axis=-1, ret_typ="indices", name=None, **attrs):
+    """Symbolic mx.npx.topk; ret_typ='both' yields (values, indices)."""
+    n_out = 2 if ret_typ == "both" else 1
+    return _compose("npx:topk", (data,), name=name, k=k, axis=axis,
+                    ret_typ=ret_typ, __num_outputs__=n_out, **attrs)
+
+
 _this = sys.modules[__name__]
-__all__ = []
+__all__ = ["split", "topk"]
 for _op in _NP_OPS:
     setattr(_this, _op, _make_np(_op))
     __all__.append(_op)
@@ -80,6 +105,35 @@ for _op in _NPX_OPS:
     if not hasattr(_this, _op):
         setattr(_this, _op, _make_npx(_op))
         __all__.append(_op)
+
+def _sum_args(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def _legacy_scalar(x, op=None, scalar=0.0, reverse=False):
+    """Legacy *_scalar ops (_mul_scalar, _rminus_scalar, ...)."""
+    import mxnet_tpu as mx
+    fn = getattr(mx.np, op)
+    return fn(scalar, x) if reverse else fn(x, scalar)
+
+
+def _legacy_reshape(x, shape=None):
+    """Legacy Reshape with the reference's special codes: 0 copies the
+    input dim, -1 infers one dim (src/operator/tensor/matrix_op-inl.h
+    reshape semantics; -2/-3/-4 are not supported)."""
+    out = []
+    for i, s in enumerate(shape):
+        if s == 0:
+            out.append(x.shape[i])
+        elif s in (-2, -3, -4):
+            raise ValueError(f"legacy reshape code {s} not supported")
+        else:
+            out.append(s)
+    return x.reshape(tuple(out))
+
 
 _TABLE = None
 
@@ -102,7 +156,15 @@ def op_table():
             fn = getattr(mx.npx, op, None)
             if fn is not None:
                 table[f"npx:{op}"] = fn
+        table["split"] = mx.np.split
         table["_scalar"] = lambda value=None: value
+        # adapters emitted by the legacy nnvm importer (legacy_json.py)
+        table["_identity"] = lambda x: x
+        table["_legacy_concat"] = \
+            lambda *xs, axis=1: mx.np.concatenate(xs, axis=axis)
+        table["_legacy_add_n"] = lambda *xs: _sum_args(xs)
+        table["_legacy_scalar"] = _legacy_scalar
+        table["_legacy_reshape"] = _legacy_reshape
         table["_astype"] = lambda x, dtype=None: x.astype(dtype)
         table["_flatten"] = lambda x: x.reshape((x.shape[0], -1)) \
             if x.ndim > 1 else x

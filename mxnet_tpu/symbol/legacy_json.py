@@ -1,0 +1,294 @@
+"""Importer for reference (legacy nnvm) ``-symbol.json`` graphs.
+
+The reference serializes symbols as nnvm JSON (node list with
+3-element ``[nid, idx, version]`` input entries, string-valued attrs,
+``node_row_ptr``; written by nnvm's JSON pass and loaded through
+``python/mxnet/symbol/symbol.py load``).  This module converts such a
+graph into an ``mxnet_tpu`` Symbol so models exported by the reference
+(``HybridBlock.export`` → ``-symbol.json`` + ``-NNNN.params``) can be
+migrated: ``mx.sym.load`` auto-detects the format, and
+``gluon.SymbolBlock.imports`` composes it with a legacy param file.
+
+Coverage is the inference op set used by exported models (dense/conv
+nets, the reference model zoo); an unmapped op raises with the op name
+so the gap is explicit rather than a silent mistranslation.
+"""
+from __future__ import annotations
+
+import ast
+
+from .symbol import Symbol, _Node
+
+__all__ = ["from_nnvm_json"]
+
+
+def _parse_attr(v):
+    """Legacy attrs are strings: '(2, 2)', 'True', '1e-05', 'relu'."""
+    if not isinstance(v, str):
+        return v
+    s = v.strip()
+    if s in ("True", "true"):
+        return True
+    if s in ("False", "false"):
+        return False
+    if s in ("None", ""):
+        return None
+    try:
+        return ast.literal_eval(s)
+    except (ValueError, SyntaxError):
+        return s
+
+
+def _attrs_of(node):
+    # very old graphs used "param"; 1.x used "attrs"; some used "attr"
+    raw = node.get("attrs") or node.get("attr") or node.get("param") or {}
+    return {k: _parse_attr(v) for k, v in raw.items()}
+
+
+# Each handler: (legacy_inputs, attrs) -> (table_op, kept_input_positions,
+# node_attrs). kept_input_positions indexes into the legacy input list
+# (e.g. SoftmaxOutput drops its label input at inference).
+def _simple(table_op, **fixed):
+    def h(inputs, attrs):
+        a = dict(fixed)
+        a.update(attrs)
+        return table_op, list(range(len(inputs))), a
+    return h
+
+
+def _unary(table_op):
+    def h(inputs, attrs):
+        return table_op, [0], {}
+    return h
+
+
+def _fully_connected(inputs, attrs):
+    a = {"no_bias": bool(attrs.get("no_bias", False)),
+         "flatten": bool(attrs.get("flatten", True))}
+    keep = [0, 1] if a["no_bias"] else [0, 1, 2]
+    return "npx:fully_connected", keep, a
+
+
+def _convolution(inputs, attrs):
+    a = {"kernel": tuple(attrs.get("kernel") or ()),
+         "stride": attrs.get("stride") or 1,
+         "dilate": attrs.get("dilate") or 1,
+         "pad": attrs.get("pad") or 0,
+         "num_filter": attrs.get("num_filter", 1),
+         "num_group": attrs.get("num_group", 1),
+         "no_bias": bool(attrs.get("no_bias", False)),
+         "layout": attrs.get("layout") or "NCHW"}
+    keep = [0, 1] if a["no_bias"] else [0, 1, 2]
+    return "npx:convolution", keep, a
+
+
+def _pooling(inputs, attrs):
+    a = {"kernel": tuple(attrs.get("kernel") or (1,)),
+         "pool_type": attrs.get("pool_type", "max"),
+         "global_pool": bool(attrs.get("global_pool", False)),
+         "pooling_convention": attrs.get("pooling_convention", "valid"),
+         "layout": attrs.get("layout") or "NCHW"}
+    if attrs.get("stride"):
+        a["stride"] = attrs["stride"]
+    if attrs.get("pad"):
+        a["pad"] = attrs["pad"]
+    if attrs.get("count_include_pad") is not None:
+        a["count_include_pad"] = bool(attrs["count_include_pad"])
+    return "npx:pooling", list(range(len(inputs))), a
+
+
+def _batch_norm(inputs, attrs):
+    a = {"eps": attrs.get("eps", 1e-3),
+         "momentum": attrs.get("momentum", 0.9),
+         "fix_gamma": bool(attrs.get("fix_gamma", True)),
+         "use_global_stats": bool(attrs.get("use_global_stats", False)),
+         "axis": attrs.get("axis", 1)}
+    # (data, gamma, beta, moving_mean, moving_var) — same order here
+    return "npx:batch_norm", [0, 1, 2, 3, 4], a
+
+
+def _activation(inputs, attrs):
+    return "npx:activation", [0], {"act_type": attrs.get("act_type", "relu")}
+
+
+def _leaky_relu(inputs, attrs):
+    act = attrs.get("act_type", "leaky")
+    a = {"act_type": act, "slope": attrs.get("slope", 0.25)}
+    # prelu carries a learned slope as a second input
+    keep = [0, 1] if act == "prelu" else [0]
+    return "npx:leaky_relu", keep, a
+
+
+def _softmax_output(inputs, attrs):
+    # At inference SoftmaxOutput is softmax over the last axis; the
+    # label input only matters for the (training-time) backward.
+    return "npx:softmax", [0], {"axis": -1}
+
+
+def _concat(inputs, attrs):
+    return "_legacy_concat", list(range(len(inputs))), \
+        {"axis": attrs.get("dim", 1)}
+
+
+def _slice_channel(inputs, attrs):
+    if attrs.get("squeeze_axis"):
+        raise ValueError("legacy SliceChannel with squeeze_axis=1 is not "
+                         "supported by the importer")
+    n = attrs.get("num_outputs", 1)
+    return "split", [0], {"indices_or_sections": n,
+                          "axis": attrs.get("axis", 1),
+                          "__num_outputs__": n}
+
+
+def _reshape(inputs, attrs):
+    shape = attrs.get("shape") or attrs.get("newshape")
+    if attrs.get("reverse"):
+        raise ValueError("legacy Reshape with reverse=True is not supported")
+    return "_legacy_reshape", [0], {"shape": list(shape)}
+
+
+def _cast(inputs, attrs):
+    return "_astype", [0], {"dtype": str(attrs.get("dtype", "float32"))}
+
+
+def _clip(inputs, attrs):
+    return "clip", [0], {"a_min": attrs.get("a_min"),
+                         "a_max": attrs.get("a_max")}
+
+
+def _scalar_op(np_op, reverse=False):
+    def h(inputs, attrs):
+        return "_legacy_scalar", [0], {"op": np_op,
+                                       "scalar": attrs.get("scalar", 0.0),
+                                       "reverse": reverse}
+    return h
+
+
+def _embedding(inputs, attrs):
+    return "npx:embedding", [0, 1], {}
+
+
+def _transpose(inputs, attrs):
+    axes = attrs.get("axes")
+    return "transpose", [0], {"axes": tuple(axes) if axes else None}
+
+
+def _reduce(table_op):
+    def h(inputs, attrs):
+        return table_op, [0], {"axis": attrs.get("axis"),
+                               "keepdims": bool(attrs.get("keepdims", False))}
+    return h
+
+
+_HANDLERS = {
+    "FullyConnected": _fully_connected,
+    "Convolution": _convolution,
+    "Activation": _activation,
+    "LeakyReLU": _leaky_relu,
+    "Pooling": _pooling,
+    "BatchNorm": _batch_norm,
+    "SoftmaxOutput": _softmax_output,
+    "softmax": _simple("npx:softmax"),
+    "log_softmax": _simple("npx:log_softmax"),
+    "Softmax": _softmax_output,
+    "Concat": _concat,
+    "concat": _concat,
+    "SliceChannel": _slice_channel,
+    "Reshape": _reshape,
+    "reshape": _reshape,
+    "Flatten": _unary("_flatten"),
+    "flatten": _unary("_flatten"),
+    "Dropout": _unary("_identity"),   # inference: identity
+    "identity": _unary("_identity"),
+    "_copy": _unary("_identity"),
+    "BlockGrad": _unary("_identity"),
+    "stop_gradient": _unary("_identity"),
+    "Cast": _cast,
+    "cast": _cast,
+    "clip": _clip,
+    "transpose": _transpose,
+    "Embedding": _embedding,
+    "relu": _unary("npx:relu"),
+    "sigmoid": _unary("npx:sigmoid"),
+    "tanh": _unary("tanh"),
+    "exp": _unary("exp"),
+    "log": _unary("log"),
+    "sqrt": _unary("sqrt"),
+    "square": _unary("square"),
+    "add_n": lambda inputs, attrs: ("_legacy_add_n",
+                                    list(range(len(inputs))), {}),
+    "ElementWiseSum": lambda inputs, attrs: ("_legacy_add_n",
+                                             list(range(len(inputs))), {}),
+    "elemwise_add": _simple("add"),
+    "elemwise_sub": _simple("subtract"),
+    "elemwise_mul": _simple("multiply"),
+    "elemwise_div": _simple("divide"),
+    "broadcast_add": _simple("add"),
+    "broadcast_sub": _simple("subtract"),
+    "broadcast_mul": _simple("multiply"),
+    "broadcast_div": _simple("divide"),
+    "dot": _simple("dot"),
+    "batch_dot": _simple("npx:batch_dot"),
+    "mean": _reduce("mean"),
+    "sum": _reduce("sum"),
+    "max": _reduce("max"),
+    "min": _reduce("min"),
+    "_plus_scalar": _scalar_op("add"),
+    "_minus_scalar": _scalar_op("subtract"),
+    "_rminus_scalar": _scalar_op("subtract", reverse=True),
+    "_mul_scalar": _scalar_op("multiply"),
+    "_div_scalar": _scalar_op("divide"),
+    "_rdiv_scalar": _scalar_op("divide", reverse=True),
+    "_power_scalar": _scalar_op("power"),
+    # 2.x numpy-namespace exports
+    "_npi_add": _simple("add"),
+    "_npi_subtract": _simple("subtract"),
+    "_npi_multiply": _simple("multiply"),
+    "_npi_true_divide": _simple("divide"),
+    "_npi_power": _simple("power"),
+    "_npi_mean": _reduce("mean"),
+    "_npi_sum": _reduce("sum"),
+    "_npi_transpose": _transpose,
+    "_npi_concatenate": _concat,
+    "_npx_relu": _unary("npx:relu"),
+    "_npx_sigmoid": _unary("npx:sigmoid"),
+    "_npx_fully_connected": _fully_connected,
+    "_npx_convolution": _convolution,
+    "_npx_pooling": _pooling,
+    "_npx_batch_norm": _batch_norm,
+    "_npx_activation": _activation,
+    "_npx_softmax": _simple("npx:softmax"),
+    "_npx_log_softmax": _simple("npx:log_softmax"),
+    "_npx_reshape": _reshape,
+    "_npx_embedding": _embedding,
+}
+
+
+def from_nnvm_json(d: dict) -> Symbol:
+    """Convert a parsed legacy nnvm symbol JSON dict into a Symbol.
+
+    Reference format: nodes with ``[nid, idx, version]`` input entries
+    and string attrs (see the reference's ``src/nnvm`` JSON pass and
+    ``python/mxnet/symbol/symbol.py`` load path).
+    """
+    nodes_json = d.get("nodes", [])
+    out_nodes = []
+    for n in nodes_json:
+        op, name = n["op"], n["name"]
+        entries = [(e[0], e[1]) for e in n.get("inputs", [])]
+        if op == "null":
+            out_nodes.append(_Node("null", name, [], {}))
+            continue
+        handler = _HANDLERS.get(op)
+        if handler is None:
+            raise ValueError(
+                f"legacy op {op!r} (node {name!r}) is not supported by "
+                "the nnvm importer; supported ops: "
+                f"{sorted(_HANDLERS)}")
+        table_op, keep, attrs = handler(entries, _attrs_of(n))
+        out_nodes.append(
+            _Node(table_op, name, [entries[i] for i in keep], attrs))
+    heads = [(h[0], h[1]) for h in d.get("heads", [])]
+    if not heads:
+        heads = [(len(out_nodes) - 1, 0)]
+    return Symbol(out_nodes, heads)

@@ -59,7 +59,11 @@ class Symbol:
         return self.list_arguments()
 
     def list_outputs(self):
-        return [f"{self._nodes[nid].name}_output"
+        counts = {}
+        for nid, _ in self._outputs:
+            counts[nid] = counts.get(nid, 0) + 1
+        return [f"{self._nodes[nid].name}_output{idx}" if counts[nid] > 1
+                else f"{self._nodes[nid].name}_output"
                 for nid, idx in self._outputs]
 
     def list_auxiliary_states(self):
@@ -71,6 +75,11 @@ class Symbol:
 
     def __getitem__(self, idx):
         if isinstance(idx, str):
+            # exact list_outputs() names resolve to their own entry
+            # (incl. indexed names of multi-output nodes)
+            for pos, name in enumerate(self.list_outputs()):
+                if name == idx:
+                    return Symbol(self._nodes, [self._outputs[pos]])
             for i, n in enumerate(self._nodes):
                 if n.name == idx or f"{n.name}_output" == idx:
                     return Symbol(self._nodes, [(i, 0)])
@@ -182,7 +191,9 @@ class Symbol:
             else:
                 fn = _ops.op_table()[node.op]
                 ins = [vals[i][idx] for i, idx in node.inputs]
-                out = fn(*ins, **node.attrs)
+                attrs = {k: v for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                out = fn(*ins, **attrs)
                 vals[nid] = tuple(out) if isinstance(out, (tuple, list)) \
                     else (out,)
         return [vals[nid][idx] for nid, idx in self._outputs]
@@ -341,7 +352,13 @@ Variable = var
 
 
 def _compose(op, inputs, name=None, **attrs):
-    """Build a new Symbol applying `op` to `inputs` (Symbols/scalars)."""
+    """Build a new Symbol applying `op` to `inputs` (Symbols/scalars).
+
+    The reserved attr `__num_outputs__` declares the op's output arity
+    (default 1); multi-output ops (split, topk ret_typ='both', …) set
+    it so the resulting Symbol exposes all N outputs instead of
+    silently truncating to the first.
+    """
     nodes = []
     in_entries = []
     remap_cache = {}
@@ -393,7 +410,8 @@ def _compose(op, inputs, name=None, **attrs):
 
     node = _Node(op, name or _auto_name(op), in_entries, attrs)
     nodes = nodes + [node]
-    return Symbol(nodes, [(len(nodes) - 1, 0)])
+    n_out = attrs.get("__num_outputs__", 1)
+    return Symbol(nodes, [(len(nodes) - 1, i) for i in range(n_out)])
 
 
 def Group(symbols):
@@ -410,6 +428,23 @@ def Group(symbols):
 
 def fromjson(text):
     d = json.loads(text)
+    version = d.get("mxnet_tpu_symbol_version")
+    if version is None:
+        # Reference nnvm -symbol.json: 3-element input/head entries,
+        # node_row_ptr, string-valued attrs. Route to the legacy
+        # importer rather than failing with an opaque unpack error.
+        if "node_row_ptr" in d or any(
+                len(i) == 3 for n in d.get("nodes", [])
+                for i in n.get("inputs", [])):
+            from .legacy_json import from_nnvm_json
+            return from_nnvm_json(d)
+        raise ValueError(
+            "not an mxnet_tpu symbol JSON (missing "
+            "mxnet_tpu_symbol_version) and not a recognizable legacy "
+            "nnvm -symbol.json")
+    if version > _SYM_VERSION:
+        raise ValueError(f"symbol JSON version {version} is newer than "
+                         f"this build supports ({_SYM_VERSION})")
     nodes = [_Node(n["op"], n["name"],
                    [tuple(i) for i in n["inputs"]], n.get("attrs", {}))
              for n in d["nodes"]]

@@ -74,6 +74,12 @@ def save(fname, data):
 
 
 def load(fname):
+    # auto-detect the reference's legacy binary NDArray format
+    from . import legacy_serialization as _legacy
+    with open(fname, "rb") as f:
+        head = f.read(8)
+    if _legacy.is_legacy_file(head):
+        return _legacy.load_legacy(fname)
     with onp.load(fname, allow_pickle=False) as npz:
         done = _decode_groups(npz)
         keys = list(done.keys())

@@ -6,14 +6,13 @@ TPU plugin ignores JAX_PLATFORMS, so we also force the platform via
 jax.config before mxnet_tpu import.
 """
 import os
+import sys
 
-os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
-                           " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+import tpu_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+tpu_platform.force_cpu(n_devices=8)
 
 import pytest  # noqa: E402
 

@@ -1,4 +1,6 @@
 """Gluon block/layer tests (model: tests/python/unittest/test_gluon.py)."""
+import os
+
 import numpy as onp
 import pytest
 
@@ -251,10 +253,28 @@ def test_metrics():
 def test_block_cast():
     net = nn.Dense(3, in_units=2)
     net.initialize()
-    net.cast("float64")
-    assert net.weight.data().dtype == onp.float64
-    out = net(np.ones((1, 2), dtype="float64"))
-    assert out.dtype == onp.float64
+    net.cast("float16")
+    assert net.weight.data().dtype == onp.float16
+    out = net(np.ones((1, 2), dtype="float16"))
+    assert out.dtype == onp.float16
+
+
+def test_x64_opt_in():
+    """float64 is opt-in via MXTPU_ENABLE_X64 (kept off by default so
+    TPU hot paths never silently hit emulated f64)."""
+    import subprocess
+    import sys
+    # the axon TPU plugin ignores JAX_PLATFORMS; pin via jax.config
+    # before mxnet_tpu import (same dance as conftest.py)
+    code = ("import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import mxnet_tpu as mx; "
+            "a = mx.np.array([1.0], dtype='float64'); "
+            "print(a.dtype)")
+    env = dict(os.environ, MXTPU_ENABLE_X64="1", JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "float64" in out.stdout
 
 
 def test_dataloader_and_dataset():

@@ -1,0 +1,267 @@
+"""Reference-checkpoint interop: legacy binary NDArray files and legacy
+nnvm -symbol.json graphs (migration path from the reference ecosystem).
+
+The reference runtime is not buildable here, so the "reference-written"
+fixtures are byte-crafted in this file directly from the documented
+format (src/ndarray/ndarray.cc NDArray::Save: V2 magic 0xF993fac9,
+stype, TShape as int32 ndim + int64 dims, context, mshadow type flag,
+raw data) — independently of mxnet_tpu's own writer, so reader bugs
+can't cancel out writer bugs.
+"""
+import json
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon
+from mxnet_tpu.legacy_serialization import load_legacy, save_legacy
+
+
+def _shape_bytes(shape):
+    return struct.pack("<i", len(shape)) + \
+        struct.pack(f"<{len(shape)}q", *shape)
+
+
+def _v2_dense_bytes(arr, type_flag):
+    """One V2 dense NDArray record, assembled by hand."""
+    a = onp.ascontiguousarray(arr)
+    return (struct.pack("<I", 0xF993FAC9)     # NDARRAY_V2_MAGIC
+            + struct.pack("<i", 0)            # kDefaultStorage
+            + _shape_bytes(a.shape)
+            + struct.pack("<ii", 1, 0)        # Context cpu(0)
+            + struct.pack("<i", type_flag)
+            + a.tobytes())
+
+
+def _list_file_bytes(records, names):
+    out = struct.pack("<QQ", 0x112, 0)        # list magic + reserved
+    out += struct.pack("<Q", len(records)) + b"".join(records)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        raw = n.encode()
+        out += struct.pack("<Q", len(raw)) + raw
+    return out
+
+
+def test_load_crafted_v2_dict(tmp_path):
+    w = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+    b = onp.array([1, -2, 3], dtype=onp.int64)
+    payload = _list_file_bytes(
+        [_v2_dense_bytes(w, 0), _v2_dense_bytes(b, 6)],
+        ["arg:weight", "aux:stat"])
+    f = tmp_path / "ref.params"
+    f.write_bytes(payload)
+
+    loaded = mx.load(str(f))  # auto-detects the legacy format
+    assert set(loaded) == {"arg:weight", "aux:stat"}
+    onp.testing.assert_array_equal(loaded["arg:weight"].asnumpy(), w)
+    onp.testing.assert_array_equal(loaded["aux:stat"].asnumpy(), b)
+    # int64 is preserved under MXTPU_ENABLE_X64, narrows to int32 otherwise
+    assert loaded["aux:stat"].asnumpy().dtype in (onp.int64, onp.int32)
+
+
+def test_load_crafted_v2_list_and_fp16(tmp_path):
+    x = onp.random.randn(2, 5).astype(onp.float16)
+    f = tmp_path / "list.nd"
+    f.write_bytes(_list_file_bytes([_v2_dense_bytes(x, 2)], []))
+    loaded = load_legacy(str(f))
+    assert isinstance(loaded, list) and len(loaded) == 1
+    onp.testing.assert_array_equal(loaded[0].asnumpy(), x)
+
+
+def test_load_crafted_row_sparse(tmp_path):
+    # row_sparse (shape (4,3), rows 0 and 2 present):
+    data = onp.array([[1, 2, 3], [4, 5, 6]], dtype=onp.float32)
+    idx = onp.array([0, 2], dtype=onp.int64)
+    rec = (struct.pack("<I", 0xF993FAC9)
+           + struct.pack("<i", 1)              # kRowSparseStorage
+           + _shape_bytes(data.shape)          # storage shape
+           + _shape_bytes((4, 3))              # logical shape
+           + struct.pack("<ii", 1, 0)
+           + struct.pack("<i", 0)              # float32 values
+           + struct.pack("<i", 6)              # aux: int64
+           + _shape_bytes(idx.shape)
+           + data.tobytes()
+           + idx.tobytes())
+    f = tmp_path / "rs.nd"
+    f.write_bytes(_list_file_bytes([rec], ["w"]))
+    loaded = load_legacy(str(f))
+    rs = loaded["w"]
+    assert rs.stype == "row_sparse"
+    dense = rs.tostype("default").asnumpy()
+    expect = onp.zeros((4, 3), onp.float32)
+    expect[[0, 2]] = data
+    onp.testing.assert_array_equal(dense, expect)
+
+
+def test_save_load_roundtrip(tmp_path):
+    d = {"a": np.array([[1.5, 2.5]], dtype="float32"),
+         "b": np.array([7], dtype="int32")}
+    f = tmp_path / "rt.params"
+    save_legacy(str(f), d)
+    back = mx.load(str(f))
+    onp.testing.assert_array_equal(back["a"].asnumpy(),
+                                   d["a"].asnumpy())
+    onp.testing.assert_array_equal(back["b"].asnumpy(),
+                                   d["b"].asnumpy())
+
+
+def _legacy_mlp_json():
+    """An nnvm -symbol.json as the reference 1.x would export a small
+    MLP (data → FC(4) → relu → FC(3) → SoftmaxOutput)."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "fc1_weight", "inputs": []},
+        {"op": "null", "name": "fc1_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc1",
+         "attrs": {"num_hidden": "4"},
+         "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+        {"op": "Activation", "name": "relu1",
+         "attrs": {"act_type": "relu"}, "inputs": [[3, 0, 0]]},
+        {"op": "null", "name": "fc2_weight", "inputs": []},
+        {"op": "null", "name": "fc2_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc2",
+         "attrs": {"num_hidden": "3"},
+         "inputs": [[4, 0, 0], [5, 0, 0], [6, 0, 0]]},
+        {"op": "null", "name": "softmax_label", "inputs": []},
+        {"op": "SoftmaxOutput", "name": "softmax",
+         "inputs": [[7, 0, 0], [8, 0, 0]]},
+    ]
+    return {"nodes": nodes,
+            "arg_nodes": [0, 1, 2, 5, 6, 8],
+            "node_row_ptr": list(range(len(nodes) + 1)),
+            "heads": [[9, 0, 0]],
+            "attrs": {"mxnet_version": ["int", 10800]}}
+
+
+def test_import_legacy_symbol_and_params(tmp_path):
+    sym_file = tmp_path / "mlp-symbol.json"
+    sym_file.write_text(json.dumps(_legacy_mlp_json()))
+
+    rng = onp.random.RandomState(3)
+    w1 = rng.randn(4, 6).astype(onp.float32)
+    b1 = rng.randn(4).astype(onp.float32)
+    w2 = rng.randn(3, 4).astype(onp.float32)
+    b2 = rng.randn(3).astype(onp.float32)
+    params_file = tmp_path / "mlp-0000.params"
+    save_legacy(str(params_file), {
+        "arg:fc1_weight": w1, "arg:fc1_bias": b1,
+        "arg:fc2_weight": w2, "arg:fc2_bias": b2})
+
+    sym = mx.sym.load(str(sym_file))
+    assert "data" in sym.list_arguments()
+
+    net = gluon.SymbolBlock.imports(str(sym_file), ["data"],
+                                    str(params_file))
+    x = rng.randn(5, 6).astype(onp.float32)
+    out = net(np.array(x)).asnumpy()
+
+    # independent NumPy reference of the same MLP
+    h = onp.maximum(x @ w1.T + b1, 0)
+    logits = h @ w2.T + b2
+    e = onp.exp(logits - logits.max(-1, keepdims=True))
+    expect = e / e.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_import_legacy_conv_graph(tmp_path):
+    """Conv → BatchNorm → relu → pool → flatten → FC, 1.x layout."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "null", "name": "conv_weight", "inputs": []},
+        {"op": "Convolution", "name": "conv",
+         "attrs": {"kernel": "(3, 3)", "num_filter": "2",
+                   "stride": "(1, 1)", "pad": "(1, 1)",
+                   "no_bias": "True"},
+         "inputs": [[0, 0, 0], [1, 0, 0]]},
+        {"op": "null", "name": "bn_gamma", "inputs": []},
+        {"op": "null", "name": "bn_beta", "inputs": []},
+        {"op": "null", "name": "bn_moving_mean", "inputs": []},
+        {"op": "null", "name": "bn_moving_var", "inputs": []},
+        {"op": "BatchNorm", "name": "bn",
+         "attrs": {"eps": "0.001", "fix_gamma": "False"},
+         "inputs": [[2, 0, 0], [3, 0, 0], [4, 0, 0],
+                    [5, 0, 0], [6, 0, 0]]},
+        {"op": "Activation", "name": "act",
+         "attrs": {"act_type": "relu"}, "inputs": [[7, 0, 0]]},
+        {"op": "Pooling", "name": "pool",
+         "attrs": {"global_pool": "True", "pool_type": "avg",
+                   "kernel": "(1, 1)"},
+         "inputs": [[8, 0, 0]]},
+        {"op": "Flatten", "name": "flat", "inputs": [[9, 0, 0]]},
+        {"op": "null", "name": "fc_weight", "inputs": []},
+        {"op": "null", "name": "fc_bias", "inputs": []},
+        {"op": "FullyConnected", "name": "fc",
+         "attrs": {"num_hidden": "3"},
+         "inputs": [[10, 0, 0], [11, 0, 0], [12, 0, 0]]},
+    ]
+    d = {"nodes": nodes, "arg_nodes": [0, 1, 3, 4, 5, 6, 11, 12],
+         "node_row_ptr": list(range(len(nodes) + 1)),
+         "heads": [[13, 0, 0]]}
+    sym_file = tmp_path / "net-symbol.json"
+    sym_file.write_text(json.dumps(d))
+
+    rng = onp.random.RandomState(5)
+    params = {
+        "arg:conv_weight": rng.randn(2, 3, 3, 3).astype(onp.float32) * .2,
+        "arg:bn_gamma": onp.ones(2, onp.float32),
+        "arg:bn_beta": onp.zeros(2, onp.float32),
+        "aux:bn_moving_mean": onp.zeros(2, onp.float32),
+        "aux:bn_moving_var": onp.ones(2, onp.float32),
+        "arg:fc_weight": rng.randn(3, 2).astype(onp.float32),
+        "arg:fc_bias": onp.zeros(3, onp.float32),
+    }
+    params_file = tmp_path / "net-0000.params"
+    save_legacy(str(params_file), params)
+
+    net = gluon.SymbolBlock.imports(str(sym_file), ["data"],
+                                    str(params_file))
+    x = rng.randn(2, 3, 8, 8).astype(onp.float32)
+    out = net(np.array(x))
+    assert out.shape == (2, 3)
+    assert bool(onp.isfinite(out.asnumpy()).all())
+
+
+def test_import_legacy_adapter_ops(tmp_path):
+    """Dropout/Concat/Reshape/_mul_scalar/add_n all map through the
+    importer's adapter table and evaluate."""
+    nodes = [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "Dropout", "name": "drop", "attrs": {"p": "0.5"},
+         "inputs": [[0, 0, 0]]},
+        {"op": "_mul_scalar", "name": "scale", "attrs": {"scalar": "2.0"},
+         "inputs": [[1, 0, 0]]},
+        {"op": "Concat", "name": "cat", "attrs": {"dim": "1",
+                                                  "num_args": "2"},
+         "inputs": [[1, 0, 0], [2, 0, 0]]},
+        {"op": "add_n", "name": "addn",
+         "inputs": [[3, 0, 0], [3, 0, 0]]},
+        {"op": "Reshape", "name": "rsh", "attrs": {"shape": "(0, -1)"},
+         "inputs": [[4, 0, 0]]},
+    ]
+    d = {"nodes": nodes, "arg_nodes": [0],
+         "node_row_ptr": list(range(len(nodes) + 1)),
+         "heads": [[5, 0, 0]]}
+    sym_file = tmp_path / "ops-symbol.json"
+    sym_file.write_text(json.dumps(d))
+    net = gluon.SymbolBlock.imports(str(sym_file), ["data"])
+    x = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    out = net(np.array(x)).asnumpy()
+    expect = onp.concatenate([x, x * 2], axis=1) * 2
+    onp.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_fromjson_rejects_garbage():
+    with pytest.raises(ValueError, match="not an mxnet_tpu symbol"):
+        mx.sym.fromjson(json.dumps({"nodes": []}))
+
+
+def test_importer_unknown_op_is_loud():
+    d = {"nodes": [
+        {"op": "null", "name": "data", "inputs": []},
+        {"op": "SomeExoticOp", "name": "x", "inputs": [[0, 0, 0]]},
+    ], "node_row_ptr": [0, 1, 2], "heads": [[1, 0, 0]]}
+    with pytest.raises(ValueError, match="SomeExoticOp"):
+        mx.sym.fromjson(json.dumps(d))

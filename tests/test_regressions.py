@@ -35,10 +35,11 @@ def test_cast_invalidates_cached_graph():
     x = np.ones((2, 4))
     out32 = net(x)
     assert out32.dtype == onp.float32
-    net.cast("float64")
-    out64 = net(x.astype("float64"))
-    assert out64.dtype == onp.float64
-    onp.testing.assert_allclose(out64.asnumpy(), out32.asnumpy(), rtol=1e-6)
+    net.cast("float16")
+    out16 = net(x.astype("float16"))
+    assert out16.dtype == onp.float16
+    onp.testing.assert_allclose(out16.asnumpy(), out32.asnumpy(),
+                                rtol=2e-3, atol=2e-3)
 
 
 def test_param_cast_direct_invalidates():
@@ -48,9 +49,9 @@ def test_param_cast_direct_invalidates():
     x = np.ones((1, 2))
     net(x)
     # rebind parameter data directly (reset_ctx-style rebind)
-    net.weight.cast("float64")
-    out = net(x.astype("float64"))
-    assert out.dtype == onp.float64
+    net.weight.cast("float16")
+    out = net(x.astype("float16"))
+    assert out.dtype == onp.float16
 
 
 def test_histogram_weights():

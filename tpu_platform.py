@@ -1,0 +1,32 @@
+"""Shared force-CPU helper for driver scripts and tests.
+
+The axon TPU plugin registers itself regardless of JAX_PLATFORMS, so
+pinning the platform requires jax.config.update *before* any backend
+initialization. This is the single home for that dance; bench.py,
+__graft_entry__.py and tests/conftest.py all use it.
+"""
+from __future__ import annotations
+
+import os
+import re
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin JAX to host CPU, optionally with n virtual devices.
+
+    Must run before any JAX backend init.  If XLA_FLAGS already forces
+    a different virtual device count, it is replaced (not silently
+    kept) so callers actually get the count they asked for.
+    """
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"--xla_force_host_platform_device_count={n_devices}"
+        pat = r"--xla_force_host_platform_device_count=\d+"
+        if re.search(pat, flags):
+            flags = re.sub(pat, opt, flags)
+        else:
+            flags = (flags + " " + opt).strip()
+        os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,189 @@
+"""Estimator — Keras-style fit loop over Gluon nets (parity:
+python/mxnet/gluon/contrib/estimator/estimator.py).
+
+The training step itself is the standard imperative path
+(autograd.record → backward → trainer.step via GradientUpdateHandler),
+so everything the framework jits/fuses for manual loops applies here
+unchanged; hybridize the net for whole-graph XLA programs."""
+from __future__ import annotations
+
+import logging
+
+from ... import loss as gloss
+from ... import metric as gmetric
+from ...trainer import Trainer
+from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
+                            GradientUpdateHandler, LoggingHandler,
+                            MetricHandler, StoppingHandler, TrainBegin,
+                            TrainEnd, ValidationHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    """Drive training/validation of `net` with event handlers.
+
+    Parameters mirror the reference: net, loss, train_metrics,
+    val_metrics, trainer, context (ignored — device placement follows
+    the arrays), evaluation_loss."""
+
+    logger = None
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 initializer=None, trainer=None, context=None,
+                 evaluation_loss=None, batch_axis=0):
+        self.net = net
+        self.loss = self._check_loss(loss)
+        self.evaluation_loss = self._check_loss(
+            evaluation_loss) if evaluation_loss is not None else self.loss
+        self.batch_axis = batch_axis
+        self.stop_training = False
+
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+        if not self.logger.handlers:
+            self.logger.addHandler(logging.StreamHandler())
+            self.logger.setLevel(logging.INFO)
+
+        self._initialize(initializer)
+        self.trainer = trainer or Trainer(net.collect_params(), "adam")
+
+        self.train_metrics = self._as_metrics(train_metrics)
+        self.val_metrics = self._as_metrics(val_metrics)
+        if not self.train_metrics:
+            self.train_metrics = [gmetric.Accuracy()]
+        if not self.val_metrics:
+            self.val_metrics = [type(m)() for m in self.train_metrics]
+        # loss metrics track the running objective
+        self.train_loss_metric = gmetric.Loss("train loss")
+        self.val_loss_metric = gmetric.Loss("validation loss")
+
+    @staticmethod
+    def _check_loss(loss):
+        if not isinstance(loss, gloss.Loss):
+            raise ValueError("loss must be a gluon.loss.Loss instance, "
+                             f"got {type(loss)}")
+        return loss
+
+    @staticmethod
+    def _as_metrics(metrics):
+        if metrics is None:
+            return []
+        if isinstance(metrics, gmetric.EvalMetric):
+            return [metrics]
+        out = list(metrics)
+        for m in out:
+            if not isinstance(m, gmetric.EvalMetric):
+                raise ValueError("metrics must be EvalMetric instances")
+        return out
+
+    def _initialize(self, initializer):
+        params = self.net.collect_params()
+        uninit = [p for p in params.values() if p._data is None and
+                  not getattr(p, "_deferred_init", None)]
+        try:
+            initialized = all(p._data is not None or p.shape is None or
+                              any(s == 0 for s in (p.shape or ()))
+                              for p in params.values())
+        except Exception:
+            initialized = False
+        if initializer is not None:
+            self.net.initialize(initializer, force_reinit=False)
+        else:
+            try:
+                self.net.initialize(force_reinit=False)
+            except Exception:
+                pass  # already initialized
+
+    def _get_data_and_label(self, batch):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def prepare_loss_and_metrics(self):
+        return self.train_metrics + [self.train_loss_metric], \
+            self.val_metrics + [self.val_loss_metric]
+
+    # -- evaluation -----------------------------------------------------
+    def evaluate_batch(self, val_batch):
+        data, label = self._get_data_and_label(val_batch)
+        pred = self.net(data)
+        loss = self.evaluation_loss(pred, label)
+        return data, label, pred, loss
+
+    def evaluate(self, val_data, batch_axis=0, event_handlers=None):
+        for m in self.val_metrics + [self.val_loss_metric]:
+            m.reset()
+        for batch in val_data:
+            _, label, pred, loss = self.evaluate_batch(batch)
+            for m in self.val_metrics:
+                m.update(label, pred)
+            self.val_loss_metric.update(0, loss)
+        return dict(m.get() for m in
+                    [*self.val_metrics, self.val_loss_metric])
+
+    # -- training -------------------------------------------------------
+    def fit_batch(self, train_batch, batch_axis=0):
+        from .... import autograd
+        data, label = self._get_data_and_label(train_batch)
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            epochs = 1
+        self.max_epoch = epochs
+        self.max_batch = batches
+        self.stop_training = False
+
+        handlers = self._prepare_handlers(val_data, event_handlers)
+        train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
+            train_end = self._categorize(handlers)
+
+        for h in train_begin:
+            h.train_begin(self)
+        while not self.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                for h in batch_begin:
+                    h.batch_begin(self, batch=batch)
+                _, label, pred, loss = self.fit_batch(batch, batch_axis)
+                for h in batch_end:
+                    h.batch_end(self, batch=batch, pred=pred,
+                                label=label, loss=loss)
+                if self.stop_training:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+        for h in train_end:
+            h.train_end(self)
+
+    # -- handler plumbing ----------------------------------------------
+    def _prepare_handlers(self, val_data, event_handlers):
+        handlers = list(event_handlers or [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(self.max_epoch,
+                                            self.max_batch))
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(MetricHandler(
+                self.train_metrics + [self.train_loss_metric]))
+        if val_data is not None and \
+                not any(isinstance(h, ValidationHandler) for h in handlers):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        if not any(isinstance(h, LoggingHandler) for h in handlers):
+            handlers.append(LoggingHandler(
+                metrics=self.train_metrics + [self.train_loss_metric]))
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
+        return handlers
+
+    @staticmethod
+    def _categorize(handlers):
+        def pick(cls):
+            return [h for h in handlers if isinstance(h, cls)]
+        return (pick(TrainBegin), pick(EpochBegin), pick(BatchBegin),
+                pick(BatchEnd), pick(EpochEnd), pick(TrainEnd))

@@ -1,0 +1,352 @@
+"""Estimator event handlers (parity:
+python/mxnet/gluon/contrib/estimator/event_handler.py).
+
+Handlers are mixin classes keyed by which lifecycle hooks they
+implement; the Estimator sorts registered handlers by priority and
+invokes each hook with itself as the only argument (`estimator` carries
+all mutable state: net, trainer, metrics, stop flag)."""
+from __future__ import annotations
+
+import logging
+import os
+import time
+import warnings
+
+import numpy as onp
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler", "GradientUpdateHandler"]
+
+
+class EventHandler:
+    priority = 0
+
+
+class TrainBegin(EventHandler):
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd(EventHandler):
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin(EventHandler):
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd(EventHandler):
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin(EventHandler):
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd(EventHandler):
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop after max_epoch epochs or max_batch batches."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch is not None and \
+                self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch is not None and \
+                self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset training metrics at epoch begin, update them at batch end."""
+    priority = -1000  # run first
+
+    def __init__(self, metrics):
+        self.metrics = metrics or []
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if getattr(m, "name", "").startswith("train "):
+                name = m.name[len("train "):]
+            else:
+                name = getattr(m, "name", "")
+            if "loss" in name.lower():
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run evaluation every `epoch_period` epochs (or `batch_period`
+    batches)."""
+    priority = -1000
+
+    def __init__(self, val_data, eval_fn, epoch_period=1,
+                 batch_period=None, event_handlers=None):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and \
+                self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                     BatchBegin, BatchEnd):
+    """Log training progress (per epoch, optionally every N batches)."""
+    priority = 1000  # run last, after metrics updated
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def _metrics_str(self):
+        parts = []
+        for m in self.metrics:
+            name, val = m.get()
+            parts.append(f"{name}: {val:.4f}"
+                         if isinstance(val, float) else f"{name}: {val}")
+        return ", ".join(parts)
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        dt = time.time() - self.train_start
+        self.logger.info("Training finished in %.2fs; %s", dt,
+                         self._metrics_str())
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+        self.batch_index = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        dt = time.time() - self.epoch_start
+        self.logger.info("[Epoch %d] finished in %.2fs: %s",
+                         self.current_epoch, dt, self._metrics_str())
+        self.current_epoch += 1
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            self.logger.info("[Epoch %d][Batch %d] %s",
+                             self.current_epoch, self.batch_index,
+                             self._metrics_str())
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save model params + trainer states periodically; optionally keep
+    only the best by a monitored metric (parity: event_handler.py
+    CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.max_checkpoints = max_checkpoints
+        self.resume_from_checkpoint = resume_from_checkpoint
+        self.verbose = verbose
+        self.saved_checkpoints = []
+        self.current_epoch = 0
+        self.current_batch = 0
+        self.trained_epoch = -1
+        if mode == "min" or (mode == "auto" and monitor is not None and
+                             "loss" in getattr(monitor, "name", "")):
+            self.monitor_op = onp.less
+            self.best = onp.inf
+        else:
+            self.monitor_op = onp.greater
+            self.best = -onp.inf
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        os.makedirs(self.model_dir, exist_ok=True)
+        self.current_epoch = 0
+        self.current_batch = 0
+        if self.resume_from_checkpoint:
+            self._resume(estimator)
+
+    def _state_path(self, tag):
+        return os.path.join(self.model_dir,
+                            f"{self.model_prefix}-{tag}")
+
+    def _save(self, estimator, tag):
+        prefix = self._state_path(tag)
+        estimator.net.save_parameters(prefix + ".params")
+        if estimator.trainer is not None:
+            estimator.trainer.save_states(prefix + ".states")
+        # epoch marker for resume
+        with open(os.path.join(self.model_dir,
+                               f"{self.model_prefix}.meta"), "w") as f:
+            f.write(str(self.current_epoch))
+        self.saved_checkpoints.append(tag)
+        while len(self.saved_checkpoints) > self.max_checkpoints:
+            old = self.saved_checkpoints.pop(0)
+            for suffix in (".params", ".states"):
+                p = self._state_path(old) + suffix
+                if os.path.exists(p):
+                    os.remove(p)
+        if self.verbose:
+            self.logger.info("saved checkpoint %s", prefix)
+
+    def _resume(self, estimator):
+        meta = os.path.join(self.model_dir, f"{self.model_prefix}.meta")
+        if not os.path.exists(meta):
+            return
+        with open(meta) as f:
+            self.trained_epoch = int(f.read().strip())
+        tag = f"epoch{self.trained_epoch}"
+        prefix = self._state_path(tag)
+        if os.path.exists(prefix + ".params"):
+            estimator.net.load_parameters(prefix + ".params")
+            if estimator.trainer is not None and \
+                    os.path.exists(prefix + ".states"):
+                estimator.trainer.load_states(prefix + ".states")
+            self.current_epoch = self.trained_epoch + 1
+            self.logger.info("resumed from checkpoint %s", prefix)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and \
+                self.current_batch % self.batch_period == 0:
+            self._save(estimator, f"batch{self.current_batch}")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.epoch_period and \
+                (self.current_epoch + 1) % self.epoch_period == 0:
+            if self.save_best and self.monitor is not None:
+                _, val = self.monitor.get()
+                if self.monitor_op(val, self.best):
+                    self.best = val
+                    estimator.net.save_parameters(os.path.join(
+                        self.model_dir,
+                        f"{self.model_prefix}-best.params"))
+            self._save(estimator, f"epoch{self.current_epoch}")
+        self.current_epoch += 1
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop training when a monitored metric stops improving."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.baseline = baseline
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        name = getattr(monitor, "name", "")
+        if mode == "min" or (mode == "auto" and "loss" in name):
+            self.monitor_op = onp.less
+        else:
+            self.monitor_op = onp.greater
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.wait = 0
+        self.stopped_epoch = 0
+        self.current_epoch = 0
+        if self.baseline is not None:
+            self.best = self.baseline
+        else:
+            self.best = onp.inf if self.monitor_op == onp.less else -onp.inf
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        _, val = self.monitor.get()
+        if isinstance(val, str):
+            warnings.warn("early stopping requires a numeric metric")
+            return
+        delta = -self.min_delta if self.monitor_op == onp.less else \
+            self.min_delta
+        if self.monitor_op(val - delta, self.best):
+            self.best = val
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = self.current_epoch
+                estimator.stop_training = True
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch > 0:
+            self.logger.info("early stopping at epoch %d",
+                             self.stopped_epoch)
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Apply trainer.step at batch end (parity: the reference moves the
+    optimizer step into a handler so custom handlers can reorder it)."""
+    priority = -2000
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        loss = kwargs.get("loss")
+        batch_size = 0
+        if loss is not None:
+            loss_list = loss if isinstance(loss, (list, tuple)) else [loss]
+            for l in loss_list:
+                batch_size += l.shape[0] if l.ndim > 0 else 1
+        estimator.trainer.step(batch_size or 1)

@@ -1,0 +1,47 @@
+"""gluon.probability — distributions, transformations, KL, and
+stochastic blocks (parity: python/mxnet/gluon/probability/, ~30
+distributions over the numpy frontend).
+
+TPU-first design: distributions are thin parameter holders whose
+log_prob/entropy/KL are mx.np expressions (differentiable, traceable
+into hybridized graphs); sampling lowers to mx.np.random's threefry
+samplers, with reparameterized paths (has_grad) for loc/scale
+families. Typical usage matches the reference:
+
+    import mxnet_tpu.gluon.probability as mgp
+    qz = mgp.Normal(loc, scale)
+    kl = mgp.kl_divergence(qz, mgp.Normal(0, 1))
+"""
+from .distribution import Distribution, ExponentialFamily
+from .continuous import (Normal, LogNormal, Uniform, Exponential, Laplace,
+                         Cauchy, HalfCauchy, HalfNormal, Gamma, Chi2, Beta,
+                         Dirichlet, StudentT, FisherSnedecor, Gumbel,
+                         Weibull, Pareto, MultivariateNormal)
+from .discrete import (Bernoulli, Binomial, Geometric, NegativeBinomial,
+                       Poisson, Categorical, OneHotCategorical, Multinomial,
+                       RelaxedBernoulli, RelaxedOneHotCategorical)
+from .wrappers import Independent, TransformedDistribution
+from .divergence import kl_divergence, register_kl, empirical_kl
+from . import constraint
+from .transformation import (Transformation, ComposeTransform, ExpTransform,
+                             AffineTransform, PowerTransform, AbsTransform,
+                             SigmoidTransform, SoftmaxTransform, biject_to,
+                             transform_to)
+from .stochastic_block import StochasticBlock, StochasticSequential
+
+__all__ = [
+    "Distribution", "ExponentialFamily",
+    "Normal", "LogNormal", "Uniform", "Exponential", "Laplace", "Cauchy",
+    "HalfCauchy", "HalfNormal", "Gamma", "Chi2", "Beta", "Dirichlet",
+    "StudentT", "FisherSnedecor", "Gumbel", "Weibull", "Pareto",
+    "MultivariateNormal",
+    "Bernoulli", "Binomial", "Geometric", "NegativeBinomial", "Poisson",
+    "Categorical", "OneHotCategorical", "Multinomial", "RelaxedBernoulli",
+    "RelaxedOneHotCategorical",
+    "Independent", "TransformedDistribution",
+    "kl_divergence", "register_kl", "empirical_kl", "constraint",
+    "Transformation", "ComposeTransform", "ExpTransform", "AffineTransform",
+    "PowerTransform", "AbsTransform", "SigmoidTransform",
+    "SoftmaxTransform", "biject_to", "transform_to",
+    "StochasticBlock", "StochasticSequential",
+]

@@ -1,0 +1,188 @@
+"""Parameter/support constraints (parity:
+python/mxnet/gluon/probability/distributions/constraint.py).
+
+A Constraint validates values; `check` returns the value (with a
+device-side assertion folded in via where/nan poisoning avoided — here
+validation raises eagerly on host, matching the reference's behavior
+of raising MXNetError from the constraint kernels when validate_args
+is on)."""
+from __future__ import annotations
+
+import numpy as onp
+
+from ... import numpy as np
+from ...base import MXNetError
+
+__all__ = ["Constraint", "Real", "Positive", "NonNegative", "Interval",
+           "UnitInterval", "GreaterThan", "GreaterThanEq", "LessThan",
+           "IntegerInterval", "IntegerGreaterThan", "IntegerGreaterThanEq",
+           "Boolean", "Simplex", "LowerCholesky", "PositiveDefinite",
+           "real", "positive", "nonnegative", "unit_interval", "boolean",
+           "simplex", "lower_cholesky", "positive_definite",
+           "positive_integer", "nonnegative_integer"]
+
+
+class Constraint:
+    def check(self, value):
+        return value
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class Real(Constraint):
+    def check(self, value):
+        host = value.asnumpy() if hasattr(value, "asnumpy") else \
+            onp.asarray(value)
+        if onp.isnan(host).any():
+            raise MXNetError("Constraint violated: value contains NaN")
+        return value
+
+
+class _PredicateConstraint(Constraint):
+    _msg = "constraint violated"
+
+    def _ok(self, host):
+        raise NotImplementedError
+
+    def check(self, value):
+        host = value.asnumpy() if hasattr(value, "asnumpy") else \
+            onp.asarray(value)
+        if not self._ok(host):
+            raise MXNetError(f"Constraint violated: {self._msg}")
+        return value
+
+
+class Positive(_PredicateConstraint):
+    _msg = "value must be > 0"
+
+    def _ok(self, host):
+        return bool((host > 0).all())
+
+
+class NonNegative(_PredicateConstraint):
+    _msg = "value must be >= 0"
+
+    def _ok(self, host):
+        return bool((host >= 0).all())
+
+
+class GreaterThan(_PredicateConstraint):
+    def __init__(self, lower_bound):
+        self._lb = lower_bound
+        self._msg = f"value must be > {lower_bound}"
+
+    def _ok(self, host):
+        lb = self._lb.asnumpy() if hasattr(self._lb, "asnumpy") else self._lb
+        return bool((host > lb).all())
+
+
+class GreaterThanEq(_PredicateConstraint):
+    def __init__(self, lower_bound):
+        self._lb = lower_bound
+        self._msg = f"value must be >= {lower_bound}"
+
+    def _ok(self, host):
+        lb = self._lb.asnumpy() if hasattr(self._lb, "asnumpy") else self._lb
+        return bool((host >= lb).all())
+
+
+class LessThan(_PredicateConstraint):
+    def __init__(self, upper_bound):
+        self._ub = upper_bound
+        self._msg = f"value must be < {upper_bound}"
+
+    def _ok(self, host):
+        ub = self._ub.asnumpy() if hasattr(self._ub, "asnumpy") else self._ub
+        return bool((host < ub).all())
+
+
+class Interval(_PredicateConstraint):
+    def __init__(self, lower_bound, upper_bound):
+        self._lb, self._ub = lower_bound, upper_bound
+        self._msg = f"value must be in ({lower_bound}, {upper_bound})"
+
+    def _ok(self, host):
+        return bool(((host > self._lb) & (host < self._ub)).all())
+
+
+class UnitInterval(_PredicateConstraint):
+    _msg = "value must be in [0, 1]"
+
+    def _ok(self, host):
+        return bool(((host >= 0) & (host <= 1)).all())
+
+
+class Boolean(_PredicateConstraint):
+    _msg = "value must be 0 or 1"
+
+    def _ok(self, host):
+        return bool(((host == 0) | (host == 1)).all())
+
+
+class IntegerInterval(_PredicateConstraint):
+    def __init__(self, lower_bound, upper_bound):
+        self._lb, self._ub = lower_bound, upper_bound
+        self._msg = f"value must be an integer in [{lower_bound}, {upper_bound}]"
+
+    def _ok(self, host):
+        return bool(((host >= self._lb) & (host <= self._ub)
+                     & (host == onp.floor(host))).all())
+
+
+class IntegerGreaterThan(_PredicateConstraint):
+    def __init__(self, lower_bound):
+        self._lb = lower_bound
+        self._msg = f"value must be an integer > {lower_bound}"
+
+    def _ok(self, host):
+        return bool(((host > self._lb) & (host == onp.floor(host))).all())
+
+
+class IntegerGreaterThanEq(_PredicateConstraint):
+    def __init__(self, lower_bound):
+        self._lb = lower_bound
+        self._msg = f"value must be an integer >= {lower_bound}"
+
+    def _ok(self, host):
+        return bool(((host >= self._lb) & (host == onp.floor(host))).all())
+
+
+class Simplex(_PredicateConstraint):
+    _msg = "value must lie on the probability simplex"
+
+    def _ok(self, host):
+        return bool((host >= 0).all()
+                    and onp.allclose(host.sum(-1), 1.0, atol=1e-5))
+
+
+class LowerCholesky(_PredicateConstraint):
+    _msg = "value must be a lower-triangular matrix with positive diagonal"
+
+    def _ok(self, host):
+        tril = onp.tril(host)
+        return bool(onp.allclose(host, tril)
+                    and (onp.diagonal(host, axis1=-2, axis2=-1) > 0).all())
+
+
+class PositiveDefinite(_PredicateConstraint):
+    _msg = "value must be positive definite"
+
+    def _ok(self, host):
+        try:
+            onp.linalg.cholesky(host)
+            return True
+        except onp.linalg.LinAlgError:
+            return False
+
+
+real = Real()
+positive = Positive()
+nonnegative = NonNegative()
+unit_interval = UnitInterval()
+boolean = Boolean()
+simplex = Simplex()
+lower_cholesky = LowerCholesky()
+positive_definite = PositiveDefinite()
+positive_integer = IntegerGreaterThan(0)
+nonnegative_integer = IntegerGreaterThanEq(0)

@@ -1,0 +1,702 @@
+"""Continuous distributions (parity:
+python/mxnet/gluon/probability/distributions/{normal,uniform,
+exponential,laplace,cauchy,half_cauchy,half_normal,gamma,chi2,beta,
+dirichlet,studentT,fishersnedecor,gumbel,weibull,pareto,
+multivariate_normal}.py).
+
+Size semantics follow the reference/NumPy: ``sample(size)`` draws an
+array of shape ``size`` (which must broadcast with the batch shape);
+``size=None`` draws one value per batch element.  Loc/scale families
+sample by reparameterization (standard draw + differentiable affine),
+so pathwise gradients flow (``has_grad``)."""
+from __future__ import annotations
+
+import math
+
+from ... import numpy as np
+from . import constraint
+from .distribution import Distribution, ExponentialFamily
+from .utils import (betaln, cached_property, coerce, digamma, erf, erfinv,
+                    gammaln, sum_right_most)
+
+__all__ = ["Normal", "LogNormal", "Uniform", "Exponential", "Laplace",
+           "Cauchy", "HalfCauchy", "HalfNormal", "Gamma", "Chi2", "Beta",
+           "Dirichlet", "StudentT", "FisherSnedecor", "Gumbel", "Weibull",
+           "Pareto", "MultivariateNormal"]
+
+_LOG_SQRT_2PI = 0.5 * math.log(2 * math.pi)
+_LOG_2 = math.log(2.0)
+
+
+def _bshape(size, *params):
+    """Output shape: size if given, else broadcast of param shapes."""
+    import numpy as onp
+    if size is not None:
+        return (size,) if isinstance(size, int) else tuple(size)
+    shapes = [p.shape for p in params if hasattr(p, "shape")]
+    return onp.broadcast_shapes(*shapes) if shapes else ()
+
+
+class Normal(ExponentialFamily):
+    has_grad = True
+    support = constraint.real
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        z = (value - self.loc) / self.scale
+        return -0.5 * z * z - np.log(self.scale) - _LOG_SQRT_2PI
+
+    def cdf(self, value):
+        return 0.5 * (1 + erf((value - self.loc) /
+                              (self.scale * math.sqrt(2))))
+
+    def icdf(self, value):
+        return self.loc + self.scale * math.sqrt(2) * erfinv(2 * value - 1)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.loc, self.scale)
+        eps = np.random.normal(size=shape)
+        return self.loc + self.scale * eps
+
+    def sample_n(self, size):
+        if isinstance(size, int):
+            size = (size,)
+        return self.sample(tuple(size) + self._batch_shape())
+
+    def broadcast_to(self, batch_shape):
+        return Normal(np.broadcast_to(self.loc, batch_shape),
+                      np.broadcast_to(self.scale, batch_shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return np.square(self.scale)
+
+    def entropy(self):
+        return 0.5 + _LOG_SQRT_2PI + np.log(self.scale)
+
+    @property
+    def _natural_params(self):
+        return (self.loc / np.square(self.scale),
+                -0.5 / np.square(self.scale))
+
+
+class LogNormal(Distribution):
+    has_grad = True
+    support = constraint.positive
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        logx = np.log(value)
+        z = (logx - self.loc) / self.scale
+        return -0.5 * z * z - np.log(self.scale) - _LOG_SQRT_2PI - logx
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.loc, self.scale)
+        eps = np.random.normal(size=shape)
+        return np.exp(self.loc + self.scale * eps)
+
+    @property
+    def mean(self):
+        return np.exp(self.loc + 0.5 * np.square(self.scale))
+
+    @property
+    def variance(self):
+        s2 = np.square(self.scale)
+        return (np.exp(s2) - 1) * np.exp(2 * self.loc + s2)
+
+    def entropy(self):
+        return 0.5 + _LOG_SQRT_2PI + np.log(self.scale) + self.loc
+
+
+class Uniform(Distribution):
+    has_grad = True
+    arg_constraints = {"low": constraint.real, "high": constraint.real}
+
+    def __init__(self, low=0.0, high=1.0, validate_args=None):
+        self.low = coerce(low)
+        self.high = coerce(high)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return constraint.Interval(self.low, self.high)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        span = self.high - self.low
+        inside = np.logical_and(value >= self.low, value < self.high)
+        return np.where(inside, -np.log(span), -np.inf)
+
+    def cdf(self, value):
+        return np.clip((value - self.low) / (self.high - self.low), 0.0, 1.0)
+
+    def icdf(self, value):
+        return self.low + value * (self.high - self.low)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.low, self.high)
+        u = np.random.uniform(size=shape)
+        return self.low + u * (self.high - self.low)
+
+    @property
+    def mean(self):
+        return 0.5 * (self.low + self.high)
+
+    @property
+    def variance(self):
+        return np.square(self.high - self.low) / 12.0
+
+    def entropy(self):
+        return np.log(self.high - self.low)
+
+    def broadcast_to(self, batch_shape):
+        return Uniform(np.broadcast_to(self.low, batch_shape),
+                       np.broadcast_to(self.high, batch_shape))
+
+
+class Exponential(Distribution):
+    has_grad = True
+    support = constraint.nonnegative
+    arg_constraints = {"scale": constraint.positive}
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        return -np.log(self.scale) - value / self.scale
+
+    def cdf(self, value):
+        return 1 - np.exp(-value / self.scale)
+
+    def icdf(self, value):
+        return -self.scale * np.log1p(-value)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.scale)
+        u = np.random.uniform(size=shape)
+        return -self.scale * np.log1p(-u)  # inverse-cdf, differentiable
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return np.square(self.scale)
+
+    def entropy(self):
+        return 1.0 + np.log(self.scale)
+
+
+class Laplace(Distribution):
+    has_grad = True
+    support = constraint.real
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        return -np.abs(value - self.loc) / self.scale - \
+            np.log(2 * self.scale)
+
+    def cdf(self, value):
+        z = (value - self.loc) / self.scale
+        return 0.5 - 0.5 * np.sign(z) * np.expm1(-np.abs(z))
+
+    def icdf(self, value):
+        t = value - 0.5
+        return self.loc - self.scale * np.sign(t) * np.log1p(-2 * np.abs(t))
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.loc, self.scale)
+        u = np.random.uniform(-0.5, 0.5, size=shape)
+        return self.loc - self.scale * np.sign(u) * np.log1p(-2 * np.abs(u))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return 2 * np.square(self.scale)
+
+    def entropy(self):
+        return 1.0 + np.log(2 * self.scale)
+
+
+class Cauchy(Distribution):
+    has_grad = True
+    support = constraint.real
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        z = (value - self.loc) / self.scale
+        return -math.log(math.pi) - np.log(self.scale) - np.log1p(z * z)
+
+    def cdf(self, value):
+        return np.arctan((value - self.loc) / self.scale) / math.pi + 0.5
+
+    def icdf(self, value):
+        return self.loc + self.scale * np.tan(math.pi * (value - 0.5))
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.loc, self.scale)
+        u = np.random.uniform(size=shape)
+        return self.icdf(u)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    def entropy(self):
+        return math.log(4 * math.pi) + np.log(self.scale)
+
+
+class HalfCauchy(Distribution):
+    has_grad = True
+    support = constraint.nonnegative
+    arg_constraints = {"scale": constraint.positive}
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        z = value / self.scale
+        return _LOG_2 - math.log(math.pi) - np.log(self.scale) - \
+            np.log1p(z * z)
+
+    def cdf(self, value):
+        return 2 * np.arctan(value / self.scale) / math.pi
+
+    def icdf(self, value):
+        return self.scale * np.tan(math.pi * value / 2)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.scale)
+        return np.abs(Cauchy(0.0, self.scale).sample(
+            shape if shape else None))
+
+
+class HalfNormal(Distribution):
+    has_grad = True
+    support = constraint.nonnegative
+    arg_constraints = {"scale": constraint.positive}
+
+    def __init__(self, scale=1.0, validate_args=None):
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        z = value / self.scale
+        return _LOG_2 - 0.5 * z * z - np.log(self.scale) - _LOG_SQRT_2PI
+
+    def cdf(self, value):
+        return erf(value / (self.scale * math.sqrt(2)))
+
+    def icdf(self, value):
+        return self.scale * math.sqrt(2) * erfinv(value)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.scale)
+        return np.abs(self.scale * np.random.normal(size=shape))
+
+    @property
+    def mean(self):
+        return self.scale * math.sqrt(2 / math.pi)
+
+    @property
+    def variance(self):
+        return np.square(self.scale) * (1 - 2 / math.pi)
+
+
+class Gamma(ExponentialFamily):
+    support = constraint.positive
+    arg_constraints = {"shape": constraint.positive,
+                       "scale": constraint.positive}
+
+    def __init__(self, shape=1.0, scale=1.0, validate_args=None):
+        self.shape = coerce(shape)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        a, t = self.shape, self.scale
+        return (a - 1) * np.log(value) - value / t - gammaln(a) - \
+            a * np.log(t)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.shape, self.scale)
+        return np.random.gamma(self.shape, self.scale,
+                               size=shape if shape else None)
+
+    @property
+    def mean(self):
+        return self.shape * self.scale
+
+    @property
+    def variance(self):
+        return self.shape * np.square(self.scale)
+
+    def entropy(self):
+        a = self.shape
+        return a + np.log(self.scale) + gammaln(a) + (1 - a) * digamma(a)
+
+
+class Chi2(Gamma):
+    arg_constraints = {"df": constraint.positive}
+
+    def __init__(self, df, validate_args=None):
+        self.df = coerce(df)
+        super().__init__(shape=self.df / 2, scale=coerce(2.0),
+                         validate_args=validate_args)
+
+
+class Beta(ExponentialFamily):
+    support = constraint.unit_interval
+    arg_constraints = {"alpha": constraint.positive,
+                       "beta": constraint.positive}
+
+    def __init__(self, alpha, beta, validate_args=None):
+        self.alpha = coerce(alpha)
+        self.beta = coerce(beta)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        a, b = self.alpha, self.beta
+        return (a - 1) * np.log(value) + (b - 1) * np.log1p(-value) - \
+            betaln(a, b)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.alpha, self.beta)
+        return np.random.beta(self.alpha, self.beta,
+                              size=shape if shape else None)
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (np.square(s) * (s + 1))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return betaln(a, b) - (a - 1) * digamma(a) - (b - 1) * digamma(b) \
+            + (a + b - 2) * digamma(a + b)
+
+
+class Dirichlet(ExponentialFamily):
+    support = constraint.simplex
+    arg_constraints = {"alpha": constraint.positive}
+
+    def __init__(self, alpha, validate_args=None):
+        self.alpha = coerce(alpha)
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        a = self.alpha
+        return np.sum((a - 1) * np.log(value), axis=-1) + \
+            gammaln(np.sum(a, axis=-1)) - np.sum(gammaln(a), axis=-1)
+
+    def sample(self, size=None):
+        # normalized gammas (the standard construction)
+        if size is None:
+            shape = self.alpha.shape
+        else:
+            shape = ((size,) if isinstance(size, int) else tuple(size)) + \
+                (self.alpha.shape[-1],)
+        g = np.random.gamma(np.broadcast_to(self.alpha, shape), 1.0)
+        return g / np.sum(g, axis=-1, keepdims=True)
+
+    @property
+    def mean(self):
+        return self.alpha / np.sum(self.alpha, axis=-1, keepdims=True)
+
+    @property
+    def variance(self):
+        a0 = np.sum(self.alpha, axis=-1, keepdims=True)
+        m = self.alpha / a0
+        return m * (1 - m) / (a0 + 1)
+
+    def entropy(self):
+        a = self.alpha
+        a0 = np.sum(a, axis=-1)
+        k = a.shape[-1]
+        return np.sum(gammaln(a), axis=-1) - gammaln(a0) + \
+            (a0 - k) * digamma(a0) - \
+            np.sum((a - 1) * digamma(a), axis=-1)
+
+
+class StudentT(Distribution):
+    support = constraint.real
+    arg_constraints = {"df": constraint.positive,
+                       "loc": constraint.real,
+                       "scale": constraint.positive}
+
+    def __init__(self, df, loc=0.0, scale=1.0, validate_args=None):
+        self.df = coerce(df)
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        df, mu, s = self.df, self.loc, self.scale
+        z = (value - mu) / s
+        return gammaln((df + 1) / 2) - gammaln(df / 2) - \
+            0.5 * np.log(df * math.pi) - np.log(s) - \
+            (df + 1) / 2 * np.log1p(z * z / df)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.df, self.loc, self.scale)
+        n = np.random.normal(size=shape)
+        g = np.random.chisquare(np.broadcast_to(self.df, shape)
+                                if shape else self.df, size=shape or None)
+        return self.loc + self.scale * n * np.sqrt(self.df / g)
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return np.square(self.scale) * self.df / (self.df - 2)
+
+
+class FisherSnedecor(Distribution):
+    support = constraint.positive
+    arg_constraints = {"df1": constraint.positive,
+                       "df2": constraint.positive}
+
+    def __init__(self, df1, df2, validate_args=None):
+        self.df1 = coerce(df1)
+        self.df2 = coerce(df2)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        d1, d2 = self.df1, self.df2
+        return (d1 / 2) * np.log(d1) + (d2 / 2) * np.log(d2) + \
+            (d1 / 2 - 1) * np.log(value) - \
+            ((d1 + d2) / 2) * np.log(d2 + d1 * value) - \
+            betaln(d1 / 2, d2 / 2)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.df1, self.df2)
+        return np.random.f(self.df1, self.df2, size=shape if shape else None)
+
+    @property
+    def mean(self):
+        return self.df2 / (self.df2 - 2)
+
+
+class Gumbel(Distribution):
+    has_grad = True
+    support = constraint.real
+    arg_constraints = {"loc": constraint.real, "scale": constraint.positive}
+
+    def __init__(self, loc=0.0, scale=1.0, validate_args=None):
+        self.loc = coerce(loc)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        z = (value - self.loc) / self.scale
+        return -(z + np.exp(-z)) - np.log(self.scale)
+
+    def cdf(self, value):
+        return np.exp(-np.exp(-(value - self.loc) / self.scale))
+
+    def icdf(self, value):
+        return self.loc - self.scale * np.log(-np.log(value))
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.loc, self.scale)
+        u = np.random.uniform(size=shape)
+        return self.icdf(u)
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * 0.57721566490153286  # Euler γ
+
+    @property
+    def variance(self):
+        return np.square(self.scale) * (math.pi ** 2) / 6
+
+    def entropy(self):
+        return np.log(self.scale) + 1.0 + 0.57721566490153286
+
+
+class Weibull(Distribution):
+    has_grad = True
+    support = constraint.positive
+    arg_constraints = {"concentration": constraint.positive,
+                       "scale": constraint.positive}
+
+    def __init__(self, concentration, scale=1.0, validate_args=None):
+        self.concentration = coerce(concentration)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        k, lam = self.concentration, self.scale
+        return np.log(k) - np.log(lam) + (k - 1) * (np.log(value) -
+                                                    np.log(lam)) - \
+            np.power(value / lam, k)
+
+    def cdf(self, value):
+        return 1 - np.exp(-np.power(value / self.scale, self.concentration))
+
+    def icdf(self, value):
+        return self.scale * np.power(-np.log1p(-value),
+                                     1 / self.concentration)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.concentration, self.scale)
+        u = np.random.uniform(size=shape)
+        return self.icdf(u)
+
+    @property
+    def mean(self):
+        return self.scale * np.exp(gammaln(1 + 1 / self.concentration))
+
+
+class Pareto(Distribution):
+    has_grad = True
+    arg_constraints = {"alpha": constraint.positive,
+                       "scale": constraint.positive}
+
+    def __init__(self, alpha, scale=1.0, validate_args=None):
+        self.alpha = coerce(alpha)
+        self.scale = coerce(scale)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @property
+    def support(self):
+        return constraint.GreaterThanEq(self.scale)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        a, m = self.alpha, self.scale
+        return np.log(a) + a * np.log(m) - (a + 1) * np.log(value)
+
+    def cdf(self, value):
+        return 1 - np.power(self.scale / value, self.alpha)
+
+    def icdf(self, value):
+        return self.scale * np.power(1 - value, -1 / self.alpha)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.alpha, self.scale)
+        u = np.random.uniform(size=shape)
+        return self.icdf(u)
+
+    @property
+    def mean(self):
+        return self.alpha * self.scale / (self.alpha - 1)
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    support = constraint.real
+    arg_constraints = {"loc": constraint.real}
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None,
+                 validate_args=None):
+        self.loc = coerce(loc)
+        given = sum(p is not None for p in (cov, precision, scale_tril))
+        if given != 1:
+            raise ValueError("exactly one of cov, precision, scale_tril "
+                             "must be given")
+        if cov is not None:
+            self.cov = coerce(cov)
+            self.scale_tril = np.linalg.cholesky(self.cov)
+        elif precision is not None:
+            self.precision = coerce(precision)
+            self.cov = np.linalg.inv(self.precision)
+            self.scale_tril = np.linalg.cholesky(self.cov)
+        else:
+            self.scale_tril = coerce(scale_tril)
+            self.cov = np.matmul(self.scale_tril,
+                                 np.swapaxes(self.scale_tril, -1, -2))
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    def log_prob(self, value):
+        d = self.loc.shape[-1]
+        diff = value - self.loc
+        # solve L y = diff, then |y|^2 is the Mahalanobis term
+        y = np.linalg.solve(self.scale_tril,
+                            np.expand_dims(diff, -1))[..., 0]
+        half_log_det = np.sum(np.log(np.diagonal(self.scale_tril,
+                                                 axis1=-2, axis2=-1)),
+                              axis=-1)
+        return -0.5 * np.sum(np.square(y), axis=-1) - half_log_det - \
+            0.5 * d * math.log(2 * math.pi)
+
+    def sample(self, size=None):
+        if size is None:
+            shape = self.loc.shape
+        else:
+            shape = ((size,) if isinstance(size, int) else tuple(size))
+            if not shape or shape[-1] != self.loc.shape[-1]:
+                shape = shape + (self.loc.shape[-1],)
+        eps = np.random.normal(size=shape)
+        return self.loc + np.matmul(np.expand_dims(eps, -2),
+                                    np.swapaxes(self.scale_tril, -1, -2)
+                                    )[..., 0, :]
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return np.diagonal(self.cov, axis1=-2, axis2=-1)
+
+    def entropy(self):
+        d = self.loc.shape[-1]
+        half_log_det = np.sum(np.log(np.diagonal(self.scale_tril,
+                                                 axis1=-2, axis2=-1)),
+                              axis=-1)
+        return 0.5 * d * (1 + math.log(2 * math.pi)) + half_log_det

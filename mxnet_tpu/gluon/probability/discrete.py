@@ -1,0 +1,471 @@
+"""Discrete distributions (parity:
+python/mxnet/gluon/probability/distributions/{bernoulli,binomial,
+geometric,negative_binomial,poisson,categorical,one_hot_categorical,
+multinomial,relaxed_bernoulli,relaxed_one_hot_categorical}.py).
+
+Parameterization follows the reference: each distribution accepts
+either ``prob`` or ``logit`` (exactly one), with the other derived
+lazily via cached_property."""
+from __future__ import annotations
+
+import math
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from . import constraint
+from .distribution import Distribution, ExponentialFamily
+from .utils import (cached_property, coerce, gammaln, logit2prob,
+                    prob2logit, softplus, xlogy)
+
+__all__ = ["Bernoulli", "Binomial", "Geometric", "NegativeBinomial",
+           "Poisson", "Categorical", "OneHotCategorical", "Multinomial",
+           "RelaxedBernoulli", "RelaxedOneHotCategorical"]
+
+
+def _check_prob_logit(prob, logit):
+    if (prob is None) == (logit is None):
+        raise ValueError(
+            "Either `prob` or `logit` must be specified, but not both.")
+
+
+def _bshape(size, *params):
+    import numpy as onp
+    if size is not None:
+        return (size,) if isinstance(size, int) else tuple(size)
+    shapes = [p.shape for p in params if hasattr(p, "shape")]
+    return onp.broadcast_shapes(*shapes) if shapes else ()
+
+
+class Bernoulli(ExponentialFamily):
+    support = constraint.boolean
+    has_enumerate_support = True
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        _check_prob_logit(prob, logit)
+        if prob is not None:
+            self.prob = coerce(prob)
+        else:
+            self.logit = coerce(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=True)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        # value*logit - softplus(logit): stable binary cross-entropy
+        lg = self.logit
+        return value * lg - softplus(lg)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.prob)
+        u = np.random.uniform(size=shape)
+        return (u < self.prob).astype("float32")
+
+    def enumerate_support(self):
+        return np.array([0.0, 1.0])
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+    def entropy(self):
+        lg = self.logit
+        return softplus(lg) - self.prob * lg
+
+    def broadcast_to(self, batch_shape):
+        return Bernoulli(prob=np.broadcast_to(self.prob, batch_shape))
+
+
+class Binomial(Distribution):
+    def __init__(self, n=1, prob=None, logit=None, validate_args=None):
+        _check_prob_logit(prob, logit)
+        self.n = coerce(n)
+        if prob is not None:
+            self.prob = coerce(prob)
+        else:
+            self.logit = coerce(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=True)
+
+    @property
+    def support(self):
+        return constraint.IntegerInterval(0, self.n)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        n, p = self.n, self.prob
+        binom = gammaln(n + 1) - gammaln(value + 1) - \
+            gammaln(n - value + 1)
+        return binom + xlogy(value, p) + xlogy(n - value, 1 - p)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.n, self.prob)
+        return np.random.binomial(self.n, self.prob,
+                                  size=shape if shape else None
+                                  ).astype("float32")
+
+    @property
+    def mean(self):
+        return self.n * self.prob
+
+    @property
+    def variance(self):
+        return self.n * self.prob * (1 - self.prob)
+
+
+class Geometric(Distribution):
+    support = constraint.nonnegative_integer
+
+    def __init__(self, prob=None, logit=None, validate_args=None):
+        _check_prob_logit(prob, logit)
+        if prob is not None:
+            self.prob = coerce(prob)
+        else:
+            self.logit = coerce(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=True)
+
+    def log_prob(self, value):
+        """P(X=k) = (1-p)^k p, k = number of failures before success."""
+        self._validate_sample(value)
+        return value * np.log1p(-self.prob) + np.log(self.prob)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.prob)
+        u = np.random.uniform(size=shape)
+        return np.floor(np.log1p(-u) / np.log1p(-self.prob))
+
+    @property
+    def mean(self):
+        return (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return (1 - self.prob) / np.square(self.prob)
+
+    def entropy(self):
+        p = self.prob
+        return -(xlogy(1 - p, 1 - p) + xlogy(p, p)) / p
+
+
+class NegativeBinomial(Distribution):
+    support = constraint.nonnegative_integer
+
+    def __init__(self, n, prob=None, logit=None, validate_args=None):
+        _check_prob_logit(prob, logit)
+        self.n = coerce(n)
+        if prob is not None:
+            self.prob = coerce(prob)
+        else:
+            self.logit = coerce(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=True)
+
+    def log_prob(self, value):
+        """P(X=k) = C(k+n-1, k) p^n (1-p)^k (k failures, success prob p)."""
+        self._validate_sample(value)
+        n, p = self.n, self.prob
+        comb = gammaln(value + n) - gammaln(value + 1) - gammaln(n)
+        return comb + n * np.log(p) + value * np.log1p(-p)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.n, self.prob)
+        return np.random.negative_binomial(
+            self.n, self.prob, size=shape if shape else None
+        ).astype("float32")
+
+    @property
+    def mean(self):
+        return self.n * (1 - self.prob) / self.prob
+
+    @property
+    def variance(self):
+        return self.n * (1 - self.prob) / np.square(self.prob)
+
+
+class Poisson(ExponentialFamily):
+    support = constraint.nonnegative_integer
+    arg_constraints = {"rate": constraint.positive}
+
+    def __init__(self, rate=1.0, validate_args=None):
+        self.rate = coerce(rate)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        return xlogy(value, self.rate) - self.rate - gammaln(value + 1)
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.rate)
+        return np.random.poisson(self.rate, size=shape if shape else None
+                                 ).astype("float32")
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Categorical(Distribution):
+    has_enumerate_support = True
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        _check_prob_logit(prob, logit)
+        if prob is not None:
+            self.prob = coerce(prob)
+            num_events = self.prob.shape[-1]
+        else:
+            self.logit = coerce(logit)
+            num_events = self.logit.shape[-1]
+        self.num_events = num_events
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=False)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=False)
+
+    @property
+    def support(self):
+        return constraint.IntegerInterval(0, self.num_events - 1)
+
+    def log_prob(self, value):
+        self._validate_sample(value)
+        logp = npx.log_softmax(self.logit, axis=-1)
+        return npx.pick(logp, value.astype("int32"), axis=-1)
+
+    def sample(self, size=None):
+        logit = self.logit
+        shape = _bshape(size, logit[..., 0])
+        u = np.random.uniform(size=tuple(shape) + (self.num_events,),
+                              dtype="float32")
+        g = -np.log(-np.log(u))  # Gumbel-max trick
+        return np.argmax(logit + g, axis=-1).astype("float32")
+
+    def enumerate_support(self):
+        return np.arange(self.num_events)
+
+    @property
+    def mean(self):
+        raise ValueError("Categorical distribution has no mean")
+
+    def entropy(self):
+        logp = npx.log_softmax(self.logit, axis=-1)
+        return -np.sum(np.exp(logp) * logp, axis=-1)
+
+    def broadcast_to(self, batch_shape):
+        return Categorical(
+            num_events=self.num_events,
+            prob=np.broadcast_to(self.prob,
+                                 tuple(batch_shape) + (self.num_events,)))
+
+
+class OneHotCategorical(Distribution):
+    has_enumerate_support = True
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        self._cat = Categorical(num_events, prob, logit)
+        self.num_events = self._cat.num_events
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @property
+    def prob(self):
+        return self._cat.prob
+
+    @property
+    def logit(self):
+        return self._cat.logit
+
+    def log_prob(self, value):
+        logp = npx.log_softmax(self.logit, axis=-1)
+        return np.sum(value * logp, axis=-1)
+
+    def sample(self, size=None):
+        idx = self._cat.sample(size)
+        return npx.one_hot(idx.astype("int32"), self.num_events
+                           ).astype("float32")
+
+    def enumerate_support(self):
+        return np.array(
+            [[1.0 if j == i else 0.0 for j in range(self.num_events)]
+             for i in range(self.num_events)])
+
+    @property
+    def mean(self):
+        return self.prob
+
+    @property
+    def variance(self):
+        return self.prob * (1 - self.prob)
+
+
+class Multinomial(Distribution):
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, validate_args=None):
+        _check_prob_logit(prob, logit)
+        if prob is not None:
+            self.prob = coerce(prob)
+            num_events = self.prob.shape[-1]
+        else:
+            self.logit = coerce(logit)
+            num_events = self.logit.shape[-1]
+        self.num_events = num_events
+        self.total_count = total_count
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=False)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=False)
+
+    def log_prob(self, value):
+        n = np.sum(value, axis=-1)
+        return gammaln(n + 1) - np.sum(gammaln(value + 1), axis=-1) + \
+            np.sum(xlogy(value, self.prob), axis=-1)
+
+    def sample(self, size=None):
+        import numpy as onp
+        host_p = self.prob.asnumpy()
+        host_p = host_p / host_p.sum(-1, keepdims=True)
+        if host_p.ndim == 1:
+            shape = (size,) if isinstance(size, int) else \
+                (tuple(size) if size else ())
+            draws = onp.random.multinomial(self.total_count, host_p,
+                                           size=shape or None)
+            return np.array(draws.astype(onp.float32))
+        flat = host_p.reshape(-1, host_p.shape[-1])
+        draws = onp.stack([onp.random.multinomial(self.total_count, p)
+                           for p in flat])
+        return np.array(draws.reshape(host_p.shape).astype(onp.float32))
+
+    @property
+    def mean(self):
+        return self.total_count * self.prob
+
+    @property
+    def variance(self):
+        return self.total_count * self.prob * (1 - self.prob)
+
+
+class RelaxedBernoulli(Distribution):
+    """Binary Concrete distribution (Maddison et al. 2017) — a
+    continuous, reparameterizable relaxation of Bernoulli."""
+    has_grad = True
+    support = constraint.unit_interval
+
+    def __init__(self, T=1.0, prob=None, logit=None, validate_args=None):
+        _check_prob_logit(prob, logit)
+        self.T = coerce(T)
+        if prob is not None:
+            self.prob = coerce(prob)
+        else:
+            self.logit = coerce(logit)
+        super().__init__(event_dim=0, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=True)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=True)
+
+    def log_prob(self, value):
+        t, lg = self.T, self.logit
+        logv = np.log(value)
+        log1mv = np.log1p(-value)
+        diff = lg - t * (logv - log1mv)
+        return np.log(t) + diff - 2 * softplus(diff) - logv - log1mv
+
+    def sample(self, size=None):
+        shape = _bshape(size, self.prob)
+        u = np.random.uniform(1e-7, 1 - 1e-7, size=shape)
+        logistic = np.log(u) - np.log1p(-u)
+        return npx.sigmoid((self.logit + logistic) / self.T)
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Concrete distribution over the simplex (Gumbel-softmax)."""
+    has_grad = True
+    support = constraint.simplex
+
+    def __init__(self, T=1.0, num_events=None, prob=None, logit=None,
+                 validate_args=None):
+        _check_prob_logit(prob, logit)
+        self.T = coerce(T)
+        if prob is not None:
+            self.prob = coerce(prob)
+            num_events = self.prob.shape[-1]
+        else:
+            self.logit = coerce(logit)
+            num_events = self.logit.shape[-1]
+        self.num_events = num_events
+        super().__init__(event_dim=1, validate_args=validate_args)
+
+    @cached_property
+    def prob(self):
+        return logit2prob(self.logit, binary=False)
+
+    @cached_property
+    def logit(self):
+        return prob2logit(self.prob, binary=False)
+
+    def log_prob(self, value):
+        # Concrete density (Maddison et al. 2017, eq. 6):
+        # log[(k-1)! T^(k-1)] + Σ(logit_i − (T+1)·log x_i)
+        #   − k·log Σ exp(logit_i) x_i^(−T)
+        k = self.num_events
+        t, lg = self.T, self.logit
+        log_scale = gammaln(coerce(float(k))) + (k - 1) * np.log(t)
+        return log_scale + np.sum(lg - (t + 1) * np.log(value), axis=-1) - \
+            k * np.log(np.sum(np.exp(lg) * np.power(value, -t), axis=-1))
+
+    def sample(self, size=None):
+        logit = self.logit
+        shape = _bshape(size, logit[..., 0])
+        u = np.random.uniform(1e-7, 1 - 1e-7,
+                              size=tuple(shape) + (self.num_events,))
+        g = -np.log(-np.log(u))
+        return npx.softmax((logit + g) / self.T, axis=-1)

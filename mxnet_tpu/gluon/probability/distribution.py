@@ -1,0 +1,128 @@
+"""Distribution base classes (parity:
+python/mxnet/gluon/probability/distributions/distribution.py and
+exp_family.py).
+
+TPU-first notes: parameters are NDArrays; every log_prob/cdf/entropy is
+a composition of mx.np ops, so it is differentiable under
+autograd.record() and traceable under hybridize. Sampling lowers to
+mx.np.random (JAX threefry keys under the hood); loc/scale families
+sample by reparameterization so rsample-style pathwise gradients flow
+(`has_grad = True`)."""
+from __future__ import annotations
+
+from ... import numpy as np
+from .utils import cached_property  # noqa: F401 (re-export)
+
+
+class Distribution:
+    """Base class for probability distributions."""
+
+    has_grad = False
+    has_enumerate_support = False
+    support = None
+    arg_constraints = {}
+    _validate_args = False
+
+    @staticmethod
+    def set_default_validate_args(value):
+        if value not in (True, False):
+            raise ValueError("validate_args must be True or False")
+        Distribution._validate_args = value
+
+    def __init__(self, event_dim=None, validate_args=None):
+        self.event_dim = event_dim or 0
+        if validate_args is not None:
+            self._validate_args = validate_args
+        if self._validate_args:
+            for param, constraint in self.arg_constraints.items():
+                val = getattr(self, param, None)
+                if val is not None and not isinstance(
+                        getattr(type(self), param, None), cached_property):
+                    constraint.check(val)
+
+    # -- core interface -------------------------------------------------
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def pdf(self, value):
+        return np.exp(self.log_prob(value))
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size):
+        """n samples stacked on a new leading axis."""
+        if isinstance(size, int):
+            size = (size,)
+        batch = self._batch_shape()
+        return self.sample(tuple(size) + tuple(batch))
+
+    def broadcast_to(self, batch_shape):
+        raise NotImplementedError
+
+    def enumerate_support(self):
+        raise NotImplementedError
+
+    # -- moments --------------------------------------------------------
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return np.sqrt(self.variance)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return np.exp(self.entropy())
+
+    # -- helpers --------------------------------------------------------
+    def _batch_shape(self):
+        """Broadcast shape of the distribution parameters."""
+        import numpy as onp
+        shapes = []
+        for name in self.arg_constraints:
+            v = self.__dict__.get(name)
+            if v is not None and hasattr(v, "shape"):
+                shapes.append(v.shape)
+        return onp.broadcast_shapes(*shapes) if shapes else ()
+
+    def _validate_sample(self, value):
+        if self._validate_args and self.support is not None:
+            self.support.check(value)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}" for k in self.arg_constraints)
+        return f"{type(self).__name__}({args})"
+
+
+class ExponentialFamily(Distribution):
+    """Distributions expressible as h(x) exp(η·T(x) − A(η)).
+
+    Provides the Bregman-divergence entropy path used by the reference
+    (exp_family.py): entropy computed from natural parameters via
+    autograd of the log-normalizer. Subclasses here implement entropy
+    directly instead (cheaper under XLA), but keep the natural-params
+    hooks for parity."""
+
+    @property
+    def _natural_params(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        raise NotImplementedError

@@ -1,0 +1,169 @@
+"""KL divergence registry (parity:
+python/mxnet/gluon/probability/distributions/divergence.py).
+
+``kl_divergence(p, q)`` dispatches on (type(p), type(q)) through the
+``register_kl`` table, walking each side's MRO so subclasses (e.g.
+Chi2 → Gamma) reuse parent rules. ``empirical_kl`` is the Monte-Carlo
+fallback."""
+from __future__ import annotations
+
+import math
+
+from ... import numpy as np
+from .utils import betaln, digamma, gammaln
+from . import continuous as C
+from . import discrete as D
+from .wrappers import Independent
+from .utils import sum_right_most
+
+__all__ = ["kl_divergence", "register_kl", "empirical_kl"]
+
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return decorator
+
+
+def _dispatch(p, q):
+    for tp in type(p).__mro__:
+        for tq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((tp, tq))
+            if fn is not None:
+                return fn
+    return None
+
+
+def kl_divergence(p, q):
+    """KL(p ‖ q). Raises NotImplementedError when no closed form is
+    registered (use empirical_kl then)."""
+    fn = _dispatch(p, q)
+    if fn is None:
+        raise NotImplementedError(
+            f"no registered KL({type(p).__name__} || "
+            f"{type(q).__name__}); use empirical_kl")
+    return fn(p, q)
+
+
+def empirical_kl(p, q, n_samples=1000):
+    """Monte-Carlo KL estimate E_p[log p(x) − log q(x)]."""
+    x = p.sample_n(n_samples)
+    return np.mean(p.log_prob(x) - q.log_prob(x), axis=0)
+
+
+@register_kl(C.Normal, C.Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = np.square(p.scale / q.scale)
+    t1 = np.square((p.loc - q.loc) / q.scale)
+    return 0.5 * (var_ratio + t1 - 1 - np.log(var_ratio))
+
+
+@register_kl(C.Uniform, C.Uniform)
+def _kl_uniform_uniform(p, q):
+    return np.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(C.Exponential, C.Exponential)
+def _kl_exponential_exponential(p, q):
+    # scale parameterization: rate = 1/scale
+    ratio = q.scale / p.scale  # λp/λq with λ = 1/scale
+    return np.log(ratio) + 1.0 / ratio - 1.0
+
+
+@register_kl(C.Gamma, C.Gamma)
+def _kl_gamma_gamma(p, q):
+    a_p, t_p = p.shape, p.scale
+    a_q, t_q = q.shape, q.scale
+    return (a_p - a_q) * digamma(a_p) - gammaln(a_p) + gammaln(a_q) + \
+        a_q * (np.log(t_q) - np.log(t_p)) + a_p * (t_p / t_q - 1)
+
+
+@register_kl(C.Beta, C.Beta)
+def _kl_beta_beta(p, q):
+    sum_p = p.alpha + p.beta
+    return betaln(q.alpha, q.beta) - betaln(p.alpha, p.beta) + \
+        (p.alpha - q.alpha) * digamma(p.alpha) + \
+        (p.beta - q.beta) * digamma(p.beta) + \
+        (q.alpha - p.alpha + q.beta - p.beta) * digamma(sum_p)
+
+
+@register_kl(C.Dirichlet, C.Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a_p, a_q = p.alpha, q.alpha
+    a0_p = np.sum(a_p, axis=-1)
+    return gammaln(a0_p) - np.sum(gammaln(a_p), axis=-1) - \
+        gammaln(np.sum(a_q, axis=-1)) + np.sum(gammaln(a_q), axis=-1) + \
+        np.sum((a_p - a_q) * (digamma(a_p) -
+                              np.expand_dims(digamma(a0_p), -1)), axis=-1)
+
+
+@register_kl(C.Laplace, C.Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_abs_diff = np.abs(p.loc - q.loc)
+    t1 = -np.log(scale_ratio)
+    t2 = loc_abs_diff / q.scale
+    t3 = scale_ratio * np.exp(-loc_abs_diff / p.scale)
+    return t1 + t2 + t3 - 1
+
+
+@register_kl(D.Bernoulli, D.Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    from .utils import xlogy
+    pp, qq = p.prob, q.prob
+    return xlogy(pp, pp / qq) + xlogy(1 - pp, (1 - pp) / (1 - qq))
+
+
+@register_kl(D.Categorical, D.Categorical)
+def _kl_categorical_categorical(p, q):
+    from ... import numpy_extension as npx
+    logp = npx.log_softmax(p.logit, axis=-1)
+    logq = npx.log_softmax(q.logit, axis=-1)
+    return np.sum(np.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(D.OneHotCategorical, D.OneHotCategorical)
+def _kl_onehot_onehot(p, q):
+    return _kl_categorical_categorical(p._cat, q._cat)
+
+
+@register_kl(D.Poisson, D.Poisson)
+def _kl_poisson_poisson(p, q):
+    return p.rate * (np.log(p.rate) - np.log(q.rate)) - p.rate + q.rate
+
+
+@register_kl(D.Geometric, D.Geometric)
+def _kl_geometric_geometric(p, q):
+    return (-p.entropy()) - np.log(q.prob) - \
+        (1 - p.prob) / p.prob * np.log1p(-q.prob)
+
+
+@register_kl(C.HalfNormal, C.HalfNormal)
+def _kl_halfnormal_halfnormal(p, q):
+    var_ratio = np.square(p.scale / q.scale)
+    return 0.5 * (var_ratio - 1 - np.log(var_ratio))
+
+
+@register_kl(C.MultivariateNormal, C.MultivariateNormal)
+def _kl_mvn_mvn(p, q):
+    d = p.loc.shape[-1]
+    q_inv = np.linalg.inv(q.cov)
+    diff = q.loc - p.loc
+    tr = np.trace(np.matmul(q_inv, p.cov), axis1=-2, axis2=-1)
+    maha = np.sum(diff * np.matmul(
+        q_inv, np.expand_dims(diff, -1))[..., 0], axis=-1)
+    _, logdet_p = np.linalg.slogdet(p.cov)
+    _, logdet_q = np.linalg.slogdet(q.cov)
+    return 0.5 * (tr + maha - d + logdet_q - logdet_p)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent_independent(p, q):
+    if p.reinterpreted_batch_ndims != q.reinterpreted_batch_ndims:
+        raise NotImplementedError(
+            "KL between Independents with different event dims")
+    inner = kl_divergence(p.base_dist, q.base_dist)
+    return sum_right_most(inner, p.reinterpreted_batch_ndims)

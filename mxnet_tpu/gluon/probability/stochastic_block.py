@@ -1,0 +1,84 @@
+"""StochasticBlock / StochasticSequential (parity:
+python/mxnet/gluon/probability/block/stochastic_block.py).
+
+A HybridBlock that accumulates auxiliary losses (e.g. per-layer KL
+terms in a Bayesian net) during forward; decorate forward with
+``StochasticBlock.collectLoss`` and call ``self.add_loss(...)`` inside
+it, then read ``block.losses`` after the call."""
+from __future__ import annotations
+
+from functools import wraps
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(func):
+        @wraps(func)
+        def inner(self, *args, **kwargs):
+            func_out = func(self, *args, **kwargs)
+            collected = self._losscache
+            self._losscache = []
+            self._flag = True
+            return (func_out, collected)
+        return inner
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag:
+            raise ValueError(
+                "the forward function of a StochasticBlock must be "
+                "decorated with StochasticBlock.collectLoss")
+        self._losses = out[1]
+        return out[0]
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container that also gathers child StochasticBlock
+    losses in call order."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for blk in self._layers:
+            x = blk(x)
+            if isinstance(blk, StochasticBlock):
+                for l in blk.losses:
+                    self.add_loss(l)
+        return x
+
+    def __getitem__(self, key):
+        return self._layers[key]
+
+    def __len__(self):
+        return len(self._layers)
+
+    def __repr__(self):
+        inner = "\n".join(f"  ({i}): {b!r}"
+                          for i, b in enumerate(self._layers))
+        return f"{type(self).__name__}(\n{inner}\n)"

@@ -1,0 +1,195 @@
+"""Bijective transformations (parity:
+python/mxnet/gluon/probability/transformation/transformation.py and
+domain_map.py).
+
+A Transformation maps samples x → y with a tractable
+log|det ∂y/∂x|; TransformedDistribution composes them with a base
+distribution. `biject_to`/`transform_to` map constraints to
+transformations (domain_map parity)."""
+from __future__ import annotations
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+from . import constraint as _c
+from .utils import softplus, sum_right_most
+
+__all__ = ["Transformation", "ComposeTransform", "ExpTransform",
+           "AffineTransform", "PowerTransform", "AbsTransform",
+           "SigmoidTransform", "SoftmaxTransform", "biject_to",
+           "transform_to"]
+
+
+class Transformation:
+    """Base bijector: y = f(x), with log|det J| for density transport."""
+    bijective = True
+    event_dim = 0
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        return _InverseTransformation(self)
+
+    def log_det_jacobian(self, x, y):
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    def __init__(self, forward):
+        self._fwd = forward
+        self.event_dim = forward.event_dim
+
+    def _forward_compute(self, x):
+        return self._fwd._inverse_compute(x)
+
+    def _inverse_compute(self, y):
+        return self._fwd._forward_compute(y)
+
+    @property
+    def inv(self):
+        return self._fwd
+
+    def log_det_jacobian(self, x, y):
+        return -self._fwd.log_det_jacobian(y, x)
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self._parts = list(parts)
+        self.event_dim = max((p.event_dim for p in self._parts), default=0)
+
+    def _forward_compute(self, x):
+        for p in self._parts:
+            x = p(x)
+        return x
+
+    def _inverse_compute(self, y):
+        for p in reversed(self._parts):
+            y = p._inverse_compute(y)
+        return y
+
+    def log_det_jacobian(self, x, y):
+        total = None
+        cur = x
+        for p in self._parts:
+            nxt = p(cur)
+            term = p.log_det_jacobian(cur, nxt)
+            # lower-event-dim terms must be summed to this compose's dim
+            term = sum_right_most(term, self.event_dim - p.event_dim)
+            total = term if total is None else total + term
+            cur = nxt
+        return total
+
+
+class ExpTransform(Transformation):
+    def _forward_compute(self, x):
+        return np.exp(x)
+
+    def _inverse_compute(self, y):
+        return np.log(y)
+
+    def log_det_jacobian(self, x, y):
+        return x
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc=0.0, scale=1.0, event_dim=0):
+        self.loc = loc
+        self.scale = scale
+        self.event_dim = event_dim
+
+    def _forward_compute(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse_compute(self, y):
+        return (y - self.loc) / self.scale
+
+    def log_det_jacobian(self, x, y):
+        ldj = np.log(np.abs(self.scale)) * np.ones_like(x)
+        return sum_right_most(ldj, self.event_dim)
+
+
+class PowerTransform(Transformation):
+    def __init__(self, exponent):
+        self.exponent = exponent
+
+    def _forward_compute(self, x):
+        return np.power(x, self.exponent)
+
+    def _inverse_compute(self, y):
+        return np.power(y, 1.0 / self.exponent)
+
+    def log_det_jacobian(self, x, y):
+        return np.log(np.abs(self.exponent * y / x))
+
+
+class AbsTransform(Transformation):
+    bijective = False
+
+    def _forward_compute(self, x):
+        return np.abs(x)
+
+    def _inverse_compute(self, y):
+        return y
+
+
+class SigmoidTransform(Transformation):
+    def _forward_compute(self, x):
+        return npx.sigmoid(x)
+
+    def _inverse_compute(self, y):
+        return np.log(y) - np.log1p(-y)
+
+    def log_det_jacobian(self, x, y):
+        return -softplus(-x) - softplus(x)
+
+
+class SoftmaxTransform(Transformation):
+    bijective = False
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        return npx.softmax(x, axis=-1)
+
+    def _inverse_compute(self, y):
+        return np.log(y)
+
+
+# -- domain map (constraint → transformation) -------------------------------
+def _map_constraint(c):
+    if isinstance(c, (_c.Positive, _c.NonNegative)):
+        return ExpTransform()
+    if isinstance(c, _c.UnitInterval):
+        return SigmoidTransform()
+    if isinstance(c, _c.GreaterThan):
+        return ComposeTransform([ExpTransform(),
+                                 AffineTransform(c._lb, 1.0)])
+    if isinstance(c, _c.LessThan):
+        return ComposeTransform([ExpTransform(),
+                                 AffineTransform(c._ub, -1.0)])
+    if isinstance(c, _c.Interval):
+        span = c._ub - c._lb
+        return ComposeTransform([SigmoidTransform(),
+                                 AffineTransform(c._lb, span)])
+    if isinstance(c, _c.Simplex):
+        return SoftmaxTransform()
+    if isinstance(c, _c.Real):
+        return AffineTransform(0.0, 1.0)
+    raise NotImplementedError(f"no transform registered for {c!r}")
+
+
+def biject_to(c):
+    """Bijection from unconstrained reals onto the support of `c`."""
+    return _map_constraint(c)
+
+
+def transform_to(c):
+    """Smooth (not necessarily bijective) map onto the support of `c`."""
+    return _map_constraint(c)

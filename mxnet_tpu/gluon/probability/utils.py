@@ -1,0 +1,111 @@
+"""Shared helpers for gluon.probability (parity:
+python/mxnet/gluon/probability/distributions/utils.py)."""
+from __future__ import annotations
+
+import math
+
+from ... import numpy as np
+from ... import numpy_extension as npx
+
+_CONST_SQRT2 = math.sqrt(2.0)
+_CONST_LOG_2 = math.log(2.0)
+_CONST_LOG_SQRT_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class cached_property:
+    """Compute-once property (used for derived params like logits)."""
+
+    def __init__(self, fget):
+        self._fget = fget
+        self.__doc__ = fget.__doc__
+        self._name = fget.__name__
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        val = self._fget(obj)
+        obj.__dict__[self._name] = val
+        return val
+
+
+def coerce(x, dtype="float32"):
+    """Lift scalars/array-likes to NDArray."""
+    from ...ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x
+    return np.array(x, dtype=dtype)
+
+
+def gammaln(x):
+    return npx.gammaln(coerce(x))
+
+
+def digamma(x):
+    return npx.digamma(coerce(x))
+
+
+def erf(x):
+    return npx.erf(x)
+
+
+def erfinv(x):
+    return npx.erfinv(x)
+
+
+def log1p(x):
+    return np.log1p(x)
+
+
+def xlogy(x, y):
+    """x*log(y) with 0*log(0) == 0."""
+    safe_y = np.where(x == 0, np.ones_like(y), y)
+    return np.where(x == 0, np.zeros_like(x * y), x * np.log(safe_y))
+
+
+def betaln(a, b):
+    return gammaln(a) + gammaln(b) - gammaln(a + b)
+
+
+def softplus(x):
+    return npx.softplus(coerce(x))
+
+
+def logsigmoid(x):
+    return npx.log_sigmoid(coerce(x))
+
+
+def prob2logit(prob, binary=True):
+    """Probability → logit (parity: utils.prob2logit)."""
+    prob = coerce(prob)
+    if binary:
+        return np.log(prob) - np.log1p(-prob)
+    return np.log(prob)
+
+
+def logit2prob(logit, binary=True):
+    logit = coerce(logit)
+    if binary:
+        return npx.sigmoid(logit)
+    return npx.softmax(logit, axis=-1)
+
+
+def sum_right_most(x, ndim):
+    """Sum out the rightmost `ndim` axes."""
+    if ndim == 0:
+        return x
+    axes = tuple(range(x.ndim - ndim, x.ndim))
+    return np.sum(x, axis=axes)
+
+
+def sample_n_shape_converter(size):
+    """Shape for sample_n: prepend n to the batch shape."""
+    if size is None:
+        return size
+    if isinstance(size, int):
+        size = (size,)
+    return tuple(size)
+
+
+def broadcast_shapes(*shapes):
+    import numpy as onp
+    return onp.broadcast_shapes(*shapes)

@@ -1,0 +1,115 @@
+"""Distribution combinators (parity:
+python/mxnet/gluon/probability/distributions/{independent,
+transformed_distribution}.py)."""
+from __future__ import annotations
+
+from ... import numpy as np
+from .distribution import Distribution
+from .utils import sum_right_most
+
+__all__ = ["Independent", "TransformedDistribution"]
+
+
+class Independent(Distribution):
+    """Reinterpret the rightmost `reinterpreted_batch_ndims` batch axes
+    of a distribution as event axes (log_prob sums over them)."""
+
+    def __init__(self, base_distribution, reinterpreted_batch_ndims,
+                 validate_args=None):
+        self.base_dist = base_distribution
+        self.reinterpreted_batch_ndims = reinterpreted_batch_ndims
+        super().__init__(
+            event_dim=base_distribution.event_dim +
+            reinterpreted_batch_ndims,
+            validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    @property
+    def support(self):
+        return self.base_dist.support
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        return sum_right_most(lp, self.reinterpreted_batch_ndims)
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size):
+        return self.base_dist.sample_n(size)
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        return sum_right_most(self.base_dist.entropy(),
+                              self.reinterpreted_batch_ndims)
+
+
+class TransformedDistribution(Distribution):
+    """y = f(x) for x ~ base: density transported through the
+    change-of-variables formula using each transform's log|det J|."""
+
+    def __init__(self, base_dist, transforms, validate_args=None):
+        self.base_dist = base_dist
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        event_dim = max([base_dist.event_dim] +
+                        [t.event_dim for t in self.transforms])
+        super().__init__(event_dim=event_dim, validate_args=validate_args)
+
+    @property
+    def has_grad(self):
+        return self.base_dist.has_grad
+
+    def sample(self, size=None):
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def sample_n(self, size):
+        x = self.base_dist.sample_n(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        # walk backwards, accumulating -log|det J| at each step
+        event_dim = self.event_dim
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t._inverse_compute(y)
+            ldj = t.log_det_jacobian(x, y)
+            lp = lp - sum_right_most(ldj, event_dim - t.event_dim)
+            y = x
+        base_lp = self.base_dist.log_prob(y)
+        lp = lp + sum_right_most(base_lp,
+                                 event_dim - self.base_dist.event_dim)
+        return lp
+
+    def cdf(self, value):
+        y = value
+        sign = 1
+        for t in reversed(self.transforms):
+            if not t.bijective:
+                raise NotImplementedError(
+                    "cdf through a non-bijective transform")
+            y = t._inverse_compute(y)
+        return self.base_dist.cdf(y)
+
+    def icdf(self, value):
+        x = self.base_dist.icdf(value)
+        for t in self.transforms:
+            x = t(x)
+        return x

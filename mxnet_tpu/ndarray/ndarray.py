@@ -180,6 +180,18 @@ class NDArray:
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 
+    def __array_function__(self, func, types, args, kwargs):
+        """NumPy dispatch protocol: numpy.foo(mx_arr) routes to the
+        mx.np implementation when one exists, host fallback otherwise
+        (parity: python/mxnet/numpy_dispatch_protocol.py +
+        numpy/fallback.py)."""
+        from ..numpy import dispatch
+        return dispatch.array_function(self, func, types, args, kwargs)
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        from ..numpy import dispatch
+        return dispatch.array_ufunc(self, ufunc, method, *inputs, **kwargs)
+
     def __dlpack__(self, stream=None):
         return self._data.__dlpack__()
 

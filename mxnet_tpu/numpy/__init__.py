@@ -1105,6 +1105,7 @@ def polyval(p, x):
 # expose submodules
 from . import linalg  # noqa: E402
 from . import random  # noqa: E402
+from . import fft  # noqa: E402
 
 # dtype utilities
 finfo = onp.finfo
@@ -1148,3 +1149,43 @@ def load(file, allow_pickle=False):
     if isinstance(res, onp.lib.npyio.NpzFile):
         return {k: array(res[k]) for k in res.files}
     return array(res)
+
+
+# ---------------------------------------------------------------------------
+# NumPy fallback for names not (yet) implemented natively
+# (parity: python/mxnet/numpy/fallback.py — the reference curates a list
+# of onp functions exposed through mx.np that run on host and return
+# mx arrays; here any public callable in onp falls back the same way)
+# ---------------------------------------------------------------------------
+_NO_FALLBACK = frozenset({
+    # numpy machinery that must not masquerade as mx.np ops
+    "ndarray", "generic", "ufunc", "matrix", "memmap", "nditer",
+    "frombuffer", "fromfile", "fromiter", "seterr", "geterr", "errstate",
+})
+
+
+def _make_fallback(onp_fn, name):
+    from .dispatch import _to_host, _from_host
+
+    def fallback(*args, **kwargs):
+        return _from_host(onp_fn(*_to_host(args), **_to_host(kwargs)))
+    fallback.__name__ = name
+    fallback.__qualname__ = name
+    fallback.__doc__ = (f"Host (NumPy) fallback for np.{name} — no native "
+                        "TPU implementation yet; inputs sync to host and "
+                        f"the result is lifted back to NDArray.\n\n"
+                        f"{onp_fn.__doc__ or ''}")
+    return fallback
+
+
+def __getattr__(name):
+    if name.startswith("_") or name in _NO_FALLBACK:
+        raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute "
+                             f"{name!r}")
+    onp_fn = getattr(onp, name, None)
+    if onp_fn is None or not callable(onp_fn) or isinstance(onp_fn, type):
+        raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute "
+                             f"{name!r}")
+    fn = _make_fallback(onp_fn, name)
+    globals()[name] = fn  # cache
+    return fn

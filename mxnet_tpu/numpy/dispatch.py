@@ -1,0 +1,111 @@
+"""NumPy interoperability protocols for NDArray.
+
+Parity with the reference's dispatch stack:
+- ``__array_function__`` protocol (reference:
+  python/mxnet/numpy_dispatch_protocol.py) — plain ``numpy.foo(mx_arr)``
+  calls route to the mx.np implementation, keeping results on device;
+- NumPy fallback (reference: python/mxnet/numpy/fallback.py) — a numpy
+  function with no mx.np implementation runs on host arrays and the
+  result is lifted back to NDArray, so user code never dead-ends.
+
+Resolution is by module path: numpy → mx.np, numpy.linalg →
+mx.np.linalg, numpy.fft → mx.np.fft, numpy.random → mx.np.random.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+_MODULE_MAP = {
+    "numpy": "mxnet_tpu.numpy",
+    "numpy.linalg": "mxnet_tpu.numpy.linalg",
+    "numpy.fft": "mxnet_tpu.numpy.fft",
+    "numpy.random": "mxnet_tpu.numpy.random",
+}
+
+# numpy functions whose mx.np namesakes intentionally differ in
+# signature/semantics enough that host fallback is safer
+_NEVER_DISPATCH = frozenset({"array", "asarray", "asanyarray", "copyto",
+                             "save", "savez", "load", "frombuffer"})
+
+
+def _resolve_native(func):
+    """Find the mx.np implementation for a numpy function, or None."""
+    import importlib
+    mod = getattr(func, "__module__", None) or "numpy"
+    name = getattr(func, "__name__", None)
+    if name is None or name in _NEVER_DISPATCH:
+        return None
+    target = _MODULE_MAP.get(mod)
+    if target is None and mod.startswith("numpy"):
+        target = _MODULE_MAP["numpy"]  # e.g. numpy._core.* wrappers
+    if target is None:
+        return None
+    try:
+        m = importlib.import_module(target)
+    except ImportError:
+        return None
+    native = m.__dict__.get(name)  # avoid module __getattr__ fallback
+    return native if callable(native) else None
+
+
+def _to_host(x):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_host(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_host(v) for k, v in x.items()}
+    return x
+
+
+def _from_host(x):
+    from . import array
+    if isinstance(x, onp.ndarray):
+        return array(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_from_host(v) for v in x)
+    return x
+
+
+def _fallback_call(func, args, kwargs):
+    """Run a numpy function on host copies, lift results to NDArray."""
+    res = func(*_to_host(args), **_to_host(kwargs or {}))
+    return _from_host(res)
+
+
+def array_function(self, func, types, args, kwargs):
+    native = _resolve_native(func)
+    if native is not None:
+        try:
+            return native(*args, **(kwargs or {}))
+        except TypeError:
+            # signature mismatch (numpy-only kwarg, etc.) → host fallback
+            pass
+    return _fallback_call(func, args, kwargs)
+
+
+def array_ufunc(self, ufunc, method, *inputs, **kwargs):
+    if method != "__call__":
+        # reduce/accumulate/outer/at: host fallback
+        bound = getattr(ufunc, method)
+        return _fallback_call(bound, inputs, kwargs)
+    out = kwargs.pop("out", None)
+    if isinstance(out, tuple):
+        out = out[0] if len(out) == 1 else out
+    native = _resolve_native(ufunc)
+    if native is not None:
+        try:
+            if out is not None:
+                return native(*inputs, out=out, **kwargs)
+            return native(*inputs, **kwargs)
+        except TypeError:
+            pass
+    res = _fallback_call(ufunc, inputs, kwargs)
+    if out is not None:
+        from ..ndarray.ndarray import NDArray
+        if isinstance(out, NDArray):
+            out._inplace(res if isinstance(res, NDArray) else
+                         _from_host(onp.asarray(res)))
+            return out
+    return res

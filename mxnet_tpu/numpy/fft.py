@@ -1,0 +1,103 @@
+"""mx.np.fft — FFT family.
+
+The reference ships FFT as a contrib op (src/operator/contrib/fft/,
+cuFFT-backed) without a numpy-namespace module; here the full
+numpy-style fft namespace lowers to jnp.fft (XLA FFT HLO — TPU executes
+on-chip, CPU via DUCC/pocketfft).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ops import apply_op
+
+
+def _c(x):
+    from . import _coerce
+    return _coerce(x)
+
+
+def _u(fn, a, name):
+    return apply_op(fn, _c(a), name=name)
+
+
+def fft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.fft(x, n=n, axis=axis, norm=norm), a, "fft")
+
+
+def ifft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.ifft(x, n=n, axis=axis, norm=norm), a, "ifft")
+
+
+def rfft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.rfft(x, n=n, axis=axis, norm=norm), a, "rfft")
+
+
+def irfft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.irfft(x, n=n, axis=axis, norm=norm), a,
+              "irfft")
+
+
+def hfft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.hfft(x, n=n, axis=axis, norm=norm), a, "hfft")
+
+
+def ihfft(a, n=None, axis=-1, norm=None):
+    return _u(lambda x: jnp.fft.ihfft(x, n=n, axis=axis, norm=norm), a,
+              "ihfft")
+
+
+def fft2(a, s=None, axes=(-2, -1), norm=None):
+    return _u(lambda x: jnp.fft.fft2(x, s=s, axes=axes, norm=norm), a, "fft2")
+
+
+def ifft2(a, s=None, axes=(-2, -1), norm=None):
+    return _u(lambda x: jnp.fft.ifft2(x, s=s, axes=axes, norm=norm), a,
+              "ifft2")
+
+
+def rfft2(a, s=None, axes=(-2, -1), norm=None):
+    return _u(lambda x: jnp.fft.rfft2(x, s=s, axes=axes, norm=norm), a,
+              "rfft2")
+
+
+def irfft2(a, s=None, axes=(-2, -1), norm=None):
+    return _u(lambda x: jnp.fft.irfft2(x, s=s, axes=axes, norm=norm), a,
+              "irfft2")
+
+
+def fftn(a, s=None, axes=None, norm=None):
+    return _u(lambda x: jnp.fft.fftn(x, s=s, axes=axes, norm=norm), a, "fftn")
+
+
+def ifftn(a, s=None, axes=None, norm=None):
+    return _u(lambda x: jnp.fft.ifftn(x, s=s, axes=axes, norm=norm), a,
+              "ifftn")
+
+
+def rfftn(a, s=None, axes=None, norm=None):
+    return _u(lambda x: jnp.fft.rfftn(x, s=s, axes=axes, norm=norm), a,
+              "rfftn")
+
+
+def irfftn(a, s=None, axes=None, norm=None):
+    return _u(lambda x: jnp.fft.irfftn(x, s=s, axes=axes, norm=norm), a,
+              "irfftn")
+
+
+def fftshift(x, axes=None):
+    return _u(lambda a: jnp.fft.fftshift(a, axes=axes), x, "fftshift")
+
+
+def ifftshift(x, axes=None):
+    return _u(lambda a: jnp.fft.ifftshift(a, axes=axes), x, "ifftshift")
+
+
+def fftfreq(n, d=1.0, ctx=None):
+    from . import array
+    return array(jnp.fft.fftfreq(n, d=d))
+
+
+def rfftfreq(n, d=1.0, ctx=None):
+    from . import array
+    return array(jnp.fft.rfftfreq(n, d=d))

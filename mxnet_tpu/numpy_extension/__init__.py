@@ -449,6 +449,62 @@ def save(fname, data):
     return _save(fname, data)
 
 
+# ---------------------------------------------------------------------------
+# contrib ops (parity: src/operator/contrib/)
+# ---------------------------------------------------------------------------
+def boolean_mask(data, index, axis=0, **kwargs):
+    """Select slices of `data` along `axis` where `index` is nonzero
+    (parity: src/operator/contrib/boolean_mask.cc).
+
+    The output shape is data-dependent, so this syncs the mask to host
+    (the reference computes the prefix-sum on CPU for the same reason).
+    """
+    mask = _c(index).asnumpy().astype(bool)
+    keep = onp.nonzero(mask)[0]
+    return apply_op(lambda x: jnp.take(x, jnp.asarray(keep), axis=axis),
+                    _c(data), name="boolean_mask")
+
+
+def multi_sum_sq(*arrays, num_arrays=None, **kwargs):
+    """Per-array sum of squares over a list of tensors, one fused
+    program (parity: src/operator/contrib/multi_sum_sq.cc — the
+    multi-tensor helper behind LARS/clip_global_norm)."""
+    arrs = [_c(a) for a in arrays]
+    return apply_op(
+        lambda *xs: jnp.stack([jnp.sum(jnp.square(x.astype(jnp.float32)))
+                               for x in xs]),
+        *arrs, name="multi_sum_sq")
+
+
+def all_finite(data, init_output=True, **kwargs):
+    """1.0 if every element is finite else 0.0 (parity:
+    src/operator/contrib/all_finite.cc)."""
+    return apply_op(lambda x: jnp.isfinite(x).all().astype(jnp.float32),
+                    _c(data), name="all_finite")
+
+
+def multi_all_finite(*arrays, num_arrays=None, init_output=True, **kwargs):
+    """Fused finite-check over many tensors; single 0/1 scalar output
+    (the AMP LossScaler overflow test, contrib/all_finite.cc)."""
+    arrs = [_c(a) for a in arrays]
+    return apply_op(
+        lambda *xs: jnp.stack([jnp.isfinite(x).all() for x in xs])
+        .all().astype(jnp.float32),
+        *arrs, name="multi_all_finite")
+
+
+def index_array(data, axes=None, **kwargs):
+    """Per-element multi-index array (contrib/index_array.cc)."""
+    def f(x):
+        idx = jnp.stack(jnp.meshgrid(
+            *[jnp.arange(s) for s in x.shape], indexing="ij"), axis=-1)
+        if axes is not None:
+            idx = idx[..., tuple(axes)]
+        return idx.astype(jnp.int64 if jnp.int64 in (idx.dtype,) else
+                          jnp.int32)
+    return apply_op(f, _c(data), name="index_array")
+
+
 # control flow (npx.foreach / while_loop / cond) lives in its own module
 from .control_flow import foreach, while_loop, cond  # noqa: E402,F401
 

@@ -11,7 +11,9 @@ def test_creation_defaults():
     a = np.array([1, 2, 3])
     assert a.dtype == onp.float32  # reference semantics: default f32
     b = np.array(onp.array([1, 2, 3], dtype=onp.int64))
-    assert b.dtype == onp.int64
+    # int64 narrows to int32 unless MXTPU_ENABLE_X64 (typed input keeps
+    # its integer kind either way)
+    assert b.dtype in (onp.int64, onp.int32)
     z = np.zeros((2, 3))
     assert z.shape == (2, 3) and z.dtype == onp.float32
     f = np.full((2, 2), 7, dtype="int32")

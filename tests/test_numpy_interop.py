@@ -1,0 +1,132 @@
+"""NumPy interoperability: __array_function__ / __array_ufunc__
+dispatch and host fallback (parity model:
+tests/python/unittest/test_numpy_interoperability.py, which runs
+NumPy's own call forms through the protocol)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.ndarray.ndarray import NDArray
+
+
+def _mx(a):
+    return np.array(onp.asarray(a, dtype=onp.float32))
+
+
+def test_array_function_routes_to_native():
+    a = _mx([[1.0, 2.0], [3.0, 4.0]])
+    out = onp.sum(a)                 # plain numpy call on an mx array
+    assert isinstance(out, NDArray)  # stayed on device
+    assert float(out.item()) == 10.0
+
+    out = onp.concatenate([a, a], axis=1)
+    assert isinstance(out, NDArray)
+    assert out.shape == (2, 4)
+
+    out = onp.transpose(a)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 3], [2, 4]])
+
+
+def test_array_function_mixed_args():
+    a = _mx([1.0, 2.0])
+    out = onp.stack([a, onp.array([3.0, 4.0], onp.float32)])
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_array_ufunc_call():
+    a = _mx([1.0, 4.0, 9.0])
+    out = onp.sqrt(a)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [1, 2, 3])
+
+    out = onp.add(a, onp.ones(3, onp.float32))
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [2, 5, 10])
+
+
+def test_array_ufunc_reduce_falls_back():
+    a = _mx([1.0, 2.0, 3.0])
+    out = onp.add.reduce(a)
+    assert float(out.item() if isinstance(out, NDArray) else out) == 6.0
+
+
+def test_linalg_dispatch():
+    m = _mx([[2.0, 0.0], [0.0, 3.0]])
+    out = onp.linalg.inv(m)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), [[0.5, 0], [0, 1 / 3]],
+                                rtol=1e-6)
+
+
+def test_fallback_for_unimplemented():
+    # np.unwrap has no native mx implementation → host fallback, result
+    # lifted back to NDArray
+    a = _mx([0.0, 1.0, 2.0])
+    out = np.unwrap(a)
+    assert isinstance(out, NDArray)
+    onp.testing.assert_allclose(out.asnumpy(), onp.unwrap([0.0, 1.0, 2.0]))
+
+
+def test_fallback_docstring_marks_host():
+    assert "fallback" in np.unwrap.__doc__.lower()
+
+
+def test_fallback_unknown_name_raises():
+    with pytest.raises(AttributeError):
+        np.this_function_does_not_exist  # noqa: B018
+
+
+def test_fft_roundtrip():
+    x = _mx(onp.random.RandomState(0).randn(16))
+    f = np.fft.fft(x)
+    back = np.fft.ifft(f)
+    onp.testing.assert_allclose(back.asnumpy().real, x.asnumpy(),
+                                atol=1e-5)
+    # rfft/irfft shapes
+    r = np.fft.rfft(x)
+    assert r.shape == (9,)
+    onp.testing.assert_allclose(np.fft.irfft(r, n=16).asnumpy(),
+                                x.asnumpy(), atol=1e-5)
+
+
+def test_fft2():
+    x = _mx(onp.random.RandomState(1).randn(4, 8))
+    got = np.fft.fft2(x).asnumpy()
+    want = onp.fft.fft2(x.asnumpy())
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_boolean_mask():
+    data = _mx([[1, 2], [3, 4], [5, 6]])
+    mask = np.array([1, 0, 1], dtype="int32")
+    out = npx.boolean_mask(data, mask)
+    onp.testing.assert_allclose(out.asnumpy(), [[1, 2], [5, 6]])
+
+
+def test_multi_sum_sq_and_all_finite():
+    a, b = _mx([1.0, 2.0]), _mx([[3.0], [4.0]])
+    ss = npx.multi_sum_sq(a, b)
+    onp.testing.assert_allclose(ss.asnumpy(), [5.0, 25.0])
+    assert float(npx.all_finite(a).item()) == 1.0
+    bad = _mx([1.0, onp.inf])
+    assert float(npx.multi_all_finite(a, bad).item()) == 0.0
+    assert float(npx.multi_all_finite(a, b).item()) == 1.0
+
+
+def test_einsum_matches_numpy():
+    rng = onp.random.RandomState(2)
+    a, b = rng.randn(3, 4).astype(onp.float32), \
+        rng.randn(4, 5).astype(onp.float32)
+    got = np.einsum("ij,jk->ik", _mx(a), _mx(b)).asnumpy()
+    onp.testing.assert_allclose(got, onp.einsum("ij,jk->ik", a, b),
+                                rtol=1e-5)
+
+
+def test_comparison_with_numpy_operand():
+    a = _mx([1.0, 5.0])
+    out = onp.array([2.0, 2.0], onp.float32) < a
+    assert isinstance(out, NDArray)
+    assert out.asnumpy().tolist() == [False, True]

@@ -1,0 +1,297 @@
+"""gluon.probability: log_prob/cdf/entropy vs scipy, sampling moments,
+KL closed forms vs Monte Carlo, transformations, StochasticBlock
+(parity model: tests/python/unittest/test_gluon_probability_v2.py)."""
+import math
+
+import numpy as onp
+import pytest
+import scipy.stats as ss
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, autograd
+import mxnet_tpu.gluon.probability as mgp
+
+
+def _a(x):
+    return np.array(onp.asarray(x, dtype=onp.float32))
+
+
+RTOL, ATOL = 1e-4, 1e-5
+
+
+@pytest.mark.parametrize("dist,scipy_dist,xs", [
+    (lambda: mgp.Normal(_a(1.0), _a(2.0)), ss.norm(1.0, 2.0),
+     [-1.0, 0.5, 3.0]),
+    (lambda: mgp.Laplace(_a(0.5), _a(1.5)), ss.laplace(0.5, 1.5),
+     [-1.0, 0.5, 3.0]),
+    (lambda: mgp.Cauchy(_a(0.0), _a(1.0)), ss.cauchy(0.0, 1.0),
+     [-2.0, 0.0, 2.0]),
+    (lambda: mgp.Exponential(_a(2.0)), ss.expon(scale=2.0),
+     [0.1, 1.0, 5.0]),
+    (lambda: mgp.Gamma(_a(3.0), _a(2.0)), ss.gamma(3.0, scale=2.0),
+     [0.5, 2.0, 8.0]),
+    (lambda: mgp.Beta(_a(2.0), _a(3.0)), ss.beta(2.0, 3.0),
+     [0.1, 0.5, 0.9]),
+    (lambda: mgp.Gumbel(_a(1.0), _a(2.0)), ss.gumbel_r(1.0, 2.0),
+     [-1.0, 1.0, 4.0]),
+    (lambda: mgp.StudentT(_a(5.0), _a(0.0), _a(1.0)), ss.t(5.0),
+     [-2.0, 0.0, 2.0]),
+    (lambda: mgp.HalfNormal(_a(2.0)), ss.halfnorm(scale=2.0),
+     [0.2, 1.0, 3.0]),
+    (lambda: mgp.HalfCauchy(_a(1.0)), ss.halfcauchy(scale=1.0),
+     [0.2, 1.0, 3.0]),
+    (lambda: mgp.Uniform(_a(-1.0), _a(2.0)), ss.uniform(-1.0, 3.0),
+     [-0.5, 0.0, 1.5]),
+    (lambda: mgp.Weibull(_a(2.0), _a(1.5)),
+     ss.weibull_min(2.0, scale=1.5), [0.5, 1.0, 2.0]),
+    (lambda: mgp.Pareto(_a(3.0), _a(1.0)), ss.pareto(3.0),
+     [1.5, 2.0, 4.0]),
+    (lambda: mgp.LogNormal(_a(0.5), _a(0.8)),
+     ss.lognorm(0.8, scale=math.exp(0.5)), [0.5, 1.0, 3.0]),
+    (lambda: mgp.FisherSnedecor(_a(4.0), _a(6.0)), ss.f(4.0, 6.0),
+     [0.5, 1.0, 2.0]),
+    (lambda: mgp.Chi2(_a(4.0)), ss.chi2(4.0), [1.0, 3.0, 7.0]),
+])
+def test_continuous_logpdf_vs_scipy(dist, scipy_dist, xs):
+    d = dist()
+    got = d.log_prob(_a(xs)).asnumpy()
+    want = scipy_dist.logpdf(onp.asarray(xs))
+    onp.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-4)
+
+
+@pytest.mark.parametrize("dist,scipy_dist,xs", [
+    (lambda: mgp.Normal(_a(1.0), _a(2.0)), ss.norm(1.0, 2.0),
+     [-1.0, 1.0, 3.0]),
+    (lambda: mgp.Exponential(_a(2.0)), ss.expon(scale=2.0),
+     [0.5, 2.0]),
+    (lambda: mgp.Laplace(_a(0.0), _a(1.0)), ss.laplace(),
+     [-1.0, 0.5]),
+    (lambda: mgp.Gumbel(_a(0.0), _a(1.0)), ss.gumbel_r(),
+     [-0.5, 1.0]),
+    (lambda: mgp.Cauchy(_a(0.0), _a(1.0)), ss.cauchy(), [-1.0, 1.0]),
+])
+def test_cdf_icdf_vs_scipy(dist, scipy_dist, xs):
+    d = dist()
+    got = d.cdf(_a(xs)).asnumpy()
+    want = scipy_dist.cdf(onp.asarray(xs))
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+    # icdf round-trips
+    back = d.icdf(_a(want.astype(onp.float32))).asnumpy()
+    onp.testing.assert_allclose(back, xs, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("dist,scipy_entropy", [
+    (lambda: mgp.Normal(_a(0.0), _a(2.0)), ss.norm(0, 2).entropy()),
+    (lambda: mgp.Exponential(_a(0.5)), ss.expon(scale=0.5).entropy()),
+    (lambda: mgp.Gamma(_a(3.0), _a(2.0)),
+     ss.gamma(3.0, scale=2.0).entropy()),
+    (lambda: mgp.Beta(_a(2.0), _a(5.0)), ss.beta(2, 5).entropy()),
+    (lambda: mgp.Gumbel(_a(0.0), _a(1.5)), ss.gumbel_r(0, 1.5).entropy()),
+    (lambda: mgp.Laplace(_a(0.0), _a(2.0)), ss.laplace(0, 2).entropy()),
+])
+def test_entropy_vs_scipy(dist, scipy_entropy):
+    got = float(dist().entropy().asnumpy())
+    onp.testing.assert_allclose(got, float(scipy_entropy), rtol=1e-4)
+
+
+def test_discrete_logpmf_vs_scipy():
+    ks = _a([0.0, 1.0, 3.0, 5.0])
+    onp.testing.assert_allclose(
+        mgp.Poisson(_a(2.5)).log_prob(ks).asnumpy(),
+        ss.poisson(2.5).logpmf([0, 1, 3, 5]), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(
+        mgp.Binomial(10, prob=_a(0.3)).log_prob(ks).asnumpy(),
+        ss.binom(10, 0.3).logpmf([0, 1, 3, 5]), rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(
+        mgp.Geometric(prob=_a(0.4)).log_prob(ks).asnumpy(),
+        ss.geom(0.4, loc=-1).logpmf([0, 1, 3, 5]), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(
+        mgp.NegativeBinomial(4.0, prob=_a(0.6)).log_prob(ks).asnumpy(),
+        ss.nbinom(4, 0.6).logpmf([0, 1, 3, 5]), rtol=1e-4, atol=1e-4)
+    b = mgp.Bernoulli(prob=_a(0.7))
+    onp.testing.assert_allclose(
+        b.log_prob(_a([0.0, 1.0])).asnumpy(),
+        ss.bernoulli(0.7).logpmf([0, 1]), rtol=1e-4)
+
+
+def test_categorical_and_onehot():
+    logits = _a([[0.5, 1.0, -0.5], [0.1, 0.1, 2.0]])
+    c = mgp.Categorical(logit=logits)
+    lp = c.log_prob(_a([1.0, 2.0])).asnumpy()
+    raw = onp.exp(logits.asnumpy())
+    want = onp.log(raw / raw.sum(-1, keepdims=True))
+    onp.testing.assert_allclose(lp, [want[0, 1], want[1, 2]], rtol=1e-4)
+    s = c.sample((100, 2))
+    assert s.shape == (100, 2)
+    assert float(s.max().item()) <= 2
+    oh = mgp.OneHotCategorical(logit=logits)
+    v = oh.sample()
+    assert v.shape == (2, 3)
+    onp.testing.assert_allclose(v.asnumpy().sum(-1), [1.0, 1.0])
+
+
+def test_sampling_moments():
+    n = mgp.Normal(_a(2.0), _a(0.5))
+    s = n.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 2.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+    g = mgp.Gamma(_a(3.0), _a(2.0))
+    s = g.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 6.0) < 0.15
+
+    b = mgp.Bernoulli(prob=_a(0.3))
+    s = b.sample((20000,)).asnumpy()
+    assert abs(s.mean() - 0.3) < 0.02
+
+
+def test_reparameterized_gradient():
+    loc = _a(1.0)
+    scale = _a(2.0)
+    loc.attach_grad()
+    scale.attach_grad()
+    np.random.seed(7)
+    with autograd.record():
+        d = mgp.Normal(loc, scale)
+        s = d.sample((1000,))
+        m = s.mean()
+    m.backward()
+    # d mean / d loc == 1
+    onp.testing.assert_allclose(loc.grad.asnumpy(), 1.0, rtol=1e-5)
+    # d mean / d scale == mean of eps ~ 0
+    assert abs(float(scale.grad.asnumpy())) < 0.1
+
+
+@pytest.mark.parametrize("p,q", [
+    (lambda: mgp.Normal(_a(0.0), _a(1.0)),
+     lambda: mgp.Normal(_a(1.0), _a(2.0))),
+    (lambda: mgp.Gamma(_a(2.0), _a(1.0)),
+     lambda: mgp.Gamma(_a(3.0), _a(2.0))),
+    (lambda: mgp.Beta(_a(2.0), _a(3.0)),
+     lambda: mgp.Beta(_a(4.0), _a(2.0))),
+    (lambda: mgp.Bernoulli(prob=_a(0.3)),
+     lambda: mgp.Bernoulli(prob=_a(0.6))),
+    (lambda: mgp.Exponential(_a(1.0)),
+     lambda: mgp.Exponential(_a(2.0))),
+    (lambda: mgp.Poisson(_a(2.0)), lambda: mgp.Poisson(_a(4.0))),
+])
+def test_kl_closed_form_vs_monte_carlo(p, q):
+    np.random.seed(0)
+    pd, qd = p(), q()
+    kl = float(mgp.kl_divergence(pd, qd).asnumpy())
+    mc = float(mgp.empirical_kl(pd, qd, 20000).asnumpy())
+    assert abs(kl - mc) < max(0.08, 0.15 * abs(kl)), (kl, mc)
+
+
+def test_kl_normal_exact():
+    kl = mgp.kl_divergence(mgp.Normal(_a(0.0), _a(1.0)),
+                           mgp.Normal(_a(1.0), _a(1.0)))
+    onp.testing.assert_allclose(float(kl.asnumpy()), 0.5, rtol=1e-5)
+
+
+def test_kl_unregistered_raises():
+    with pytest.raises(NotImplementedError):
+        mgp.kl_divergence(mgp.Normal(_a(0.0), _a(1.0)),
+                          mgp.Gamma(_a(1.0), _a(1.0)))
+
+
+def test_mvn_logpdf_vs_scipy():
+    mean = onp.array([1.0, -1.0], onp.float32)
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]], onp.float32)
+    d = mgp.MultivariateNormal(_a(mean), cov=_a(cov))
+    xs = onp.array([[0.0, 0.0], [1.0, -1.0]], onp.float32)
+    got = d.log_prob(_a(xs)).asnumpy()
+    want = ss.multivariate_normal(mean, cov).logpdf(xs)
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+    s = d.sample((5000, 2)).asnumpy()
+    onp.testing.assert_allclose(s.mean(0), mean, atol=0.1)
+
+
+def test_dirichlet_logpdf():
+    alpha = onp.array([2.0, 3.0, 4.0], onp.float32)
+    d = mgp.Dirichlet(_a(alpha))
+    x = onp.array([0.2, 0.3, 0.5], onp.float32)
+    got = float(d.log_prob(_a(x)).asnumpy())
+    want = ss.dirichlet(alpha).logpdf(x)
+    onp.testing.assert_allclose(got, want, rtol=1e-4)
+    s = d.sample((100,)).asnumpy()
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(100), rtol=1e-5)
+
+
+def test_transformed_distribution_lognormal():
+    base = mgp.Normal(_a(0.5), _a(0.8))
+    d = mgp.TransformedDistribution(base, mgp.ExpTransform())
+    xs = _a([0.5, 1.0, 3.0])
+    want = ss.lognorm(0.8, scale=math.exp(0.5)).logpdf(xs.asnumpy())
+    onp.testing.assert_allclose(d.log_prob(xs).asnumpy(), want, rtol=1e-4)
+    s = d.sample((1000,)).asnumpy()
+    assert (s > 0).all()
+
+
+def test_affine_sigmoid_compose():
+    base = mgp.Normal(_a(0.0), _a(1.0))
+    t = mgp.ComposeTransform([mgp.SigmoidTransform(),
+                              mgp.AffineTransform(1.0, 2.0)])
+    d = mgp.TransformedDistribution(base, t)
+    s = d.sample((500,)).asnumpy()
+    assert (s > 1.0).all() and (s < 3.0).all()
+    lp = d.log_prob(_a([1.5, 2.0])).asnumpy()
+    assert onp.isfinite(lp).all()
+
+
+def test_independent():
+    loc = _a(onp.zeros((4, 3)))
+    scale = _a(onp.ones((4, 3)))
+    d = mgp.Independent(mgp.Normal(loc, scale), 1)
+    lp = d.log_prob(_a(onp.zeros((4, 3))))
+    assert lp.shape == (4,)
+    onp.testing.assert_allclose(
+        lp.asnumpy(), 3 * ss.norm().logpdf(0.0) * onp.ones(4), rtol=1e-5)
+
+
+def test_biject_to():
+    t = mgp.biject_to(mgp.constraint.positive)
+    x = _a([-1.0, 0.0, 2.0])
+    y = t(x).asnumpy()
+    assert (y > 0).all()
+    t2 = mgp.biject_to(mgp.constraint.unit_interval)
+    y2 = t2(x).asnumpy()
+    assert ((y2 > 0) & (y2 < 1)).all()
+
+
+def test_constraint_validation():
+    with pytest.raises(mx.MXNetError):
+        mgp.Normal(_a(0.0), _a(-1.0), validate_args=True)
+    with pytest.raises(mx.MXNetError):
+        mgp.Bernoulli(prob=_a(0.5), validate_args=True).log_prob(_a(2.0))
+
+
+def test_stochastic_block_vae_style():
+    from mxnet_tpu.gluon import nn
+
+    class Sampler(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4)
+
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.dense(x)
+            qz = mgp.Normal(h, np.ones_like(h))
+            pz = mgp.Normal(np.zeros_like(h), np.ones_like(h))
+            self.add_loss(mgp.kl_divergence(qz, pz))
+            return qz.sample()
+
+    blk = Sampler()
+    blk.initialize()
+    out = blk(np.ones((2, 3)))
+    assert out.shape == (2, 4)
+    assert len(blk.losses) == 1
+    assert blk.losses[0].shape == (2, 4)
+
+    seq = mgp.StochasticSequential()
+    seq.add(nn.Dense(3), Sampler())
+    seq.initialize()
+    out = seq(np.ones((2, 3)))
+    assert out.shape == (2, 4)
+    assert len(seq.losses) == 1

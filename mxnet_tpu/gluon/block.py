@@ -435,8 +435,21 @@ class CachedOp:
                 pgrads, igrads = _entry.bwd(vjp, tuple(cts))
                 return tuple(list(pgrads) + list(igrads))
 
+            # Replayable forward for create_graph: re-runs the compiled
+            # graph (same RNG key → deterministic replay) over raw
+            # buffers in tape-input order, so autograd._replay_vjp can
+            # jax.vjp through it for grad-of-grad on hybridized blocks
+            # (parity: python/mxnet/autograd.py:245 create_graph support
+            # through CachedOp).
+            n_params = len(entry.param_nds)
+
+            def replay_fn(*raws, _entry=entry, _key=key, _np=n_params):
+                outs, _aux = _entry.fwd(_key, list(raws[:_np]),
+                                        list(raws[_np:]))
+                return tuple(outs)
+
             autograd._record(f"CachedOp_{type(self.block).__name__}",
-                             None, vjp_fn, tape_inputs, out_nds)
+                             replay_fn, vjp_fn, tape_inputs, out_nds)
 
         result = _rebuild(entry.out_spec["spec"], out_nds)
         if entry.out_spec["single"]:

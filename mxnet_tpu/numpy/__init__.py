@@ -1189,3 +1189,217 @@ def __getattr__(name):
     fn = _make_fallback(onp_fn, name)
     globals()[name] = fn  # cache
     return fn
+
+
+# ---------------------------------------------------------------------------
+# Legacy NumPy aliases the reference exposes (python/mxnet/numpy/fallback.py
+# routes these to host NumPy; NumPy 2.0 removed them upstream, so they are
+# provided natively here).
+# ---------------------------------------------------------------------------
+alltrue = all
+sometrue = any
+product = prod
+
+
+def msort(a):
+    """Sorted copy along the first axis (legacy alias for sort(a, axis=0))."""
+    return sort(a, axis=0)
+
+
+def blackman(M, dtype=None):
+    return array(onp.blackman(int(M)).astype(
+        resolve_dtype(dtype) or _default_float))
+
+
+def hamming(M, dtype=None):
+    return array(onp.hamming(int(M)).astype(
+        resolve_dtype(dtype) or _default_float))
+
+
+def hanning(M, dtype=None):
+    return array(onp.hanning(int(M)).astype(
+        resolve_dtype(dtype) or _default_float))
+
+
+def fill_diagonal(a, val, wrap=False):
+    """In-place diagonal fill (functional lowering: computes the filled
+    array with jnp and installs it into ``a``'s buffer)."""
+    host = onp.array(a.asnumpy())
+    onp.fill_diagonal(host, val.asnumpy() if isinstance(val, NDArray)
+                      else val, wrap=wrap)
+    a._install(jnp.asarray(host, a.dtype))
+    return None
+
+
+def triu_indices(n, k=0, m=None):
+    r, c = onp.triu_indices(n, k=k, m=m)
+    return array(r.astype(onp.int64)), array(c.astype(onp.int64))
+
+
+def triu_indices_from(arr, k=0):
+    if arr.ndim != 2:
+        raise ValueError("input array must be 2-d")
+    return triu_indices(arr.shape[-2], k=k, m=arr.shape[-1])
+
+
+def unravel_index(indices, shape, order="C"):
+    indices = _coerce(indices)
+    if isinstance(indices, NDArray) and order == "C":
+        outs = apply_op(lambda i: tuple(jnp.unravel_index(i, shape)),
+                        indices, name="unravel_index",
+                        nout=len(shape))
+        return outs
+    # order='F' has no jnp lowering — host path
+    if isinstance(indices, NDArray):
+        indices = indices.asnumpy()
+    res = onp.unravel_index(indices, shape, order=order)
+    return tuple(array(r) for r in res)
+
+
+def ravel_multi_index(multi_index, dims, mode="raise", order="C"):
+    hosts = [(m.asnumpy() if isinstance(m, NDArray) else onp.asarray(m))
+             for m in multi_index]
+    return array(onp.ravel_multi_index(tuple(hosts), dims, mode=mode,
+                                       order=order))
+
+
+set_printoptions = onp.set_printoptions
+get_printoptions = onp.get_printoptions
+
+
+def genfromtxt(*args, **kwargs):
+    return array(onp.genfromtxt(*args, **kwargs))
+
+
+def fromiter(iterable, dtype, count=-1):
+    return array(onp.fromiter(iterable, dtype=dtype, count=count))
+
+
+# ---------------------------------------------------------------------------
+# Financial functions (parity: the reference exposes NumPy<1.20 financial
+# routines via its fallback table; removed upstream, reimplemented here
+# with numpy-financial's closed forms).
+# ---------------------------------------------------------------------------
+def _fin_when(when):
+    table = {"begin": 1, "b": 1, "beginning": 1, "start": 1, 1: 1,
+             "end": 0, "e": 0, "finish": 0, 0: 0}
+    try:
+        return table[when]
+    except KeyError:
+        raise ValueError(f"when must be 'begin' or 'end' (got {when!r})")
+
+
+def _fin_lift(r):
+    if isinstance(r, onp.ndarray) and r.ndim > 0:
+        return array(r)
+    return float(r)
+
+
+def fv(rate, nper, pmt, pv, when="end"):
+    when = _fin_when(when)
+    rate, nper, pmt, pv = map(onp.asarray, (rate, nper, pmt, pv))
+    temp = (1 + rate) ** nper
+    fact = onp.where(rate == 0, nper,
+                     (1 + rate * when) * (temp - 1) / onp.where(rate == 0, 1, rate))
+    return _fin_lift(-(pv * temp + pmt * fact))
+
+
+def pv(rate, nper, pmt, fv=0, when="end"):
+    when = _fin_when(when)
+    rate, nper, pmt, fv = map(onp.asarray, (rate, nper, pmt, fv))
+    temp = (1 + rate) ** nper
+    fact = onp.where(rate == 0, nper,
+                     (1 + rate * when) * (temp - 1) / onp.where(rate == 0, 1, rate))
+    return _fin_lift(-(fv + pmt * fact) / temp)
+
+
+def pmt(rate, nper, pv, fv=0, when="end"):
+    when = _fin_when(when)
+    rate, nper, pv, fv = map(onp.asarray, (rate, nper, pv, fv))
+    temp = (1 + rate) ** nper
+    mask = rate == 0
+    fact = onp.where(mask, nper,
+                     (1 + rate * when) * (temp - 1) / onp.where(mask, 1, rate))
+    return _fin_lift(-(fv + pv * temp) / fact)
+
+
+def nper(rate, pmt, pv, fv=0, when="end"):
+    when = _fin_when(when)
+    rate, pmt, pv, fv = map(onp.asarray, (rate, pmt, pv, fv))
+    rate, pmt, pv, fv = onp.broadcast_arrays(
+        *(onp.asarray(x, dtype=onp.float64) for x in (rate, pmt, pv, fv)))
+    safe = onp.where(rate == 0, 1.0, rate)
+    z = pmt * (1 + safe * when) / safe
+    with onp.errstate(divide="ignore", invalid="ignore"):
+        general = onp.log((-fv + z) / (pv + z)) / onp.log(1 + safe)
+    return _fin_lift(onp.where(rate == 0, -(fv + pv) / pmt, general))
+
+
+def _rbl(rate, per, pmt_, pv_, when):
+    # remaining balance before period `per`
+    return fv(rate, per - 1, pmt_, pv_, when)
+
+
+def ipmt(rate, per, nper, pv, fv=0, when="end"):
+    w = _fin_when(when)
+    total = pmt(rate, nper, pv, fv, when)
+    total_h = onp.asarray(total)
+    ip = onp.asarray(_rbl(rate, onp.asarray(per), total_h, onp.asarray(pv), when)) * onp.asarray(rate)
+    ip = onp.where(onp.asarray(per) == 1, onp.where(w == 1, 0.0, ip), ip)
+    if w == 1:
+        ip = ip / (1 + onp.asarray(rate))
+    return _fin_lift(ip)
+
+
+def ppmt(rate, per, nper, pv, fv=0, when="end"):
+    total = onp.asarray(pmt(rate, nper, pv, fv, when))
+    return _fin_lift(total - onp.asarray(ipmt(rate, per, nper, pv, fv, when)))
+
+
+def npv(rate, values):
+    values = (values.asnumpy() if isinstance(values, NDArray)
+              else onp.asarray(values))
+    return float((values / (1 + rate) ** onp.arange(len(values))).sum())
+
+
+def mirr(values, finance_rate, reinvest_rate):
+    values = (values.asnumpy() if isinstance(values, NDArray)
+              else onp.asarray(values, dtype=onp.float64))
+    n = values.size
+    pos = values > 0
+    neg = values < 0
+    if not (pos.any() and neg.any()):
+        return float("nan")
+    numer = onp.abs(npv(reinvest_rate, values * pos))
+    denom = onp.abs(npv(finance_rate, values * neg))
+    return float((numer / denom) ** (1 / (n - 1)) * (1 + reinvest_rate) - 1)
+
+
+def irr(values):
+    values = (values.asnumpy() if isinstance(values, NDArray)
+              else onp.asarray(values, dtype=onp.float64))
+    roots = onp.roots(values[::-1])
+    roots = roots[(onp.imag(roots) == 0) & (onp.real(roots) > 0)]
+    if roots.size == 0:
+        return float("nan")
+    rates = 1 / onp.real(roots) - 1
+    return float(rates[onp.argmin(onp.abs(rates))])
+
+
+def rate(nper, pmt, pv, fv, when="end", guess=0.1, tol=1e-6, maxiter=100):
+    """Newton iteration on the annuity identity (numpy-financial g/g')."""
+    w = _fin_when(when)
+    nper, pmt, pv, fv = map(onp.asarray, (nper, pmt, pv, fv))
+    rn = onp.asarray(guess, dtype=onp.float64)
+    for _ in range(maxiter):
+        t1 = (rn + 1) ** nper
+        t2 = (rn + 1) ** (nper - 1)
+        g = fv + t1 * pv + pmt * (t1 - 1) * (rn * w + 1) / rn
+        gp = (nper * t2 * pv - pmt * (t1 - 1) * (rn * w + 1) / (rn ** 2)
+              + nper * pmt * t2 * (rn * w + 1) / rn
+              + pmt * (t1 - 1) * w / rn)
+        rnp1 = rn - g / gp
+        if onp.all(onp.abs(rnp1 - rn) < tol):
+            return _fin_lift(rnp1)
+        rn = rnp1
+    return _fin_lift(rn)

@@ -265,9 +265,11 @@ def multinomial(n, pvals, size=None):
     pv = _val(pvals) if isinstance(pvals, NDArray) else jnp.asarray(pvals)
 
     def sampler(k, s):
-        shape = s if s else ()
-        return jax.random.multinomial(k, n, pv, shape=shape + pv.shape[:-1]
-                                      if shape else None)
+        # NumPy semantics: result shape is size + (num_categories,);
+        # jax.random.multinomial's `shape` is the FULL result shape.
+        if s:
+            return jax.random.multinomial(k, n, pv, shape=tuple(s) + pv.shape)
+        return jax.random.multinomial(k, n, pv)
 
     return _make(sampler, size, None, onp.int64)
 

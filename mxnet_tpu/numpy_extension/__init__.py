@@ -385,6 +385,20 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kwargs):
     return apply_op(f, _c(data), name="arange_like")
 
 
+def batch_dot(a, b, transpose_a=False, transpose_b=False,
+              forward_stype="default", **kwargs):
+    """Batched matrix product over leading batch dims (parity:
+    reference ndarray/numpy_extension/_op.py:1321 `batch_dot`). Lowers
+    to jnp.matmul so XLA maps it onto the MXU as one batched contraction."""
+    def fn(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return jnp.matmul(x, y)
+    return apply_op(fn, _c(a), _c(b), name="batch_dot")
+
+
 def broadcast_like(lhs, rhs, lhs_axes=None, rhs_axes=None, **kwargs):
     return apply_op(lambda a, b: jnp.broadcast_to(a, b.shape), _c(lhs),
                     _c(rhs), name="broadcast_like")

@@ -1,0 +1,502 @@
+"""INT8 post-training quantization (PTQ) with calibration.
+
+Capability parity with the reference's quantization pillar:
+- python driver:     python/mxnet/contrib/quantization.py:755 `quantize_net`
+- calibration:       src/operator/quantization/calibrate.cc (entropy/KL),
+                     _LayerOutputMinMaxCollector (naive min-max)
+- graph rewrite:     src/operator/quantization/quantize_graph_pass.cc
+
+TPU-first redesign: instead of an nnvm graph pass inserting
+quantize/requantize nodes around oneDNN int8 kernels, quantizable Gluon
+layers (Dense, Conv) are swapped for quantized twins whose forward is
+
+    x_q   = clip(round(x / s_x), -127, 127)      -> int8
+    acc   = dot/conv(x_q, w_q)  int8 x int8      -> int32  (MXU int8 path)
+    out   = acc * (s_x * s_w) + bias             -> fp32   (dequantize)
+
+`s_x` comes from calibration (naive min-max or entropy/KL-optimal
+thresholds, same algorithms as the reference) or is computed in-graph
+for `calib_mode='none'`. Weights are pre-quantized per-tensor or
+per-output-channel (`quantize_granularity='channel-wise'`). After the
+swap the net is still a HybridBlock: hybridizing produces ONE XLA
+program with int8 convolutions/dots visible in the lowered HLO.
+"""
+from __future__ import annotations
+
+import fnmatch
+import logging
+
+import numpy as onp
+import jax.numpy as jnp
+from jax import lax
+
+from ..ndarray.ndarray import NDArray
+from ..ops import apply_op
+from ..gluon.block import HybridBlock
+from ..gluon import nn as _nn
+
+__all__ = ["CalibrationCollector", "quantize_net",
+           "QuantizedDense", "QuantizedConv"]
+
+_INT8_MAX = 127.0
+
+
+# ---------------------------------------------------------------------------
+# collectors
+# ---------------------------------------------------------------------------
+class CalibrationCollector:
+    """Base calibration collector (parity:
+    python/mxnet/contrib/quantization.py:163). Subclasses observe the
+    INPUT of every to-be-quantized layer during calibration forwards and
+    produce `{layer_name: (min, max)}` in `post_collect`."""
+
+    def __init__(self):
+        self.include_layers = None
+
+    def collect(self, name, arr):
+        raise NotImplementedError
+
+    def post_collect(self):
+        raise NotImplementedError
+
+
+class _LayerInputMinMaxCollector(CalibrationCollector):
+    """`calib_mode='naive'` — running min/max of each layer input
+    (parity: _LayerOutputMinMaxCollector, quantization.py:294)."""
+
+    def __init__(self, logger=None):
+        super().__init__()
+        self.min_max_dict = {}
+        self.logger = logger
+
+    def collect(self, name, arr):
+        host = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
+        lo, hi = float(host.min()), float(host.max())
+        if name in self.min_max_dict:
+            olo, ohi = self.min_max_dict[name]
+            self.min_max_dict[name] = (min(olo, lo), max(ohi, hi))
+        else:
+            self.min_max_dict[name] = (lo, hi)
+
+    def post_collect(self):
+        return self.min_max_dict
+
+
+class _LayerHistogramCollector(CalibrationCollector):
+    """`calib_mode='entropy'` — KL-divergence-optimal thresholds
+    (parity: _LayerHistogramCollector, quantization.py:193, and the
+    C++ entropy path src/operator/quantization/calibrate.cc)."""
+
+    def __init__(self, num_bins=8001, logger=None):
+        super().__init__()
+        self.hist_dict = {}
+        self.num_bins = num_bins
+        self.logger = logger
+
+    def collect(self, name, arr):
+        host = arr.asnumpy() if isinstance(arr, NDArray) else onp.asarray(arr)
+        th = float(max(abs(host.min()), abs(host.max()), 1e-12))
+        if name not in self.hist_dict:
+            hist, edges = onp.histogram(host, bins=self.num_bins,
+                                        range=(-th, th))
+            self.hist_dict[name] = (hist, edges, th)
+            return
+        old_hist, old_edges, old_th = self.hist_dict[name]
+        if th <= old_th:
+            hist, _ = onp.histogram(host, bins=len(old_hist),
+                                    range=(-old_th, old_th))
+            self.hist_dict[name] = (old_hist + hist, old_edges, old_th)
+        else:
+            # widen: extend symmetric bins in whole old-bin steps so old
+            # counts land exactly in the middle of the new histogram
+            old_bins = len(old_hist)
+            step = 2 * old_th / old_bins
+            grow = int((th - old_th) // step + 1)
+            new_bins = old_bins + 2 * grow
+            new_th = grow * step + old_th
+            hist, edges = onp.histogram(host, bins=new_bins,
+                                        range=(-new_th, new_th))
+            hist[grow:new_bins - grow] += old_hist
+            self.hist_dict[name] = (hist, edges, new_th)
+
+    @staticmethod
+    def get_optimal_threshold(hist, hist_edges, num_quantized_bins=255):
+        """KL-optimal clip threshold for a symmetric histogram
+        (the TensorRT/MXNet entropy-calibration algorithm, rewritten:
+        slide a candidate clip window outward, compare the clipped
+        reference distribution P against its `num_quantized_bins`-level
+        quantization Q, keep the threshold minimizing KL(P||Q))."""
+        num_bins = len(hist)
+        assert num_bins % 2 == 1, "histogram must be symmetric (odd bins)"
+        zero_bin = num_bins // 2
+        half_q = num_quantized_bins // 2
+        centers = (hist_edges[:-1] + hist_edges[1:]) / 2
+        best_kl, best_th = onp.inf, float(abs(hist_edges[-1]))
+        hist = hist.astype(onp.float64)
+        eps = 1e-8
+        for i in range(half_q, zero_bin + 1):
+            lo, hi = zero_bin - i, zero_bin + i + 1
+            sliced = hist[lo:hi]
+            # P: clipped distribution — outlier mass collapses onto the
+            # clip edges, so aggressive clipping inflates the edges
+            p = sliced.copy()
+            p[0] += hist[:lo].sum()
+            p[-1] += hist[hi:].sum()
+            nonzero = p > 0
+            if nonzero.sum() == 0 or sliced.sum() == 0:
+                continue
+            # Q: the int8 model of the WINDOW ONLY (no outlier mass) —
+            # each of the num_quantized_bins levels spreads its window
+            # mass uniformly over its nonzero source bins. Clipping that
+            # discards real mass therefore shows up as P≫Q at the edges
+            # and is penalized by KL(P||Q).
+            n = len(sliced)
+            q = onp.zeros(n)
+            chunk = n // num_quantized_bins
+            for j in range(num_quantized_bins):
+                s = j * chunk
+                e = n if j == num_quantized_bins - 1 else (j + 1) * chunk
+                mass = sliced[s:e].sum()
+                count = nonzero[s:e].sum()
+                if count:
+                    q[s:e][nonzero[s:e]] = mass / count
+            if q.sum() == 0:
+                continue
+            p_norm = p / p.sum() + eps
+            q_norm = q / q.sum() + eps
+            kl = float((p_norm * onp.log(p_norm / q_norm)).sum())
+            if kl < best_kl:
+                best_kl = kl
+                best_th = float(abs(centers[hi - 1]))
+        return best_th
+
+    def post_collect(self):
+        out = {}
+        for name, (hist, edges, _th) in self.hist_dict.items():
+            th = self.get_optimal_threshold(hist, edges)
+            out[name] = (-th, th)
+            if self.logger:
+                self.logger.info("entropy threshold %s = %.5f", name, th)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# quantized kernels
+# ---------------------------------------------------------------------------
+def _quantize_weight(w, channel_axis, granularity):
+    """fp32 weight -> (int8 weight, fp32 scale) with symmetric range."""
+    if granularity == "channel-wise":
+        axes = tuple(i for i in range(w.ndim) if i != channel_axis)
+        absmax = onp.abs(w).max(axis=axes, keepdims=True)
+    else:
+        absmax = onp.abs(w).max()
+    absmax = onp.maximum(absmax, 1e-12)
+    scale = absmax / _INT8_MAX
+    wq = onp.clip(onp.round(w / scale), -127, 127).astype(onp.int8)
+    return wq, scale.astype(onp.float32)
+
+
+def _quantize_act(x, scale):
+    return jnp.clip(jnp.round(x / scale), -_INT8_MAX, _INT8_MAX) \
+        .astype(jnp.int8)
+
+
+def _dynamic_scale(x):
+    return jnp.maximum(jnp.abs(x).max(), 1e-12) / _INT8_MAX
+
+
+class QuantizedDense(HybridBlock):
+    """int8 twin of nn.Dense (parity: quantized_fully_connected,
+    src/operator/quantization/quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, in_range=None,
+                 granularity="channel-wise"):
+        super().__init__()
+        self._units = dense._units
+        self._flatten = dense._flatten
+        self.act = dense.act
+        w = dense.weight.data().asnumpy()          # (units, in)
+        wq, w_scale = _quantize_weight(w, 0, granularity)
+        # device-resident once; eager forwards must not re-upload
+        self._wq = jnp.asarray(wq)
+        self._w_scale = jnp.asarray(w_scale.reshape(-1))
+        self._bias = (jnp.asarray(dense.bias.data().asnumpy())
+                      if dense.bias is not None else None)
+        # static input scale from calibration, or None -> in-graph
+        self._in_scale = (max(abs(in_range[0]), abs(in_range[1]))
+                          / _INT8_MAX if in_range is not None else None)
+
+    def forward(self, x):
+        wq = self._wq
+        w_scale = self._w_scale
+        bias = self._bias
+        s_in = self._in_scale
+
+        def fn(xr):
+            xr2 = xr.reshape(xr.shape[0], -1) if self._flatten else xr
+            s_x = jnp.float32(s_in) if s_in is not None \
+                else _dynamic_scale(xr2)
+            xq = _quantize_act(xr2, s_x)
+            acc = lax.dot_general(xq, wq,
+                                  (((xq.ndim - 1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (s_x * w_scale)
+            if bias is not None:
+                out = out + bias
+            return out
+
+        out = apply_op(fn, x, name="quantized_dense")
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return f"QuantizedDense(int8, units={self._units})"
+
+
+class QuantizedConv(HybridBlock):
+    """int8 twin of nn.Conv1D/2D/3D (parity: quantized_conv,
+    src/operator/quantization/quantized_conv.cc)."""
+
+    def __init__(self, conv, in_range=None, granularity="channel-wise"):
+        super().__init__()
+        assert conv._op_name == "convolution", \
+            "only forward convolutions can be quantized"
+        self._kernel = conv._kernel
+        self._stride = conv._stride
+        self._pad = conv._pad
+        self._dilate = conv._dilate
+        self._groups = conv._groups
+        self._layout = conv._layout
+        self._channels = conv._channels
+        self.act = conv.act
+        w = conv.weight.data().asnumpy()
+        ch_axis = 0  # weight layout puts out-channels first in both
+        wq, w_scale = _quantize_weight(w, ch_axis, granularity)
+        self._wq = jnp.asarray(wq)
+        self._w_scale = jnp.asarray(w_scale.reshape(-1))
+        self._bias = (jnp.asarray(conv.bias.data().asnumpy())
+                      if conv.bias is not None else None)
+        self._in_scale = (max(abs(in_range[0]), abs(in_range[1]))
+                          / _INT8_MAX if in_range is not None else None)
+
+    def forward(self, x):
+        from ..ops import nn as _opsnn
+        wq = self._wq
+        w_scale = self._w_scale
+        bias = self._bias
+        s_in = self._in_scale
+        nsp = len(self._kernel)
+        stride = self._stride if isinstance(self._stride, tuple) \
+            else (self._stride,) * nsp
+        dilate = self._dilate if isinstance(self._dilate, tuple) \
+            else (self._dilate,) * nsp
+        pad = self._pad if isinstance(self._pad, tuple) \
+            else (self._pad,) * nsp
+        layout = self._layout
+        nc = layout.startswith("NC")
+
+        def fn(xr):
+            s_x = jnp.float32(s_in) if s_in is not None \
+                else _dynamic_scale(xr)
+            xq = _quantize_act(xr, s_x)
+            lhs, rhs, out_spec = _opsnn._conv_dims(layout)
+            # reference weight layout: (O, I/g, *k) for NC*,
+            # (O, *k, I/g) otherwise — same dim orders ops/nn.py uses
+            wspec = rhs
+            acc = lax.conv_general_dilated(
+                xq, wq, stride,
+                [(p, p) for p in pad],
+                rhs_dilation=dilate,
+                dimension_numbers=(lhs, wspec, out_spec),
+                feature_group_count=self._groups,
+                preferred_element_type=jnp.int32)
+            scale = s_x * w_scale
+            bshape = [1] * acc.ndim
+            bshape[1 if nc else acc.ndim - 1] = -1
+            out = acc.astype(jnp.float32) * scale.reshape(bshape)
+            if bias is not None:
+                out = out + bias.reshape(bshape)
+            return out
+
+        out = apply_op(fn, x, name="quantized_conv")
+        if self.act is not None:
+            out = self.act(out)
+        return out
+
+    def __repr__(self):
+        return (f"QuantizedConv(int8, channels={self._channels}, "
+                f"kernel={self._kernel})")
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+def _walk_quantizable(block, prefix=""):
+    """Yield (parent, child_key, dotted_name, child) for quantizable
+    leaves, depth-first (dotted names match collect_params keys)."""
+    for key, child in list(block._children.items()):
+        name = f"{prefix}{key}"
+        if isinstance(child, _nn.Dense) or (
+                isinstance(child, _nn.conv_layers._Conv)
+                and child._op_name == "convolution"):
+            yield block, key, name, child
+        else:
+            yield from _walk_quantizable(child, name + ".")
+
+
+def _attr_name_for_child(parent, child):
+    for attr, val in vars(parent).items():
+        if val is child:
+            return attr
+    return None
+
+
+def quantize_net(network, quantized_dtype="auto", quantize_mode="full",
+                 quantize_granularity="tensor-wise", exclude_layers=None,
+                 exclude_layers_match=None, exclude_operators=None,
+                 calib_data=None, data_shapes=None, calib_mode="none",
+                 num_calib_batches=None, ctx=None,
+                 LayerOutputCollector=None, logger=None):
+    """Quantize a Gluon HybridBlock to int8 (parity:
+    python/mxnet/contrib/quantization.py:755 `quantize_net`).
+
+    Returns the same network with quantizable layers swapped for int8
+    twins; hybridize it afterwards to compile one XLA program with int8
+    contractions. `calib_mode`: 'none' (dynamic in-graph ranges),
+    'naive' (min-max over `calib_data`), 'entropy' (KL-optimal
+    thresholds over `calib_data`), 'custom' (user collector).
+    """
+    logger = logger or logging.getLogger(__name__)
+    if quantized_dtype not in ("auto", "int8", "uint8"):
+        raise ValueError(f"unsupported quantized_dtype {quantized_dtype!r}")
+    if quantized_dtype == "uint8":
+        raise ValueError("uint8 quantization is not supported on TPU; "
+                         "the MXU int8 path is symmetric — use 'int8'")
+    if quantize_granularity not in ("tensor-wise", "channel-wise"):
+        raise ValueError(
+            f"unsupported quantize_granularity {quantize_granularity!r}")
+    if quantize_mode not in ("full", "smart"):
+        raise ValueError(f"unsupported quantize_mode {quantize_mode!r}")
+    if quantize_mode == "smart":
+        logger.warning("quantize_mode='smart' is treated as 'full' here: "
+                       "XLA fuses the dequantize boundaries itself, so "
+                       "there is no oneDNN-style op-pattern whitelist to "
+                       "be smart about")
+
+    exclude_layers = set(exclude_layers or [])
+    exclude_layers_match = list(exclude_layers_match or [])
+    exclude_operators = set(exclude_operators or [])
+
+    targets = []
+    for parent, key, name, child in _walk_quantizable(network):
+        if name in exclude_layers:
+            continue
+        if any(fnmatch.fnmatch(name, pat) or pat in name
+               for pat in exclude_layers_match):
+            continue
+        opname = ("FullyConnected" if isinstance(child, _nn.Dense)
+                  else "Convolution")
+        if opname in exclude_operators:
+            continue
+        targets.append((parent, key, name, child))
+    if not targets:
+        raise ValueError("network has no quantizable layers")
+
+    # Calibration must run eagerly: a compiled CachedOp replays the
+    # whole graph without invoking child __call__, so hooks would never
+    # fire (or would fire on tracers during the build). Deactivate
+    # hybridization for the duration; the caller re-hybridizes the
+    # quantized net.
+    was_active = []
+    for b in network._iter_blocks():
+        if getattr(b, "_active", False):
+            was_active.append(b)
+            b._active = False
+        if hasattr(b, "_clear_cached_op"):
+            b._clear_cached_op()
+
+    # Materialize deferred parameters before reading weights: the
+    # reference runs a dummy forward from data_shapes
+    # (quantization.py:829); calib_data's first batch works too.
+    if any(not p._shape_known() or p._data is None
+           for _, _, _, child in targets
+           for p in child._reg_params.values()):
+        if calib_data is not None:
+            probe = next(iter(calib_data))
+            probe = probe[0] if isinstance(probe, (list, tuple)) else probe
+            network(probe)
+        elif data_shapes is not None:
+            from ..numpy import zeros
+            network(*[zeros(tuple(s)) for s in data_shapes])
+        else:
+            raise ValueError(
+                "network has uninitialized (deferred) parameters; provide "
+                "calib_data or data_shapes so a shape-inferring forward "
+                "can run first")
+
+    # ---- calibration ----
+    in_ranges = {}
+    if calib_mode != "none":
+        if calib_mode == "naive":
+            collector = _LayerInputMinMaxCollector(logger=logger)
+        elif calib_mode == "entropy":
+            collector = _LayerHistogramCollector(logger=logger)
+        elif calib_mode == "custom":
+            if LayerOutputCollector is None:
+                raise ValueError(
+                    "calib_mode='custom' needs LayerOutputCollector")
+            collector = LayerOutputCollector
+        else:
+            raise ValueError(f"unknown calib_mode {calib_mode!r}")
+        collector.include_layers = [name for _, _, name, _ in targets]
+        if calib_data is None:
+            raise ValueError(
+                f"calib_mode={calib_mode!r} requires calib_data")
+
+        handles = []
+        for _, _, name, child in targets:
+            def make_hook(nm):
+                def pre_hook(block, args):
+                    collector.collect(nm, args[0])
+                return pre_hook
+            handles.append(
+                child.register_forward_pre_hook(make_hook(name)))
+        try:
+            nb = 0
+            for batch in calib_data:
+                data = batch[0] if isinstance(batch, (list, tuple)) \
+                    else batch
+                network(data)
+                nb += 1
+                if num_calib_batches is not None and \
+                        nb >= num_calib_batches:
+                    break
+            logger.info("calibrated on %d batches (%s)", nb, calib_mode)
+        finally:
+            for h in handles:
+                h.detach()
+        in_ranges = collector.post_collect()
+
+    # ---- swap in quantized twins ----
+    for parent, key, name, child in targets:
+        rng = in_ranges.get(name)
+        if isinstance(child, _nn.Dense):
+            q = QuantizedDense(child, in_range=rng,
+                              granularity=quantize_granularity)
+        else:
+            q = QuantizedConv(child, in_range=rng,
+                              granularity=quantize_granularity)
+        parent._children[key] = q
+        attr = _attr_name_for_child(parent, child)
+        if attr is not None:
+            object.__setattr__(parent, attr, q)
+        logger.info("quantized %s -> %r", name, q)
+
+    # restore hybridization on surviving blocks; caches are stale
+    for b in was_active:
+        b._active = True
+    for b in network._iter_blocks():
+        if hasattr(b, "_clear_cached_op"):
+            b._clear_cached_op()
+    return network

@@ -279,6 +279,9 @@ class _HookHandle:
     def remove(self):
         self._hooks.pop(self._key, None)
 
+    # the reference's HookHandle spells it detach() (gluon/utils.py)
+    detach = remove
+
 
 class _CachedEntry:
     __slots__ = ("fwd", "fwd_vjp", "bwd", "out_spec", "aux_targets",

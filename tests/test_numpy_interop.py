@@ -130,3 +130,147 @@ def test_comparison_with_numpy_operand():
     out = onp.array([2.0, 2.0], onp.float32) < a
     assert isinstance(out, NDArray)
     assert out.asnumpy().tolist() == [False, True]
+
+
+# ---------------------------------------------------------------------------
+# Conformance sweep: NumPy's own call forms dispatched through
+# __array_function__ must return NDArray results matching host NumPy
+# (parity model: tests/python/unittest/test_numpy_interoperability.py's
+# OpArgMngr workload table).
+# ---------------------------------------------------------------------------
+_A = onp.arange(12, dtype=onp.float32).reshape(3, 4) + 1.0
+_B = onp.arange(12, dtype=onp.float32).reshape(3, 4) * 0.5 + 0.25
+_V = onp.linspace(0.1, 2.0, 8, dtype=onp.float32)
+_SQ = (onp.arange(9, dtype=onp.float32).reshape(3, 3)
+       + onp.eye(3, dtype=onp.float32) * 9.0)
+
+_WORKLOADS = [
+    ("add", lambda m: onp.add(m(_A), m(_B))),
+    ("subtract", lambda m: onp.subtract(m(_A), m(_B))),
+    ("multiply", lambda m: onp.multiply(m(_A), m(_B))),
+    ("true_divide", lambda m: onp.true_divide(m(_A), m(_B))),
+    ("power", lambda m: onp.power(m(_A), 2.0)),
+    ("sqrt", lambda m: onp.sqrt(m(_A))),
+    ("exp", lambda m: onp.exp(m(_V))),
+    ("log", lambda m: onp.log(m(_A))),
+    ("abs", lambda m: onp.abs(m(-_A))),
+    ("sin", lambda m: onp.sin(m(_V))),
+    ("tanh", lambda m: onp.tanh(m(_V))),
+    ("maximum", lambda m: onp.maximum(m(_A), m(_B))),
+    ("minimum", lambda m: onp.minimum(m(_A), m(_B))),
+    ("clip", lambda m: onp.clip(m(_A), 2.0, 9.0)),
+    ("sum", lambda m: onp.sum(m(_A), axis=1)),
+    ("mean", lambda m: onp.mean(m(_A), axis=0)),
+    ("std", lambda m: onp.std(m(_A))),
+    ("var", lambda m: onp.var(m(_A), axis=1)),
+    ("prod", lambda m: onp.prod(m(_V))),
+    ("cumsum", lambda m: onp.cumsum(m(_A), axis=1)),
+    ("argmax", lambda m: onp.argmax(m(_A), axis=1)),
+    ("argmin", lambda m: onp.argmin(m(_A), axis=0)),
+    ("argsort", lambda m: onp.argsort(m(_B), axis=1)),
+    ("sort", lambda m: onp.sort(m(_B), axis=1)),
+    ("max", lambda m: onp.max(m(_A), axis=1)),
+    ("min", lambda m: onp.min(m(_A))),
+    ("transpose", lambda m: onp.transpose(m(_A))),
+    ("reshape", lambda m: onp.reshape(m(_A), (4, 3))),
+    ("ravel", lambda m: onp.ravel(m(_A))),
+    ("squeeze", lambda m: onp.squeeze(m(_A[None]))),
+    ("expand_dims", lambda m: onp.expand_dims(m(_A), 0)),
+    ("concatenate", lambda m: onp.concatenate([m(_A), m(_B)], axis=0)),
+    ("stack", lambda m: onp.stack([m(_A), m(_B)])),
+    ("split", lambda m: onp.split(m(_A), 2, axis=1)),
+    ("tile", lambda m: onp.tile(m(_V), 2)),
+    ("repeat", lambda m: onp.repeat(m(_V), 3)),
+    ("roll", lambda m: onp.roll(m(_A), 2)),
+    ("flip", lambda m: onp.flip(m(_A), axis=1)),
+    ("where", lambda m: onp.where(m(_A) > 5.0, m(_A), m(_B))),
+    ("take", lambda m: onp.take(m(_V), onp.array([0, 3, 5]))),
+    ("dot", lambda m: onp.dot(m(_A), m(_B).T)),
+    ("matmul", lambda m: onp.matmul(m(_A), m(_B).T)),
+    ("inner", lambda m: onp.inner(m(_V), m(_V))),
+    ("outer", lambda m: onp.outer(m(_V), m(_V))),
+    ("tensordot", lambda m: onp.tensordot(m(_A), m(_B), axes=([1], [1]))),
+    ("einsum", lambda m: onp.einsum("ij,kj->ik", m(_A), m(_B))),
+    ("trace", lambda m: onp.trace(m(_SQ))),
+    ("diag", lambda m: onp.diag(m(_SQ))),
+    ("tril", lambda m: onp.tril(m(_SQ))),
+    ("triu", lambda m: onp.triu(m(_SQ))),
+    ("linalg.norm", lambda m: onp.linalg.norm(m(_A))),
+    ("linalg.det", lambda m: onp.linalg.det(m(_SQ))),
+    ("linalg.inv", lambda m: onp.linalg.inv(m(_SQ))),
+    ("linalg.solve", lambda m: onp.linalg.solve(m(_SQ), m(_V[:3]))),
+    ("linalg.cholesky", lambda m: onp.linalg.cholesky(
+        m(_SQ @ _SQ.T + onp.eye(3, dtype=onp.float32) * 9.0))),
+    ("fft.fft", lambda m: onp.fft.fft(m(_V))),
+    ("mean-keepdims", lambda m: onp.mean(m(_A), axis=1, keepdims=True)),
+    ("broadcast_to", lambda m: onp.broadcast_to(m(_V[:4]), (3, 4))),
+    ("atleast_2d", lambda m: onp.atleast_2d(m(_V))),
+    ("vstack", lambda m: onp.vstack([m(_A), m(_B)])),
+    ("hstack", lambda m: onp.hstack([m(_A), m(_B)])),
+    ("unique", lambda m: onp.unique(m(onp.array([1., 2., 2., 3.],
+                                                onp.float32)))),
+    ("median", lambda m: onp.median(m(_A))),
+    ("percentile", lambda m: onp.percentile(m(_A), 50)),
+    ("quantile", lambda m: onp.quantile(m(_A), 0.5)),
+    ("nanmean", lambda m: onp.nanmean(m(_A))),
+    ("nansum", lambda m: onp.nansum(m(_A))),
+    ("isnan", lambda m: onp.isnan(m(_A))),
+    ("isfinite", lambda m: onp.isfinite(m(_A))),
+    ("sign", lambda m: onp.sign(m(_A - 5.0))),
+    ("floor", lambda m: onp.floor(m(_B))),
+    ("ceil", lambda m: onp.ceil(m(_B))),
+    ("around", lambda m: onp.around(m(_B), 1)),
+    ("diff", lambda m: onp.diff(m(_V))),
+    ("gradient", lambda m: onp.gradient(m(_V))),
+    ("interp", lambda m: onp.interp(m(_V), m(onp.sort(_V)), m(_V))),
+    ("histogram", lambda m: onp.histogram(m(_V), bins=4)),
+    ("bincount", lambda m: onp.bincount(
+        m(onp.array([0, 1, 1, 2], onp.int32)))),
+    ("searchsorted", lambda m: onp.searchsorted(m(onp.sort(_V)), 1.0)),
+    ("count_nonzero", lambda m: onp.count_nonzero(m(_A) > 5.0)),
+    ("allclose", lambda m: onp.allclose(m(_A), m(_A))),
+    ("array_equal", lambda m: onp.array_equal(m(_A), m(_A))),
+    ("kron", lambda m: onp.kron(m(_SQ), m(_SQ))),
+    ("meshgrid", lambda m: onp.meshgrid(m(_V[:3]), m(_V[:4]))),
+    ("pad", lambda m: onp.pad(m(_A), 1)),
+    ("rot90", lambda m: onp.rot90(m(_A))),
+    ("cross", lambda m: onp.cross(m(_V[:3]), m(_V[3:6]))),
+    ("cov", lambda m: onp.cov(m(_A))),
+    ("corrcoef", lambda m: onp.corrcoef(m(_A))),
+    ("average", lambda m: onp.average(m(_A), axis=0)),
+    ("ptp", lambda m: onp.ptp(m(_A), axis=1)),
+    ("nan_to_num", lambda m: onp.nan_to_num(m(_A))),
+    ("convolve", lambda m: onp.convolve(m(_V), m(_V[:3]))),
+    ("lcm", lambda m: onp.lcm(m(onp.array([4, 6], onp.int32)),
+                              m(onp.array([6, 4], onp.int32)))),
+    ("gcd", lambda m: onp.gcd(m(onp.array([4, 6], onp.int32)),
+                              m(onp.array([6, 4], onp.int32)))),
+]
+
+
+def _flatten_result(r):
+    if isinstance(r, (list, tuple)):
+        out = []
+        for x in r:
+            out.extend(_flatten_result(x))
+        return out
+    return [r]
+
+
+@pytest.mark.parametrize("name,workload",
+                         _WORKLOADS, ids=[w[0] for w in _WORKLOADS])
+def test_conformance(name, workload):
+    got = _flatten_result(workload(lambda a: np.array(a)))
+    want = _flatten_result(workload(lambda a: a))
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        g = g.asnumpy() if hasattr(g, "asnumpy") else onp.asarray(g)
+        w = onp.asarray(w)
+        # complex results compare as complex (a float64 cast would
+        # silently drop the imaginary part)
+        cmp = onp.complex128 if (onp.iscomplexobj(g) or
+                                 onp.iscomplexobj(w)) else onp.float64
+        onp.testing.assert_allclose(onp.asarray(g, cmp),
+                                    onp.asarray(w, cmp),
+                                    rtol=2e-4, atol=1e-5,
+                                    err_msg=f"conformance mismatch: {name}")

@@ -1,0 +1,185 @@
+"""INT8 PTQ tests (parity model: tests/python/quantization/ and the
+contrib/quantization.py driver; accuracy bar from
+example/quantization/README.md — int8 within ~1pt of fp32)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.contrib import quantization as q
+
+
+def _small_cnn():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.Dense(10))
+    net.initialize()
+    return net
+
+
+@pytest.mark.parametrize("mode", ["none", "naive", "entropy"])
+def test_quantize_cnn_close_to_fp32(mode):
+    net = _small_cnn()
+    x = mx.np.random.uniform(-1, 1, size=(4, 3, 16, 16))
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode=mode,
+                          quantize_granularity="channel-wise")
+    out = qnet(x).asnumpy()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert rel < 0.06, f"{mode}: int8 deviates {rel:.3f} from fp32"
+    # hybridized graph must reproduce the eager quantized numbers
+    qnet.hybridize()
+    out_h = qnet(x).asnumpy()
+    onp.testing.assert_allclose(out_h, out, atol=1e-5)
+
+
+def test_int8_ops_in_lowered_hlo():
+    """The compiled XLA program must actually contain s8 contractions
+    (VERDICT r2 'Done' bar: int8 ops visible in lowered HLO)."""
+    net = _small_cnn()
+    x = mx.np.random.uniform(-1, 1, size=(2, 3, 16, 16))
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode="naive")
+    qnet.hybridize()
+    qnet(x)  # builds the CachedOp entry
+    entry = next(iter(qnet._cached_op._entries.values()))
+    import jax
+    key = jax.random.PRNGKey(0)
+    param_datas = [nd._data for nd in entry.param_nds]
+    hlo = entry.fwd.lower(key, param_datas, [x._data]).as_text()
+    # StableHLO spells signed-int tensors i8/i32
+    assert "xi8>" in hlo, "no int8 tensors in the lowered program"
+    assert "xi32>" in hlo, "no int32 accumulation in the lowered program"
+
+
+def test_trained_mlp_accuracy_within_1pt():
+    """Train fp32 to high accuracy on a separable synthetic task, then
+    check int8 accuracy drop <= 1pt (BASELINE.md quantization bar)."""
+    rng = onp.random.RandomState(0)
+    n, d, k = 1024, 16, 4
+    centers = rng.uniform(-2, 2, size=(k, d)).astype(onp.float32)
+    labels = rng.randint(0, k, size=n)
+    data = centers[labels] + rng.normal(0, 0.35, size=(n, d)) \
+        .astype(onp.float32)
+    x = mx.np.array(data)
+    y = mx.np.array(labels.astype(onp.int32))
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(k))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(60):
+        with autograd.record():
+            l = loss_fn(net(x), y).mean()
+        l.backward()
+        tr.step(1)
+
+    def acc(m):
+        pred = m(x).asnumpy().argmax(axis=1)
+        return (pred == labels).mean()
+
+    fp32_acc = acc(net)
+    assert fp32_acc > 0.9, f"fp32 net failed to train ({fp32_acc})"
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode="entropy")
+    int8_acc = acc(qnet)
+    assert fp32_acc - int8_acc <= 0.01, \
+        f"int8 accuracy dropped {fp32_acc - int8_acc:.3f} (> 1pt)"
+
+
+def test_quantize_resnet18_v1():
+    """VERDICT r2 item #2 'Done' criterion: quantize resnet18_v1 on
+    synthetic data; outputs stay close; int8 in the program."""
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=10)
+    net.initialize()
+    x = mx.np.random.uniform(0, 1, size=(2, 3, 64, 64))
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode="naive",
+                          quantize_granularity="channel-wise")
+    out = qnet(x).asnumpy()
+    # argmax agreement + bounded relative error on logits
+    assert (out.argmax(1) == ref.argmax(1)).all()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert rel < 0.1, f"resnet18 int8 rel err {rel:.3f}"
+
+
+def test_exclude_layers_and_operators():
+    net = _small_cnn()
+    x = mx.np.random.uniform(-1, 1, size=(2, 3, 16, 16))
+    net(x)
+    qnet = q.quantize_net(net, calib_mode="none",
+                          exclude_operators=["Convolution"])
+    kinds = [type(c).__name__ for c in qnet._children.values()]
+    assert "QuantizedDense" in kinds
+    assert "QuantizedConv" not in kinds
+    assert kinds.count("Conv2D") == 2  # convs untouched
+
+
+def test_entropy_threshold_clips_outliers():
+    """KL calibration must pick a threshold well inside an outlier's
+    range (the whole point of entropy vs naive calibration)."""
+    rng = onp.random.RandomState(3)
+    bulk = rng.normal(0, 1, size=50_000).astype(onp.float32)
+    spiked = onp.concatenate([bulk, onp.array([40.0], onp.float32)])
+    c = q._LayerHistogramCollector()
+    c.collect("l", mx.np.array(spiked))
+    (lo, hi), = c.post_collect().values()
+    assert hi < 20.0, f"entropy threshold {hi} did not clip the outlier"
+    naive = q._LayerInputMinMaxCollector()
+    naive.collect("l", mx.np.array(spiked))
+    (_, nhi), = naive.post_collect().values()
+    assert nhi == pytest.approx(40.0)
+
+
+def test_custom_collector_mode():
+    class FixedCollector(q.CalibrationCollector):
+        def __init__(self):
+            super().__init__()
+            self.seen = []
+
+        def collect(self, name, arr):
+            self.seen.append(name)
+
+        def post_collect(self):
+            return {n: (-1.0, 1.0) for n in self.include_layers}
+
+    net = _small_cnn()
+    x = mx.np.random.uniform(-1, 1, size=(2, 3, 16, 16))
+    coll = FixedCollector()
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode="custom",
+                          LayerOutputCollector=coll)
+    assert coll.seen  # hooks fired
+    out = qnet(x)
+    assert out.shape == (2, 10)
+
+
+def test_calibration_on_already_hybridized_net():
+    """quantize_net must calibrate correctly even when the input net is
+    hybridized and its CachedOp already compiled (hooks don't fire
+    through a compiled replay — quantize_net has to drop to eager)."""
+    net = _small_cnn()
+    x = mx.np.random.uniform(-1, 1, size=(2, 3, 16, 16))
+    net.hybridize()
+    net(x)  # populate the CachedOp cache
+    ref = net(x).asnumpy()
+    qnet = q.quantize_net(net, calib_data=[(x,)], calib_mode="naive")
+    # calibration actually happened: static scales, not dynamic
+    assert all(c._in_scale is not None
+               for c in qnet._children.values()
+               if isinstance(c, (q.QuantizedDense, q.QuantizedConv)))
+    out = qnet(x).asnumpy()
+    rel = onp.abs(out - ref).max() / (onp.abs(ref).max() + 1e-9)
+    assert rel < 0.06
+
+
+def test_deferred_params_materialized_from_data_shapes():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()  # shapes still deferred — no forward yet
+    qnet = q.quantize_net(net, data_shapes=[(2, 16)], calib_mode="none")
+    out = qnet(mx.np.random.uniform(size=(2, 16)))
+    assert out.shape == (2, 4)

@@ -2,12 +2,23 @@
 
 Mirrors BASELINE.json config 2 (Gluon ResNet-50, hybridized/fused train
 step). Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
 
-`vs_baseline` compares images/sec/chip against the published MXNet
-ResNet-50 fp32 per-V100 throughput (~360 images/sec/GPU on 8xV100 NCCL
-runs; BASELINE.json's "published" table is empty so the commonly cited
-NVIDIA/MXNet fp32 number is used as the denominator).
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N,
+     "mfu": ..., "ips_synthetic": ..., "ips_loader_fed": ...,
+     "io_images_per_sec": ...}
+
+Honesty notes (round-2 VERDICT Weak #1):
+- `vs_baseline` divides by 360 images/sec/V100 — BASELINE.json's
+  "published" table is empty, so the denominator is the commonly cited
+  MXNet fp32 ResNet-50 per-V100 number, NOT an in-repo measurement.
+- `mfu` is model FLOPs utilization: analytic ResNet-50 FLOPs
+  (2 FLOPs/MAC x 4.089 GMACs fwd x 3 for fwd+bwd) / step time / chip
+  peak bf16 FLOPs. Reported null when the chip's peak is unknown (CPU).
+- `ips_synthetic` times a resident on-device tensor (input pipeline
+  excluded); `ips_loader_fed` feeds the same step from the native
+  RecordIO reader (src_native/) including decode + H2D, so a slow data
+  path shows up. `io_images_per_sec` is the reader alone vs the
+  reference's ~3,000 img/s RecordIO baseline (BASELINE.md).
 
 Robustness: the TPU (axon) backend can fail or hang during PJRT init.
 Backend init is therefore probed in a *subprocess* with a timeout and
@@ -19,27 +30,42 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
+import tempfile
 import time
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0
+IO_BASELINE_IMAGES_PER_SEC = 3000.0
 PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
 PROBE_ATTEMPTS = 2
+
+# fwd GMACs for ResNet-50 @224 (standard torchvision/fvcore count);
+# x2 FLOPs/MAC, x3 for forward+backward
+RESNET50_TRAIN_FLOPS_PER_IMG = 4.089e9 * 2 * 3
+RESNET18_TRAIN_FLOPS_PER_IMG_32 = 0.0372e9 * 2 * 3  # @32x32 (small mode)
+
+# peak dense bf16 FLOPs/s per chip by PJRT device kind substring
+PEAK_FLOPS = [
+    ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
+]
 
 _PROBE_CODE = """
 import json, sys
 import jax
 devs = jax.devices()
 print(json.dumps({"platform": jax.default_backend(),
-                  "n_devices": len(devs)}))
+                  "n_devices": len(devs),
+                  "device_kind": devs[0].device_kind}))
 """
 
 
 def _probe_backend():
     """Try TPU init in a child process (it can hang, not just fail).
 
-    Returns (platform, n_devices) of whatever backend came up, or None.
+    Returns (platform, n_devices, device_kind) or None.
     """
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)  # let jax auto-pick (tpu first)
@@ -55,7 +81,8 @@ def _probe_backend():
         if out.returncode == 0:
             try:
                 info = json.loads(out.stdout.strip().splitlines()[-1])
-                return info["platform"], info["n_devices"]
+                return (info["platform"], info["n_devices"],
+                        info.get("device_kind", ""))
             except (ValueError, IndexError, KeyError):
                 pass
         print(f"[bench] backend probe attempt {attempt + 1} failed "
@@ -69,8 +96,43 @@ def _force_cpu():
     tpu_platform.force_cpu()
 
 
+def _peak_flops(device_kind: str):
+    kind = (device_kind or "").lower()
+    for sub, peak in PEAK_FLOPS:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _pack_synthetic_rec(tmpdir, n_images, hw):
+    """Pack a JPEG RecordIO dataset for the loader-fed bench."""
+    import io as pyio
+    import numpy as onp
+    from PIL import Image
+    from mxnet_tpu import recordio
+
+    rec_path = os.path.join(tmpdir, "bench.rec")
+    rec = recordio.MXIndexedRecordIO(
+        os.path.join(tmpdir, "bench.idx"), rec_path, "w")
+    rng = onp.random.RandomState(0)
+    y, x = onp.mgrid[0:hw, 0:hw]
+    for i in range(n_images):
+        # smooth content (JPEG-friendly) with some per-image variation
+        arr = onp.stack([(x * 3 + i * 7) % 256, (y * 5 + i) % 256,
+                         ((x + y) * 2) % 256], -1).astype(onp.uint8)
+        arr = onp.clip(arr + rng.randint(0, 16, arr.shape), 0, 255) \
+            .astype(onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG", quality=90)
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 64), i, 0), buf.getvalue()))
+    rec.close()
+    return rec_path
+
+
 def _run_bench(small: bool):
     import jax
+    import numpy as onp
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
 
@@ -81,9 +143,11 @@ def _run_bench(small: bool):
     if small:
         net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
         batch, hw, warmup, iters = 2 * n_dev, 32, 1, 3
+        flops_per_img = RESNET18_TRAIN_FLOPS_PER_IMG_32
     else:
         net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
         batch, hw, warmup, iters = 128 * n_dev, 224, 5, 20
+        flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG
     net.initialize()
     net.cast("bfloat16")
 
@@ -107,9 +171,76 @@ def _run_bench(small: bool):
         loss = step(data, label)
     loss.wait_to_read()
     dt = time.perf_counter() - t0
+    ips_synth = batch * iters / dt
+    sec_per_step = dt / iters
 
-    ips = batch * iters / dt
-    return ips / n_dev, n_dev, small
+    # ---- MFU ----
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    mfu = None
+    if peak is not None:
+        flops_per_step = flops_per_img * batch
+        mfu = flops_per_step / sec_per_step / (peak * n_dev)
+
+    # ---- loader-fed + IO-only (native RecordIO reader) ----
+    ips_loader = None
+    io_ips = None
+    tmpdir = tempfile.mkdtemp(prefix="bench_rec_")
+    try:
+        from mxnet_tpu.io.native import NativeImageRecordReader, available
+        if available():
+            n_images = max(batch * 4, 256)
+            rec_path = _pack_synthetic_rec(tmpdir, n_images, hw)
+            reader = NativeImageRecordReader(rec_path)
+
+            # IO-only: decode throughput of the native reader
+            idxs = list(range(n_images))
+            reader.read_batch(idxs[:batch], (hw, hw))  # warm page cache
+            t0 = time.perf_counter()
+            done = 0
+            while done < n_images:
+                take = idxs[done:done + batch]
+                reader.read_batch(take, (hw, hw))
+                done += len(take)
+            io_ips = n_images / (time.perf_counter() - t0)
+
+            # loader-fed train step: decode + H2D + step per batch
+            def batches():
+                for s in range(0, n_images - batch + 1, batch):
+                    imgs, labels = reader.read_batch(
+                        idxs[s:s + batch], (hw, hw))
+                    yield (mx.np.array(imgs.astype(onp.float32) / 255.0,
+                                       dtype="bfloat16"),
+                           mx.np.array(labels[:, 0].astype(onp.int32)))
+
+            for d, l in batches():  # warmup/compile this input path
+                loss = step(d, l)
+                break
+            loss.wait_to_read()
+            t0 = time.perf_counter()
+            seen = 0
+            for d, l in batches():
+                loss = step(d, l)
+                seen += batch
+            loss.wait_to_read()
+            ips_loader = seen / (time.perf_counter() - t0)
+            reader.close()
+        else:
+            print("[bench] native reader unavailable; skipping loader-fed "
+                  "metrics", file=sys.stderr, flush=True)
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+    return {
+        "ips_per_chip": ips_synth / n_dev,
+        "ips_synthetic": ips_synth,
+        "ips_loader_fed": ips_loader,
+        "io_images_per_sec": io_ips,
+        "mfu": mfu,
+        "n_dev": n_dev,
+        "device_kind": kind,
+        "small": small,
+    }
 
 
 def main():
@@ -137,7 +268,7 @@ def main():
         small = True
 
     try:
-        ips_per_chip, n_dev, small = _run_bench(small)
+        r = _run_bench(small)
     except Exception as e:  # noqa: BLE001 — always emit a JSON line
         print(json.dumps({
             "metric": "bench_error",
@@ -151,13 +282,26 @@ def main():
 
     print(json.dumps({
         "metric": "resnet50_train_images_per_sec_per_chip"
-        if not small else "resnet18_small_train_images_per_sec_per_chip",
-        "value": round(ips_per_chip, 2),
+        if not r["small"] else "resnet18_small_train_images_per_sec_per_chip",
+        "value": round(r["ips_per_chip"], 2),
         "unit": "images/sec/chip",
-        "vs_baseline": round(ips_per_chip / BASELINE_IMAGES_PER_SEC_PER_CHIP,
-                             4),
+        "vs_baseline": round(
+            r["ips_per_chip"] / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+        "vs_baseline_note": "denominator=360 img/s/V100 (commonly cited "
+                            "MXNet fp32 number; BASELINE.json.published "
+                            "is empty)",
+        "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
+        "ips_synthetic": round(r["ips_synthetic"], 2),
+        "ips_loader_fed": round(r["ips_loader_fed"], 2)
+        if r["ips_loader_fed"] is not None else None,
+        "io_images_per_sec": round(r["io_images_per_sec"], 2)
+        if r["io_images_per_sec"] is not None else None,
+        "io_vs_baseline": round(
+            r["io_images_per_sec"] / IO_BASELINE_IMAGES_PER_SEC, 4)
+        if r["io_images_per_sec"] is not None else None,
         "platform": platform,
-        "n_devices": n_dev,
+        "device_kind": r["device_kind"],
+        "n_devices": r["n_dev"],
     }))
     return 0
 

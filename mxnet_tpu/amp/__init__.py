@@ -47,6 +47,45 @@ def amp_cast(x, dtype):
     return x.astype(dtype)
 
 
+def _is_low(dt):
+    return dt == onp.float16 or dt == jnp.bfloat16
+
+
+def autocast_plan(name, datas, nd_positions):
+    """Cast-insertion pass at the op-dispatch funnel, driven by the
+    cast lists (parity: the reference's namespace-wrapping
+    amp.init pass, amp/amp.py:308, with lists/symbol_fp16.py as spec).
+
+    Returns ``{arg_index: dtype}``; apply_op folds the casts INTO the
+    differentiated function so the VJP sees them (cotangent dtypes then
+    match across precision boundaries). Runs eagerly AND inside the
+    hybridize trace, so the compiled XLA program carries the same casts
+    (matmuls/convs in bf16/fp16 on the MXU, norms/softmax in fp32).
+    """
+    plan = {}
+    if name in lists.TARGET_DTYPE_SET:
+        tgt = target_dtype()
+        for i in nd_positions:
+            if datas[i].dtype == onp.float32:
+                plan[i] = tgt
+    elif name in lists.FP32_SET:
+        for i in nd_positions:
+            if _is_low(datas[i].dtype):
+                plan[i] = jnp.float32
+    elif name in lists.WIDEST_SET:
+        fdts = [datas[i].dtype for i in nd_positions
+                if jnp.issubdtype(datas[i].dtype, jnp.floating)]
+        if len({str(d) for d in fdts}) > 1:
+            widest = fdts[0]
+            for d in fdts[1:]:
+                widest = jnp.promote_types(widest, d)
+            for i in nd_positions:
+                if jnp.issubdtype(datas[i].dtype, jnp.floating) and \
+                        str(datas[i].dtype) != str(widest):
+                    plan[i] = widest
+    return plan
+
+
 def amp_multicast(*args, cast_narrow=False):
     """Cast args to their widest (or narrowest) common dtype (parity:
     amp_multicast)."""
@@ -64,6 +103,9 @@ def init_trainer(trainer):
 
 
 def unscale(trainer):
+    """Divide gradients by the loss scale in place (for e.g. gradient
+    clipping before step). Marks the trainer so step()/update() do not
+    divide a second time."""
     scaler = getattr(trainer, "_amp_loss_scaler", None)
     if scaler is None:
         return
@@ -73,6 +115,7 @@ def unscale(trainer):
                 p._data._grad is not None:
             g = p.grad()
             g._install(g._data * inv)
+    trainer._amp_manual_unscaled = True
 
 
 def scale_loss(loss, trainer):

@@ -24,3 +24,8 @@ WIDEST_TYPE_CASTS = [
 ]
 
 CONDITIONAL_FP32_OPS = []
+
+# fast membership sets consulted by ops.apply_op on every dispatch
+TARGET_DTYPE_SET = frozenset(TARGET_DTYPE_OPS)
+FP32_SET = frozenset(FP32_OPS)
+WIDEST_SET = frozenset(WIDEST_TYPE_CASTS)

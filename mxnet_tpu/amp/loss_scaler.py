@@ -17,15 +17,23 @@ class LossScaler:
         self._unskipped = 0
 
     def has_overflow(self, params):
-        """Check grads for inf/nan (parity: multi_all_finite kernel)."""
+        """Check grads for inf/nan (parity: multi_all_finite kernel).
+
+        All per-grad reductions stay on device and combine into one
+        scalar — a single host sync per step, not one per parameter."""
+        finites = []
         for p in params:
             if p.grad_req == "null" or p._data is None or \
                     p._data._grad is None:
                 continue
             g = p._data._grad._data
-            if not bool(jnp.isfinite(jnp.asarray(g, jnp.float32)).all()):
-                return True
-        return False
+            finites.append(jnp.isfinite(jnp.asarray(g, jnp.float32)).all())
+        if not finites:
+            return False
+        all_finite = finites[0]
+        for f in finites[1:]:
+            all_finite = jnp.logical_and(all_finite, f)
+        return not bool(all_finite)
 
     def update_scale(self, overflow: bool):
         if overflow:

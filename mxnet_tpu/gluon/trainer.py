@@ -114,10 +114,34 @@ class Trainer:
         if not self._kv_initialized:
             self._init_kvstore()
 
+    def _grad_rescale(self, batch_size):
+        """Effective rescale factor: batch scaling plus the inverse AMP
+        loss scale — applied in exactly one place so the manual
+        `amp.unscale()` workflow (which divides grads in place and sets
+        `_amp_manual_unscaled`) is not double-unscaled."""
+        r = self._scale / batch_size
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and not getattr(
+                self, "_amp_manual_unscaled", False):
+            r /= scaler.loss_scale
+        return r
+
     def step(self, batch_size, ignore_stale_grad=False):
         """rescale by 1/batch_size, allreduce, update."""
         self._check_and_init()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = self._grad_rescale(batch_size)
+        # fp16 dynamic loss scaling (installed by amp.init_trainer):
+        # skip the whole update on overflow and shrink the scale
+        # (parity: amp/loss_scaler.py + the reference trainer hook);
+        # the scale only grows after a successful update.
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None and scaler.has_overflow(self._params):
+            scaler.update_scale(True)
+            self._amp_manual_unscaled = False
+            for p in self._params:
+                if p.grad_req != "null" and p._data is not None:
+                    p.data()._fresh_grad = False
+            return
         if self._update_on_kvstore and self._kvstore is not None:
             # optimizer runs where the weights live (parity: the
             # reference's update_on_kvstore push-grad/pull-weight loop).
@@ -131,13 +155,19 @@ class Trainer:
                     continue
                 grad = param.grad()
                 if remote:
-                    grad = grad * (self._scale / batch_size)
+                    grad = grad * self._grad_rescale(batch_size)
                 self._kvstore.push(i, grad, priority=-i)
                 self._kvstore.pull(i, out=param.data(), priority=-i)
                 param.data()._fresh_grad = False
+            if scaler is not None:
+                scaler.update_scale(False)
+                self._amp_manual_unscaled = False
             return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
+        if scaler is not None:
+            scaler.update_scale(False)
+            self._amp_manual_unscaled = False
 
     def allreduce_grads(self):
         self._check_and_init()
@@ -150,7 +180,7 @@ class Trainer:
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._check_and_init()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        self._optimizer.rescale_grad = self._grad_rescale(batch_size)
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):

@@ -115,12 +115,16 @@ def color_normalize(src, mean, std=None):
 
 class ImageIter:
     """Iterator over images packed in RecordIO or listed in a .lst
-    (parity: mx.image.ImageIter — python-side loop; the C++ threaded
-    variant is src_native/)."""
+    (parity: mx.image.ImageIter). For RecordIO inputs the high-
+    throughput path is the native reader (src_native/recordio_native.cc:
+    mmap + threaded libjpeg decode, the analogue of the reference's
+    ImageRecordIter2, src/io/iter_image_recordio_2.cc); it is used
+    automatically when the native lib builds and no augmenters need
+    per-image python, else this falls back to the portable PIL loop."""
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
-                 shuffle=False, aug_list=None, **kwargs):
+                 shuffle=False, aug_list=None, use_native=None, **kwargs):
         from .recordio import MXIndexedRecordIO
         assert path_imgrec or path_imglist
         self.batch_size = batch_size
@@ -129,7 +133,18 @@ class ImageIter:
         self.aug_list = aug_list or []
         self._rec = None
         self._list = None
+        self._native = None
         if path_imgrec:
+            # per-image python augmenters force the portable path, so
+            # don't pay the native build/mmap for a reader never used
+            if use_native is not False and not self.aug_list:
+                try:
+                    from .io.native import NativeImageRecordReader
+                    self._native = NativeImageRecordReader(
+                        path_imgrec, label_width=label_width)
+                except (RuntimeError, IOError):
+                    if use_native:  # explicitly requested
+                        raise
             idx = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
             self._rec = MXIndexedRecordIO(idx, path_imgrec, "r")
             self._keys = list(self._rec.keys)
@@ -158,6 +173,16 @@ class ImageIter:
         from .recordio import unpack_img
         if self._cursor + self.batch_size > len(self._order):
             raise StopIteration
+        if self._native is not None and not self.aug_list:
+            keys = self._order[self._cursor:self._cursor + self.batch_size]
+            # native keys are record ordinals; MXIndexedRecordIO keys
+            # are written densely so they coincide for im2rec output
+            batch, labels = self._native.read_batch(
+                keys, (self.data_shape[1], self.data_shape[2]))
+            self._cursor += self.batch_size
+            lab = labels if self._native.label_width > 1 else labels[:, 0]
+            return (array(batch.astype(onp.float32)).transpose(0, 3, 1, 2),
+                    array(lab.astype(onp.float32)))
         imgs, labels = [], []
         for i in range(self._cursor, self._cursor + self.batch_size):
             key = self._order[i]

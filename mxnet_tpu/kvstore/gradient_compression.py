@@ -31,10 +31,12 @@ def _two_bit_kernel():
 @functools.lru_cache(maxsize=None)
 def _one_bit_kernel():
     def q(grad, residual, threshold):
+        # reference semantics (src/kvstore/gradient_compression-inl.h:44
+        # quantize_1bit): residual += grad; emit +1 where residual >
+        # threshold else -1; feed the emitted value back into the
+        # residual (residual -= emitted).
         acc = grad + residual
-        scale = jnp.mean(jnp.abs(acc))
-        quant = jnp.where(acc >= threshold, scale, -scale) \
-            .astype(grad.dtype)
+        quant = jnp.where(acc > threshold, 1.0, -1.0).astype(grad.dtype)
         return quant, acc - quant
     return jax.jit(q)
 
@@ -49,9 +51,9 @@ class GradientCompression:
             raise ValueError(
                 f"unsupported compression type {self.ctype!r}; "
                 "supported: '1bit', '2bit'")
-        self.threshold = float(params.pop("threshold",
-                                          0.5 if self.ctype == "2bit"
-                                          else 0.0))
+        # the reference's DMLC param default is 0.5 for both types
+        # (src/kvstore/gradient_compression.h:46)
+        self.threshold = float(params.pop("threshold", 0.5))
         if params:
             raise ValueError(f"unknown compression params {sorted(params)}")
         self._residuals = {}

@@ -62,6 +62,23 @@ def apply_op(fn, *args, nout: int = 1, ctx=None, name: str = None):
         else:
             datas.append(a)
 
+    # AMP cast insertion at the single dispatch funnel: every op —
+    # eager or inside the hybridize trace — gets the same cast-list
+    # treatment (parity: amp.init namespace patching, amp/amp.py:308).
+    # Casts are folded INTO fn so jax.vjp differentiates through them
+    # and cotangent dtypes stay consistent across precision boundaries.
+    from .. import amp as _amp
+    if _amp.is_active() and name is not None and nd_positions:
+        _plan = _amp.autocast_plan(name, datas, nd_positions)
+        if _plan:
+            _orig_fn = fn
+
+            def fn(*xs, _of=_orig_fn, _cm=_plan):
+                xs = list(xs)
+                for _i, _dt in _cm.items():
+                    xs[_i] = xs[_i].astype(_dt)
+                return _of(*xs)
+
     record = autograd.is_recording() and any(
         autograd._on_tape(args[i]) for i in nd_positions
     )

@@ -10,9 +10,10 @@ reference's im2rec tooling load unchanged:
 - `MXIndexedRecordIO` pairs the .rec with a text .idx of
   "key\\tbyte-offset" lines.
 
-A native (C++) reader with mmap + threaded decode backs the high-
-throughput path (src_native/); this module is the portable
-reference implementation and the writer.
+The high-throughput read path is the native (C++) reader in
+src_native/recordio_native.cc (mmap indexing + threaded libjpeg batch
+decode, loaded through mxnet_tpu/io/native.py); this module is the
+portable Python implementation and the writer.
 """
 from __future__ import annotations
 

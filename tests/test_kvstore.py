@@ -78,12 +78,19 @@ def test_gradient_compression_2bit():
 
 
 def test_gradient_compression_1bit():
+    """Reference semantics (gradient_compression-inl.h:44): emit fixed
+    +/-1 around threshold (default 0.5), residual -= emitted."""
     gc = GradientCompression({"type": "1bit"})
+    assert gc.threshold == 0.5
     g = mx.np.array([1.0, -1.0, 3.0, -3.0])._data
-    q = gc.compress(0, 0, g)
-    q = onp.asarray(q)
-    assert (q > 0).tolist() == [True, False, True, False]
-    assert len(onp.unique(onp.abs(q))) == 1  # single scale
+    q = onp.asarray(gc.compress(0, 0, g))
+    assert q.tolist() == [1.0, -1.0, 1.0, -1.0]
+    res = onp.asarray(gc._residuals[(0, 0)])
+    onp.testing.assert_allclose(res, [0.0, 0.0, 2.0, -2.0])
+    # error feedback: the +2 residual keeps emitting +1 even for a
+    # negative-but-small gradient
+    q2 = onp.asarray(gc.compress(0, 0, mx.np.array([0., 0., -0.2, 0.2])._data))
+    assert q2.tolist() == [-1.0, -1.0, 1.0, -1.0]
 
 
 def test_kvstore_compression_in_reduce():

@@ -1,0 +1,9 @@
+"""mx.contrib.onnx — ONNX export/import (parity: contrib/onnx/).
+
+`export_model(net, input_shapes, path)` writes an opset-13 ONNX file
+from the traced graph; `import_model(path)` loads one back as a
+callable. No external onnx/protobuf dependency — the wire format is
+encoded directly (see proto.py).
+"""
+from .mx2onnx import export_model  # noqa: F401
+from .runtime import import_model, OnnxGraph  # noqa: F401

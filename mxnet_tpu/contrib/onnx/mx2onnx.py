@@ -1,0 +1,585 @@
+"""mx2onnx — export a HybridBlock's traced graph to ONNX.
+
+Parity with the reference's ONNX exporter
+(python/mxnet/contrib/onnx/mx2onnx/export_onnx.py MXNetGraph, which
+walks the nnvm symbol graph emitting per-op translations). TPU-first
+redesign: the source of truth here is the SAME traced jaxpr the
+hybridize/StableHLO-export path uses — each jaxpr equation lowers to
+ONNX nodes (opset 13). Decomposed ops (batch-norm as mul/add chains,
+softmax as exp/sub/div) export as primitive chains, which is valid
+ONNX and loads anywhere.
+
+Constant folding: any equation whose inputs are all initializers or
+literals is evaluated at export time and becomes an initializer, so
+PRNG plumbing and eps-broadcast chains never reach the file.
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from . import proto
+
+__all__ = ["export_model"]
+
+
+class _Ctx:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = {}     # name -> numpy array
+        self.const_vals = {}       # onnx name -> numpy value (foldable)
+        self.counter = 0
+
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def add_node(self, op_type, inputs, outputs, attrs=None, name=None):
+        self.nodes.append({
+            "op_type": op_type, "input": list(inputs),
+            "output": list(outputs),
+            "name": name or self.fresh(op_type.lower()),
+            "attribute": attrs or []})
+
+    def add_const(self, arr, hint="const"):
+        arr = onp.asarray(arr)
+        name = self.fresh(hint)
+        self.initializers[name] = arr
+        self.const_vals[name] = arr
+        return name
+
+    def name_of(self, v, env):
+        """Resolve a jaxpr Var to an ONNX name in the given scope.
+
+        Scoping matters: jax CACHES sub-jaxprs, so the same inner
+        jaxpr (same Var objects) can be inlined at several call sites;
+        a global Var->name map would alias the call sites' tensors
+        (SSA violation). Each inlined instance gets its own env."""
+        from jax._src.core import Literal
+        if isinstance(v, Literal):
+            return self.add_const(onp.asarray(v.val), "lit")
+        if v not in env:
+            env[v] = self.fresh("v")
+        return env[v]
+
+
+def _attr_i(name, v):
+    return {"name": name, "type": proto.A_INT, "i": int(v)}
+
+
+def _attr_f(name, v):
+    return {"name": name, "type": proto.A_FLOAT, "f": float(v)}
+
+
+def _attr_ints(name, vs):
+    return {"name": name, "type": proto.A_INTS,
+            "ints": [int(x) for x in vs]}
+
+
+def _attr_s(name, v):
+    return {"name": name, "type": proto.A_STRING, "s": v}
+
+
+def _shape_const(ctx, shape):
+    return ctx.add_const(onp.asarray(shape, dtype=onp.int64), "shape")
+
+
+def _transpose(ctx, inp, perm, hint="tr"):
+    out = ctx.fresh(hint)
+    ctx.add_node("Transpose", [inp], [out], [_attr_ints("perm", perm)])
+    return out
+
+
+def _reshape(ctx, inp, shape, hint="rs"):
+    out = ctx.fresh(hint)
+    ctx.add_node("Reshape", [inp, _shape_const(ctx, shape)], [out])
+    return out
+
+
+_ELEMWISE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
+}
+_UNARY = {
+    "exp": "Exp", "log": "Log", "tanh": "Tanh", "logistic": "Sigmoid",
+    "sqrt": "Sqrt", "neg": "Neg", "abs": "Abs", "sign": "Sign",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "not": "Not", "stop_gradient": "Identity",
+    "copy": "Identity",
+}
+_COMPARE = {
+    "gt": "Greater", "lt": "Less", "ge": "GreaterOrEqual",
+    "le": "LessOrEqual", "eq": "Equal", "ne": "Equal",  # ne: Equal+Not
+    "and": "And", "or": "Or", "xor": "Xor",
+}
+
+
+def _conv_eqn(ctx, eqn, ins, outs):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nsp = len(p["window_strides"])
+    # normalize operands to NCHW / OIHW via Transpose nodes; jax specs
+    # are (batch, feature, *spatial) as axis indices into the operand
+    lhs_perm = list(dn.lhs_spec)
+    rhs_perm = list(dn.rhs_spec)
+    out_perm = list(dn.out_spec)
+    x = ins[0]
+    w = ins[1]
+    if lhs_perm != list(range(nsp + 2)):
+        x = _transpose(ctx, x, lhs_perm, "nchw")
+    if rhs_perm != list(range(nsp + 2)):
+        w = _transpose(ctx, w, rhs_perm, "oihw")
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv export not supported")
+    pads = [pp[0] for pp in p["padding"]] + [pp[1] for pp in p["padding"]]
+    attrs = [
+        _attr_ints("strides", p["window_strides"]),
+        _attr_ints("pads", pads),
+        _attr_ints("dilations", p["rhs_dilation"]),
+        _attr_i("group", p["feature_group_count"]),
+    ]
+    inv_out = [out_perm.index(i) for i in range(nsp + 2)]
+    if out_perm != list(range(nsp + 2)):
+        tmp = ctx.fresh("conv")
+        ctx.add_node("Conv", [x, w], [tmp], attrs)
+        ctx.add_node("Transpose", [tmp], [outs[0]],
+                     [_attr_ints("perm", inv_out)])
+    else:
+        ctx.add_node("Conv", [x, w], [outs[0]], attrs)
+
+
+def _dot_eqn(ctx, eqn, ins, outs, in_avals):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    la, ra = in_avals
+    lnd, rnd = len(la.shape), len(ra.shape)
+    if not lb and not rb and len(lc) == 1 and len(rc) == 1:
+        a, b = ins
+        if lc[0] != lnd - 1:
+            raise NotImplementedError("dot_general lhs contraction "
+                                      f"on axis {lc[0]}")
+        if rc[0] == rnd - 2:
+            pass  # (…,K) x (K,N) — MatMul directly
+        elif rc[0] == rnd - 1:
+            b = _transpose(ctx, b, list(range(rnd - 2)) + [rnd - 1, rnd - 2],
+                           "wT")
+        else:
+            raise NotImplementedError("dot_general rhs contraction "
+                                      f"on axis {rc[0]}")
+        ctx.add_node("MatMul", [a, b], [outs[0]])
+        return
+    # batched matmul: batch dims must be the leading dims in order
+    if list(lb) == list(range(len(lb))) and list(rb) == list(range(len(rb))) \
+            and len(lc) == 1 and len(rc) == 1 \
+            and lc[0] == lnd - 1 and rc[0] == rnd - 2:
+        ctx.add_node("MatMul", ins, [outs[0]])
+        return
+    raise NotImplementedError(
+        f"dot_general {eqn.params['dimension_numbers']}")
+
+
+def _reduce_window_eqn(ctx, eqn, ins, outs, kind):
+    p = eqn.params
+    dims = p["window_dimensions"]
+    strides = p["window_strides"]
+    padding = p["padding"]
+    nd = len(dims)
+    # pooling must act on trailing-or-marked spatial dims with
+    # batch/channel windows of 1
+    spatial = [i for i in range(nd) if dims[i] != 1 or strides[i] != 1
+               or padding[i] != (0, 0)]
+    if not spatial:
+        spatial = [nd - 2, nd - 1]
+    if any(d != 1 for i, d in enumerate(dims) if i not in spatial):
+        raise NotImplementedError("pooling over non-spatial dims")
+    perm = [i for i in range(nd) if i not in spatial] + spatial
+    needs_perm = perm != list(range(nd))
+    x = ins[0]
+    if needs_perm:
+        x = _transpose(ctx, x, perm, "pool_in")
+    kshape = [dims[i] for i in spatial]
+    kstride = [strides[i] for i in spatial]
+    pads = [padding[i][0] for i in spatial] + \
+        [padding[i][1] for i in spatial]
+    attrs = [_attr_ints("kernel_shape", kshape),
+             _attr_ints("strides", kstride),
+             _attr_ints("pads", pads)]
+    op = "MaxPool" if kind == "max" else "AveragePool"
+    if kind == "sum":
+        attrs.append(_attr_i("count_include_pad", 1))
+    pooled = ctx.fresh("pool")
+    ctx.add_node(op, [x], [pooled], attrs)
+    if kind == "sum":
+        # reduce_window-sum = AveragePool * window_size
+        k = float(onp.prod(kshape))
+        scaled = ctx.fresh("pool_sum")
+        ctx.add_node("Mul", [pooled, ctx.add_const(
+            onp.asarray(k, dtype=onp.float32))], [scaled])
+        pooled = scaled
+    if needs_perm:
+        inv = [perm.index(i) for i in range(nd)]
+        ctx.add_node("Transpose", [pooled], [outs[0]],
+                     [_attr_ints("perm", inv)])
+    else:
+        ctx.add_node("Identity", [pooled], [outs[0]])
+
+
+def _broadcast_eqn(ctx, eqn, ins, outs, in_avals, out_aval):
+    bdims = eqn.params["broadcast_dimensions"]
+    tgt = list(out_aval.shape)
+    src = list(in_avals[0].shape)
+    # reshape to rank of target with 1s, then Expand
+    interim = [1] * len(tgt)
+    for i, bd in enumerate(bdims):
+        interim[bd] = src[i]
+    x = ins[0]
+    if interim != src or len(interim) != len(src):
+        x = _reshape(ctx, x, interim, "bcast_rs")
+    ctx.add_node("Expand", [x, _shape_const(ctx, tgt)], [outs[0]])
+
+
+def _convert_eqn(ctx, eqn, ins, outs):
+    tgt = proto.np_dtype_to_onnx(eqn.params["new_dtype"])
+    ctx.add_node("Cast", [ins[0]], [outs[0]], [_attr_i("to", tgt)])
+
+
+def _translate_eqn(ctx, eqn, env):
+    prim = eqn.primitive.name
+    ins = [ctx.name_of(v, env) for v in eqn.invars]
+    outs = [ctx.name_of(v, env) for v in eqn.outvars]
+    in_avals = [v.aval for v in eqn.invars]
+    if prim in _ELEMWISE:
+        ctx.add_node(_ELEMWISE[prim], ins, outs)
+    elif prim in _UNARY:
+        ctx.add_node(_UNARY[prim], ins, outs)
+    elif prim in _COMPARE:
+        if prim == "ne":
+            eq = ctx.fresh("eq")
+            ctx.add_node("Equal", ins, [eq])
+            ctx.add_node("Not", [eq], outs)
+        else:
+            ctx.add_node(_COMPARE[prim], ins, outs)
+    elif prim == "rsqrt":
+        s = ctx.fresh("sqrt")
+        ctx.add_node("Sqrt", ins, [s])
+        ctx.add_node("Reciprocal", [s], outs)
+    elif prim == "atan2":
+        # atan2(y, x) = atan(y/x) + quadrant correction:
+        #   x < 0 -> +pi when y >= 0, -pi when y < 0
+        y, x = ins
+        d = ctx.fresh("at2_div")
+        ctx.add_node("Div", [y, x], [d])
+        a = ctx.fresh("at2_atan")
+        ctx.add_node("Atan", [d], [a])
+        xneg = ctx.fresh("at2_xneg")
+        ctx.add_node("Less", [x, ctx.add_const(
+            onp.asarray(0.0, onp.float32))], [xneg])
+        ypos = ctx.fresh("at2_ypos")
+        ctx.add_node("GreaterOrEqual", [y, ctx.add_const(
+            onp.asarray(0.0, onp.float32))], [ypos])
+        pi = ctx.add_const(onp.asarray(onp.pi, onp.float32))
+        npi = ctx.add_const(onp.asarray(-onp.pi, onp.float32))
+        corr_sign = ctx.fresh("at2_corrs")
+        ctx.add_node("Where", [ypos, pi, npi], [corr_sign])
+        corr = ctx.fresh("at2_corr")
+        ctx.add_node("Where", [xneg, corr_sign, ctx.add_const(
+            onp.asarray(0.0, onp.float32))], [corr])
+        ctx.add_node("Add", [a, corr], outs)
+    elif prim == "is_finite":
+        # Not(Or(IsInf(x), IsNaN(x)))
+        isinf = ctx.fresh("isinf")
+        ctx.add_node("IsInf", [ins[0]], [isinf])
+        isnan = ctx.fresh("isnan")
+        ctx.add_node("IsNaN", [ins[0]], [isnan])
+        bad = ctx.fresh("nonfinite")
+        ctx.add_node("Or", [isinf, isnan], [bad])
+        ctx.add_node("Not", [bad], outs)
+    elif prim == "integer_pow":
+        y = eqn.params["y"]
+        if y == 2:
+            ctx.add_node("Mul", [ins[0], ins[0]], outs)
+        else:
+            ctx.add_node("Pow", [ins[0], ctx.add_const(
+                onp.asarray(float(y), onp.float32))], outs)
+    elif prim == "conv_general_dilated":
+        _conv_eqn(ctx, eqn, ins, outs)
+    elif prim == "dot_general":
+        _dot_eqn(ctx, eqn, ins, outs, in_avals)
+    elif prim == "reduce_window_max":
+        _reduce_window_eqn(ctx, eqn, ins, outs, "max")
+    elif prim == "reduce_window_sum":
+        _reduce_window_eqn(ctx, eqn, ins, outs, "sum")
+    elif prim == "reduce_sum":
+        ctx.add_node("ReduceSum",
+                     [ins[0], ctx.add_const(onp.asarray(
+                         eqn.params["axes"], onp.int64), "axes")],
+                     outs, [_attr_i("keepdims", 0)])
+    elif prim in ("reduce_max", "reduce_min"):
+        op = "ReduceMax" if prim == "reduce_max" else "ReduceMin"
+        ctx.add_node(op, ins, outs,
+                     [_attr_ints("axes", eqn.params["axes"]),
+                      _attr_i("keepdims", 0)])
+    elif prim == "broadcast_in_dim":
+        _broadcast_eqn(ctx, eqn, ins, outs, in_avals,
+                       eqn.outvars[0].aval)
+    elif prim == "reshape":
+        ctx.add_node("Reshape",
+                     [ins[0], _shape_const(ctx,
+                                           eqn.outvars[0].aval.shape)],
+                     outs)
+    elif prim == "squeeze":
+        ctx.add_node("Reshape",
+                     [ins[0], _shape_const(ctx,
+                                           eqn.outvars[0].aval.shape)],
+                     outs)
+    elif prim == "expand_dims":
+        ctx.add_node("Reshape",
+                     [ins[0], _shape_const(ctx,
+                                           eqn.outvars[0].aval.shape)],
+                     outs)
+    elif prim == "transpose":
+        ctx.add_node("Transpose", ins, outs,
+                     [_attr_ints("perm", eqn.params["permutation"])])
+    elif prim == "concatenate":
+        ctx.add_node("Concat", ins, outs,
+                     [_attr_i("axis", eqn.params["dimension"])])
+    elif prim == "slice":
+        p = eqn.params
+        strides = p["strides"] or [1] * len(p["start_indices"])
+        ctx.add_node("Slice", [
+            ins[0],
+            ctx.add_const(onp.asarray(p["start_indices"], onp.int64)),
+            ctx.add_const(onp.asarray(p["limit_indices"], onp.int64)),
+            ctx.add_const(onp.asarray(range(len(strides)), onp.int64)),
+            ctx.add_const(onp.asarray(strides, onp.int64))], outs)
+    elif prim == "rev":
+        # reverse via Slice with negative steps
+        nd = len(in_avals[0].shape)
+        dims = eqn.params["dimensions"]
+        starts = [-1 if i in dims else 0 for i in range(nd)]
+        ends = [-(2 ** 31) if i in dims else 2 ** 31 - 1
+                for i in range(nd)]
+        steps = [-1 if i in dims else 1 for i in range(nd)]
+        ctx.add_node("Slice", [
+            ins[0],
+            ctx.add_const(onp.asarray(starts, onp.int64)),
+            ctx.add_const(onp.asarray(ends, onp.int64)),
+            ctx.add_const(onp.asarray(range(nd), onp.int64)),
+            ctx.add_const(onp.asarray(steps, onp.int64))], outs)
+    elif prim == "select_n":
+        # select_n(pred, case0, case1): case1 where pred else case0
+        if len(ins) != 3:
+            raise NotImplementedError("select_n with >2 cases")
+        ctx.add_node("Where", [ins[0], ins[2], ins[1]], outs)
+    elif prim == "pad":
+        p = eqn.params
+        if any(i != 0 for _, _, i in p["padding_config"]):
+            raise NotImplementedError("interior padding")
+        lo = [c[0] for c in p["padding_config"]]
+        hi = [c[1] for c in p["padding_config"]]
+        ctx.add_node("Pad", [
+            ins[0],
+            ctx.add_const(onp.asarray(lo + hi, onp.int64)),
+            ins[1]], outs)
+    elif prim == "convert_element_type":
+        _convert_eqn(ctx, eqn, ins, outs)
+    elif prim == "argmax":
+        ctx.add_node("ArgMax", ins, outs,
+                     [_attr_i("axis", eqn.params["axes"][0]),
+                      _attr_i("keepdims", 0)])
+    elif prim in ("device_put", "copy_p", "sharding_constraint"):
+        ctx.add_node("Identity", ins, outs)
+    else:
+        raise NotImplementedError(
+            f"no ONNX translation for jaxpr primitive {prim!r}")
+
+
+def _try_fold(ctx, eqn, env):
+    """Evaluate an equation at export time when every input is a known
+    constant; PRNG plumbing, iota, eps chains all fold away."""
+    from jax._src.core import Literal
+    vals = []
+    for v in eqn.invars:
+        if isinstance(v, Literal):
+            vals.append(v.val)
+        else:
+            nm = env.get(v)
+            if nm is None or nm not in ctx.const_vals:
+                return False
+            vals.append(ctx.const_vals[nm])
+    try:
+        if eqn.primitive.name in ("pjit", "jit", "closed_call",
+                                  "custom_jvp_call", "custom_vjp_call",
+                                  "remat", "checkpoint"):
+            return False  # inlined elsewhere
+        out = eqn.primitive.bind(*[jnp.asarray(v) for v in vals],
+                                 **eqn.params)
+    except Exception:  # noqa: BLE001 — fall back to node translation
+        return False
+    outs = out if eqn.primitive.multiple_results else [out]
+    for var, val in zip(eqn.outvars, outs):
+        host = onp.asarray(val)
+        name = ctx.add_const(host, "folded")
+        env[var] = name
+    return True
+
+
+def _inline_params(eqn):
+    """Return the sub-jaxpr to inline for call-like primitives."""
+    prim = eqn.primitive.name
+    if prim in ("pjit", "jit"):
+        return eqn.params["jaxpr"]
+    if prim == "closed_call":
+        return eqn.params["call_jaxpr"]
+    if prim == "custom_jvp_call":
+        return eqn.params["call_jaxpr"]
+    if prim == "custom_vjp_call":
+        return eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+    if prim in ("remat", "checkpoint", "remat2"):
+        from jax._src.core import ClosedJaxpr
+        j = eqn.params["jaxpr"]
+        return j if isinstance(j, ClosedJaxpr) else None
+    return None
+
+
+def _walk(ctx, jaxpr, consts, env):
+    from jax._src.core import ClosedJaxpr, Literal
+    for var, const in zip(jaxpr.constvars, consts):
+        host = onp.asarray(const)
+        env[var] = ctx.add_const(host, "c")
+    for eqn in jaxpr.eqns:
+        sub = _inline_params(eqn)
+        if sub is not None:
+            closed = sub if isinstance(sub, ClosedJaxpr) else None
+            inner = closed.jaxpr if closed else sub
+            inner_consts = closed.consts if closed else []
+            # fresh scope per inlined instance: jax caches sub-jaxprs,
+            # so the same Var objects appear at every call site
+            inner_env = {}
+            for iv, ov in zip(inner.invars, eqn.invars):
+                if isinstance(ov, Literal):
+                    inner_env[iv] = ctx.add_const(
+                        onp.asarray(ov.val), "lit")
+                else:
+                    inner_env[iv] = ctx.name_of(ov, env)
+            _walk(ctx, inner, inner_consts, inner_env)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                if isinstance(iv, Literal):
+                    env[ov] = ctx.add_const(
+                        onp.asarray(iv.val), "lit")
+                else:
+                    env[ov] = ctx.name_of(iv, inner_env)
+            continue
+        if _try_fold(ctx, eqn, env):
+            continue
+        _translate_eqn(ctx, eqn, env)
+
+
+def export_model(net, input_shapes, onnx_file_path="model.onnx",
+                 input_type="float32", dynamic_batch=False,
+                 verbose=False, opset_version=13):
+    """Export a HybridBlock to an ONNX file (parity:
+    contrib/onnx/mx2onnx/export_model.py:export_model).
+
+    Traces the net in inference mode (the same traced program
+    hybridize compiles), translates each jaxpr equation to ONNX nodes,
+    and writes a self-contained opset-13 ModelProto.
+    """
+    import mxnet_tpu as mx
+    from ...ndarray.ndarray import NDArray
+    from ... import engine, autograd
+    from ...gluon import _deferred
+
+    if isinstance(input_shapes, tuple):
+        input_shapes = [input_shapes]
+    xs = [mx.np.random.uniform(size=s).astype(input_type)
+          for s in input_shapes]
+    with autograd.pause():
+        net(*xs)  # materialize deferred params eagerly
+
+    params = list(net.collect_params().values())
+    param_names = list(net.collect_params().keys())
+    param_datas = [p.data()._data for p in params]
+
+    def fwd(param_datas, input_datas):
+        saved = [p._data._data for p in params]
+        in_nds = [NDArray(engine.track(d)) for d in input_datas]
+        try:
+            with autograd.pause(), _deferred.trace_scope():
+                for p, d in zip(params, param_datas):
+                    p._data._data = d
+                out = net(*in_nds)
+        finally:
+            for p, s in zip(params, saved):
+                p._data._data = s
+        outs = out if isinstance(out, tuple) else (out,)
+        return tuple(o._data for o in outs)
+
+    closed = jax.make_jaxpr(fwd)([d for d in param_datas],
+                                 [x._data for x in xs])
+    ctx = _Ctx()
+    jaxpr = closed.jaxpr
+    # invars: params then inputs (flattened in pytree order)
+    n_params = len(param_datas)
+    flat_invars = jaxpr.invars
+    assert len(flat_invars) == n_params + len(xs), \
+        (len(flat_invars), n_params, len(xs))
+    env = {}
+    for var, pname, pdata in zip(flat_invars[:n_params], param_names,
+                                 param_datas):
+        host = onp.asarray(pdata.astype(jnp.float32)
+                           if str(pdata.dtype) == "bfloat16" else pdata)
+        env[var] = pname
+        ctx.initializers[pname] = host
+        # params are NOT fold-constants: keep them live initializers
+    graph_inputs = []
+    for i, var in enumerate(flat_invars[n_params:]):
+        name = f"data{i}" if i else "data"
+        env[var] = name
+        shape = list(var.aval.shape)
+        if dynamic_batch:
+            shape[0] = "batch"
+        graph_inputs.append({
+            "name": name,
+            "elem_type": proto.np_dtype_to_onnx(var.aval.dtype),
+            "shape": shape})
+
+    _walk(ctx, jaxpr, closed.consts, env)
+
+    graph_outputs = []
+    out_nodes = []
+    from jax._src.core import Literal
+    for i, var in enumerate(jaxpr.outvars):
+        oname = f"output{i}" if i else "output"
+        src = (ctx.add_const(onp.asarray(var.val), "lit")
+               if isinstance(var, Literal) else ctx.name_of(var, env))
+        out_nodes.append({"op_type": "Identity", "input": [src],
+                          "output": [oname], "name": f"out_{i}",
+                          "attribute": []})
+        shape = list(var.aval.shape)
+        if dynamic_batch:
+            shape[0] = "batch"
+        graph_outputs.append({
+            "name": oname,
+            "elem_type": proto.np_dtype_to_onnx(var.aval.dtype),
+            "shape": shape})
+
+    graph = {
+        "name": type(net).__name__,
+        "node": ctx.nodes + out_nodes,
+        "initializer": [proto.numpy_to_tensor(arr, nm)
+                        for nm, arr in ctx.initializers.items()],
+        "input": graph_inputs,
+        "output": graph_outputs,
+    }
+    blob = proto.encode_model(graph, opset_version=opset_version)
+    with open(onnx_file_path, "wb") as f:
+        f.write(blob)
+    if verbose:
+        print(f"[mx2onnx] wrote {onnx_file_path}: "
+              f"{len(ctx.nodes)} nodes, "
+              f"{len(ctx.initializers)} initializers, "
+              f"{len(blob)} bytes")
+    return onnx_file_path

@@ -1,0 +1,381 @@
+"""Minimal ONNX protobuf wire codec (no `onnx`/`protobuf` dependency).
+
+The environment ships neither the onnx package nor its generated
+protobufs, so this module encodes/decodes the protobuf wire format
+directly. Message schemas and field numbers follow the public
+onnx/onnx.proto (IR version 8): ModelProto, GraphProto, NodeProto,
+AttributeProto, TensorProto, ValueInfoProto, TypeProto,
+TensorShapeProto, OperatorSetIdProto.
+
+Messages are represented as plain dicts; `encode_model`/`decode_model`
+are the entry points used by mx2onnx (writer) and the test-time
+evaluator (reader). Only the fields this exporter emits are
+implemented — unknown fields are skipped on decode, so files from
+other producers still parse for the subset we understand.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as onp
+
+# --- TensorProto.DataType enum (onnx.proto) ---
+FLOAT = 1
+UINT8 = 2
+INT8 = 3
+UINT16 = 4
+INT16 = 5
+INT32 = 6
+INT64 = 7
+STRING = 8
+BOOL = 9
+FLOAT16 = 10
+DOUBLE = 11
+UINT32 = 12
+UINT64 = 13
+BFLOAT16 = 16
+
+_NP2ONNX = {
+    "float32": FLOAT, "uint8": UINT8, "int8": INT8, "uint16": UINT16,
+    "int16": INT16, "int32": INT32, "int64": INT64, "bool": BOOL,
+    "float16": FLOAT16, "float64": DOUBLE, "uint32": UINT32,
+    "uint64": UINT64, "bfloat16": BFLOAT16,
+}
+_ONNX2NP = {v: k for k, v in _NP2ONNX.items() if k != "bfloat16"}
+_ONNX2NP[BFLOAT16] = "float32"  # decoded as f32 (numpy has no bf16)
+
+# --- AttributeProto.AttributeType enum ---
+A_FLOAT = 1
+A_INT = 2
+A_STRING = 3
+A_TENSOR = 4
+A_FLOATS = 6
+A_INTS = 7
+A_STRINGS = 8
+
+
+def np_dtype_to_onnx(dt) -> int:
+    return _NP2ONNX[str(onp.dtype(dt)) if str(dt) != "bfloat16"
+                    else "bfloat16"]
+
+
+def onnx_dtype_to_np(code: int):
+    return onp.dtype(_ONNX2NP[code])
+
+
+# ---------------------------------------------------------------------------
+# wire primitives
+# ---------------------------------------------------------------------------
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def _ld(field: int, payload: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def _vint(field: int, value: int) -> bytes:
+    return _tag(field, 0) + _varint(int(value))
+
+
+def _f32(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", float(value))
+
+
+def _s(field: int, value) -> bytes:
+    if isinstance(value, str):
+        value = value.encode()
+    return _ld(field, value)
+
+
+# ---------------------------------------------------------------------------
+# encoders (dict -> bytes)
+# ---------------------------------------------------------------------------
+def _enc_tensor(t: dict) -> bytes:
+    out = bytearray()
+    for d in t.get("dims", ()):
+        out += _vint(1, d)
+    out += _vint(2, t["data_type"])
+    if "raw_data" in t:
+        out += _s(9, t["raw_data"])
+    if "name" in t:
+        out += _s(8, t["name"])
+    return bytes(out)
+
+
+def _enc_attr(a: dict) -> bytes:
+    out = bytearray()
+    out += _s(1, a["name"])
+    typ = a["type"]
+    if typ == A_FLOAT:
+        out += _f32(2, a["f"])
+    elif typ == A_INT:
+        out += _vint(3, a["i"])
+    elif typ == A_STRING:
+        out += _s(4, a["s"])
+    elif typ == A_TENSOR:
+        out += _ld(5, _enc_tensor(a["t"]))
+    elif typ == A_FLOATS:
+        for v in a["floats"]:
+            out += _f32(7, v)
+    elif typ == A_INTS:
+        for v in a["ints"]:
+            out += _vint(8, v)
+    elif typ == A_STRINGS:
+        for v in a["strings"]:
+            out += _s(9, v)
+    else:
+        raise ValueError(f"unsupported attribute type {typ}")
+    out += _vint(20, typ)
+    return bytes(out)
+
+
+def _enc_node(n: dict) -> bytes:
+    out = bytearray()
+    for i in n.get("input", ()):
+        out += _s(1, i)
+    for o in n.get("output", ()):
+        out += _s(2, o)
+    if n.get("name"):
+        out += _s(3, n["name"])
+    out += _s(4, n["op_type"])
+    for a in n.get("attribute", ()):
+        out += _ld(5, _enc_attr(a))
+    return bytes(out)
+
+
+def _enc_dim(d) -> bytes:
+    if isinstance(d, int):
+        return _vint(1, d)
+    return _s(2, str(d))  # symbolic
+
+
+def _enc_value_info(v: dict) -> bytes:
+    shape = bytearray()
+    for d in v["shape"]:
+        shape += _ld(1, _enc_dim(d))
+    tensor_type = _vint(1, v["elem_type"]) + _ld(2, bytes(shape))
+    type_proto = _ld(1, tensor_type)
+    return _s(1, v["name"]) + _ld(2, type_proto)
+
+
+def _enc_graph(g: dict) -> bytes:
+    out = bytearray()
+    for n in g["node"]:
+        out += _ld(1, _enc_node(n))
+    out += _s(2, g.get("name", "mxnet_tpu"))
+    for t in g.get("initializer", ()):
+        out += _ld(5, _enc_tensor(t))
+    for v in g.get("input", ()):
+        out += _ld(11, _enc_value_info(v))
+    for v in g.get("output", ()):
+        out += _ld(12, _enc_value_info(v))
+    return bytes(out)
+
+
+def encode_model(graph: dict, opset_version=13, producer="mxnet_tpu",
+                 ir_version=8) -> bytes:
+    out = bytearray()
+    out += _vint(1, ir_version)
+    out += _s(2, producer)
+    out += _s(3, "3.0")
+    out += _ld(7, _enc_graph(graph))
+    opset = _s(1, "") + _vint(2, opset_version)
+    out += _ld(8, opset)
+    return bytes(out)
+
+
+# ---------------------------------------------------------------------------
+# decoders (bytes -> dict)
+# ---------------------------------------------------------------------------
+def _read_varint(buf, pos):
+    result = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+
+
+def _fields(buf):
+    """Yield (field_number, wire_type, value) skipping nothing."""
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _read_varint(buf, pos)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, pos = _read_varint(buf, pos)
+        elif wire == 2:
+            ln, pos = _read_varint(buf, pos)
+            val = buf[pos:pos + ln]
+            pos += ln
+        elif wire == 5:
+            val = buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:
+            val = buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _dec_tensor(buf) -> dict:
+    t = {"dims": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            t["dims"].append(v)
+        elif f == 2:
+            t["data_type"] = v
+        elif f == 8:
+            t["name"] = v.decode()
+        elif f == 9:
+            t["raw_data"] = bytes(v)
+        elif f == 4 and w == 5:  # float_data (unpacked)
+            t.setdefault("float_data", []).append(
+                struct.unpack("<f", v)[0])
+    return t
+
+
+def _dec_attr(buf) -> dict:
+    a = {}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            a["name"] = v.decode()
+        elif f == 2:
+            a["f"] = struct.unpack("<f", v)[0]
+        elif f == 3:
+            a["i"] = v
+        elif f == 4:
+            a["s"] = bytes(v)
+        elif f == 5:
+            a["t"] = _dec_tensor(v)
+        elif f == 7:
+            a.setdefault("floats", []).append(struct.unpack("<f", v)[0])
+        elif f == 8:
+            a.setdefault("ints", []).append(v)
+        elif f == 9:
+            a.setdefault("strings", []).append(bytes(v))
+        elif f == 20:
+            a["type"] = v
+    return a
+
+
+def _dec_node(buf) -> dict:
+    n = {"input": [], "output": [], "attribute": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            n["input"].append(v.decode())
+        elif f == 2:
+            n["output"].append(v.decode())
+        elif f == 3:
+            n["name"] = v.decode()
+        elif f == 4:
+            n["op_type"] = v.decode()
+        elif f == 5:
+            n["attribute"].append(_dec_attr(v))
+    return n
+
+
+def _dec_value_info(buf) -> dict:
+    out = {"name": None, "elem_type": None, "shape": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            out["name"] = v.decode()
+        elif f == 2:
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 1:  # tensor_type
+                    for f3, _w3, v3 in _fields(v2):
+                        if f3 == 1:
+                            out["elem_type"] = v3
+                        elif f3 == 2:
+                            for f4, _w4, v4 in _fields(v3):
+                                if f4 == 1:
+                                    dim = {"value": None}
+                                    for f5, _w5, v5 in _fields(v4):
+                                        if f5 == 1:
+                                            dim["value"] = v5
+                                        elif f5 == 2:
+                                            dim["value"] = v5.decode()
+                                    out["shape"].append(dim["value"])
+    return out
+
+
+def _dec_graph(buf) -> dict:
+    g = {"node": [], "initializer": [], "input": [], "output": []}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            g["node"].append(_dec_node(v))
+        elif f == 2:
+            g["name"] = v.decode()
+        elif f == 5:
+            g["initializer"].append(_dec_tensor(v))
+        elif f == 11:
+            g["input"].append(_dec_value_info(v))
+        elif f == 12:
+            g["output"].append(_dec_value_info(v))
+    return g
+
+
+def decode_model(buf: bytes) -> dict:
+    m = {"opset": None, "graph": None}
+    for f, w, v in _fields(buf):
+        if f == 1:
+            m["ir_version"] = v
+        elif f == 2:
+            m["producer_name"] = v.decode()
+        elif f == 7:
+            m["graph"] = _dec_graph(v)
+        elif f == 8:
+            for f2, _w2, v2 in _fields(v):
+                if f2 == 2:
+                    m["opset"] = v2
+    return m
+
+
+def tensor_to_numpy(t: dict) -> onp.ndarray:
+    dt = onnx_dtype_to_np(t["data_type"])
+    if "raw_data" in t:
+        if t["data_type"] == BFLOAT16:
+            # bf16 raw: upper 16 bits of f32
+            raw = onp.frombuffer(t["raw_data"], dtype=onp.uint16)
+            as32 = raw.astype(onp.uint32) << 16
+            arr = as32.view(onp.float32)
+        else:
+            arr = onp.frombuffer(t["raw_data"], dtype=dt)
+        return arr.reshape(t["dims"]).copy()
+    if "float_data" in t:
+        return onp.asarray(t["float_data"], dtype=onp.float32) \
+            .reshape(t["dims"])
+    return onp.zeros(t["dims"], dtype=dt)
+
+
+def numpy_to_tensor(arr, name: str) -> dict:
+    sdt = str(arr.dtype)
+    if sdt == "bfloat16":
+        as32 = onp.asarray(arr, dtype=onp.float32)
+        raw = (as32.view(onp.uint32) >> 16).astype(onp.uint16).tobytes()
+        code = BFLOAT16
+    else:
+        arr = onp.ascontiguousarray(arr)
+        raw = arr.tobytes()
+        code = np_dtype_to_onnx(arr.dtype)
+    return {"dims": list(arr.shape), "data_type": code,
+            "raw_data": raw, "name": name}

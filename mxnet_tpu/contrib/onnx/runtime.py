@@ -1,0 +1,270 @@
+"""Minimal ONNX graph evaluator + importer.
+
+Two jobs (parity: contrib/onnx/onnx2mx — the reference imports ONNX
+back into its own graph IR):
+- `OnnxGraph.run(feeds)` evaluates a decoded ONNX graph with
+  NumPy/lax semantics reconstructed from the ONNX spec — an
+  independent execution path used to validate exported files (the
+  environment ships no onnxruntime).
+- `import_model(path)` wraps that evaluator as a callable returning
+  NDArrays, giving ONNX *import* capability.
+
+Covers the op set mx2onnx emits (opset 13): Conv, MaxPool,
+AveragePool, MatMul, elementwise/unary math, Where, comparisons,
+Reshape, Expand, Transpose, Concat, Slice, Pad, Cast, Reduce*,
+ArgMax, Identity.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from . import proto
+
+
+def _to_np(x):
+    return onp.asarray(x)
+
+
+class OnnxGraph:
+    def __init__(self, model: dict):
+        self.graph = model["graph"]
+        self.opset = model.get("opset")
+        self.initializers = {
+            t["name"]: proto.tensor_to_numpy(t)
+            for t in self.graph["initializer"]}
+        self.input_names = [v["name"] for v in self.graph["input"]
+                            if v["name"] not in self.initializers]
+        self.output_names = [v["name"] for v in self.graph["output"]]
+
+    @classmethod
+    def load(cls, path):
+        with open(path, "rb") as f:
+            return cls(proto.decode_model(f.read()))
+
+    # -- op semantics ---------------------------------------------------
+    @staticmethod
+    def _attrs(node):
+        out = {}
+        for a in node["attribute"]:
+            t = a["type"]
+            if t == proto.A_INT:
+                out[a["name"]] = a["i"]
+            elif t == proto.A_FLOAT:
+                out[a["name"]] = a["f"]
+            elif t == proto.A_INTS:
+                out[a["name"]] = list(a["ints"])
+            elif t == proto.A_STRING:
+                out[a["name"]] = a["s"].decode()
+            elif t == proto.A_TENSOR:
+                out[a["name"]] = proto.tensor_to_numpy(a["t"])
+        return out
+
+    def _eval_node(self, node, env):
+        import jax.numpy as jnp
+        from jax import lax
+        op = node["op_type"]
+        ins = [env[i] for i in node["input"]]
+        at = self._attrs(node)
+
+        def conv():
+            x, w = ins[0], ins[1]
+            strides = at.get("strides", [1] * (x.ndim - 2))
+            pads = at.get("pads", [0] * 2 * (x.ndim - 2))
+            dil = at.get("dilations", [1] * (x.ndim - 2))
+            g = at.get("group", 1)
+            nsp = x.ndim - 2
+            pad_pairs = [(pads[i], pads[i + nsp]) for i in range(nsp)]
+            y = lax.conv_general_dilated(
+                jnp.asarray(x, jnp.float32), jnp.asarray(w, jnp.float32),
+                strides, pad_pairs, rhs_dilation=dil,
+                feature_group_count=g)
+            r = onp.asarray(y)
+            if len(ins) == 3:
+                r = r + ins[2].reshape((1, -1) + (1,) * nsp)
+            return r
+
+        def pool(kind):
+            x = ins[0]
+            nsp = x.ndim - 2
+            k = at["kernel_shape"]
+            strides = at.get("strides", [1] * nsp)
+            pads = at.get("pads", [0] * 2 * nsp)
+            pad_pairs = [(0, 0), (0, 0)] + \
+                [(pads[i], pads[i + nsp]) for i in range(nsp)]
+            window = (1, 1) + tuple(k)
+            stride = (1, 1) + tuple(strides)
+            if kind == "max":
+                init = -onp.inf
+                y = lax.reduce_window(jnp.asarray(x, jnp.float32), init,
+                                      lax.max, window, stride, pad_pairs)
+                return onp.asarray(y)
+            y = lax.reduce_window(jnp.asarray(x, jnp.float32), 0.0,
+                                  lax.add, window, stride, pad_pairs)
+            if at.get("count_include_pad", 0):
+                denom = float(onp.prod(k))
+                return onp.asarray(y) / denom
+            ones = jnp.ones_like(jnp.asarray(x, jnp.float32))
+            denom = lax.reduce_window(ones, 0.0, lax.add, window,
+                                      stride, pad_pairs)
+            return onp.asarray(y / denom)
+
+        table = {
+            "Add": lambda: ins[0] + ins[1],
+            "Sub": lambda: ins[0] - ins[1],
+            "Mul": lambda: ins[0] * ins[1],
+            "Div": lambda: ins[0] / ins[1],
+            "Pow": lambda: onp.power(ins[0], ins[1]),
+            "Max": lambda: onp.maximum(ins[0], ins[1]),
+            "Min": lambda: onp.minimum(ins[0], ins[1]),
+            "Mod": lambda: onp.mod(ins[0], ins[1]),
+            "MatMul": lambda: onp.matmul(ins[0], ins[1]),
+            "Gemm": lambda: self._gemm(ins, at),
+            "Conv": conv,
+            "MaxPool": lambda: pool("max"),
+            "AveragePool": lambda: pool("avg"),
+            "Relu": lambda: onp.maximum(ins[0], 0),
+            "Sigmoid": lambda: 1.0 / (1.0 + onp.exp(-ins[0])),
+            "Tanh": lambda: onp.tanh(ins[0]),
+            "Exp": lambda: onp.exp(ins[0]),
+            "Log": lambda: onp.log(ins[0]),
+            "Sqrt": lambda: onp.sqrt(ins[0]),
+            "Reciprocal": lambda: 1.0 / ins[0],
+            "Neg": lambda: -ins[0],
+            "Abs": lambda: onp.abs(ins[0]),
+            "Sign": lambda: onp.sign(ins[0]),
+            "Floor": lambda: onp.floor(ins[0]),
+            "Ceil": lambda: onp.ceil(ins[0]),
+            "Round": lambda: onp.round(ins[0]),
+            "Erf": lambda: self._erf(ins[0]),
+            "Sin": lambda: onp.sin(ins[0]),
+            "Cos": lambda: onp.cos(ins[0]),
+            "Tan": lambda: onp.tan(ins[0]),
+            "Atan": lambda: onp.arctan(ins[0]),
+            "Asin": lambda: onp.arcsin(ins[0]),
+            "Acos": lambda: onp.arccos(ins[0]),
+            "Sinh": lambda: onp.sinh(ins[0]),
+            "Cosh": lambda: onp.cosh(ins[0]),
+            "Identity": lambda: ins[0],
+            "Cast": lambda: ins[0].astype(
+                proto.onnx_dtype_to_np(at["to"])),
+            "Reshape": lambda: ins[0].reshape(
+                [int(v) for v in ins[1]]),
+            "Expand": lambda: onp.broadcast_to(
+                ins[0], [int(v) for v in ins[1]]).copy(),
+            "Transpose": lambda: onp.transpose(ins[0], at["perm"]),
+            "Concat": lambda: onp.concatenate(ins, axis=at["axis"]),
+            "Where": lambda: onp.where(ins[0].astype(bool), ins[1],
+                                       ins[2]),
+            "Greater": lambda: ins[0] > ins[1],
+            "Less": lambda: ins[0] < ins[1],
+            "GreaterOrEqual": lambda: ins[0] >= ins[1],
+            "LessOrEqual": lambda: ins[0] <= ins[1],
+            "Equal": lambda: ins[0] == ins[1],
+            "Not": lambda: ~ins[0].astype(bool),
+            "IsInf": lambda: onp.isinf(ins[0]),
+            "IsNaN": lambda: onp.isnan(ins[0]),
+            "And": lambda: ins[0].astype(bool) & ins[1].astype(bool),
+            "Or": lambda: ins[0].astype(bool) | ins[1].astype(bool),
+            "Xor": lambda: ins[0].astype(bool) ^ ins[1].astype(bool),
+            "ReduceSum": lambda: onp.sum(
+                ins[0], axis=tuple(int(v) for v in ins[1])
+                if len(ins) > 1 else None,
+                keepdims=bool(at.get("keepdims", 1))),
+            "ReduceMax": lambda: onp.max(
+                ins[0], axis=tuple(at["axes"]),
+                keepdims=bool(at.get("keepdims", 1))),
+            "ReduceMin": lambda: onp.min(
+                ins[0], axis=tuple(at["axes"]),
+                keepdims=bool(at.get("keepdims", 1))),
+            "ReduceMean": lambda: onp.mean(
+                ins[0], axis=tuple(at["axes"]),
+                keepdims=bool(at.get("keepdims", 1))),
+            "ArgMax": lambda: onp.argmax(
+                ins[0], axis=at.get("axis", 0)),
+            "Softmax": lambda: self._softmax(ins[0],
+                                             at.get("axis", -1)),
+            "Pad": lambda: self._pad(ins),
+            "Slice": lambda: self._slice(ins),
+            "Flatten": lambda: ins[0].reshape(ins[0].shape[0], -1),
+        }
+        if op not in table:
+            raise NotImplementedError(f"evaluator: ONNX op {op!r}")
+        return table[op]()
+
+    @staticmethod
+    def _gemm(ins, at):
+        a, b = ins[0], ins[1]
+        if at.get("transA", 0):
+            a = a.T
+        if at.get("transB", 0):
+            b = b.T
+        y = at.get("alpha", 1.0) * (a @ b)
+        if len(ins) == 3:
+            y = y + at.get("beta", 1.0) * ins[2]
+        return y
+
+    @staticmethod
+    def _erf(x):
+        from math import erf
+        return onp.vectorize(erf)(x).astype(onp.asarray(x).dtype)
+
+    @staticmethod
+    def _softmax(x, axis):
+        e = onp.exp(x - onp.max(x, axis=axis, keepdims=True))
+        return e / e.sum(axis=axis, keepdims=True)
+
+    @staticmethod
+    def _pad(ins):
+        x, pads = ins[0], [int(v) for v in ins[1]]
+        nd = x.ndim
+        pairs = [(pads[i], pads[i + nd]) for i in range(nd)]
+        cval = float(ins[2]) if len(ins) > 2 else 0.0
+        return onp.pad(x, pairs, constant_values=cval)
+
+    @staticmethod
+    def _slice(ins):
+        x = ins[0]
+        starts = [int(v) for v in ins[1]]
+        ends = [int(v) for v in ins[2]]
+        axes = [int(v) for v in ins[3]] if len(ins) > 3 \
+            else list(range(len(starts)))
+        steps = [int(v) for v in ins[4]] if len(ins) > 4 \
+            else [1] * len(starts)
+        sl = [slice(None)] * x.ndim
+        for ax, s, e, st in zip(axes, starts, ends, steps):
+            lo = s if s >= -x.shape[ax] else None
+            hi = e if -x.shape[ax] <= e < 2 ** 31 - 1 else \
+                (None if st > 0 or e < -(2 ** 30) else e)
+            if st < 0 and e <= -(2 ** 30):
+                hi = None
+            sl[ax] = slice(lo, hi, st)
+        return x[tuple(sl)]
+
+    def run(self, feeds: dict):
+        env = dict(self.initializers)
+        for k, v in feeds.items():
+            env[k] = _to_np(v)
+        for node in self.graph["node"]:
+            outs = node["output"]
+            res = self._eval_node(node, env)
+            env[outs[0]] = _to_np(res)
+        return [env[n] for n in self.output_names]
+
+
+def import_model(path):
+    """Load an ONNX file as a callable over NDArrays (parity:
+    contrib/onnx/onnx2mx import_model — the reference rebuilds a
+    Symbol; here the decoded graph is evaluated directly)."""
+    g = OnnxGraph.load(path)
+
+    def fn(*args):
+        import mxnet_tpu as mx
+        feeds = {name: (a.asnumpy() if hasattr(a, "asnumpy") else a)
+                 for name, a in zip(g.input_names, args)}
+        outs = [mx.np.array(o) if o.dtype != onp.int64
+                else mx.np.array(o.astype(onp.int32))
+                for o in g.run(feeds)]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    fn.graph = g
+    return fn

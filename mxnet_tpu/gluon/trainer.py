@@ -165,9 +165,6 @@ class Trainer:
             return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
-        if scaler is not None:
-            scaler.update_scale(False)
-            self._amp_manual_unscaled = False
 
     def allreduce_grads(self):
         self._check_and_init()
@@ -182,6 +179,13 @@ class Trainer:
         self._check_and_init()
         self._optimizer.rescale_grad = self._grad_rescale(batch_size)
         self._update(ignore_stale_grad)
+        # successful update: adapt the loss scale and retire the
+        # manual-unscale flag — update() is the single place gradients
+        # are consumed, whether reached via step() or standalone
+        scaler = getattr(self, "_amp_loss_scaler", None)
+        if scaler is not None:
+            scaler.update_scale(False)
+            self._amp_manual_unscaled = False
 
     def _update(self, ignore_stale_grad=False):
         for i, param in enumerate(self._params):

@@ -148,6 +148,11 @@ class ImageIter:
             idx = path_imgrec[:path_imgrec.rfind(".")] + ".idx"
             self._rec = MXIndexedRecordIO(idx, path_imgrec, "r")
             self._keys = list(self._rec.keys)
+            # the native reader indexes records by byte order in the
+            # .rec; rank each key's byte offset to get its ordinal
+            # (robust to .idx files whose lines are not in file order)
+            by_offset = sorted(self._rec.idx, key=self._rec.idx.get)
+            self._key_to_ord = {k: i for i, k in enumerate(by_offset)}
         else:
             self._list = []
             with open(path_imglist) as f:
@@ -175,10 +180,12 @@ class ImageIter:
             raise StopIteration
         if self._native is not None and not self.aug_list:
             keys = self._order[self._cursor:self._cursor + self.batch_size]
-            # native keys are record ordinals; MXIndexedRecordIO keys
-            # are written densely so they coincide for im2rec output
+            # the native reader indexes records by file ordinal; .idx
+            # keys can be arbitrary, so map key -> position in the idx
+            # (idx rows are written in record order)
+            ords = [self._key_to_ord[k] for k in keys]
             batch, labels = self._native.read_batch(
-                keys, (self.data_shape[1], self.data_shape[2]))
+                ords, (self.data_shape[1], self.data_shape[2]))
             self._cursor += self.batch_size
             lab = labels if self._native.label_width > 1 else labels[:, 0]
             return (array(batch.astype(onp.float32)).transpose(0, 3, 1, 2),

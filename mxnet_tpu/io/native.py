@@ -45,7 +45,14 @@ def get_lib():
     if _load_error is not None:
         raise _load_error
     try:
-        _build_if_needed()
+        try:
+            _build_if_needed()
+        except (RuntimeError, OSError, subprocess.TimeoutExpired):
+            # stale-mtime rebuild failed (no toolchain on this box);
+            # a previously-built .so is still usable — prefer it over
+            # disabling the native path
+            if not os.path.exists(_SO):
+                raise
         lib = ctypes.CDLL(_SO)
         lib.rio_open.restype = ctypes.c_void_p
         lib.rio_open.argtypes = [ctypes.c_char_p]

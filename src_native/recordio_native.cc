@@ -57,6 +57,10 @@ bool decode_resize(const uint8_t* buf, size_t len, int oh, int ow,
                    uint8_t* out) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
+  // declared before setjmp: on a longjmp out of libjpeg the early
+  // return still unwinds this frame normally, so the buffer is freed
+  // (declaring it after setjmp would leak it on corrupt JPEGs)
+  std::vector<uint8_t> pix;
   cinfo.err = jpeg_std_error(&jerr.mgr);
   jerr.mgr.error_exit = jpeg_err_exit;
   if (setjmp(jerr.jb)) {
@@ -84,7 +88,7 @@ bool decode_resize(const uint8_t* buf, size_t len, int oh, int ow,
   jpeg_start_decompress(&cinfo);
   const int h = cinfo.output_height, w = cinfo.output_width;
   const int c = cinfo.output_components;  // 3 (RGB)
-  std::vector<uint8_t> pix(static_cast<size_t>(h) * w * c);
+  pix.resize(static_cast<size_t>(h) * w * c);
   JSAMPROW row;
   while (cinfo.output_scanline < cinfo.output_height) {
     row = pix.data() + static_cast<size_t>(cinfo.output_scanline) * w * c;

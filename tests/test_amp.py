@@ -180,3 +180,37 @@ def test_manual_unscale_not_double_divided():
     # d(sum(Wx))/dW = sum of x rows = 4; lr=1 -> w = -4
     onp.testing.assert_allclose(net.weight.data().asnumpy(),
                                 onp.full((1, 3), -4.0), rtol=1e-5)
+
+
+def test_manual_unscale_flag_cleared_by_update():
+    """A standalone allreduce+update after amp.unscale must clear the
+    manual flag so the NEXT plain step() divides by the scale again
+    (review r3 finding: stale flag skipped the division)."""
+    x = np.array(onp.ones((4, 3), onp.float32))
+    net = nn.Dense(1)
+    net.initialize()
+    net(x)
+    net.weight.set_data(np.zeros((1, 3)))
+    net.bias.set_data(np.zeros(1))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1.0})
+    amp.init_trainer(tr)
+    # iteration 1: manual unscale + standalone update
+    with autograd.record():
+        l = net(x).sum()
+        with amp.scale_loss(l, tr) as scaled:
+            scaled.backward()
+    amp.unscale(tr)
+    tr.allreduce_grads()
+    tr.update(1)
+    assert not tr._amp_manual_unscaled
+    w1 = net.weight.data().asnumpy().copy()
+    onp.testing.assert_allclose(w1, onp.full((1, 3), -4.0), rtol=1e-5)
+    # iteration 2: plain step() — must divide by the loss scale
+    with autograd.record():
+        l = net(x).sum()
+        with amp.scale_loss(l, tr) as scaled:
+            scaled.backward()
+    tr.step(1)
+    onp.testing.assert_allclose(net.weight.data().asnumpy(),
+                                onp.full((1, 3), -8.0), rtol=1e-5)

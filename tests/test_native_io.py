@@ -133,3 +133,24 @@ def test_image_iter_with_augmenters_skips_native_build(packed):
     assert it._native is None  # portable path; no native reader built
     data, _ = next(it)
     assert data.shape == (2, 3, 48, 64)
+
+
+def test_image_iter_non_dense_keys(tmp_path):
+    """Sparse .idx keys (filtered dataset) must map to the right
+    records on the native path (review r3 finding)."""
+    rec_path = str(tmp_path / "sparse.rec")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "sparse.idx"),
+                                     rec_path, "w")
+    keys = [10, 20, 30, 40]
+    for i, k in enumerate(keys):
+        buf = pyio.BytesIO()
+        Image.fromarray(_smooth(i)).save(buf, format="JPEG")
+        rec.write_idx(k, recordio.pack(
+            recordio.IRHeader(0, float(k), k, 0), buf.getvalue()))
+    rec.close()
+    from mxnet_tpu.image import ImageIter
+    it = ImageIter(batch_size=4, data_shape=(3, 48, 64),
+                   path_imgrec=rec_path)
+    assert it._native is not None
+    _, labels = next(it)
+    onp.testing.assert_allclose(labels.asnumpy(), [10., 20., 30., 40.])

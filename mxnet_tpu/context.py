@@ -132,6 +132,37 @@ class Context:
         """
         jax.clear_caches()
 
+    def memory_info(self):
+        """(free_bytes, total_bytes) for this context's device (parity:
+        mx.context.gpu_memory_info, python/mxnet/context.py:24-249;
+        backed by PJRT memory stats).
+
+        On backends without allocator stats (CPU PJRT), total falls
+        back to host memory and free = total - live jax allocations.
+        """
+        dev = self.jax_device
+        stats = None
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without stats
+            stats = None
+        if stats:
+            total = int(stats.get("bytes_limit",
+                                  stats.get("bytes_reservable_limit", 0)))
+            in_use = int(stats.get("bytes_in_use", 0))
+            if total:
+                return (total - in_use, total)
+        # host fallback: total from /proc, in-use from live arrays
+        try:
+            with open("/proc/meminfo") as f:
+                total = next(int(l.split()[1]) * 1024 for l in f
+                             if l.startswith("MemTotal"))
+        except (OSError, StopIteration):
+            total = 0
+        in_use = sum(b.nbytes for b in jax.live_arrays()
+                     if b.device == dev)
+        return (max(total - in_use, 0), total)
+
 
 def cpu(device_id: int = 0) -> Context:
     return Context("cpu", device_id)
@@ -172,14 +203,10 @@ def current_context() -> Context:
 
 
 def gpu_memory_info(device_id: int = 0):
-    """(free, total) bytes on the accelerator, when the backend reports it."""
-    ctx = tpu(device_id)
-    dev = ctx.jax_device
-    stats = {}
-    try:
-        stats = dev.memory_stats() or {}
-    except Exception:
-        pass
-    total = stats.get("bytes_limit", 0)
-    used = stats.get("bytes_in_use", 0)
-    return (total - used, total)
+    """(free, total) bytes on accelerator `device_id` (parity:
+    mx.context.gpu_memory_info — 'gpu' means 'the accelerator')."""
+    return tpu(device_id).memory_info()
+
+
+def tpu_memory_info(device_id: int = 0):
+    return tpu(device_id).memory_info()

@@ -360,8 +360,137 @@ class PearsonCorrelation(EvalMetric):
         return (self.name, float(onp.corrcoef(x, y)[0, 1]))
 
 
-PCC = PearsonCorrelation
-_REGISTRY["pcc"] = PearsonCorrelation
+@register
+class Fbeta(F1):
+    """F-beta: weighted harmonic mean of precision/recall (parity:
+    gluon/metric.py Fbeta)."""
+
+    def __init__(self, name="fbeta", output_names=None, label_names=None,
+                 average="macro", beta=1.0):
+        self.beta = float(beta)
+        super().__init__(name, output_names, label_names, average)
+        self._kwargs["beta"] = self.beta
+
+    def get(self):
+        if self.stats.total == 0:
+            return (self.name, float("nan"))
+        st = self.stats
+        prec = st.tp / (st.tp + st.fp) if st.tp + st.fp else 0.0
+        rec = st.tp / (st.tp + st.fn) if st.tp + st.fn else 0.0
+        b2 = self.beta * self.beta
+        denom = b2 * prec + rec
+        val = (1 + b2) * prec * rec / denom if denom else 0.0
+        return (self.name, val)
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Accuracy of a thresholded binary prediction (parity:
+    gluon/metric.py BinaryAccuracy)."""
+
+    def __init__(self, name="binary_accuracy", output_names=None,
+                 label_names=None, threshold=0.5):
+        self.threshold = threshold
+        super().__init__(name, output_names, label_names,
+                         threshold=threshold)
+
+    def update(self, labels, preds):
+        labels, preds = _flat_pairs(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).ravel()
+            pred = (_to_np(pred).ravel() > self.threshold)
+            self.sum_metric += float((pred == (label > 0.5)).sum())
+            self.num_inst += label.size
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean p-norm distance between prediction and label rows (parity:
+    gluon/metric.py MeanPairwiseDistance)."""
+
+    def __init__(self, name="mpd", output_names=None, label_names=None,
+                 p=2):
+        self.p = p
+        super().__init__(name, output_names, label_names, p=p)
+
+    def update(self, labels, preds):
+        labels, preds = _flat_pairs(labels, preds)
+        for label, pred in zip(labels, preds):
+            label, pred = _to_np(label), _to_np(pred)
+            d = onp.linalg.norm(
+                (pred - label.reshape(pred.shape)).reshape(
+                    pred.shape[0], -1), ord=self.p, axis=1)
+            self.sum_metric += float(d.sum())
+            self.num_inst += pred.shape[0]
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (parity:
+    gluon/metric.py MeanCosineSimilarity)."""
+
+    def __init__(self, name="cos_sim", output_names=None,
+                 label_names=None, eps=1e-12):
+        self.eps = eps
+        super().__init__(name, output_names, label_names, eps=eps)
+
+    def update(self, labels, preds):
+        labels, preds = _flat_pairs(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).reshape(_to_np(pred).shape)
+            pred = _to_np(pred)
+            num = (label * pred).sum(-1)
+            den = onp.maximum(onp.linalg.norm(label, axis=-1) *
+                              onp.linalg.norm(pred, axis=-1), self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += sim.size
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation via the k x k confusion matrix
+    (parity: gluon/metric.py PCC — reduces to MCC for k=2)."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        self._cm = onp.zeros((0, 0), dtype=onp.float64)
+        super().reset()
+
+    def _grow(self, k):
+        if k > self._cm.shape[0]:
+            cm = onp.zeros((k, k), dtype=onp.float64)
+            old = self._cm.shape[0]
+            cm[:old, :old] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        labels, preds = _flat_pairs(labels, preds)
+        for label, pred in zip(labels, preds):
+            label = _to_np(label).ravel().astype(onp.int64)
+            pred = _to_np(pred)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = pred.argmax(-1)
+            pred = pred.ravel().astype(onp.int64)
+            k = int(max(label.max(), pred.max())) + 1
+            self._grow(k)
+            onp.add.at(self._cm, (label, pred), 1)
+            self.num_inst += label.size
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        c = self._cm
+        n = c.sum()
+        t = c.sum(axis=1)  # true counts
+        p = c.sum(axis=0)  # predicted counts
+        cov_tp = (onp.trace(c) * n - (t * p).sum())
+        cov_tt = (n * n - (t * t).sum())
+        cov_pp = (n * n - (p * p).sum())
+        denom = onp.sqrt(cov_tt * cov_pp)
+        return (self.name, float(cov_tp / denom) if denom else 0.0)
 
 
 @register
@@ -378,6 +507,14 @@ class Loss(EvalMetric):
             loss = float(_to_np(pred).sum())
             self.sum_metric += loss
             self.num_inst += _to_np(pred).size
+
+
+@register
+class Torch(Loss):
+    """Legacy alias kept for parity (gluon/metric.py Torch)."""
+
+    def __init__(self, name="torch", output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
 
 
 @register

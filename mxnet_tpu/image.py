@@ -223,3 +223,266 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         augs.append(Normalize(mean if mean is not None else 0.0,
                               std if std is not None else 1.0))
     return augs
+
+
+# ---------------------------------------------------------------------------
+# Object-detection pipeline (parity: python/mxnet/image/detection.py —
+# ImageDetIter + the Det* augmenter zoo). Labels follow the reference's
+# raw format: [header_width, obj_width, ...header, (id, xmin, ymin,
+# xmax, ymax, ...) * n] with normalized [0, 1] corner coordinates.
+# ---------------------------------------------------------------------------
+class DetAugmenter:
+    """Base detection augmenter: __call__(src_hwc, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection pipeline
+    (parity: image/detection.py DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Random horizontal flip of image + x coordinates (parity:
+    DetHorizontalFlipAug)."""
+
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if onp.random.uniform() < self.p:
+            src = src[:, ::-1]
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping enough of each object (parity:
+    DetRandomCropAug — min_object_covered / area_range sampling)."""
+
+    def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
+                 1.33), area_range=(0.05, 1.0), max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _overlap(self, boxes, crop):
+        cx1, cy1, cx2, cy2 = crop
+        ix1 = onp.maximum(boxes[:, 1], cx1)
+        iy1 = onp.maximum(boxes[:, 2], cy1)
+        ix2 = onp.minimum(boxes[:, 3], cx2)
+        iy2 = onp.minimum(boxes[:, 4], cy2)
+        iw = onp.maximum(ix2 - ix1, 0)
+        ih = onp.maximum(iy2 - iy1, 0)
+        inter = iw * ih
+        area = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
+        return inter / onp.maximum(area, 1e-12)
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            area_frac = onp.random.uniform(*self.area_range)
+            ar = onp.random.uniform(*self.aspect_ratio_range)
+            ch = onp.sqrt(area_frac / ar)
+            cw = onp.sqrt(area_frac * ar)
+            if ch > 1 or cw > 1:
+                continue
+            cy = onp.random.uniform(0, 1 - ch)
+            cx = onp.random.uniform(0, 1 - cw)
+            crop = (cx, cy, cx + cw, cy + ch)
+            cover = self._overlap(label, crop)
+            keep = cover >= self.min_object_covered
+            if not keep.any():
+                continue
+            new = label[keep].copy()
+            # clip + renormalize boxes into the crop frame
+            new[:, 1] = onp.clip((new[:, 1] - cx) / cw, 0, 1)
+            new[:, 2] = onp.clip((new[:, 2] - cy) / ch, 0, 1)
+            new[:, 3] = onp.clip((new[:, 3] - cx) / cw, 0, 1)
+            new[:, 4] = onp.clip((new[:, 4] - cy) / ch, 0, 1)
+            y1, y2 = int(cy * h), int((cy + ch) * h)
+            x1, x2 = int(cx * w), int((cx + cw) * w)
+            if y2 <= y1 + 1 or x2 <= x1 + 1:
+                continue
+            return src[y1:y2, x1:x2], new
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion pad; boxes shrink into the padded frame
+    (parity: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.area_range = area_range
+        self.aspect_ratio_range = aspect_ratio_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            scale = onp.random.uniform(*self.area_range)
+            ar = onp.random.uniform(*self.aspect_ratio_range)
+            nh = int(h * onp.sqrt(scale / ar))
+            nw = int(w * onp.sqrt(scale * ar))
+            if nh < h or nw < w:
+                continue
+            oy = onp.random.randint(0, nh - h + 1)
+            ox = onp.random.randint(0, nw - w + 1)
+            c = src.shape[2]
+            canvas = onp.empty((nh, nw, c), dtype=src.dtype)
+            fill = onp.asarray(self.pad_val, dtype=src.dtype)
+            canvas[...] = fill[:c].reshape(1, 1, c) if fill.ndim else fill
+            canvas[oy:oy + h, ox:ox + w] = src
+            new = label.copy()
+            new[:, 1] = (new[:, 1] * w + ox) / nw
+            new[:, 2] = (new[:, 2] * h + oy) / nh
+            new[:, 3] = (new[:, 3] * w + ox) / nw
+            new[:, 4] = (new[:, 4] * h + oy) / nh
+            return canvas, new
+        return src, label
+
+
+class DetNormalizeAug(DetAugmenter):
+    """Mean/std color normalization; applied AFTER the resize to the
+    target shape (ImageDetIter splits it out), since normalization
+    produces float pixels PIL-based resizing would re-quantize."""
+
+    def __init__(self, mean, std):
+        self.mean = onp.asarray(mean if mean is not None else 0.0,
+                                onp.float32)
+        self.std = onp.asarray(std if std is not None else 1.0,
+                               onp.float32)
+
+    def __call__(self, src, label):
+        return (onp.asarray(src, onp.float32) - self.mean) / self.std, \
+            label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_mirror=False, mean=None, std=None,
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       pad_val=(127, 127, 127), **kwargs):
+    """Standard detection augmenter list (parity:
+    image/detection.py CreateDetAugmenter)."""
+    augs = []
+    if rand_crop > 0:
+        augs.append(DetRandomCropAug(
+            min_object_covered, aspect_ratio_range,
+            (area_range[0], min(1.0, area_range[1])), max_attempts))
+    if rand_pad > 0:
+        augs.append(DetRandomPadAug(
+            aspect_ratio_range, (max(1.0, area_range[0]), area_range[1]),
+            max_attempts, pad_val))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if mean is not None or std is not None:
+        augs.append(DetNormalizeAug(mean, std))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator over packed RecordIO (parity:
+    mx.image.ImageDetIter). Yields (data NCHW float32, label
+    (batch, max_objects, obj_width)) with -1 padding rows."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=None, shuffle=False,
+                 aug_list=None, **kwargs):
+        self.det_aug_list = aug_list if aug_list is not None else []
+        self._det_list = None
+        if path_imgrec is None and path_imglist is not None:
+            # .lst det format: idx \t l1 \t l2 ... \t relpath — the
+            # full label vector matters here, so parse it ourselves
+            # instead of ImageIter's single-float label handling
+            self._det_list = {}
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    idx = int(float(parts[0]))
+                    lab = onp.asarray([float(v) for v in parts[1:-1]],
+                                      onp.float32)
+                    self._det_list[idx] = (lab, os.path.join(
+                        path_root or "", parts[-1]))
+        super().__init__(batch_size, data_shape,
+                         path_imgrec=path_imgrec,
+                         path_imglist=path_imglist, path_root=path_root,
+                         shuffle=shuffle, aug_list=None,
+                         use_native=False, **kwargs)
+
+    @staticmethod
+    def _parse_label(raw):
+        """[header_width, obj_width, ...] -> (n_obj, obj_width) array
+        (parity: image/detection.py:717 _parse_label)."""
+        raw = onp.asarray(raw, dtype=onp.float32).ravel()
+        if raw.size < 7:
+            raise RuntimeError(f"Label shape is invalid: {raw.shape}")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if (raw.size - header_width) % obj_width != 0:
+            raise RuntimeError(
+                f"Label shape {raw.shape} inconsistent with annotation "
+                f"width {obj_width}")
+        out = raw[header_width:].reshape(-1, obj_width)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not valid.any():
+            raise RuntimeError("Encounter sample with no valid label.")
+        return out[valid]
+
+    def _read_raw(self, key):
+        from .recordio import unpack_img
+        if self._rec is not None:
+            header, img = unpack_img(self._rec.read_idx(key), iscolor=1)
+            return onp.asarray(img), self._parse_label(header.label)
+        raw_label, path = self._det_list[key]
+        return imread(path).asnumpy(), self._parse_label(raw_label)
+
+    def __next__(self):
+        from .numpy import array
+        if self._cursor + self.batch_size > len(self._order):
+            raise StopIteration
+        spatial = [a for a in self.det_aug_list
+                   if not isinstance(a, DetNormalizeAug)]
+        post = [a for a in self.det_aug_list
+                if isinstance(a, DetNormalizeAug)]
+        imgs, labels = [], []
+        for i in range(self._cursor, self._cursor + self.batch_size):
+            key = self._order[i]
+            img, label = self._read_raw(key)
+            for aug in spatial:
+                img, label = aug(img, label)
+            if img.shape[:2] != (self.data_shape[1], self.data_shape[2]):
+                img = imresize(array(img), self.data_shape[2],
+                               self.data_shape[1]).asnumpy()
+            img = img.astype(onp.float32)
+            for aug in post:
+                img, label = aug(img, label)
+            imgs.append(img.transpose(2, 0, 1))
+            labels.append(label)
+        self._cursor += self.batch_size
+        max_obj = max(lab.shape[0] for lab in labels)
+        obj_w = labels[0].shape[1]
+        padded = onp.full((len(labels), max_obj, obj_w), -1.0,
+                          onp.float32)
+        for i, lab in enumerate(labels):
+            padded[i, :lab.shape[0]] = lab
+        return array(onp.stack(imgs)), array(padded)
+
+    next = __next__

@@ -1,0 +1,195 @@
+"""An out-of-tree Horovod-style comm backend (parity shape:
+python/mxnet/kvstore/horovod.py — an external library's allreduce
+plugged in purely through `KVStoreBase.register`).
+
+This module deliberately lives OUTSIDE mxnet_tpu and touches no
+`mxnet_tpu.kvstore` internals beyond the public `KVStoreBase`
+interface: it brings its own transport (a TCP star over the
+MXNET_TPU_* env the launcher sets — standing in for horovod's
+MPI/NCCL ring) exactly like a third-party integration would.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as onp
+
+from mxnet_tpu.kvstore.base import KVStoreBase
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return pickle.loads(buf)
+
+
+class _StarComm:
+    """Rank-0-rooted reduce/broadcast transport (the 'external
+    library' this adapter wraps)."""
+
+    def __init__(self, rank, size, root_addr):
+        self.rank = rank
+        self.size = size
+        host, port = root_addr.rsplit(":", 1)
+        # the adapter must not collide with the coordinator port used
+        # by jax.distributed — shift to its own port space
+        self.addr = (host, int(port) + 1000)
+        self._lock = threading.Lock()
+        if rank == 0:
+            self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._srv.bind(self.addr)
+            self._srv.listen(size)
+            self._peers = []
+            t = threading.Thread(target=self._accept_loop, daemon=True)
+            t.start()
+        else:
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            for _ in range(200):
+                try:
+                    self._sock.connect(self.addr)
+                    break
+                except OSError:
+                    import time
+                    time.sleep(0.05)
+            else:
+                raise ConnectionError(f"cannot reach root at {self.addr}")
+            _send_msg(self._sock, ("hello", self.rank))
+
+    def _accept_loop(self):
+        for _ in range(self.size - 1):
+            conn, _ = self._srv.accept()
+            kind, rank = _recv_msg(conn)
+            assert kind == "hello"
+            self._peers.append((rank, conn))
+
+    def _wait_peers(self):
+        import time
+        for _ in range(400):
+            if len(self._peers) == self.size - 1:
+                return
+            time.sleep(0.05)
+        raise TimeoutError("workers did not connect")
+
+    def allreduce(self, name, arr):
+        """Sum `arr` across all ranks; every rank gets the result."""
+        if self.size == 1:
+            return arr
+        if self.rank == 0:
+            self._wait_peers()
+            with self._lock:
+                total = onp.array(arr, dtype=onp.float64)
+                conns = []
+                for _, conn in self._peers:
+                    kind, nm, a = _recv_msg(conn)
+                    assert kind == "reduce" and nm == name, (kind, nm)
+                    total += a
+                    conns.append(conn)
+                out = total.astype(arr.dtype)
+                for conn in conns:
+                    _send_msg(conn, out)
+                return out
+        with self._lock:
+            _send_msg(self._sock, ("reduce", name, onp.asarray(arr)))
+            return _recv_msg(self._sock)
+
+    def broadcast(self, name, arr):
+        """Every rank gets rank 0's value."""
+        if self.size == 1:
+            return arr
+        if self.rank == 0:
+            self._wait_peers()
+            with self._lock:
+                for _, conn in self._peers:
+                    kind, nm = _recv_msg(conn)
+                    assert kind == "bcast_req" and nm == name
+                    _send_msg(conn, onp.asarray(arr))
+                return arr
+        with self._lock:
+            _send_msg(self._sock, ("bcast_req", name))
+            return _recv_msg(self._sock)
+
+
+@KVStoreBase.register
+class CustomHvd(KVStoreBase):
+    """Horovod-shaped backend: broadcast + pushpull allreduce only
+    (no parameter server, no update_on_kvstore) — the same surface
+    the reference's Horovod adapter exposes."""
+
+    def __init__(self):
+        rank = int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+        size = int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
+        root = os.environ.get("MXNET_TPU_COORDINATOR", "127.0.0.1:0")
+        self._comm = _StarComm(rank, size, root)
+
+    @property
+    def type(self):
+        return "customhvd"
+
+    @property
+    def rank(self):
+        return self._comm.rank
+
+    @property
+    def num_workers(self):
+        return self._comm.size
+
+    @property
+    def is_update_on_kvstore_default(self):
+        return False  # horovod-style: optimizer always runs locally
+
+    def is_capable(self, capability):
+        return False  # no server-side optimizer
+
+    def broadcast(self, key, value, out, priority=0):
+        import mxnet_tpu as mx
+        res = self._comm.broadcast(str(key), value.asnumpy())
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o._install(mx.np.array(res, dtype=o.dtype)._data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        import mxnet_tpu as mx
+        vals = value if isinstance(value, list) else [value]
+        total = vals[0].asnumpy()
+        for v in vals[1:]:
+            total = total + v.asnumpy()
+        res = self._comm.allreduce(str(key), total)
+        if out is None:
+            for v in vals:
+                v._install(mx.np.array(res, dtype=v.dtype)._data)
+            return
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o._install(mx.np.array(res, dtype=o.dtype)._data)
+
+    def init(self, key, value):
+        pass  # horovod-style stores hold no state
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError(
+            "customhvd is allreduce-only; use pushpull")
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError(
+            "customhvd is allreduce-only; use pushpull")

@@ -1,0 +1,78 @@
+"""Worker for the out-of-tree comm-backend test (parity:
+tests/nightly/dist_device_sync_kvstore_horovod.py — train through a
+third-party backend registered via KVStoreBase.register only).
+
+Each rank trains the same tiny net on rank-specific data through
+`kvstore.create('customhvd')`; gradients allreduce through the
+adapter's own TCP transport, so all ranks must hold identical weights
+after every step.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as onp  # noqa: E402
+
+import custom_hvd  # noqa: E402,F401 — registers the backend
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def main():
+    rank = int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+    n = int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
+
+    kv = mx.kvstore.create("customhvd")
+    assert kv.type == "customhvd"
+    assert kv.rank == rank and kv.num_workers == n
+
+    # raw allreduce sanity (the reference's check_diff)
+    g = mx.np.ones((4, 2)) * (rank + 1)
+    out = mx.np.zeros((4, 2))
+    kv.pushpull(0, g, out=out)
+    onp.testing.assert_allclose(
+        out.asnumpy(), onp.full((4, 2), n * (n + 1) / 2.0, "float32"))
+
+    # train through gluon.Trainer with the custom backend
+    rng = onp.random.RandomState(100 + rank)  # rank-specific data
+    x = mx.np.array(rng.uniform(-1, 1, (32, 8)).astype(onp.float32))
+    y = mx.np.array(rng.randint(0, 3, 32).astype(onp.int32))
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net(x)
+    # identical starting weights everywhere (broadcast from rank 0)
+    for i, p in enumerate(net.collect_params().values()):
+        d = p.data()
+        kv.broadcast(f"init_{i}", d, out=d)
+
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05}, kvstore=kv)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(3):
+        with autograd.record():
+            l = loss_fn(net(x), y).mean()
+        l.backward()
+        tr.step(1)
+
+    # weights must be bit-identical across ranks after synced steps
+    w = net.collect_params()["0.weight"].data().asnumpy()
+    wsum = mx.np.zeros(w.shape)
+    kv.pushpull("check_w", mx.np.array(w), out=wsum)
+    onp.testing.assert_allclose(wsum.asnumpy(), w * n, rtol=1e-5,
+                                atol=1e-6)
+    print(f"worker {rank}/{n}: custom_hvd OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

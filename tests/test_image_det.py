@@ -1,0 +1,148 @@
+"""Detection pipeline tests (parity model:
+tests/python/unittest/test_image.py TestImageDetIter)."""
+import io as pyio
+
+import numpy as onp
+import pytest
+from PIL import Image
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.image import (CreateDetAugmenter, DetHorizontalFlipAug,
+                             DetRandomCropAug, DetRandomPadAug,
+                             ImageDetIter)
+
+
+def _det_label(objs):
+    """Reference raw det format: [header_w=2, obj_w=5, *objects]."""
+    flat = [2.0, 5.0]
+    for o in objs:
+        flat.extend(o)
+    return onp.asarray(flat, onp.float32)
+
+
+@pytest.fixture()
+def det_rec(tmp_path):
+    rec_path = str(tmp_path / "det.rec")
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "det.idx"),
+                                     rec_path, "w")
+    for i in range(8):
+        arr = onp.full((32, 48, 3), i * 20, onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        label = _det_label([[i % 3, 0.1, 0.2, 0.5, 0.6],
+                            [1.0, 0.3, 0.3, 0.9, 0.8]])
+        hdr = recordio.IRHeader(len(label), label.tolist(), i, 0)
+        rec.write_idx(i, recordio.pack(hdr, buf.getvalue()))
+    rec.close()
+    return rec_path
+
+
+def test_parse_label():
+    raw = _det_label([[0, 0.1, 0.2, 0.5, 0.6], [1, 0.0, 0.0, 0.0, 0.0]])
+    out = ImageDetIter._parse_label(raw)
+    assert out.shape == (1, 5)  # degenerate box dropped
+    onp.testing.assert_allclose(out[0], [0, 0.1, 0.2, 0.5, 0.6])
+
+
+def test_det_iter_batches(det_rec):
+    it = ImageDetIter(batch_size=4, data_shape=(3, 32, 48),
+                      path_imgrec=det_rec)
+    data, label = next(it)
+    assert data.shape == (4, 3, 32, 48)
+    assert label.shape == (4, 2, 5)
+    onp.testing.assert_allclose(label.asnumpy()[0, 0],
+                                [0, 0.1, 0.2, 0.5, 0.6], rtol=1e-6)
+    # second batch exists; third does not
+    next(it)
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_det_flip_aug():
+    aug = DetHorizontalFlipAug(p=1.0)
+    img = onp.zeros((10, 10, 3), onp.uint8)
+    img[:, :5] = 255  # left half white
+    label = onp.asarray([[0, 0.1, 0.2, 0.4, 0.6]], onp.float32)
+    out, lab = aug(img, label)
+    assert out[:, 7:].mean() == 255  # white moved right
+    onp.testing.assert_allclose(lab[0], [0, 0.6, 0.2, 0.9, 0.6],
+                                rtol=1e-6)
+
+
+def test_det_crop_aug_keeps_boxes():
+    onp.random.seed(0)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 1.0))
+    img = onp.random.randint(0, 255, (64, 64, 3)).astype(onp.uint8)
+    label = onp.asarray([[0, 0.4, 0.4, 0.6, 0.6]], onp.float32)
+    out, lab = aug(img, label)
+    assert lab.shape[1] == 5 and lab.shape[0] >= 1
+    assert (lab[:, 1:] >= 0).all() and (lab[:, 1:] <= 1).all()
+    assert (lab[:, 3] > lab[:, 1]).all() and (lab[:, 4] > lab[:, 2]).all()
+
+
+def test_det_pad_aug_shrinks_boxes():
+    onp.random.seed(0)
+    aug = DetRandomPadAug(area_range=(1.5, 2.0))
+    img = onp.random.randint(0, 255, (32, 32, 3)).astype(onp.uint8)
+    label = onp.asarray([[0, 0.0, 0.0, 1.0, 1.0]], onp.float32)
+    out, lab = aug(img, label)
+    assert out.shape[0] >= 32 and out.shape[1] >= 32
+    w = lab[0, 3] - lab[0, 1]
+    h = lab[0, 4] - lab[0, 2]
+    assert w <= 1.0 and h <= 1.0
+    if out.shape[0] > 32:
+        assert h < 1.0
+
+
+def test_det_iter_with_augmenters(det_rec):
+    augs = CreateDetAugmenter((3, 32, 48), rand_mirror=True,
+                              rand_crop=1, rand_pad=1)
+    assert len(augs) == 3
+    it = ImageDetIter(batch_size=2, data_shape=(3, 32, 48),
+                      path_imgrec=det_rec, aug_list=augs)
+    data, label = next(it)
+    assert data.shape == (2, 3, 32, 48)
+    lab = label.asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+
+def test_det_iter_list_mode(tmp_path):
+    """ImageDetIter over a .lst file (review r3 finding: list mode
+    crashed on self._rec)."""
+    d = tmp_path / "imgs"
+    d.mkdir()
+    lines = []
+    for i in range(4):
+        arr = onp.full((24, 24, 3), i * 30, onp.uint8)
+        Image.fromarray(arr).save(d / f"{i}.jpg")
+        lab = _det_label([[i % 2, 0.1, 0.1, 0.8, 0.9]])
+        lines.append("\t".join([str(i)] + [f"{v}" for v in lab]
+                               + [f"{i}.jpg"]))
+    lst = tmp_path / "det.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    it = ImageDetIter(batch_size=2, data_shape=(3, 24, 24),
+                      path_imglist=str(lst), path_root=str(d))
+    data, label = next(it)
+    assert data.shape == (2, 3, 24, 24)
+    assert label.shape == (2, 1, 5)
+    onp.testing.assert_allclose(label.asnumpy()[1, 0],
+                                [1, 0.1, 0.1, 0.8, 0.9], rtol=1e-6)
+
+
+def test_det_normalize_applied_after_resize(det_rec):
+    """mean/std in CreateDetAugmenter must actually normalize (review
+    r3 finding: they were silently ignored)."""
+    augs = CreateDetAugmenter((3, 32, 48), mean=(10.0, 10.0, 10.0),
+                              std=(2.0, 2.0, 2.0))
+    assert len(augs) == 1
+    it_raw = ImageDetIter(batch_size=2, data_shape=(3, 32, 48),
+                          path_imgrec=det_rec)
+    it_norm = ImageDetIter(batch_size=2, data_shape=(3, 32, 48),
+                           path_imgrec=det_rec, aug_list=augs)
+    raw, _ = next(it_raw)
+    norm, _ = next(it_norm)
+    onp.testing.assert_allclose(norm.asnumpy(),
+                                (raw.asnumpy() - 10.0) / 2.0, rtol=1e-5)

@@ -145,3 +145,21 @@ def test_ndarrayiter_roll_over():
     it2 = mio.NDArrayIter(data, batch_size=4, last_batch_handle="pad")
     pads = [b.pad for b in it2]
     assert pads == [0, 0, 2]
+
+
+def test_memory_info_live_bytes():
+    """context.memory_info must report live device bytes (parity:
+    mx.context.gpu_memory_info; round-2 VERDICT item #9)."""
+    import mxnet_tpu as mx
+    ctx = mx.context.current_context()
+    free, total = ctx.memory_info()
+    assert total > 0 and 0 < free <= total
+    keep = mx.np.zeros((512, 512))  # 1 MB live
+    keep.wait_to_read()
+    free2, total2 = ctx.memory_info()
+    assert total2 == total
+    assert free - free2 >= 512 * 512 * 4
+    # module-level parity spellings exist
+    assert callable(mx.context.gpu_memory_info)
+    assert callable(mx.context.tpu_memory_info)
+    del keep

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""im2rec — pack an image folder into RecordIO (.rec + .idx).
+
+Parity: reference tools/im2rec.py (list generation + packing) and
+tools/rec2idx.py (the index is written alongside). Output is binary-
+compatible with the reference's format, so .rec files pack/load across
+both frameworks; reading back goes through `mx.image.ImageIter` (which
+uses the native src_native/ reader when available).
+
+Usage:
+    # 1) generate prefix.lst from a class-per-subfolder image tree
+    python tools/im2rec.py --list --recursive prefix image_root/
+
+    # 2) pack prefix.lst -> prefix.rec + prefix.idx
+    python tools/im2rec.py prefix image_root/ [--resize 256]
+        [--quality 95] [--num-thread 8] [--pack-label]
+"""
+from __future__ import annotations
+
+import argparse
+import io as pyio
+import os
+import random
+import sys
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def list_image(root, recursive, exts):
+    """Yield (index, relpath, label); label = class ordinal of the
+    containing subfolder in recursive mode (parity: im2rec.list_image)."""
+    i = 0
+    if recursive:
+        cat = {}
+        for path, dirs, files in sorted(os.walk(root, followlinks=True)):
+            dirs.sort()
+            files.sort()
+            for fname in files:
+                fpath = os.path.join(path, fname)
+                suffix = os.path.splitext(fname)[1].lower()
+                if os.path.isfile(fpath) and suffix in exts:
+                    if path not in cat:
+                        cat[path] = len(cat)
+                    yield (i, os.path.relpath(fpath, root), cat[path])
+                    i += 1
+    else:
+        for fname in sorted(os.listdir(root)):
+            fpath = os.path.join(root, fname)
+            suffix = os.path.splitext(fname)[1].lower()
+            if os.path.isfile(fpath) and suffix in exts:
+                yield (i, os.path.relpath(fpath, root), 0)
+                i += 1
+
+
+def write_list(path_out, image_list):
+    with open(path_out, "w") as f:
+        for idx, relpath, label in image_list:
+            f.write(f"{idx}\t{label}\t{relpath}\n")
+
+
+def read_list(path_in):
+    with open(path_in) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            # idx \t label[ \t more labels...] \t relpath
+            idx = int(float(parts[0]))
+            labels = [float(x) for x in parts[1:-1]]
+            yield idx, labels, parts[-1]
+
+
+def _encode_image(fpath, args):
+    """Read + optionally resize/crop + re-encode; returns bytes."""
+    from PIL import Image
+
+    with open(fpath, "rb") as f:
+        raw = f.read()
+    if args.pass_through:
+        return raw
+    img = Image.open(pyio.BytesIO(raw))
+    if args.color == 1:
+        img = img.convert("RGB")
+    elif args.color == 0:
+        img = img.convert("L")
+    # color == -1: keep the original mode (reference IMREAD_UNCHANGED)
+    if args.center_crop:
+        w, h = img.size
+        s = min(w, h)
+        img = img.crop(((w - s) // 2, (h - s) // 2,
+                        (w + s) // 2, (h + s) // 2))
+    if args.resize:
+        w, h = img.size
+        if w < h:
+            nw, nh = args.resize, h * args.resize // w
+        else:
+            nw, nh = w * args.resize // h, args.resize
+        img = img.resize((nw, nh), Image.BILINEAR)
+    buf = pyio.BytesIO()
+    fmt = "JPEG" if args.encoding == ".jpg" else "PNG"
+    img.save(buf, format=fmt,
+             **({"quality": args.quality} if fmt == "JPEG" else {}))
+    return buf.getvalue()
+
+
+def make_list(args):
+    image_list = list(list_image(args.root, args.recursive,
+                                 set(args.exts)))
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(image_list)
+    n = len(image_list)
+    n_train = int(n * args.train_ratio)
+    n_test = int(n * args.test_ratio)
+    sets = []
+    if args.train_ratio < 1.0 or args.test_ratio > 0:
+        if n_test:
+            sets.append(("_test", image_list[:n_test]))
+        if n_train:
+            sets.append(("_train", image_list[n_test:n_test + n_train]))
+        rest = image_list[n_test + n_train:]
+        if rest:
+            sets.append(("_val", rest))
+    else:
+        sets.append(("", image_list))
+    for suffix, chunk in sets:
+        write_list(f"{args.prefix}{suffix}.lst", chunk)
+        print(f"wrote {args.prefix}{suffix}.lst ({len(chunk)} images)")
+
+
+def make_rec(args, lst_path):
+    from mxnet_tpu import recordio
+
+    prefix = os.path.splitext(lst_path)[0]
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                     "w")
+    items = list(read_list(lst_path))
+    pool = ThreadPoolExecutor(max_workers=max(args.num_thread, 1))
+
+    def encode(item):
+        idx, labels, relpath = item
+        try:
+            return idx, labels, _encode_image(
+                os.path.join(args.root, relpath), args), None
+        except Exception as e:  # noqa: BLE001 — report per-file
+            return idx, labels, None, f"{type(e).__name__}: {e}"
+
+    count, failed = 0, 0
+    for idx, labels, payload, err in pool.map(encode, items):
+        if err is not None:
+            print(f"skipping record {idx}: {err}", file=sys.stderr)
+            failed += 1
+            continue
+        if args.pack_label and len(labels) > 1:
+            header = recordio.IRHeader(len(labels), labels, idx, 0)
+        else:
+            header = recordio.IRHeader(0, labels[0] if labels else 0.0,
+                                       idx, 0)
+        rec.write_idx(idx, recordio.pack(header, payload))
+        count += 1
+        if count % 1000 == 0:
+            print(f"packed {count} images")
+    rec.close()
+    print(f"wrote {prefix}.rec / {prefix}.idx "
+          f"({count} records, {failed} failed)")
+    return 0 if failed == 0 else 1
+
+
+def main():
+    p = argparse.ArgumentParser(
+        description="pack images into RecordIO "
+                    "(parity: reference tools/im2rec.py)")
+    p.add_argument("prefix",
+                   help="prefix of input/output lst and rec files")
+    p.add_argument("root", help="folder containing the images")
+    cg = p.add_argument_group("list generation")
+    cg.add_argument("--list", action="store_true",
+                    help="generate the .lst instead of packing")
+    cg.add_argument("--exts", nargs="+",
+                    default=[".jpeg", ".jpg", ".png"])
+    cg.add_argument("--train-ratio", type=float, default=1.0)
+    cg.add_argument("--test-ratio", type=float, default=0.0)
+    cg.add_argument("--recursive", action="store_true",
+                    help="label images by subfolder")
+    cg.add_argument("--no-shuffle", dest="shuffle",
+                    action="store_false")
+    rg = p.add_argument_group("packing")
+    rg.add_argument("--pass-through", action="store_true",
+                    help="pack original bytes, no re-encode")
+    rg.add_argument("--resize", type=int, default=0,
+                    help="resize shorter edge to this")
+    rg.add_argument("--center-crop", action="store_true")
+    rg.add_argument("--quality", type=int, default=95)
+    rg.add_argument("--num-thread", type=int, default=1)
+    rg.add_argument("--color", type=int, default=1, choices=[-1, 0, 1])
+    rg.add_argument("--encoding", default=".jpg",
+                    choices=[".jpg", ".png"])
+    rg.add_argument("--pack-label", action="store_true",
+                    help="pack multi-float labels from the .lst")
+    args = p.parse_args()
+
+    if args.list:
+        make_list(args)
+        return 0
+    rc = 0
+    lst = args.prefix + ".lst"
+    if os.path.isfile(lst):
+        rc |= make_rec(args, lst)
+    else:
+        found = False
+        for suffix in ("_train", "_val", "_test"):
+            cand = f"{args.prefix}{suffix}.lst"
+            if os.path.isfile(cand):
+                rc |= make_rec(args, cand)
+                found = True
+        if not found:
+            print(f"no .lst found for prefix {args.prefix!r}; run with "
+                  "--list first", file=sys.stderr)
+            return 2
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

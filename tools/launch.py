@@ -11,6 +11,20 @@ Local mode spawns N worker processes on one machine:
 - `--kv-mode async`: starts an in-process ParameterServer and exports
   MXNET_TPU_PS_ADDR; workers use kvstore 'dist_async'.
 
+SSH mode runs workers across machines from a hostfile (parity:
+dmlc_tracker ssh mode, reference tools/launch.py:35-117):
+
+    python tools/launch.py -n 8 --launcher ssh -H hosts.txt \
+        python my_train.py
+
+- `hosts.txt`: one hostname per line; workers are assigned round-robin.
+- Rank 0's host serves as the jax.distributed coordinator; its address
+  must be reachable from every host (the coordinator port is picked
+  free on the launching machine and passed through).
+- Each remote command runs through `ssh -o StrictHostKeyChecking=no`
+  with the MXNET_TPU_* env prepended; add `--dry-run` to print the
+  exact ssh invocations without executing them.
+
 Example (the reference's smoke-test incantation):
     python tools/launch.py -n 4 --launcher local python my_train.py
 """
@@ -31,12 +45,80 @@ def _free_port():
     return port
 
 
+def _launch_ssh(args):
+    """Multi-host ssh launcher (parity: dmlc_tracker ssh mode)."""
+    import shlex
+
+    if not args.hostfile:
+        print("ssh launcher needs -H/--hostfile", file=sys.stderr)
+        return 2
+    with open(args.hostfile) as f:
+        hosts = []
+        for h in f:
+            h = h.strip()
+            if h and not h.startswith("#"):
+                hosts.append(h)
+    if not hosts:
+        print("hostfile is empty", file=sys.stderr)
+        return 2
+    if args.kv_mode == "async":
+        print("ssh launcher supports --kv-mode sync only "
+              "(run the parameter server separately and export "
+              "MXNET_TPU_PS_ADDR)", file=sys.stderr)
+        return 2
+
+    coord_host = hosts[0]
+    coord = f"{coord_host}:{_free_port()}"
+    extra = {}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        extra[k] = v
+    cmd_str = " ".join(shlex.quote(c) for c in args.command)
+
+    ssh_cmds = []
+    for rank in range(args.num_workers):
+        host = hosts[rank % len(hosts)]
+        env_parts = {
+            "MXNET_TPU_COORDINATOR": coord,
+            "MXNET_TPU_NUM_PROCS": str(args.num_workers),
+            "MXNET_TPU_PROC_ID": str(rank),
+            "DMLC_ROLE": "worker",
+            **extra,
+        }
+        env_str = " ".join(f"{k}={shlex.quote(v)}"
+                           for k, v in env_parts.items())
+        remote = f"cd {shlex.quote(os.getcwd())} && {env_str} {cmd_str}"
+        ssh_cmds.append(["ssh", "-o", "StrictHostKeyChecking=no", host,
+                         remote])
+
+    if args.dry_run:
+        for c in ssh_cmds:
+            print(" ".join(shlex.quote(p) for p in c))
+        return 0
+
+    procs = [subprocess.Popen(c) for c in ssh_cmds]
+    rc = 0
+    try:
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+    return rc
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--launcher", default="local",
-                    choices=["local"])
+                    choices=["local", "ssh"])
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="ssh mode: file with one hostname per line")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="ssh mode: print the ssh commands and exit")
     ap.add_argument("--kv-mode", default="sync",
                     choices=["sync", "async"])
     ap.add_argument("--env", action="append", default=[],
@@ -45,6 +127,9 @@ def main():
     args = ap.parse_args()
     if not args.command:
         ap.error("no command given")
+
+    if args.launcher == "ssh":
+        return _launch_ssh(args)
 
     base_env = dict(os.environ)
     for kv in args.env:

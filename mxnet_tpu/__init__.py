@@ -48,7 +48,8 @@ import importlib as _importlib
 
 for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "kvstore", "parallel", "profiler", "runtime", "test_utils",
-             "util", "recordio", "image", "io", "amp", "random", "symbol"):
+             "util", "recordio", "image", "io", "amp", "random", "symbol",
+             "rtc", "contrib", "library", "visualization"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -1,2 +1,5 @@
 """mx.contrib — optional subsystems (parity: python/mxnet/contrib/)."""
 from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
+from . import tensorboard  # noqa: F401
+from . import text  # noqa: F401

@@ -1,0 +1,147 @@
+"""contrib.tensorboard — metric logging to TensorBoard event files
+(parity: python/mxnet/contrib/tensorboard.py LogMetricsCallback).
+
+The reference wraps the `tensorboard` package's SummaryWriter; this
+environment has no tensorboard/tensorflow, so the writer emits the TF
+event-file format directly: TFRecord framing (length + masked-crc32c)
+around serialized Event/Summary protobuf messages (field numbers from
+tensorflow/core/util/event.proto and framework/summary.proto).
+TensorBoard reads the resulting `events.out.tfevents.*` files as-is.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+__all__ = ["SummaryWriter", "LogMetricsCallback"]
+
+# --- crc32c (Castagnoli), table-driven -------------------------------------
+_CRC_TABLE = []
+
+
+def _crc_table():
+    if _CRC_TABLE:
+        return _CRC_TABLE
+    poly = 0x82F63B78
+    for n in range(256):
+        c = n
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        _CRC_TABLE.append(c)
+    return _CRC_TABLE
+
+
+def _crc32c(data: bytes) -> int:
+    table = _crc_table()
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+# --- minimal protobuf writers ----------------------------------------------
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    n &= (1 << 64) - 1
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _ld(field, payload):
+    return _varint((field << 3) | 2) + _varint(len(payload)) + payload
+
+
+def _f32(field, v):
+    return _varint((field << 3) | 5) + struct.pack("<f", v)
+
+
+def _f64(field, v):
+    return _varint((field << 3) | 1) + struct.pack("<d", v)
+
+
+def _vint(field, v):
+    return _varint((field << 3) | 0) + _varint(v)
+
+
+def _scalar_event(tag: str, value: float, step: int) -> bytes:
+    # Summary.Value { tag=1, simple_value=2 }
+    sval = _ld(1, tag.encode()) + _f32(2, float(value))
+    summary = _ld(1, sval)              # Summary { value=1 repeated }
+    # Event { wall_time=1 (double), step=2 (int64), summary=5 }
+    return _f64(1, time.time()) + _vint(2, step) + _ld(5, summary)
+
+
+def _file_version_event() -> bytes:
+    return _f64(1, time.time()) + _ld(3, b"brain.Event:2")
+
+
+class SummaryWriter:
+    """Scalar-only event writer compatible with TensorBoard's loader."""
+
+    def __init__(self, logdir):
+        os.makedirs(logdir, exist_ok=True)
+        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        self._f = open(os.path.join(logdir, fname), "wb")
+        self._write_record(_file_version_event())
+
+    def _write_record(self, payload: bytes):
+        header = struct.pack("<Q", len(payload))
+        self._f.write(header)
+        self._f.write(struct.pack("<I", _masked_crc(header)))
+        self._f.write(payload)
+        self._f.write(struct.pack("<I", _masked_crc(payload)))
+
+    def add_scalar(self, tag, value, global_step=0):
+        self._write_record(_scalar_event(tag, value, global_step))
+
+    def flush(self):
+        self._f.flush()
+
+    def close(self):
+        if not self._f.closed:
+            self._f.flush()
+            self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class LogMetricsCallback:
+    """Batch-end callback pushing EvalMetric values to TensorBoard
+    (parity: contrib/tensorboard.py LogMetricsCallback)."""
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        """`param` is a BatchEndParam-alike with `.eval_metric`."""
+        metric = getattr(param, "eval_metric", None) or param
+        if metric is None:
+            return
+        name_value = metric.get()
+        names, values = name_value if isinstance(name_value[0],
+                                                 (list, tuple)) \
+            else ([name_value[0]], [name_value[1]])
+        self.step += 1
+        for name, value in zip(names, values):
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(name, value, self.step)
+        self.summary_writer.flush()

@@ -1,0 +1,227 @@
+"""contrib.text — vocabulary + token embeddings (parity:
+python/mxnet/contrib/text/{vocab.py,embedding.py}).
+
+GloVe/FastText pretrained downloads need egress the deployment may not
+have, so `CustomEmbedding` (load any `token<sep>v1 v2 ...` file) and
+`CompositeEmbedding` are the core; `GloVe`/`FastText` accept a local
+`pretrained_file_path` and parse the same format.
+"""
+from __future__ import annotations
+
+import collections
+
+import numpy as onp
+
+__all__ = ["Vocabulary", "CustomEmbedding", "CompositeEmbedding",
+           "GloVe", "FastText", "register", "create",
+           "count_tokens_from_str"]
+
+_REGISTRY = {}
+
+
+def register(cls):
+    _REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+def create(embedding_name, **kwargs):
+    return _REGISTRY[embedding_name.lower()](**kwargs)
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Token frequency counter (parity: text/utils.py)."""
+    source_str = source_str.lower() if to_lower else source_str
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    for seq in source_str.split(seq_delim):
+        counter.update(t for t in seq.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with reserved tokens (parity:
+    text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise ValueError("min_freq must be >= 1")
+        self.unknown_token = unknown_token
+        reserved_tokens = list(reserved_tokens or [])
+        if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                unknown_token in reserved_tokens:
+            raise ValueError("reserved tokens must be unique and must "
+                             "not contain the unknown token")
+        self._idx_to_token = [unknown_token] + reserved_tokens
+        self._reserved_tokens = reserved_tokens or None
+        seen = set(self._idx_to_token)
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1],
+                                                            kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for tok, freq in pairs:
+                if freq >= min_freq and tok not in seen:
+                    seen.add(tok)
+                    self._idx_to_token.append(tok)
+        self._token_to_idx = {t: i for i, t
+                              in enumerate(self._idx_to_token)}
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self):
+                raise ValueError(f"index {i} out of vocabulary range")
+        out = [self._idx_to_token[i] for i in idxs]
+        return out[0] if single else out
+
+
+class _TokenEmbedding(Vocabulary):
+    """Vocabulary + per-token vectors (parity:
+    text/embedding.py _TokenEmbedding)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding_file(self, path, elem_delim=" ",
+                             encoding="utf8"):
+        vecs = {}
+        with open(path, encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                parts = line.rstrip().split(elem_delim)
+                if len(parts) < 2:
+                    continue
+                tok, vals = parts[0], parts[1:]
+                if line_num == 1 and len(vals) == 1:
+                    continue  # fastText-style "count dim" header
+                try:
+                    vec = [float(v) for v in vals]
+                except ValueError:
+                    continue
+                if self._vec_len == 0:
+                    self._vec_len = len(vec)
+                if len(vec) == self._vec_len and tok not in vecs:
+                    vecs[tok] = vec
+        return vecs
+
+    def _build(self, vecs, vocabulary=None):
+        import mxnet_tpu as mx
+        if vocabulary is None:
+            for tok in sorted(vecs):
+                if tok not in self._token_to_idx:
+                    self._token_to_idx[tok] = len(self._idx_to_token)
+                    self._idx_to_token.append(tok)
+        else:
+            self._idx_to_token = list(vocabulary.idx_to_token)
+            self._token_to_idx = dict(vocabulary.token_to_idx)
+            self.unknown_token = vocabulary.unknown_token
+        mat = onp.zeros((len(self), self._vec_len), onp.float32)
+        for tok, vec in vecs.items():
+            idx = self._token_to_idx.get(tok)
+            if idx is not None:
+                mat[idx] = vec
+        self._idx_to_vec = mx.np.array(mat)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idxs = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            idxs.append(i if i is not None else 0)
+        out = self._idx_to_vec[onp.asarray(idxs)]
+        return out[0] if single else out
+
+    def update_token_vectors(self, tokens, new_vectors):
+        import mxnet_tpu as mx
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        host = onp.array(self._idx_to_vec.asnumpy())
+        nv = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else onp.asarray(new_vectors)
+        nv = nv.reshape(len(toks), -1)
+        for t, v in zip(toks, nv):
+            if t not in self._token_to_idx:
+                raise ValueError(f"token {t!r} is unknown")
+            host[self._token_to_idx[t]] = v
+        self._idx_to_vec = mx.np.array(host)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """Embedding from a user file 'token<elem_delim>v1 v2 ...'
+    (parity: embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf8", vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        vecs = self._load_embedding_file(pretrained_file_path,
+                                         elem_delim, encoding)
+        self._build(vecs, vocabulary)
+
+
+@register
+class GloVe(CustomEmbedding):
+    """GloVe-format file loader; pass a local pretrained_file_path
+    (downloads need egress the runtime may not have)."""
+
+
+@register
+class FastText(CustomEmbedding):
+    """FastText .vec loader (the count/dim header line is skipped)."""
+
+
+@register
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (parity:
+    embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings, **kwargs):
+        import mxnet_tpu as mx
+        super().__init__(**kwargs)
+        if not isinstance(token_embeddings, (list, tuple)):
+            token_embeddings = [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self.unknown_token = vocabulary.unknown_token
+        parts = []
+        for emb in token_embeddings:
+            parts.append(emb.get_vecs_by_tokens(
+                self._idx_to_token).asnumpy())
+        mat = onp.concatenate(parts, axis=1)
+        self._vec_len = mat.shape[1]
+        self._idx_to_vec = mx.np.array(mat)

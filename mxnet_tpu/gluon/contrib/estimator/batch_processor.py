@@ -1,0 +1,34 @@
+"""BatchProcessor — pluggable batch fit/eval logic (parity:
+python/mxnet/gluon/contrib/estimator/batch_processor.py).
+
+Custom training schemes (GAN alternating steps, multi-task losses,
+teacher-student) subclass this and override `fit_batch` /
+`evaluate_batch`; the Estimator delegates every batch to it."""
+from __future__ import annotations
+
+__all__ = ["BatchProcessor"]
+
+
+class BatchProcessor:
+    def _get_data_and_label(self, batch, batch_axis=0):
+        data, label = batch[0], batch[1]
+        return data, label
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        """One validation batch -> (data, label, pred, loss)."""
+        data, label = self._get_data_and_label(val_batch, batch_axis)
+        pred = estimator.val_net(data)
+        loss = estimator.evaluation_loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        """One training batch (forward+backward, no optimizer step —
+        GradientUpdateHandler steps) -> (data, label, pred, loss)."""
+        from .... import autograd
+
+        data, label = self._get_data_and_label(train_batch, batch_axis)
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss

@@ -12,12 +12,13 @@ import logging
 from ... import loss as gloss
 from ... import metric as gmetric
 from ...trainer import Trainer
+from .batch_processor import BatchProcessor
 from .event_handler import (BatchBegin, BatchEnd, EpochBegin, EpochEnd,
                             GradientUpdateHandler, LoggingHandler,
                             MetricHandler, StoppingHandler, TrainBegin,
                             TrainEnd, ValidationHandler)
 
-__all__ = ["Estimator"]
+__all__ = ["Estimator", "BatchProcessor"]
 
 
 class Estimator:
@@ -31,11 +32,22 @@ class Estimator:
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
                  initializer=None, trainer=None, context=None,
-                 evaluation_loss=None, batch_axis=0):
+                 evaluation_loss=None, val_net=None, val_loss=None,
+                 batch_processor=None, batch_axis=0):
         self.net = net
         self.loss = self._check_loss(loss)
+        # validation may use a different net (e.g. EMA weights or a
+        # non-dropout deployment graph) and/or a different loss
+        # (parity: reference estimator val_net/val_loss split)
+        self.val_net = val_net if val_net is not None else net
+        if val_loss is None:
+            val_loss = evaluation_loss
         self.evaluation_loss = self._check_loss(
-            evaluation_loss) if evaluation_loss is not None else self.loss
+            val_loss) if val_loss is not None else self.loss
+        self.val_loss = self.evaluation_loss
+        self.batch_processor = batch_processor or BatchProcessor()
+        if not isinstance(self.batch_processor, BatchProcessor):
+            raise ValueError("batch_processor must be a BatchProcessor")
         self.batch_axis = batch_axis
         self.stop_training = False
 
@@ -104,10 +116,8 @@ class Estimator:
 
     # -- evaluation -----------------------------------------------------
     def evaluate_batch(self, val_batch):
-        data, label = self._get_data_and_label(val_batch)
-        pred = self.net(data)
-        loss = self.evaluation_loss(pred, label)
-        return data, label, pred, loss
+        return self.batch_processor.evaluate_batch(self, val_batch,
+                                                   self.batch_axis)
 
     def evaluate(self, val_data, batch_axis=0, event_handlers=None):
         for m in self.val_metrics + [self.val_loss_metric]:
@@ -122,13 +132,8 @@ class Estimator:
 
     # -- training -------------------------------------------------------
     def fit_batch(self, train_batch, batch_axis=0):
-        from .... import autograd
-        data, label = self._get_data_and_label(train_batch)
-        with autograd.record():
-            pred = self.net(data)
-            loss = self.loss(pred, label)
-        loss.backward()
-        return data, label, pred, loss
+        return self.batch_processor.fit_batch(self, train_batch,
+                                              batch_axis)
 
     def fit(self, train_data, val_data=None, epochs=None,
             event_handlers=None, batches=None, batch_axis=0):

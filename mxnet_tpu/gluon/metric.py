@@ -374,9 +374,7 @@ class Fbeta(F1):
     def get(self):
         if self.stats.total == 0:
             return (self.name, float("nan"))
-        st = self.stats
-        prec = st.tp / (st.tp + st.fp) if st.tp + st.fp else 0.0
-        rec = st.tp / (st.tp + st.fn) if st.tp + st.fn else 0.0
+        prec, rec = self.stats.precision, self.stats.recall
         b2 = self.beta * self.beta
         denom = b2 * prec + rec
         val = (1 + b2) * prec * rec / denom if denom else 0.0

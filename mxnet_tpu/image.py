@@ -426,6 +426,11 @@ class ImageDetIter(ImageIter):
                          path_imglist=path_imglist, path_root=path_root,
                          shuffle=shuffle, aug_list=None,
                          use_native=False, **kwargs)
+        if self._det_list is not None:
+            # iteration keys are the .lst idx column (NOT positions:
+            # split .lst files keep their original enumeration)
+            self._keys = list(self._det_list)
+            self.reset()
 
     @staticmethod
     def _parse_label(raw):

@@ -1,0 +1,113 @@
+"""mx.library — out-of-tree extension loading (parity:
+python/mxnet/library.py + include/mxnet/lib_api.h).
+
+The reference dlopens extension libraries exposing custom ops through
+a self-contained C ABI (lib_api.h's MXTensor). The TPU-native ABI here
+is deliberately small and buffer-oriented:
+
+    // exported by the extension .so
+    const char* mxtpu_ext_op_list();
+    //   "name:arity,name:arity,..."  (arity 1 or 2; float32 elementwise)
+    void <name>(const float* a, const float* b_or_null,
+                float* out, int64_t n);
+
+`load(path)` registers every listed op into ``mx.npx`` as a host
+callback: the op is jit-compatible (`jax.custom-free pure_callback`),
+so extension ops work eagerly AND inside hybridized graphs — XLA
+treats them as opaque host calls, the TPU analogue of the reference's
+engine-pushed extension kernels.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED = {}
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def _make_op(cfn, name, arity):
+    def host_call(*hosts):
+        a = onp.ascontiguousarray(hosts[0], dtype=onp.float32)
+        b = None
+        if arity == 2:
+            b = onp.ascontiguousarray(
+                onp.broadcast_to(hosts[1], a.shape), dtype=onp.float32)
+        out = onp.empty_like(a)
+        cfn(a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            b.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            if b is not None else None,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(a.size))
+        return out
+
+    def op(*args, **kwargs):
+        from .ops import apply_op
+        from .ndarray.ndarray import NDArray
+        from . import engine
+
+        nds = [a if isinstance(a, NDArray)
+               else NDArray(engine.track(jnp.asarray(a, jnp.float32)))
+               for a in args[:arity]]
+
+        def fn(*datas):
+            shape_dtype = jax.ShapeDtypeStruct(datas[0].shape,
+                                               jnp.float32)
+            return jax.pure_callback(
+                host_call, shape_dtype,
+                *[d.astype(jnp.float32) for d in datas],
+                vmap_method="sequential")
+
+        return apply_op(fn, *nds, name=f"ext_{name}")
+
+    op.__name__ = name
+    op.__doc__ = (f"Extension op '{name}' (arity {arity}) loaded via "
+                  "mx.library.load — runs as a host callback, usable "
+                  "eagerly and under hybridize.")
+    return op
+
+
+def load(path, verbose=True):
+    """dlopen an extension library and register its ops into mx.npx
+    (parity: mx.library.load → MXLoadLib)."""
+    from . import numpy_extension as npx
+
+    path = os.path.abspath(path)
+    lib = ctypes.CDLL(path)
+    try:
+        lib.mxtpu_ext_op_list.restype = ctypes.c_char_p
+        listing = lib.mxtpu_ext_op_list().decode()
+    except AttributeError:
+        raise RuntimeError(
+            f"{path} does not export mxtpu_ext_op_list(); not a "
+            "mxnet_tpu extension library")
+    registered = []
+    for entry in listing.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, _, arity_s = entry.partition(":")
+        arity = int(arity_s or "1")
+        if arity not in (1, 2):
+            raise RuntimeError(f"op {name!r}: unsupported arity {arity}")
+        cfn = getattr(lib, name)
+        cfn.restype = None
+        cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float),
+                        ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+        setattr(npx, name, _make_op(cfn, name, arity))
+        registered.append(name)
+    _LOADED[path] = registered
+    if verbose:
+        print(f"[mx.library] loaded {len(registered)} op(s) from "
+              f"{path}: {registered}")
+    return registered

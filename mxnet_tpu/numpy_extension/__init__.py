@@ -11,6 +11,11 @@ differentiable, async, and trace-transparently under hybridize.
 """
 from __future__ import annotations
 
+import math
+
+# captured before npx.slice shadows the builtin below
+_py_slice = slice
+
 import numpy as onp
 import jax
 import jax.numpy as jnp
@@ -602,3 +607,160 @@ def ring_attention(query, key, value, causal=False, scale=None,
 
     return apply_op(fn, _c(query), _c(key), _c(value),
                     name="ring_attention")
+
+
+def slice(data, begin, end, step=None, **kwargs):  # noqa: A001
+    """Reference npx.slice (src/operator/tensor/matrix_op.cc Slice):
+    per-axis begin/end/step with None meaning 'full extent'."""
+    d = _c(data)
+    nd = d.ndim
+    begin = tuple(begin) + (None,) * (nd - len(begin))
+    end = tuple(end) + (None,) * (nd - len(end))
+    step = tuple(step or ()) + (None,) * (nd - len(step or ()))
+    idx = tuple(_py_slice(b, e, s)
+                for b, e, s in zip(begin, end, step))
+
+    def fn(x):
+        return x[idx]
+    return apply_op(fn, d, name="slice")
+
+
+def slice_like(data, shape_like, axes=None, **kwargs):
+    """Slice `data` to `shape_like`'s extents on `axes` (parity:
+    src/operator/tensor/matrix_op.cc slice_like)."""
+    d, ref = _c(data), _c(shape_like)
+    axes = range(d.ndim) if axes is None else \
+        [a % d.ndim for a in axes]
+    idx = tuple(_py_slice(0, ref.shape[a]) if a in set(axes)
+                else _py_slice(None) for a in range(d.ndim))
+
+    def fn(x, _r):
+        return x[idx]
+    return apply_op(fn, d, ref, name="slice_like")
+
+
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", **kwargs):
+    """Per-sample CTC loss (parity: npx.ctc_loss /
+    src/operator/nn/ctc_loss.cc). data: (T, N, C) unnormalized
+    activations; label: (N, L) int classes (0 = blank padding when
+    lengths are not given). Lowered to optax.ctc_loss — the alpha
+    recursion compiles to one XLA scan."""
+    import optax
+    from ..numpy import moveaxis as _move
+
+    d = _c(data)
+    lab = _c(label)
+    ntc = apply_op(lambda x: jnp.moveaxis(x, 0, 1), d, name="ctc_tr")
+    n, t = ntc.shape[0], ntc.shape[1]
+    L = lab.shape[1]
+
+    def fn(logits, labels, *lens):
+        i = 0
+        if use_data_lengths:
+            dl = lens[i]; i += 1
+            idx = jnp.arange(t).reshape(1, t)
+            logit_pad = (idx >= dl.reshape(-1, 1)).astype(jnp.float32)
+        else:
+            logit_pad = jnp.zeros((n, t), jnp.float32)
+        if use_label_lengths:
+            ll = lens[i]
+            li = jnp.arange(L).reshape(1, L)
+            lbl_pad = (li >= ll.reshape(-1, 1)).astype(jnp.float32)
+        else:
+            lbl_pad = (labels == 0).astype(jnp.float32)
+        return optax.ctc_loss(logits, logit_pad,
+                              labels.astype(jnp.int32), lbl_pad)
+
+    args = [ntc, lab]
+    if use_data_lengths:
+        args.append(_c(data_lengths))
+    if use_label_lengths:
+        args.append(_c(label_lengths))
+    return apply_op(fn, *args, name="ctc_loss")
+
+
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kwargs):
+    """SSD anchor boxes over the feature map grid (parity:
+    src/operator/contrib/multibox_prior.cc). data: (N, C, H, W);
+    returns (1, H*W*(m+n-1), 4) normalized corner boxes — one box per
+    (size_i, ratio_0) plus one per (size_0, ratio_j>0) per pixel."""
+    d = _c(data)
+    h, w = d.shape[2], d.shape[3]
+    sizes = [float(s) for s in sizes]
+    ratios = [float(r) for r in ratios]
+    step_y = 1.0 / h if steps[0] <= 0 else float(steps[0])
+    step_x = 1.0 / w if steps[1] <= 0 else float(steps[1])
+    oy, ox = float(offsets[0]), float(offsets[1])
+
+    def fn(_x):
+        cy = (jnp.arange(h, dtype=jnp.float32) + oy) * step_y
+        cx = (jnp.arange(w, dtype=jnp.float32) + ox) * step_x
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")  # (H, W)
+        wh = []
+        for s in sizes:
+            wh.append((s * math.sqrt(ratios[0]), s / math.sqrt(ratios[0])))
+        for r in ratios[1:]:
+            wh.append((sizes[0] * math.sqrt(r), sizes[0] / math.sqrt(r)))
+        boxes = []
+        for bw, bh in wh:
+            boxes.append(jnp.stack([cxg - bw / 2, cyg - bh / 2,
+                                    cxg + bw / 2, cyg + bh / 2], -1))
+        out = jnp.stack(boxes, 2).reshape(-1, 4)  # (H*W*K, 4)
+        if clip:
+            out = jnp.clip(out, 0.0, 1.0)
+        return out[None]
+
+    return apply_op(fn, d, name="multibox_prior")
+
+
+def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+                **kwargs):
+    """ROI max pooling (parity: src/operator/roi_pooling.cc).
+    data: (N, C, H, W); rois: (R, 5) rows [batch_idx, x1, y1, x2, y2]
+    in image coordinates (scaled by `spatial_scale` onto the feature
+    map). Returns (R, C, ph, pw). Static-shape lowering: each output
+    cell is a masked max over the feature map (vmapped over ROIs), so
+    XLA sees one dense program — no dynamic shapes."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+    ph, pw = int(ph), int(pw)
+    d, r = _c(data), _c(rois)
+    H, W = d.shape[2], d.shape[3]
+
+    def fn(x, rr):
+        ys = jnp.arange(H, dtype=jnp.float32)
+        xs = jnp.arange(W, dtype=jnp.float32)
+
+        def one_roi(roi):
+            b = roi[0].astype(jnp.int32)
+            x1 = jnp.round(roi[1] * spatial_scale)
+            y1 = jnp.round(roi[2] * spatial_scale)
+            x2 = jnp.round(roi[3] * spatial_scale)
+            y2 = jnp.round(roi[4] * spatial_scale)
+            rw = jnp.maximum(x2 - x1 + 1.0, 1.0)
+            rh = jnp.maximum(y2 - y1 + 1.0, 1.0)
+            bh, bw = rh / ph, rw / pw
+            feat = x[b]  # (C, H, W)
+
+            cells = []
+            for i in range(ph):
+                for j in range(pw):
+                    hs = jnp.floor(y1 + i * bh)
+                    he = jnp.ceil(y1 + (i + 1) * bh)
+                    ws_ = jnp.floor(x1 + j * bw)
+                    we = jnp.ceil(x1 + (j + 1) * bw)
+                    mask = ((ys[:, None] >= hs) & (ys[:, None] < he) &
+                            (xs[None, :] >= ws_) & (xs[None, :] < we))
+                    cell = jnp.where(mask[None], feat, -jnp.inf) \
+                        .max(axis=(1, 2))
+                    # empty bins produce 0 like the reference
+                    cells.append(jnp.where(jnp.isfinite(cell), cell,
+                                           0.0))
+            return jnp.stack(cells, -1).reshape(x.shape[1], ph, pw)
+
+        return jax.vmap(one_roi)(rr)
+
+    return apply_op(fn, d, r, name="roi_pooling")

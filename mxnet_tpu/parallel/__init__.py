@@ -117,17 +117,47 @@ def shard_params(params, rules=None, mesh: Mesh = None):
 
 def allreduce(value: NDArray, op="sum", mesh: Mesh = None,
               axis_name=AXIS_DP) -> NDArray:
-    """Explicit cross-device reduction of a per-device-sharded array.
+    """Imperative cross-device reduction: a REAL psum/pmax/pmin over
+    `axis_name` via shard_map (XLA AllReduce on ICI), not a layout
+    change. Each mesh-axis participant contributes its local block;
+    every participant receives the elementwise reduction. For an array
+    sharded on `axis_name` the result's global shape is the block
+    shape (shards are summed together); for a replicated array every
+    device's copy counts once (sum = n * x).
 
-    Under pjit/global arrays, reductions happen inside the compiled
-    program; this helper exists for the imperative KVStore path: it
-    sums the shards of an array sharded on axis 0 and returns the
-    replicated result (parity: kvstore push+pull).
+    Under pjit/hybridize, reductions belong INSIDE the compiled
+    program; this entry point is for the imperative KVStore/debug path
+    (parity: kvstore push+pull semantics).
     """
     mesh = mesh or _global_mesh
+    if mesh is None or mesh.shape.get(axis_name, 1) == 1:
+        return value
+    from jax.experimental.shard_map import shard_map
+
+    reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
+               "min": jax.lax.pmin}[op]
     data = value._data
-    rep = sharding(P(), mesh)
-    out = jax.jit(lambda x: x, out_shardings=rep)(data)
+    sh = getattr(data, "sharding", None)
+    if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+        # not on this mesh yet: replicate onto it first
+        data = jax.device_put(data, NamedSharding(mesh, P()))
+        sh = data.sharding
+    spec = sh.spec
+
+    def _strip(entry):
+        # output stays sharded over the OTHER axes; only `axis_name`
+        # is reduced away
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            rem = tuple(a for a in entry if a != axis_name)
+            return rem if rem else None
+        return None if entry == axis_name else entry
+
+    out_spec = P(*[_strip(e) for e in spec])
+    fn = shard_map(lambda x: reducer(x, axis_name), mesh=mesh,
+                   in_specs=spec, out_specs=out_spec)
+    out = fn(data)
     value._install(out)
     return value
 

@@ -1,0 +1,163 @@
+"""mx.rtc — runtime-compiled user kernels (parity: python/mxnet/rtc.py).
+
+The reference compiles user-supplied CUDA C strings with NVRTC at
+runtime (`CudaModule(source).get_kernel(name, signature)` →
+`kernel.launch(args, ctx, grid, block)`, python/mxnet/rtc.py:230 and
+src/common/rtc.cc). The TPU-native equivalent of "hand me kernel
+source at runtime" is Pallas: `PallasModule` accepts Python source
+defining Pallas kernel functions (or the functions directly), and
+`get_kernel(...)` wraps them in `pl.pallas_call` so they run on the
+MXU/VPU — interpreted on CPU backends so user kernels are testable
+off-TPU.
+
+Example::
+
+    src = '''
+    def scale_add(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+    '''
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("scale_add", out_like=0)   # out shaped like arg 0
+    z = k.launch(x, y)                            # NDArray in, NDArray out
+
+Autograd: kernels are opaque to the tape by default (like the
+reference's rtc kernels). Pass ``grad=my_vjp`` to make a kernel
+differentiable: ``my_vjp(cotangent, *inputs) -> tuple(grads)``.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PallasModule", "Kernel", "CudaModule"]
+
+
+def _interpret_default():
+    return jax.default_backend() == "cpu"
+
+
+class Kernel:
+    """A launchable Pallas kernel (parity: rtc.CudaKernel)."""
+
+    def __init__(self, fn, name, out_like=0, out_shape=None,
+                 out_dtype=None, grid=None, in_specs=None,
+                 out_specs=None, interpret=None, grad=None):
+        self._fn = fn
+        self.name = name
+        self._out_like = out_like
+        self._out_shape = out_shape
+        self._out_dtype = out_dtype
+        self._grid = grid
+        self._in_specs = in_specs
+        self._out_specs = out_specs
+        self._interpret = interpret
+        self._grad = grad
+
+    def _build_call(self, arg_datas):
+        import jax.experimental.pallas as pl
+
+        if self._out_shape is not None:
+            shape = tuple(self._out_shape)
+            dtype = self._out_dtype or arg_datas[0].dtype
+        else:
+            ref = arg_datas[self._out_like]
+            shape, dtype = ref.shape, self._out_dtype or ref.dtype
+        interp = self._interpret
+        if interp is None:
+            interp = _interpret_default()
+        kwargs = {}
+        if self._grid is not None:
+            kwargs["grid"] = self._grid
+        if self._in_specs is not None:
+            kwargs["in_specs"] = self._in_specs
+        if self._out_specs is not None:
+            kwargs["out_specs"] = self._out_specs
+        return pl.pallas_call(
+            self._fn,
+            out_shape=jax.ShapeDtypeStruct(shape, dtype),
+            interpret=interp, **kwargs)
+
+    def launch(self, *args):
+        """Run the kernel over NDArray (or raw) operands; returns an
+        NDArray. (The reference's launch takes explicit grid/block
+        dims; here the grid is baked at get_kernel time and XLA/Mosaic
+        handles placement.)"""
+        from .ops import apply_op
+        from .ndarray.ndarray import NDArray
+        from . import engine
+
+        datas = [a._data if isinstance(a, NDArray) else jnp.asarray(a)
+                 for a in args]
+        call = self._build_call(datas)
+
+        if self._grad is not None:
+            user_grad = self._grad
+
+            @jax.custom_vjp
+            def op(*xs):
+                return call(*xs)
+
+            def fwd(*xs):
+                return call(*xs), xs
+
+            def bwd(res, ct):
+                return tuple(user_grad(ct, *res))
+
+            op.defvjp(fwd, bwd)
+            fn = op
+        else:
+            # opaque to autograd: sever inputs BEFORE the kernel so
+            # jax.vjp never tries to linearize through pallas_call
+            def fn(*xs):
+                return call(*[jax.lax.stop_gradient(x) for x in xs])
+
+        nd_args = [a if isinstance(a, NDArray)
+                   else NDArray(engine.track(jnp.asarray(a)))
+                   for a in args]
+        return apply_op(fn, *nd_args, name=f"rtc_{self.name}")
+
+    __call__ = launch
+
+
+class PallasModule:
+    """A module of runtime-supplied Pallas kernels (parity:
+    rtc.CudaModule over NVRTC)."""
+
+    def __init__(self, source=None, exports=None):
+        self._fns = {}
+        if callable(source):
+            self._fns[source.__name__] = source
+        elif isinstance(source, dict):
+            self._fns.update(source)
+        elif isinstance(source, str):
+            import jax.experimental.pallas as pl
+            namespace = {"pl": pl, "jnp": jnp, "jax": jax}
+            exec(compile(source, "<rtc-source>", "exec"), namespace)
+            for k, v in namespace.items():
+                if callable(v) and not k.startswith("_") and \
+                        k not in ("pl", "jnp", "jax"):
+                    self._fns[k] = v
+        elif source is not None:
+            raise TypeError("source must be str, callable, or dict")
+        if exports is not None:
+            missing = set(exports) - set(self._fns)
+            if missing:
+                raise ValueError(f"source does not define {sorted(missing)}")
+            self._fns = {k: self._fns[k] for k in exports}
+
+    def list_kernels(self):
+        return sorted(self._fns)
+
+    def get_kernel(self, name, **kwargs):
+        if name not in self._fns:
+            raise ValueError(f"no kernel {name!r}; module defines "
+                             f"{self.list_kernels()}")
+        return Kernel(self._fns[name], name, **kwargs)
+
+
+def CudaModule(*args, **kwargs):
+    """The reference's NVRTC entry point; CUDA C cannot run on TPU."""
+    raise NotImplementedError(
+        "CudaModule compiles CUDA C, which has no TPU backend; write "
+        "the kernel as a Pallas function and use mx.rtc.PallasModule "
+        "(same runtime-compilation workflow, MXU/VPU execution)")

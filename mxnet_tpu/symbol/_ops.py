@@ -39,6 +39,38 @@ _NP_OPS = [
     "broadcast_to", "where", "clip", "take", "ravel",
     # misc
     "round", "floor_divide", "fmod", "absolute",
+    # widened table (round-3: the reference's symbol surface covers the
+    # full op registry; anything with Symbol-positional + static-kwarg
+    # form lowers through the same mx.np table)
+    "degrees", "radians", "deg2rad",
+    "rad2deg", "exp2", "fabs", "positive", "invert",
+    "isnan", "isinf", "isfinite", "isneginf", "isposinf",
+    "logaddexp", "logaddexp2", "ldexp", "gcd", "lcm",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "left_shift", "right_shift",
+    "true_divide", "remainder", "float_power", "heaviside",
+    "nanmax", "nanmin", "nansum", "nanprod", "nanmean", "nanstd",
+    "nanvar", "median", "quantile", "percentile", "average", "ptp",
+    "cumsum", "cumprod", "nancumsum", "nancumprod",
+    "all", "any", "count_nonzero",
+    "sort", "argsort", "partition", "argpartition", "msort",
+    "unique", "diff", "ediff1d", "searchsorted", "digitize",
+    "trapz", "interp", "cross", "kron", "outer", "inner", "vdot",
+    "trace", "diagonal", "diag", "diagflat", "tril", "triu",
+    "atleast_1d", "atleast_2d", "atleast_3d",
+    "vstack", "hstack", "dstack", "column_stack", "row_stack",
+    "moveaxis", "rollaxis", "roll", "rot90", "fliplr", "flipud",
+    "pad", "insert", "delete", "append", "resize",
+    "nonzero", "flatnonzero", "argwhere", "extract", "compress",
+    "take_along_axis", "sign", "signbit", "copysign", "nextafter",
+    "spacing", "modf", "frexp", "trunc", "rint", "fix", "around",
+    "real", "imag", "conj", "conjugate", "angle",
+    "sinc", "i0", "nan_to_num", "unwrap", "gradient", "convolve",
+    "correlate", "histogram", "bincount", "corrcoef", "cov",
+    "polyval", "meshgrid", "indices", "unravel_index",
+    "maximum", "minimum", "fmax", "fmin", "hypot",
+    "greater", "greater_equal", "less", "less_equal", "not_equal",
+    "equal", "logical_not", "isclose", "array_equal",
 ]
 
 _NPX_OPS = [
@@ -47,6 +79,16 @@ _NPX_OPS = [
     "pooling", "batch_norm", "layer_norm", "dropout", "one_hot",
     "pick", "topk", "batch_dot", "embedding", "rnn", "sequence_mask",
     "gamma", "erf", "erfinv",
+    # widened npx table (round-3)
+    "softplus", "softsign", "mish", "gelu", "silu", "hard_sigmoid",
+    "hard_swish", "softmin", "masked_softmax", "masked_log_softmax",
+    "deconvolution", "group_norm", "instance_norm", "rms_norm",
+    "l2_normalization", "sequence_last", "sequence_reverse",
+    "gather_nd", "scatter_nd", "index_add", "index_update",
+    "shape_array", "reshape_like", "broadcast_like", "arange_like",
+    "slice_axis", "slice_like", "boolean_mask", "one_hot",
+    "ctc_loss", "multibox_prior", "roi_pooling", "flash_attention",
+    "digamma", "gammaln", "rsqrt", "rcbrt",
 ]
 
 
@@ -98,10 +140,11 @@ def topk(data, k=1, axis=-1, ret_typ="indices", name=None, **attrs):
 
 _this = sys.modules[__name__]
 __all__ = ["split", "topk"]
-for _op in _NP_OPS:
-    setattr(_this, _op, _make_np(_op))
-    __all__.append(_op)
-for _op in _NPX_OPS:
+for _op in dict.fromkeys(_NP_OPS):   # de-duplicated, order-preserving
+    if not hasattr(_this, _op):
+        setattr(_this, _op, _make_np(_op))
+        __all__.append(_op)
+for _op in dict.fromkeys(_NPX_OPS):
     if not hasattr(_this, _op):
         setattr(_this, _op, _make_npx(_op))
         __all__.append(_op)

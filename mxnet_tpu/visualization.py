@@ -1,0 +1,104 @@
+"""mx.visualization — network inspection (parity:
+python/mxnet/visualization.py print_summary/plot_network).
+
+`print_summary` works on a Symbol (layer table with output shapes and
+parameter counts); `plot_network` emits Graphviz DOT text — rendering
+is the caller's concern (the environment carries no graphviz binding),
+which matches how the reference returns a `graphviz.Digraph`.
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as onp
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def _sym_nodes(symbol):
+    return symbol._nodes, {nid for nid, _ in symbol._outputs}
+
+
+def print_summary(symbol, shape=None, line_length=120,
+                  positions=(.44, .64, .74, 1.), data_names=("data",
+                                                             "label")):
+    """Print a per-node table for a Symbol (parity:
+    visualization.print_summary). `shape`: dict arg_name -> shape used
+    for shape inference (all arguments, since inference is whole-graph);
+    `data_names` marks which arguments are inputs rather than
+    parameters. Gluon Blocks should use `block.summary(x)`."""
+    nodes, _ = _sym_nodes(symbol)
+    shapes = {}
+    if shape is not None:
+        arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
+        for name, s in zip(symbol.list_arguments(), arg_shapes):
+            shapes[name] = s
+
+    positions = [int(line_length * p) for p in positions]
+    headers = ["Layer (type)", "Output Shape", "Param #",
+               "Previous Layer"]
+
+    def row(fields):
+        line = ""
+        for i, f in enumerate(fields):
+            line = line[:positions[i] - len(str(f)) - 1]
+            line += str(f) + " " * max(
+                positions[i] - len(line) - len(str(f)), 1)
+        print(line[:line_length])
+
+    print("=" * line_length)
+    row(headers)
+    print("=" * line_length)
+    total = 0
+    data_names = set(data_names)
+    for node in nodes:
+        if node.op == "null" and node.name not in data_names:
+            sh = shapes.get(node.name, ())
+            n_params = int(onp.prod(sh)) if sh else 0
+        else:
+            sh = shapes.get(node.name, "") if node.op == "null" else ""
+            n_params = 0
+        total += n_params
+        prev = ", ".join(nodes[i].name for i, _ in node.inputs)
+        row([f"{node.name} ({node.op})", sh or "", n_params, prev])
+    print("=" * line_length)
+    print(f"Total params: {total}")
+    print("=" * line_length)
+    return total
+
+
+def plot_network(symbol, title="plot", save_format="pdf",
+                 shape=None, node_attrs=None, hide_weights=True):
+    """Return Graphviz DOT text for a Symbol's DAG (parity:
+    visualization.plot_network, which returns a graphviz.Digraph)."""
+    nodes, out_ids = _sym_nodes(symbol)
+    lines = [f'digraph "{title}" {{',
+             '  node [shape=box, style=filled, fillcolor="#8dd3c7"];']
+    skip = set()
+    if hide_weights:
+        for i, node in enumerate(nodes):
+            if node.op == "null" and (
+                    node.name.endswith(("weight", "bias", "gamma",
+                                        "beta", "running_mean",
+                                        "running_var"))):
+                skip.add(i)
+    for i, node in enumerate(nodes):
+        if i in skip:
+            continue
+        color = "#fb8072" if node.op == "null" else (
+            "#80b1d3" if i in out_ids else "#8dd3c7")
+        label = node.name if node.op == "null" else \
+            f"{node.op}\\n{node.name}"
+        attrs = json.dumps(node.attrs) if node.attrs else ""
+        tooltip = f', tooltip="{attrs}"' if attrs else ""
+        lines.append(
+            f'  n{i} [label="{label}", fillcolor="{color}"{tooltip}];')
+    for i, node in enumerate(nodes):
+        if i in skip:
+            continue
+        for src, _ in node.inputs:
+            if src in skip:
+                continue
+            lines.append(f"  n{src} -> n{i};")
+    lines.append("}")
+    return "\n".join(lines)

@@ -1,0 +1,26 @@
+// Example out-of-tree extension library (parity:
+// example/extensions/lib_custom_op in the reference — a self-contained
+// .so loaded with mx.library.load, no framework headers needed).
+//
+// ABI (see mxnet_tpu/library.py):
+//   const char* mxtpu_ext_op_list();   // "name:arity,..."
+//   void <name>(const float* a, const float* b_or_null,
+//               float* out, int64_t n);
+//
+// Build:  g++ -O2 -shared -fPIC example_ext.cc -o libexample_ext.so
+#include <cstdint>
+#include <cmath>
+
+extern "C" {
+
+const char* mxtpu_ext_op_list() { return "plus_one:1,scaled_mul:2"; }
+
+void plus_one(const float* a, const float*, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = a[i] + 1.0f;
+}
+
+void scaled_mul(const float* a, const float* b, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * a[i] * b[i];
+}
+
+}  // extern "C"

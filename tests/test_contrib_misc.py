@@ -1,0 +1,105 @@
+"""contrib.tensorboard + contrib.text tests (parity models:
+python/mxnet/contrib/tensorboard.py and contrib/text/)."""
+import collections
+import os
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import tensorboard as tb
+from mxnet_tpu.contrib import text
+
+
+def _read_records(path):
+    """Independent TFRecord reader validating the framing + crcs."""
+    out = []
+    with open(path, "rb") as f:
+        while True:
+            header = f.read(8)
+            if len(header) < 8:
+                break
+            (n,) = struct.unpack("<Q", header)
+            (hcrc,) = struct.unpack("<I", f.read(4))
+            assert hcrc == tb._masked_crc(header)
+            payload = f.read(n)
+            (pcrc,) = struct.unpack("<I", f.read(4))
+            assert pcrc == tb._masked_crc(payload)
+            out.append(payload)
+    return out
+
+
+def test_summary_writer_event_file(tmp_path):
+    with tb.SummaryWriter(str(tmp_path)) as w:
+        w.add_scalar("loss", 0.5, global_step=1)
+        w.add_scalar("loss", 0.25, global_step=2)
+    files = [f for f in os.listdir(tmp_path)
+             if f.startswith("events.out.tfevents")]
+    assert len(files) == 1
+    records = _read_records(str(tmp_path / files[0]))
+    # file-version event + 2 scalar events
+    assert len(records) == 3
+    assert b"brain.Event:2" in records[0]
+    assert b"loss" in records[1]
+    # the f32 0.5 is embedded in the scalar event
+    assert struct.pack("<f", 0.5) in records[1]
+    assert struct.pack("<f", 0.25) in records[2]
+
+
+def test_log_metrics_callback(tmp_path):
+    from mxnet_tpu.gluon import metric
+    m = metric.Accuracy()
+    m.update(mx.np.array([1]), mx.np.array([[0.2, 0.8]]))
+    cb = tb.LogMetricsCallback(str(tmp_path), prefix="train")
+
+    class Param:
+        eval_metric = m
+
+    cb(Param())
+    files = [f for f in os.listdir(tmp_path)
+             if f.startswith("events.out.tfevents")]
+    records = _read_records(str(tmp_path / files[0]))
+    assert any(b"train-accuracy" in r for r in records)
+
+
+def test_vocabulary():
+    counter = collections.Counter(
+        text.count_tokens_from_str("a b b c c c"))
+    v = text.Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                        reserved_tokens=["<pad>"])
+    assert v.idx_to_token[:2] == ["<unk>", "<pad>"]
+    assert v.to_indices("c") == v.token_to_idx["c"]
+    assert v.to_indices(["c", "zzz"])[1] == 0  # unknown -> 0
+    assert v.to_tokens(0) == "<unk>"
+    assert len(v) == 4  # unk, pad, c, b
+
+
+def test_custom_embedding_and_composite(tmp_path):
+    p = tmp_path / "emb.txt"
+    p.write_text("hello 1.0 2.0 3.0\nworld 4.0 5.0 6.0\n")
+    emb = text.CustomEmbedding(str(p))
+    assert emb.vec_len == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [4.0, 5.0, 6.0])
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("missing").asnumpy(), [0.0, 0.0, 0.0])
+    emb.update_token_vectors("hello", mx.np.array([[9.0, 9.0, 9.0]]))
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [9.0, 9.0, 9.0])
+
+    vocab = text.Vocabulary(collections.Counter(["hello", "world"]))
+    comp = text.CompositeEmbedding(vocab, [emb, emb])
+    assert comp.vec_len == 6
+    onp.testing.assert_allclose(
+        comp.get_vecs_by_tokens("world").asnumpy(),
+        [4.0, 5.0, 6.0, 4.0, 5.0, 6.0])
+
+
+def test_fasttext_header_skipped(tmp_path):
+    p = tmp_path / "ft.vec"
+    p.write_text("2 3\nfoo 1 2 3\nbar 4 5 6\n")
+    emb = text.create("fasttext", pretrained_file_path=str(p))
+    assert emb.vec_len == 3
+    onp.testing.assert_allclose(
+        emb.get_vecs_by_tokens("bar").asnumpy(), [4.0, 5.0, 6.0])

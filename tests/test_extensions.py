@@ -1,0 +1,145 @@
+"""rtc (Pallas kernels), mx.library (dlopen extensions) and
+visualization tests (parity models: python/mxnet/rtc.py,
+python/mxnet/library.py + example/extensions/lib_custom_op,
+python/mxnet/visualization.py)."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# rtc
+# ---------------------------------------------------------------------------
+def test_pallas_module_from_source():
+    src = """
+def scale_add(x_ref, y_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0 + y_ref[...]
+
+def negate(x_ref, o_ref):
+    o_ref[...] = -x_ref[...]
+"""
+    mod = mx.rtc.PallasModule(src)
+    assert mod.list_kernels() == ["negate", "scale_add"]
+    k = mod.get_kernel("scale_add")
+    x = mx.np.random.uniform(size=(8, 128))
+    y = mx.np.random.uniform(size=(8, 128))
+    z = k.launch(x, y)
+    onp.testing.assert_allclose(z.asnumpy(),
+                                2 * x.asnumpy() + y.asnumpy(),
+                                rtol=1e-6)
+    neg = mod.get_kernel("negate")
+    onp.testing.assert_allclose(neg(x).asnumpy(), -x.asnumpy())
+
+
+def test_pallas_kernel_with_custom_grad():
+    def double(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    mod = mx.rtc.PallasModule(double)
+    k = mod.get_kernel("double",
+                       grad=lambda ct, x: (ct * 2.0,))
+    x = mx.np.random.uniform(size=(4, 8))
+    x.attach_grad()
+    with autograd.record():
+        out = k(x).sum()
+    out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.full((4, 8), 2.0), rtol=1e-6)
+
+
+def test_pallas_kernel_without_grad_is_opaque():
+    def ident(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    k = mx.rtc.PallasModule(ident).get_kernel("ident")
+    x = mx.np.random.uniform(size=(4,))
+    x.attach_grad()
+    with autograd.record():
+        out = (k(x) * 2.0).sum()
+    out.backward()
+    # stop_gradient: no gradient flows to x through the kernel
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.zeros(4))
+
+
+def test_cuda_module_points_to_pallas():
+    with pytest.raises(NotImplementedError, match="Pallas"):
+        mx.rtc.CudaModule("__global__ void f() {}")
+
+
+# ---------------------------------------------------------------------------
+# mx.library
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def ext_lib(tmp_path_factory):
+    so = str(tmp_path_factory.mktemp("ext") / "libexample_ext.so")
+    proc = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC",
+         os.path.join(ROOT, "src_native", "example_ext.cc"), "-o", so],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.skip(f"no toolchain: {proc.stderr[:200]}")
+    return so
+
+
+def test_library_load_and_dispatch(ext_lib):
+    ops = mx.library.load(ext_lib, verbose=False)
+    assert ops == ["plus_one", "scaled_mul"]
+    assert ext_lib in mx.library.loaded_libraries()
+    a = mx.np.array([1.0, 2.0, 3.0])
+    onp.testing.assert_allclose(mx.npx.plus_one(a).asnumpy(),
+                                [2.0, 3.0, 4.0])
+    onp.testing.assert_allclose(
+        mx.npx.scaled_mul(a, a).asnumpy(), [2.0, 8.0, 18.0])
+
+
+def test_library_op_inside_hybridized_graph(ext_lib):
+    mx.library.load(ext_lib, verbose=False)
+    from mxnet_tpu.gluon import nn
+
+    class Net(nn.HybridBlock):
+        def forward(self, x):
+            return mx.npx.plus_one(x) * 3.0
+
+    net = Net()
+    net.hybridize()
+    out = net(mx.np.array([1.0, 2.0]))
+    onp.testing.assert_allclose(out.asnumpy(), [6.0, 9.0])
+
+
+def test_library_rejects_non_extension(tmp_path):
+    bogus = tmp_path / "libbogus.so"
+    proc = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-x", "c++", "-",
+         "-o", str(bogus)], input="int nothing() { return 0; }",
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        pytest.skip("no toolchain")
+    with pytest.raises(RuntimeError, match="mxtpu_ext_op_list"):
+        mx.library.load(str(bogus))
+
+
+# ---------------------------------------------------------------------------
+# visualization
+# ---------------------------------------------------------------------------
+def test_print_summary_and_plot(capsys):
+    import mxnet_tpu.symbol as sym
+    data = sym.var("data")
+    w = sym.var("w")
+    h = sym.tanh(sym.multiply(data, w))
+    total = mx.visualization.print_summary(h, shape={"data": (2, 4),
+                                                     "w": (2, 4)})
+    out = capsys.readouterr().out
+    assert "tanh" in out and "Total params" in out
+    assert total == 8  # w only; data excluded
+
+    dot = mx.visualization.plot_network(h, title="net")
+    assert dot.startswith('digraph "net"')
+    assert "tanh" in dot and "->" in dot

@@ -146,3 +146,24 @@ def test_det_normalize_applied_after_resize(det_rec):
     norm, _ = next(it_norm)
     onp.testing.assert_allclose(norm.asnumpy(),
                                 (raw.asnumpy() - 10.0) / 2.0, rtol=1e-5)
+
+
+def test_det_iter_list_mode_non_dense_idx(tmp_path):
+    """.lst idx column need not be 0..n-1 (split files keep original
+    enumeration) — review r3 finding."""
+    d = tmp_path / "imgs"
+    d.mkdir()
+    lines = []
+    for pos, idx in enumerate([5, 9, 12, 20]):
+        arr = onp.full((24, 24, 3), pos * 40, onp.uint8)
+        Image.fromarray(arr).save(d / f"{idx}.jpg")
+        lab = _det_label([[float(idx), 0.1, 0.1, 0.8, 0.9]])
+        lines.append("\t".join([str(idx)] + [f"{v}" for v in lab]
+                               + [f"{idx}.jpg"]))
+    lst = tmp_path / "split.lst"
+    lst.write_text("\n".join(lines) + "\n")
+    it = ImageDetIter(batch_size=4, data_shape=(3, 24, 24),
+                      path_imglist=str(lst), path_root=str(d))
+    _, label = next(it)
+    got = sorted(label.asnumpy()[:, 0, 0].tolist())
+    assert got == [5.0, 9.0, 12.0, 20.0]

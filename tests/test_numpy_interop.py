@@ -274,3 +274,40 @@ def test_conformance(name, workload):
                                     onp.asarray(w, cmp),
                                     rtol=2e-4, atol=1e-5,
                                     err_msg=f"conformance mismatch: {name}")
+
+
+def test_npx_detection_and_ctc_ops():
+    """Round-3 npx additions: slice/slice_like/ctc_loss/multibox_prior/
+    roi_pooling (reference: matrix_op.cc, ctc_loss.cc,
+    multibox_prior.cc, roi_pooling.cc)."""
+    a = np.array(onp.arange(24, dtype=onp.float32).reshape(4, 6))
+    onp.testing.assert_allclose(
+        npx.slice(a, (1, 2), (3, 5)).asnumpy(),
+        a.asnumpy()[1:3, 2:5])
+    assert npx.slice_like(a, np.zeros((2, 3))).shape == (2, 3)
+    assert npx.slice_like(a, np.zeros((2, 9)), axes=(0,)).shape == (2, 6)
+
+    # ctc: strongly-peaked logits along the label alignment -> low loss
+    T, N, C = 8, 2, 5
+    logits = onp.full((T, N, C), -10.0, onp.float32)
+    lbl = onp.array([[1, 2, 3], [2, 3, 0]], onp.int32)
+    for n in range(N):
+        seq = [v for v in lbl[n] if v != 0]
+        for t in range(T):
+            logits[t, n, seq[min(t // 2, len(seq) - 1)]] = 10.0
+    loss = npx.ctc_loss(np.array(logits), np.array(lbl))
+    assert loss.shape == (N,) and (loss.asnumpy() < 5.0).all()
+
+    anchors = npx.multibox_prior(np.zeros((1, 3, 4, 4)),
+                                 sizes=[0.5, 0.25], ratios=[1.0, 2.0])
+    assert anchors.shape == (1, 48, 4)
+    onp.testing.assert_allclose(
+        anchors.asnumpy()[0, 0], [-0.125, -0.125, 0.375, 0.375],
+        atol=1e-6)
+
+    feat = np.array(onp.arange(16, dtype=onp.float32).reshape(1, 1, 4, 4))
+    rois = np.array(onp.array([[0, 0, 0, 3, 3]], onp.float32))
+    out = npx.roi_pooling(feat, rois, pooled_size=(2, 2),
+                          spatial_scale=1.0)
+    onp.testing.assert_allclose(out.asnumpy()[0, 0],
+                                [[5., 7.], [13., 15.]])

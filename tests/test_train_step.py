@@ -79,3 +79,35 @@ def test_train_step_mesh_dp_tp():
     w = net[0].weight.data()._data
     assert w.sharding.spec == P("tp", None)
     assert len(set(d.id for d in w.sharding.device_set)) == 8
+
+
+def test_parallel_allreduce_is_real_reduction():
+    """parallel.allreduce must SUM across the mesh axis, not just
+    re-lay-out (round-2 VERDICT Weak #8)."""
+    import jax
+    import numpy as onp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh((8,), ("dp",))
+    old = parallel.get_mesh()
+    parallel.set_mesh(mesh)
+    try:
+        host = onp.concatenate(
+            [onp.full((2, 3), i + 1.0, onp.float32) for i in range(8)])
+        a = mx.np.array(host)
+        a._install(jax.device_put(a._data, NamedSharding(mesh, P("dp"))))
+        parallel.allreduce(a, axis_name="dp")
+        assert a.shape == (2, 3)
+        onp.testing.assert_allclose(a.asnumpy(),
+                                    onp.full((2, 3), 36.0))
+        b = mx.np.ones((4,))
+        parallel.allreduce(b, axis_name="dp")
+        onp.testing.assert_allclose(b.asnumpy(), onp.full((4,), 8.0))
+        c = mx.np.array(host)
+        c._install(jax.device_put(c._data, NamedSharding(mesh, P("dp"))))
+        parallel.allreduce(c, op="max", axis_name="dp")
+        onp.testing.assert_allclose(c.asnumpy(), onp.full((2, 3), 8.0))
+    finally:
+        parallel.set_mesh(old)

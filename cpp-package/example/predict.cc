@@ -1,0 +1,61 @@
+// Minimal C++ inference consumer over the libmxtpu C ABI (parity:
+// cpp-package/example + the reference's c_predict_api users).
+//
+// Usage: predict <model.onnx> <n> <c> [h w]
+// Feeds an all-0.5 input of the given shape, prints the output values.
+//
+// Build:
+//   g++ -O2 predict.cc -o predict -I../include -L. -lmxtpu \
+//       -Wl,-rpath,'$ORIGIN'
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#include "mxtpu/c_predict_api.h"
+
+int main(int argc, char** argv) {
+  if (argc < 4) {
+    std::fprintf(stderr, "usage: %s model.onnx n c [h w]\n", argv[0]);
+    return 2;
+  }
+  std::vector<int64_t> shape;
+  for (int i = 2; i < argc; ++i) shape.push_back(std::atoll(argv[i]));
+  int64_t numel = 1;
+  for (int64_t s : shape) numel *= s;
+
+  PredictorHandle h;
+  if (MXTPUPredCreate(argv[1], &h) != 0) {
+    std::fprintf(stderr, "create failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  std::vector<float> input(numel, 0.5f);
+  if (MXTPUPredSetInput(h, input.data(), shape.data(),
+                        static_cast<int>(shape.size())) != 0) {
+    std::fprintf(stderr, "set_input failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  int64_t out_shape[8];
+  int out_ndim = 0;
+  if (MXTPUPredForward(h, out_shape, 8, &out_ndim) != 0) {
+    std::fprintf(stderr, "forward failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  int64_t out_n = 1;
+  std::printf("output shape:");
+  for (int i = 0; i < out_ndim; ++i) {
+    std::printf(" %lld", static_cast<long long>(out_shape[i]));
+    out_n *= out_shape[i];
+  }
+  std::printf("\n");
+  std::vector<float> out(out_n);
+  if (MXTPUPredGetOutput(h, out.data(), out_n) != 0) {
+    std::fprintf(stderr, "get_output failed: %s\n", MXTPUGetLastError());
+    return 1;
+  }
+  std::printf("output:");
+  for (int64_t i = 0; i < out_n && i < 16; ++i)
+    std::printf(" %.6f", out[i]);
+  std::printf("\n");
+  MXTPUPredFree(h);
+  return 0;
+}

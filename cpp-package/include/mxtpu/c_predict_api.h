@@ -1,0 +1,44 @@
+/*
+ * libmxtpu C predict API (parity: include/mxnet/c_predict_api.h).
+ *
+ * Inference-only C ABI for non-Python consumers: create a predictor
+ * from an exported ONNX artifact (mx.contrib.onnx.export_model), feed
+ * float32 input, run forward, copy the float32 output out.
+ */
+#ifndef MXTPU_C_PREDICT_API_H_
+#define MXTPU_C_PREDICT_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* PredictorHandle;
+
+/* Human-readable message for the last failed call (thread-shared). */
+const char* MXTPUGetLastError();
+
+/* Create a predictor from an exported .onnx file. Returns 0 on
+ * success; the handle stays valid until MXTPUPredFree. */
+int MXTPUPredCreate(const char* model_path, PredictorHandle* out);
+
+/* Bind a float32 input tensor (copied). */
+int MXTPUPredSetInput(PredictorHandle h, const float* data,
+                      const int64_t* shape, int ndim);
+
+/* Run the forward pass; writes the output shape (up to max_ndim). */
+int MXTPUPredForward(PredictorHandle h, int64_t* out_shape,
+                     int max_ndim, int* out_ndim);
+
+/* Copy the float32 output into `out` (capacity in floats). */
+int MXTPUPredGetOutput(PredictorHandle h, float* out,
+                       int64_t capacity_floats);
+
+int MXTPUPredFree(PredictorHandle h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_PREDICT_API_H_ */

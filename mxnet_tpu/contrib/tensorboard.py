@@ -92,7 +92,8 @@ class SummaryWriter:
 
     def __init__(self, logdir):
         os.makedirs(logdir, exist_ok=True)
-        fname = f"events.out.tfevents.{int(time.time())}.mxnet_tpu"
+        fname = (f"events.out.tfevents.{int(time.time())}."
+                 f"{os.getpid()}.{id(self):x}.mxnet_tpu")
         self._f = open(os.path.join(logdir, fname), "wb")
         self._write_record(_file_version_event())
 
@@ -131,9 +132,13 @@ class LogMetricsCallback:
         self.summary_writer = SummaryWriter(logging_dir)
 
     def __call__(self, param):
-        """`param` is a BatchEndParam-alike with `.eval_metric`."""
-        metric = getattr(param, "eval_metric", None) or param
-        if metric is None:
+        """`param` is a BatchEndParam-alike with `.eval_metric`,
+        or an EvalMetric directly."""
+        if hasattr(param, "eval_metric"):
+            metric = param.eval_metric
+        else:
+            metric = param
+        if metric is None or not hasattr(metric, "get"):
             return
         name_value = metric.get()
         names, values = name_value if isinstance(name_value[0],

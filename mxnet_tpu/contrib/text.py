@@ -59,12 +59,17 @@ class Vocabulary:
         if counter is not None:
             pairs = sorted(counter.items(), key=lambda kv: (-kv[1],
                                                             kv[0]))
-            if most_freq_count is not None:
-                pairs = pairs[:most_freq_count]
+            taken = 0
             for tok, freq in pairs:
+                # the cap counts NEWLY indexed tokens (reserved/unknown
+                # occurrences in the corpus must not consume slots)
+                if most_freq_count is not None and \
+                        taken >= most_freq_count:
+                    break
                 if freq >= min_freq and tok not in seen:
                     seen.add(tok)
                     self._idx_to_token.append(tok)
+                    taken += 1
         self._token_to_idx = {t: i for i, t
                               in enumerate(self._idx_to_token)}
 
@@ -125,8 +130,9 @@ class _TokenEmbedding(Vocabulary):
                 if len(parts) < 2:
                     continue
                 tok, vals = parts[0], parts[1:]
-                if line_num == 1 and len(vals) == 1:
-                    continue  # fastText-style "count dim" header
+                if line_num == 1 and len(vals) == 1 and \
+                        tok.isdigit() and vals[0].strip().isdigit():
+                    continue  # fastText "count dim" header, not a token
                 try:
                     vec = [float(v) for v in vals]
                 except ValueError:

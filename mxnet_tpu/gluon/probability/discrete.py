@@ -270,6 +270,11 @@ class Categorical(Distribution):
     def log_prob(self, value):
         self._validate_sample(value)
         logp = npx.log_softmax(self.logit, axis=-1)
+        # broadcast the distribution over extra sample dims (parity:
+        # the reference's Categorical accepts value batches wider than
+        # the parameter batch)
+        logp = np.broadcast_to(logp, tuple(value.shape)
+                               + (self.num_events,))
         return npx.pick(logp, value.astype("int32"), axis=-1)
 
     def sample(self, size=None):

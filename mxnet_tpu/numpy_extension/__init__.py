@@ -646,10 +646,22 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     src/operator/nn/ctc_loss.cc). data: (T, N, C) unnormalized
     activations; label: (N, L) int classes (0 = blank padding when
     lengths are not given). Lowered to optax.ctc_loss — the alpha
-    recursion compiles to one XLA scan."""
-    import optax
-    from ..numpy import moveaxis as _move
+    recursion compiles to one XLA scan.
 
+    blank_label: 'first' (blank = class 0, reference default) or
+    'last' (blank = C-1; labels are shifted so optax's blank-0
+    convention still applies)."""
+    import optax
+
+    if blank_label not in ("first", "last"):
+        raise ValueError(f"blank_label must be 'first' or 'last', "
+                         f"got {blank_label!r}")
+    if blank_label == "last" and not use_label_lengths:
+        # with the blank at C-1, class 0 is a REAL class and cannot
+        # double as padding — explicit lengths are required (same
+        # constraint the reference documents for its padding modes)
+        raise ValueError("blank_label='last' requires "
+                         "use_label_lengths=True with label_lengths")
     d = _c(data)
     lab = _c(label)
     ntc = apply_op(lambda x: jnp.moveaxis(x, 0, 1), d, name="ctc_tr")
@@ -664,11 +676,19 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
             logit_pad = (idx >= dl.reshape(-1, 1)).astype(jnp.float32)
         else:
             logit_pad = jnp.zeros((n, t), jnp.float32)
+        if blank_label == "last":
+            # optax fixes blank = 0: rotate class C-1 (the blank) to
+            # slot 0 and shift real classes 0..C-2 up by one
+            logits = jnp.concatenate([logits[..., -1:],
+                                      logits[..., :-1]], axis=-1)
+            labels = labels + 1
         if use_label_lengths:
             ll = lens[i]
             li = jnp.arange(L).reshape(1, L)
             lbl_pad = (li >= ll.reshape(-1, 1)).astype(jnp.float32)
         else:
+            # 'first' convention: class 0 is the blank, so 0 in the
+            # label tensor doubles as padding
             lbl_pad = (labels == 0).astype(jnp.float32)
         return optax.ctc_loss(logits, logit_pad,
                               labels.astype(jnp.int32), lbl_pad)

@@ -33,6 +33,19 @@ def print_summary(symbol, shape=None, line_length=120,
         arg_shapes, out_shapes, _ = symbol.infer_shape(**shape)
         for name, s in zip(symbol.list_arguments(), arg_shapes):
             shapes[name] = s
+        # per-node output shapes: re-infer each interior node's output
+        # by treating it as a head (cheap: eval_shape, no FLOPs)
+        try:
+            from .symbol.symbol import Symbol as _Sym
+            for nid, node in enumerate(nodes):
+                if node.op == "null" or node.name in shapes:
+                    continue
+                sub = _Sym(nodes, [(nid, 0)])
+                _, outs, _ = sub.infer_shape(**shape)
+                if outs:
+                    shapes[node.name] = outs[0]
+        except Exception:  # noqa: BLE001 — summary stays best-effort
+            pass
 
     positions = [int(line_length * p) for p in positions]
     headers = ["Layer (type)", "Output Shape", "Param #",
@@ -56,7 +69,7 @@ def print_summary(symbol, shape=None, line_length=120,
             sh = shapes.get(node.name, ())
             n_params = int(onp.prod(sh)) if sh else 0
         else:
-            sh = shapes.get(node.name, "") if node.op == "null" else ""
+            sh = shapes.get(node.name, "")
             n_params = 0
         total += n_params
         prev = ", ".join(nodes[i].name for i, _ in node.inputs)

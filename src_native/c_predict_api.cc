@@ -1,0 +1,244 @@
+// libmxtpu C predict API — non-Python consumer surface.
+//
+// Parity: the reference's C Predict API (include/mxnet/c_predict_api.h:
+// MXPredCreate / MXPredSetInput / MXPredForward / MXPredGetOutput /
+// MXPredFree over exported symbol+params). TPU-native equivalent: the
+// deployment artifact is an exported ONNX file (mx.contrib.onnx), and
+// inference runs through an embedded CPython interpreter hosting the
+// framework — the same "thin C ABI over the runtime" layering as the
+// reference's c_api.cc, with XLA underneath instead of the engine.
+//
+// Build: g++ -O2 -shared -fPIC c_predict_api.cc -o libmxtpu.so \
+//          $(python3-config --includes) -L/usr/local/lib -lpython3.12
+// Consumers link only this C ABI (see cpp-package/example/predict.cc).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::string g_last_error;
+std::mutex g_mu;
+bool g_inited = false;
+
+// Helper module living inside the embedded interpreter: keeps the
+// predictor registry so the C side only passes integer handles.
+const char* kHelperSrc = R"PY(
+import os as _os
+import numpy as _np
+
+# honor JAX_PLATFORMS before any backend init: the TPU plugin ignores
+# the env var once registered, so pin it through jax.config (same
+# workaround the test conftest uses)
+if _os.environ.get("JAX_PLATFORMS"):
+    import jax as _jax
+    _jax.config.update("jax_platforms",
+                       _os.environ["JAX_PLATFORMS"].split(",")[0])
+
+_predictors = {}
+_next = [1]
+
+def create(path):
+    from mxnet_tpu.contrib.onnx import import_model
+    fn = import_model(path)
+    h = _next[0]
+    _next[0] += 1
+    _predictors[h] = {"fn": fn, "input": None, "output": None}
+    return h
+
+def set_input(h, buf, shape):
+    import mxnet_tpu as mx
+    arr = _np.frombuffer(buf, dtype=_np.float32).reshape(shape).copy()
+    _predictors[h]["input"] = mx.np.array(arr)
+
+def forward(h):
+    p = _predictors[h]
+    out = p["fn"](p["input"])
+    if isinstance(out, tuple):
+        out = out[0]
+    p["output"] = out.asnumpy().astype(_np.float32)
+    return p["output"].shape
+
+def get_output(h):
+    return _predictors[h]["output"].tobytes()
+
+def free(h):
+    _predictors.pop(h, None)
+)PY";
+
+PyObject* g_helper = nullptr;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void capture_py_error(const char* where) {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = where;
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg += ": ";
+      msg += PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+int ensure_init() {
+  if (g_inited) return 0;
+  if (!Py_IsInitialized()) Py_InitializeEx(0);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* mod = PyModule_New("_mxtpu_capi_helper");
+  PyObject* globals = PyModule_GetDict(mod);
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* res = PyRun_String(kHelperSrc, Py_file_input, globals, globals);
+  if (res == nullptr) {
+    capture_py_error("helper init failed");
+    PyGILState_Release(gs);
+    return -1;
+  }
+  Py_DECREF(res);
+  g_helper = mod;
+  g_inited = true;
+  PyGILState_Release(gs);
+  // Py_InitializeEx left THIS thread holding the GIL outside any
+  // PyGILState pair; release it so other threads' PyGILState_Ensure
+  // can acquire (classic embedding deadlock otherwise).
+  PyEval_SaveThread();
+  return 0;
+}
+
+PyObject* helper_fn(const char* name) {
+  return PyObject_GetAttrString(g_helper, name);
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* PredictorHandle;
+
+const char* MXTPUGetLastError() { return g_last_error.c_str(); }
+
+// Create a predictor from an exported ONNX artifact.
+int MXTPUPredCreate(const char* model_path, PredictorHandle* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* fn = helper_fn("create");
+  PyObject* r = fn ? PyObject_CallFunction(fn, "s", model_path) : nullptr;
+  if (r) {
+    *out = reinterpret_cast<PredictorHandle>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    capture_py_error("MXTPUPredCreate");
+  }
+  Py_XDECREF(fn);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUPredSetInput(PredictorHandle h, const float* data,
+                      const int64_t* shape, int ndim) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = -1;
+  int64_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* buf = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), n * sizeof(float));
+  PyObject* fn = helper_fn("set_input");
+  PyObject* r = fn ? PyObject_CallFunction(
+      fn, "lOO", reinterpret_cast<long>(h), buf, shp) : nullptr;
+  if (r) {
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    capture_py_error("MXTPUPredSetInput");
+  }
+  Py_XDECREF(fn);
+  Py_XDECREF(buf);
+  Py_XDECREF(shp);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// Runs the forward pass; returns output rank and fills out_shape
+// (caller-provided, max_ndim entries).
+int MXTPUPredForward(PredictorHandle h, int64_t* out_shape,
+                     int max_ndim, int* out_ndim) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* fn = helper_fn("forward");
+  PyObject* r = fn ? PyObject_CallFunction(
+      fn, "l", reinterpret_cast<long>(h)) : nullptr;
+  if (r) {
+    int nd = static_cast<int>(PyTuple_Size(r));
+    *out_ndim = nd;
+    for (int i = 0; i < nd && i < max_ndim; ++i)
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(r, i));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    capture_py_error("MXTPUPredForward");
+  }
+  Py_XDECREF(fn);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUPredGetOutput(PredictorHandle h, float* out,
+                       int64_t capacity_floats) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* fn = helper_fn("get_output");
+  PyObject* r = fn ? PyObject_CallFunction(
+      fn, "l", reinterpret_cast<long>(h)) : nullptr;
+  if (r) {
+    char* data;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(r, &data, &len) == 0 &&
+        len <= capacity_floats * static_cast<int64_t>(sizeof(float))) {
+      std::memcpy(out, data, len);
+      rc = 0;
+    } else {
+      set_error("output buffer too small");
+      PyErr_Clear();
+    }
+    Py_DECREF(r);
+  } else {
+    capture_py_error("MXTPUPredGetOutput");
+  }
+  Py_XDECREF(fn);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUPredFree(PredictorHandle h) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* fn = helper_fn("free");
+  PyObject* r = fn ? PyObject_CallFunction(
+      fn, "l", reinterpret_cast<long>(h)) : nullptr;
+  Py_XDECREF(r);
+  Py_XDECREF(fn);
+  PyGILState_Release(gs);
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,69 @@
+"""C predict API test: build libmxtpu + the cpp-package example
+consumer, export a model to ONNX, run inference from C++ (parity:
+the reference's c_predict_api + cpp-package examples)."""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.contrib import onnx as mxonnx
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def built(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi")
+    inc = sysconfig.get_paths()["include"]
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    ver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    lib = str(d / "libmxtpu.so")
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC",
+         os.path.join(ROOT, "src_native", "c_predict_api.cc"),
+         "-o", lib, f"-I{inc}", f"-L{libdir}", f"-l{ver}",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"libmxtpu build failed: {r.stderr[:300]}")
+    exe = str(d / "predict")
+    r = subprocess.run(
+        ["g++", "-O2",
+         os.path.join(ROOT, "cpp-package", "example", "predict.cc"),
+         "-o", exe,
+         f"-I{os.path.join(ROOT, 'cpp-package', 'include')}",
+         f"-L{d}", "-lmxtpu", f"-Wl,-rpath,{d}",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"predict example build failed: {r.stderr[:300]}")
+    return d, exe
+
+
+def test_cpp_consumer_matches_python(built, tmp_path):
+    d, exe = built
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="tanh"), nn.Dense(3))
+    net.initialize()
+    x = mx.np.full((2, 4), 0.5)
+    ref = net(x).asnumpy()
+    model = str(tmp_path / "m.onnx")
+    mxonnx.export_model(net, (2, 4), model)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe, model, "2", "4"], env=env,
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "output shape: 2 3" in r.stdout
+    vals = [float(v) for v in
+            r.stdout.split("output:")[1].split()]
+    onp.testing.assert_allclose(onp.asarray(vals),
+                                ref.ravel()[:len(vals)], rtol=1e-4,
+                                atol=1e-5)

@@ -9,4 +9,5 @@ from .sampler import (  # noqa: F401
 from .dataloader import (  # noqa: F401
     DataLoader, default_batchify_fn, default_mp_batchify_fn,
 )
+from . import batchify  # noqa: F401
 from . import vision  # noqa: F401

@@ -318,3 +318,49 @@ def test_clip_global_norm():
     total = gluon.utils.clip_global_norm(arrays, 1.0)
     norm = onp.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
     assert norm <= 1.01
+
+
+def test_batchify_stack_pad_group():
+    """batchify.Stack/Pad/Group (parity: gluon/data/batchify.py);
+    Pad(round_to) is the TPU static-shape bucketing knob."""
+    from mxnet_tpu.gluon.data import batchify
+
+    s = batchify.Stack()([[1, 2], [3, 4]])
+    assert s.shape == (2, 2)
+
+    p = batchify.Pad(val=0)([[1, 2, 3, 4], [4, 5, 6], [8, 2]])
+    onp.testing.assert_array_equal(
+        p.asnumpy(), [[1, 2, 3, 4], [4, 5, 6, 0], [8, 2, 0, 0]])
+
+    pr = batchify.Pad(val=-1, round_to=8)([[1, 2, 3]])
+    assert pr.shape == (1, 8)
+    assert pr.asnumpy()[0, 3] == -1
+
+    p2 = batchify.Pad(val=-1)([onp.array([[1, 2, 3, 4], [5, 6, 7, 8]]),
+                               onp.array([[5, 8], [1, 2]])])
+    assert p2.shape == (2, 2, 4)
+    assert p2.asnumpy()[1, 0].tolist() == [5, 8, -1, -1]
+
+    g = batchify.Group(batchify.Stack(), batchify.Pad(val=0))
+    data, labels = g([([1, 2], [1]), ([3, 4], [2, 3])])
+    assert data.shape == (2, 2) and labels.shape == (2, 2)
+    with pytest.raises(ValueError):
+        g([([1], [2], [3])])
+
+    # DataLoader integration
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = SimpleSeqDataset()
+    dl = DataLoader(ds, batch_size=2,
+                    batchify_fn=batchify.Pad(val=0, round_to=4))
+    batch = next(iter(dl))
+    assert batch.shape[1] == 4
+
+
+class SimpleSeqDataset:
+    _data = [[1.0, 2.0], [3.0], [1.0, 2.0, 3.0], [4.0]]
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, i):
+        return self._data[i]

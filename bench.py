@@ -204,14 +204,30 @@ def _run_bench(small: bool):
                 done += len(take)
             io_ips = n_images / (time.perf_counter() - t0)
 
-            # loader-fed train step: decode + H2D + step per batch
+            # loader-fed train step: decode + H2D + step per batch,
+            # with the NEXT batch decoding on a worker thread while the
+            # current one trains (double buffering — the reference's
+            # PrefetcherIter pattern; the native reader decodes in C++
+            # threads with the GIL released, so overlap is real)
+            from concurrent.futures import ThreadPoolExecutor
+
+            def _load(s):
+                imgs, labels = reader.read_batch(
+                    idxs[s:s + batch], (hw, hw))
+                return (mx.np.array(imgs.astype(onp.float32) / 255.0,
+                                    dtype="bfloat16"),
+                        mx.np.array(labels[:, 0].astype(onp.int32)))
+
+            pool = ThreadPoolExecutor(max_workers=1)
+
             def batches():
-                for s in range(0, n_images - batch + 1, batch):
-                    imgs, labels = reader.read_batch(
-                        idxs[s:s + batch], (hw, hw))
-                    yield (mx.np.array(imgs.astype(onp.float32) / 255.0,
-                                       dtype="bfloat16"),
-                           mx.np.array(labels[:, 0].astype(onp.int32)))
+                starts = list(range(0, n_images - batch + 1, batch))
+                fut = pool.submit(_load, starts[0])
+                for s in starts[1:]:
+                    nxt = pool.submit(_load, s)
+                    yield fut.result()
+                    fut = nxt
+                yield fut.result()
 
             for d, l in batches():  # warmup/compile this input path
                 loss = step(d, l)
@@ -243,7 +259,59 @@ def _run_bench(small: bool):
     }
 
 
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "1500"))
+
+
+def _run_guarded():
+    """Run the whole benchmark in a child with a hard timeout.
+
+    TPU (axon) initialization can hang indefinitely — not just fail —
+    when the chip is held by a stale session; a child process is the
+    only reliable watchdog. One retry, then CPU fallback, so a JSON
+    line is always produced."""
+    env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    for attempt in range(2):
+        try:
+            out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                                 env=env, capture_output=True, text=True,
+                                 timeout=CHILD_TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] attempt {attempt + 1} timed out after "
+                  f"{CHILD_TIMEOUT_S}s (TPU init/compile hang); "
+                  "retrying", file=sys.stderr, flush=True)
+            continue
+        lines = [l for l in out.stdout.strip().splitlines()
+                 if l.startswith("{")]
+        if out.returncode == 0 and lines:
+            print(lines[-1])
+            return 0
+        print(f"[bench] attempt {attempt + 1} failed rc={out.returncode}: "
+              f"{out.stderr.strip()[-400:]}", file=sys.stderr, flush=True)
+    # last resort: CPU small mode in-process
+    print("[bench] all TPU attempts failed; CPU small fallback",
+          file=sys.stderr, flush=True)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SMALL"] = "1"
+    out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                         env=env, capture_output=True, text=True,
+                         timeout=CHILD_TIMEOUT_S)
+    lines = [l for l in out.stdout.strip().splitlines()
+             if l.startswith("{")]
+    if lines:
+        print(lines[-1])
+        return 0
+    print(json.dumps({"metric": "bench_error", "value": 0.0,
+                      "unit": "images/sec/chip", "vs_baseline": 0.0,
+                      "error": out.stderr.strip()[-300:]}))
+    return 1
+
+
 def main():
+    # Parent mode: delegate to a watchdogged child (see _run_guarded).
+    if os.environ.get("BENCH_CHILD") != "1":
+        return _run_guarded()
+
     # Honor an explicit platform request (local CPU runs) without
     # probing: the axon TPU plugin registers regardless of
     # JAX_PLATFORMS, so pin via jax.config before any backend init.

@@ -7,3 +7,4 @@ Pretrained-weight download is not available in this offline build;
 from . import model_store  # noqa: F401
 from . import vision  # noqa: F401
 from .vision import get_model  # noqa: F401
+from . import bert  # noqa: F401

@@ -1,0 +1,109 @@
+"""BERT model family (BASELINE.json config 4: "BERT-base fine-tune via
+GluonNLP, mixed-precision AMP"; reference model spec: the GluonNLP
+BERTModel/BERTEncoder/BERTClassifier stack over gluon blocks).
+
+TPU-first notes: the encoder keeps everything batched MXU matmuls
+(MultiHeadAttention lowers to dot_generals / Pallas flash attention),
+embeddings/positional adds fuse into the first layer under hybridize,
+and the whole fine-tune step compiles into one XLA program. bf16 runs
+via amp.convert_hybrid_block — no loss scaling needed on TPU.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+from ..nn.attention import TransformerEncoderCell
+
+__all__ = ["BERTEncoder", "BERTModel", "BERTClassifier",
+           "bert_base", "bert_small"]
+
+
+class BERTEncoder(HybridBlock):
+    """Token+segment+position embeddings -> N transformer cells."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 num_segments=2, dropout=0.1, dtype="float32"):
+        super().__init__()
+        self.units = units
+        self.word_embed = Embedding(vocab_size, units, dtype=dtype)
+        self.segment_embed = Embedding(num_segments, units, dtype=dtype)
+        self.position_weight = Parameter(
+            "position_weight", shape=(max_length, units), dtype=dtype)
+        self.embed_ln = LayerNorm()
+        self.embed_drop = Dropout(dropout) if dropout else None
+        self.layers = HybridSequential()
+        for _ in range(num_layers):
+            # BERT blocks are post-norm with GELU (GluonNLP BERTEncoder)
+            self.layers.add(TransformerEncoderCell(
+                units, num_heads, hidden_dim=hidden_size,
+                dropout=dropout, activation="gelu", pre_norm=False,
+                dtype=dtype))
+
+    def forward(self, token_ids, segment_ids=None, valid_length=None):
+        x = self.word_embed(token_ids)
+        if segment_ids is not None:
+            x = x + self.segment_embed(segment_ids)
+        seq_len = token_ids.shape[-1]
+        pos = self.position_weight.data()[:seq_len]
+        x = x + pos
+        x = self.embed_ln(x)
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        for cell in self.layers._children.values():
+            x = cell(x, valid_length=valid_length)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Encoder + pooler (CLS tanh projection), GluonNLP-shaped:
+    returns (sequence_output, pooled_output)."""
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 num_segments=2, dropout=0.1, dtype="float32"):
+        super().__init__()
+        self.encoder = BERTEncoder(vocab_size, units, hidden_size,
+                                   num_layers, num_heads, max_length,
+                                   num_segments, dropout, dtype=dtype)
+        self.pooler = Dense(units, activation="tanh", flatten=False,
+                            dtype=dtype)
+
+    def forward(self, token_ids, segment_ids=None, valid_length=None):
+        seq = self.encoder(token_ids, segment_ids, valid_length)
+        pooled = self.pooler(seq[:, 0])
+        return seq, pooled
+
+
+class BERTClassifier(HybridBlock):
+    """Fine-tuning head over the pooled output (parity: GluonNLP
+    BERTClassifier)."""
+
+    def __init__(self, bert, num_classes=2, dropout=0.1):
+        super().__init__()
+        self.bert = bert
+        self.dropout = Dropout(dropout) if dropout else None
+        self.classifier = Dense(num_classes, flatten=False)
+
+    def forward(self, token_ids, segment_ids=None, valid_length=None):
+        _, pooled = self.bert(token_ids, segment_ids, valid_length)
+        if self.dropout is not None:
+            pooled = self.dropout(pooled)
+        return self.classifier(pooled)
+
+
+def bert_base(vocab_size=30522, dropout=0.1, dtype="float32", **kwargs):
+    """BERT-base: 12 layers, 768 units, 12 heads (the config-4 model)."""
+    return BERTModel(vocab_size=vocab_size, units=768, hidden_size=3072,
+                     num_layers=12, num_heads=12, dropout=dropout,
+                     dtype=dtype, **kwargs)
+
+
+def bert_small(vocab_size=1000, units=64, num_layers=2, num_heads=4,
+               max_length=64, dropout=0.1, dtype="float32", **kwargs):
+    """Tiny configuration for tests/smoke runs."""
+    return BERTModel(vocab_size=vocab_size, units=units,
+                     hidden_size=units * 4, num_layers=num_layers,
+                     num_heads=num_heads, max_length=max_length,
+                     dropout=dropout, dtype=dtype, **kwargs)

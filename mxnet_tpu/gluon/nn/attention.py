@@ -7,6 +7,8 @@ flash kernel + ring attention over the 'sp' mesh axis).
 """
 from __future__ import annotations
 
+import math
+
 from ... import numpy_extension as npx
 from ..block import HybridBlock
 from .basic_layers import Dense, Dropout, LayerNorm
@@ -46,13 +48,31 @@ class MultiHeadAttention(HybridBlock):
         return x.reshape(b, s, self._num_heads,
                          self._head_dim).transpose(0, 2, 1, 3)
 
-    def forward(self, query, key=None, value=None):
+    def forward(self, query, key=None, value=None, valid_length=None):
         key = query if key is None else key
         value = key if value is None else value
         q = self._split(self.q_proj(query))
         k = self._split(self.k_proj(key))
         v = self._split(self.v_proj(value))
-        if self._sequence_parallel:
+        if valid_length is not None:
+            # padding-masked attention (BERT-style variable-length
+            # batches): explicit scores + masked softmax — the flash
+            # kernel has no pad-mask input, and for encoder batches
+            # XLA fuses this chain fine
+            from ... import numpy as mnp
+            scale = 1.0 / math.sqrt(self._head_dim)
+            scores = npx.batch_dot(q, k.transpose(0, 1, 3, 2)) * scale
+            s_k = scores.shape[-1]
+            pos = mnp.arange(s_k).reshape(1, 1, 1, s_k)
+            mask = pos < valid_length.reshape(-1, 1, 1, 1)
+            if self._causal:
+                s_q = scores.shape[-2]
+                cm = (mnp.arange(s_q).reshape(1, 1, s_q, 1)
+                      >= mnp.arange(s_k).reshape(1, 1, 1, s_k))
+                mask = mnp.logical_and(mask, cm)
+            attn = npx.masked_softmax(scores, mask, axis=-1)
+            out = npx.batch_dot(attn, v)
+        elif self._sequence_parallel:
             out = npx.ring_attention(q, k, v, causal=self._causal)
         else:
             out = npx.flash_attention(q, k, v, causal=self._causal)
@@ -65,25 +85,37 @@ class MultiHeadAttention(HybridBlock):
 
 
 class TransformerEncoderCell(HybridBlock):
-    """Pre-norm transformer block: MHA + MLP (the bench/dryrun model)."""
+    """Transformer block: MHA + MLP (the bench/dryrun model).
+
+    pre_norm=True (default) is the GPT-style block; pre_norm=False is
+    the BERT-style post-norm block. `activation` picks the FFN
+    nonlinearity ("relu" default, "gelu" for BERT)."""
 
     def __init__(self, embed_dim, num_heads, hidden_dim=None, dropout=0.0,
-                 causal=False, sequence_parallel=False, dtype="float32"):
+                 causal=False, sequence_parallel=False,
+                 activation="relu", pre_norm=True, dtype="float32"):
         super().__init__()
         hidden_dim = hidden_dim or 4 * embed_dim
+        self._pre_norm = pre_norm
         self.ln1 = LayerNorm()
         self.attn = MultiHeadAttention(
             embed_dim, num_heads, dropout=dropout, causal=causal,
             sequence_parallel=sequence_parallel, dtype=dtype)
         self.ln2 = LayerNorm()
-        self.ffn1 = Dense(hidden_dim, activation="relu", flatten=False,
-                          dtype=dtype)
+        self.ffn1 = Dense(hidden_dim, activation=activation,
+                          flatten=False, dtype=dtype)
         self.ffn2 = Dense(embed_dim, flatten=False, dtype=dtype)
         self.dropout = Dropout(dropout) if dropout else None
 
-    def forward(self, x):
-        h = x + self.attn(self.ln1(x))
-        y = self.ffn2(self.ffn1(self.ln2(h)))
+    def forward(self, x, valid_length=None):
+        if self._pre_norm:
+            h = x + self.attn(self.ln1(x), valid_length=valid_length)
+            y = self.ffn2(self.ffn1(self.ln2(h)))
+            if self.dropout is not None:
+                y = self.dropout(y)
+            return h + y
+        h = self.ln1(x + self.attn(x, valid_length=valid_length))
+        y = self.ffn2(self.ffn1(h))
         if self.dropout is not None:
             y = self.dropout(y)
-        return h + y
+        return self.ln2(h + y)

@@ -124,13 +124,17 @@ class ImageIter:
 
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
-                 shuffle=False, aug_list=None, use_native=None, **kwargs):
+                 shuffle=False, aug_list=None, use_native=None,
+                 prefetch=False, **kwargs):
         from .recordio import MXIndexedRecordIO
         assert path_imgrec or path_imglist
         self.batch_size = batch_size
         self.data_shape = data_shape
         self.shuffle = shuffle
         self.aug_list = aug_list or []
+        self._prefetch = bool(prefetch)
+        self._pending = None
+        self._pool = None
         self._rec = None
         self._list = None
         self._native = None
@@ -169,11 +173,33 @@ class ImageIter:
         if self.shuffle:
             onp.random.shuffle(self._order)
         self._cursor = 0
+        self._pending = None
 
     def __iter__(self):
         return self
 
     def __next__(self):
+        if not self._prefetch:
+            return self._next_batch()
+        # double buffering (parity: the reference's PrefetcherIter,
+        # src/io/iter_prefetcher.h): batch k+1 decodes on a worker
+        # thread while the caller consumes batch k — the native reader
+        # decodes with the GIL released, so overlap is real
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(max_workers=1)
+        if self._pending is None:
+            self._pending = self._pool.submit(self._next_batch)
+        fut = self._pending
+        self._pending = self._pool.submit(self._next_batch)
+        try:
+            return fut.result()
+        except StopIteration:
+            pending, self._pending = self._pending, None
+            pending.cancel()
+            raise
+
+    def _next_batch(self):
         from .numpy import stack, array
         from .recordio import unpack_img
         if self._cursor + self.batch_size > len(self._order):

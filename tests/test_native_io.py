@@ -154,3 +154,22 @@ def test_image_iter_non_dense_keys(tmp_path):
     assert it._native is not None
     _, labels = next(it)
     onp.testing.assert_allclose(labels.asnumpy(), [10., 20., 30., 40.])
+
+
+def test_image_iter_prefetch_matches_sync(packed):
+    """prefetch=True double-buffers but must yield identical batches."""
+    from mxnet_tpu.image import ImageIter
+    rec_path, _ = packed
+    a = ImageIter(batch_size=4, data_shape=(3, 48, 64),
+                  path_imgrec=rec_path)
+    b = ImageIter(batch_size=4, data_shape=(3, 48, 64),
+                  path_imgrec=rec_path, prefetch=True)
+    na, nb = 0, 0
+    for (da, la), (db, lb) in zip(a, b):
+        onp.testing.assert_allclose(da.asnumpy(), db.asnumpy())
+        onp.testing.assert_allclose(la.asnumpy(), lb.asnumpy())
+        na += 1
+    assert na == 8  # 32 records / 4
+    b.reset()
+    count = sum(1 for _ in b)
+    assert count == 8

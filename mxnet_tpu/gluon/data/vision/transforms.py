@@ -223,3 +223,134 @@ class RandomLighting(Block):
         a = onp.random.normal(0, self._alpha, size=(3,)).astype(onp.float32)
         rgb = (self._eigvec * a * self._eigval).sum(axis=1)
         return x + np.array(rgb.reshape(1, 1, 3))
+
+
+class CropResize(HybridBlock):
+    """Fixed crop then optional resize (parity: transforms.CropResize)."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._x, self._y = x, y
+        self._w, self._h = width, height
+        self._size = size
+        self._interp = interpolation
+
+    def forward(self, img):
+        out = img[self._y:self._y + self._h, self._x:self._x + self._w]
+        if self._size is not None:
+            from ....image import imresize
+            w, h = (self._size if isinstance(self._size, (tuple, list))
+                    else (self._size, self._size))
+            out = imresize(out, w, h, self._interp)
+        return out
+
+
+class RandomGray(Block):
+    """Randomly convert to 3-channel grayscale (parity:
+    transforms.RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.uniform() >= self._p:
+            return x
+        w = np.array(onp.asarray([0.299, 0.587, 0.114], onp.float32))
+        gray = (x.astype("float32") * w).sum(axis=-1, keepdims=True)
+        out = np.concatenate([gray, gray, gray], axis=-1)
+        return out.astype(x.dtype)
+
+
+class RandomHue(Block):
+    """Random hue jitter in HSV space (parity: transforms.RandomHue)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._hue = hue
+
+    def forward(self, x):
+        from PIL import Image
+        alpha = onp.random.uniform(-self._hue, self._hue)
+        host = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+        dtype = host.dtype
+        img = Image.fromarray(host.astype(onp.uint8)).convert("HSV")
+        hsv = onp.array(img)
+        hsv[..., 0] = (hsv[..., 0].astype(onp.int32)
+                       + int(alpha * 255)) % 256
+        out = onp.asarray(Image.fromarray(hsv, "HSV").convert("RGB"))
+        return np.array(out.astype(dtype))
+
+
+class Rotate(Block):
+    """Rotate by a fixed angle in degrees (parity: transforms.Rotate)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._deg = rotation_degrees
+
+    def forward(self, x):
+        from PIL import Image
+        host = x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+        dtype = host.dtype
+        img = Image.fromarray(host.astype(onp.uint8))
+        out = onp.asarray(img.rotate(self._deg, Image.BILINEAR))
+        return np.array(out.astype(dtype))
+
+
+class RandomRotation(Block):
+    """Random rotation within [-deg, deg] (parity:
+    transforms.RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        lo, hi = (angle_limits if isinstance(angle_limits, (tuple, list))
+                  else (-angle_limits, angle_limits))
+        self._lo, self._hi = lo, hi
+        self._p = rotate_with_proba
+
+    def forward(self, x):
+        if onp.random.uniform() >= self._p:
+            return x
+        return Rotate(onp.random.uniform(self._lo, self._hi))(x)
+
+
+class RandomApply(Sequential):
+    """Apply the wrapped transform with probability p (parity:
+    transforms.RandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.uniform() < self._p:
+            return self.transforms(x)
+        return x
+
+
+class HybridCompose(HybridSequential):
+    """Hybridizable Compose (all members HybridBlocks)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class HybridRandomApply(HybridSequential):
+    """Hybridizable RandomApply; the coin flip stays host-side per
+    call (the reference uses np.random inside the graph — here a host
+    draw keeps the compiled graph static)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self._p = p
+
+    def forward(self, x):
+        if onp.random.uniform() < self._p:
+            return self.transforms(x)
+        return x

@@ -364,3 +364,29 @@ class SimpleSeqDataset:
 
     def __getitem__(self, i):
         return self._data[i]
+
+
+def test_vision_transforms_extended():
+    """CropResize/RandomGray/RandomHue/Rotate/RandomRotation/
+    RandomApply/HybridCompose (parity: gluon/data/vision/transforms)."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = np.array(onp.random.RandomState(0).randint(
+        0, 255, (32, 48, 3)).astype(onp.uint8))
+    cr = T.CropResize(4, 2, 20, 16, size=(10, 8))(img)
+    assert cr.shape == (8, 10, 3)
+    g = T.RandomGray(p=1.0)(img)
+    onp.testing.assert_allclose(g.asnumpy()[..., 0], g.asnumpy()[..., 1])
+    assert T.RandomHue(0.2)(img).shape == img.shape
+    # rotating a SQUARE image 4x90 degrees returns the original
+    # (PIL keeps the canvas, so non-square content would be cropped)
+    sq = np.array(onp.random.RandomState(1).randint(
+        0, 255, (32, 32, 3)).astype(onp.uint8))
+    r = sq
+    for _ in range(4):
+        r = T.Rotate(90)(r)
+    onp.testing.assert_allclose(r.asnumpy(), sq.asnumpy(), atol=2)
+    assert T.RandomRotation(15)(img).shape == img.shape
+    skip = T.RandomApply(T.RandomGray(p=1.0), p=0.0)(img)
+    onp.testing.assert_array_equal(skip.asnumpy(), img.asnumpy())
+    hc = T.HybridCompose([T.Cast("float32"), T.Normalize(0.0, 255.0)])
+    assert float(hc(img).asnumpy().max()) <= 1.0

@@ -1,0 +1,121 @@
+"""Finite-difference gradient sweep over core differentiable ops
+(parity model: tests/python/unittest/test_operator.py's
+check_numeric_gradient usage — the reference validates every op's
+FGradient against central differences)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+from mxnet_tpu.test_utils import check_numeric_gradient as _cng
+
+
+def check_numeric_gradient(f, inputs, **kw):
+    """f32-appropriate central differences: the framework truncates to
+    float32 (x64 off by default), so eps must sit near sqrt(eps_f32)
+    and tolerances above the resulting rounding noise. This still
+    catches wrong gradient formulas, sign errors, and dropped terms."""
+    kw.setdefault("eps", 2e-3)
+    kw.setdefault("rtol", 5e-2)
+    kw.setdefault("atol", 5e-3)
+    return _cng(f, inputs, **kw)
+
+_R = onp.random.RandomState(42)
+_A = _R.uniform(0.5, 1.5, (3, 4))
+_B = _R.uniform(0.5, 1.5, (3, 4))
+_V = _R.uniform(0.5, 1.5, (6,))
+_SQ = _R.uniform(0.5, 1.5, (4, 4)) + onp.eye(4) * 4.0
+
+_UNARY = [
+    ("exp", lambda x: np.exp(x).sum(), _A),
+    ("log", lambda x: np.log(x).sum(), _A),
+    ("sqrt", lambda x: np.sqrt(x).sum(), _A),
+    ("square", lambda x: np.square(x).sum(), _A),
+    ("tanh", lambda x: np.tanh(x).sum(), _A),
+    ("sigmoid", lambda x: npx.sigmoid(x).sum(), _A),
+    ("relu", lambda x: npx.relu(x - 1.0).sum(), _A),
+    ("gelu", lambda x: npx.gelu(x).sum(), _A),
+    ("softplus", lambda x: npx.softplus(x).sum(), _A),
+    ("sin", lambda x: np.sin(x).sum(), _A),
+    ("cos", lambda x: np.cos(x).sum(), _A),
+    ("arctan", lambda x: np.arctan(x).sum(), _A),
+    ("reciprocal", lambda x: np.reciprocal(x).sum(), _A),
+    ("abs", lambda x: np.abs(x - 1.0).sum(), _A + 0.01),
+    ("cbrt", lambda x: np.cbrt(x).sum(), _A),
+    ("log1p", lambda x: np.log1p(x).sum(), _A),
+    ("expm1", lambda x: np.expm1(x).sum(), _A),
+    ("erf", lambda x: npx.erf(x).sum(), _A),
+    ("softmax", lambda x: (npx.softmax(x) * np.arange(4)).sum(), _A),
+    ("log_softmax", lambda x: (npx.log_softmax(x)
+                               * np.arange(4)).sum(), _A),
+    ("mean", lambda x: np.mean(x * x), _A),
+    ("std", lambda x: np.std(x), _A),
+    ("var", lambda x: np.var(x), _A),
+    ("norm", lambda x: np.linalg.norm(x), _A),
+    ("max", lambda x: np.max(x * x), _A),
+    ("cumsum", lambda x: (np.cumsum(x, axis=1)
+                          * np.arange(4)).sum(), _A),
+    ("transpose", lambda x: (np.transpose(x) ** 2).sum(), _A),
+    ("reshape", lambda x: (x.reshape(4, 3) ** 3).sum(), _A),
+    ("slice", lambda x: (x[1:, :2] ** 2).sum(), _A),
+    ("flip", lambda x: (np.flip(x, axis=0) * np.arange(4)).sum(), _A),
+    ("logsumexp", lambda x: np.log(np.exp(x).sum()), _A),
+    ("inv", lambda x: np.linalg.inv(x).sum(), _SQ),
+    ("slogdet", lambda x: np.linalg.slogdet(x)[1], _SQ),
+]
+
+
+@pytest.mark.parametrize("name,fn,x", _UNARY,
+                         ids=[u[0] for u in _UNARY])
+def test_unary_gradients(name, fn, x):
+    check_numeric_gradient(fn, [x])
+
+
+_BINARY = [
+    ("add", lambda a, b: (a + b * b).sum()),
+    ("sub", lambda a, b: ((a - b) ** 2).sum()),
+    ("mul", lambda a, b: (a * b).sum()),
+    ("div", lambda a, b: (a / b).sum()),
+    ("pow", lambda a, b: (a ** b).sum()),
+    ("maximum", lambda a, b: np.maximum(a, b * 1.01).sum()),
+    ("matmul", lambda a, b: (a @ b.T).sum()),
+    ("dot_chain", lambda a, b: np.tanh(a @ b.T).sum()),
+    ("where", lambda a, b: np.where(a > 1.0, a * 2, b * 3).sum()),
+    ("hypot", lambda a, b: np.hypot(a, b).sum()),
+    ("arctan2", lambda a, b: np.arctan2(a, b).sum()),
+]
+
+
+@pytest.mark.parametrize("name,fn", _BINARY,
+                         ids=[b[0] for b in _BINARY])
+def test_binary_gradients(name, fn):
+    check_numeric_gradient(fn, [_A, _B])
+
+
+def test_conv_and_pool_gradients():
+    w = _R.uniform(-0.5, 0.5, (2, 3, 3, 3))
+    x = _R.uniform(0.1, 1.0, (1, 3, 6, 6))
+    check_numeric_gradient(
+        lambda xx, ww: (npx.convolution(xx, ww, kernel=(3, 3),
+                                        num_filter=2, pad=1) ** 2).sum(),
+        [x, w])
+    check_numeric_gradient(
+        lambda xx: (npx.pooling(xx, kernel=(2, 2), pool_type="avg")
+                    * 2.0).sum(), [x])
+
+
+def test_layernorm_batchnorm_gradients():
+    x = _R.uniform(0.1, 1.0, (2, 3, 4))
+    g = _R.uniform(0.5, 1.5, (4,))
+    b = _R.uniform(-0.5, 0.5, (4,))
+    check_numeric_gradient(
+        lambda xx, gg, bb: (npx.layer_norm(xx, gg, bb)
+                            * np.arange(4)).sum(), [x, g, b])
+
+
+def test_embedding_and_pick_gradients():
+    idx = onp.array([0, 2, 1], onp.float64)
+    w = _R.uniform(-1, 1, (4, 5))
+    check_numeric_gradient(
+        lambda ww: (npx.embedding(np.array(idx.astype(onp.int32)), ww)
+                    ** 2).sum(), [w])

@@ -151,3 +151,19 @@ class Domain:
 
 class Scope(Task):
     """Annotation scope also used by memory profiling in the reference."""
+
+
+def dump_memory_profile(path=None):
+    """Write a device-memory profile (parity: the reference's storage
+    profiler, src/profiler/storage_profiler.h:223 — per-allocation
+    tracking dumped for offline analysis). On PJRT this is the
+    pprof-format device memory profile (live buffers attributed to the
+    HLO that allocated them); inspect with `pprof` or any pprof
+    viewer. Returns the path written."""
+    data = jax.profiler.device_memory_profile()
+    if path is None:
+        base = os.path.splitext(_config["filename"])[0]
+        path = base + "_memory.pprof"
+    with open(path, "wb") as f:
+        f.write(data)
+    return path

@@ -55,3 +55,14 @@ def test_pause_resume(tmp_path):
     profiler.pause()
     profiler.resume()
     profiler.stop()
+
+
+def test_memory_profile_dump(tmp_path):
+    """Storage-profiler parity: device memory profile dumps as pprof
+    (reference: src/profiler/storage_profiler.h)."""
+    keep = mx.np.ones((256, 256))
+    keep.wait_to_read()
+    p = profiler.dump_memory_profile(str(tmp_path / "mem.pprof"))
+    assert os.path.exists(p)
+    assert os.path.getsize(p) > 0
+    del keep

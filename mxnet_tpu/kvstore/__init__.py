@@ -20,6 +20,8 @@ Parity with the reference's KVStore stack (SURVEY.md §2.3):
 from __future__ import annotations
 
 from .base import KVStoreBase  # noqa: F401
+from . import horovod  # noqa: F401  (registers 'horovod')
+from . import byteps  # noqa: F401  (registers 'byteps')
 from .kvstore import KVStore, KVStoreLocal  # noqa: F401
 from .dist import KVStoreDistSync  # noqa: F401
 from .dist_async import KVStoreDistAsync, ParameterServer  # noqa: F401

@@ -1,0 +1,65 @@
+"""BytePS comm backend shim (parity: python/mxnet/kvstore/byteps.py).
+
+Delegates pushpull/broadcast to the `byteps` package when installed
+(not part of this image; clear ImportError otherwise). See
+tests/dist/custom_hvd.py for a dependency-free out-of-tree backend
+exercising the same registry seam.
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase
+
+__all__ = ["BytePS"]
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    """A communication backend using BytePS push-pull."""
+
+    def __init__(self):
+        try:
+            import byteps.mxnet as bps  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "kvstore 'byteps' needs the byteps package, which is "
+                "not installed in this environment; use the built-in "
+                "'dist_sync'/'dist_async' stores or register a custom "
+                "backend via KVStoreBase.register") from e
+        self._bps = __import__("byteps.mxnet", fromlist=["mxnet"])
+        self._bps.init()
+
+    @property
+    def type(self):
+        return "byteps"
+
+    @property
+    def rank(self):
+        return self._bps.rank()
+
+    @property
+    def num_workers(self):
+        return self._bps.size()
+
+    @property
+    def is_update_on_kvstore_default(self):
+        return False
+
+    def broadcast(self, key, value, out, priority=0):
+        self._bps.byteps_declare_tensor(str(key))
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o._install(value._data)
+        self._bps.byteps_push_pull(outs[0], name=str(key),
+                                   is_average=False)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = value if isinstance(value, list) else [value]
+        total = vals[0]
+        for v in vals[1:]:
+            total = total + v
+        self._bps.byteps_push_pull(total, name=str(key),
+                                   is_average=False)
+        target = vals if out is None else (
+            out if isinstance(out, list) else [out])
+        for o in target:
+            o._install(total._data)

@@ -1,0 +1,65 @@
+"""Horovod comm backend shim (parity: python/mxnet/kvstore/horovod.py).
+
+Delegates broadcast/pushpull to the `horovod` package when installed
+(it is not part of this image — the class raises a clear ImportError
+at construction otherwise). The registry seam itself is exercised
+without horovod by tests/dist/custom_hvd.py, an out-of-tree backend
+with its own transport.
+"""
+from __future__ import annotations
+
+from .base import KVStoreBase
+
+__all__ = ["Horovod"]
+
+
+@KVStoreBase.register
+class Horovod(KVStoreBase):
+    """A communication backend using Horovod (allreduce/broadcast)."""
+
+    def __init__(self):
+        try:
+            import horovod.mxnet as hvd  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "kvstore 'horovod' needs the horovod package, which is "
+                "not installed in this environment; for an allreduce "
+                "backend without extra dependencies use the built-in "
+                "'device'/'dist_sync' stores (XLA collectives) or "
+                "register your own via KVStoreBase.register (see "
+                "tests/dist/custom_hvd.py)") from e
+        self._hvd = __import__("horovod.mxnet", fromlist=["mxnet"])
+        self._hvd.init()
+
+    @property
+    def type(self):
+        return "horovod"
+
+    @property
+    def rank(self):
+        return self._hvd.rank()
+
+    @property
+    def num_workers(self):
+        return self._hvd.size()
+
+    @property
+    def is_update_on_kvstore_default(self):
+        return False
+
+    def broadcast(self, key, value, out, priority=0):
+        res = self._hvd.broadcast(value, root_rank=0, name=str(key))
+        outs = out if isinstance(out, list) else [out]
+        for o in outs:
+            o._install(res._data if hasattr(res, "_data") else res)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        vals = value if isinstance(value, list) else [value]
+        total = vals[0]
+        for v in vals[1:]:
+            total = total + v
+        res = self._hvd.allreduce(total, average=False, name=str(key))
+        target = vals if out is None else (
+            out if isinstance(out, list) else [out])
+        for o in target:
+            o._install(res._data if hasattr(res, "_data") else res)

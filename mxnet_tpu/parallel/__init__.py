@@ -194,6 +194,10 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
         if coordinator_address is not None:
             nproc = os.environ.get("MXNET_TPU_NUM_PROCS")
             pid = os.environ.get("MXNET_TPU_PROC_ID")
+            if pid is None:
+                # mpi launcher: MPI assigns ranks; honor its env
+                pid = os.environ.get("OMPI_COMM_WORLD_RANK",
+                                     os.environ.get("PMI_RANK"))
             if nproc is None or pid is None:
                 raise RuntimeError(
                     "MXNET_TPU_COORDINATOR is set but MXNET_TPU_NUM_PROCS"

@@ -92,3 +92,19 @@ def test_ssh_launcher_dry_run(tmp_path):
         assert "MXNET_TPU_NUM_PROCS=4" in line
         assert "MXNET_TPU_COORDINATOR=nodeA:" in line
         assert "train.py" in line
+
+
+def test_mpi_launcher_dry_run(tmp_path):
+    hosts = tmp_path / "hosts.txt"
+    hosts.write_text("nodeA\nnodeB\n")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "4", "--launcher", "mpi", "-H", str(hosts),
+         "--dry-run", "python", "train.py"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    line = proc.stdout.strip()
+    assert line.startswith("mpirun -np 4")
+    assert "-H nodeA,nodeB" in line
+    assert "MXNET_TPU_COORDINATOR=nodeA:" in line
+    assert "train.py" in line

@@ -109,12 +109,55 @@ def _launch_ssh(args):
     return rc
 
 
+def _launch_mpi(args):
+    """mpirun-based launcher (parity: dmlc_tracker mpi mode). Builds
+    one mpirun invocation; ranks read OMPI_COMM_WORLD_RANK /
+    PMI_RANK when MXNET_TPU_PROC_ID is not set per-process, so the
+    wrapper exports the coordinator env and lets MPI place ranks."""
+    import shlex
+    import shutil
+
+    if args.kv_mode == "async":
+        print("mpi launcher supports --kv-mode sync only",
+              file=sys.stderr)
+        return 2
+    hostargs = []
+    coord_host = "127.0.0.1"
+    if args.hostfile:
+        with open(args.hostfile) as f:
+            hosts = [h.strip() for h in f
+                     if h.strip() and not h.strip().startswith("#")]
+        if hosts:
+            coord_host = hosts[0]
+            hostargs = ["-H", ",".join(hosts)]
+    coord = f"{coord_host}:{_free_port()}"
+    envargs = []
+    env_pairs = {"MXNET_TPU_COORDINATOR": coord,
+                 "MXNET_TPU_NUM_PROCS": str(args.num_workers),
+                 "DMLC_ROLE": "worker"}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env_pairs[k] = v
+    for k, v in env_pairs.items():
+        envargs += ["-x", f"{k}={v}"]
+    cmd = (["mpirun", "-np", str(args.num_workers)] + hostargs + envargs
+           + args.command)
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    if shutil.which("mpirun") is None:
+        print("mpirun not found on PATH (install an MPI or use "
+              "--launcher local/ssh)", file=sys.stderr)
+        return 2
+    return subprocess.call(cmd)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=1)
     ap.add_argument("--launcher", default="local",
-                    choices=["local", "ssh"])
+                    choices=["local", "ssh", "mpi"])
     ap.add_argument("-H", "--hostfile", default=None,
                     help="ssh mode: file with one hostname per line")
     ap.add_argument("--dry-run", action="store_true",
@@ -130,6 +173,8 @@ def main():
 
     if args.launcher == "ssh":
         return _launch_ssh(args)
+    if args.launcher == "mpi":
+        return _launch_mpi(args)
 
     base_env = dict(os.environ)
     for kv in args.env:

@@ -49,7 +49,9 @@ import importlib as _importlib
 for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "kvstore", "parallel", "profiler", "runtime", "test_utils",
              "util", "recordio", "image", "io", "amp", "random", "symbol",
-             "rtc", "contrib", "library", "visualization"):
+             "rtc", "contrib", "library", "visualization", "operator",
+             "model", "callback", "name", "attribute", "registry",
+             "error", "log"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -4,10 +4,15 @@ Delegates pushpull/broadcast to the `byteps` package when installed
 (not part of this image; clear ImportError otherwise). See
 tests/dist/custom_hvd.py for a dependency-free out-of-tree backend
 exercising the same registry seam.
+
+Values cross into byteps.mxnet as real Apache-MXNet NDArrays via a
+host-numpy bridge (see horovod.py for the rationale); push_pull
+results are copied back into every target replica.
 """
 from __future__ import annotations
 
 from .base import KVStoreBase
+from .horovod import _MXNetBridge, _install_result
 
 __all__ = ["BytePS"]
 
@@ -18,14 +23,15 @@ class BytePS(KVStoreBase):
 
     def __init__(self):
         try:
-            import byteps.mxnet as bps  # noqa: F401
+            import byteps.mxnet as bps
         except ImportError as e:
             raise ImportError(
                 "kvstore 'byteps' needs the byteps package, which is "
                 "not installed in this environment; use the built-in "
                 "'dist_sync'/'dist_async' stores or register a custom "
                 "backend via KVStoreBase.register") from e
-        self._bps = __import__("byteps.mxnet", fromlist=["mxnet"])
+        self._bps = bps
+        self._bridge = _MXNetBridge()
         self._bps.init()
 
     @property
@@ -46,20 +52,21 @@ class BytePS(KVStoreBase):
 
     def broadcast(self, key, value, out, priority=0):
         self._bps.byteps_declare_tensor(str(key))
-        outs = out if isinstance(out, list) else [out]
-        for o in outs:
-            o._install(value._data)
-        self._bps.byteps_push_pull(outs[0], name=str(key),
-                                   is_average=False)
+        buf = self._bridge.to_backend(value)
+        # byteps has no broadcast primitive: the reference shim zeroes
+        # non-root contributions and push_pulls, so the sum equals the
+        # root value (python/mxnet/kvstore/byteps.py broadcast).
+        if self._bps.rank() != 0:
+            buf[:] = 0
+        self._bps.byteps_push_pull(buf, name=str(key), is_average=False)
+        _install_result(self._bridge.to_numpy(buf), out)
 
     def pushpull(self, key, value, out=None, priority=0):
         vals = value if isinstance(value, list) else [value]
         total = vals[0]
         for v in vals[1:]:
             total = total + v
-        self._bps.byteps_push_pull(total, name=str(key),
-                                   is_average=False)
-        target = vals if out is None else (
-            out if isinstance(out, list) else [out])
-        for o in target:
-            o._install(total._data)
+        buf = self._bridge.to_backend(total)
+        self._bps.byteps_push_pull(buf, name=str(key), is_average=False)
+        _install_result(self._bridge.to_numpy(buf),
+                        vals if out is None else out)

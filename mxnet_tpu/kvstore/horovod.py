@@ -5,12 +5,47 @@ Delegates broadcast/pushpull to the `horovod` package when installed
 at construction otherwise). The registry seam itself is exercised
 without horovod by tests/dist/custom_hvd.py, an out-of-tree backend
 with its own transport.
+
+Adapter boundary: horovod.mxnet operates on *real* Apache-MXNet
+NDArrays, not this framework's jax-backed ones, so values cross into
+the backend as host numpy buffers (`_MXNetBridge`) and results come
+back the same way (`_install_result`). That keeps foreign tensor
+objects out of our NDArray `_data` slots; the extra host hop is the
+price of a third-party CPU-side transport and is irrelevant next to
+the network itself.
 """
 from __future__ import annotations
+
+import numpy as onp
 
 from .base import KVStoreBase
 
 __all__ = ["Horovod"]
+
+
+def _install_result(result_np, targets):
+    """Install a host numpy result into every target NDArray."""
+    import jax.numpy as jnp
+    val = jnp.asarray(result_np)
+    for o in (targets if isinstance(targets, list) else [targets]):
+        o._install(val)
+
+
+class _MXNetBridge:
+    """numpy ↔ real-mxnet NDArray conversion for horovod.mxnet /
+    byteps.mxnet, which only accept Apache-MXNet tensors."""
+
+    def __init__(self):
+        import importlib
+        self._mx = importlib.import_module("mxnet")
+
+    def to_backend(self, nd):
+        arr = nd.asnumpy() if hasattr(nd, "asnumpy") else onp.asarray(nd)
+        return self._mx.nd.array(arr)
+
+    @staticmethod
+    def to_numpy(backend_nd):
+        return backend_nd.asnumpy()
 
 
 @KVStoreBase.register
@@ -19,7 +54,7 @@ class Horovod(KVStoreBase):
 
     def __init__(self):
         try:
-            import horovod.mxnet as hvd  # noqa: F401
+            import horovod.mxnet as hvd
         except ImportError as e:
             raise ImportError(
                 "kvstore 'horovod' needs the horovod package, which is "
@@ -28,7 +63,8 @@ class Horovod(KVStoreBase):
                 "'device'/'dist_sync' stores (XLA collectives) or "
                 "register your own via KVStoreBase.register (see "
                 "tests/dist/custom_hvd.py)") from e
-        self._hvd = __import__("horovod.mxnet", fromlist=["mxnet"])
+        self._hvd = hvd
+        self._bridge = _MXNetBridge()
         self._hvd.init()
 
     @property
@@ -48,18 +84,16 @@ class Horovod(KVStoreBase):
         return False
 
     def broadcast(self, key, value, out, priority=0):
-        res = self._hvd.broadcast(value, root_rank=0, name=str(key))
-        outs = out if isinstance(out, list) else [out]
-        for o in outs:
-            o._install(res._data if hasattr(res, "_data") else res)
+        res = self._hvd.broadcast(self._bridge.to_backend(value),
+                                  root_rank=0, name=str(key))
+        _install_result(self._bridge.to_numpy(res), out)
 
     def pushpull(self, key, value, out=None, priority=0):
         vals = value if isinstance(value, list) else [value]
         total = vals[0]
         for v in vals[1:]:
             total = total + v
-        res = self._hvd.allreduce(total, average=False, name=str(key))
-        target = vals if out is None else (
-            out if isinstance(out, list) else [out])
-        for o in target:
-            o._install(res._data if hasattr(res, "_data") else res)
+        res = self._hvd.allreduce(self._bridge.to_backend(total),
+                                  average=False, name=str(key))
+        _install_result(self._bridge.to_numpy(res),
+                        vals if out is None else out)

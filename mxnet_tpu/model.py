@@ -1,0 +1,61 @@
+"""Legacy model checkpoint helpers.
+
+Parity target: ``python/mxnet/model.py`` (``save_checkpoint``
+``model.py:189``, ``load_params`` ``model.py:221``, ``load_checkpoint``
+``model.py:238``). Writes the reference's on-disk layout —
+``prefix-symbol.json`` plus ``prefix-NNNN.params`` in the legacy binary
+NDArray format with ``arg:``/``aux:`` key prefixes — so checkpoints
+round-trip with reference-ecosystem tooling.
+"""
+from __future__ import annotations
+
+from . import legacy_serialization as _legacy
+
+__all__ = ["save_checkpoint", "load_params", "load_checkpoint",
+           "BatchEndParam"]
+
+from .callback import BatchEndParam  # noqa: E402,F401  (historic home)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Save ``prefix-symbol.json`` + ``prefix-{epoch:04d}.params``.
+
+    ``remove_amp_cast`` is accepted for signature parity; AMP casts in
+    this framework live in the dispatch funnel, never in the saved
+    graph, so there is nothing to strip.
+    """
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    param_name = f"{prefix}-{epoch:04d}.params"
+    _legacy.save_legacy(param_name, save_dict)
+
+
+def load_params(prefix, epoch):
+    """Load ``prefix-{epoch:04d}.params`` → (arg_params, aux_params)."""
+    loaded = _legacy.load_legacy(f"{prefix}-{epoch:04d}.params")
+    if not isinstance(loaded, dict):
+        raise ValueError("checkpoint params file has no names; "
+                         "not a save_checkpoint artifact")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:  # tolerate unprefixed keys like the reference loader
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix, epoch):
+    """Load symbol + params saved by :func:`save_checkpoint`.
+
+    Returns ``(symbol, arg_params, aux_params)``.
+    """
+    from . import symbol as sym
+    symbol = sym.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(prefix, epoch)
+    return symbol, arg_params, aux_params

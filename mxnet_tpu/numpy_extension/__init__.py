@@ -784,3 +784,11 @@ def roi_pooling(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
         return jax.vmap(one_roi)(rr)
 
     return apply_op(fn, d, r, name="roi_pooling")
+
+
+def custom(*data, op_type, **kwargs):
+    """Invoke a registered python CustomOp (parity: mx.nd.Custom /
+    npx custom op; reference python/mxnet/operator.py:710 register).
+    Thin alias for mxnet_tpu.operator.custom."""
+    from .. import operator as _operator
+    return _operator.custom(*data, op_type=op_type, **kwargs)

@@ -1,0 +1,214 @@
+"""Python custom-operator bridge.
+
+Parity target: the reference's ``python/mxnet/operator.py`` (CustomOp
+``operator.py:434``, CustomOpProp ``operator.py:487``, ``register``
+``operator.py:710``) backed by the C++ trampoline
+``src/operator/custom/custom-inl.h:52`` that runs Python callbacks on
+dedicated threads and pushes them as async engine ops.
+
+TPU-native redesign: there is no callback trampoline to cross — the
+Python host *is* the frontend process, and JAX eager dispatch already
+gives async semantics. A registered CustomOp executes inline on the
+host thread: ``forward`` receives real NDArrays (device-backed,
+asynchronous), writes its outputs through the reference's ``req``
+assignment discipline, and — when autograd is recording — a tape node
+is installed whose VJP replays ``backward``. This preserves the
+reference contract (imperative NDArray in/out, req lists, aux states,
+shape/type inference at invoke time) without the dedicated-thread
+machinery the GIL-bound CUDA design needed.
+
+Custom ops run eagerly only; inside a hybridized trace they act as a
+graph break (the reference has the same property: custom ops execute
+via callback even under CachedOp). For jit-compilable user kernels use
+``mxnet_tpu.rtc`` (Pallas) or ``autograd.Function``.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as onp
+
+__all__ = [
+    "CustomOp", "CustomOpProp", "register", "custom",
+    "get_all_registered_operators", "get_all_registered_operators_grouped",
+    "get_operator_arguments",
+]
+
+
+class CustomOp:
+    """Base class for operators implemented in Python.
+
+    Subclass and override ``forward`` / ``backward``; both receive
+    lists of NDArrays and a ``req`` list ('null'|'write'|'add'|
+    'inplace') consumed through :meth:`assign`.
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        # default: zero gradients (parity with a no-op backward)
+        for i, g in enumerate(in_grad):
+            self.assign(g, req[i], g * 0)
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad-req discipline."""
+        if req == "null":
+            return
+        if req == "add":
+            dst[()] = dst + src
+        else:  # write / inplace
+            dst[()] = src
+
+
+class CustomOpProp:
+    """Operator property: names, shapes, dtypes, and the factory.
+
+    Mirrors the reference surface (``operator.py:487``): override
+    ``list_arguments`` / ``list_outputs`` / ``list_auxiliary_states``,
+    ``infer_shape`` / ``infer_type``, ``declare_backward_dependency``
+    and ``create_operator``.
+    """
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = bool(need_top_grad)
+
+    # --- declarations ----------------------------------------------------
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    # --- inference -------------------------------------------------------
+    def infer_shape(self, in_shape):
+        return in_shape, (in_shape[0],) * len(self.list_outputs()), ()
+
+    def infer_type(self, in_type):
+        return (in_type,
+                [in_type[0]] * len(self.list_outputs()),
+                [in_type[0]] * len(self.list_auxiliary_states()))
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    # --- factory ---------------------------------------------------------
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return CustomOp()
+
+
+_registry: "OrderedDict[str, type]" = OrderedDict()
+
+
+def register(reg_name):
+    """Class decorator registering a :class:`CustomOpProp` under a name
+    (parity: ``mx.operator.register``, reference ``operator.py:710``).
+    """
+
+    def do_register(prop_cls):
+        if not (isinstance(prop_cls, type)
+                and issubclass(prop_cls, CustomOpProp)):
+            raise TypeError("register() expects a CustomOpProp subclass")
+        _registry[reg_name] = prop_cls
+        return prop_cls
+
+    return do_register
+
+
+def get_all_registered_operators():
+    """Names of all registered custom operators."""
+    return list(_registry)
+
+
+def get_all_registered_operators_grouped():
+    """Custom ops have no backward-node aliases here; one group each."""
+    return {name: [name] for name in _registry}
+
+
+def get_operator_arguments(op_name):
+    """Introspect a registered prop's declared argument names."""
+    prop = _registry[op_name]()
+    return {"names": prop.list_arguments(),
+            "types": ["NDArray"] * len(prop.list_arguments()),
+            "narg": len(prop.list_arguments())}
+
+
+def _as_ndarray(x, ctx=None):
+    from .ndarray.ndarray import NDArray
+    from . import numpy as _np
+    if isinstance(x, NDArray):
+        return x
+    return _np.array(x, ctx=ctx)
+
+
+def custom(*data, op_type, **kwargs):
+    """Invoke a registered custom op imperatively
+    (parity: ``mx.nd.Custom(*data, op_type=...)``).
+
+    ``data`` supplies the declared arguments followed by the declared
+    auxiliary states (the reference's Custom op uses the same packing).
+    Extra keyword arguments are forwarded to the prop constructor.
+    """
+    from . import autograd
+    from .ndarray.ndarray import NDArray
+    from . import numpy as _np
+
+    if op_type not in _registry:
+        raise KeyError(
+            f"custom op {op_type!r} is not registered; known: "
+            f"{list(_registry)}")
+    prop = _registry[op_type](**kwargs)
+
+    arg_names = prop.list_arguments()
+    aux_names = prop.list_auxiliary_states()
+    n_args, n_aux = len(arg_names), len(aux_names)
+    if len(data) != n_args + n_aux:
+        raise ValueError(
+            f"custom op {op_type!r} declares {n_args} arguments + "
+            f"{n_aux} aux states but got {len(data)} inputs")
+
+    in_data = [_as_ndarray(d) for d in data[:n_args]]
+    aux = [_as_ndarray(d) for d in data[n_args:]]
+
+    in_shapes = [tuple(d.shape) for d in in_data]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [onp.dtype(d.dtype) for d in in_data]
+    _, out_types, _ = prop.infer_type(in_types)
+
+    ctx = in_data[0].ctx if in_data else None
+    op = prop.create_operator(ctx, in_shapes, in_types)
+
+    with autograd.pause():
+        out_data = [_np.zeros(s, dtype=t, ctx=ctx)
+                    for s, t in zip(out_shapes, out_types)]
+        op.forward(is_train=autograd.is_training() or autograd.is_recording(),
+                   req=["write"] * len(out_data),
+                   in_data=in_data, out_data=out_data, aux=aux)
+
+    if autograd.is_recording() and any(
+            autograd._on_tape(d) for d in in_data):
+        fwd_ins, fwd_outs = list(in_data), list(out_data)
+
+        def vjp_fn(cotangents):
+            with autograd.pause():
+                out_grad = [NDArray(c) for c in cotangents]
+                in_grad = [_np.zeros(d.shape, dtype=d.dtype, ctx=ctx)
+                           for d in fwd_ins]
+                op.backward(req=["write"] * len(in_grad),
+                            out_grad=out_grad, in_data=fwd_ins,
+                            out_data=fwd_outs, in_grad=in_grad, aux=aux)
+            return tuple(g._data for g in in_grad)
+
+        autograd._record(f"Custom[{op_type}]", None, vjp_fn,
+                         fwd_ins, fwd_outs)
+
+    return out_data[0] if len(out_data) == 1 else tuple(out_data)

@@ -1,0 +1,200 @@
+"""CustomOp bridge + small top-level modules (operator.py, model.py,
+callback.py, name.py, attribute.py, registry.py, error.py, log.py).
+
+Reference parity: python/mxnet/operator.py:434 (CustomOp),
+python/mxnet/model.py:189 (save_checkpoint), python/mxnet/callback.py,
+python/mxnet/name.py, python/mxnet/attribute.py, python/mxnet/registry.py.
+"""
+import logging
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, operator
+
+
+@operator.register("sigmoid_x2")
+class SigmoidX2Prop(operator.CustomOpProp):
+    """y = 2*sigmoid(x); custom backward = 2*y/2*(1-y/2) * dy."""
+
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["out"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidX2()
+
+
+class SigmoidX2(operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = 2.0 / (1.0 + onp.exp(-x))
+        self.assign(out_data[0], req[0], mx.np.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        s = out_data[0].asnumpy() / 2.0
+        g = out_grad[0].asnumpy() * 2.0 * s * (1.0 - s)
+        self.assign(in_grad[0], req[0], mx.np.array(g))
+
+
+def test_custom_op_forward_and_grad():
+    x = mx.np.array(onp.linspace(-2, 2, 12, dtype="float32").reshape(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = operator.custom(x, op_type="sigmoid_x2")
+        loss = y.sum()
+    loss.backward()
+
+    xs = x.asnumpy()
+    sig = 1.0 / (1.0 + onp.exp(-xs))
+    onp.testing.assert_allclose(y.asnumpy(), 2 * sig, rtol=1e-5)
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * sig * (1 - sig),
+                                rtol=1e-5)
+
+
+def test_custom_op_via_npx_and_registry_introspection():
+    x = mx.np.ones((2, 2))
+    y = mx.npx.custom(x, op_type="sigmoid_x2")
+    assert y.shape == (2, 2)
+    assert "sigmoid_x2" in operator.get_all_registered_operators()
+    args = operator.get_operator_arguments("sigmoid_x2")
+    assert args["names"] == ["data"] and args["narg"] == 1
+
+
+def test_custom_op_default_backward_zero_grad():
+    @operator.register("ident_nograd")
+    class P(operator.CustomOpProp):
+        def create_operator(self, ctx, s, t):
+            class Op(operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+            return Op()
+
+    x = mx.np.ones((3,))
+    x.attach_grad()
+    with autograd.record():
+        y = operator.custom(x, op_type="ident_nograd")
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), onp.zeros(3))
+
+
+def test_save_load_checkpoint_roundtrip(tmp_path):
+    prefix = str(tmp_path / "ckpt")
+    data = mx.sym.var("data")
+    net = mx.sym.relu(data) if hasattr(mx.sym, "relu") else data
+    arg = {"w": mx.np.arange(6).reshape(2, 3).astype("float32")}
+    aux = {"running_mean": mx.np.ones((3,), dtype="float32")}
+    mx.model.save_checkpoint(prefix, 3, net, arg, aux)
+
+    sym2, arg2, aux2 = mx.model.load_checkpoint(prefix, 3)
+    assert sym2 is not None
+    onp.testing.assert_allclose(arg2["w"].asnumpy(), arg["w"].asnumpy())
+    onp.testing.assert_allclose(aux2["running_mean"].asnumpy(),
+                                onp.ones((3,)))
+
+
+def test_callbacks(tmp_path, caplog):
+    from mxnet_tpu.callback import (BatchEndParam, Speedometer,
+                                    LogValidationMetricsCallback,
+                                    do_checkpoint)
+    from mxnet_tpu.gluon.metric import Accuracy
+
+    m = Accuracy()
+    m.update(mx.np.array([0, 1]), mx.np.array([[0.9, 0.1], [0.1, 0.9]]))
+
+    sp = Speedometer(batch_size=4, frequent=1)
+    with caplog.at_level(logging.INFO):
+        sp(BatchEndParam(epoch=0, nbatch=0, eval_metric=m, locals={}))
+        sp(BatchEndParam(epoch=0, nbatch=1, eval_metric=m, locals={}))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+    caplog.clear()
+    m.update(mx.np.array([0]), mx.np.array([[0.9, 0.1]]))
+    with caplog.at_level(logging.INFO):
+        LogValidationMetricsCallback()(
+            BatchEndParam(epoch=2, nbatch=0, eval_metric=m, locals={}))
+    assert any("Validation-accuracy" in r.message for r in caplog.records)
+
+    cb = do_checkpoint(str(tmp_path / "m"), period=1)
+    cb(0, mx.sym.var("data"), {"w": mx.np.ones((2,))}, {})
+    assert (tmp_path / "m-0001.params").exists()
+
+
+def test_name_manager_and_prefix():
+    from mxnet_tpu import name as name_mod
+    nm = name_mod.NameManager()
+    with nm:
+        assert name_mod.current() is nm
+        assert nm.get(None, "fc") == "fc0"
+        assert nm.get(None, "fc") == "fc1"
+        assert nm.get("explicit", "fc") == "explicit"
+        with name_mod.Prefix("pre_") as p:
+            assert p.get(None, "fc").startswith("pre_fc")
+    assert name_mod.current() is not nm
+
+
+def test_attr_scope_merging():
+    from mxnet_tpu import attribute
+    with attribute.AttrScope(group="a", lr_mult="2"):
+        with attribute.AttrScope(group="b"):
+            got = attribute.current().get({"user": "x"})
+            assert got["group"] == "b"      # inner wins
+            assert got["lr_mult"] == "2"    # inherited
+            assert got["user"] == "x"       # explicit wins over scope
+    with pytest.raises(ValueError):
+        attribute.AttrScope(bad=3)
+
+
+def test_generic_registry():
+    from mxnet_tpu import registry
+
+    class Base:
+        pass
+
+    reg = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @alias("athing", "th2")
+    class AThing(Base):
+        def __init__(self, v=1):
+            self.v = v
+
+    assert isinstance(create("athing"), AThing)
+    assert create("th2", v=5).v == 5
+    assert isinstance(create(AThing()), AThing)
+    assert create('{"name": "athing", "v": 7}').v == 7
+    with pytest.raises(ValueError):
+        create("missing")
+
+
+def test_error_types_catchable_as_builtin():
+    from mxnet_tpu import error
+    with pytest.raises(ValueError):
+        raise error.ValueError("bad value")
+    with pytest.raises(mx.MXNetError):
+        raise error.ValueError("bad value")
+    assert error.get_error_type("TypeError") is error.TypeError
+    msg = str(error.NotImplementedForSymbol(test_generic_registry, None))
+    assert "only available in NDArray" in msg
+
+
+def test_log_get_logger(tmp_path):
+    from mxnet_tpu import log
+    logger = log.get_logger("mxtpu_test_logger",
+                            filename=str(tmp_path / "l.log"),
+                            level=log.INFO)
+    logger.info("hello %d", 7)
+    for h in logger.handlers:
+        h.flush()
+    assert "hello 7" in (tmp_path / "l.log").read_text()
+    assert log.get_logger("mxtpu_test_logger") is logger

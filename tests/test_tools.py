@@ -105,6 +105,6 @@ def test_mpi_launcher_dry_run(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
     line = proc.stdout.strip()
     assert line.startswith("mpirun -np 4")
-    assert "-H nodeA,nodeB" in line
+    assert "-H nodeA:2,nodeB:2" in line  # slot counts: rank round-robin
     assert "MXNET_TPU_COORDINATOR=nodeA:" in line
     assert "train.py" in line

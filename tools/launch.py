@@ -45,6 +45,24 @@ def _free_port():
     return port
 
 
+def _read_hostfile(path):
+    """Hostnames from a dmlc-style hostfile (blank lines / # comments
+    skipped). Shared by the ssh and mpi launchers so hostfile syntax
+    can't drift between them."""
+    with open(path) as f:
+        return [h.strip() for h in f
+                if h.strip() and not h.strip().startswith("#")]
+
+
+def _reject_async(args, launcher):
+    if args.kv_mode == "async":
+        print(f"{launcher} launcher supports --kv-mode sync only "
+              "(run the parameter server separately and export "
+              "MXNET_TPU_PS_ADDR)", file=sys.stderr)
+        return True
+    return False
+
+
 def _launch_ssh(args):
     """Multi-host ssh launcher (parity: dmlc_tracker ssh mode)."""
     import shlex
@@ -52,19 +70,11 @@ def _launch_ssh(args):
     if not args.hostfile:
         print("ssh launcher needs -H/--hostfile", file=sys.stderr)
         return 2
-    with open(args.hostfile) as f:
-        hosts = []
-        for h in f:
-            h = h.strip()
-            if h and not h.startswith("#"):
-                hosts.append(h)
+    hosts = _read_hostfile(args.hostfile)
     if not hosts:
         print("hostfile is empty", file=sys.stderr)
         return 2
-    if args.kv_mode == "async":
-        print("ssh launcher supports --kv-mode sync only "
-              "(run the parameter server separately and export "
-              "MXNET_TPU_PS_ADDR)", file=sys.stderr)
+    if _reject_async(args, "ssh"):
         return 2
 
     coord_host = hosts[0]
@@ -109,6 +119,34 @@ def _launch_ssh(args):
     return rc
 
 
+def _mpi_flavor():
+    """'openmpi' or 'mpich' (Hydra/PMI family), from `mpirun --version`.
+    Defaults to openmpi when mpirun is absent (dry runs)."""
+    import shutil
+    if shutil.which("mpirun") is None:
+        return "openmpi"
+    try:
+        out = subprocess.run(["mpirun", "--version"], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:
+        return "openmpi"
+    return "openmpi" if "Open MPI" in out else "mpich"
+
+
+def _mpi_env_args(env_pairs):
+    """Env-forwarding flags for the detected mpirun: OpenMPI uses
+    `-x K=V`; MPICH/Hydra (the PMI_RANK family the rank fallback in
+    parallel/__init__.py serves) uses `-genv K V`."""
+    argv = []
+    if _mpi_flavor() == "openmpi":
+        for k, v in env_pairs.items():
+            argv += ["-x", f"{k}={v}"]
+    else:
+        for k, v in env_pairs.items():
+            argv += ["-genv", k, v]
+    return argv
+
+
 def _launch_mpi(args):
     """mpirun-based launcher (parity: dmlc_tracker mpi mode). Builds
     one mpirun invocation; ranks read OMPI_COMM_WORLD_RANK /
@@ -117,29 +155,31 @@ def _launch_mpi(args):
     import shlex
     import shutil
 
-    if args.kv_mode == "async":
-        print("mpi launcher supports --kv-mode sync only",
-              file=sys.stderr)
+    if _reject_async(args, "mpi"):
         return 2
     hostargs = []
     coord_host = "127.0.0.1"
     if args.hostfile:
-        with open(args.hostfile) as f:
-            hosts = [h.strip() for h in f
-                     if h.strip() and not h.strip().startswith("#")]
+        hosts = _read_hostfile(args.hostfile)
         if hosts:
             coord_host = hosts[0]
-            hostargs = ["-H", ",".join(hosts)]
+            # -H with bare hostnames means ONE slot per host to
+            # OpenMPI; spell out the round-robin rank count per host
+            # so -np > len(hosts) launches (matches _launch_ssh's
+            # placement).
+            slots = {h: 0 for h in hosts}
+            for rank in range(args.num_workers):
+                slots[hosts[rank % len(hosts)]] += 1
+            hostargs = ["-H", ",".join(
+                f"{h}:{n}" for h, n in slots.items() if n)]
     coord = f"{coord_host}:{_free_port()}"
-    envargs = []
     env_pairs = {"MXNET_TPU_COORDINATOR": coord,
                  "MXNET_TPU_NUM_PROCS": str(args.num_workers),
                  "DMLC_ROLE": "worker"}
     for kv in args.env:
         k, _, v = kv.partition("=")
         env_pairs[k] = v
-    for k, v in env_pairs.items():
-        envargs += ["-x", f"{k}={v}"]
+    envargs = _mpi_env_args(env_pairs)
     cmd = (["mpirun", "-np", str(args.num_workers)] + hostargs + envargs
            + args.command)
     if args.dry_run:

@@ -18,7 +18,16 @@ Honesty notes (round-2 VERDICT Weak #1):
   excluded); `ips_loader_fed` feeds the same step from the native
   RecordIO reader (src_native/) including decode + H2D, so a slow data
   path shows up. `io_images_per_sec` is the reader alone vs the
-  reference's ~3,000 img/s RecordIO baseline (BASELINE.md).
+  reference's ~3,000 img/s RecordIO baseline (BASELINE.md) — measured
+  here on a 1-vCPU host, so it is decode-bound by core count.
+- Timing uses FETCH-based synchronization with a two-point delta:
+  the axon tunnel's `block_until_ready`/`wait_to_read` returns before
+  device execution completes (measured: a 5.5 PFLOP matmul chain
+  "completes" in 0ms by wait, 0.63s by value fetch at ~187 TFLOP/s
+  sustained — 95% of the v5e's 197 nominal peak). Only materializing
+  bytes (`loss.asnumpy()`) proves execution, so each measurement times
+  `iters` chained steps ending in a scalar fetch, at two iteration
+  counts; the difference cancels the fixed fetch/RPC overhead.
 
 Robustness: the TPU (axon) backend can fail or hang during PJRT init.
 Backend init is therefore probed in a *subprocess* with a timeout and
@@ -142,11 +151,12 @@ def _run_bench(small: bool):
 
     if small:
         net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
-        batch, hw, warmup, iters = 2 * n_dev, 32, 1, 3
+        batch, hw, iters_lo, iters_hi = 2 * n_dev, 32, 1, 4
         flops_per_img = RESNET18_TRAIN_FLOPS_PER_IMG_32
     else:
         net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
-        batch, hw, warmup, iters = 128 * n_dev, 224, 5, 20
+        batch = int(os.environ.get("BENCH_BATCH", "384")) * n_dev
+        hw, iters_lo, iters_hi = 224, 2, 12
         flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG
     net.initialize()
     net.cast("bfloat16")
@@ -160,19 +170,22 @@ def _run_bench(small: bool):
     data = mx.np.random.uniform(size=(batch, hw, hw, 3), dtype="bfloat16")
     label = mx.np.zeros((batch,), dtype="int32")
 
-    for _ in range(warmup):
-        loss = step(data, label)
-    loss.wait_to_read()
-    print(f"[bench] warmup done ({warmup} iters)", file=sys.stderr,
-          flush=True)
+    def timed_chain(n):
+        """Time n chained steps ended by a scalar fetch (the only sync
+        the tunnel honors — see module docstring)."""
+        t0 = time.perf_counter()
+        for _ in range(n):
+            loss = step(data, label)
+        float(loss.asnumpy())
+        return time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(data, label)
-    loss.wait_to_read()
-    dt = time.perf_counter() - t0
-    ips_synth = batch * iters / dt
-    sec_per_step = dt / iters
+    timed_chain(iters_lo)  # compile + drain queue
+    print("[bench] warmup done", file=sys.stderr, flush=True)
+
+    t_lo = timed_chain(iters_lo)
+    t_hi = timed_chain(iters_hi)
+    sec_per_step = max((t_hi - t_lo) / (iters_hi - iters_lo), 1e-9)
+    ips_synth = batch / sec_per_step
 
     # ---- MFU ----
     kind = jax.devices()[0].device_kind
@@ -208,15 +221,20 @@ def _run_bench(small: bool):
             # with the NEXT batch decoding on a worker thread while the
             # current one trains (double buffering — the reference's
             # PrefetcherIter pattern; the native reader decodes in C++
-            # threads with the GIL released, so overlap is real)
+            # threads with the GIL released, so overlap is real).
+            # Images cross host→device as uint8 (4x less PCIe/tunnel
+            # bytes) and normalize to bf16 ON DEVICE — the 1-vCPU host
+            # cannot afford a 77MB/batch float conversion.
             from concurrent.futures import ThreadPoolExecutor
 
             def _load(s):
                 imgs, labels = reader.read_batch(
                     idxs[s:s + batch], (hw, hw))
-                return (mx.np.array(imgs.astype(onp.float32) / 255.0,
-                                    dtype="bfloat16"),
+                return (mx.np.array(imgs),  # uint8, H2D
                         mx.np.array(labels[:, 0].astype(onp.int32)))
+
+            def _feed(d, l):
+                return step(d.astype("bfloat16") / 255.0, l)
 
             pool = ThreadPoolExecutor(max_workers=1)
 
@@ -230,15 +248,15 @@ def _run_bench(small: bool):
                 yield fut.result()
 
             for d, l in batches():  # warmup/compile this input path
-                loss = step(d, l)
+                loss = _feed(d, l)
                 break
-            loss.wait_to_read()
+            float(loss.asnumpy())
             t0 = time.perf_counter()
             seen = 0
             for d, l in batches():
-                loss = step(d, l)
+                loss = _feed(d, l)
                 seen += batch
-            loss.wait_to_read()
+            float(loss.asnumpy())
             ips_loader = seen / (time.perf_counter() - t0)
             reader.close()
         else:
@@ -358,6 +376,9 @@ def main():
         "vs_baseline_note": "denominator=360 img/s/V100 (commonly cited "
                             "MXNet fp32 number; BASELINE.json.published "
                             "is empty)",
+        "timing": "fetch-delta: n chained steps + scalar fetch, two "
+                  "iteration counts differenced (tunnel wait APIs are "
+                  "async no-ops; only value fetch proves execution)",
         "mfu": round(r["mfu"], 4) if r["mfu"] is not None else None,
         "ips_synthetic": round(r["ips_synthetic"], 2),
         "ips_loader_fed": round(r["ips_loader_fed"], 2)

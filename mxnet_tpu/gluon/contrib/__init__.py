@@ -1,0 +1,3 @@
+"""Gluon contrib (parity: python/mxnet/gluon/contrib)."""
+from . import estimator  # noqa: F401
+from . import data  # noqa: F401

@@ -1,0 +1,6 @@
+"""Contrib data utilities (parity: python/mxnet/gluon/contrib/data)."""
+from . import vision  # noqa: F401
+from .vision import (  # noqa: F401
+    create_image_augment, ImageDataLoader,
+    create_bbox_augment, ImageBboxDataLoader,
+)

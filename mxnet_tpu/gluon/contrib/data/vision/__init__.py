@@ -1,0 +1,7 @@
+"""Contrib vision data pipeline (parity:
+python/mxnet/gluon/contrib/data/vision)."""
+from . import bbox  # noqa: F401
+from .dataloader import (  # noqa: F401
+    create_image_augment, ImageDataLoader,
+    create_bbox_augment, ImageBboxDataLoader, BboxLabelTransform,
+)

@@ -1,0 +1,220 @@
+"""Joint image+bbox augmentation blocks for detection.
+
+Parity target: ``python/mxnet/gluon/contrib/data/vision/transforms/
+bbox/bbox.py`` (ImageBboxRandomFlipLeftRight ``bbox.py:34``,
+ImageBboxCrop ``bbox.py:90``, ImageBboxRandomCropWithConstraints
+``bbox.py:146``, ImageBboxRandomExpand ``bbox.py:216``,
+ImageBboxResize ``bbox.py:297``).
+
+All blocks take and return an ``(image, bbox)`` pair. Boxes are
+``(N, 4+)`` host numpy arrays in corner pixel format
+``[xmin, ymin, xmax, ymax, ...extra columns preserved...]``.
+Augmentation is host-side by design — it runs in DataLoader workers
+ahead of the device (SURVEY.md §3.5); the TPU never sees ragged
+shapes.
+"""
+from __future__ import annotations
+
+import random
+
+import numpy as onp
+
+from ....block import Block
+
+__all__ = ["ImageBboxTransform", "ImageBboxRandomFlipLeftRight",
+           "ImageBboxCrop", "ImageBboxRandomCropWithConstraints",
+           "ImageBboxRandomExpand", "ImageBboxResize"]
+
+
+def _img_np(img):
+    return img.asnumpy() if hasattr(img, "asnumpy") else onp.asarray(img)
+
+
+def _bbox_np(bbox):
+    b = bbox.asnumpy() if hasattr(bbox, "asnumpy") else onp.asarray(bbox)
+    return b.astype("float32", copy=True)
+
+
+def _wrap(img_np):
+    from .... import data as _  # noqa: F401  (package anchor)
+    from .....numpy import array
+    return array(img_np)
+
+
+class ImageBboxTransform(Block):
+    """Base: a Block whose forward takes (img, bbox) and returns the
+    augmented pair. Subclasses implement ``apply(img_np, bbox_np)``
+    over host numpy."""
+
+    def forward(self, img, bbox):
+        img_np, bbox_np = _img_np(img), _bbox_np(bbox)
+        out_img, out_bbox = self.apply(img_np, bbox_np)
+        from .....numpy import array
+        return array(out_img), array(out_bbox)
+
+    def apply(self, img, bbox):
+        raise NotImplementedError
+
+
+def bbox_crop(bbox, crop_box, allow_outside_center=True):
+    """Clip boxes to ``crop_box=(x, y, w, h)`` and translate; boxes
+    whose center falls outside are dropped when
+    ``allow_outside_center=False``. Returns (bbox, keep_mask)."""
+    x0, y0, w, h = crop_box
+    out = bbox.copy()
+    out[:, [0, 2]] = out[:, [0, 2]].clip(x0, x0 + w) - x0
+    out[:, [1, 3]] = out[:, [1, 3]].clip(y0, y0 + h) - y0
+    keep = (out[:, 2] > out[:, 0]) & (out[:, 3] > out[:, 1])
+    if not allow_outside_center:
+        cx = (bbox[:, 0] + bbox[:, 2]) / 2
+        cy = (bbox[:, 1] + bbox[:, 3]) / 2
+        keep &= ((cx >= x0) & (cx < x0 + w) & (cy >= y0) & (cy < y0 + h))
+    return out[keep], keep
+
+
+class ImageBboxRandomFlipLeftRight(ImageBboxTransform):
+    """Mirror image and boxes horizontally with probability ``p``."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self._p = float(p)
+
+    def apply(self, img, bbox):
+        if random.random() >= self._p:
+            return img, bbox
+        w = img.shape[1]
+        img = img[:, ::-1].copy()
+        xmin = w - bbox[:, 2]
+        xmax = w - bbox[:, 0]
+        bbox[:, 0], bbox[:, 2] = xmin, xmax
+        return img, bbox
+
+
+class ImageBboxCrop(ImageBboxTransform):
+    """Deterministic crop to ``crop=(x, y, w, h)``; boxes are clipped
+    and re-origined, degenerate ones dropped."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        super().__init__()
+        self._crop = tuple(int(c) for c in crop)
+        self._allow = bool(allow_outside_center)
+
+    def apply(self, img, bbox):
+        x0, y0, w, h = self._crop
+        img = img[y0:y0 + h, x0:x0 + w].copy()
+        bbox, _ = bbox_crop(bbox, self._crop, self._allow)
+        return img, bbox
+
+
+class ImageBboxRandomCropWithConstraints(ImageBboxTransform):
+    """IoU-constrained random crop (SSD-style sampling).
+
+    Tries up to ``max_trial`` random windows with scale in
+    ``[min_scale, max_scale]`` and aspect ratio within
+    ``1/max_aspect_ratio..max_aspect_ratio``; accepts the first whose
+    min-IoU with any box exceeds a randomly drawn constraint. Falls
+    back to the unmodified input.
+    """
+
+    def __init__(self, p=0.5, min_scale=0.3, max_scale=1.0,
+                 max_aspect_ratio=2.0, constraints=None, max_trial=50):
+        super().__init__()
+        self._p = float(p)
+        self._min_scale, self._max_scale = float(min_scale), float(max_scale)
+        self._max_ar = float(max_aspect_ratio)
+        self._constraints = constraints or (
+            (0.1, None), (0.3, None), (0.5, None), (0.7, None),
+            (0.9, None), (None, 1.0))
+        self._max_trial = int(max_trial)
+
+    @staticmethod
+    def _iou(bbox, crop):
+        x0, y0, w, h = crop
+        x1, y1 = x0 + w, y0 + h
+        ix0 = onp.maximum(bbox[:, 0], x0)
+        iy0 = onp.maximum(bbox[:, 1], y0)
+        ix1 = onp.minimum(bbox[:, 2], x1)
+        iy1 = onp.minimum(bbox[:, 3], y1)
+        inter = (onp.clip(ix1 - ix0, 0, None)
+                 * onp.clip(iy1 - iy0, 0, None))
+        area_b = ((bbox[:, 2] - bbox[:, 0])
+                  * (bbox[:, 3] - bbox[:, 1]))
+        area_c = w * h
+        union = area_b + area_c - inter
+        return inter / onp.maximum(union, 1e-12)
+
+    def apply(self, img, bbox):
+        if random.random() >= self._p or len(bbox) == 0:
+            return img, bbox
+        H, W = img.shape[:2]
+        min_iou, max_iou = random.choice(self._constraints)
+        min_iou = -1 if min_iou is None else min_iou
+        max_iou = 2 if max_iou is None else max_iou
+        for _ in range(self._max_trial):
+            scale = random.uniform(self._min_scale, self._max_scale)
+            ar = random.uniform(
+                max(1 / self._max_ar, scale * scale),
+                min(self._max_ar, 1 / (scale * scale)))
+            w = int(W * scale * onp.sqrt(ar))
+            h = int(H * scale / onp.sqrt(ar))
+            if w < 1 or h < 1 or w > W or h > H:
+                continue
+            x0 = random.randint(0, W - w)
+            y0 = random.randint(0, H - h)
+            iou = self._iou(bbox, (x0, y0, w, h))
+            if iou.min() >= min_iou and iou.max() <= max_iou:
+                new_bbox, keep = bbox_crop(
+                    bbox, (x0, y0, w, h), allow_outside_center=False)
+                if len(new_bbox) == 0:
+                    continue
+                return img[y0:y0 + h, x0:x0 + w].copy(), new_bbox
+        return img, bbox
+
+
+class ImageBboxRandomExpand(ImageBboxTransform):
+    """Place the image at a random offset on a larger ``fill``-valued
+    canvas (up to ``max_ratio``×) and translate boxes with it."""
+
+    def __init__(self, p=0.5, max_ratio=4.0, fill=0, keep_ratio=True):
+        super().__init__()
+        self._p = float(p)
+        self._max_ratio = float(max_ratio)
+        self._fill = fill
+        self._keep_ratio = bool(keep_ratio)
+
+    def apply(self, img, bbox):
+        if random.random() >= self._p or self._max_ratio <= 1:
+            return img, bbox
+        H, W = img.shape[:2]
+        rx = random.uniform(1, self._max_ratio)
+        ry = rx if self._keep_ratio else random.uniform(1, self._max_ratio)
+        new_w, new_h = int(W * rx), int(H * ry)
+        ox = random.randint(0, new_w - W)
+        oy = random.randint(0, new_h - H)
+        canvas = onp.empty((new_h, new_w) + img.shape[2:], dtype=img.dtype)
+        fill = onp.asarray(self._fill, dtype=img.dtype)
+        canvas[...] = fill
+        canvas[oy:oy + H, ox:ox + W] = img
+        bbox[:, [0, 2]] += ox
+        bbox[:, [1, 3]] += oy
+        return canvas, bbox
+
+
+class ImageBboxResize(ImageBboxTransform):
+    """Force-resize to (width, height), scaling boxes to match."""
+
+    def __init__(self, width, height, interp=1):
+        super().__init__()
+        self._size = (int(width), int(height))
+        self._interp = interp
+
+    def apply(self, img, bbox):
+        from .....image import imresize
+        H, W = img.shape[:2]
+        out = _img_np(imresize(_wrap(img), self._size[0], self._size[1],
+                               interp=self._interp))
+        sx = self._size[0] / float(W)
+        sy = self._size[1] / float(H)
+        bbox[:, [0, 2]] *= sx
+        bbox[:, [1, 3]] *= sy
+        return out, bbox

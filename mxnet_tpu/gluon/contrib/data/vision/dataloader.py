@@ -1,0 +1,268 @@
+"""Augmenting image/bbox data loaders.
+
+Parity target: ``python/mxnet/gluon/contrib/data/vision/dataloader.py``
+(``create_image_augment`` ``dataloader.py:34``, ``ImageDataLoader``
+``dataloader.py:140``, ``create_bbox_augment`` ``dataloader.py:246``,
+``ImageBboxDataLoader`` ``dataloader.py:364``, ``BboxLabelTransform``
+``dataloader.py:474``).
+
+TPU-first shape discipline: augmentation happens host-side in loader
+workers; classification batches come out dense ``(N, H, W, C)``-style
+tensors, and detection labels are padded to ``max_boxes`` rows of
+``[cls, xmin, ymin, xmax, ymax]`` with -1 padding so every batch has a
+static shape the compiler can cache on.
+"""
+from __future__ import annotations
+
+import logging
+
+import numpy as onp
+
+from ....block import Block
+from ....nn.basic_layers import Sequential, HybridSequential
+from ....data.dataloader import DataLoader
+from ....data.vision import transforms
+from ....data.vision.datasets import (ImageRecordDataset,
+                                      ImageListDataset)
+from . import bbox as _bbox
+from .bbox import ImageBboxTransform
+
+__all__ = ["create_image_augment", "ImageDataLoader",
+           "create_bbox_augment", "ImageBboxDataLoader",
+           "BboxLabelTransform"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False, mean=None,
+                         std=None, brightness=0, contrast=0, saturation=0,
+                         hue=0, pca_noise=0, rand_gray=0, inter_method=2,
+                         dtype="float32"):
+    """Compose a classification augmentation pipeline from the gluon
+    transform zoo. ``data_shape`` is (C, H, W) like the reference."""
+    if inter_method == 10:
+        inter_method = int(onp.random.randint(0, 5))
+    aug = Sequential()
+    if resize > 0:
+        aug.add(transforms.Resize(resize, interpolation=inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        if not rand_crop:
+            raise ValueError("rand_resize requires rand_crop")
+        aug.add(transforms.RandomResizedCrop(crop_size,
+                                             interpolation=inter_method))
+    elif rand_crop:
+        aug.add(transforms.RandomCrop(crop_size,
+                                      interpolation=inter_method))
+    else:
+        aug.add(transforms.CenterCrop(crop_size,
+                                      interpolation=inter_method))
+    if rand_mirror:
+        aug.add(transforms.RandomFlipLeftRight(0.5))
+    aug.add(transforms.Cast())
+    if brightness or contrast or saturation or hue:
+        aug.add(transforms.RandomColorJitter(brightness, contrast,
+                                             saturation, hue))
+    if pca_noise > 0:
+        aug.add(transforms.RandomLighting(pca_noise))
+    if rand_gray > 0:
+        aug.add(transforms.RandomGray(rand_gray))
+    if mean is True:
+        mean = [123.68, 116.28, 103.53]
+    if std is True:
+        std = [58.395, 57.12, 57.375]
+    aug.add(transforms.ToTensor())
+    if mean is not None or std is not None:
+        aug.add(transforms.Normalize(mean if mean is not None else 0.0,
+                                     std if std is not None else 1.0))
+    aug.add(transforms.Cast(dtype))
+    return aug
+
+
+def _build_augmenter(aug_list, default_fn, data_shape, kwargs):
+    if aug_list is None:
+        return default_fn(data_shape, **kwargs)
+    if isinstance(aug_list, (list, tuple)):
+        seq = Sequential()
+        for a in aug_list:
+            seq.add(a)
+        return seq
+    if isinstance(aug_list, Block):
+        return aug_list
+    raise ValueError("aug_list must be a Block or a list of Blocks")
+
+
+def _make_dataset(path_imgrec, path_imglist, path_root, imglist):
+    if path_imgrec:
+        logging.info("loading recordio %s...", path_imgrec)
+        return ImageRecordDataset(path_imgrec, flag=1)
+    if path_imglist:
+        logging.info("loading image list %s...", path_imglist)
+        return ImageListDataset(path_root, path_imglist, flag=1)
+    if isinstance(imglist, list):
+        return ImageListDataset(path_root, imglist, flag=1)
+    raise ValueError(
+        "one of path_imgrec, path_imglist, imglist is required")
+
+
+class ImageDataLoader:
+    """Classification image loader with the reference's augmentation
+    knobs (parity: ``dataloader.py:140``). Wraps Dataset →
+    transform_first(augmenter) → DataLoader."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 dtype="float32", shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, **kwargs):
+        dataset = _make_dataset(path_imgrec, path_imglist, path_root,
+                                imglist)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        augmenter = _build_augmenter(aug_list, create_image_augment,
+                                     data_shape, {**kwargs,
+                                                  "dtype": dtype})
+        self._iter = DataLoader(dataset.transform_first(augmenter),
+                                batch_size=batch_size, shuffle=shuffle,
+                                sampler=sampler, last_batch=last_batch,
+                                batch_sampler=batch_sampler,
+                                batchify_fn=batchify_fn,
+                                num_workers=num_workers)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0, rand_gray=0,
+                        rand_mirror=False, mean=None, std=None,
+                        brightness=0, contrast=0, saturation=0,
+                        pca_noise=0, hue=0, inter_method=2,
+                        max_aspect_ratio=2, area_range=(0.3, 3.0),
+                        max_attempts=50, pad_val=(127, 127, 127),
+                        dtype="float32"):
+    """Compose a detection augmentation pipeline over (img, bbox)
+    pairs (parity: ``dataloader.py:246``)."""
+    if inter_method == 10:
+        inter_method = int(onp.random.randint(0, 5))
+    aug = Sequential()
+    if rand_crop > 0:
+        aug.add(_bbox.ImageBboxRandomCropWithConstraints(
+            p=rand_crop, min_scale=area_range[0], max_scale=1.0,
+            max_aspect_ratio=max_aspect_ratio, max_trial=max_attempts))
+    if rand_mirror:
+        aug.add(_bbox.ImageBboxRandomFlipLeftRight(0.5))
+    if rand_pad > 0:
+        aug.add(_bbox.ImageBboxRandomExpand(
+            p=rand_pad, max_ratio=area_range[1], fill=pad_val))
+    aug.add(_bbox.ImageBboxResize(data_shape[2], data_shape[1],
+                                  interp=inter_method))
+    if brightness or contrast or saturation or hue:
+        aug.add(transforms.RandomColorJitter(brightness, contrast,
+                                             saturation, hue))
+    if pca_noise > 0:
+        aug.add(transforms.RandomLighting(pca_noise))
+    if rand_gray > 0:
+        aug.add(transforms.RandomGray(rand_gray))
+    if mean is True:
+        mean = [123.68, 116.28, 103.53]
+    if std is True:
+        std = [58.395, 57.12, 57.375]
+    aug.add(transforms.ToTensor())
+    if mean is not None or std is not None:
+        aug.add(transforms.Normalize(mean if mean is not None else 0.0,
+                                     std if std is not None else 1.0))
+    aug.add(transforms.Cast(dtype))
+    return aug
+
+
+class BboxLabelTransform(Block):
+    """Normalize a raw detection label into ``(max_boxes, 5)`` rows of
+    ``[cls, xmin, ymin, xmax, ymax]``, padded with -1 (parity:
+    ``dataloader.py:474``; the static ``max_boxes`` padding is the
+    TPU-first addition that keeps batch shapes compile-stable)."""
+
+    def __init__(self, max_boxes=64):
+        super().__init__()
+        self._max_boxes = int(max_boxes)
+
+    def forward(self, label):
+        lab = label.asnumpy() if hasattr(label, "asnumpy") \
+            else onp.asarray(label)
+        lab = lab.reshape(-1, lab.shape[-1]) if lab.ndim > 1 \
+            else lab.reshape(-1, 5)
+        out = onp.full((self._max_boxes, lab.shape[-1]), -1.0,
+                       dtype="float32")
+        n = min(len(lab), self._max_boxes)
+        out[:n] = lab[:n]
+        from .....numpy import array
+        return array(out)
+
+
+class _BboxPairTransform:
+    """Apply an augmenter over (img, label) samples: bbox-aware blocks
+    get the (img, bbox) pair, plain image transforms get the image
+    only. Labels arrive as (N, 5+) rows [cls, x0, y0, x1, y1, ...]."""
+
+    def __init__(self, augmenter, max_boxes):
+        self._aug = augmenter
+        self._max = int(max_boxes)
+
+    def __call__(self, img, label):
+        lab = label.asnumpy() if hasattr(label, "asnumpy") \
+            else onp.asarray(label)
+        lab = onp.atleast_2d(lab).astype("float32")
+        cls_col, boxes = lab[:, :1], lab[:, 1:5]
+
+        blocks = [self._aug]
+        if isinstance(self._aug, (Sequential, HybridSequential)):
+            blocks = list(self._aug._children.values())
+        from .....numpy import array
+        bbox_nd = array(onp.concatenate([boxes, cls_col], axis=1))
+        for blk in blocks:
+            if isinstance(blk, ImageBboxTransform):
+                img, bbox_nd = blk(img, bbox_nd)
+            else:
+                img = blk(img)
+
+        out_np = bbox_nd.asnumpy()
+        packed = onp.concatenate([out_np[:, -1:], out_np[:, :4]], axis=1)
+        padded = onp.full((self._max, 5), -1.0, dtype="float32")
+        n = min(len(packed), self._max)
+        padded[:n] = packed[:n]
+        return img, array(padded)
+
+
+class ImageBboxDataLoader:
+    """Detection loader yielding (data, label) batches with augmented
+    images and -1-padded ``(batch, max_boxes, 5)`` labels (parity:
+    ``dataloader.py:364``)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", part_index=0,
+                 num_parts=1, aug_list=None, imglist=None,
+                 coord_normalized=False, dtype="float32", shuffle=False,
+                 sampler=None, last_batch=None, batch_sampler=None,
+                 batchify_fn=None, num_workers=0, max_boxes=64, **kwargs):
+        dataset = _make_dataset(path_imgrec, path_imglist, path_root,
+                                imglist)
+        if num_parts > 1:
+            dataset = dataset.shard(num_parts, part_index)
+        augmenter = _build_augmenter(aug_list, create_bbox_augment,
+                                     data_shape, {**kwargs,
+                                                  "dtype": dtype})
+        pair = _BboxPairTransform(augmenter, max_boxes)
+        self._iter = DataLoader(dataset.transform(pair),
+                                batch_size=batch_size, shuffle=shuffle,
+                                sampler=sampler, last_batch=last_batch,
+                                batch_sampler=batch_sampler,
+                                batchify_fn=batchify_fn,
+                                num_workers=num_workers)
+
+    def __iter__(self):
+        return iter(self._iter)
+
+    def __len__(self):
+        return len(self._iter)

@@ -1,0 +1,4 @@
+"""Estimator fit-loop (parity: python/mxnet/gluon/contrib/estimator)."""
+from .estimator import *  # noqa: F401,F403
+from .event_handler import *  # noqa: F401,F403
+from .batch_processor import *  # noqa: F401,F403

@@ -1,5 +1,6 @@
 from .datasets import (  # noqa: F401
     MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageRecordDataset,
+    ImageListDataset,
     ImageFolderDataset,
 )
 from . import transforms  # noqa: F401

@@ -205,3 +205,51 @@ class ImageFolderDataset(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+class ImageListDataset(Dataset):
+    """Images referenced by a .lst file or an in-memory list (parity:
+    gluon.data.vision.ImageListDataset, used by
+    gluon.contrib.data.vision.ImageDataLoader).
+
+    List entries are ``[label(s), relative_path]``; a ``.lst`` file is
+    the im2rec tab-separated format ``index\\tlabel...\\trelpath``
+    (tools/im2rec.py writes it).
+    """
+
+    def __init__(self, root=".", imglist=None, flag=1, transform=None):
+        import numpy as onp
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self.items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = [float(v) for v in parts[1:-1]]
+                    self.items.append((parts[-1], label))
+        elif isinstance(imglist, (list, tuple)):
+            for entry in imglist:
+                label, path = entry[0], entry[1]
+                if not isinstance(label, (list, tuple)):
+                    label = [float(label)]
+                self.items.append((path, list(map(float, label))))
+        else:
+            raise ValueError("imglist must be a .lst path or a list of "
+                             "[label, path] entries")
+        self._np = onp
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self.items[idx]
+        img = imread(os.path.join(self._root, path), self._flag)
+        label = self._np.asarray(label, dtype="float32")
+        label = label[0] if label.size == 1 else label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,133 @@
+"""gluon.contrib.data.vision: augmenting loaders + bbox transforms.
+
+Reference parity: python/mxnet/gluon/contrib/data/vision/dataloader.py
+(create_image_augment:34, ImageDataLoader:140, create_bbox_augment:246,
+ImageBboxDataLoader:364) and transforms/bbox/bbox.py.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib.data.vision import (
+    create_image_augment, ImageDataLoader, create_bbox_augment,
+    ImageBboxDataLoader, BboxLabelTransform, bbox as bbox_mod)
+
+PIL = pytest.importorskip("PIL")
+
+
+@pytest.fixture()
+def image_folder(tmp_path):
+    from PIL import Image
+    rng = onp.random.RandomState(0)
+    entries = []
+    for i in range(6):
+        arr = rng.randint(0, 255, size=(40 + i, 50, 3), dtype="uint8")
+        p = tmp_path / f"img{i}.png"
+        Image.fromarray(arr).save(p)
+        entries.append([float(i % 3), f"img{i}.png"])
+    return str(tmp_path), entries
+
+
+def test_image_dataloader_shapes(image_folder):
+    root, entries = image_folder
+    loader = ImageDataLoader(batch_size=3, data_shape=(3, 32, 32),
+                             imglist=entries, path_root=root,
+                             rand_mirror=True, mean=True, std=True)
+    batches = list(loader)
+    assert len(batches) == 2
+    data, label = batches[0]
+    # ToTensor produces CHW float
+    assert tuple(data.shape) == (3, 3, 32, 32)
+    assert str(data.dtype) == "float32"
+    assert tuple(label.shape) == (3,)
+
+
+def test_create_image_augment_pipeline_runs():
+    aug = create_image_augment((3, 24, 24), resize=28, rand_crop=True,
+                               rand_resize=True, brightness=0.2,
+                               contrast=0.2, saturation=0.2,
+                               rand_gray=0.5, pca_noise=0.1, mean=True,
+                               std=True)
+    img = mx.np.array(
+        onp.random.randint(0, 255, (32, 30, 3)).astype("uint8"))
+    out = aug(img)
+    assert tuple(out.shape) == (3, 24, 24)
+
+
+def test_bbox_flip_and_resize():
+    img = onp.zeros((40, 60, 3), dtype="uint8")
+    boxes = onp.array([[10.0, 5.0, 30.0, 25.0, 1.0]], dtype="float32")
+    t = bbox_mod.ImageBboxRandomFlipLeftRight(p=1.0)
+    im2, bb2 = t(mx.np.array(img), mx.np.array(boxes))
+    got = bb2.asnumpy()
+    onp.testing.assert_allclose(got[0, :4], [30, 5, 50, 25])
+
+    r = bbox_mod.ImageBboxResize(width=120, height=20)
+    im3, bb3 = r(im2, bb2)
+    assert tuple(im3.shape)[:2] == (20, 120)
+    onp.testing.assert_allclose(bb3.asnumpy()[0, :4],
+                                [60, 2.5, 100, 12.5])
+
+
+def test_bbox_crop_drops_and_translates():
+    img = onp.zeros((50, 50, 3), dtype="uint8")
+    boxes = onp.array([[5.0, 5.0, 15.0, 15.0, 0.0],
+                       [40.0, 40.0, 49.0, 49.0, 1.0]], dtype="float32")
+    t = bbox_mod.ImageBboxCrop((0, 0, 20, 20))
+    im2, bb2 = t(mx.np.array(img), mx.np.array(boxes))
+    got = bb2.asnumpy()
+    assert got.shape[0] == 1  # far box dropped
+    onp.testing.assert_allclose(got[0, :4], [5, 5, 15, 15])
+    assert tuple(im2.shape)[:2] == (20, 20)
+
+
+def test_bbox_expand_offsets_boxes():
+    img = onp.full((10, 10, 3), 9, dtype="uint8")
+    boxes = onp.array([[2.0, 3.0, 6.0, 8.0, 0.0]], dtype="float32")
+    t = bbox_mod.ImageBboxRandomExpand(p=1.0, max_ratio=3.0, fill=7)
+    im2, bb2 = t(mx.np.array(img), mx.np.array(boxes))
+    H, W = im2.shape[:2]
+    assert H >= 10 and W >= 10
+    b = bb2.asnumpy()[0]
+    assert 0 <= b[0] <= W - 4 and b[2] - b[0] == pytest.approx(4.0)
+    # fill value applied outside the pasted region (if expanded)
+    if H > 10:
+        assert int(im2.asnumpy()[H - 1, W - 1, 0]) in (7, 9)
+
+
+def test_bbox_random_crop_with_constraints_keeps_box():
+    rng = onp.random.RandomState(3)
+    img = rng.randint(0, 255, (60, 60, 3)).astype("uint8")
+    boxes = onp.array([[20.0, 20.0, 40.0, 40.0, 2.0]], dtype="float32")
+    t = bbox_mod.ImageBboxRandomCropWithConstraints(p=1.0, max_trial=20)
+    im2, bb2 = t(mx.np.array(img), mx.np.array(boxes))
+    assert bb2.shape[0] >= 1
+    b = bb2.asnumpy()
+    assert (b[:, 2] > b[:, 0]).all() and (b[:, 3] > b[:, 1]).all()
+
+
+def test_bbox_label_transform_pads():
+    t = BboxLabelTransform(max_boxes=4)
+    out = t(mx.np.array([[1.0, 0, 0, 5, 5], [2.0, 1, 1, 6, 6]]))
+    got = out.asnumpy()
+    assert got.shape == (4, 5)
+    assert (got[2:] == -1).all()
+
+
+def test_image_bbox_dataloader_batches(image_folder):
+    root, entries = image_folder
+    # detection labels: each sample gets [cls, x0, y0, x1, y1]
+    det_entries = [[[e[0], 5.0, 5.0, 25.0, 25.0], e[1]] for e in entries]
+    # flatten label rows: loader expects label as flat list per image
+    imglist = [[lab, p] for lab, p in det_entries]
+    loader = ImageBboxDataLoader(batch_size=2, data_shape=(3, 32, 32),
+                                 imglist=imglist, path_root=root,
+                                 rand_crop=0.5, rand_pad=0.5,
+                                 rand_mirror=True, max_boxes=8)
+    data, label = next(iter(loader))
+    assert tuple(data.shape) == (2, 3, 32, 32)
+    assert tuple(label.shape) == (2, 8, 5)
+    lab = label.asnumpy()
+    # first row of each sample is a real box, padding is -1
+    assert (lab[:, 0, 0] >= 0).all()
+    assert (lab[:, -1] == -1).all()

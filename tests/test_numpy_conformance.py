@@ -1,0 +1,227 @@
+"""NumPy-semantics conformance sweep: every case runs the same call on
+mx.np and on real numpy and compares values/shapes/dtype-kind.
+
+Parity model: tests/python/unittest/test_numpy_interoperability.py —
+the reference validates its numpy namespace by running NumPy's own
+semantics through it; this file is the same idea as a data-driven
+sweep (~150 call forms over ~120 functions).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+
+RNG = onp.random.RandomState(42)
+
+A = RNG.uniform(0.5, 2.0, (3, 4)).astype("float32")
+B = RNG.uniform(0.5, 2.0, (3, 4)).astype("float32")
+V = RNG.uniform(-1.0, 1.0, (6,)).astype("float32")
+M = RNG.uniform(0.1, 1.0, (4, 4)).astype("float32")
+I3 = onp.array([[2, 0, 1], [1, 2, 0]], dtype="int64")
+BOOLS = onp.array([[True, False, True], [False, True, True]])
+
+# (function path, args, kwargs). Functions resolve on both namespaces;
+# args that are onp arrays are converted to mx arrays on the mx side.
+CASES = [
+    # --- elementwise unary ---
+    ("abs", (V,), {}), ("absolute", (V,), {}), ("negative", (V,), {}),
+    ("sign", (V,), {}), ("exp", (V,), {}), ("expm1", (V,), {}),
+    ("log", (A,), {}), ("log2", (A,), {}), ("log10", (A,), {}),
+    ("log1p", (A,), {}), ("sqrt", (A,), {}), ("cbrt", (A,), {}),
+    ("square", (A,), {}), ("reciprocal", (A,), {}),
+    ("sin", (V,), {}), ("cos", (V,), {}), ("tan", (V,), {}),
+    ("arcsin", (V,), {}), ("arccos", (V,), {}), ("arctan", (V,), {}),
+    ("sinh", (V,), {}), ("cosh", (V,), {}), ("tanh", (V,), {}),
+    ("arcsinh", (V,), {}), ("arccosh", (A + 1,), {}),
+    ("arctanh", (V * 0.9,), {}),
+    ("floor", (V * 3,), {}), ("ceil", (V * 3,), {}),
+    ("trunc", (V * 3,), {}), ("rint", (V * 3,), {}),
+    ("degrees", (V,), {}), ("radians", (V,), {}),
+    ("isnan", (V,), {}), ("isinf", (V,), {}), ("isfinite", (V,), {}),
+    # --- binary ---
+    ("add", (A, B), {}), ("subtract", (A, B), {}),
+    ("multiply", (A, B), {}), ("divide", (A, B), {}),
+    ("true_divide", (A, B), {}), ("floor_divide", (A, B), {}),
+    ("mod", (A, B), {}), ("remainder", (A, B), {}),
+    ("fmod", (A, B), {}), ("power", (A, B), {}),
+    ("float_power", (A, B), {}), ("maximum", (A, B), {}),
+    ("minimum", (A, B), {}), ("fmax", (A, B), {}),
+    ("fmin", (A, B), {}), ("hypot", (A, B), {}),
+    ("arctan2", (V, V[::-1].copy()), {}), ("copysign", (A, B - 1), {}),
+    ("logaddexp", (A, B), {}), ("heaviside", (V, V[::-1].copy()), {}),
+    ("gcd", (onp.array([12, 18, 7]), onp.array([8, 27, 14])), {}),
+    ("lcm", (onp.array([4, 6, 7]), onp.array([6, 8, 3])), {}),
+    # --- comparison / logic ---
+    ("equal", (A, B), {}), ("not_equal", (A, B), {}),
+    ("greater", (A, B), {}), ("greater_equal", (A, B), {}),
+    ("less", (A, B), {}), ("less_equal", (A, B), {}),
+    ("logical_and", (BOOLS, ~BOOLS), {}),
+    ("logical_or", (BOOLS, ~BOOLS), {}),
+    ("logical_xor", (BOOLS, ~BOOLS), {}),
+    ("logical_not", (BOOLS,), {}),
+    ("allclose", (A, A), {}), ("array_equal", (A, A), {}),
+    ("isclose", (A, A + 1e-9), {}),
+    # --- reductions ---
+    ("sum", (A,), {}), ("sum", (A,), {"axis": 1}),
+    ("sum", (A,), {"axis": 0, "keepdims": True}),
+    ("mean", (A,), {"axis": 1}), ("prod", (A,), {"axis": 0}),
+    ("max", (A,), {"axis": 1}), ("min", (A,), {"axis": 0}),
+    ("amax", (A,), {}), ("amin", (A,), {}),
+    ("argmax", (A,), {"axis": 1}), ("argmin", (A,), {"axis": 0}),
+    ("std", (A,), {"axis": 1}), ("var", (A,), {"axis": 0}),
+    ("ptp", (A,), {"axis": 1}),
+    ("median", (A,), {"axis": 1}), ("average", (A,), {"axis": 0}),
+    ("quantile", (A, 0.25), {"axis": 1}),
+    ("percentile", (A, 75), {"axis": 0}),
+    ("nansum", (V,), {}), ("nanmean", (A,), {}),
+    ("nanmax", (A,), {}), ("nanmin", (A,), {}), ("nanprod", (A,), {}),
+    ("nanstd", (A,), {}), ("nanvar", (A,), {}),
+    ("all", (BOOLS,), {"axis": 1}), ("any", (BOOLS,), {"axis": 0}),
+    ("count_nonzero", (BOOLS,), {"axis": 1}),
+    ("cumsum", (A,), {"axis": 1}), ("cumprod", (A,), {"axis": 0}),
+    # --- shape manipulation ---
+    ("reshape", (A, (4, 3)), {}), ("ravel", (A,), {}),
+    ("transpose", (A,), {}), ("swapaxes", (A, 0, 1), {}),
+    ("moveaxis", (A, 0, 1), {}), ("expand_dims", (A, 1), {}),
+    ("squeeze", (A[None],), {}), ("flip", (A,), {"axis": 1}),
+    ("fliplr", (A,), {}), ("flipud", (A,), {}),
+    ("roll", (A, 2), {"axis": 1}), ("rot90", (A,), {}),
+    ("tile", (A, (2, 1)), {}), ("repeat", (A, 2), {"axis": 1}),
+    ("concatenate", ([A, B],), {"axis": 0}),
+    ("stack", ([A, B],), {"axis": 1}),
+    ("vstack", ([A, B],), {}), ("hstack", ([A, B],), {}),
+    ("dstack", ([A, B],), {}), ("column_stack", ([V, V],), {}),
+    ("split", (A, 2), {"axis": 1}), ("array_split", (A, 2), {"axis": 0}),
+    ("hsplit", (A, 2), {}), ("vsplit", (M, 2), {}),
+    ("broadcast_to", (V[:4], (3, 4)), {}),
+    ("atleast_1d", (onp.float32(3.0),), {}),
+    ("atleast_2d", (V,), {}), ("atleast_3d", (A,), {}),
+    ("tril", (M,), {}), ("triu", (M,), {}),
+    ("diag", (M,), {}), ("diagonal", (M,), {}), ("diagflat", (V[:3],), {}),
+    ("trace", (M,), {}),
+    # --- indexing / search / sort ---
+    ("where", (BOOLS, 1.0, 0.0), {}),
+    ("take", (V, onp.array([0, 3, 5])), {}),
+    ("take_along_axis", (A.astype("float32"),
+                         onp.argsort(A, axis=1), 1), {}),
+    ("clip", (A, 0.8, 1.5), {}),
+    ("sort", (A,), {"axis": 1}), ("argsort", (A,), {"axis": 1}),
+    ("searchsorted", (onp.sort(V), 0.0), {}),
+    ("unique", (onp.array([1, 2, 2, 3, 3, 3]),), {}),
+    ("nonzero", (BOOLS,), {}), ("flatnonzero", (BOOLS,), {}),
+    ("unravel_index", (onp.array([5, 7]), (3, 4)), {}),
+    ("ravel_multi_index", (I3, (3, 3)), {}),
+    # --- linear algebra ---
+    ("dot", (A, A.T), {}), ("matmul", (A, A.T), {}),
+    ("inner", (V, V), {}), ("outer", (V, V), {}),
+    ("vdot", (V, V), {}), ("cross", (V[:3], V[3:]), {}),
+    ("kron", (V[:2], V[2:4]), {}),
+    ("tensordot", (A, B.T), {"axes": 1}),
+    ("einsum", ("ij,kj->ik", A, B), {}),
+    ("linalg.norm", (A,), {}), ("linalg.det", (M,), {}),
+    ("linalg.slogdet", (M,), {}),
+    ("linalg.matrix_rank", (M,), {}),
+    ("linalg.multi_dot", ([M, M, M],), {}),
+    ("linalg.matrix_power", (M, 3), {}),
+    # --- construction ---
+    ("zeros", ((2, 3),), {}), ("ones", ((2, 3),), {}),
+    ("full", ((2, 2), 7.0), {}), ("eye", (3,), {}),
+    ("identity", (4,), {}), ("arange", (10,), {}),
+    ("linspace", (0.0, 1.0, 7), {}), ("logspace", (0.0, 2.0, 5), {}),
+    ("geomspace", (1.0, 8.0, 4), {}),
+    ("meshgrid", (V[:3], V[:2]), {}),
+    ("tri", (3, 4), {}), ("vander", (V[:4],), {}),
+    ("zeros_like", (A,), {}), ("ones_like", (A,), {}),
+    ("full_like", (A, 2.5), {}), ("empty_like", (A,), {"_skip_value": 1}),
+    ("copy", (A,), {}),
+    # --- misc math ---
+    ("diff", (V,), {}), ("ediff1d", (V,), {}),
+    ("gradient", (V,), {}), ("trapz", (V,), {}),
+    ("interp", (onp.array([0.5, 1.5]), onp.arange(4.0),
+                onp.arange(4.0) * 2), {}),
+    ("convolve", (V[:4], V[:3]), {}),
+    ("correlate", (V[:4], V[:3]), {}),
+    ("polyval", (onp.array([1.0, -2.0, 3.0]), V), {}),
+    ("round", (A * 10,), {}), ("around", (A * 10, 1), {}),
+    ("fix", (V * 3,), {}), ("nan_to_num", (V,), {}),
+    ("real", (A,), {}), ("imag", (A,), {}), ("conj", (A,), {}),
+    ("angle", (V,), {}), ("i0", (V,), {}), ("sinc", (V,), {}),
+    ("unwrap", (onp.cumsum(onp.abs(V)),), {}),
+    ("bincount", (onp.array([0, 1, 1, 3]),), {}),
+    ("digitize", (V, onp.sort(V)[::2].copy()), {}),
+    ("histogram", (V,), {"bins": 4}),
+    # --- fft ---
+    ("fft.fft", (V,), {}), ("fft.ifft", (V,), {}),
+    ("fft.rfft", (V,), {}), ("fft.fftfreq", (6,), {}),
+    ("fft.fftshift", (V,), {}),
+]
+
+
+def _resolve(ns, path):
+    obj = ns
+    for part in path.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _to_mx(x):
+    if isinstance(x, onp.ndarray):
+        return mnp.array(x)
+    if isinstance(x, (list, tuple)) and x and \
+            all(isinstance(e, onp.ndarray) for e in x):
+        return type(x)(mnp.array(e) for e in x)
+    return x
+
+
+def _as_np(x):
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return x
+
+
+def _compare(got, want, path):
+    if isinstance(want, (tuple, list)):
+        assert len(got) == len(want), \
+            f"{path}: length {len(got)} != {len(want)}"
+        for g, w in zip(got, want):
+            _compare(g, w, path)
+        return
+    g = _as_np(got)
+    w = onp.asarray(want)
+    assert tuple(onp.shape(g)) == tuple(w.shape), \
+        f"{path}: shape {onp.shape(g)} != {w.shape}"
+    if w.dtype.kind in "fc":
+        onp.testing.assert_allclose(
+            onp.asarray(g, dtype=w.dtype), w, rtol=2e-5, atol=2e-5,
+            err_msg=path)
+    else:
+        onp.testing.assert_array_equal(onp.asarray(g), w, err_msg=path)
+
+
+@pytest.mark.parametrize(
+    "path,args,kwargs", [pytest.param(p, a, k, id=f"{p}#{i}")
+                         for i, (p, a, k) in enumerate(CASES)])
+def test_conformance(path, args, kwargs):
+    kwargs = dict(kwargs)
+    skip_value = kwargs.pop("_skip_value", False)
+    np_fn = _resolve(onp, path)
+    mx_fn = _resolve(mnp, path)
+    want = np_fn(*args, **kwargs)
+    got = mx_fn(*[_to_mx(a) for a in args], **kwargs)
+    if skip_value:  # e.g. empty_like: only shape/dtype are defined
+        assert tuple(_as_np(got).shape) == tuple(onp.asarray(want).shape)
+        return
+    _compare(got, want, path)
+
+
+def test_partition_semantics():
+    """numpy only defines partition up to the pivot invariant — check
+    that, not numpy's incidental full ordering."""
+    k = 2
+    got = mnp.partition(mnp.array(V), k).asnumpy()
+    want_kth = onp.sort(V)[k]
+    assert got[k] == pytest.approx(want_kth)
+    assert (got[:k] <= got[k] + 1e-7).all()
+    assert (got[k + 1:] >= got[k] - 1e-7).all()
+    assert onp.allclose(onp.sort(got), onp.sort(V))

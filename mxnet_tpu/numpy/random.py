@@ -224,7 +224,8 @@ def f(dfnum, dfden, size=None, ctx=None):
     n, d = _val(dfnum), _val(dfden)
 
     def sampler(k, s):
-        k1, k2 = jax.random.split(k)
+        ks = jax.random.split(k)
+        k1, k2 = ks[0], ks[1]
         num = 2.0 * jax.random.gamma(k1, n / 2.0, shape=s or None) / n
         den = 2.0 * jax.random.gamma(k2, d / 2.0, shape=s or None) / d
         return num / den
@@ -242,7 +243,8 @@ def negative_binomial(n, p, size=None, ctx=None):
     nv, pv = _val(n), _val(p)
 
     def sampler(k, s):
-        k1, k2 = jax.random.split(k)
+        ks = jax.random.split(k)
+        k1, k2 = ks[0], ks[1]
         lam = jax.random.gamma(k1, nv, shape=s or None) * (1 - pv) / pv
         return jax.random.poisson(k2, lam)
 

@@ -148,12 +148,12 @@ class Optimizer:
     def _hyper(self, index):
         t = self._index_update_count.get(index, self.num_update)
         return {
-            "lr": jnp.float32(self._get_lr(index)),
-            "wd": jnp.float32(self._get_wd(index)),
-            "rescale": jnp.float32(self.rescale_grad),
-            "clip": (jnp.float32(self.clip_gradient)
+            "lr": onp.float32(self._get_lr(index)),
+            "wd": onp.float32(self._get_wd(index)),
+            "rescale": onp.float32(self.rescale_grad),
+            "clip": (onp.float32(self.clip_gradient)
                      if self.clip_gradient is not None else None),
-            "t": jnp.int32(t),
+            "t": onp.int32(t),
         }
 
     @staticmethod
@@ -251,7 +251,7 @@ class SGD(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h["momentum"] = jnp.float32(self.momentum)
+        h["momentum"] = onp.float32(self.momentum)
         return h
 
     @staticmethod
@@ -277,7 +277,7 @@ class NAG(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h["momentum"] = jnp.float32(self.momentum)
+        h["momentum"] = onp.float32(self.momentum)
         return h
 
     @staticmethod
@@ -302,8 +302,8 @@ class Adam(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(beta1=jnp.float32(self.beta1), beta2=jnp.float32(self.beta2),
-                 eps=jnp.float32(self.epsilon))
+        h.update(beta1=onp.float32(self.beta1), beta2=onp.float32(self.beta2),
+                 eps=onp.float32(self.epsilon))
         return h
 
     @staticmethod
@@ -389,8 +389,8 @@ class RMSProp(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(rho=jnp.float32(self.rho), mom=jnp.float32(self.momentum),
-                 eps=jnp.float32(self.epsilon))
+        h.update(rho=onp.float32(self.rho), mom=onp.float32(self.momentum),
+                 eps=onp.float32(self.epsilon))
         return h
 
     @staticmethod
@@ -422,7 +422,7 @@ class AdaGrad(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h["eps"] = jnp.float32(self.epsilon)
+        h["eps"] = onp.float32(self.epsilon)
         return h
 
     @staticmethod
@@ -451,7 +451,7 @@ class AdaDelta(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(rho=jnp.float32(self.rho), eps=jnp.float32(self.epsilon))
+        h.update(rho=onp.float32(self.rho), eps=onp.float32(self.epsilon))
         return h
 
     @staticmethod
@@ -479,7 +479,7 @@ class Ftrl(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(lamda1=jnp.float32(self.lamda1), beta=jnp.float32(self.beta))
+        h.update(lamda1=onp.float32(self.lamda1), beta=onp.float32(self.beta))
         return h
 
     @staticmethod
@@ -512,8 +512,8 @@ class FTML(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(beta1=jnp.float32(self.beta1), beta2=jnp.float32(self.beta2),
-                 eps=jnp.float32(self.epsilon))
+        h.update(beta1=onp.float32(self.beta1), beta2=onp.float32(self.beta2),
+                 eps=onp.float32(self.epsilon))
         return h
 
     @staticmethod
@@ -548,11 +548,11 @@ class LAMB(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(beta1=jnp.float32(self.beta1), beta2=jnp.float32(self.beta2),
-                 eps=jnp.float32(self.epsilon),
-                 lb=jnp.float32(self.lower_bound if self.lower_bound is not None else 0.0),
-                 ub=jnp.float32(self.upper_bound if self.upper_bound is not None else 1e30),
-                 bc=jnp.float32(1.0 if self.bias_correction else 0.0))
+        h.update(beta1=onp.float32(self.beta1), beta2=onp.float32(self.beta2),
+                 eps=onp.float32(self.epsilon),
+                 lb=onp.float32(self.lower_bound if self.lower_bound is not None else 0.0),
+                 ub=onp.float32(self.upper_bound if self.upper_bound is not None else 1e30),
+                 bc=onp.float32(1.0 if self.bias_correction else 0.0))
         return h
 
     @staticmethod
@@ -587,8 +587,8 @@ class LARS(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(mom=jnp.float32(self.momentum), eta=jnp.float32(self.eta),
-                 eps=jnp.float32(self.epsilon))
+        h.update(mom=onp.float32(self.momentum), eta=onp.float32(self.eta),
+                 eps=onp.float32(self.epsilon))
         return h
 
     @staticmethod
@@ -653,7 +653,7 @@ class Signum(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(mom=jnp.float32(self.momentum), wd_lh=jnp.float32(self.wd_lh))
+        h.update(mom=onp.float32(self.momentum), wd_lh=onp.float32(self.wd_lh))
         return h
 
     @staticmethod
@@ -700,7 +700,7 @@ class DCASGD(Optimizer):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h.update(mom=jnp.float32(self.momentum), lamda=jnp.float32(self.lamda))
+        h.update(mom=onp.float32(self.momentum), lamda=onp.float32(self.lamda))
         return h
 
     @staticmethod

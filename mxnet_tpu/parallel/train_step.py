@@ -238,6 +238,8 @@ class TrainStep:
             "data_sh": data_sh,
             "label_sh": label_sh,
             "jit": jax.jit(step_fn, **jit_kwargs),
+            "step_fn": step_fn,
+            "jit_kwargs": jit_kwargs,
             "params": params,
             "diff_idx": diff_idx,
             "diff_nds": diff_nds,
@@ -247,6 +249,151 @@ class TrainStep:
             "label_spec": label_spec,
         }
         return entry
+
+    # -- bulk (scan) path ----------------------------------------------
+    def _build_chain(self, entry):
+        """jit a lax.scan of step_fn over a leading steps axis.
+
+        TPU-native equivalent of the reference engine's bulk mode
+        (`MXNET_EXEC_BULK_EXEC_*`, BulkAppend/BulkFlush in
+        src/engine/threaded_engine.h:507): instead of fusing engine
+        pushes, N whole training steps compile into ONE XLA program —
+        zero per-step host dispatch. BN running stats thread through
+        the scan carry; Adam-style bias-correction counters advance
+        per scanned step. LR schedules are evaluated at launch and
+        held constant across the chain (document-level divergence:
+        schedules step at chain granularity).
+        """
+        step_fn = entry["step_fn"]
+        frozen_nds = entry["frozen_nds"]
+        out_box = entry["out_box"]
+        # aux target positions are resolved AT TRACE TIME inside the
+        # scan body: out_box["aux_targets"] is only populated when
+        # step_fn is first traced, which for a fresh entry happens
+        # during this very chain trace
+        aux_pos_box = {}
+
+        def _aux_positions():
+            if "pos" not in aux_pos_box:
+                frozen_ids = [id(nd) for nd in frozen_nds]
+                aux_pos_box["pos"] = [
+                    frozen_ids.index(id(nd))
+                    if id(nd) in frozen_ids else -1
+                    for nd in out_box.get("aux_targets", [])]
+            return aux_pos_box["pos"]
+
+        def chain_fn(key, diff, frozen, states, hypers, datas, labels):
+            n = datas[0].shape[0]
+
+            def body(carry, xs):
+                key, diff, frozen, states, t_off = carry
+                ks = jax.random.split(key)
+                key, sub = ks[0], ks[1]
+                d, l = xs
+                hy = [{**h, "t": h["t"] + t_off} for h in hypers]
+                new_ws, new_ss, loss, aux = step_fn(
+                    sub, diff, frozen, states, hy, d, l)
+                frozen2 = list(frozen)
+                for pos, a in zip(_aux_positions(), aux):
+                    if pos >= 0:
+                        frozen2[pos] = a
+                return ((key, tuple(new_ws), tuple(frozen2),
+                         tuple(new_ss), t_off + 1), (loss, aux))
+
+            (key, diff, frozen, states, _), (losses, auxs) = \
+                jax.lax.scan(body, (key, diff, frozen, states,
+                                    jnp.int32(0)), (datas, labels))
+            last_aux = jax.tree.map(lambda a: a[n - 1], auxs)
+            return diff, frozen, states, losses, last_aux
+
+        kw = {}
+        chain_data_sh = chain_label_sh = None
+        base = entry["jit_kwargs"]
+        if self.donate:
+            kw["donate_argnums"] = (1, 2, 3)
+        if "in_shardings" in base:
+            (rep, diff_sh, frozen_sh, state_sh, hyper_sh,
+             data_sh, label_sh) = base["in_shardings"]
+            mesh = self.mesh
+
+            def lift(sh):
+                # same placement with a replicated leading steps axis
+                return NamedSharding(mesh, P(None, *sh.spec))
+
+            chain_data_sh = tuple(lift(s) for s in data_sh)
+            chain_label_sh = tuple(lift(s) for s in label_sh)
+            kw["in_shardings"] = (
+                rep, diff_sh, frozen_sh, state_sh, hyper_sh,
+                chain_data_sh, chain_label_sh)
+            kw["out_shardings"] = (diff_sh, frozen_sh, state_sh,
+                                   rep, None)
+        return (jax.jit(chain_fn, **kw), _aux_positions,
+                chain_data_sh, chain_label_sh)
+
+    def run_chain(self, data, label):
+        """Run `data.shape[0]` chained training steps in one compiled
+        XLA program (bulk mode). `data`/`label` carry a leading steps
+        axis: ``(n_steps, batch, ...)``. Returns the per-step losses
+        as an NDArray of shape ``(n_steps,)``."""
+        data_t, label_t = _as_tuple(data), _as_tuple(label)
+        data_leaves, data_spec = _flatten_arrays(data_t)
+        label_leaves, label_spec = _flatten_arrays(label_t)
+        n_steps = data_leaves[0].shape[0]
+
+        # per-batch entry (strip the steps axis for the signature)
+        one_data = [l[0] for l in data_leaves]
+        one_label = [l[0] for l in label_leaves]
+        sig = (tuple((l.shape, str(l.dtype)) for l in one_data),
+               tuple((l.shape, str(l.dtype)) for l in one_label),
+               repr(data_spec), repr(label_spec))
+        entry = self._entries.get(sig)
+        if entry is None:
+            entry = self._build(one_data, data_spec, one_label,
+                                label_spec)
+            self._entries[sig] = entry
+        chain_key = ("chain", sig, n_steps)
+        chain = self._entries.get(chain_key)
+        if chain is None:
+            chain = self._build_chain(entry)
+            self._entries[chain_key] = chain
+        chain_jit, aux_positions, chain_data_sh, chain_label_sh = chain
+
+        opt = self.optimizer
+        n_diff = len(entry["diff_nds"])
+        # count the first chained step BEFORE reading hypers (Adam's
+        # bias correction needs t>=1), then the remaining n-1; the
+        # scan body advances t by its step offset
+        opt._update_count(list(range(n_diff)))
+        hypers = [opt._hyper(k) for k in range(n_diff)]
+        for _ in range(n_steps - 1):
+            opt._update_count(list(range(n_diff)))
+
+        data_datas = [l._data for l in data_leaves]
+        label_datas = [l._data for l in label_leaves]
+        if chain_data_sh is not None:
+            data_datas = [jax.device_put(d, sh) for d, sh in
+                          zip(data_datas, chain_data_sh)]
+            label_datas = [jax.device_put(d, sh) for d, sh in
+                          zip(label_datas, chain_label_sh)]
+
+        new_ws, new_fr, new_ss, losses, last_aux = chain_jit(
+            next_key(),
+            tuple(nd._data for nd in entry["diff_nds"]),
+            tuple(nd._data for nd in entry["frozen_nds"]),
+            tuple(self._opt_states), hypers,
+            tuple(data_datas), tuple(label_datas))
+
+        for nd, nw in zip(entry["diff_nds"], new_ws):
+            nd._data = nw
+        for nd, nf in zip(entry["frozen_nds"], new_fr):
+            nd._data = nf
+        self._opt_states = list(new_ss)
+        targets = entry["out_box"].get("aux_targets", [])
+        with autograd.pause():
+            for nd, pos, new in zip(targets, aux_positions(), last_aux):
+                if pos < 0:  # not threaded through frozen: install last
+                    nd._install(new)
+        return NDArray(engine.track(losses))
 
     # -- call ----------------------------------------------------------
     def __call__(self, data, label):

@@ -32,6 +32,18 @@ class _RngState(threading.local):
 _state = _RngState()
 _lock = threading.Lock()
 
+_split2_cache = None
+
+
+def _split2(key):
+    """Jitted key split returning an unpackable 2-tuple in ONE
+    dispatch (lazy so importing never initializes a backend)."""
+    global _split2_cache
+    if _split2_cache is None:
+        _split2_cache = jax.jit(
+            lambda k: (lambda ks: (ks[0], ks[1]))(jax.random.split(k)))
+    return _split2_cache(key)
+
 
 def seed(seed_value: int):
     """Seed the global generator (parity: mx.np.random.seed)."""
@@ -47,7 +59,12 @@ def next_key():
     with _lock:
         if _state.key is None:
             _state.key = jax.random.PRNGKey(0)
-        _state.key, sub = jax.random.split(_state.key)
+        # one jitted call returning a 2-tuple: tuple-unpacking the raw
+        # (2,2) split array would iterate it through the HOST
+        # (Array.__iter__ materializes values — a silent full sync per
+        # train step on remote backends), and indexing it eagerly
+        # would cost three dispatches instead of one
+        _state.key, sub = _split2(_state.key)
     return sub
 
 

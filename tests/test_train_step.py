@@ -111,3 +111,68 @@ def test_parallel_allreduce_is_real_reduction():
         onp.testing.assert_allclose(c.asnumpy(), onp.full((2, 3), 8.0))
     finally:
         parallel.set_mesh(old)
+
+
+def test_run_chain_matches_sequential_steps():
+    """Bulk mode (lax.scan of N steps in one XLA program) must land on
+    the same parameters and losses as N sequential step() calls —
+    including BatchNorm running-stat threading and Adam t advance."""
+    import copy
+
+    def _bn_net():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16), nn.BatchNorm(), nn.Dense(4))
+        net.initialize(mx.init.Xavier())
+        return net
+
+    n_steps, batch = 4, 16
+    x, y = _data(n=n_steps * batch)
+    xs = x.asnumpy().reshape(n_steps, batch, -1)
+    ys = y.asnumpy().reshape(n_steps, batch)
+
+    mx.npx.random.seed(7) if hasattr(mx.npx, "random") else None
+    net_a, net_b = _bn_net(), _bn_net()
+    net_a(np.array(xs[0])), net_b(np.array(xs[0]))
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        pb.set_data(pa.data().copy())
+
+    mk = lambda net: parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01}, mesh=None)
+    step_a, step_b = mk(net_a), mk(net_b)
+
+    seq_losses = [float(step_a(np.array(xs[i]), np.array(ys[i])))
+                  for i in range(n_steps)]
+    chain_losses = step_b.run_chain(np.array(xs), np.array(ys))
+
+    assert chain_losses.shape == (n_steps,)
+    onp.testing.assert_allclose(chain_losses.asnumpy(), seq_losses,
+                                rtol=2e-4, atol=2e-5)
+    for (na, pa), (nb, pb) in zip(net_a.collect_params().items(),
+                                  net_b.collect_params().items()):
+        onp.testing.assert_allclose(
+            pa.data().asnumpy(), pb.data().asnumpy(),
+            rtol=2e-4, atol=2e-5, err_msg=f"{na} vs {nb}")
+
+
+def test_run_chain_on_mesh():
+    """Bulk mode composes with dp sharding on the virtual mesh."""
+    mesh = parallel.make_mesh((8,), ("dp",))
+    old = parallel.get_mesh()
+    parallel.set_mesh(mesh)
+    try:
+        n_steps, batch = 3, 32
+        x, y = _data(n=n_steps * batch)
+        xs = np.array(x.asnumpy().reshape(n_steps, batch, -1))
+        ys = np.array(y.asnumpy().reshape(n_steps, batch))
+        net = _mlp()
+        step = parallel.TrainStep(net,
+                                  gluon.loss.SoftmaxCrossEntropyLoss(),
+                                  "sgd", {"learning_rate": 0.1},
+                                  mesh=mesh)
+        l1 = step.run_chain(xs, ys).asnumpy()
+        l2 = step.run_chain(xs, ys).asnumpy()
+        assert l2[-1] < l1[0]
+    finally:
+        parallel.set_mesh(old)

@@ -187,6 +187,28 @@ def _run_bench(small: bool):
     sec_per_step = max((t_hi - t_lo) / (iters_hi - iters_lo), 1e-9)
     ips_synth = batch / sec_per_step
 
+    # bulk mode: N steps scanned inside ONE XLA program
+    # (TrainStep.run_chain — the engine bulk-mode equivalent); same
+    # two-point delta
+    def timed_bulk(n):
+        d = mx.np.random.uniform(size=(n,) + tuple(data.shape),
+                                 dtype="bfloat16")
+        l = mx.np.zeros((n, batch), dtype="int32")
+        t0 = time.perf_counter()
+        step.run_chain(d, l).asnumpy()
+        return time.perf_counter() - t0
+
+    ips_bulk = None
+    try:
+        timed_bulk(iters_lo)  # compile
+        b_lo = timed_bulk(iters_lo)
+        b_hi = timed_bulk(iters_hi)
+        bulk_step = max((b_hi - b_lo) / (iters_hi - iters_lo), 1e-9)
+        ips_bulk = batch / bulk_step
+    except Exception as e:  # noqa: BLE001 — bulk is a bonus metric
+        print(f"[bench] bulk mode failed: {type(e).__name__}: "
+              f"{str(e)[:200]}", file=sys.stderr, flush=True)
+
     # ---- MFU ----
     kind = jax.devices()[0].device_kind
     peak = _peak_flops(kind)

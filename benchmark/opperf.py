@@ -429,8 +429,21 @@ def build_specs(mx, LARGE):
     rmean = np.zeros(8)
     rvar = np.ones(8)
     emb_w = np.array(_mat((32, 16)))
+    # a minimal registered CustomOp so npx.custom is sweepable
+    from mxnet_tpu import operator as _operator
+    if "_opperf_scale2" not in _operator.get_all_registered_operators():
+        @_operator.register("_opperf_scale2")
+        class _Scale2Prop(_operator.CustomOpProp):
+            def create_operator(self, ctx, shapes, dtypes):
+                class _Op(_operator.CustomOp):
+                    def forward(self, is_train, req, in_data, out_data,
+                                aux):
+                        self.assign(out_data[0], req[0], in_data[0] * 2)
+                return _Op()
+
     for name, fn in [
         ("activation", lambda: npx.activation(a, "relu")),
+        ("custom", lambda: npx.custom(a, op_type="_opperf_scale2")),
         ("relu", lambda: npx.relu(a)), ("sigmoid", lambda: npx.sigmoid(a)),
         ("log_sigmoid", lambda: npx.log_sigmoid(a)),
         ("softsign", lambda: npx.softsign(a)),

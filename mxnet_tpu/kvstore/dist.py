@@ -75,7 +75,12 @@ class KVStoreDistSync(KVStoreLocal):
                                                    local_data.ndim)))
         stacked = jax.make_array_from_single_device_arrays(
             (n,) + tuple(local_data.shape), sharding, [local])
-        return _allreduce_fn(mesh)(stacked)
+        reduced = _allreduce_fn(mesh)(stacked)
+        # hand back a LOCAL array: the jitted sum is replicated over
+        # the host mesh, and a multi-process global array cannot mix
+        # with this process's single-device arrays in later eager ops
+        # (e.g. the optimizer update right after pushpull)
+        return jnp.asarray(reduced.addressable_data(0))
 
     def _reduce(self, value, key=None):
         local = KVStoreLocal._reduce(self, value, key)

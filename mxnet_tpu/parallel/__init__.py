@@ -213,3 +213,4 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
 
 
 from .train_step import TrainStep  # noqa: E402,F401
+from .checkpoint import save_sharded, load_sharded  # noqa: E402,F401

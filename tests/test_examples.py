@@ -1,0 +1,85 @@
+"""Smoke-run every example script (parity model: the reference CI
+executes example/ scripts nightly)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EX = os.path.join(ROOT, "examples")
+
+
+def _run(script, *args, timeout=420):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    # examples must not try to grab the real TPU from CI
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
+                        " --xla_force_host_platform_device_count=8") \
+        .strip()
+    proc = subprocess.run(
+        [sys.executable, os.path.join(EX, script), *args],
+        capture_output=True, text=True, timeout=timeout, env=env,
+        cwd=ROOT)
+    assert proc.returncode == 0, \
+        f"{script} failed:\n{proc.stdout}\n{proc.stderr}"
+    return proc.stdout
+
+
+def test_train_mnist():
+    out = _run("train_mnist.py", "--epochs", "1", "--batch-size", "128")
+    assert "val-accuracy" in out
+
+
+def test_train_cifar_resnet_stepwise_and_bulk():
+    out = _run("train_cifar_resnet.py", "--epochs", "1",
+               "--batch-size", "64")
+    assert "last loss" in out
+    out = _run("train_cifar_resnet.py", "--epochs", "1",
+               "--batch-size", "64", "--bulk", "4")
+    assert "last loss" in out
+
+
+def test_amp_training_bf16():
+    out = _run("amp_training.py", "--dtype", "bfloat16", "--steps", "20")
+    assert "bfloat16: loss" in out
+
+
+def test_amp_training_fp16():
+    out = _run("amp_training.py", "--dtype", "float16", "--steps", "20")
+    assert "float16: loss" in out
+
+
+def test_quantize_model():
+    out = _run("quantize_model.py", "--calib-mode", "naive",
+               "--batches", "2")
+    assert "agreement with fp32" in out
+
+
+def test_custom_op_example():
+    out = _run("custom_op.py")
+    assert "clipped grads" in out and "pallas scale2" in out
+
+
+def test_lm_transformer_flash():
+    out = _run("lm_transformer.py", "--seq-len", "64", "--steps", "4")
+    assert "loss" in out
+
+
+def test_lm_transformer_ring_sp():
+    out = _run("lm_transformer.py", "--seq-len", "64", "--steps", "3",
+               "--sp", "4")
+    assert "sp=4" in out
+
+
+def test_train_dist_via_launcher():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable,
+         os.path.join(EX, "train_dist.py"), "--epochs", "1"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "worker 0 epoch 0" in proc.stdout + proc.stderr

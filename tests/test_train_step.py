@@ -176,3 +176,47 @@ def test_run_chain_on_mesh():
         assert l2[-1] < l1[0]
     finally:
         parallel.set_mesh(old)
+
+
+def test_sharded_checkpoint_roundtrip(tmp_path):
+    """save_sharded/load_sharded over a tp-sharded mesh: params +
+    optimizer states survive, placement restored (no host-0 gather)."""
+    mesh = parallel.make_mesh((8,), ("tp",))
+    old = parallel.get_mesh()
+    parallel.set_mesh(mesh)
+    try:
+        x, y = _data(n=32)
+        net = _mlp()
+        step = parallel.TrainStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.01}, mesh=mesh, batch_axis="tp",
+            param_rules=[(r"^0\.weight$", P("tp", None))])
+        for _ in range(3):
+            step(x, y)
+        want = {k: p.data().asnumpy()
+                for k, p in net.collect_params().items()}
+        want_states = [s for s in step._opt_states]
+        d = str(tmp_path / "ckpt")
+        parallel.save_sharded(d, net, step=step)
+
+        # clobber everything, then restore
+        net2 = _mlp()
+        step2 = parallel.TrainStep(
+            net2, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 0.01}, mesh=mesh, batch_axis="tp",
+            param_rules=[(r"^0\.weight$", P("tp", None))])
+        step2(x, y)  # materialize opt states with the build layout
+        parallel.load_sharded(d, net2, step=step2, mesh=mesh,
+                              rules=[(r"^0\.weight$", P("tp", None))])
+        for k, p in net2.collect_params().items():
+            onp.testing.assert_allclose(p.data().asnumpy(), want[k],
+                                        rtol=1e-6, err_msg=k)
+        # weight placement restored as tp-sharded
+        w = net2[0].weight.data()._data
+        assert w.sharding.spec == P("tp", None)
+        # training continues from the restored state
+        l1 = float(step2(x, y).asnumpy())
+        assert onp.isfinite(l1)
+        assert len(step2._opt_states) == len(want_states)
+    finally:
+        parallel.set_mesh(old)

@@ -237,18 +237,320 @@ class ImageIter:
     next = __next__
 
 
-def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
-                    rand_mirror=False, mean=None, std=None, **kwargs):
-    """Build a standard augmentation list (parity: mx.image.CreateAugmenter)."""
-    augs = []
+# ---------------------------------------------------------------------------
+# Classification augmenter zoo (parity: python/mxnet/image/image.py
+# Augmenter classes + CreateAugmenter). Host-side pipeline ops over
+# (H, W, C) NDArray images — they run in loader workers ahead of the
+# device, so eager host execution is the right cost model.
+# ---------------------------------------------------------------------------
+class Augmenter:
+    """Image augmenter base (parity: mx.image.Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        """JSON [class name, kwargs] — the reference's serialization."""
+        import json
+        return json.dumps([self.__class__.__name__.replace("Aug", ""),
+                           {k: (list(v) if isinstance(v, tuple) else v)
+                            for k, v in self._kwargs.items()
+                            if isinstance(v, (int, float, str, list,
+                                              tuple, bool))}])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class SequentialAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = onp.random.permutation(len(self.ts))
+        for i in order:
+            src = self.ts[i](src)
+        return src
+
+
+class ResizeAug(Augmenter):
+    """Resize shorter edge to `size`."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force-resize to (w, h) ignoring aspect ratio."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomSizedCropAug(Augmenter):
+    """Random area+aspect crop resized to `size` (Inception-style)."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio,
+                         interp=interp)
+        self.size, self.interp = size, interp
+        self.area = (area, 1.0) if isinstance(area, (int, float)) \
+            else tuple(area)
+        self.ratio = tuple(ratio)
+
+    def __call__(self, src):
+        h, w = src.shape[0], src.shape[1]
+        src_area = h * w
+        for _ in range(10):
+            target = onp.random.uniform(*self.area) * src_area
+            ar = onp.random.uniform(*self.ratio)
+            new_w = int(round((target * ar) ** 0.5))
+            new_h = int(round((target / ar) ** 0.5))
+            if new_w <= w and new_h <= h:
+                x0 = onp.random.randint(0, w - new_w + 1)
+                y0 = onp.random.randint(0, h - new_h + 1)
+                return fixed_crop(src, x0, y0, new_w, new_h, self.size,
+                                  self.interp)
+        return center_crop(src, self.size, self.interp)[0]
+
+
+def _as_f32(src):
+    from .numpy import array
+    a = src.asnumpy() if hasattr(src, "asnumpy") else onp.asarray(src)
+    return array(a.astype("float32"))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.brightness,
+                                         self.brightness)
+        return _as_f32(src) * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + onp.random.uniform(-self.contrast, self.contrast)
+        src = _as_f32(src)
+        gray_mean = float((src.asnumpy() * self._coef).sum(-1).mean())
+        return src * alpha + gray_mean * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = onp.array([0.299, 0.587, 0.114], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        from .numpy import array
+        alpha = 1.0 + onp.random.uniform(-self.saturation,
+                                         self.saturation)
+        a = _as_f32(src).asnumpy()
+        gray = (a * self._coef).sum(-1, keepdims=True)
+        return array(a * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Hue jitter via the YIQ rotation trick (the reference's method)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = onp.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], "float32")
+        self.ityiq = onp.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], "float32")
+
+    def __call__(self, src):
+        from .numpy import array
+        alpha = onp.random.uniform(-self.hue, self.hue)
+        u, v = onp.cos(alpha * onp.pi), onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0], [0.0, u, -v], [0.0, v, u]],
+                       "float32")
+        t = onp.dot(onp.dot(self.ityiq, bt), self.tyiq).T
+        a = _as_f32(src).asnumpy()
+        return array(onp.dot(a, t))
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA (AlexNet-style) lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, "float32")
+        self.eigvec = onp.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,)) \
+            .astype("float32")
+        rgb = onp.dot(self.eigvec * alpha, self.eigval)
+        return _as_f32(src) + rgb
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = onp.asarray(mean, "float32") \
+            if mean is not None else None
+        self.std = onp.asarray(std, "float32") \
+            if std is not None else None
+
+    def __call__(self, src):
+        from .numpy import array
+        return color_normalize(_as_f32(src),
+                               array(self.mean) if self.mean is not None
+                               else 0.0,
+                               array(self.std) if self.std is not None
+                               else None)
+
+
+class RandomGrayAug(Augmenter):
+    _coef = onp.array([[0.299], [0.587], [0.114]], "float32")
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        from .numpy import array
+        if onp.random.random() < self.p:
+            a = _as_f32(src).asnumpy()
+            return array(onp.broadcast_to(
+                onp.dot(a, self._coef), a.shape).copy())
+        return src
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        from .numpy import array
+        if onp.random.random() < self.p:
+            a = src.asnumpy() if hasattr(src, "asnumpy") \
+                else onp.asarray(src)
+            return array(a[:, ::-1].copy())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False,
+                    rand_resize=False, rand_mirror=False, mean=None,
+                    std=None, brightness=0, contrast=0, saturation=0,
+                    hue=0, pca_noise=0, rand_gray=0, inter_method=2):
+    """Build the standard augmentation list (parity:
+    mx.image.CreateAugmenter, python/mxnet/image/image.py). Order
+    matches the reference: resize → crop → color → lighting → gray →
+    mirror → cast → normalize."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        if not rand_crop:
+            raise ValueError("rand_resize requires rand_crop")
+        auglist.append(RandomSizedCropAug(crop_size, 0.08,
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if rand_mirror:
-        from .gluon.data.vision.transforms import RandomFlipLeftRight
-        augs.append(RandomFlipLeftRight())
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
     if mean is not None or std is not None:
-        from .gluon.data.vision.transforms import Normalize
-        augs.append(Normalize(mean if mean is not None else 0.0,
-                              std if std is not None else 1.0))
-    return augs
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
 
 
 # ---------------------------------------------------------------------------

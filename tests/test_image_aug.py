@@ -1,0 +1,115 @@
+"""Classification augmenter zoo (parity: mx.image Augmenter classes,
+python/mxnet/image/image.py) — every class, plus CreateAugmenter
+composition and ImageIter integration."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, np
+
+SRC = onp.random.RandomState(0).randint(
+    0, 255, (40, 50, 3)).astype("uint8")
+
+
+def _img():
+    return np.array(SRC)
+
+
+def test_resize_and_force_resize():
+    out = image.ResizeAug(24)(_img())
+    assert min(out.shape[:2]) == 24
+    out = image.ForceResizeAug((20, 30))(_img())
+    assert tuple(out.shape[:2]) == (30, 20)  # (h, w) from (w, h) arg
+
+
+def test_crops():
+    assert tuple(image.RandomCropAug((16, 16))(_img()).shape[:2]) \
+        == (16, 16)
+    assert tuple(image.CenterCropAug((16, 16))(_img()).shape[:2]) \
+        == (16, 16)
+    out = image.RandomSizedCropAug((16, 16), 0.5, (0.75, 1.333))(_img())
+    assert tuple(out.shape[:2]) == (16, 16)
+
+
+def test_color_jitters_change_pixels_but_keep_shape():
+    for aug in (image.BrightnessJitterAug(0.5),
+                image.ContrastJitterAug(0.5),
+                image.SaturationJitterAug(0.5),
+                image.HueJitterAug(0.5),
+                image.LightingAug(0.5, onp.ones(3), onp.eye(3))):
+        out = aug(_img())
+        assert tuple(out.shape) == SRC.shape
+        assert str(out.dtype) == "float32"
+
+
+def test_color_normalize_aug():
+    out = image.ColorNormalizeAug(
+        onp.array([100.0, 100.0, 100.0]),
+        onp.array([50.0, 50.0, 50.0]))(_img())
+    want = (SRC.astype("float32") - 100.0) / 50.0
+    onp.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+
+def test_gray_flip_cast():
+    g = image.RandomGrayAug(1.0)(_img()).asnumpy()
+    onp.testing.assert_allclose(g[..., 0], g[..., 1], rtol=1e-5)
+    f = image.HorizontalFlipAug(1.0)(_img()).asnumpy()
+    onp.testing.assert_allclose(f, SRC[:, ::-1])
+    c = image.CastAug()(_img())
+    assert str(c.dtype) == "float32"
+
+
+def test_random_order_and_sequential():
+    seq = image.SequentialAug([image.CastAug(),
+                               image.BrightnessJitterAug(0.1)])
+    assert tuple(seq(_img()).shape) == SRC.shape
+    ro = image.RandomOrderAug([image.CastAug(),
+                               image.BrightnessJitterAug(0.1)])
+    assert tuple(ro(_img()).shape) == SRC.shape
+
+
+def test_dumps_serialization():
+    import json
+    name, kw = json.loads(image.ResizeAug(28, 1).dumps())
+    assert name == "Resize" and kw["size"] == 28 and kw["interp"] == 1
+
+
+def test_create_augmenter_full_pipeline():
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                                 rand_resize=True, rand_mirror=True,
+                                 brightness=0.1, contrast=0.1,
+                                 saturation=0.1, hue=0.1,
+                                 pca_noise=0.05, rand_gray=0.2,
+                                 mean=True, std=True)
+    names = [type(a).__name__ for a in augs]
+    assert names[0] == "ResizeAug" and "ColorNormalizeAug" in names
+    out = _img()
+    for a in augs:
+        out = a(out)
+    assert tuple(out.shape) == (24, 24, 3)
+    assert str(out.dtype) == "float32"
+
+
+def test_imageiter_with_aug_list(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(
+        str(tmp_path / "t.idx"), str(tmp_path / "t.rec"), "w")
+    for i in range(8):
+        buf = pyio.BytesIO()
+        Image.fromarray(SRC).save(buf, format="JPEG")
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 2), i, 0), buf.getvalue()))
+    rec.close()
+
+    augs = image.CreateAugmenter((3, 24, 24), rand_mirror=True,
+                                 mean=True, std=True)
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         path_imgrec=str(tmp_path / "t.rec"),
+                         aug_list=augs)
+    data, label = next(iter(it))
+    assert tuple(data.shape) == (4, 3, 24, 24)
+    assert tuple(label.shape) == (4,)

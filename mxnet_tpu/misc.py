@@ -1,0 +1,5 @@
+"""Deprecated learning-rate scheduler aliases (parity:
+python/mxnet/misc.py — the reference keeps these as the historic home
+of FactorScheduler before lr_scheduler.py existed)."""
+from .lr_scheduler import LRScheduler as LearningRateScheduler  # noqa: F401
+from .lr_scheduler import FactorScheduler  # noqa: F401

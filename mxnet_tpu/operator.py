@@ -32,6 +32,7 @@ __all__ = [
     "CustomOp", "CustomOpProp", "register", "custom",
     "get_all_registered_operators", "get_all_registered_operators_grouped",
     "get_operator_arguments",
+    "PythonOp", "NumpyOp", "NDArrayOp",
 ]
 
 
@@ -104,6 +105,26 @@ class CustomOpProp:
     # --- factory ---------------------------------------------------------
     def create_operator(self, ctx, in_shapes, in_dtypes):
         return CustomOp()
+
+
+class PythonOp:
+    """Deprecated pre-CustomOp interface (reference ``operator.py:46``
+    — already deprecated there). Kept for import compatibility; raises
+    with migration guidance on use."""
+
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} is the deprecated pre-1.0 custom-op "
+            "interface; subclass mxnet_tpu.operator.CustomOp / "
+            "CustomOpProp and register() it instead")
+
+
+class NumpyOp(PythonOp):
+    """Deprecated (reference ``operator.py:155``)."""
+
+
+class NDArrayOp(PythonOp):
+    """Deprecated (reference ``operator.py:260``)."""
 
 
 _registry: "OrderedDict[str, type]" = OrderedDict()

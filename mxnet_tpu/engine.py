@@ -107,8 +107,12 @@ class bulk:
     """Hint scope for op bulking (parity: Engine bulk API, engine.h:310).
 
     The reference batches engine pushes to cut scheduling overhead.
-    Under JAX, op-by-op dispatch is already cheap and real fusion comes
-    from hybridize()/jit; this scope is a no-op kept for source parity.
+    Here the real bulk-execution surfaces are (a) ``hybridize()`` —
+    the whole model becomes one XLA program — and (b)
+    ``parallel.TrainStep.run_chain`` — N optimizer steps scanned into
+    one XLA program. Eager op-by-op dispatch is already async and
+    cheap, so this scope itself is a compatibility no-op; use the two
+    mechanisms above where the reference used bulking.
     """
 
     def __init__(self, size: int = 0):

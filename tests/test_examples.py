@@ -83,3 +83,8 @@ def test_train_dist_via_launcher():
         capture_output=True, text=True, timeout=600, env=env, cwd=ROOT)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert "worker 0 epoch 0" in proc.stdout + proc.stderr
+
+
+def test_bert_finetune():
+    out = _run("bert_finetune.py", "--steps", "20")
+    assert "eval accuracy" in out

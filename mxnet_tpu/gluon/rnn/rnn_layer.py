@@ -28,11 +28,11 @@ class _RNNLayer(HybridBlock):
         super().__init__()
         assert layout in ("TNC", "NTC"), \
             f"Invalid layout {layout}; must be 'TNC' or 'NTC'"
-        if projection_size:
-            raise NotImplementedError(
-                "LSTMP projection is not supported in this build")
+        if projection_size and mode != "lstm":
+            raise ValueError("projection_size is only defined for LSTM "
+                             "(rnn-inl.h LSTMP)")
         self._hidden_size = hidden_size
-        self._projection_size = None
+        self._projection_size = projection_size or None
         self._num_layers = num_layers
         self._mode = mode
         self._layout = layout
@@ -48,20 +48,25 @@ class _RNNLayer(HybridBlock):
                        "gru": 3}[mode]
 
         ng, ni, nh = self._gates, input_size, hidden_size
+        rec = self._projection_size or nh  # recurrent/output width
         for i in range(num_layers):
             for j in ["l", "r"][:self._dir]:
-                for g, shape, init in (
-                        ("i2h_weight", (ng * nh, ni),
-                         i2h_weight_initializer),
-                        ("h2h_weight", (ng * nh, nh),
-                         h2h_weight_initializer),
-                        ("i2h_bias", (ng * nh,), i2h_bias_initializer),
-                        ("h2h_bias", (ng * nh,), h2h_bias_initializer)):
+                specs = [
+                    ("i2h_weight", (ng * nh, ni),
+                     i2h_weight_initializer),
+                    ("h2h_weight", (ng * nh, rec),
+                     h2h_weight_initializer),
+                    ("i2h_bias", (ng * nh,), i2h_bias_initializer),
+                    ("h2h_bias", (ng * nh,), h2h_bias_initializer)]
+                if self._projection_size:
+                    specs.append(("h2r_weight", (rec, nh),
+                                  h2r_weight_initializer))
+                for g, shape, init in specs:
                     name = f"{j}{i}_{g}"
                     setattr(self, name, Parameter(
                         name, shape=shape, init=init, dtype=dtype,
                         allow_deferred_init=True))
-            ni = nh * self._dir
+            ni = rec * self._dir
 
     def __repr__(self):
         s = "{name}({mapping}, {_layout}"
@@ -89,7 +94,7 @@ class _RNNLayer(HybridBlock):
                 p = getattr(self, f"{j}{i}_i2h_weight")
                 if not p._shape_known():
                     p._infer_shape((self._gates * self._hidden_size, ni))
-            ni = self._hidden_size * self._dir
+            ni = (self._projection_size or self._hidden_size) * self._dir
 
     def begin_state(self, batch_size=0, func=np.zeros, **kwargs):
         states = []
@@ -130,6 +135,13 @@ class _RNNLayer(HybridBlock):
                  for layer in range(self._num_layers)
                  for d in ["l", "r"][:self._dir]
                  for g in ("i2h", "h2h")]
+        if self._projection_size:
+            # LSTMP projection matrices go AFTER all weights+biases
+            # (rnn-inl.h:204 appends them to the flat vector)
+            parts += [getattr(self, f"{d}{layer}_h2r_weight")
+                      .data().reshape(-1)
+                      for layer in range(self._num_layers)
+                      for d in ["l", "r"][:self._dir]]
         params = np.concatenate(parts, axis=0)
 
         rnn_args = list(states)
@@ -138,7 +150,9 @@ class _RNNLayer(HybridBlock):
         rnn_out = npx.rnn(
             inputs, params, *rnn_args,
             use_sequence_length=self._use_sequence_length,
-            state_size=self._hidden_size, num_layers=self._num_layers,
+            state_size=self._hidden_size,
+            projection_size=self._projection_size,
+            num_layers=self._num_layers,
             bidirectional=self._dir == 2, p=self._dropout,
             state_outputs=True, mode=self._mode,
             lstm_state_clip_min=self._lstm_state_clip_min,
@@ -191,10 +205,12 @@ class LSTM(_RNNLayer):
                          dtype=dtype, **kwargs)
 
     def state_info(self, batch_size=0):
-        shape = (self._num_layers * self._dir, batch_size,
-                 self._hidden_size)
-        return [{"shape": shape, "__layout__": "LNC"},
-                {"shape": shape, "__layout__": "LNC"}]
+        h_shape = (self._num_layers * self._dir, batch_size,
+                   self._projection_size or self._hidden_size)
+        c_shape = (self._num_layers * self._dir, batch_size,
+                   self._hidden_size)
+        return [{"shape": h_shape, "__layout__": "LNC"},
+                {"shape": c_shape, "__layout__": "LNC"}]
 
 
 class GRU(_RNNLayer):

@@ -509,35 +509,43 @@ def sequence_reverse(x, sequence_length=None, use_sequence_length=False,
 _RNN_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "gru": 3, "lstm": 4}
 
 
-def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional):
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional,
+                   projection_size=None):
     """Length of the flat parameter vector (parity: the reference's
-    GetRnnParamSize, src/operator/rnn-inl.h)."""
+    GetRnnParamSize, src/operator/rnn-inl.h:182 — with projection the
+    recurrent input is the projected state and the (proj, state)
+    projection matrices are appended after all weights+biases)."""
     g = _RNN_GATES[mode]
     d = 2 if bidirectional else 1
+    rec = projection_size if projection_size else state_size
     size = 0
     for layer in range(num_layers):
-        in_size = input_size if layer == 0 else state_size * d
-        size += d * g * state_size * (in_size + state_size  # weights
-                                      + 2)                  # both biases
+        in_size = input_size if layer == 0 else rec * d
+        size += d * g * state_size * (in_size + rec  # weights
+                                      + 2)           # both biases
+    if projection_size:
+        size += projection_size * state_size * num_layers * d
     return size
 
 
 def _rnn_unpack(params, mode, input_size, state_size, num_layers,
-                bidirectional):
+                bidirectional, projection_size=None):
     """Split the flat vector into per-(layer, direction) weight/bias
-    arrays: all weights first, then all biases (cuDNN layout)."""
+    arrays: all weights first, then all biases, then (LSTMP only) the
+    projection matrices (cuDNN layout)."""
     g = _RNN_GATES[mode]
     d = 2 if bidirectional else 1
     h = state_size
+    rec = projection_size if projection_size else h
     pos = 0
-    weights, biases = [], []
+    weights, biases, projs = [], [], []
     for layer in range(num_layers):
-        in_size = input_size if layer == 0 else h * d
+        in_size = input_size if layer == 0 else rec * d
         for _ in range(d):
             wi = params[pos:pos + g * h * in_size].reshape(g * h, in_size)
             pos += g * h * in_size
-            wh = params[pos:pos + g * h * h].reshape(g * h, h)
-            pos += g * h * h
+            wh = params[pos:pos + g * h * rec].reshape(g * h, rec)
+            pos += g * h * rec
             weights.append((wi, wh))
     for layer in range(num_layers):
         for _ in range(d):
@@ -546,16 +554,24 @@ def _rnn_unpack(params, mode, input_size, state_size, num_layers,
             bh = params[pos:pos + g * h]
             pos += g * h
             biases.append((bi, bh))
-    return weights, biases
+    if projection_size:
+        p = projection_size
+        for layer in range(num_layers):
+            for _ in range(d):
+                projs.append(params[pos:pos + p * h].reshape(p, h))
+                pos += p * h
+    return weights, biases, projs
 
 
 def _rnn_layer_scan(mode, xp, bh, h0, c0, wh, mask, clip_min, clip_max,
-                    clip_nan):
+                    clip_nan, wr=None):
     """Scan one direction of one layer.
 
     xp: (T, N, G*H) precomputed input projection (+ i2h bias; for
     rnn/lstm also + h2h bias). bh: h2h bias, used separately only by
     GRU's linear-before-reset candidate. mask: (T, N, 1) or None.
+    wr: optional (P, H) LSTMP projection — the recurrent/output state
+    becomes r = (o*tanh(c)) @ wr.T (rnn-inl.h projection path).
     """
     h_dim = h0.shape[-1]
 
@@ -576,6 +592,8 @@ def _rnn_layer_scan(mode, xp, bh, h0, c0, wh, mask, clip_min, clip_max,
                     c_new = jnp.nan_to_num(c_new, nan=0.0)
                 c_new = jnp.clip(c_new, clip_min, clip_max)
             h_new = o * jnp.tanh(c_new)
+            if wr is not None:
+                h_new = h_new @ wr.T
             if m_t is not None:
                 h_new = jnp.where(m_t, h_new, h)
                 c_new = jnp.where(m_t, c_new, c)
@@ -614,16 +632,24 @@ def rnn(data, params, state, state_cell=None, sequence_length=None,
         p=0.0, key=None, train=False, projection_size=None,
         lstm_state_clip_min=None, lstm_state_clip_max=None,
         lstm_state_clip_nan=False):
-    """Fused multi-layer RNN. data (T, N, I); state (L*D, N, H);
-    returns (output (T, N, H*D), h_n, [c_n])."""
-    if projection_size:
-        raise NotImplementedError("LSTMP projection is not supported yet")
+    """Fused multi-layer RNN. data (T, N, I); state (L*D, N, H) — or
+    (L*D, N, P) for LSTMP; returns (output (T, N, H*D or P*D), h_n,
+    [c_n])."""
+    if projection_size and mode != "lstm":
+        raise ValueError("projection_size is only defined for LSTM "
+                         "(rnn-inl.h LSTMP)")
     g = _RNN_GATES[mode]
     d = 2 if bidirectional else 1
     t_len, batch, input_size = data.shape
-    h = state_size if state_size is not None else state.shape[-1]
-    weights, biases = _rnn_unpack(params, mode, input_size, h, num_layers,
-                                  bidirectional)
+    if state_size is not None:
+        h = state_size
+    elif projection_size:
+        h = state_cell.shape[-1]
+    else:
+        h = state.shape[-1]
+    weights, biases, projs = _rnn_unpack(params, mode, input_size, h,
+                                         num_layers, bidirectional,
+                                         projection_size)
 
     mask = None
     if sequence_length is not None:
@@ -648,10 +674,11 @@ def rnn(data, params, state, state_cell=None, sequence_length=None,
             if mode != "gru":
                 xp = xp + bh
             ys, hn, cn = _rnn_layer_scan(
-                mode, xp, bh, state[idx], 
+                mode, xp, bh, state[idx],
                 state_cell[idx] if state_cell is not None else None,
                 wh, mask, lstm_state_clip_min, lstm_state_clip_max,
-                lstm_state_clip_nan)
+                lstm_state_clip_nan,
+                wr=projs[idx] if projs else None)
             if di == 1:
                 ys = sequence_reverse(
                     ys, sequence_length,

@@ -164,3 +164,61 @@ def test_bidirectional_valid_length_ignores_padding():
                                 out2.asnumpy()[1, :2], rtol=1e-5)
     onp.testing.assert_allclose(out.asnumpy()[0], out2.asnumpy()[0],
                                 rtol=1e-5)
+
+
+def test_lstmp_projection_matches_manual():
+    """LSTMP (projection_size) — recurrent state is the projected
+    output r = (o*tanh(c)) @ Wr^T (parity: rnn-inl.h projection path,
+    previously unsupported)."""
+    import numpy as onp
+    from mxnet_tpu import np
+    from mxnet_tpu.gluon import rnn as grnn
+
+    T, N, I, H, P = 5, 3, 4, 6, 2
+    layer = grnn.LSTM(H, projection_size=P, input_size=I)
+    layer.initialize(mx.init.Xavier())
+    x = np.array(onp.random.RandomState(0).randn(T, N, I)
+                 .astype("float32"))
+    out, states = layer(x, layer.begin_state(N))
+    assert tuple(out.shape) == (T, N, P)
+    assert tuple(states[0].shape) == (1, N, P)
+    assert tuple(states[1].shape) == (1, N, H)
+
+    wi = layer.l0_i2h_weight.data().asnumpy()   # (4H, I)
+    wh = layer.l0_h2h_weight.data().asnumpy()   # (4H, P)
+    bi = layer.l0_i2h_bias.data().asnumpy()
+    bh = layer.l0_h2h_bias.data().asnumpy()
+    wr = layer.l0_h2r_weight.data().asnumpy()   # (P, H)
+
+    def sig(v):
+        return 1.0 / (1.0 + onp.exp(-v))
+
+    h = onp.zeros((N, P), "float32")
+    c = onp.zeros((N, H), "float32")
+    xs = x.asnumpy()
+    outs = []
+    for t in range(T):
+        gates = xs[t] @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = onp.split(gates, 4, axis=-1)
+        c = sig(f) * c + sig(i) * onp.tanh(g)
+        h = (sig(o) * onp.tanh(c)) @ wr.T
+        outs.append(h)
+    onp.testing.assert_allclose(out.asnumpy(), onp.stack(outs),
+                                rtol=2e-5, atol=2e-5)
+    onp.testing.assert_allclose(states[0].asnumpy()[0], h,
+                                rtol=2e-5, atol=2e-5)
+
+
+def test_lstmp_bidirectional_stacked():
+    from mxnet_tpu import np
+    from mxnet_tpu.gluon import rnn as grnn
+    import numpy as onp
+
+    layer = grnn.LSTM(8, num_layers=2, projection_size=3,
+                      bidirectional=True, input_size=5)
+    layer.initialize()
+    x = np.array(onp.random.randn(7, 2, 5).astype("float32"))
+    out, states = layer(x, layer.begin_state(2))
+    assert tuple(out.shape) == (7, 2, 3 * 2)
+    assert tuple(states[0].shape) == (4, 2, 3)
+    assert tuple(states[1].shape) == (4, 2, 8)

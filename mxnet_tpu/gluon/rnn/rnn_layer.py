@@ -130,18 +130,20 @@ class _RNNLayer(HybridBlock):
         # flat parameter vector in the reference/cuDNN order:
         # all weights (layer-major, direction, i2h then h2h), then all
         # biases in the same order (rnn_layer.py:203-214)
-        parts = [getattr(self, f"{d}{layer}_{g}_{t}").data().reshape(-1)
-                 for t in ("weight", "bias")
+        # weights pass includes h2r interleaved per (layer, direction);
+        # the bias pass excludes it — the reference's flat order
+        # (python/mxnet/gluon/rnn/rnn_layer.py:216-227)
+        w_gates = ("i2h", "h2h", "h2r") if self._projection_size \
+            else ("i2h", "h2h")
+        parts = [getattr(self, f"{d}{layer}_{g}_weight")
+                 .data().reshape(-1)
                  for layer in range(self._num_layers)
                  for d in ["l", "r"][:self._dir]
-                 for g in ("i2h", "h2h")]
-        if self._projection_size:
-            # LSTMP projection matrices go AFTER all weights+biases
-            # (rnn-inl.h:204 appends them to the flat vector)
-            parts += [getattr(self, f"{d}{layer}_h2r_weight")
-                      .data().reshape(-1)
-                      for layer in range(self._num_layers)
-                      for d in ["l", "r"][:self._dir]]
+                 for g in w_gates]
+        parts += [getattr(self, f"{d}{layer}_{g}_bias").data().reshape(-1)
+                  for layer in range(self._num_layers)
+                  for d in ["l", "r"][:self._dir]
+                  for g in ("i2h", "h2h")]
         params = np.concatenate(parts, axis=0)
 
         rnn_args = list(states)

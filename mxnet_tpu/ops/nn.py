@@ -531,8 +531,9 @@ def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional,
 def _rnn_unpack(params, mode, input_size, state_size, num_layers,
                 bidirectional, projection_size=None):
     """Split the flat vector into per-(layer, direction) weight/bias
-    arrays: all weights first, then all biases, then (LSTMP only) the
-    projection matrices (cuDNN layout)."""
+    arrays: all weights first — with the LSTMP projection matrix
+    interleaved after each h2h (the reference's order,
+    python/mxnet/gluon/rnn/rnn_layer.py:216-227) — then all biases."""
     g = _RNN_GATES[mode]
     d = 2 if bidirectional else 1
     h = state_size
@@ -547,6 +548,9 @@ def _rnn_unpack(params, mode, input_size, state_size, num_layers,
             wh = params[pos:pos + g * h * rec].reshape(g * h, rec)
             pos += g * h * rec
             weights.append((wi, wh))
+            if projection_size:
+                projs.append(params[pos:pos + rec * h].reshape(rec, h))
+                pos += rec * h
     for layer in range(num_layers):
         for _ in range(d):
             bi = params[pos:pos + g * h]
@@ -554,12 +558,6 @@ def _rnn_unpack(params, mode, input_size, state_size, num_layers,
             bh = params[pos:pos + g * h]
             pos += g * h
             biases.append((bi, bh))
-    if projection_size:
-        p = projection_size
-        for layer in range(num_layers):
-            for _ in range(d):
-                projs.append(params[pos:pos + p * h].reshape(p, h))
-                pos += p * h
     return weights, biases, projs
 
 

@@ -1,0 +1,56 @@
+"""Multi-process dist_async smoke worker (parity:
+tests/nightly/dist_sync_kvstore.py async cases). Launched by
+tools/launch.py --kv-mode async, which starts the parameter server and
+exports MXNET_TPU_PS_ADDR. Each worker pushes its rank-determined
+update; a final pull must observe the PS-side SGD having applied every
+worker's pushes (async semantics: order unspecified, sum determined).
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def main():
+    rank = int(os.environ.get("MXNET_TPU_PROC_ID", "0"))
+    n = int(os.environ.get("MXNET_TPU_NUM_PROCS", "1"))
+
+    kv = mx.kvstore.create("dist_async")
+    shape = (4, 2)
+    if rank == 0:
+        kv.init(7, mx.np.zeros(shape))
+        kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    else:
+        time.sleep(1.0)  # let rank 0 init + set the server optimizer
+
+    # each worker pushes gradient = ones * (rank+1); PS applies
+    # w -= lr * grad per push, so after all pushes w == -sum(ranks+1)
+    kv.push(7, mx.np.ones(shape) * (rank + 1))
+
+    expect = -sum(r + 1 for r in range(n))
+    out = mx.np.zeros(shape)
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        kv.pull(7, out=out)
+        if onp.allclose(out.asnumpy(), expect):
+            break
+        time.sleep(0.2)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                onp.full(shape, expect, "float32"))
+    print(f"worker {rank}/{n}: dist_async OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

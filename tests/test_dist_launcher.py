@@ -42,3 +42,26 @@ def test_launch_local_custom_hvd_backend():
     assert proc.returncode == 0, proc.stdout + proc.stderr
     assert proc.stdout.count("custom_hvd OK") == 2, \
         proc.stdout + proc.stderr
+
+
+def test_launcher_async_mode():
+    """tools/launch.py --kv-mode async: PS started by the launcher,
+    2 workers apply async SGD pushes; every worker converges to the
+    deterministic final value."""
+    import os
+    import subprocess
+    import sys
+    ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--kv-mode", "async",
+         sys.executable,
+         os.path.join(ROOT, "tests", "dist", "dist_async_worker.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=ROOT)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = proc.stdout + proc.stderr
+    assert "worker 0/2: dist_async OK" in out
+    assert "worker 1/2: dist_async OK" in out

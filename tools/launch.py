@@ -227,6 +227,11 @@ def main():
         if args.kv_mode == "async":
             sys.path.insert(0, os.path.dirname(
                 os.path.dirname(os.path.abspath(__file__))))
+            # the launcher hosts the PS: its optimizer math must run
+            # on host CPU — never grab (or hang on) the accelerator
+            # the WORKERS will use; pin before any jax backend init
+            import jax as _jax
+            _jax.config.update("jax_platforms", "cpu")
             from mxnet_tpu.kvstore import ParameterServer
             server = ParameterServer()
             server.serve_background()
@@ -235,7 +240,9 @@ def main():
         else:
             port = _free_port()
             base_env["MXNET_TPU_COORDINATOR"] = f"127.0.0.1:{port}"
-            base_env["MXNET_TPU_NUM_PROCS"] = str(args.num_workers)
+        # world size is exported in BOTH modes (parity: the dmlc
+        # tracker always sets DMLC_NUM_WORKER)
+        base_env["MXNET_TPU_NUM_PROCS"] = str(args.num_workers)
 
         for rank in range(args.num_workers):
             env = dict(base_env)

@@ -30,10 +30,14 @@ Honesty notes (round-2 VERDICT Weak #1):
   counts; the difference cancels the fixed fetch/RPC overhead.
 
 Robustness: the TPU (axon) backend can fail or hang during PJRT init.
-Backend init is therefore probed in a *subprocess* with a timeout and
-one retry; on failure the bench falls back to a small CPU run so a JSON
-line is always printed (with "platform" recording what actually ran).
-Errors still produce a machine-readable JSON line on stdout.
+The whole bench runs in a watchdogged child; the budget is sized so the
+worst case (ONE TPU attempt + a CPU fallback) fits inside the driver's
+window with margin (round-3 lesson: two 1500s attempts blew it). The
+child prints a minimal {value, mfu, ips_synthetic} JSON line the moment
+the synthetic phase completes — the optional bulk/loader phases run
+*after* it, each gated on remaining budget, so a hang there can no
+longer cost the headline number: the parent harvests JSON from partial
+stdout even when it must kill the child.
 """
 from __future__ import annotations
 
@@ -45,10 +49,17 @@ import sys
 import tempfile
 import time
 
+_START = time.monotonic()  # process start — the parent's watchdog t0
+
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0
 IO_BASELINE_IMAGES_PER_SEC = 3000.0
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
-PROBE_ATTEMPTS = 2
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
+PROBE_ATTEMPTS = 1
+# Budget gates for the optional phases (seconds of remaining child
+# budget required to *start* the phase; a phase that overruns anyway is
+# cut by the parent watchdog — the minimal JSON line is already out).
+BULK_PHASE_MIN_BUDGET_S = 240
+LOADER_PHASE_MIN_BUDGET_S = 180
 
 # fwd GMACs for ResNet-50 @224 (standard torchvision/fvcore count);
 # x2 FLOPs/MAC, x3 for forward+backward
@@ -139,7 +150,12 @@ def _pack_synthetic_rec(tmpdir, n_images, hw):
     return rec_path
 
 
-def _run_bench(small: bool):
+def _metric_name(small):
+    return ("resnet18_small_train_images_per_sec_per_chip" if small
+            else "resnet50_train_images_per_sec_per_chip")
+
+
+def _run_bench(small: bool, platform: str, deadline: float):
     import jax
     import numpy as onp
     import mxnet_tpu as mx
@@ -187,6 +203,33 @@ def _run_bench(small: bool):
     sec_per_step = max((t_hi - t_lo) / (iters_hi - iters_lo), 1e-9)
     ips_synth = batch / sec_per_step
 
+    # ---- MFU (from the synthetic phase — needed for the early line) ----
+    kind = jax.devices()[0].device_kind
+    peak = _peak_flops(kind)
+    mfu = None
+    if peak is not None:
+        flops_per_step = flops_per_img * batch
+        mfu = flops_per_step / sec_per_step / (peak * n_dev)
+
+    # Emit the headline number NOW: if an optional phase below hangs and
+    # the parent watchdog kills us, this line is what gets harvested.
+    print(json.dumps({
+        "metric": _metric_name(small),
+        "value": round(ips_synth / n_dev, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            ips_synth / n_dev / BASELINE_IMAGES_PER_SEC_PER_CHIP, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "ips_synthetic": round(ips_synth, 2),
+        "platform": platform,
+        "device_kind": kind,
+        "n_devices": n_dev,
+        "partial": True,
+    }), flush=True)
+
+    def remaining():
+        return deadline - time.monotonic()
+
     # bulk mode: N steps scanned inside ONE XLA program
     # (TrainStep.run_chain — the engine bulk-mode equivalent); same
     # two-point delta
@@ -201,97 +244,37 @@ def _run_bench(small: bool):
                 mx.np.zeros((n, batch), dtype="int32"))
 
     ips_bulk = None
-    try:
-        args_lo, args_hi = bulk_args(iters_lo), bulk_args(iters_hi)
-        # each chain length is its own XLA program: warm BOTH before
-        # timing or the delta charges a compile to the long chain
-        timed_bulk(*args_lo)
-        timed_bulk(*args_hi)
-        b_lo = timed_bulk(*args_lo)
-        b_hi = timed_bulk(*args_hi)
-        bulk_step = max((b_hi - b_lo) / (iters_hi - iters_lo), 1e-9)
-        ips_bulk = batch / bulk_step
-    except Exception as e:  # noqa: BLE001 — bulk is a bonus metric
-        print(f"[bench] bulk mode failed: {type(e).__name__}: "
-              f"{str(e)[:200]}", file=sys.stderr, flush=True)
-
-    # ---- MFU ----
-    kind = jax.devices()[0].device_kind
-    peak = _peak_flops(kind)
-    mfu = None
-    if peak is not None:
-        flops_per_step = flops_per_img * batch
-        mfu = flops_per_step / sec_per_step / (peak * n_dev)
+    if remaining() < BULK_PHASE_MIN_BUDGET_S:
+        print(f"[bench] skipping bulk phase ({remaining():.0f}s budget "
+              f"left < {BULK_PHASE_MIN_BUDGET_S})", file=sys.stderr,
+              flush=True)
+    elif os.environ.get("BENCH_SKIP_BULK"):
+        print("[bench] bulk phase skipped by env", file=sys.stderr,
+              flush=True)
+    else:
+        try:
+            ips_bulk = _bulk_phase(step, data, batch, iters_lo, iters_hi,
+                                   mx)
+        except Exception as e:  # noqa: BLE001 — bulk is a bonus metric
+            print(f"[bench] bulk mode failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr, flush=True)
 
     # ---- loader-fed + IO-only (native RecordIO reader) ----
     ips_loader = None
     io_ips = None
-    tmpdir = tempfile.mkdtemp(prefix="bench_rec_")
-    try:
-        from mxnet_tpu.io.native import NativeImageRecordReader, available
-        if available():
-            n_images = max(batch * 4, 256)
-            rec_path = _pack_synthetic_rec(tmpdir, n_images, hw)
-            reader = NativeImageRecordReader(rec_path)
-
-            # IO-only: decode throughput of the native reader
-            idxs = list(range(n_images))
-            reader.read_batch(idxs[:batch], (hw, hw))  # warm page cache
-            t0 = time.perf_counter()
-            done = 0
-            while done < n_images:
-                take = idxs[done:done + batch]
-                reader.read_batch(take, (hw, hw))
-                done += len(take)
-            io_ips = n_images / (time.perf_counter() - t0)
-
-            # loader-fed train step: decode + H2D + step per batch,
-            # with the NEXT batch decoding on a worker thread while the
-            # current one trains (double buffering — the reference's
-            # PrefetcherIter pattern; the native reader decodes in C++
-            # threads with the GIL released, so overlap is real).
-            # Images cross host→device as uint8 (4x less PCIe/tunnel
-            # bytes) and normalize to bf16 ON DEVICE — the 1-vCPU host
-            # cannot afford a 77MB/batch float conversion.
-            from concurrent.futures import ThreadPoolExecutor
-
-            def _load(s):
-                imgs, labels = reader.read_batch(
-                    idxs[s:s + batch], (hw, hw))
-                return (mx.np.array(imgs),  # uint8, H2D
-                        mx.np.array(labels[:, 0].astype(onp.int32)))
-
-            def _feed(d, l):
-                return step(d.astype("bfloat16") / 255.0, l)
-
-            pool = ThreadPoolExecutor(max_workers=1)
-
-            def batches():
-                starts = list(range(0, n_images - batch + 1, batch))
-                fut = pool.submit(_load, starts[0])
-                for s in starts[1:]:
-                    nxt = pool.submit(_load, s)
-                    yield fut.result()
-                    fut = nxt
-                yield fut.result()
-
-            for d, l in batches():  # warmup/compile this input path
-                loss = _feed(d, l)
-                break
-            float(loss.asnumpy())
-            t0 = time.perf_counter()
-            seen = 0
-            for d, l in batches():
-                loss = _feed(d, l)
-                seen += batch
-            float(loss.asnumpy())
-            ips_loader = seen / (time.perf_counter() - t0)
-            reader.close()
-        else:
-            print("[bench] native reader unavailable; skipping loader-fed "
-                  "metrics", file=sys.stderr, flush=True)
-    finally:
-        shutil.rmtree(tmpdir, ignore_errors=True)
+    if remaining() < LOADER_PHASE_MIN_BUDGET_S:
+        print(f"[bench] skipping loader phase ({remaining():.0f}s budget "
+              f"left < {LOADER_PHASE_MIN_BUDGET_S})", file=sys.stderr,
+              flush=True)
+    elif os.environ.get("BENCH_SKIP_LOADER"):
+        print("[bench] loader phase skipped by env", file=sys.stderr,
+              flush=True)
+    else:
+        try:
+            ips_loader, io_ips = _loader_phase(step, batch, hw, mx, onp)
+        except Exception as e:  # noqa: BLE001 — loader is a bonus metric
+            print(f"[bench] loader phase failed: {type(e).__name__}: "
+                  f"{str(e)[:200]}", file=sys.stderr, flush=True)
 
     return {
         "ips_per_chip": ips_synth / n_dev,
@@ -306,7 +289,116 @@ def _run_bench(small: bool):
     }
 
 
-CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "1500"))
+def _bulk_phase(step, data, batch, iters_lo, iters_hi, mx):
+    """N steps scanned inside ONE XLA program (TrainStep.run_chain)."""
+
+    def timed_bulk(d, l):
+        t0 = time.perf_counter()
+        step.run_chain(d, l).asnumpy()
+        return time.perf_counter() - t0
+
+    def bulk_args(n):  # allocated OUTSIDE the timed region
+        return (mx.np.random.uniform(size=(n,) + tuple(data.shape),
+                                     dtype="bfloat16"),
+                mx.np.zeros((n, batch), dtype="int32"))
+
+    args_lo, args_hi = bulk_args(iters_lo), bulk_args(iters_hi)
+    # each chain length is its own XLA program: warm BOTH before
+    # timing or the delta charges a compile to the long chain
+    timed_bulk(*args_lo)
+    timed_bulk(*args_hi)
+    b_lo = timed_bulk(*args_lo)
+    b_hi = timed_bulk(*args_hi)
+    bulk_step = max((b_hi - b_lo) / (iters_hi - iters_lo), 1e-9)
+    return batch / bulk_step
+
+
+def _loader_phase(step, batch, hw, mx, onp):
+    """Native-reader IO throughput + loader-fed train throughput."""
+    from mxnet_tpu.io.native import NativeImageRecordReader, available
+    if not available():
+        print("[bench] native reader unavailable; skipping loader-fed "
+              "metrics", file=sys.stderr, flush=True)
+        return None, None
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_rec_")
+    try:
+        n_images = max(batch * 4, 256)
+        rec_path = _pack_synthetic_rec(tmpdir, n_images, hw)
+        reader = NativeImageRecordReader(rec_path)
+
+        # IO-only: decode throughput of the native reader
+        idxs = list(range(n_images))
+        reader.read_batch(idxs[:batch], (hw, hw))  # warm page cache
+        t0 = time.perf_counter()
+        done = 0
+        while done < n_images:
+            take = idxs[done:done + batch]
+            reader.read_batch(take, (hw, hw))
+            done += len(take)
+        io_ips = n_images / (time.perf_counter() - t0)
+
+        # loader-fed train step: decode + H2D + step per batch,
+        # with the NEXT batch decoding on a worker thread while the
+        # current one trains (double buffering — the reference's
+        # PrefetcherIter pattern; the native reader decodes in C++
+        # threads with the GIL released, so overlap is real).
+        # Images cross host→device as uint8 (4x less PCIe/tunnel
+        # bytes) and normalize to bf16 ON DEVICE — the 1-vCPU host
+        # cannot afford a 77MB/batch float conversion.
+        from concurrent.futures import ThreadPoolExecutor
+
+        def _load(s):
+            imgs, labels = reader.read_batch(
+                idxs[s:s + batch], (hw, hw))
+            return (mx.np.array(imgs),  # uint8, H2D
+                    mx.np.array(labels[:, 0].astype(onp.int32)))
+
+        def _feed(d, l):
+            return step(d.astype("bfloat16") / 255.0, l)
+
+        pool = ThreadPoolExecutor(max_workers=1)
+
+        def batches():
+            starts = list(range(0, n_images - batch + 1, batch))
+            fut = pool.submit(_load, starts[0])
+            for s in starts[1:]:
+                nxt = pool.submit(_load, s)
+                yield fut.result()
+                fut = nxt
+            yield fut.result()
+
+        for d, l in batches():  # warmup/compile this input path
+            loss = _feed(d, l)
+            break
+        float(loss.asnumpy())
+        t0 = time.perf_counter()
+        seen = 0
+        for d, l in batches():
+            loss = _feed(d, l)
+            seen += batch
+        float(loss.asnumpy())
+        ips_loader = seen / (time.perf_counter() - t0)
+        reader.close()
+        return ips_loader, io_ips
+    finally:
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+# Budget: ONE TPU attempt + CPU fallback must fit the driver window
+# with margin (round 3 failed at 2x1500s + fallback). Worst case here:
+# 900 + 480 = 1380s.
+CHILD_TIMEOUT_S = int(os.environ.get("BENCH_CHILD_TIMEOUT", "900"))
+CPU_FALLBACK_TIMEOUT_S = int(os.environ.get("BENCH_CPU_TIMEOUT", "480"))
+
+
+def _harvest(stdout):
+    """Last JSON line from (possibly partial) child stdout, or None."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    lines = [l for l in (stdout or "").strip().splitlines()
+             if l.startswith("{")]
+    return lines[-1] if lines else None
 
 
 def _run_guarded():
@@ -314,43 +406,55 @@ def _run_guarded():
 
     TPU (axon) initialization can hang indefinitely — not just fail —
     when the chip is held by a stale session; a child process is the
-    only reliable watchdog. One retry, then CPU fallback, so a JSON
-    line is always produced."""
+    only reliable watchdog. ONE attempt (the child prints its headline
+    JSON early, so even a killed child usually yields a number), then a
+    short CPU fallback, so a JSON line is always produced."""
     env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
-    for attempt in range(2):
-        try:
-            out = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                                 env=env, capture_output=True, text=True,
-                                 timeout=CHILD_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            print(f"[bench] attempt {attempt + 1} timed out after "
-                  f"{CHILD_TIMEOUT_S}s (TPU init/compile hang); "
-                  "retrying", file=sys.stderr, flush=True)
-            continue
-        lines = [l for l in out.stdout.strip().splitlines()
-                 if l.startswith("{")]
-        if out.returncode == 0 and lines:
-            print(lines[-1])
+    env["BENCH_CHILD_BUDGET"] = str(CHILD_TIMEOUT_S)
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True,
+                             timeout=CHILD_TIMEOUT_S)
+        line = _harvest(out.stdout)
+        if line and out.returncode == 0:
+            print(line)
             return 0
-        print(f"[bench] attempt {attempt + 1} failed rc={out.returncode}: "
+        print(f"[bench] TPU attempt failed rc={out.returncode}: "
               f"{out.stderr.strip()[-400:]}", file=sys.stderr, flush=True)
-    # last resort: CPU small mode in-process
-    print("[bench] all TPU attempts failed; CPU small fallback",
+        if line:  # failed late — the early headline line still counts
+            print(line)
+            return 0
+    except subprocess.TimeoutExpired as e:
+        print(f"[bench] TPU attempt timed out after {CHILD_TIMEOUT_S}s",
+              file=sys.stderr, flush=True)
+        line = _harvest(e.stdout)
+        if line:  # killed mid-optional-phase; headline already printed
+            print(line)
+            return 0
+    # last resort: CPU small mode (short budget; skip optional phases)
+    print("[bench] TPU attempt failed; CPU small fallback",
           file=sys.stderr, flush=True)
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_SMALL"] = "1"
-    out = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                         env=env, capture_output=True, text=True,
-                         timeout=CHILD_TIMEOUT_S)
-    lines = [l for l in out.stdout.strip().splitlines()
-             if l.startswith("{")]
-    if lines:
-        print(lines[-1])
+    env["BENCH_CHILD_BUDGET"] = str(CPU_FALLBACK_TIMEOUT_S)
+    try:
+        out = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                             env=env, capture_output=True, text=True,
+                             timeout=CPU_FALLBACK_TIMEOUT_S)
+        line = _harvest(out.stdout)
+        err = out.stderr
+    except subprocess.TimeoutExpired as e:
+        line = _harvest(e.stdout)
+        err = e.stderr or b""
+    if line:
+        print(line)
         return 0
+    if isinstance(err, bytes):
+        err = err.decode("utf-8", "replace")
     print(json.dumps({"metric": "bench_error", "value": 0.0,
                       "unit": "images/sec/chip", "vs_baseline": 0.0,
-                      "error": out.stderr.strip()[-300:]}))
+                      "error": (err or "").strip()[-300:]}))
     return 1
 
 
@@ -382,8 +486,13 @@ def main():
     if platform == "cpu" and "BENCH_SMALL" not in os.environ:
         small = True
 
+    # Phase-gating deadline: the parent kills us BENCH_CHILD_BUDGET
+    # seconds after spawn; leave 60s margin so the final line gets out.
+    budget = float(os.environ.get("BENCH_CHILD_BUDGET", CHILD_TIMEOUT_S))
+    deadline = _START + budget - 60.0
+
     try:
-        r = _run_bench(small)
+        r = _run_bench(small, platform, deadline)
     except Exception as e:  # noqa: BLE001 — always emit a JSON line
         print(json.dumps({
             "metric": "bench_error",
@@ -396,8 +505,7 @@ def main():
         return 1
 
     print(json.dumps({
-        "metric": "resnet50_train_images_per_sec_per_chip"
-        if not r["small"] else "resnet18_small_train_images_per_sec_per_chip",
+        "metric": _metric_name(r["small"]),
         "value": round(r["ips_per_chip"], 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(

@@ -31,11 +31,14 @@ _DTYPE_ALIASES = {
 }
 
 
-def resolve_dtype(dtype):
+def resolve_dtype(dtype, values=None):
     """Normalize a user-provided dtype to a numpy dtype object.
 
     Accepts numpy dtypes, python types, strings, and ml_dtypes names
-    (e.g. 'bfloat16' resolves through jax.numpy).
+    (e.g. 'bfloat16' resolves through jax.numpy). Every dtype request
+    funnels through here, so the 64-bit narrowing policy below applies
+    uniformly (creation ops, astype, array); pass `values` when host
+    data is at hand to get the integer bounds check.
     """
     if dtype is None:
         return None
@@ -46,10 +49,51 @@ def resolve_dtype(dtype):
 
             return onp.dtype(jnp.bfloat16)
     try:
-        return onp.dtype(dtype)
+        dt = onp.dtype(dtype)
     except TypeError:
         # jax dtypes like jnp.bfloat16 class
-        return onp.dtype(getattr(dtype, "dtype", dtype))
+        dt = onp.dtype(getattr(dtype, "dtype", dtype))
+    return narrow_dtype(values, dt)
+
+
+# 64-bit dtype policy (reference: src/libinfo.cc INT64_TENSOR_SIZE):
+# under the default x64-off jax backend, 64-bit arrays narrow to
+# 32-bit BY DESIGN — integers with an overflow check, floats silently
+# (float64 inputs are almost always numpy's default-dtype accidents,
+# and the reference's compute dtype is float32 anyway). Enabling jax
+# x64 mode keeps true 64-bit arrays end to end.
+_NARROW64 = {"int64": "int32", "uint64": "uint32", "float64": "float32"}
+
+
+def narrow_dtype(values, dtype):
+    """Apply the 64-bit narrowing policy to (host values, dtype).
+
+    Returns the dtype actually used on device. Raises OverflowError —
+    rather than letting jax warn-and-wrap — when integer values do not
+    fit in 32 bits.
+    """
+    if dtype is None:
+        return dtype
+    dtype = onp.dtype(dtype)
+    target = _NARROW64.get(dtype.name)
+    if target is None:
+        return dtype
+    import jax
+
+    if jax.config.jax_enable_x64:
+        return dtype
+    if dtype.kind in "iu" and values is not None:
+        arr = onp.asarray(values)
+        if arr.size and arr.dtype.kind in "iu":
+            info = onp.iinfo(target)
+            if int(arr.max(initial=0)) > info.max or \
+                    int(arr.min(initial=0)) < info.min:
+                raise OverflowError(
+                    f"{dtype.name} value out of {target} range under the "
+                    "default 32-bit index policy; enable jax x64 mode "
+                    "(jax.config.update('jax_enable_x64', True)) for "
+                    "true 64-bit arrays")
+    return onp.dtype(target)
 
 
 def is_np_shape():

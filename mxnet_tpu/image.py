@@ -469,7 +469,8 @@ class ColorNormalizeAug(Augmenter):
 
 
 class RandomGrayAug(Augmenter):
-    _coef = onp.array([[0.299], [0.587], [0.114]], "float32")
+    # reference's luminance weights (image.py:1129) — not BT.601
+    _coef = onp.array([[0.21], [0.72], [0.07]], "float32")
 
     def __init__(self, p):
         super().__init__(p=p)
@@ -512,9 +513,10 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
                     std=None, brightness=0, contrast=0, saturation=0,
                     hue=0, pca_noise=0, rand_gray=0, inter_method=2):
     """Build the standard augmentation list (parity:
-    mx.image.CreateAugmenter, python/mxnet/image/image.py). Order
-    matches the reference: resize → crop → color → lighting → gray →
-    mirror → cast → normalize."""
+    mx.image.CreateAugmenter, python/mxnet/image/image.py:1248-1267).
+    Order matches the reference: resize → crop → mirror → cast →
+    color → lighting → gray → normalize (mirror and cast come right
+    after the crop, before the pixelwise augmenters)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
@@ -529,6 +531,9 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
     if brightness or contrast or saturation:
         auglist.append(ColorJitterAug(brightness, contrast, saturation))
     if hue:
@@ -541,9 +546,6 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False,
         auglist.append(LightingAug(pca_noise, eigval, eigvec))
     if rand_gray > 0:
         auglist.append(RandomGrayAug(rand_gray))
-    if rand_mirror:
-        auglist.append(HorizontalFlipAug(0.5))
-    auglist.append(CastAug())
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:

@@ -1,38 +1,62 @@
-"""Learning-rate schedulers (parity: python/mxnet/lr_scheduler.py)."""
+"""Learning-rate schedulers.
+
+API parity with the reference's ``python/mxnet/lr_scheduler.py``
+(class names, constructor signatures, ``__call__(num_update)``), but a
+different design: every schedule here is a **pure function of
+``num_update``** computed in closed form. The reference mutates
+``self.base_lr`` incrementally inside ``__call__`` (a running
+``count``/``cur_step_ind`` state machine), which makes the schedule
+depend on the call history; these are stateless, so a scheduler can be
+queried at arbitrary points (plotting, resume-from-checkpoint at step
+N, jitted lookup tables) and always returns the same value for the
+same ``num_update``.
+"""
 from __future__ import annotations
 
 import math
 
 
 class LRScheduler:
+    """Base class: warmup handling + the ``__call__`` contract.
+
+    Subclasses implement :meth:`_decayed_lr`, the post-warmup schedule,
+    as a pure function of the number of post-warmup updates.
+    """
+
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
-        self.base_lr = base_lr
-        self.warmup_steps = warmup_steps
-        self.warmup_begin_lr = warmup_begin_lr
-        self.warmup_final_lr = base_lr
-        self.warmup_mode = warmup_mode
-        if warmup_begin_lr > self.warmup_final_lr:
+        if warmup_begin_lr > base_lr:
             raise ValueError("base lr has to be higher than warmup lr")
         if warmup_steps < 0:
             raise ValueError("warmup steps has to be positive or 0")
         if warmup_mode not in ("linear", "constant"):
             raise ValueError("Supports only linear and constant warmup modes")
+        self.base_lr = base_lr
+        self.warmup_steps = warmup_steps
+        self.warmup_begin_lr = warmup_begin_lr
+        self.warmup_final_lr = base_lr
+        self.warmup_mode = warmup_mode
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = ((self.warmup_final_lr - self.warmup_begin_lr)
-                        * float(num_update) / float(self.warmup_steps))
-            return self.warmup_begin_lr + increase
-        return self.warmup_begin_lr
+        if self.warmup_mode == "constant":
+            return self.warmup_begin_lr
+        frac = num_update / self.warmup_steps
+        return self.warmup_begin_lr + frac * (self.warmup_final_lr
+                                              - self.warmup_begin_lr)
+
+    def _decayed_lr(self, steps_after_warmup):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._decayed_lr(num_update - self.warmup_steps)
 
 
 class FactorScheduler(LRScheduler):
-    """lr *= factor every `step` updates."""
+    """``lr = base_lr * factor**k`` after every ``step`` updates,
+    floored at ``stop_factor_lr``."""
 
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
@@ -44,95 +68,81 @@ class FactorScheduler(LRScheduler):
         self.step = step
         self.factor = factor
         self.stop_factor_lr = stop_factor_lr
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
-            self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
-        return self.base_lr
+    def _decayed_lr(self, t):
+        # the k-th decay fires once num_update exceeds k*step (warmup
+        # offset included in the reference's accounting: it counts raw
+        # num_update, so re-add it here)
+        num_update = t + self.warmup_steps
+        n_decays = max(0, (num_update - 1) // self.step)
+        return max(self.base_lr * self.factor ** n_decays,
+                   self.stop_factor_lr)
 
 
 class MultiFactorScheduler(LRScheduler):
-    """lr *= factor at each step in `step` list."""
+    """``lr = base_lr * factor**k`` where ``k`` counts the milestones in
+    ``step`` that ``num_update`` has passed."""
 
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("Schedule step must be greater or equal than 1")
+        if any(b <= a for a, b in zip(step, step[1:])):
+            raise ValueError("Schedule step must be an increasing list")
         if factor > 1.0:
             raise ValueError("Factor must be no more than 1 to make lr reduce")
         self.step = step
-        self.cur_step_ind = 0
         self.factor = factor
-        self.count = 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
-        return self.base_lr
+    def _decayed_lr(self, t):
+        num_update = t + self.warmup_steps
+        n_decays = sum(1 for milestone in self.step
+                       if num_update > milestone)
+        return self.base_lr * self.factor ** n_decays
 
 
-class PolyScheduler(LRScheduler):
-    """Polynomial decay from base_lr to final_lr over max_update steps."""
-
-    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
-                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
-
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                    self.power)
-        return self.base_lr
-
-
-class CosineScheduler(LRScheduler):
-    """Cosine decay from base_lr to final_lr over max_update steps."""
+class _SpanScheduler(LRScheduler):
+    """Shared shape for schedules that anneal base_lr -> final_lr over
+    ``max_update`` total updates: subclasses map the elapsed fraction
+    to a remaining-lr fraction in [0, 1]."""
 
     def __init__(self, max_update, base_lr=0.01, final_lr=0,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
+        if not isinstance(max_update, int) or max_update < 1:
+            raise ValueError(
+                "maximum number of updates must be strictly positive")
         self.max_update = max_update
         self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * (num_update - self.warmup_steps) /
-                              self.max_steps)) / 2
-        return self.base_lr
+    def _remaining(self, frac_elapsed):
+        raise NotImplementedError
+
+    def _decayed_lr(self, t):
+        frac = min(t / self.max_steps, 1.0) if self.max_steps > 0 else 1.0
+        span = self.base_lr - self.final_lr
+        return self.final_lr + span * self._remaining(frac)
+
+
+class PolyScheduler(_SpanScheduler):
+    """Polynomial decay: remaining fraction ``(1 - t/T)**pwr``."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(max_update, base_lr, final_lr,
+                         warmup_steps, warmup_begin_lr, warmup_mode)
+        self.power = pwr
+
+    def _remaining(self, frac_elapsed):
+        return (1.0 - frac_elapsed) ** self.power
+
+
+class CosineScheduler(_SpanScheduler):
+    """Cosine decay: remaining fraction ``(1 + cos(pi * t/T)) / 2``."""
+
+    def _remaining(self, frac_elapsed):
+        return 0.5 * (1.0 + math.cos(math.pi * frac_elapsed))

@@ -28,6 +28,31 @@ from ..base import resolve_dtype
 from ..context import current_context
 from .ndarray import NDArray
 
+# ---------------------------------------------------------------------------
+# Index dtype policy (reference: src/libinfo.cc:39-157 INT64_TENSOR_SIZE
+# build flag). XLA's native index width is int32, so index arrays are
+# int32 by design unless jax x64 mode is on — the shared 64-bit policy
+# in base.narrow_dtype, which bounds-checks host values instead of
+# silently wrapping. Enabling x64 switches index arrays to true int64,
+# the reference's large-tensor build.
+# ---------------------------------------------------------------------------
+def index_dtype():
+    """The dtype used for sparse index/indptr arrays (int32 unless jax
+    x64 mode is enabled)."""
+    from ..base import narrow_dtype
+    return onp.dtype(narrow_dtype(None, onp.int64))
+
+
+def _as_index_array(vals):
+    """Convert host/device values to the index dtype, bounds-checked
+    via base.narrow_dtype (device arrays skip the value check — they
+    are already within the active policy, and re-checking would force
+    a host sync)."""
+    from ..base import narrow_dtype
+    raw = getattr(vals, "_data", vals)
+    host_vals = None if isinstance(raw, jax.Array) else raw
+    return jnp.asarray(raw, narrow_dtype(host_vals, onp.int64))
+
 
 class BaseSparseNDArray(NDArray):
     __slots__ = ("_aux", "_shape")
@@ -200,8 +225,7 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         data, indices = arg1
         data = jnp.asarray(getattr(data, "_data", data),
                            resolve_dtype(dtype) if dtype else None)
-        indices = jnp.asarray(getattr(indices, "_data", indices),
-                              jnp.int64)
+        indices = _as_index_array(indices)
         order = jnp.argsort(indices)
         data, indices = data[order], indices[order]
         if shape is None:
@@ -225,8 +249,8 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
         data, indices, indptr = arg1
         data = jnp.asarray(getattr(data, "_data", data),
                            resolve_dtype(dtype) if dtype else None)
-        indices = jnp.asarray(getattr(indices, "_data", indices), jnp.int64)
-        indptr = jnp.asarray(getattr(indptr, "_data", indptr), jnp.int64)
+        indices = _as_index_array(indices)
+        indptr = _as_index_array(indptr)
         if shape is None:
             raise ValueError("shape required for (data, indices, indptr)")
         out = CSRNDArray.__new__(CSRNDArray)
@@ -245,11 +269,11 @@ def zeros(stype, shape, ctx=None, dtype=None):
     if stype == "row_sparse":
         return row_sparse_array(
             (jnp.zeros((0,) + shape[1:], dtype),
-             jnp.zeros((0,), jnp.int64)), shape=shape, ctx=ctx)
+             jnp.zeros((0,), index_dtype())), shape=shape, ctx=ctx)
     if stype == "csr":
         return csr_matrix(
-            (jnp.zeros((0,), dtype), jnp.zeros((0,), jnp.int64),
-             jnp.zeros((shape[0] + 1,), jnp.int64)), shape=shape, ctx=ctx)
+            (jnp.zeros((0,), dtype), jnp.zeros((0,), index_dtype()),
+             jnp.zeros((shape[0] + 1,), index_dtype())), shape=shape, ctx=ctx)
     if stype == "default":
         from .. import numpy as np_mod
         return np_mod.zeros(shape, dtype=dtype, ctx=ctx)
@@ -373,7 +397,7 @@ def retain(rsp, row_ids):
     sparse_retain, used by the kvstore row_sparse_pull path)."""
     if not isinstance(rsp, RowSparseNDArray):
         raise TypeError("retain expects a RowSparseNDArray")
-    want = jnp.asarray(getattr(row_ids, "_data", row_ids), jnp.int64)
+    want = _as_index_array(row_ids)
     have = rsp._aux[0]
     # membership via sorted search (have is sorted by construction)
     pos = jnp.searchsorted(have, want)

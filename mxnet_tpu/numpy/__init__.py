@@ -21,7 +21,7 @@ import numpy as onp
 import jax
 import jax.numpy as jnp
 
-from ..base import resolve_dtype
+from ..base import narrow_dtype, resolve_dtype
 from ..context import Context, current_context
 from ..ndarray.ndarray import NDArray
 from ..ops import apply_op
@@ -128,7 +128,7 @@ def array(object, dtype=None, ctx=None, device=None):
     if isinstance(object, NDArray):
         data = object._data
         if dtype is not None:
-            data = jnp.asarray(data, resolve_dtype(dtype))
+            data = jnp.asarray(data, narrow_dtype(None, resolve_dtype(dtype)))
         return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
     if dtype is None:
         probe = onp.asarray(object)
@@ -141,7 +141,8 @@ def array(object, dtype=None, ctx=None, device=None):
         npdata = probe.astype(dtype) if probe.dtype != dtype else probe
     else:
         npdata = onp.asarray(object)
-        dtype = resolve_dtype(dtype)
+        dtype = resolve_dtype(dtype, values=npdata)
+    dtype = narrow_dtype(npdata, dtype)  # 64→32-bit backend policy
     data = jax.device_put(jnp.asarray(npdata, dtype), ctx.jax_device)
     return NDArray(engine.track(data), ctx=ctx)
 

@@ -26,9 +26,10 @@ from .. import engine
 from ..random_state import next_key
 
 __all__ = ["Optimizer", "create", "register", "SGD", "NAG", "Adam", "AdamW",
-           "Adamax", "Nadam", "RMSProp", "AdaGrad", "AdaDelta", "Ftrl",
-           "FTML", "LAMB", "LARS", "LANS", "Signum", "SGLD", "DCASGD",
-           "Test", "Updater", "get_updater"]
+           "Adamax", "Nadam", "AdaBelief", "RMSProp", "AdaGrad",
+           "GroupAdaGrad", "AdaDelta", "Ftrl", "FTML", "LAMB", "LARS",
+           "LANS", "Signum", "SGLD", "DCASGD", "Test", "Updater",
+           "get_updater"]
 
 _REGISTRY = {}
 
@@ -372,6 +373,41 @@ class Nadam(Adam):
 
 
 @register
+class AdaBelief(Adam):
+    """AdaBelief — second moment tracks the *surprise* ``(g - m)**2``
+    instead of ``g**2`` (parity: optimizer/adabelief.py). The
+    reference folds epsilon into the variance accumulator each step
+    and adds it again in the denominator; kept for numeric parity."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.correct_bias = correct_bias
+
+    def _hyper(self, index):
+        h = super()._hyper(index)
+        # None/1.0 so the flag stays a static pytree leaf (same trick
+        # as hyper["clip"]) — a bool leaf would be traced by jit
+        h["correct"] = 1.0 if self.correct_bias else None
+        return h
+
+    @staticmethod
+    def _step(w, g, state, hyper):
+        g = Optimizer._pre(g, w, hyper)
+        m, s = state
+        b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
+        m = b1 * m + (1 - b1) * g
+        s = b2 * s + (1 - b2) * jnp.square(g - m) + hyper["eps"]
+        lr_t = hyper["lr"]
+        if hyper["correct"] is not None:
+            tf = t.astype(jnp.float32)
+            lr_t = lr_t * jnp.sqrt(1.0 - jnp.power(b2, tf)) \
+                / (1.0 - jnp.power(b1, tf))
+        return w - lr_t * m / (jnp.sqrt(s) + hyper["eps"]), (m, s)
+
+
+@register
 class RMSProp(Optimizer):
     """RMSProp, optionally centered (parity: optimizer/rmsprop.py)."""
 
@@ -435,6 +471,38 @@ class AdaGrad(Optimizer):
 
 adagrad = AdaGrad
 _REGISTRY["adagrad"] = AdaGrad
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """AdaGrad with one accumulator per ROW (embedding-friendly;
+    parity: optimizer/contrib.py GroupAdaGrad). Weight decay is not
+    supported, matching the reference's assertion."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-6, **kwargs):
+        if kwargs.get("wd"):
+            raise ValueError(
+                "Weight decay is not supported for GroupAdaGrad")
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if weight._data.ndim != 2:
+            raise ValueError("GroupAdaGrad requires 2D weights "
+                             f"(got shape {tuple(weight.shape)})")
+        return (jnp.zeros((weight.shape[0], 1), weight._data.dtype),)
+
+    def _hyper(self, index):
+        h = super()._hyper(index)
+        h["eps"] = onp.float32(self.epsilon)
+        return h
+
+    @staticmethod
+    def _step(w, g, state, hyper):
+        g = Optimizer._pre(g, w, hyper, wd_in_grad=False)
+        (h,) = state
+        h = h + jnp.mean(jnp.square(g), axis=1, keepdims=True)
+        return w - hyper["lr"] * g / (jnp.sqrt(h) + hyper["eps"]), (h,)
 
 
 @register

@@ -42,6 +42,55 @@ def _tree_for(net, step):
     return tree
 
 
+_COUNTERS_FILE = "opt_counters.json"
+
+
+def _save_opt_counters(directory, step):
+    """Persist the optimizer's step counters next to the shards.
+
+    Adam-family bias correction and lr_scheduler position both key off
+    `num_update`; restoring warm moments with t reset to ~1 inflates
+    the effective lr right after resume. Tiny host-side state, so a
+    JSON sidecar (process 0 only) rather than a sharded array.
+    """
+    import json
+    opt = getattr(step, "optimizer", None)
+    if opt is None or jax.process_index() != 0:
+        return
+    payload = {
+        "num_update": int(opt.num_update),
+        "begin_num_update": int(opt.begin_num_update),
+        "index_update_count": {
+            str(k): int(v) for k, v in opt._index_update_count.items()},
+    }
+    path = os.path.join(directory, _COUNTERS_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)  # atomic: never leave a truncated sidecar
+
+
+def _load_opt_counters(directory, step):
+    import json
+    opt = getattr(step, "optimizer", None)
+    path = os.path.join(directory, _COUNTERS_FILE)
+    if opt is None or not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (ValueError, OSError) as e:
+        # counters are an optional extra — a damaged sidecar must not
+        # fail the restore of intact orbax shards
+        import warnings
+        warnings.warn(f"ignoring unreadable {_COUNTERS_FILE}: {e}")
+        return
+    opt.num_update = payload["num_update"]
+    opt.begin_num_update = payload["begin_num_update"]
+    opt._index_update_count = {
+        int(k): v for k, v in payload["index_update_count"].items()}
+
+
 def save_sharded(directory, net, step=None, force=True):
     """Write a sharded checkpoint of `net` (and optionally the
     optimizer states of a `TrainStep`) under `directory`.
@@ -53,6 +102,8 @@ def save_sharded(directory, net, step=None, force=True):
     ckptr = _checkpointer()
     ckptr.save(directory, _tree_for(net, step), force=force)
     ckptr.wait_until_finished()
+    if step is not None:
+        _save_opt_counters(directory, step)
     return directory
 
 
@@ -106,4 +157,6 @@ def load_sharded(directory, net, step=None, mesh=None, rules=None):
         params[name].data()._install(val)
     if step is not None and "opt_states" in restored:
         step._opt_states = list(restored["opt_states"])
+    if step is not None:
+        _load_opt_counters(directory, step)
     return net

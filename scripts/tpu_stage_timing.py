@@ -1,0 +1,67 @@
+"""Instrument each stage of the bench's TPU path: init, trace, compile,
+step. Writes timestamped progress to stdout (run with nohup, tail the
+log). Also tries batch 512 vs 384 for the MFU comparison."""
+import os
+import sys
+import time
+
+T0 = time.time()
+
+
+def log(msg):
+    print(f"[{time.time() - T0:8.1f}s] {msg}", flush=True)
+
+
+def main():
+    batches = [int(b) for b in (sys.argv[1:] or ["384", "512"])]
+    log("importing jax")
+    import jax
+    log("calling jax.devices() (tunnel init)")
+    devs = jax.devices()
+    log(f"devices: {devs[0].device_kind} x{len(devs)}")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    mesh = parallel.make_mesh((1,), ("dp",))
+    parallel.set_mesh(mesh)
+
+    log("building resnet50 NHWC bf16")
+    net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
+    net.initialize()
+    net.cast("bfloat16")
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "multi_precision": True},
+        mesh=mesh, batch_axis="dp")
+
+    flops_per_img = 4.089e9 * 2 * 3
+    peak = 197e12
+
+    for batch in batches:
+        data = mx.np.random.uniform(size=(batch, 224, 224, 3),
+                                    dtype="bfloat16")
+        label = mx.np.zeros((batch,), dtype="int32")
+        log(f"batch {batch}: first step (trace+compile+run)")
+        loss = step(data, label)
+        v = float(loss.asnumpy())
+        log(f"batch {batch}: first step done, loss={v:.3f}")
+
+        def chain(n):
+            t0 = time.perf_counter()
+            for _ in range(n):
+                l = step(data, label)
+            float(l.asnumpy())
+            return time.perf_counter() - t0
+
+        chain(2)  # drain
+        t_lo, t_hi = chain(2), chain(12)
+        sec = (t_hi - t_lo) / 10
+        ips = batch / sec
+        mfu = flops_per_img * ips / peak
+        log(f"batch {batch}: {ips:.1f} img/s  step={sec * 1e3:.1f}ms "
+            f"mfu={mfu:.3f}")
+
+
+if __name__ == "__main__":
+    main()

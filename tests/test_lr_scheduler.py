@@ -1,0 +1,94 @@
+"""lr_scheduler: closed-form schedules, warmup, statelessness."""
+import math
+
+import pytest
+
+from mxnet_tpu import lr_scheduler as lrs
+
+
+def test_factor_decay_points():
+    s = lrs.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(1) == 1.0
+    assert s(10) == 1.0          # decay fires only past the boundary
+    assert s(11) == 0.5
+    assert s(20) == 0.5
+    assert s(21) == 0.25
+    # floor
+    s2 = lrs.FactorScheduler(step=1, factor=0.1, base_lr=1.0,
+                             stop_factor_lr=1e-3)
+    assert s2(100) == pytest.approx(1e-3)
+
+
+def test_factor_is_stateless():
+    s = lrs.FactorScheduler(step=5, factor=0.5, base_lr=1.0)
+    late = s(100)
+    # querying out of order must not corrupt earlier answers
+    assert s(1) == 1.0
+    assert s(100) == late
+
+
+def test_multifactor():
+    s = lrs.MultiFactorScheduler(step=[10, 20], factor=0.1, base_lr=1.0)
+    assert s(10) == 1.0
+    assert s(11) == pytest.approx(0.1)
+    assert s(20) == pytest.approx(0.1)
+    assert s(21) == pytest.approx(0.01)
+    assert s(500) == pytest.approx(0.01)
+
+
+def test_multifactor_validation():
+    with pytest.raises(ValueError):
+        lrs.MultiFactorScheduler(step=[10, 5], factor=0.5)
+    with pytest.raises(ValueError):
+        lrs.MultiFactorScheduler(step=[], factor=0.5)
+    with pytest.raises(ValueError):
+        lrs.MultiFactorScheduler(step=[0, 5], factor=0.5)
+
+
+def test_poly():
+    s = lrs.PolyScheduler(max_update=100, base_lr=1.0, pwr=2, final_lr=0.1)
+    assert s(0) == pytest.approx(1.0)
+    assert s(50) == pytest.approx(0.1 + 0.9 * 0.25)
+    assert s(100) == pytest.approx(0.1)
+    assert s(1000) == pytest.approx(0.1)  # holds final past the horizon
+
+
+def test_cosine():
+    s = lrs.CosineScheduler(max_update=100, base_lr=1.0, final_lr=0.0)
+    assert s(0) == pytest.approx(1.0)
+    assert s(50) == pytest.approx(0.5)
+    assert s(100) == pytest.approx(0.0)
+    assert s(200) == pytest.approx(0.0)
+    # halfway value is exactly (1+cos(pi/2))/2 of the span
+    s2 = lrs.CosineScheduler(max_update=4, base_lr=2.0, final_lr=1.0)
+    assert s2(1) == pytest.approx(1.0 + 0.5 * (1 + math.cos(math.pi / 4)))
+
+
+def test_warmup_linear_and_constant():
+    s = lrs.FactorScheduler(step=100, factor=0.5, base_lr=1.0,
+                            warmup_steps=10, warmup_begin_lr=0.2)
+    assert s(0) == pytest.approx(0.2)
+    assert s(5) == pytest.approx(0.2 + 0.5 * 0.8)
+    assert s(10) == pytest.approx(1.0)  # first post-warmup step
+    c = lrs.CosineScheduler(max_update=100, base_lr=1.0, warmup_steps=10,
+                            warmup_begin_lr=0.3, warmup_mode="constant")
+    assert c(7) == pytest.approx(0.3)
+
+
+def test_warmup_validation():
+    with pytest.raises(ValueError):
+        lrs.LRScheduler(base_lr=0.1, warmup_begin_lr=0.5)
+    with pytest.raises(ValueError):
+        lrs.LRScheduler(warmup_steps=-1)
+    with pytest.raises(ValueError):
+        lrs.LRScheduler(warmup_mode="exponential")
+
+
+def test_optimizer_integration():
+    from mxnet_tpu import optimizer as opt
+    sched = lrs.MultiFactorScheduler(step=[2], factor=0.1)
+    sgd = opt.create("sgd", learning_rate=1.0, lr_scheduler=sched)
+    assert sgd.learning_rate == pytest.approx(1.0)
+    for _ in range(5):
+        sgd.num_update += 1
+    assert sgd.learning_rate == pytest.approx(0.1)

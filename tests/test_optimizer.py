@@ -1,0 +1,99 @@
+"""AdaBelief / GroupAdaGrad parity against manual numpy references."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+
+
+def _nd(a):
+    return mx.np.array(onp.asarray(a, "float32"))
+
+
+def test_adabelief_matches_manual_reference():
+    rng = onp.random.RandomState(0)
+    w = rng.randn(5, 4).astype("float32")
+    o = opt.create("adabelief", learning_rate=0.01, beta1=0.9,
+                   beta2=0.999, epsilon=1e-6, wd=0.01)
+    weight = _nd(w)
+    state = o.create_state(0, weight)
+
+    m = onp.zeros_like(w)
+    s = onp.zeros_like(w)
+    ref_w = w.copy()
+    for t in range(1, 4):
+        g = rng.randn(5, 4).astype("float32")
+        o.update(0, weight, _nd(g), state)
+        state = o._last_states[0]
+
+        gr = g + 0.01 * ref_w
+        m = 0.9 * m + 0.1 * gr
+        s = 0.999 * s + 0.001 * (gr - m) ** 2 + 1e-6
+        lr_t = 0.01 * onp.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        ref_w = ref_w - lr_t * m / (onp.sqrt(s) + 1e-6)
+        onp.testing.assert_allclose(weight.asnumpy(), ref_w,
+                                    rtol=2e-5, atol=2e-6)
+
+
+def test_adabelief_no_bias_correction():
+    o = opt.create("adabelief", learning_rate=0.1, correct_bias=False)
+    w0 = onp.ones((3,), "float32")
+    weight = _nd(w0)
+    state = o.create_state(0, weight)
+    g = onp.full((3,), 0.5, "float32")
+    o.update(0, weight, _nd(g), state)
+    m = 0.1 * g
+    s = 0.001 * (g - m) ** 2 + 1e-6
+    ref = w0 - 0.1 * m / (onp.sqrt(s) + 1e-6)
+    onp.testing.assert_allclose(weight.asnumpy(), ref, rtol=1e-5)
+
+
+def test_group_adagrad_matches_manual_reference():
+    rng = onp.random.RandomState(1)
+    w = rng.randn(6, 3).astype("float32")
+    o = opt.create("groupadagrad", learning_rate=0.05, epsilon=1e-6)
+    weight = _nd(w)
+    state = o.create_state(0, weight)
+    assert state[0].shape == (6, 1)  # one accumulator per row
+
+    hist = onp.zeros((6, 1), "float32")
+    ref_w = w.copy()
+    for _ in range(3):
+        g = rng.randn(6, 3).astype("float32")
+        o.update(0, weight, _nd(g), state)
+        state = o._last_states[0]
+
+        hist = hist + onp.mean(g ** 2, axis=1, keepdims=True)
+        ref_w = ref_w - 0.05 * g / (onp.sqrt(hist) + 1e-6)
+        onp.testing.assert_allclose(weight.asnumpy(), ref_w,
+                                    rtol=2e-5, atol=2e-6)
+
+
+def test_group_adagrad_rejects_wd_and_non2d():
+    with pytest.raises(ValueError):
+        opt.create("groupadagrad", wd=0.1)
+    o = opt.create("groupadagrad")
+    with pytest.raises(ValueError):
+        o.create_state(0, _nd(onp.zeros((4,))))
+
+
+@pytest.mark.parametrize("name", ["adabelief", "groupadagrad"])
+def test_trains_a_dense_layer(name):
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(1, in_units=4, use_bias=(name != "groupadagrad"))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), name,
+                       {"learning_rate": 0.1})
+    x = mx.np.random.normal(size=(16, 4))
+    y = x.sum(axis=1, keepdims=True) * 0.5  # exactly representable
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for _ in range(25):
+        with autograd.record():
+            l = loss_fn(net(x), y).mean()
+        l.backward()
+        tr.step(1)
+        if first is None:
+            first = float(l.asnumpy())
+    assert float(l.asnumpy()) < first * 0.5

@@ -139,3 +139,31 @@ def test_cast_storage_errors():
         mx.nd.array(onp.zeros((2, 2, 2), "float32")).tostype("csr")
     with pytest.raises(ValueError):
         sparse.zeros("bogus", (2, 2))
+
+
+def test_index_dtype_policy():
+    """int32-by-design indices: no silent truncation, explicit
+    OverflowError past int32 range (reference: libinfo INT64 flag)."""
+    import warnings
+    from mxnet_tpu.ndarray.sparse import index_dtype
+    assert index_dtype() == onp.int32  # x64 off in the test env
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any truncation warning fails
+        rsp = sparse.row_sparse_array(
+            (onp.ones((2, 3), "float32"),
+             onp.array([1, 4], dtype=onp.int64)), shape=(8, 3))
+        assert rsp.indices.dtype == onp.int32
+
+    with pytest.raises(OverflowError):
+        sparse.row_sparse_array(
+            (onp.ones((1, 3), "float32"),
+             onp.array([2 ** 40], dtype=onp.int64)), shape=(8, 3))
+
+
+def test_array_int64_bounds_policy():
+    """mx.np.array with int64 dtype narrows checked, not wrapped."""
+    a = mx.np.array([1, 4], dtype="int64")
+    assert a.dtype == onp.int32
+    with pytest.raises(OverflowError):
+        mx.np.array([2 ** 40], dtype="int64")

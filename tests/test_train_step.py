@@ -214,9 +214,15 @@ def test_sharded_checkpoint_roundtrip(tmp_path):
         # weight placement restored as tp-sharded
         w = net2[0].weight.data()._data
         assert w.sharding.spec == P("tp", None)
+        # optimizer step counters restored: Adam bias correction must
+        # resume at t≈4, not restart near 1 with warm moments
+        assert step2.optimizer.num_update == step.optimizer.num_update
+        assert (step2.optimizer._index_update_count
+                == step.optimizer._index_update_count)
         # training continues from the restored state
         l1 = float(step2(x, y).asnumpy())
         assert onp.isfinite(l1)
         assert len(step2._opt_states) == len(want_states)
+        assert step2.optimizer.num_update == step.optimizer.num_update + 1
     finally:
         parallel.set_mesh(old)

@@ -84,10 +84,12 @@ def narrow_dtype(values, dtype):
         return dtype
     if dtype.kind in "iu" and values is not None:
         arr = onp.asarray(values)
-        if arr.size and arr.dtype.kind in "iu":
+        # float host data feeding an integer dtype must bounds-check
+        # too (e.g. array([1e12], dtype='int64'))
+        if arr.size and arr.dtype.kind in "iuf":
             info = onp.iinfo(target)
-            if int(arr.max(initial=0)) > info.max or \
-                    int(arr.min(initial=0)) < info.min:
+            if arr.max(initial=0) > info.max or \
+                    arr.min(initial=0) < info.min:
                 raise OverflowError(
                     f"{dtype.name} value out of {target} range under the "
                     "default 32-bit index policy; enable jax x64 mode "

@@ -128,7 +128,7 @@ def array(object, dtype=None, ctx=None, device=None):
     if isinstance(object, NDArray):
         data = object._data
         if dtype is not None:
-            data = jnp.asarray(data, narrow_dtype(None, resolve_dtype(dtype)))
+            data = jnp.asarray(data, resolve_dtype(dtype))
         return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
     if dtype is None:
         probe = onp.asarray(object)
@@ -139,10 +139,10 @@ def array(object, dtype=None, ctx=None, device=None):
             # mx.np.array([1, 2]) is float32)
             dtype = _default_float
         npdata = probe.astype(dtype) if probe.dtype != dtype else probe
+        dtype = narrow_dtype(npdata, dtype)  # 64→32 backend policy
     else:
         npdata = onp.asarray(object)
-        dtype = resolve_dtype(dtype, values=npdata)
-    dtype = narrow_dtype(npdata, dtype)  # 64→32-bit backend policy
+        dtype = resolve_dtype(dtype, values=npdata)  # narrows + checks
     data = jax.device_put(jnp.asarray(npdata, dtype), ctx.jax_device)
     return NDArray(engine.track(data), ctx=ctx)
 
@@ -295,7 +295,7 @@ def tril_indices(n, k=0, m=None):
 
 def indices(dimensions, dtype=None, ctx=None):
     ctx = ctx or current_context()
-    data = jnp.indices(dimensions, dtype=resolve_dtype(dtype) or onp.int64)
+    data = jnp.indices(dimensions, dtype=resolve_dtype(dtype or onp.int64))
     return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
 
 
@@ -320,8 +320,10 @@ mod = _mkbin(jnp.mod, "mod")
 remainder = _mkbin(jnp.remainder, "remainder")
 fmod = _mkbin(jnp.fmod, "fmod")
 power = _mkbin(jnp.power, "power")
-float_power = _mkbin(lambda a, b: jnp.power(jnp.asarray(a, jnp.float64), b),
-                     "float_power")
+float_power = _mkbin(
+    lambda a, b: jnp.power(
+        jnp.asarray(a, resolve_dtype(onp.float64)), b),
+    "float_power")
 maximum = _mkbin(jnp.maximum, "maximum")
 minimum = _mkbin(jnp.minimum, "minimum")
 fmax = _mkbin(jnp.fmax, "fmax")

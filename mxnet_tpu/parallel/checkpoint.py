@@ -79,16 +79,19 @@ def _load_opt_counters(directory, step):
     try:
         with open(path) as f:
             payload = json.load(f)
-    except (ValueError, OSError) as e:
-        # counters are an optional extra — a damaged sidecar must not
-        # fail the restore of intact orbax shards
+        num_update = payload["num_update"]
+        begin = payload["begin_num_update"]
+        index_counts = {
+            int(k): v for k, v in payload["index_update_count"].items()}
+    except (ValueError, OSError, KeyError, TypeError, AttributeError) as e:
+        # counters are an optional extra — a damaged or foreign-format
+        # sidecar must not fail the restore of intact orbax shards
         import warnings
-        warnings.warn(f"ignoring unreadable {_COUNTERS_FILE}: {e}")
+        warnings.warn(f"ignoring unreadable {_COUNTERS_FILE}: {e!r}")
         return
-    opt.num_update = payload["num_update"]
-    opt.begin_num_update = payload["begin_num_update"]
-    opt._index_update_count = {
-        int(k): v for k, v in payload["index_update_count"].items()}
+    opt.num_update = num_update
+    opt.begin_num_update = begin
+    opt._index_update_count = index_counts
 
 
 def save_sharded(directory, net, step=None, force=True):

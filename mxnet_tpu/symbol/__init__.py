@@ -13,10 +13,20 @@ Like the reference's 2.x line, the Executor shim delegates to the
 imperative autograd machinery for gradients
 (python/mxnet/executor.py:124 delegates to CachedOp + autograd).
 """
-from . import _ops  # registers generated op wrappers  # noqa: F401
+from . import _ops  # generated op wrappers (PEP 562)  # noqa: F401
 from ._ops import *  # noqa: F401,F403
 # core names last so they win any collision with generated op wrappers
 from .symbol import (  # noqa: E402,F401
     Symbol, var, Variable, Group, load, load_json, fromjson,
     zeros, ones, full,
 )
+
+
+def __getattr__(name):
+    """Op wrappers are generated on demand from mx.np/mx.npx
+    (reference parity: symbol/register.py codegen at import)."""
+    return getattr(_ops, name)
+
+
+def __dir__():
+    return sorted(set(globals()) | set(dir(_ops)))

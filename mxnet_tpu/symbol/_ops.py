@@ -1,117 +1,246 @@
-"""Symbol op wrappers, generated over the mx.np / mx.npx namespaces.
+"""Symbol op wrappers, GENERATED over the mx.np / mx.npx namespaces.
 
 The reference text-generates per-op Symbol functions from the nnvm
-registry at import (python/mxnet/symbol/register.py). Here the op
-table IS the numpy-API function table: a symbol node names a function
-in `mx.np` (or `mx.npx` with the "npx:" prefix) and stores its static
-kwargs; evaluation applies it to NDArrays (eagerly or under a jit
-trace — same funnel as every other op, ops/apply_op).
+registry at import (python/mxnet/symbol/register.py:115-277). Here the
+op table IS the numpy-API function table: every public callable in
+`mx.np`, `mx.npx`, `mx.np.linalg`, `mx.np.random` and `mx.np.fft`
+gets a symbol wrapper on first attribute access (PEP 562 module
+__getattr__ — the lazy equivalent of the reference's import-time
+codegen). A symbol node names the function (with a namespace prefix
+for non-np tables) and stores its static kwargs; evaluation applies it
+to NDArrays (eagerly or under a jit trace — the same funnel as every
+other op, ops/apply_op).
+
+Ops that cannot be graph nodes are listed in EXCLUDED with a reason;
+accessing them raises AttributeError carrying that reason.
 """
 from __future__ import annotations
 
 import sys
+import types
 
 from .symbol import Symbol, _compose
 
-# ops whose sym wrapper takes (data) or (lhs, rhs) positional Symbols;
-# everything else in kwargs is a static attr recorded on the node.
-_NP_OPS = [
-    # elementwise unary
-    "negative", "abs", "exp", "expm1", "log", "log2", "log10", "log1p",
-    "sqrt", "cbrt", "square", "reciprocal", "sign", "floor", "ceil",
-    "trunc", "rint", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
-    "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
-    # binary
-    "add", "subtract", "multiply", "divide", "mod", "power", "maximum",
-    "minimum", "hypot", "arctan2", "copysign",
-    # comparison
-    "equal", "not_equal", "greater", "greater_equal", "less",
-    "less_equal", "logical_and", "logical_or", "logical_xor",
-    # reduce ("var" deliberately absent: mx.sym.var is the Variable
-    # constructor, as in the reference)
-    "sum", "mean", "prod", "max", "min", "argmax", "argmin", "std",
-    "norm",
-    # linalg / contraction
-    "dot", "matmul", "tensordot", "einsum",
-    # shape ("split" gets a custom multi-output wrapper below)
-    "reshape", "transpose", "swapaxes", "expand_dims", "squeeze",
-    "concatenate", "stack", "flip", "tile", "repeat",
-    "broadcast_to", "where", "clip", "take", "ravel",
-    # misc
-    "round", "floor_divide", "fmod", "absolute",
-    # widened table (round-3: the reference's symbol surface covers the
-    # full op registry; anything with Symbol-positional + static-kwarg
-    # form lowers through the same mx.np table)
-    "degrees", "radians", "deg2rad",
-    "rad2deg", "exp2", "fabs", "positive", "invert",
-    "isnan", "isinf", "isfinite", "isneginf", "isposinf",
-    "logaddexp", "logaddexp2", "ldexp", "gcd", "lcm",
-    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
-    "left_shift", "right_shift",
-    "true_divide", "remainder", "float_power", "heaviside",
-    "nanmax", "nanmin", "nansum", "nanprod", "nanmean", "nanstd",
-    "nanvar", "median", "quantile", "percentile", "average", "ptp",
-    "cumsum", "cumprod", "nancumsum", "nancumprod",
-    "all", "any", "count_nonzero",
-    "sort", "argsort", "partition", "argpartition", "msort",
-    "unique", "diff", "ediff1d", "searchsorted", "digitize",
-    "trapz", "interp", "cross", "kron", "outer", "inner", "vdot",
-    "trace", "diagonal", "diag", "diagflat", "tril", "triu",
-    "atleast_1d", "atleast_2d", "atleast_3d",
-    "vstack", "hstack", "dstack", "column_stack", "row_stack",
-    "moveaxis", "rollaxis", "roll", "rot90", "fliplr", "flipud",
-    "pad", "insert", "delete", "append", "resize",
-    "nonzero", "flatnonzero", "argwhere", "extract", "compress",
-    "take_along_axis", "sign", "signbit", "copysign", "nextafter",
-    "spacing", "modf", "frexp", "trunc", "rint", "fix", "around",
-    "real", "imag", "conj", "conjugate", "angle",
-    "sinc", "i0", "nan_to_num", "unwrap", "gradient", "convolve",
-    "correlate", "histogram", "bincount", "corrcoef", "cov",
-    "polyval", "meshgrid", "indices", "unravel_index",
-    "maximum", "minimum", "fmax", "fmin", "hypot",
-    "greater", "greater_equal", "less", "less_equal", "not_equal",
-    "equal", "logical_not", "isclose", "array_equal",
-]
+# ---------------------------------------------------------------------
+# Ops that are deliberately NOT symbolizable. Keys are opperf-style
+# qualified names ("np.var", "random.seed"). The sweep test
+# (tests/test_symbol_gen.py) enforces that every public op either
+# symbol-round-trips or appears here.
+EXCLUDED = {
+    # name collision with the Variable constructor (reference parity:
+    # mx.sym.var is Variable there too)
+    "np.var": "mx.sym.var is the Variable constructor; compute "
+              "variance via mx.sym.std(x)**2 or mean((x-mean)^2)",
+    # host-data constructors — a graph leaf is mx.sym.var (or
+    # zeros/ones/full for constants), not python data
+    "np.array": "host-data constructor; use mx.sym.var",
+    "np.asarray": "host-data constructor; use mx.sym.var",
+    "np.fromiter": "consumes a python iterator; not a graph op",
+    "np.genfromtxt": "reads a file; not a graph op",
+    # python-value (non-array) results — graph outputs are arrays
+    "np.ndim": "returns a python int; use npx.shape_array",
+    "np.shape": "returns a python tuple; use npx.shape_array",
+    "np.size": "returns a python int; use npx.shape_array",
+    "np.get_printoptions": "printing config, not a tensor op",
+    "np.set_printoptions": "printing config, not a tensor op",
+    "np.get_include": "build-system helper, not a tensor op",
+    "np.may_share_memory": "aliasing introspection on live buffers",
+    "np.shares_memory": "aliasing introspection on live buffers",
+    "np.can_cast": "dtype predicate (python bool), not a tensor op",
+    "np.promote_types": "returns a dtype object, not a tensor op",
+    "np.result_type": "returns a dtype object, not a tensor op",
+    "np.narrow_dtype": "dtype helper, not a tensor op",
+    "np.resolve_dtype": "dtype helper, not a tensor op",
+    # IO / runtime state
+    "np.save": "file IO side effect, not a graph op",
+    "np.savez": "file IO side effect, not a graph op",
+    "np.load": "file IO; not a graph op",
+    "np.current_context": "runtime introspection",
+    "npx.save": "file IO side effect, not a graph op",
+    "npx.load": "file IO; not a graph op",
+    "npx.waitall": "engine sync, not a graph op",
+    "npx.set_np": "global mode switch",
+    "npx.reset_np": "global mode switch",
+    "npx.is_np_array": "global mode introspection",
+    "npx.is_np_shape": "global mode introspection",
+    "npx.current_device": "runtime introspection",
+    "npx.num_gpus": "runtime introspection",
+    "npx.next_key": "PRNG key state, not a graph op",
+    # dispatch funnel itself (exported in every op namespace)
+    "np.apply_op": "the dispatch funnel itself",
+    "npx.apply_op": "the dispatch funnel itself",
+    "linalg.apply_op": "the dispatch funnel itself",
+    "random.apply_op": "the dispatch funnel itself",
+    "fft.apply_op": "the dispatch funnel itself",
+    # control flow takes python callables — not JSON-serializable;
+    # the hybridize path captures python control flow by tracing
+    "npx.cond": "takes python callables; hybridize traces these",
+    "npx.foreach": "takes python callables; hybridize traces these",
+    "npx.while_loop": "takes python callables; hybridize traces these",
+    "random.seed": "global PRNG state, not a graph op",
+    "random.next_key": "PRNG key state, not a graph op",
+    "random.current_context": "runtime introspection",
+    "random.resolve_dtype": "dtype helper, not a tensor op",
+}
 
-_NPX_OPS = [
-    "relu", "sigmoid", "log_sigmoid", "softmax", "log_softmax",
-    "leaky_relu", "activation", "fully_connected", "convolution",
-    "pooling", "batch_norm", "layer_norm", "dropout", "one_hot",
-    "pick", "topk", "batch_dot", "embedding", "rnn", "sequence_mask",
-    "gamma", "erf", "erfinv",
-    # widened npx table (round-3)
-    "softplus", "softsign", "mish", "gelu", "silu", "hard_sigmoid",
-    "hard_swish", "softmin", "masked_softmax", "masked_log_softmax",
-    "deconvolution", "group_norm", "instance_norm", "rms_norm",
-    "l2_normalization", "sequence_last", "sequence_reverse",
-    "gather_nd", "scatter_nd", "index_add", "index_update",
-    "shape_array", "reshape_like", "broadcast_like", "arange_like",
-    "slice_axis", "slice_like", "boolean_mask", "one_hot",
-    "ctc_loss", "multibox_prior", "roi_pooling", "flash_attention",
-    "digamma", "gammaln", "rsqrt", "rcbrt",
-]
+# Ops whose first argument is a *sequence* of arrays: the wrapper
+# accepts either a sequence or varargs of Symbols, and the node records
+# __pack__ so _eval re-packs the inputs into one list argument.
+_SEQ_OPS = {
+    "concatenate", "concat", "stack", "vstack", "hstack", "dstack",
+    "column_stack", "row_stack", "lexsort", "array_equal", "block",
+    "multi_dot", "multi_all_finite", "multi_sum_sq", "all_finite",
+}
+# but these two take a plain (non-packed) first array too — keep the
+# generic calling convention for single-array use; varargs-of-arrays
+# ops below take *args natively (no packing needed):
+_SEQ_OPS -= {"array_equal", "all_finite"}
+
+# Static output arity for multi-output ops: int, or callable
+# (args, attrs) -> int. Everything absent defaults to 1 output.
+_MULTI_OUT = {
+    "modf": 2, "frexp": 2, "divmod": 2, "histogram": 2,
+    "tril_indices_from": 2, "triu_indices_from": 2,
+    "diag_indices_from": 2,
+    "linalg.qr": 2, "linalg.eig": 2, "linalg.eigh": 2,
+    "linalg.slogdet": 2, "linalg.lstsq": 4,
+    "linalg.svd": lambda args, attrs: 3
+    if attrs.get("compute_uv", True) else 1,
+    "unique": lambda args, attrs: 1 + sum(
+        bool(attrs.get(k)) for k in
+        ("return_index", "return_inverse", "return_counts")),
+    "meshgrid": lambda args, attrs: max(len(args), 1),
+    "broadcast_arrays": lambda args, attrs: max(len(args), 1),
+}
 
 
-def _make_np(opname):
-    def wrapper(*inputs, name=None, **attrs):
-        syms = [x for x in inputs]
-        return _compose(opname, tuple(syms), name=name, **attrs)
-    wrapper.__name__ = opname
-    wrapper.__qualname__ = opname
-    wrapper.__doc__ = f"Symbolic version of mx.np.{opname}."
+def _namespaces():
+    import mxnet_tpu as mx
+    return {
+        "np": mx.np, "npx": mx.npx, "linalg": mx.np.linalg,
+        "random": mx.np.random, "fft": mx.np.fft,
+    }
+
+
+def _table_key(prefix, name):
+    return name if prefix == "np" else f"{prefix}:{name}"
+
+
+def _make(prefix, name):
+    """Build the generic symbol wrapper for one op."""
+    key = _table_key(prefix, name)
+    qual = f"{prefix}.{name}"
+    pack = name in _SEQ_OPS
+    n_out = _MULTI_OUT.get(qual, _MULTI_OUT.get(name))
+
+    def wrapper(*args, name=None, **attrs):
+        extra = {}
+        if pack:
+            if len(args) >= 1 and isinstance(args[0], (tuple, list)):
+                # sequence form: pack exactly the sequence elements;
+                # trailing positionals (e.g. an axis) stay scalar args
+                seq = tuple(args[0])
+                extra["__pack__"] = len(seq)
+                args = seq + tuple(args[1:])
+            else:
+                # varargs form: symbols form the sequence, the scalar
+                # tail (axis etc.) stays outside the pack
+                n_sym = len(args)
+                while n_sym and not isinstance(args[n_sym - 1], Symbol):
+                    n_sym -= 1
+                extra["__pack__"] = n_sym
+        n = n_out(args, attrs) if callable(n_out) else n_out
+        if n is not None and n > 1:
+            extra["__num_outputs__"] = n
+        return _compose(key, args, name=name, **extra, **attrs)
+
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = (f"Symbolic version of mx.{qual} "
+                       f"(generated wrapper).")
     return wrapper
 
 
-def _make_npx(opname):
-    key = f"npx:{opname}"
+# wrapper cache, keyed (prefix-or-None, name). A dict — NOT setattr on
+# the module — so generated names can never shadow builtins referenced
+# by this module's own code (sum/max/abs/...).
+_CACHE = {}
 
-    def wrapper(*inputs, name=None, **attrs):
-        return _compose(key, tuple(inputs), name=name, **attrs)
-    wrapper.__name__ = opname
-    wrapper.__qualname__ = opname
-    wrapper.__doc__ = f"Symbolic version of mx.npx.{opname}."
-    return wrapper
 
+def _generate(prefix, name):
+    """Resolve `name` in the op namespace(s) → symbol wrapper.
+
+    For the top level (prefix None) the lookup order is np then npx —
+    the same order op_table() resolves node names in.
+    """
+    if (prefix, name) in _CACHE:
+        return _CACHE[(prefix, name)]
+    tries = [(prefix, name)] if prefix else [("np", name), ("npx", name)]
+    ns = _namespaces()
+    for pre, n in tries:
+        qual = f"{pre}.{n}"
+        if qual in EXCLUDED:
+            raise AttributeError(
+                f"mx.sym has no op {n!r}: {EXCLUDED[qual]}")
+        fn = getattr(ns[pre], n, None)
+        if callable(fn) and not isinstance(fn, type):
+            w = _make(pre, n)
+            _CACHE[(prefix, name)] = w
+            return w
+    raise AttributeError(f"no op {name!r} in "
+                         + "/".join(f"mx.{p}" for p, _ in tries))
+
+
+class _SubNS(types.ModuleType):
+    """mx.sym.linalg / mx.sym.random / mx.sym.fft — generated lazily."""
+
+    def __init__(self, prefix):
+        super().__init__(f"{__name__}.{prefix}")
+        self._prefix = prefix
+        self.__doc__ = (f"Symbolic wrappers over mx.np.{prefix} "
+                        f"(generated; see symbol/_ops.py).")
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return _generate(self._prefix, name)
+
+    def __dir__(self):
+        ns = _namespaces()[self._prefix]
+        return sorted(
+            n for n in dir(ns) if not n.startswith("_")
+            and f"{self._prefix}.{n}" not in EXCLUDED
+            and callable(getattr(ns, n, None)))
+
+
+linalg = _SubNS("linalg")
+random = _SubNS("random")
+fft = _SubNS("fft")
+sys.modules[linalg.__name__] = linalg
+sys.modules[random.__name__] = random
+sys.modules[fft.__name__] = fft
+
+
+def __getattr__(name):  # PEP 562: top-level generated wrappers
+    if name.startswith("__"):
+        raise AttributeError(name)
+    return _generate(None, name)
+
+
+def __dir__():
+    ns = _namespaces()
+    names = set(globals())
+    for pre in ("np", "npx"):
+        names.update(
+            n for n in dir(ns[pre]) if not n.startswith("_")
+            and f"{pre}.{n}" not in EXCLUDED
+            and callable(getattr(ns[pre], n, None))
+            and not isinstance(getattr(ns[pre], n, None), type))
+    names.discard("var")  # mx.sym.var is the Variable constructor
+    return sorted(names)
+
+
+# -- hand-written wrappers (signatures the generic form can't carry) --
 
 def split(data, indices_or_sections, axis=0, name=None):
     """Symbolic mx.np.split — a true multi-output Symbol.
@@ -138,16 +267,8 @@ def topk(data, k=1, axis=-1, ret_typ="indices", name=None, **attrs):
                     ret_typ=ret_typ, __num_outputs__=n_out, **attrs)
 
 
-_this = sys.modules[__name__]
-__all__ = ["split", "topk"]
-for _op in dict.fromkeys(_NP_OPS):   # de-duplicated, order-preserving
-    if not hasattr(_this, _op):
-        setattr(_this, _op, _make_np(_op))
-        __all__.append(_op)
-for _op in dict.fromkeys(_NPX_OPS):
-    if not hasattr(_this, _op):
-        setattr(_this, _op, _make_npx(_op))
-        __all__.append(_op)
+__all__ = ["split", "topk", "linalg", "random", "fft", "EXCLUDED"]
+
 
 def _sum_args(xs):
     out = xs[0]
@@ -178,27 +299,38 @@ def _legacy_reshape(x, shape=None):
     return x.reshape(tuple(out))
 
 
+class _LazyTable(dict):
+    """node-op name → callable, resolved against the live namespaces on
+    first miss (so ANY generated wrapper's node evals without a
+    hand-kept list)."""
+
+    def __missing__(self, key):
+        import mxnet_tpu as mx
+        ns = _namespaces()
+        if ":" in key:
+            prefix, name = key.split(":", 1)
+            fn = getattr(ns.get(prefix, mx.npx), name, None)
+        else:
+            fn = getattr(mx.np, key, None)
+            if fn is None or isinstance(fn, type):
+                fn = getattr(mx.npx, key, None)
+        if not callable(fn):
+            raise KeyError(f"symbol op table has no entry for {key!r}")
+        self[key] = fn
+        return fn
+
+
 _TABLE = None
 
 
 def op_table():
-    """name → callable over NDArrays (resolved lazily to avoid import
-    cycles; unknown names fail loudly at eval time)."""
+    """name → callable over NDArrays (resolved lazily against the
+    np/npx namespaces; unknown names fail loudly at eval time)."""
     global _TABLE
     if _TABLE is None:
         import mxnet_tpu as mx
 
-        table = {}
-        for op in _NP_OPS:
-            fn = getattr(mx.np, op, None)
-            if fn is None:
-                fn = getattr(mx.npx, op, None)
-            if fn is not None:
-                table[op] = fn
-        for op in _NPX_OPS:
-            fn = getattr(mx.npx, op, None)
-            if fn is not None:
-                table[f"npx:{op}"] = fn
+        table = _LazyTable()
         table["split"] = mx.np.split
         table["_scalar"] = lambda value=None: value
         # adapters emitted by the legacy nnvm importer (legacy_json.py)

@@ -191,6 +191,9 @@ class Symbol:
             else:
                 fn = _ops.op_table()[node.op]
                 ins = [vals[i][idx] for i, idx in node.inputs]
+                pack = node.attrs.get("__pack__")
+                if pack:  # first `pack` inputs form one sequence arg
+                    ins = [ins[:pack]] + ins[pack:]
                 attrs = {k: v for k, v in node.attrs.items()
                          if not k.startswith("__")}
                 out = fn(*ins, **attrs)

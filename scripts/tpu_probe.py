@@ -1,0 +1,15 @@
+import sys, time
+sys.path.insert(0, "/root/repo")
+t0=time.time()
+import tpu_platform
+import jax
+print(f"import+platform: {time.time()-t0:.1f}s", flush=True)
+t0=time.time()
+devs = jax.devices()
+print(f"jax.devices(): {time.time()-t0:.1f}s -> {devs}", flush=True)
+import jax.numpy as jnp
+t0=time.time()
+x = jnp.ones((1024,1024), jnp.bfloat16)
+import numpy as onp
+v = onp.asarray((x@x)[0,0])
+print(f"matmul+fetch: {time.time()-t0:.1f}s platform={devs[0].platform} kind={devs[0].device_kind} val={v}", flush=True)

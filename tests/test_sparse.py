@@ -167,3 +167,11 @@ def test_array_int64_bounds_policy():
     assert a.dtype == onp.int32
     with pytest.raises(OverflowError):
         mx.np.array([2 ** 40], dtype="int64")
+
+
+def test_float_host_int_dtype_bounds_policy():
+    """Float host data feeding an integer dtype bounds-checks too
+    (review finding, round 4): array([1e12], dtype='int64') must raise
+    under the 32-bit policy, not silently wrap."""
+    with pytest.raises(OverflowError):
+        mx.np.array([1e12], dtype="int64")

@@ -1,0 +1,234 @@
+"""Generated symbol op table: the full np/npx surface symbolizes.
+
+Round-3 VERDICT item 7: the symbol table must be generated from the
+op namespaces (reference: python/mxnet/symbol/register.py:115-277
+text-generates wrappers for the whole nnvm registry at import), with
+every op in opperf's enumerate_ops either resolvable as a symbol
+wrapper or explicitly excluded with a reason (symbol/_ops.EXCLUDED).
+"""
+import sys
+from pathlib import Path
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np
+from mxnet_tpu.symbol import _ops
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent
+                       / "benchmark"))
+from opperf import enumerate_ops  # noqa: E402
+
+_SUBNS = {"linalg": _ops.linalg, "random": _ops.random,
+          "fft": _ops.fft}
+
+
+def _sym_lookup(qual):
+    prefix, name = qual.split(".", 1)
+    if prefix in _SUBNS:
+        return getattr(_SUBNS[prefix], name)
+    return getattr(mx.sym, name)
+
+
+def test_every_op_symbolizes_or_is_excluded():
+    """Access-level completeness over the opperf denominator."""
+    ops = enumerate_ops(mx)
+    missing = []
+    for qual in sorted(ops):
+        if qual in _ops.EXCLUDED:
+            # the generated path refuses with the recorded reason
+            # (core names like sym.load / sym.zeros may still exist as
+            # hand-written constructors — that's the intent)
+            prefix, name = qual.split(".", 1)
+            with pytest.raises(AttributeError):
+                if prefix in _SUBNS:
+                    _SUBNS[prefix].__getattr__(name)
+                else:
+                    _ops.__getattr__(name)
+            continue
+        # "np.var" style collisions aside, every public op generates
+        try:
+            fn = _sym_lookup(qual)
+        except AttributeError as e:
+            missing.append(f"{qual}: {e}")
+            continue
+        assert callable(fn), qual
+    assert not missing, "\n".join(missing)
+    # the denominator itself must stay honest: the sweep covers the
+    # same 400+ callables opperf enumerates
+    assert len(ops) >= 400, len(ops)
+
+
+def _templates():
+    """opperf-style generic call templates, over Symbols."""
+    n = 6
+    a = onp.random.RandomState(0).rand(n, n).astype(onp.float32)
+    b = onp.random.RandomState(3).rand(n, n).astype(onp.float32)
+    pos = (a * 0.4 + 0.05).astype(onp.float32)
+    iarr = (onp.arange(n * n).reshape(n, n) % 7 + 1).astype(onp.int32)
+    spd = (pos @ pos.T + n * onp.eye(n)).astype(onp.float32)
+    vec = a[0]
+    arrs = {"a": a, "b": b, "pos": pos, "iarr": iarr, "spd": spd,
+            "vec": vec}
+    return arrs, [
+        lambda s: (s("a"),),
+        lambda s: (s("pos"),),
+        lambda s: (s("vec"),),
+        lambda s: (s("spd"),),
+        lambda s: (s("a"), s("b")),
+        lambda s: (s("pos"), s("pos")),
+        lambda s: (s("iarr"),),
+        lambda s: (s("iarr"), s("iarr")),
+        lambda s: ((n, n),),
+        lambda s: (n,),
+    ]
+
+
+def test_generated_wrappers_eval_round_trip():
+    """Eval-level sweep: build graph -> tojson -> load -> eval, compare
+    against the eager op. Ops needing structured args (conv weights,
+    rnn state, ...) can't be template-called — the floor asserts the
+    broad surface works; key families are pinned individually below."""
+    ops = enumerate_ops(mx)
+    arrs, templates = _templates()
+    ok = 0
+    failures = []
+    for qual in sorted(ops):
+        if qual in _ops.EXCLUDED or qual.startswith("random."):
+            continue
+        eager = ops[qual]
+        try:
+            wrapper = _sym_lookup(qual)
+        except AttributeError:
+            continue
+        for t in templates:
+            names = []
+
+            def sel(key):
+                names.append(key)
+                return key
+
+            args = t(sel)
+            eager_args = tuple(np.array(arrs[x]) if x in arrs else x
+                               for x in args)
+            try:
+                expect = eager(*eager_args)
+            except Exception:
+                continue
+            if isinstance(expect, (tuple, list)):
+                expect = expect[0]
+            if not hasattr(expect, "asnumpy"):
+                continue
+            sym_args = tuple(mx.sym.var(x) if x in arrs else x
+                             for x in args)
+            try:
+                g = wrapper(*sym_args)
+                g2 = mx.sym.load_json(g.tojson())
+                out = g2._eval({k: np.array(arrs[k]) for k in names
+                                if k in arrs})[0]
+                onp.testing.assert_allclose(
+                    out.asnumpy().astype(onp.float64),
+                    expect.asnumpy().astype(onp.float64),
+                    rtol=1e-4, atol=1e-4)
+                ok += 1
+                break
+            except Exception as e:  # noqa: BLE001 — tally below
+                failures.append(f"{qual}: {type(e).__name__}")
+                break
+        else:
+            continue
+    assert ok >= 230, (ok, failures[:40])
+
+
+def test_subnamespace_ops_round_trip():
+    """linalg / fft / random symbol nodes serialize and eval."""
+    rs = onp.random.RandomState(0)
+    m = rs.rand(5, 5).astype(onp.float32)
+    spd = (m @ m.T + 5 * onp.eye(5)).astype(onp.float32)
+
+    x = mx.sym.var("x")
+    q, r = _ops.linalg.qr(x)
+    g = mx.sym.load_json(mx.sym.Group([q, r]).tojson())
+    qv, rv = g._eval({"x": np.array(m)})
+    onp.testing.assert_allclose((qv.asnumpy() @ rv.asnumpy()), m,
+                                atol=1e-4)
+
+    c = _ops.linalg.cholesky(x)
+    out = mx.sym.load_json(c.tojson())._eval({"x": np.array(spd)})[0]
+    onp.testing.assert_allclose(out.asnumpy() @ out.asnumpy().T, spd,
+                                rtol=1e-3, atol=1e-3)
+
+    f = _ops.fft.fft(x)
+    out = mx.sym.load_json(f.tojson())._eval({"x": np.array(m)})[0]
+    onp.testing.assert_allclose(out.asnumpy(), onp.fft.fft(m),
+                                rtol=1e-3, atol=1e-3)
+
+    rnd = _ops.random.normal(0.0, 1.0, size=(4, 3))
+    out = mx.sym.load_json(rnd.tojson())._eval({})[0]
+    assert out.shape == (4, 3)
+
+
+def test_multi_output_and_packed_ops():
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    b = (a + 1).astype(onp.float32)
+
+    # packed sequence op: varargs and list forms both work
+    x, y = mx.sym.var("x"), mx.sym.var("y")
+    for g in (mx.sym.concatenate(x, y, axis=0),
+              mx.sym.concatenate([x, y], axis=0)):
+        out = mx.sym.load_json(g.tojson())._eval(
+            {"x": np.array(a), "y": np.array(b)})[0]
+        onp.testing.assert_allclose(
+            out.asnumpy(), onp.concatenate([a, b], axis=0))
+
+    # multi-output with flag-dependent arity
+    u = mx.sym.unique(x, return_counts=True)
+    assert len(u) == 2
+    vals, counts = mx.sym.load_json(
+        mx.sym.Group(list(u)).tojson())._eval(
+        {"x": np.array(onp.array([1., 2., 2., 3.]))})
+    onp.testing.assert_allclose(vals.asnumpy(), [1., 2., 3.])
+    onp.testing.assert_allclose(counts.asnumpy(), [1, 2, 1])
+
+    # meshgrid arity follows input count
+    mg = mx.sym.meshgrid(x, y)
+    assert len(mg) == 2
+
+    # modf: two outputs from one
+    frac, integ = mx.sym.modf(x)._eval({"x": np.array(a + 0.25)})
+    onp.testing.assert_allclose(integ.asnumpy(), onp.floor(a + 0.25))
+
+
+def test_excluded_ops_raise_with_reason():
+    # np.var collides with the Variable constructor: mx.sym.var stays
+    # the constructor; the generated-table path carries the reason
+    with pytest.raises(AttributeError, match="Variable constructor"):
+        _ops.__getattr__("var")
+    v = mx.sym.var("x")
+    assert isinstance(v, mx.sym.Symbol)
+    with pytest.raises(AttributeError, match="hybridize"):
+        _ops.__getattr__("while_loop")
+    with pytest.raises(AttributeError, match="PRNG"):
+        getattr(_ops.random, "seed")
+
+
+def test_dir_reports_generated_surface():
+    surface = [n for n in dir(mx.sym) if not n.startswith("_")]
+    assert len(surface) >= 330, len(surface)
+    assert "logaddexp" in surface and "cholesky" not in surface
+    assert "var" in surface
+
+
+def test_packed_op_positional_axis():
+    """A positional axis after the sequence must stay a scalar arg,
+    not join the pack (review finding, round 4)."""
+    a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    b = (a + 1).astype(onp.float32)
+    x, y = mx.sym.var("x"), mx.sym.var("y")
+    for g in (mx.sym.concatenate([x, y], 1),
+              mx.sym.concatenate(x, y, 1)):
+        out = mx.sym.load_json(g.tojson())._eval(
+            {"x": np.array(a), "y": np.array(b)})[0]
+        onp.testing.assert_allclose(
+            out.asnumpy(), onp.concatenate([a, b], axis=1))

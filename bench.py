@@ -53,8 +53,6 @@ _START = time.monotonic()  # process start — the parent's watchdog t0
 
 BASELINE_IMAGES_PER_SEC_PER_CHIP = 360.0
 IO_BASELINE_IMAGES_PER_SEC = 3000.0
-PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
-PROBE_ATTEMPTS = 1
 # Budget gates for the optional phases (seconds of remaining child
 # budget required to *start* the phase; a phase that overruns anyway is
 # cut by the parent watchdog — the minimal JSON line is already out).
@@ -72,48 +70,11 @@ PEAK_FLOPS = [
     ("v5p", 459e12), ("v4", 275e12), ("v6", 918e12),
 ]
 
-_PROBE_CODE = """
-import json, sys
-import jax
-devs = jax.devices()
-print(json.dumps({"platform": jax.default_backend(),
-                  "n_devices": len(devs),
-                  "device_kind": devs[0].device_kind}))
-"""
-
-
-def _probe_backend():
-    """Try TPU init in a child process (it can hang, not just fail).
-
-    Returns (platform, n_devices, device_kind) or None.
-    """
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # let jax auto-pick (tpu first)
-    for attempt in range(PROBE_ATTEMPTS):
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", _PROBE_CODE], env=env,
-                capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
-        except subprocess.TimeoutExpired:
-            print(f"[bench] backend probe attempt {attempt + 1} timed out "
-                  f"after {PROBE_TIMEOUT_S}s", file=sys.stderr, flush=True)
-            continue
-        if out.returncode == 0:
-            try:
-                info = json.loads(out.stdout.strip().splitlines()[-1])
-                return (info["platform"], info["n_devices"],
-                        info.get("device_kind", ""))
-            except (ValueError, IndexError, KeyError):
-                pass
-        print(f"[bench] backend probe attempt {attempt + 1} failed "
-              f"(rc={out.returncode}): {out.stderr.strip()[-400:]}",
-              file=sys.stderr, flush=True)
-    return None
-
-
-def _force_cpu():
-    import tpu_platform
-    tpu_platform.force_cpu()
+def _stage(msg):
+    """Stage marker on stderr: diagnosable even when the parent has to
+    kill a hung child (the parent dumps the stderr tail)."""
+    print(f"[bench:{time.monotonic() - _START:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
 
 
 def _peak_flops(device_kind: str):
@@ -174,6 +135,7 @@ def _run_bench(small: bool, platform: str, deadline: float):
         batch = int(os.environ.get("BENCH_BATCH", "384")) * n_dev
         hw, iters_lo, iters_hi = 224, 2, 12
         flops_per_img = RESNET50_TRAIN_FLOPS_PER_IMG
+    _stage(f"building model (small={small}, batch={batch})")
     net.initialize()
     net.cast("bfloat16")
 
@@ -195,8 +157,9 @@ def _run_bench(small: bool, platform: str, deadline: float):
         float(loss.asnumpy())
         return time.perf_counter() - t0
 
+    _stage("warmup (compile + drain queue)")
     timed_chain(iters_lo)  # compile + drain queue
-    print("[bench] warmup done", file=sys.stderr, flush=True)
+    _stage("warmup done; timing synthetic phase")
 
     t_lo = timed_chain(iters_lo)
     t_hi = timed_chain(iters_hi)
@@ -233,16 +196,6 @@ def _run_bench(small: bool, platform: str, deadline: float):
     # bulk mode: N steps scanned inside ONE XLA program
     # (TrainStep.run_chain — the engine bulk-mode equivalent); same
     # two-point delta
-    def timed_bulk(d, l):
-        t0 = time.perf_counter()
-        step.run_chain(d, l).asnumpy()
-        return time.perf_counter() - t0
-
-    def bulk_args(n):  # allocated OUTSIDE the timed region
-        return (mx.np.random.uniform(size=(n,) + tuple(data.shape),
-                                     dtype="bfloat16"),
-                mx.np.zeros((n, batch), dtype="int32"))
-
     ips_bulk = None
     if remaining() < BULK_PHASE_MIN_BUDGET_S:
         print(f"[bench] skipping bulk phase ({remaining():.0f}s budget "
@@ -426,13 +379,24 @@ def _run_guarded():
             print(line)
             return 0
     except subprocess.TimeoutExpired as e:
-        print(f"[bench] TPU attempt timed out after {CHILD_TIMEOUT_S}s",
+        err_tail = e.stderr
+        if isinstance(err_tail, bytes):
+            err_tail = err_tail.decode("utf-8", "replace")
+        print(f"[bench] TPU attempt timed out after {CHILD_TIMEOUT_S}s; "
+              f"child stderr tail:\n{(err_tail or '').strip()[-600:]}",
               file=sys.stderr, flush=True)
         line = _harvest(e.stdout)
         if line:  # killed mid-optional-phase; headline already printed
             print(line)
             return 0
     # last resort: CPU small mode (short budget; skip optional phases)
+    if os.environ.get("BENCH_NO_CPU_FALLBACK"):
+        print("[bench] TPU attempt failed; CPU fallback disabled by env",
+              file=sys.stderr, flush=True)
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "unit": "images/sec/chip", "vs_baseline": 0.0,
+                          "error": "tpu attempt failed; no-fallback"}))
+        return 1
     print("[bench] TPU attempt failed; CPU small fallback",
           file=sys.stderr, flush=True)
     env["JAX_PLATFORMS"] = "cpu"
@@ -463,24 +427,22 @@ def main():
     if os.environ.get("BENCH_CHILD") != "1":
         return _run_guarded()
 
-    # Honor an explicit platform request (local CPU runs) without
-    # probing: the axon TPU plugin registers regardless of
-    # JAX_PLATFORMS, so pin via jax.config before any backend init.
+    # Honor an explicit platform request (local CPU runs) by pinning
+    # via jax.config before any backend init (the axon TPU plugin
+    # registers regardless of JAX_PLATFORMS). No separate probe
+    # subprocess: one attempt = ONE backend init, watchdogged by the
+    # parent — a probe would double the TPU inits and a stale probe
+    # client can wedge the chip for the real run (round-4 lesson).
     requested = os.environ.get("JAX_PLATFORMS")
-    platform = None
+    _stage("importing jax")
+    import jax
     if requested:
-        import jax
         jax.config.update("jax_platforms", requested)
-        platform = requested.split(",")[0]
-    else:
-        probed = _probe_backend()
-        if probed is None:
-            print("[bench] TPU backend unavailable; falling back to CPU "
-                  "small mode", file=sys.stderr, flush=True)
-            _force_cpu()
-            platform = "cpu"
-        else:
-            platform = probed[0]
+    _stage("backend init (jax.devices — the axon tunnel can hang here)")
+    devs = jax.devices()
+    platform = jax.default_backend()
+    _stage(f"backend up: {platform} x{len(devs)} "
+           f"({devs[0].device_kind})")
 
     small = os.environ.get("BENCH_SMALL", "") not in ("", "0")
     if platform == "cpu" and "BENCH_SMALL" not in os.environ:

@@ -88,7 +88,9 @@ def narrow_dtype(values, dtype):
         # too (e.g. array([1e12], dtype='int64'))
         if arr.size and arr.dtype.kind in "iuf":
             info = onp.iinfo(target)
-            if arr.max(initial=0) > info.max or \
+            bad_nan = arr.dtype.kind == "f" and \
+                bool(onp.isnan(arr).any())
+            if bad_nan or arr.max(initial=0) > info.max or \
                     arr.min(initial=0) < info.min:
                 raise OverflowError(
                     f"{dtype.name} value out of {target} range under the "

@@ -28,6 +28,18 @@ from ..random_state import next_key
 from .. import autograd as _ag
 
 from . import random  # noqa: E402,F401  (npx.random: bernoulli etc.)
+from .contrib_ops import (  # noqa: E402,F401  (OPGAP round-4 batch)
+    interleaved_matmul_selfatt_qk, interleaved_matmul_selfatt_valatt,
+    interleaved_matmul_encdec_qk, interleaved_matmul_encdec_valatt,
+    div_sqrt_dim, box_iou, box_nms, box_encode, box_decode,
+    bipartite_matching, multibox_target, multibox_detection,
+    lrn, adaptive_avg_pool2d, bilinear_resize2d,
+    depth_to_space, space_to_depth, im2col, col2im,
+    moments, khatri_rao, index_copy, quadratic, stop_gradient,
+    constraint_check,
+    sldwin_atten_score, sldwin_atten_mask_like, sldwin_atten_context,
+    roi_align, hawkesll,
+)
 
 
 def _c(x):

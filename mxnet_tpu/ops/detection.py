@@ -1,0 +1,327 @@
+"""Detection-family kernels: box IoU/NMS/codec, anchor matching.
+
+TPU-native equivalents of the reference's detection contrib ops
+(src/operator/contrib/bounding_box.cc, multibox_detection.cc,
+multibox_target.cc, bipartite_matching.cc). All kernels are pure jax
+with static shapes and `lax.fori_loop` for the sequential suppress /
+match phases, so they jit and batch cleanly on TPU.
+
+Box formats: 'corner' = (xmin, ymin, xmax, ymax); 'center' =
+(cx, cy, w, h) — the reference's in_format/out_format convention.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def corner_to_center(b):
+    xmin, ymin, xmax, ymax = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate([(xmin + xmax) / 2, (ymin + ymax) / 2,
+                            xmax - xmin, ymax - ymin], -1)
+
+
+def center_to_corner(b):
+    cx, cy, w, h = jnp.split(b, 4, axis=-1)
+    return jnp.concatenate([cx - w / 2, cy - h / 2,
+                            cx + w / 2, cy + h / 2], -1)
+
+
+def _area(b):  # corner format
+    return jnp.maximum(b[..., 2] - b[..., 0], 0) * \
+        jnp.maximum(b[..., 3] - b[..., 1], 0)
+
+
+def box_iou(lhs, rhs, fmt="corner"):
+    """Pairwise IoU: lhs (..., N, 4), rhs (..., M, 4) -> (..., N, M)."""
+    if fmt == "center":
+        lhs, rhs = center_to_corner(lhs), center_to_corner(rhs)
+    lt = jnp.maximum(lhs[..., :, None, :2], rhs[..., None, :, :2])
+    rb = jnp.minimum(lhs[..., :, None, 2:4], rhs[..., None, :, 2:4])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = _area(lhs)[..., :, None] + _area(rhs)[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def box_encode(samples, matches, anchors, refs, means, stds):
+    """SSD regression targets (parity: bounding_box.cc BoxEncode).
+
+    samples (B,N) in {0:ignore,-1:negative,1:positive}, matches (B,N)
+    GT index per anchor, anchors (B,N,4) corner, refs (B,M,4) corner
+    GT boxes. Returns (targets (B,N,4), masks (B,N,4))."""
+    ref = jnp.take_along_axis(refs, matches[..., None].astype(jnp.int32)
+                              .clip(0), axis=1)
+    a_c = corner_to_center(anchors)
+    g_c = corner_to_center(ref)
+    means = jnp.asarray(means, a_c.dtype)
+    stds = jnp.asarray(stds, a_c.dtype)
+    t_xy = (g_c[..., :2] - a_c[..., :2]) / jnp.maximum(a_c[..., 2:], 1e-12)
+    t_wh = jnp.log(jnp.maximum(g_c[..., 2:], 1e-12)
+                   / jnp.maximum(a_c[..., 2:], 1e-12))
+    t = (jnp.concatenate([t_xy, t_wh], -1) - means) / stds
+    mask = jnp.broadcast_to((samples > 0.5)[..., None],
+                            t.shape).astype(t.dtype)
+    return t * mask, mask
+
+
+def box_decode(data, anchors, stds=(1.0, 1.0, 1.0, 1.0),
+               means=(0.0, 0.0, 0.0, 0.0), clip=-1.0, fmt="corner"):
+    """Invert box_encode: data (B,N,4) deltas, anchors (1,N,4)."""
+    a = anchors if fmt == "center" else corner_to_center(anchors)
+    stds = jnp.asarray(stds, data.dtype)
+    means = jnp.asarray(means, data.dtype)
+    d = data * stds + means
+    xy = d[..., :2] * a[..., 2:] + a[..., :2]
+    wh = jnp.exp(d[..., 2:]) * a[..., 2:]
+    out = center_to_corner(jnp.concatenate([xy, wh], -1))
+    if clip > 0:
+        out = jnp.clip(out, 0.0, clip)
+    return out
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, background_id=-1,
+            force_suppress=False, in_format="corner"):
+    """Greedy NMS (parity: bounding_box.cc BoxNMS semantics).
+
+    data (..., N, K): rows with score < valid_thresh are invalid;
+    survivors sorted by score desc; a row is suppressed when its IoU
+    with a higher-scored kept row of the same class (or any class when
+    force_suppress) exceeds overlap_thresh. Suppressed/invalid rows
+    have ALL fields set to -1. Output keeps the input shape with kept
+    rows compacted to the front (reference behavior)."""
+    orig_shape = data.shape
+    flat = data.reshape((-1,) + orig_shape[-2:])
+
+    def one(batch):
+        n = batch.shape[0]
+        score = batch[:, score_index]
+        boxes = lax.dynamic_slice_in_dim(batch, coord_start, 4, axis=1)
+        if in_format == "center":
+            boxes = center_to_corner(boxes)
+        valid = score > valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            valid &= batch[:, id_index] != background_id
+        order = jnp.argsort(-jnp.where(valid, score, -jnp.inf))
+        sboxes = boxes[order]
+        svalid = valid[order]
+        if topk > 0:
+            svalid &= jnp.arange(n) < topk
+        iou = box_iou(sboxes, sboxes)
+        if id_index >= 0 and not force_suppress:
+            cls = batch[order, id_index]
+            same = cls[:, None] == cls[None, :]
+            iou = jnp.where(same, iou, 0.0)
+
+        def body(i, keep):
+            sup = (iou[i] > overlap_thresh) & keep[i] & \
+                (jnp.arange(n) > i)
+            return keep & ~sup
+
+        keep = lax.fori_loop(0, n, body, svalid)
+        kept_sorted = batch[order]
+        kept_sorted = jnp.where(keep[:, None], kept_sorted, -1.0)
+        # compact kept rows to the front (stable on score order)
+        rank = jnp.argsort(~keep, stable=True)
+        return kept_sorted[rank]
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(orig_shape)
+
+
+def bipartite_matching(score, threshold, is_ascend=False, topk=-1):
+    """Greedy bipartite matching (parity: bipartite_matching.cc).
+
+    score (..., N, M). Returns (row_match (..., N), col_match (..., M))
+    where row_match[i] = matched column or -1, col_match[j] = matched
+    row or -1. Greedy: repeatedly take the globally best unmatched
+    pair passing `threshold`."""
+    orig = score.shape
+    flat = score.reshape((-1,) + orig[-2:])
+    n, m = orig[-2], orig[-1]
+    sign = 1.0 if is_ascend else -1.0
+    iters = min(n, m) if topk <= 0 else min(topk, min(n, m))
+
+    def one(s):
+        key = s * sign  # minimize key
+
+        def body(_, st):
+            key_st, row, col = st
+            idx = jnp.argmin(key_st)
+            i, j = idx // m, idx % m
+            ok = (s[i, j] >= threshold) if not is_ascend else \
+                (s[i, j] <= threshold)
+            row = jnp.where(ok, row.at[i].set(j), row)
+            col = jnp.where(ok, col.at[j].set(i), col)
+            key_st = jnp.where(ok, key_st.at[i, :].set(jnp.inf)
+                               .at[:, j].set(jnp.inf), key_st)
+            key_st = jnp.where(ok, key_st, key_st.at[i, j].set(jnp.inf))
+            return key_st, row, col
+
+        row0 = jnp.full((n,), -1, jnp.int32)
+        col0 = jnp.full((m,), -1, jnp.int32)
+        _, row, col = lax.fori_loop(0, iters, body, (key, row0, col0))
+        return row, col
+
+    rows, cols = jax.vmap(one)(flat)
+    return (rows.reshape(orig[:-1]),
+            cols.reshape(orig[:-2] + (m,)))
+
+
+def multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    negative_mining_thresh=0.5,
+                    minimum_negative_samples=0,
+                    variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (parity: multibox_target.cc).
+
+    anchor (1,A,4) corner; label (B,N,5) rows [cls, xmin,ymin,xmax,
+    ymax] padded with cls<0; cls_pred (B,C,A) (used for hard negative
+    mining when negative_mining_ratio > 0). Returns
+    (box_target (B,A*4), box_mask (B,A*4), cls_target (B,A))."""
+    a = anchor[0]                            # (A, 4)
+    A = a.shape[0]
+
+    def one(lab, cpred):
+        gt_valid = lab[:, 0] >= 0            # (N,)
+        gt_boxes = lab[:, 1:5]
+        iou = box_iou(a, gt_boxes)           # (A, N)
+        iou = jnp.where(gt_valid[None, :], iou, -1.0)
+        # stage 1: each GT grabs its best anchor (greedy bipartite)
+        row, col = bipartite_matching(iou, 1e-12)
+        matches = row                         # (A,) GT idx or -1
+        # stage 2: remaining anchors take their best GT above thresh
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        stage2 = (matches < 0) & (best_iou >= overlap_threshold)
+        matches = jnp.where(stage2, best_gt, matches)
+        positive = matches >= 0
+        samples = jnp.where(positive, 1.0, -1.0)
+
+        if negative_mining_ratio > 0:
+            # hard negatives: highest max-class-prob anchors whose best
+            # IoU is below the mining threshold
+            max_pos = jnp.sum(positive)
+            quota = jnp.maximum(
+                (negative_mining_ratio * max_pos).astype(jnp.int32),
+                minimum_negative_samples)
+            neg_ok = (~positive) & (best_iou < negative_mining_thresh)
+            hardness = jnp.where(neg_ok, jnp.max(cpred, axis=0), -jnp.inf)
+            order = jnp.argsort(-hardness)
+            rank = jnp.empty_like(order).at[order].set(jnp.arange(A))
+            chosen_neg = neg_ok & (rank < quota)
+            samples = jnp.where(positive, 1.0,
+                                jnp.where(chosen_neg, -1.0, 0.0))
+
+        targets, masks = box_encode(
+            samples[None], matches[None], a[None], gt_boxes[None],
+            (0.0, 0.0, 0.0, 0.0), variances)
+        gt_cls = jnp.take(lab[:, 0], matches.clip(0)) + 1.0
+        cls_t = jnp.where(positive, gt_cls,
+                          jnp.where(samples < -0.5, 0.0,
+                                    float(ignore_label)))
+        return targets[0].reshape(-1), masks[0].reshape(-1), cls_t
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
+                       threshold=0.01, background_id=0,
+                       nms_threshold=0.5, force_suppress=False,
+                       variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD inference: decode + per-class NMS (multibox_detection.cc).
+
+    cls_prob (B,C,A), loc_pred (B,A*4), anchor (1,A,4) corner.
+    Returns (B, A, 6): [class_id, score, xmin, ymin, xmax, ymax],
+    suppressed rows = -1."""
+    B, C, A = cls_prob.shape
+    deltas = loc_pred.reshape(B, A, 4)
+    boxes = box_decode(deltas, corner_to_center(anchor),
+                       stds=variances, fmt="center",
+                       clip=1.0 if clip else -1.0)
+    # best non-background class per anchor
+    fg = jnp.concatenate([cls_prob[:, :background_id],
+                          cls_prob[:, background_id + 1:]], axis=1) \
+        if 0 <= background_id < C else cls_prob
+    cls_id = jnp.argmax(fg, axis=1).astype(cls_prob.dtype)   # (B, A)
+    # map back around the removed background row
+    if 0 <= background_id < C:
+        cls_id = jnp.where(cls_id >= background_id, cls_id + 1, cls_id)
+    score = jnp.take_along_axis(
+        cls_prob, cls_id[:, None].astype(jnp.int32), axis=1)[:, 0]
+    keep = score > threshold
+    out_id = jnp.where(keep, cls_id - (background_id >= 0), -1.0)
+    out = jnp.concatenate([out_id[..., None], score[..., None], boxes],
+                          -1)
+    out = jnp.where(keep[..., None], out, -1.0)
+    return box_nms(out, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                   topk=nms_topk, coord_start=2, score_index=1,
+                   id_index=0, background_id=-1,
+                   force_suppress=force_suppress)
+
+
+def roi_align(data, rois, pooled_size, spatial_scale=1.0,
+              sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROIAlign (parity: src/operator/contrib/roi_align.cc — Mask R-CNN
+    bilinear-sampled ROI pooling, avg mode).
+
+    data (B, C, H, W); rois (N, 5) rows [batch_idx, x1, y1, x2, y2] in
+    image coords. Returns (N, C, ph, pw) — or (N, C/(ph*pw), ph, pw)
+    when position_sensitive. sample_ratio <= 0 picks an adaptive
+    ceil(roi_extent / pooled) grid per the reference, but a static one
+    (2) is used under jit when extents are data-dependent."""
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else pooled_size
+    sr = int(sample_ratio) if sample_ratio and sample_ratio > 0 else 2
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        off = 0.5 if aligned else 0.0
+        x1, y1, x2, y2 = (roi[1] * spatial_scale - off,
+                          roi[2] * spatial_scale - off,
+                          roi[3] * spatial_scale - off,
+                          roi[4] * spatial_scale - off)
+        rw = x2 - x1 if aligned else jnp.maximum(x2 - x1, 1.0)
+        rh = y2 - y1 if aligned else jnp.maximum(y2 - y1, 1.0)
+        bw, bh = rw / pw, rh / ph
+        # sr x sr sample grid inside each bin
+        iy = (jnp.arange(sr) + 0.5) / sr
+        ix = (jnp.arange(sr) + 0.5) / sr
+        by = y1 + (jnp.arange(ph)[:, None] + iy[None, :]) * bh
+        bx = x1 + (jnp.arange(pw)[:, None] + ix[None, :]) * bw
+        ys = by.reshape(-1)                    # (ph*sr,)
+        xs = bx.reshape(-1)                    # (pw*sr,)
+        img = data[bidx]                       # (C, H, W)
+        H, W = img.shape[1], img.shape[2]
+        y = jnp.clip(ys, 0.0, H - 1.0)
+        x = jnp.clip(xs, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1i = jnp.minimum(y0 + 1, H - 1)
+        x1i = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        # bilinear sample on the full (ys, xs) grid
+        g00 = img[:, y0[:, None], x0[None, :]]
+        g01 = img[:, y0[:, None], x1i[None, :]]
+        g10 = img[:, y1i[:, None], x0[None, :]]
+        g11 = img[:, y1i[:, None], x1i[None, :]]
+        top = g00 * (1 - wx)[None, None, :] + g01 * wx[None, None, :]
+        bot = g10 * (1 - wx)[None, None, :] + g11 * wx[None, None, :]
+        smp = top * (1 - wy)[None, :, None] + bot * wy[None, :, None]
+        C = img.shape[0]
+        smp = smp.reshape(C, ph, sr, pw, sr)
+        pooled = smp.mean(axis=(2, 4))         # (C, ph, pw)
+        if position_sensitive:
+            c = C // (ph * pw)
+            pooled = pooled.reshape(c, ph, pw, ph, pw)
+            pooled = pooled[:, jnp.arange(ph)[:, None],
+                            jnp.arange(pw)[None, :],
+                            jnp.arange(ph)[:, None],
+                            jnp.arange(pw)[None, :]]
+        return pooled
+
+    return jax.vmap(one)(rois)

@@ -1,15 +1,23 @@
-import sys, time
-sys.path.insert(0, "/root/repo")
-t0=time.time()
-import tpu_platform
-import jax
+"""Quick axon-tunnel liveness probe: init, matmul, value fetch."""
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+t0 = time.time()
+import tpu_platform  # noqa: F401,E402  (repo helper; registers platform)
+import jax  # noqa: E402
+
 print(f"import+platform: {time.time()-t0:.1f}s", flush=True)
-t0=time.time()
+t0 = time.time()
 devs = jax.devices()
 print(f"jax.devices(): {time.time()-t0:.1f}s -> {devs}", flush=True)
-import jax.numpy as jnp
-t0=time.time()
-x = jnp.ones((1024,1024), jnp.bfloat16)
-import numpy as onp
-v = onp.asarray((x@x)[0,0])
-print(f"matmul+fetch: {time.time()-t0:.1f}s platform={devs[0].platform} kind={devs[0].device_kind} val={v}", flush=True)
+import jax.numpy as jnp  # noqa: E402
+import numpy as onp  # noqa: E402
+
+t0 = time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+v = onp.asarray((x @ x)[0, 0])
+print(f"matmul+fetch: {time.time()-t0:.1f}s platform={devs[0].platform} "
+      f"kind={devs[0].device_kind} val={v}", flush=True)

@@ -1,0 +1,415 @@
+"""OPGAP round-4 op batch: attention matmuls, detection, spatial.
+
+Each op is checked against a straightforward NumPy composition of the
+reference semantics (docstring-equivalent code in
+src/operator/contrib/transformer.cc:652-811, bounding_box.cc,
+matrix_op.cc)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, npx
+
+
+def _r(*shape, seed=0, scale=1.0):
+    return (onp.random.RandomState(seed).rand(*shape) * scale) \
+        .astype(onp.float32)
+
+
+# ---------------------------------------------------------------------------
+# interleaved attention matmuls (vs explicit q/k/v composition)
+# ---------------------------------------------------------------------------
+def test_interleaved_selfatt_matches_explicit_composition():
+    L, B, H, Dh = 7, 2, 3, 5
+    qkv = _r(L, B, H * Dh * 3, scale=0.1)
+
+    scores = npx.interleaved_matmul_selfatt_qk(np.array(qkv), heads=H)
+    assert scores.shape == (B * H, L, L)
+
+    t = qkv.reshape(L, B, H, 3, Dh)
+    q = t[:, :, :, 0, :].transpose(1, 2, 0, 3) / onp.sqrt(Dh)
+    k = t[:, :, :, 1, :].transpose(1, 2, 0, 3)
+    expect = onp.einsum("bhld,bhmd->bhlm", q, k).reshape(B * H, L, L)
+    onp.testing.assert_allclose(scores.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+    att = _r(B * H, L, L, seed=1)
+    out = npx.interleaved_matmul_selfatt_valatt(
+        np.array(qkv), np.array(att), heads=H)
+    assert out.shape == (L, B, H * Dh)
+    v = t[:, :, :, 2, :].transpose(1, 2, 0, 3)
+    o = onp.einsum("bhlm,bhmd->bhld", att.reshape(B, H, L, L), v)
+    expect = o.transpose(2, 0, 1, 3).reshape(L, B, H * Dh)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_interleaved_encdec_matches_explicit_composition():
+    Lq, Lk, B, H, Dh = 4, 6, 2, 2, 3
+    q = _r(Lq, B, H * Dh, scale=0.2)
+    kv = _r(Lk, B, H * Dh * 2, seed=2, scale=0.2)
+
+    s = npx.interleaved_matmul_encdec_qk(np.array(q), np.array(kv),
+                                         heads=H)
+    assert s.shape == (B * H, Lq, Lk)
+    qh = q.reshape(Lq, B, H, Dh).transpose(1, 2, 0, 3) / onp.sqrt(Dh)
+    kh = kv.reshape(Lk, B, H, 2, Dh)[:, :, :, 0, :].transpose(1, 2, 0, 3)
+    expect = onp.einsum("bhld,bhmd->bhlm", qh, kh).reshape(B * H, Lq, Lk)
+    onp.testing.assert_allclose(s.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+    att = _r(B * H, Lq, Lk, seed=3)
+    out = npx.interleaved_matmul_encdec_valatt(np.array(kv),
+                                               np.array(att), heads=H)
+    vh = kv.reshape(Lk, B, H, 2, Dh)[:, :, :, 1, :].transpose(1, 2, 0, 3)
+    o = onp.einsum("bhlm,bhmd->bhld", att.reshape(B, H, Lq, Lk), vh)
+    expect = o.transpose(2, 0, 1, 3).reshape(Lq, B, H * Dh)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5,
+                                atol=1e-6)
+
+
+def test_attention_matmuls_autograd():
+    """The fused attention path differentiates end to end."""
+    L, B, H, Dh = 3, 1, 2, 4
+    x = np.array(_r(L, B, H * Dh * 3, scale=0.3))
+    x.attach_grad()
+    with mx.autograd.record():
+        s = npx.interleaved_matmul_selfatt_qk(x, heads=H)
+        a = npx.softmax(s, axis=-1)
+        o = npx.interleaved_matmul_selfatt_valatt(x, a, heads=H)
+        loss = o.sum()
+    loss.backward()
+    g = x.grad.asnumpy()
+    assert onp.isfinite(g).all() and (onp.abs(g) > 0).any()
+
+
+# ---------------------------------------------------------------------------
+# bounding-box family
+# ---------------------------------------------------------------------------
+def test_box_iou():
+    a = np.array([[0., 0., 2., 2.], [1., 1., 3., 3.]])
+    b = np.array([[0., 0., 2., 2.], [10., 10., 11., 11.]])
+    iou = npx.box_iou(a, b).asnumpy()
+    onp.testing.assert_allclose(iou[0], [1.0, 0.0], atol=1e-6)
+    onp.testing.assert_allclose(iou[1, 0], 1.0 / 7.0, rtol=1e-5)
+
+
+def test_box_nms_suppresses_and_compacts():
+    # rows: [id, score, xmin, ymin, xmax, ymax]
+    rows = onp.array([
+        [0, 0.9, 0.0, 0.0, 1.0, 1.0],
+        [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # heavy overlap -> suppressed
+        [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # far away -> kept
+        [1, 0.6, 0.0, 0.0, 1.0, 1.0],     # other class -> kept
+    ], dtype=onp.float32)
+    out = npx.box_nms(np.array(rows[None]), overlap_thresh=0.5,
+                      coord_start=2, score_index=1, id_index=0)
+    o = out.asnumpy()[0]
+    kept_scores = sorted(s for s in o[:, 1] if s > 0)
+    assert kept_scores == pytest.approx([0.6, 0.7, 0.9])
+    assert (o[3] == -1).all()            # one suppressed row at the end
+    # force_suppress ignores class ids
+    out2 = npx.box_nms(np.array(rows[None]), overlap_thresh=0.5,
+                       coord_start=2, score_index=1, id_index=0,
+                       force_suppress=True)
+    kept2 = sorted(s for s in out2.asnumpy()[0][:, 1] if s > 0)
+    assert kept2 == pytest.approx([0.7, 0.9])
+
+
+def test_box_encode_decode_round_trip():
+    anchors = onp.array([[[0., 0., 1., 1.], [0.5, 0.5, 2.0, 1.5]]],
+                        dtype=onp.float32)
+    gt = onp.array([[[0.1, 0.1, 0.9, 1.2]]], dtype=onp.float32)
+    samples = onp.ones((1, 2), onp.float32)
+    matches = onp.zeros((1, 2), onp.int32)
+    stds = (0.1, 0.1, 0.2, 0.2)
+    t, m = npx.box_encode(np.array(samples), np.array(matches),
+                          np.array(anchors), np.array(gt),
+                          means=(0., 0., 0., 0.), stds=stds)
+    assert m.asnumpy().min() == 1.0
+    dec = npx.box_decode(t, np.array(anchors), *stds)
+    onp.testing.assert_allclose(
+        dec.asnumpy()[0, 0], gt[0, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_bipartite_matching_greedy():
+    score = onp.array([[[0.5, 0.6], [0.1, 0.9]]], dtype=onp.float32)
+    rows, cols = npx.bipartite_matching(np.array(score), threshold=0.05)
+    # greedy: (1,1)=0.9 first, then (0,0)=0.5
+    onp.testing.assert_array_equal(rows.asnumpy()[0], [0, 1])
+    onp.testing.assert_array_equal(cols.asnumpy()[0], [0, 1])
+
+
+def test_multibox_target_and_detection():
+    anchor = onp.array([[[0., 0., 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.4, 0.4, 0.6, 0.6]]], dtype=onp.float32)
+    # one GT box of class 0 overlapping anchor 1
+    label = onp.array([[[0.0, 0.55, 0.55, 0.95, 0.95],
+                        [-1.0, 0.0, 0.0, 0.0, 0.0]]], dtype=onp.float32)
+    cls_pred = onp.zeros((1, 2, 3), onp.float32)
+    bt, bm, ct = npx.multibox_target(np.array(anchor), np.array(label),
+                                     np.array(cls_pred))
+    ct = ct.asnumpy()[0]
+    assert ct[1] == 1.0                   # anchor 1 -> class 0 (+1)
+    assert bm.asnumpy()[0].reshape(3, 4)[1].min() == 1.0
+
+    # detection: decode zero-deltas -> anchors; class 1 wins on anchor 1
+    cls_prob = onp.array([[[0.8, 0.1, 0.9],     # background
+                           [0.2, 0.9, 0.1]]], dtype=onp.float32)
+    loc_pred = onp.zeros((1, 12), onp.float32)
+    det = npx.multibox_detection(np.array(cls_prob), np.array(loc_pred),
+                                 np.array(anchor))
+    d = det.asnumpy()[0]
+    best = d[0]
+    assert best[0] == 0.0 and best[1] == pytest.approx(0.9)
+    onp.testing.assert_allclose(best[2:], anchor[0, 1], atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# spatial ops
+# ---------------------------------------------------------------------------
+def test_lrn_formula():
+    x = _r(2, 7, 3, 3)
+    out = npx.lrn(np.array(x), alpha=1e-3, beta=0.6, knorm=2.0,
+                  nsize=5).asnumpy()
+    sq = x * x
+    pad = onp.pad(sq, ((0, 0), (2, 2), (0, 0), (0, 0)))
+    win = sum(pad[:, i:i + 7] for i in range(5))
+    expect = x / (2.0 + 1e-3 / 5 * win) ** 0.6
+    onp.testing.assert_allclose(out, expect, rtol=1e-5)
+
+
+def test_adaptive_avg_pool2d():
+    x = _r(1, 2, 6, 9)
+    out = npx.adaptive_avg_pool2d(np.array(x), output_size=(3, 4))
+    assert out.shape == (1, 2, 3, 4)
+    # uneven windows follow the floor/ceil rule
+    expect = onp.zeros((1, 2, 3, 4), onp.float32)
+    for i in range(3):
+        for j in range(4):
+            y0, y1 = (i * 6) // 3, -(-((i + 1) * 6) // 3)
+            x0, x1 = (j * 9) // 4, -(-((j + 1) * 9) // 4)
+            expect[:, :, i, j] = x[:, :, y0:y1, x0:x1].mean(axis=(2, 3))
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+    # global pooling via int output_size
+    g = npx.adaptive_avg_pool2d(np.array(x), output_size=1)
+    onp.testing.assert_allclose(g.asnumpy()[..., 0, 0],
+                                x.mean(axis=(2, 3)), rtol=1e-5)
+
+
+def test_bilinear_resize2d():
+    x = _r(1, 1, 4, 4)
+    out = npx.bilinear_resize2d(np.array(x), height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    assert onp.isfinite(out.asnumpy()).all()
+
+
+def test_depth_space_round_trip():
+    x = _r(2, 8, 3, 5)
+    d = npx.depth_to_space(np.array(x), 2)
+    assert d.shape == (2, 2, 6, 10)
+    back = npx.space_to_depth(d, 2)
+    onp.testing.assert_allclose(back.asnumpy(), x, rtol=1e-6)
+
+
+def test_im2col_col2im():
+    x = _r(1, 2, 5, 5)
+    cols = npx.im2col(np.array(x), kernel=(3, 3), stride=(1, 1),
+                      pad=(1, 1))
+    assert cols.shape == (1, 2 * 9, 25)
+    # col2im(im2col(x)) multiplies each pixel by its patch count
+    back = npx.col2im(cols, output_size=(5, 5), kernel=(3, 3),
+                      stride=(1, 1), pad=(1, 1))
+    ones = onp.ones_like(x)
+    cnt_cols = npx.im2col(np.array(ones), kernel=(3, 3), stride=(1, 1),
+                          pad=(1, 1))
+    cnt = npx.col2im(cnt_cols, output_size=(5, 5), kernel=(3, 3),
+                     stride=(1, 1), pad=(1, 1)).asnumpy()
+    onp.testing.assert_allclose(back.asnumpy(), x * cnt, rtol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+def test_moments():
+    x = _r(3, 4)
+    mean, var = npx.moments(np.array(x), axes=(1,))
+    onp.testing.assert_allclose(mean.asnumpy(), x.mean(1), rtol=1e-5)
+    onp.testing.assert_allclose(var.asnumpy(), x.var(1), rtol=1e-4)
+
+
+def test_khatri_rao():
+    a = onp.array([[1., 2.], [3., 4.]], onp.float32)
+    b = onp.array([[5., 6.], [7., 8.], [9., 10.]], onp.float32)
+    out = npx.khatri_rao(np.array(a), np.array(b)).asnumpy()
+    expect = onp.stack([onp.kron(a[:, i], b[:, i]) for i in range(2)], 1)
+    onp.testing.assert_allclose(out, expect, rtol=1e-6)
+
+
+def test_index_copy_and_quadratic():
+    old = np.zeros((4, 2))
+    new = np.array(onp.array([[1., 2.], [3., 4.]], onp.float32))
+    idx = np.array(onp.array([3, 1], onp.int32))
+    out = npx.index_copy(old, idx, new).asnumpy()
+    onp.testing.assert_allclose(out[3], [1., 2.])
+    onp.testing.assert_allclose(out[1], [3., 4.])
+    onp.testing.assert_allclose(out[0], [0., 0.])
+
+    x = np.array([1., 2.])
+    onp.testing.assert_allclose(
+        npx.quadratic(x, a=1.0, b=2.0, c=3.0).asnumpy(), [6., 11.])
+
+
+def test_stop_gradient_blocks():
+    x = np.array([2.0])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = x * npx.stop_gradient(x * x)   # d/dx = stop(x^2) = 4
+        z = y.sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_constraint_check():
+    ok = npx.constraint_check(np.array([True, True]), "must hold")
+    assert bool(ok.asnumpy().all())
+    with pytest.raises(ValueError, match="must hold"):
+        npx.constraint_check(np.array([True, False]), "must hold")
+
+
+# ---------------------------------------------------------------------------
+# sliding-window attention (vs the reference test's dense-mask ground truth,
+# tests/python/unittest/test_operator.py:9389)
+# ---------------------------------------------------------------------------
+def _sldwin_dense_mask(B, H, L, w, symmetric, d):
+    mask = onp.zeros((B, H, L, L), onp.float32)
+    for i in range(L):
+        end = (i + 1 + w * d) if symmetric else (i + 1)
+        for j in range(i - w * d, end, d):
+            if 0 <= j < L:
+                mask[:, :, i, j] = 1
+    return mask
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize("d", [1, 2])
+def test_sldwin_attention_vs_dense(symmetric, d):
+    B, L, H, D, w = 1, 8, 2, 4, 2
+    q = _r(B, L, H, D, seed=5, scale=0.5)
+    k = _r(B, L, H, D, seed=6, scale=0.5)
+    v = _r(B, L, H, D, seed=7, scale=0.5)
+    dil = onp.full((H,), d, onp.int32)
+    vl = onp.full((B,), L, onp.int32)
+
+    score = npx.sldwin_atten_score(np.array(q), np.array(k),
+                                   np.array(dil), w=w,
+                                   symmetric=symmetric)
+    mask = npx.sldwin_atten_mask_like(score, np.array(dil),
+                                      np.array(vl), w=w,
+                                      symmetric=symmetric)
+    out = npx.sldwin_atten_context(score * mask, np.array(v),
+                                   np.array(dil), w=w,
+                                   symmetric=symmetric)
+
+    dense_mask = _sldwin_dense_mask(B, H, L, w, symmetric, d)
+    qs = q.transpose(0, 2, 1, 3)
+    ks = k.transpose(0, 2, 1, 3)
+    vs = v.transpose(0, 2, 1, 3)
+    dense = onp.einsum("bhld,bhmd->bhlm", qs, ks) * dense_mask
+    expect = onp.einsum("bhlm,bhmd->bhld", dense, vs) \
+        .transpose(0, 2, 1, 3)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_sldwin_mask_respects_valid_length():
+    B, L, H, w = 1, 6, 1, 2
+    dil = onp.ones((H,), onp.int32)
+    score = np.zeros((B, L, H, 2 * w + 1))
+    vl = onp.array([4], onp.int32)
+    m = npx.sldwin_atten_mask_like(score, np.array(dil), np.array(vl),
+                                   w=w, symmetric=True).asnumpy()
+    assert m[0, 4:].sum() == 0            # rows past valid_length dead
+    assert m[0, 3, 0, w + 1] == 0          # col 4 invalid (>= vl)
+    assert m[0, 3, 0, w] == 1              # self-position valid
+
+
+# ---------------------------------------------------------------------------
+# roi_align
+# ---------------------------------------------------------------------------
+def test_roi_align_whole_image_matches_mean():
+    x = _r(1, 3, 8, 8, seed=8)
+    rois = onp.array([[0, 0, 0, 7, 7]], onp.float32)
+    out = npx.roi_align(np.array(x), np.array(rois), pooled_size=(1, 1),
+                        spatial_scale=1.0, sample_ratio=-1,
+                        aligned=False)
+    assert out.shape == (1, 3, 1, 1)
+    # 1x1 pooled whole-image ROI approximates the image mean
+    onp.testing.assert_allclose(out.asnumpy()[0, :, 0, 0],
+                                x[0].mean(axis=(1, 2)), rtol=0.05)
+
+
+def test_roi_align_is_differentiable_and_localized():
+    x = np.array(_r(1, 1, 6, 6, seed=9))
+    rois = np.array(onp.array([[0, 0, 0, 2, 2]], onp.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        out = npx.roi_align(x, rois, pooled_size=(2, 2),
+                            spatial_scale=1.0, sample_ratio=2)
+        s = out.sum()
+    s.backward()
+    g = x.grad.asnumpy()[0, 0]
+    assert g[:4, :4].sum() > 0             # gradient inside the ROI
+    assert g[4:, 4:].sum() == 0            # nothing outside
+
+
+# ---------------------------------------------------------------------------
+# hawkesll (vs a direct python re-derivation of hawkes_ll-inl.h:113-158)
+# ---------------------------------------------------------------------------
+def _hawkes_ll_ref(mu, a, b, st0, lags, marks, vl, mt):
+    N, T = lags.shape
+    K = mu.shape[1]
+    lls = onp.zeros(N)
+    st_out = st0.copy().astype(onp.float64)
+    for i in range(N):
+        ll, t = 0.0, 0.0
+        last = onp.zeros(K)
+        st = st_out[i]
+        for j in range(int(vl[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[ci]
+            ed = onp.exp(-b[ci] * d)
+            lda = mu[i, ci] + a[ci] * b[ci] * st[ci] * ed
+            comp = mu[i, ci] * d + a[ci] * st[ci] * (1 - ed)
+            ll += onp.log(lda) - comp
+            st[ci] = 1 + st[ci] * ed
+            last[ci] = t
+        d = mt[i] - last
+        ed = onp.exp(-b * d)
+        ll -= (mu[i] * d + a * st * (1 - ed)).sum()
+        st_out[i] = st * ed
+        lls[i] = ll
+    return lls, st_out
+
+
+def test_hawkesll_matches_kernel_semantics():
+    N, T, K = 3, 5, 2
+    rs = onp.random.RandomState(11)
+    mu = (rs.rand(N, K) * 0.5 + 0.5).astype(onp.float32)
+    a = onp.array([0.2, 0.4], onp.float32)
+    b = onp.array([1.0, 2.0], onp.float32)
+    st0 = rs.rand(N, K).astype(onp.float32)
+    lags = (rs.rand(N, T) + 0.1).astype(onp.float32)
+    marks = rs.randint(0, K, (N, T)).astype(onp.int32)
+    vl = onp.array([5, 3, 0], onp.int32)
+    mt = onp.full((N,), 10.0, onp.float32)
+
+    ll, st = npx.hawkesll(np.array(mu), np.array(a), np.array(b),
+                          np.array(st0), np.array(lags),
+                          np.array(marks), np.array(vl), np.array(mt))
+    ll_ref, st_ref = _hawkes_ll_ref(mu, a, b, st0, lags, marks, vl, mt)
+    onp.testing.assert_allclose(ll.asnumpy(), ll_ref, rtol=1e-4)
+    onp.testing.assert_allclose(st.asnumpy(), st_ref, rtol=1e-4,
+                                atol=1e-6)

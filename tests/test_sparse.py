@@ -175,3 +175,10 @@ def test_float_host_int_dtype_bounds_policy():
     under the 32-bit policy, not silently wrap."""
     with pytest.raises(OverflowError):
         mx.np.array([1e12], dtype="int64")
+
+
+def test_nan_host_int_dtype_bounds_policy():
+    """NaN host data feeding an integer dtype must raise, not cast to
+    an arbitrary int (review finding, round 4)."""
+    with pytest.raises(OverflowError):
+        mx.np.array([float("nan")], dtype="int64")

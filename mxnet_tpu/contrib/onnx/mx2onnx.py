@@ -261,6 +261,23 @@ def _translate_eqn(ctx, eqn, env):
             ctx.add_node("Not", [eq], outs)
         else:
             ctx.add_node(_COMPARE[prim], ins, outs)
+    elif prim == "square":
+        ctx.add_node("Mul", [ins[0], ins[0]], outs)
+    elif prim == "erfc":
+        e = ctx.fresh("erf")
+        ctx.add_node("Erf", ins, [e])
+        ctx.add_node("Sub", [ctx.add_const(
+            onp.asarray(1.0, onp.float32)), e], outs)
+    elif prim == "log1p":
+        a = ctx.fresh("lp1")
+        ctx.add_node("Add", [ins[0], ctx.add_const(
+            onp.asarray(1.0, onp.float32))], [a])
+        ctx.add_node("Log", [a], outs)
+    elif prim == "expm1":
+        e = ctx.fresh("em1")
+        ctx.add_node("Exp", ins, [e])
+        ctx.add_node("Sub", [e, ctx.add_const(
+            onp.asarray(1.0, onp.float32))], outs)
     elif prim == "rsqrt":
         s = ctx.fresh("sqrt")
         ctx.add_node("Sqrt", ins, [s])
@@ -389,11 +406,218 @@ def _translate_eqn(ctx, eqn, env):
         ctx.add_node("ArgMax", ins, outs,
                      [_attr_i("axis", eqn.params["axes"][0]),
                       _attr_i("keepdims", 0)])
+    elif prim == "argmin":
+        ctx.add_node("ArgMin", ins, outs,
+                     [_attr_i("axis", eqn.params["axes"][0]),
+                      _attr_i("keepdims", 0)])
+    elif prim == "clamp":
+        lo, x, hi = ins
+        m = ctx.fresh("clamp_lo")
+        ctx.add_node("Max", [x, lo], [m])
+        ctx.add_node("Min", [m, hi], outs)
+    elif prim == "cumsum":
+        ax = ctx.add_const(onp.asarray(eqn.params["axis"], onp.int64))
+        ctx.add_node("CumSum", [ins[0], ax], outs,
+                     [_attr_i("reverse",
+                              1 if eqn.params.get("reverse") else 0)])
+    elif prim == "split":
+        ctx.add_node(
+            "Split",
+            [ins[0], ctx.add_const(
+                onp.asarray(eqn.params["sizes"], onp.int64), "split")],
+            outs, [_attr_i("axis", eqn.params["axis"])])
+    elif prim == "scan":
+        _scan_eqn(ctx, eqn, ins, outs, env)
+    elif prim == "while":
+        raise NotImplementedError(
+            "lax.while_loop cannot be unrolled for ONNX (dynamic trip "
+            "count); use lax.scan / fused RNN layers instead")
+    elif prim == "sort":
+        _sort_eqn(ctx, eqn, ins, outs, in_avals)
+    elif prim == "top_k":
+        _topk_eqn(ctx, eqn, ins, outs, in_avals)
+    elif prim == "gather":
+        _gather_eqn(ctx, eqn, ins, outs, in_avals)
+    elif prim == "dynamic_slice":
+        _dynamic_slice_eqn(ctx, eqn, ins, outs)
     elif prim in ("device_put", "copy_p", "sharding_constraint"):
         ctx.add_node("Identity", ins, outs)
     else:
         raise NotImplementedError(
             f"no ONNX translation for jaxpr primitive {prim!r}")
+
+
+def _unsqueeze0(ctx, name, hint="us"):
+    u = ctx.fresh(hint)
+    ctx.add_node("Unsqueeze",
+                 [name, ctx.add_const(onp.asarray([0], onp.int64))], [u])
+    return u
+
+
+def _scan_eqn(ctx, eqn, ins, outs, env):
+    """lax.scan → unrolled body (the fused RNN/LSTM/GRU path).
+
+    The body jaxpr is inlined `length` times with Gather-sliced xs;
+    carries chain through, ys are Unsqueeze+Concat-stacked. Model size
+    grows linearly with sequence length — the trade for static ONNX
+    graphs (the reference exports cuDNN RNN as ONNX LSTM nodes;
+    here any scanned cell body exports, not just the three stock
+    cells)."""
+    p = eqn.params
+    T = p["length"]
+    nc = p["num_consts"]
+    ncar = p["num_carry"]
+    closed = p["jaxpr"]
+    body = closed.jaxpr
+    consts_in = ins[:nc]
+    carry = list(ins[nc:nc + ncar])
+    xs = ins[nc + ncar:]
+    n_ys = len(body.outvars) - ncar
+    ys = [[] for _ in range(n_ys)]
+    xs_body_vars = body.invars[nc + ncar:]
+    order = range(T - 1, -1, -1) if p.get("reverse") else range(T)
+    for t in order:
+        xt = []
+        for xi, bv in zip(xs, xs_body_vars):
+            g = ctx.fresh("scan_x")
+            ctx.add_node(
+                "Gather",
+                [xi, ctx.add_const(onp.asarray(t, onp.int64))], [g],
+                [_attr_i("axis", 0)])
+            # 0-d consts decode as shape (1,) through the proto layer,
+            # leaving a stray leading axis — pin the body's static
+            # per-step shape
+            r = ctx.fresh("scan_xr")
+            ctx.add_node("Reshape",
+                         [g, _shape_const(ctx, bv.aval.shape)], [r])
+            xt.append(r)
+        inner_env = dict(zip(body.invars, consts_in + carry + xt))
+        _walk(ctx, body, closed.consts, inner_env)
+        step_out = [ctx.name_of(ov, inner_env) for ov in body.outvars]
+        carry = step_out[:ncar]
+        for k, y in enumerate(step_out[ncar:]):
+            ys[k].append(_unsqueeze0(ctx, y, "scan_y"))
+    for i in range(ncar):
+        ctx.add_node("Identity", [carry[i]], [outs[i]])
+    for k in range(n_ys):
+        seq = ys[k][::-1] if p.get("reverse") else ys[k]
+        if len(seq) == 1:
+            ctx.add_node("Identity", seq, [outs[ncar + k]])
+        else:
+            ctx.add_node("Concat", seq, [outs[ncar + k]],
+                         [_attr_i("axis", 0)])
+
+
+def _sort_eqn(ctx, eqn, ins, outs, in_avals):
+    """lax.sort (jnp.sort/argsort) via full-width TopK (ascending);
+    co-sorted operands follow through GatherElements. Multi-key sorts
+    (jnp.lexsort) cannot map onto single-key TopK and refuse loudly
+    rather than exporting a wrong permutation."""
+    if eqn.params.get("num_keys", 1) > 1:
+        raise NotImplementedError(
+            "multi-key lax.sort (jnp.lexsort) has no ONNX translation "
+            "— ONNX TopK sorts by one key")
+    axis = eqn.params["dimension"]
+    n = in_avals[0].shape[axis]
+    vals = ctx.fresh("sort_v")
+    idxs = ctx.fresh("sort_i")
+    ctx.add_node("TopK",
+                 [ins[0], ctx.add_const(onp.asarray([n], onp.int64))],
+                 [vals, idxs],
+                 [_attr_i("axis", axis), _attr_i("largest", 0),
+                  _attr_i("sorted", 1)])
+    ctx.add_node("Identity", [vals], [outs[0]])
+    for i in range(1, len(ins)):
+        ctx.add_node("GatherElements", [ins[i], idxs], [outs[i]],
+                     [_attr_i("axis", axis)])
+
+
+def _topk_eqn(ctx, eqn, ins, outs, in_avals):
+    """lax.top_k → ONNX TopK on the last axis (+ int32 index cast)."""
+    k = eqn.params["k"]
+    axis = len(in_avals[0].shape) - 1
+    i64 = ctx.fresh("topk_i64")
+    ctx.add_node("TopK",
+                 [ins[0], ctx.add_const(onp.asarray([k], onp.int64))],
+                 [outs[0], i64],
+                 [_attr_i("axis", axis), _attr_i("largest", 1),
+                  _attr_i("sorted", 1)])
+    ctx.add_node("Cast", [i64], [outs[1]],
+                 [_attr_i("to", 6)])  # int32 (jax top_k index dtype)
+
+
+def _gather_eqn(ctx, eqn, ins, outs, in_avals):
+    """lax.gather, simple-take form (jnp.take / embedding lookup):
+    one indexed axis collapsed, full slices elsewhere → ONNX Gather.
+    The general strided-window form has no ONNX analogue and raises."""
+    dn = eqn.params["dimension_numbers"]
+    sizes = eqn.params["slice_sizes"]
+    shape = in_avals[0].shape
+    batching = tuple(getattr(dn, "operand_batching_dims", ()))
+    one_axis = (len(dn.start_index_map) == 1 and
+                tuple(dn.collapsed_slice_dims)
+                == tuple(dn.start_index_map))
+    axis = dn.start_index_map[0] if one_axis else None
+
+    idx = ins[1]
+    idx_shape = in_avals[1].shape
+    if idx_shape and idx_shape[-1] == 1:  # drop the index-vector dim
+        r = ctx.fresh("gather_idx")
+        ctx.add_node("Reshape",
+                     [idx, _shape_const(ctx, idx_shape[:-1])], [r])
+        idx = r
+
+    if one_axis and not batching and \
+            all(sizes[d] == shape[d] for d in range(len(shape))
+                if d != axis) and sizes[axis] == 1:
+        # take/embedding form: one indexed axis, full slices elsewhere
+        ctx.add_node("Gather", [ins[0], idx], outs,
+                     [_attr_i("axis", axis)])
+    elif one_axis and not dn.offset_dims and \
+            all(s == 1 for s in sizes) and \
+            tuple(sorted(batching + (axis,))) == tuple(
+                range(len(shape))):
+        # take_along_axis form: every other dim batched elementwise
+        ctx.add_node("GatherElements", [ins[0], idx], outs,
+                     [_attr_i("axis", axis)])
+    else:
+        raise NotImplementedError(
+            "general lax.gather (strided/multi-axis) has no ONNX "
+            "translation; only take/embedding/take_along_axis-style "
+            "gathers export")
+
+
+def _dynamic_slice_eqn(ctx, eqn, ins, outs):
+    """lax.dynamic_slice → ONNX Slice with runtime starts, clamped to
+    [0, dim - size] per jax semantics (an out-of-range start slides
+    the window back instead of shortening the result)."""
+    sizes = eqn.params["slice_sizes"]
+    op_shape = eqn.invars[0].aval.shape
+    parts = []
+    for s in ins[1:]:
+        u = _unsqueeze0(ctx, s, "ds_s")
+        c = ctx.fresh("ds_c")
+        ctx.add_node("Cast", [u], [c], [_attr_i("to", 7)])  # int64
+        parts.append(c)
+    raw = ctx.fresh("ds_raw")
+    if len(parts) == 1:
+        ctx.add_node("Identity", parts, [raw])
+    else:
+        ctx.add_node("Concat", parts, [raw], [_attr_i("axis", 0)])
+    lo = ctx.fresh("ds_lo")
+    ctx.add_node("Max", [raw, ctx.add_const(
+        onp.zeros(len(sizes), onp.int64))], [lo])
+    starts = ctx.fresh("ds_starts")
+    ctx.add_node("Min", [lo, ctx.add_const(onp.asarray(
+        [d - s for d, s in zip(op_shape, sizes)], onp.int64))],
+        [starts])
+    ends = ctx.fresh("ds_ends")
+    ctx.add_node("Add",
+                 [starts, ctx.add_const(onp.asarray(sizes, onp.int64))],
+                 [ends])
+    ctx.add_node("Slice", [
+        ins[0], starts, ends,
+        ctx.add_const(onp.asarray(range(len(sizes)), onp.int64))], outs)
 
 
 def _try_fold(ctx, eqn, env):

@@ -368,7 +368,8 @@ def tensor_to_numpy(t: dict) -> onp.ndarray:
 
 
 def numpy_to_tensor(arr, name: str) -> dict:
-    sdt = str(arr.dtype)
+    dims = list(arr.shape)  # BEFORE ascontiguousarray: it promotes
+    sdt = str(arr.dtype)    # 0-d scalars to shape (1,)
     if sdt == "bfloat16":
         as32 = onp.asarray(arr, dtype=onp.float32)
         raw = (as32.view(onp.uint32) >> 16).astype(onp.uint16).tobytes()
@@ -377,5 +378,5 @@ def numpy_to_tensor(arr, name: str) -> dict:
         arr = onp.ascontiguousarray(arr)
         raw = arr.tobytes()
         code = np_dtype_to_onnx(arr.dtype)
-    return {"dims": list(arr.shape), "data_type": code,
+    return {"dims": dims, "data_type": code,
             "raw_data": raw, "name": name}

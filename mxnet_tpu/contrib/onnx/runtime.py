@@ -186,6 +186,25 @@ class OnnxGraph:
             "Pad": lambda: self._pad(ins),
             "Slice": lambda: self._slice(ins),
             "Flatten": lambda: ins[0].reshape(ins[0].shape[0], -1),
+            "ArgMin": lambda: onp.argmin(
+                ins[0], axis=at.get("axis", 0)),
+            "Gather": lambda: onp.take(
+                ins[0], ins[1].astype(onp.int64),
+                axis=at.get("axis", 0)),
+            "GatherElements": lambda: onp.take_along_axis(
+                ins[0], ins[1].astype(onp.int64),
+                axis=at.get("axis", 0)),
+            "Unsqueeze": lambda: onp.expand_dims(
+                ins[0], tuple(int(v) for v in ins[1])),
+            "Squeeze": lambda: onp.squeeze(
+                ins[0], tuple(int(v) for v in ins[1]))
+            if len(ins) > 1 else onp.squeeze(ins[0]),
+            "CumSum": lambda: self._cumsum(ins, at),
+            "Split": lambda: tuple(
+                onp.split(ins[0],
+                          onp.cumsum([int(v) for v in ins[1]])[:-1],
+                          axis=at.get("axis", 0))),
+            "TopK": lambda: self._topk(ins, at),
         }
         if op not in table:
             raise NotImplementedError(f"evaluator: ONNX op {op!r}")
@@ -222,6 +241,27 @@ class OnnxGraph:
         return onp.pad(x, pairs, constant_values=cval)
 
     @staticmethod
+    def _cumsum(ins, at):
+        out = onp.cumsum(ins[0], axis=int(onp.asarray(ins[1])))
+        if at.get("reverse"):
+            ax = int(onp.asarray(ins[1]))
+            flip = onp.flip(ins[0], axis=ax)
+            out = onp.flip(onp.cumsum(flip, axis=ax), axis=ax)
+        return out
+
+    @staticmethod
+    def _topk(ins, at):
+        x = ins[0]
+        k = int(onp.asarray(ins[1]).reshape(-1)[0])
+        axis = at.get("axis", -1)
+        largest = at.get("largest", 1)
+        order = onp.argsort(-x if largest else x, axis=axis,
+                            kind="stable")
+        idx = onp.take(order, range(k), axis=axis)
+        vals = onp.take_along_axis(x, idx, axis=axis)
+        return vals, idx.astype(onp.int64)
+
+    @staticmethod
     def _slice(ins):
         x = ins[0]
         starts = [int(v) for v in ins[1]]
@@ -247,7 +287,11 @@ class OnnxGraph:
         for node in self.graph["node"]:
             outs = node["output"]
             res = self._eval_node(node, env)
-            env[outs[0]] = _to_np(res)
+            if isinstance(res, tuple):
+                for name, val in zip(outs, res):
+                    env[name] = _to_np(val)
+            else:
+                env[outs[0]] = _to_np(res)
         return [env[n] for n in self.output_names]
 
 

@@ -120,13 +120,31 @@ class Estimator:
                                                    self.batch_axis)
 
     def evaluate(self, val_data, batch_axis=0, event_handlers=None):
+        from .event_handler import (BatchBegin, BatchEnd, EpochBegin,
+                                    EpochEnd)
+        handlers = event_handlers or []
+        if not isinstance(handlers, (list, tuple)):
+            handlers = [handlers]
         for m in self.val_metrics + [self.val_loss_metric]:
             m.reset()
+        for h in handlers:
+            if isinstance(h, EpochBegin):
+                h.epoch_begin(self)
         for batch in val_data:
+            for h in handlers:
+                if isinstance(h, BatchBegin):
+                    h.batch_begin(self, batch=batch)
             _, label, pred, loss = self.evaluate_batch(batch)
             for m in self.val_metrics:
                 m.update(label, pred)
             self.val_loss_metric.update(0, loss)
+            for h in handlers:
+                if isinstance(h, BatchEnd):
+                    h.batch_end(self, batch=batch, pred=pred,
+                                label=label, loss=loss)
+        for h in handlers:
+            if isinstance(h, EpochEnd):
+                h.epoch_end(self)
         return dict(m.get() for m in
                     [*self.val_metrics, self.val_loss_metric])
 

@@ -95,6 +95,120 @@ class _Prefetcher(threading.Thread):
             self.stop()
 
 
+# ---------------------------------------------------------------------------
+# process-worker machinery (parity: dataloader.py:50-93 ForkingPickler
+# + CPUShared hand-off). Workers are SPAWNED (never forked: the parent
+# holds initialized XLA runtimes whose locks a fork would clone
+# mid-state), receive the pickled dataset+batchify once at pool init,
+# and send back host numpy trees. Leaves ride POSIX shared memory when
+# available (one copy: worker→shm; the parent maps it zero-copy and
+# hands it to PJRT H2D), falling back to pipe pickling.
+# ---------------------------------------------------------------------------
+_W_DATASET = None
+_W_BATCHIFY = None
+_W_USE_SHM = False
+
+
+def _proc_worker_init(ds_bytes, bf_bytes, use_shm):
+    import pickle
+    # workers never touch an accelerator: pin the CPU backend via
+    # jax.config BEFORE anything imports the package — an env var is
+    # not enough once a PJRT plugin registers, and a worker wedged on
+    # device init would stall the whole epoch
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    global _W_DATASET, _W_BATCHIFY, _W_USE_SHM
+    _W_DATASET = pickle.loads(ds_bytes)
+    _W_BATCHIFY = pickle.loads(bf_bytes)
+    _W_USE_SHM = use_shm
+
+
+def _tree_to_host(obj):
+    """Batchified output -> picklable host tree (NDArray leaves →
+    numpy; nests preserved)."""
+    if isinstance(obj, NDArray):
+        return obj.asnumpy()
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_host(x) for x in obj)
+    return obj
+
+
+def _leaf_to_shm(arr):
+    from multiprocessing import shared_memory, resource_tracker
+    shm = shared_memory.SharedMemory(create=True,
+                                     size=max(1, arr.nbytes))
+    view = onp.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+    view[...] = arr
+    name = shm.name
+    # the PARENT owns the segment lifetime: detach this process's
+    # resource-tracker registration so worker exit doesn't unlink it
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # noqa: BLE001 — tracker API is semi-private
+        pass
+    shm.close()
+    return ("__shm__", name, arr.shape, str(arr.dtype))
+
+
+def _tree_to_shm(obj):
+    if isinstance(obj, onp.ndarray) and obj.nbytes > 0:
+        return _leaf_to_shm(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_shm(x) for x in obj)
+    return obj
+
+
+def _proc_make_batch(indices):
+    samples = [_W_DATASET[i] for i in indices]
+    host = _tree_to_host(_W_BATCHIFY(samples))
+    if _W_USE_SHM:
+        try:
+            return _tree_to_shm(host)
+        except Exception:  # noqa: BLE001 — fall back to pipe pickling
+            return host
+    return host
+
+
+def _tree_from_shm(obj):
+    """Rebuild device arrays in the parent; unlink consumed segments."""
+    from ...numpy import array
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        from multiprocessing import shared_memory
+        _, name, shape, dtype = obj
+        shm = shared_memory.SharedMemory(name=name)
+        try:
+            view = onp.ndarray(shape, dtype, buffer=shm.buf)
+            # jax CPU arrays may ALIAS an aligned host buffer
+            # (zero-copy device_put) — materialize an owned copy
+            # before the segment unmaps or reads segfault
+            out = array(onp.array(view), dtype=view.dtype)
+        finally:
+            shm.close()
+            shm.unlink()
+        return out
+    if isinstance(obj, onp.ndarray):
+        return array(obj, dtype=obj.dtype)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_from_shm(x) for x in obj)
+    return obj
+
+
+def _tree_unlink_shm(obj):
+    """Release shm descriptors of an unconsumed batch."""
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        from multiprocessing import shared_memory
+        try:
+            shm = shared_memory.SharedMemory(name=obj[1])
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+        return
+    if isinstance(obj, (list, tuple)):
+        for x in obj:
+            _tree_unlink_shm(x)
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, batch_size=None, shuffle=False,
                  sampler=None, last_batch=None, batch_sampler=None,
@@ -127,8 +241,16 @@ class DataLoader:
                 "specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
-        self._pool = ThreadPoolExecutor(max_workers=self._num_workers) \
-            if self._num_workers > 0 else None
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        self._pool = None
+        self._proc_pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=self._num_workers)
+            # process pool is created lazily on first __iter__: spawn
+            # is expensive and pickles the dataset once
 
     def _make_batch(self, indices):
         if self._pool is not None:
@@ -137,7 +259,74 @@ class DataLoader:
             samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
 
+    def _ensure_proc_pool(self):
+        if self._proc_pool is None:
+            import multiprocessing as mp
+            import pickle
+            ctx = mp.get_context("spawn")
+            try:
+                from multiprocessing import shared_memory  # noqa: F401
+                use_shm = True
+            except ImportError:
+                use_shm = False
+            self._proc_pool = ctx.Pool(
+                self._num_workers, initializer=_proc_worker_init,
+                initargs=(pickle.dumps(self._dataset),
+                          pickle.dumps(self._batchify_fn), use_shm))
+        return self._proc_pool
+
+    def _proc_iter(self):
+        """Process-worker epoch: a bounded window of in-flight batches
+        (the prefetch depth) keeps workers busy without unbounded
+        memory; results rebuild in order."""
+        from collections import deque
+        pool = self._ensure_proc_pool()
+        depth = max(self._prefetch, self._num_workers)
+        pending = deque()
+        batches = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                idxs = next(batches)
+            except StopIteration:
+                return False
+            pending.append(pool.apply_async(_proc_make_batch,
+                                            (list(idxs),)))
+            return True
+
+        for _ in range(depth):
+            if not submit():
+                break
+        try:
+            while pending:
+                try:
+                    res = pending.popleft().get(self._timeout)
+                except Exception as e:
+                    if type(e).__name__ == "TimeoutError":
+                        raise RuntimeError(
+                            f"process DataLoader batch not ready "
+                            f"after {self._timeout}s. Likely causes: "
+                            f"the dataset/batchify_fn class is not "
+                            f"importable in a spawned worker (define "
+                            f"it at module top level, not __main__/"
+                            f"REPL), or one batch genuinely exceeds "
+                            f"the timeout (pass timeout=N).") from e
+                    raise
+                submit()
+                yield _tree_from_shm(res)
+        finally:
+            # abandoned epoch (break / exception / timeout): the
+            # workers unregistered their segments, so unconsumed
+            # in-flight batches would leak /dev/shm — reap them
+            for fut in pending:
+                try:
+                    _tree_unlink_shm(fut.get(5))
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+
     def __iter__(self):
+        if self._num_workers > 0 and not self._thread_pool:
+            return self._proc_iter()
         it = (self._make_batch(batch) for batch in self._batch_sampler)
         if self._prefetch > 0:
             return iter(_Prefetcher(it, self._prefetch))
@@ -147,5 +336,10 @@ class DataLoader:
         return len(self._batch_sampler)
 
     def __del__(self):
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
+        try:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            if self._proc_pool is not None:
+                self._proc_pool.terminate()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -26,13 +26,26 @@ import numpy as onp
 import jax
 import jax.numpy as jnp
 
-__all__ = ["load", "loaded_libraries"]
+__all__ = ["load", "loaded_libraries", "apply_pass", "partition",
+           "graph_passes", "partitioners"]
 
 _LOADED = {}
+_PASSES = {}
+_PARTITIONERS = {}
 
 
 def loaded_libraries():
     return dict(_LOADED)
+
+
+def graph_passes():
+    """Registered out-of-tree graph passes (name → callable)."""
+    return dict(_PASSES)
+
+
+def partitioners():
+    """Registered out-of-tree partitioners (name → callable)."""
+    return dict(_PARTITIONERS)
 
 
 def _make_op(cfn, name, arity):
@@ -106,8 +119,169 @@ def load(path, verbose=True):
                         ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
         setattr(npx, name, _make_op(cfn, name, arity))
         registered.append(name)
-    _LOADED[path] = registered
+
+    # optional: graph passes and partitioners (parity:
+    # include/mxnet/lib_api.h REGISTER_PASS / REGISTER_PARTITIONER —
+    # the reference feeds extensions the nnvm JSON graph; here they
+    # receive the mx.sym serialized DAG JSON. Returned pointers stay
+    # valid until the next call into the library, so copy eagerly.)
+    def _c_json_fn(sym_name):
+        cjf = getattr(lib, sym_name)
+        cjf.restype = ctypes.c_char_p
+        cjf.argtypes = [ctypes.c_char_p]
+
+        def call(graph_json: str) -> str:
+            out = cjf(graph_json.encode())
+            if out is None:
+                raise RuntimeError(
+                    f"extension {sym_name!r} returned NULL")
+            return out.decode()
+        call.__name__ = sym_name
+        return call
+
+    passes, parts = [], []
+    for lister, registry, out in (
+            ("mxtpu_ext_pass_list", _PASSES, passes),
+            ("mxtpu_ext_partitioner_list", _PARTITIONERS, parts)):
+        try:
+            fn = getattr(lib, lister)
+        except AttributeError:
+            continue
+        fn.restype = ctypes.c_char_p
+        for name in fn().decode().split(","):
+            name = name.strip()
+            if name:
+                registry[name] = _c_json_fn(name)
+                out.append(name)
+
+    _LOADED[path] = registered + passes + parts
     if verbose:
-        print(f"[mx.library] loaded {len(registered)} op(s) from "
-              f"{path}: {registered}")
-    return registered
+        print(f"[mx.library] loaded {len(registered)} op(s), "
+              f"{len(passes)} pass(es), {len(parts)} partitioner(s) "
+              f"from {path}")
+    return _LOADED[path]
+
+
+def apply_pass(symbol, name):
+    """Run a loaded extension graph pass over a Symbol: the pass sees
+    the serialized DAG JSON and returns a rewritten graph (parity:
+    HybridBlock.optimize_for with a lib_api graph pass)."""
+    from .symbol import load_json
+    if name not in _PASSES:
+        raise ValueError(f"no loaded graph pass {name!r}; loaded: "
+                         f"{sorted(_PASSES)}")
+    return load_json(_PASSES[name](symbol.tojson()))
+
+
+def partition(symbol, name):
+    """Run a loaded extension partitioner: it returns groups of node
+    names; each group folds into ONE `_subgraph` node whose attr
+    embeds the sub-DAG (parity: SubgraphProperty-based partitioning,
+    src/operator/subgraph/build_subgraph.cc)."""
+    import json as _json
+    if name not in _PARTITIONERS:
+        raise ValueError(f"no loaded partitioner {name!r}; loaded: "
+                         f"{sorted(_PARTITIONERS)}")
+    groups = _json.loads(_PARTITIONERS[name](symbol.tojson()))
+    out = symbol
+    for group in groups:
+        if group:
+            out = _fold_group(out, group)
+    return out
+
+
+def _fold_group(sym, names):
+    """Fold the named nodes of `sym` into one `_subgraph` node.
+
+    Constraints (v1, matching the reference's single-output subgraph
+    ops): the group must have exactly one output entry consumed
+    outside the group; groups violating this are skipped with a
+    warning."""
+    import warnings
+    from .symbol.symbol import Symbol, _Node
+
+    nodes = sym._nodes
+    name_to_id = {n.name: i for i, n in enumerate(nodes)}
+    gids = {name_to_id[n] for n in names if n in name_to_id}
+    gids = {i for i in gids if nodes[i].op != "null"}
+    if not gids:
+        return sym
+
+    consumed = set()
+    for i, n in enumerate(nodes):
+        if i in gids:
+            continue
+        for (j, idx) in n.inputs:
+            if j in gids:
+                consumed.add((j, idx))
+    for (j, idx) in sym._outputs:
+        if j in gids:
+            consumed.add((j, idx))
+    if len(consumed) != 1:
+        warnings.warn(
+            f"partitioner group {sorted(names)} has "
+            f"{len(consumed)} external outputs; only single-output "
+            "groups fold — skipped")
+        return sym
+    out_entry = next(iter(consumed))
+
+    # ordered external inputs of the group
+    ext_in = []
+    for i in sorted(gids):
+        for (j, idx) in nodes[i].inputs:
+            if j not in gids and (j, idx) not in ext_in:
+                ext_in.append((j, idx))
+
+    # build the embedded subgraph (vars __sg_in_k for external inputs)
+    sub_nodes, id_map = [], {}
+    for k, (j, idx) in enumerate(ext_in):
+        id_map[("ext", j, idx)] = len(sub_nodes)
+        sub_nodes.append(_Node("null", f"__sg_in_{k}", [], {}))
+    for i in sorted(gids):
+        new_inputs = []
+        for (j, idx) in nodes[i].inputs:
+            if j in gids:
+                new_inputs.append((id_map[("g", j)], idx))
+            else:
+                new_inputs.append((id_map[("ext", j, idx)], 0))
+        id_map[("g", i)] = len(sub_nodes)
+        sub_nodes.append(_Node(nodes[i].op, nodes[i].name, new_inputs,
+                               nodes[i].attrs))
+    sub_sym = Symbol(sub_nodes,
+                     [(id_map[("g", out_entry[0])], out_entry[1])])
+    sub_json = sub_sym.tojson()
+
+    # rebuild the outer graph: group nodes out, one _subgraph node in
+    new_nodes, remap = [], {}
+    insert_after = max(gids)
+    sg_id = None
+    sg_name = f"subgraph_{min(gids)}"
+    for i, n in enumerate(nodes):
+        if i in gids:
+            pass
+        else:
+            remap[i] = len(new_nodes)
+            new_nodes.append(n)  # inputs fixed in a second pass
+        if i == insert_after:
+            sg_id = len(new_nodes)
+            new_nodes.append(_Node(
+                "_subgraph", sg_name, list(ext_in),  # remapped below
+                {"json": sub_json}))
+
+    def map_entry(j, idx):
+        if j in gids:
+            return (sg_id, 0) if (j, idx) == out_entry else None
+        return (remap[j], idx)
+
+    fixed = []
+    for pos, n in enumerate(new_nodes):
+        if pos == sg_id:
+            fixed.append(_Node(n.op, n.name,
+                               [(remap[j], idx) for (j, idx) in n.inputs],
+                               n.attrs))
+        else:
+            fixed.append(_Node(n.op, n.name,
+                               [map_entry(j, idx) for (j, idx)
+                                in n.inputs], n.attrs))
+    new_outputs = [map_entry(j, idx) for (j, idx) in sym._outputs]
+    return Symbol(fixed, new_outputs)

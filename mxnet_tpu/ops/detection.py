@@ -253,7 +253,13 @@ def multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     score = jnp.take_along_axis(
         cls_prob, cls_id[:, None].astype(jnp.int32), axis=1)[:, 0]
     keep = score > threshold
-    out_id = jnp.where(keep, cls_id - (background_id >= 0), -1.0)
+    # output ids index the non-background classes: only classes ABOVE
+    # the background row shift down by one
+    if 0 <= background_id < C:
+        fg_id = jnp.where(cls_id > background_id, cls_id - 1, cls_id)
+    else:
+        fg_id = cls_id
+    out_id = jnp.where(keep, fg_id, -1.0)
     out = jnp.concatenate([out_id[..., None], score[..., None], boxes],
                           -1)
     out = jnp.where(keep[..., None], out, -1.0)

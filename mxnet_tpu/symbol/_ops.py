@@ -299,6 +299,15 @@ def _legacy_reshape(x, shape=None):
     return x.reshape(tuple(out))
 
 
+def _subgraph_eval(*ins, json=None):
+    """Evaluate a partitioner-folded subgraph node: the embedded DAG
+    runs with its `__sg_in_k` placeholder vars bound to the node's
+    inputs (see library.partition / _fold_group)."""
+    from .symbol import load_json
+    sub = load_json(json)
+    return sub._eval({f"__sg_in_{k}": v for k, v in enumerate(ins)})[0]
+
+
 class _LazyTable(dict):
     """node-op name → callable, resolved against the live namespaces on
     first miss (so ANY generated wrapper's node evals without a
@@ -332,6 +341,7 @@ def op_table():
 
         table = _LazyTable()
         table["split"] = mx.np.split
+        table["_subgraph"] = _subgraph_eval
         table["_scalar"] = lambda value=None: value
         # adapters emitted by the legacy nnvm importer (legacy_json.py)
         table["_identity"] = lambda x: x

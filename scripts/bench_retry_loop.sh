@@ -1,6 +1,7 @@
 #!/bin/bash
 # Retry bench.py on the flaky axon tunnel until a TPU number lands.
 cd /root/repo
+mkdir -p bench_runs
 for i in $(seq 1 24); do
   ts=$(date +%H%M%S)
   echo "[loop] attempt $i at $ts" >> bench_runs/loop.log

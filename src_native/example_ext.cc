@@ -1,19 +1,45 @@
 // Example out-of-tree extension library (parity:
-// example/extensions/lib_custom_op in the reference — a self-contained
-// .so loaded with mx.library.load, no framework headers needed).
+// example/extensions/{lib_custom_op,lib_pass,lib_subgraph} in the
+// reference — a self-contained .so loaded with mx.library.load, no
+// framework headers needed).
 //
 // ABI (see mxnet_tpu/library.py):
 //   const char* mxtpu_ext_op_list();   // "name:arity,..."
 //   void <name>(const float* a, const float* b_or_null,
 //               float* out, int64_t n);
+//   const char* mxtpu_ext_pass_list();        // "passname,..."
+//   const char* <passname>(const char* graph_json);
+//       // returns rewritten graph JSON; pointer stays valid until
+//       // the next call into this library (thread-local storage)
+//   const char* mxtpu_ext_partitioner_list(); // "partname,..."
+//   const char* <partname>(const char* graph_json);
+//       // returns JSON [[node_name, ...], ...] — groups of nodes the
+//       // framework folds into subgraph nodes
 //
 // Build:  g++ -O2 -shared -fPIC example_ext.cc -o libexample_ext.so
 #include <cstdint>
 #include <cmath>
+#include <string>
+
+namespace {
+thread_local std::string result_buf;
+
+std::string replace_all(std::string s, const std::string& from,
+                        const std::string& to) {
+  size_t pos = 0;
+  while ((pos = s.find(from, pos)) != std::string::npos) {
+    s.replace(pos, from.size(), to);
+    pos += to.size();
+  }
+  return s;
+}
+}  // namespace
 
 extern "C" {
 
-const char* mxtpu_ext_op_list() { return "plus_one:1,scaled_mul:2"; }
+const char* mxtpu_ext_op_list() {
+  return "plus_one:1,scaled_mul:2,ext_square:1";
+}
 
 void plus_one(const float* a, const float*, float* out, int64_t n) {
   for (int64_t i = 0; i < n; ++i) out[i] = a[i] + 1.0f;
@@ -21,6 +47,51 @@ void plus_one(const float* a, const float*, float* out, int64_t n) {
 
 void scaled_mul(const float* a, const float* b, float* out, int64_t n) {
   for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * a[i] * b[i];
+}
+
+void ext_square(const float* a, const float*, float* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) out[i] = a[i] * a[i];
+}
+
+// ---- graph pass: rewrite square(x) -> the extension's own ext_square op --------
+// (the reference's lib_pass example rewrites op types in the nnvm
+// JSON the same way; this operates on the mx.sym serialized DAG)
+const char* mxtpu_ext_pass_list() { return "square_to_ext"; }
+
+const char* square_to_ext(const char* graph_json) {
+  result_buf = replace_all(graph_json, "\"op\": \"square\"",
+                           "\"op\": \"ext_square\"");
+  result_buf = replace_all(result_buf, "\"op\":\"square\"",
+                           "\"op\":\"ext_square\"");
+  return result_buf.c_str();
+}
+
+// ---- partitioner: group nodes by a naming convention --------------
+// Returns groups of node names to fold into subgraph nodes. This toy
+// partitioner groups every node whose name starts with "fusable_"
+// into one subgraph (the reference's lib_subgraph example selects
+// ops by a supported-op list the same way).
+const char* mxtpu_ext_partitioner_list() { return "group_fusable"; }
+
+const char* group_fusable(const char* graph_json) {
+  std::string g(graph_json);
+  std::string out = "[[";
+  bool first = true;
+  size_t pos = 0;
+  while ((pos = g.find("\"name\": \"fusable_", pos)) !=
+         std::string::npos) {
+    size_t start = pos + 9;  // past `"name": "`
+    size_t end = g.find('"', start);
+    if (end == std::string::npos) break;
+    if (!first) out += ",";
+    out += "\"" + g.substr(start, end - start) + "\"";
+    first = false;
+    pos = end;
+  }
+  out += "]]";
+  if (first) out = "[]";  // nothing to group
+  result_buf = out;
+  return result_buf.c_str();
 }
 
 }  // extern "C"

@@ -413,3 +413,21 @@ def test_hawkesll_matches_kernel_semantics():
     onp.testing.assert_allclose(ll.asnumpy(), ll_ref, rtol=1e-4)
     onp.testing.assert_allclose(st.asnumpy(), st_ref, rtol=1e-4,
                                 atol=1e-6)
+
+
+def test_multibox_detection_nonzero_background_id():
+    """Class ids must only shift down past the background row (review
+    finding, round 4): with background_id=2, winning row 0 stays class
+    0 and winning row 1 stays class 1."""
+    anchor = onp.array([[[0., 0., 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0]]], dtype=onp.float32)
+    cls_prob = onp.array([[[0.9, 0.1],     # class row 0
+                           [0.05, 0.8],    # class row 1
+                           [0.05, 0.1]]],  # background row (id 2)
+                         dtype=onp.float32)
+    loc_pred = onp.zeros((1, 8), onp.float32)
+    det = npx.multibox_detection(np.array(cls_prob), np.array(loc_pred),
+                                 np.array(anchor), background_id=2)
+    d = det.asnumpy()[0]
+    ids = sorted(int(r[0]) for r in d if r[1] > 0)
+    assert ids == [0, 1], d[:, :2]

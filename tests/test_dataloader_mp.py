@@ -1,0 +1,100 @@
+"""Process-worker DataLoader (round-3 VERDICT item 9).
+
+Parity model: python/mxnet/gluon/data/dataloader.py:50-93 — worker
+processes with shared-memory NDArray hand-off. Here workers are
+spawned, run dataset[i] + batchify, and return host trees whose numpy
+leaves ride POSIX shared memory into the parent."""
+import os
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+
+
+class SquareDataset(gdata.Dataset):
+    """Top-level (picklable) dataset with a python transform."""
+
+    def __init__(self, n=32):
+        self._n = n
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        x = onp.full((4, 4), float(i), onp.float32)
+        return x * x, onp.int32(i)
+
+
+class SlowDataset(SquareDataset):
+    def __getitem__(self, i):
+        # pure-python CPU burn that HOLDS the GIL (what the process
+        # path exists for)
+        acc = 0.0
+        for k in range(20000):
+            acc += (i * k) % 7
+        x, y = super().__getitem__(i)
+        return x + (acc * 0.0), y
+
+
+def test_process_loader_matches_thread_loader():
+    ds = SquareDataset(20)
+    thread = gdata.DataLoader(ds, batch_size=4, num_workers=0)
+    proc = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                            thread_pool=False)
+    got_t = [(d.asnumpy(), l.asnumpy()) for d, l in thread]
+    got_p = [(d.asnumpy(), l.asnumpy()) for d, l in proc]
+    assert len(got_t) == len(got_p) == 5
+    for (dt, lt), (dp, lp) in zip(got_t, got_p):
+        onp.testing.assert_allclose(dp, dt)
+        onp.testing.assert_array_equal(lp, lt)
+
+
+def test_process_loader_multiple_epochs_and_shuffle():
+    ds = SquareDataset(12)
+    proc = gdata.DataLoader(ds, batch_size=3, num_workers=2,
+                            thread_pool=False, shuffle=True)
+    seen1 = sorted(int(v) for _, l in proc for v in l.asnumpy())
+    seen2 = sorted(int(v) for _, l in proc for v in l.asnumpy())
+    assert seen1 == seen2 == list(range(12))
+
+
+@pytest.mark.skipif((os.cpu_count() or 1) < 2,
+                    reason="needs >1 core to demonstrate scaling")
+def test_process_loader_scales_past_gil():
+    ds = SlowDataset(24)
+    serial = gdata.DataLoader(ds, batch_size=4, num_workers=0,
+                              prefetch=0)
+    proc = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                            thread_pool=False)
+    t0 = time.perf_counter()
+    for _ in serial:
+        pass
+    t_serial = time.perf_counter() - t0
+    next(iter(proc))  # warm the spawn pool outside the timed region
+    t0 = time.perf_counter()
+    for _ in proc:
+        pass
+    t_proc = time.perf_counter() - t0
+    # two GIL-free workers + pipelining must beat the serial loop
+    assert t_proc < t_serial * 0.9, (t_serial, t_proc)
+
+
+def test_partial_epoch_releases_shared_memory():
+    """Breaking out of an epoch must not leak /dev/shm segments
+    (review finding, round 4)."""
+    import glob
+    ds = SquareDataset(32)
+    proc = gdata.DataLoader(ds, batch_size=4, num_workers=2,
+                            thread_pool=False)
+    before = set(glob.glob("/dev/shm/*"))
+    it = iter(proc)
+    next(it)
+    it.close()   # abandon mid-epoch -> finally reaps in-flight shm
+    time.sleep(0.5)
+    after = set(glob.glob("/dev/shm/psm_*"))  # data segments only —
+    # sem.mp-* are the live pool's semaphores, freed with the pool
+    leaked = [p for p in after - before]
+    assert not leaked, leaked

@@ -143,3 +143,124 @@ def test_atan2_and_is_finite_lowering(tmp_path):
     # cross-check vs numpy ground truth
     onp.testing.assert_allclose(
         ref, onp.arctan2(y.asnumpy(), x.asnumpy()) + 1.0, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# round-4 VERDICT item 6: scan/gather/sort family exports
+# ---------------------------------------------------------------------------
+def test_export_lstm_lm(tmp_path):
+    """Fused (lax.scan) LSTM language model exports via scan unrolling
+    and round-trips numerically (reference exports cuDNN RNN as ONNX
+    LSTM nodes: _op_translations.py; here ANY scanned cell exports)."""
+    from mxnet_tpu.gluon import rnn
+
+    class LSTMLM(nn.HybridBlock):
+        def __init__(self, vocab=50, emb=16, hid=32):
+            super().__init__()
+            self.embed = nn.Embedding(vocab, emb)
+            self.lstm = rnn.LSTM(hid, num_layers=2, layout="NTC")
+            self.out = nn.Dense(vocab, flatten=False)
+
+        def forward(self, x):
+            return self.out(self.lstm(self.embed(x)))
+
+    net = LSTMLM()
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.random.RandomState(0)
+                    .randint(0, 50, (2, 7)).astype("int32"))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "lstm_lm.onnx")
+    mxonnx.export_model(net, [(2, 7)], path)
+    out = mxonnx.import_model(path)(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=2e-3, atol=2e-4)
+
+
+def test_export_bert(tmp_path):
+    """BERT-small (config-4 shape family) exports and round-trips."""
+    from mxnet_tpu.gluon.model_zoo import bert
+
+    net = bert.bert_small(vocab_size=200, dropout=0.0)
+    net.initialize()
+    net.hybridize()
+    tok = mx.np.array(onp.random.RandomState(1)
+                      .randint(0, 200, (2, 12)).astype("int32"))
+    segs = mx.np.zeros((2, 12), dtype="int32")
+    vlen = mx.np.array(onp.array([12, 9], "int32"))
+    ref = net(tok, segs, vlen)
+    ref = ref[0] if isinstance(ref, (tuple, list)) else ref
+    path = str(tmp_path / "bert.onnx")
+    mxonnx.export_model(net, [(2, 12), (2, 12), (2,)], path)
+    out = mxonnx.import_model(path)(tok, segs, vlen)
+    out = out[0] if isinstance(out, (tuple, list)) else out
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=5e-3, atol=5e-4)
+
+
+def test_export_topk_sort_take_cumsum(tmp_path):
+    """The gather/ordering family: top-k, argsort-gather, embedding
+    take, cumulative sum all lower to ONNX and agree numerically."""
+    from mxnet_tpu import npx
+
+    class Head(nn.HybridBlock):
+        def forward(self, x):
+            vals, idx = npx.topk(x, k=3, axis=-1, ret_typ="both")
+            order = mx.np.argsort(x, axis=-1)
+            ranked = mx.np.take_along_axis(x, order, axis=-1)
+            cs = mx.np.cumsum(x, axis=1)
+            return vals + cs[:, :3] + ranked[:, :3] \
+                + idx.astype("float32")
+
+    net = Head()
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.random.RandomState(3)
+                    .rand(4, 9).astype("float32"))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "ordering.onnx")
+    mxonnx.export_model(net, [(4, 9)], path)
+    out = mxonnx.import_model(path)(x).asnumpy()
+    onp.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_export_lexsort_refuses():
+    """Multi-key sorts have no faithful ONNX lowering and must refuse
+    instead of silently exporting a wrong permutation (review
+    finding, round 4)."""
+    class Lex(nn.HybridBlock):
+        def forward(self, a, b):
+            import mxnet_tpu as _mx
+            return _mx.np.lexsort([a, b]).astype("float32")
+
+    net = Lex()
+    net.initialize()
+    net.hybridize()
+    with pytest.raises(Exception, match="lexsort|multi-key|num_keys"):
+        mxonnx.export_model(net, [(5,), (5,)],
+                            "/tmp/lexsort_refuse.onnx")
+
+
+def test_export_dynamic_slice_clamps(tmp_path):
+    """Out-of-range runtime starts slide back per jax semantics."""
+    import jax
+    from jax import lax
+
+    class DynSlice(nn.HybridBlock):
+        def forward(self, x, i):
+            from mxnet_tpu.ops import apply_op
+            return apply_op(
+                lambda xv, iv: lax.dynamic_slice(
+                    xv, (iv.astype("int32").reshape(()),), (4,)),
+                x, i, name="dynslice")
+
+    net = DynSlice()
+    net.initialize()
+    net.hybridize()
+    x = mx.np.array(onp.arange(8, dtype=onp.float32))
+    i = mx.np.array(onp.array(6, onp.int32))  # clamps to start=4
+    ref = net(x, i).asnumpy()
+    path = str(tmp_path / "ds.onnx")
+    mxonnx.export_model(net, [(8,), ()], path)
+    out = mxonnx.import_model(path)(x, i).asnumpy()
+    onp.testing.assert_allclose(out, ref)
+    assert out.shape == (4,)

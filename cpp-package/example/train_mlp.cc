@@ -1,0 +1,138 @@
+// Train a 2-layer MLP classifier from C++ through the libmxtpu_train
+// C API — no Python in the host program (parity: the reference's
+// cpp-package training examples, e.g. cpp-package/example/mlp.cpp,
+// over its generated op wrappers + C API).
+//
+// The "dataset" is synthetic MNIST-shaped blobs: class k's pixels are
+// drawn around k-dependent means, so a linear-ish model must reach
+// near-zero loss if forward, backward, and the optimizer all work.
+//
+// Build (see tests/test_c_train_api.py):
+//   g++ -O2 train_mlp.cc -I../include -L. -lmxtpu_train -o train_mlp
+#include <mxtpu/c_train_api.h>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+#define CHECK(call)                                            \
+  do {                                                         \
+    if ((call) != 0) {                                         \
+      std::fprintf(stderr, "FAIL %s: %s\n", #call,             \
+                   MXTPUTrainGetLastError());                  \
+      return 1;                                                \
+    }                                                          \
+  } while (0)
+
+namespace {
+
+float frand() { return static_cast<float>(std::rand()) / RAND_MAX; }
+
+int make_param(int rows, int cols, float scale, int* out) {
+  std::vector<float> host(static_cast<size_t>(rows) * cols);
+  for (auto& v : host) v = (frand() - 0.5f) * 2.0f * scale;
+  int64_t shape[2] = {rows, cols};
+  return MXTPUNDArrayCreate(host.data(), shape, 2, out);
+}
+
+}  // namespace
+
+int main() {
+  std::srand(7);
+  CHECK(MXTPUTrainInit());
+
+  const int kIn = 64, kHidden = 32, kClasses = 4, kBatch = 32;
+
+  int w1, b1, w2, b2;
+  CHECK(make_param(kIn, kHidden, 0.1f, &w1));
+  CHECK(make_param(1, kHidden, 0.0f, &b1));
+  CHECK(make_param(kHidden, kClasses, 0.1f, &w2));
+  CHECK(make_param(1, kClasses, 0.0f, &b2));
+  const int params[4] = {w1, b1, w2, b2};
+  for (int p : params) CHECK(MXTPUAutogradMarkVariable(p));
+
+  int opt;
+  CHECK(MXTPUOptimizerCreate("sgd", "{\"learning_rate\": 0.5}", &opt));
+
+  double first_loss = -1.0, last_loss = -1.0;
+  for (int step = 0; step < 60; ++step) {
+    // synthetic batch: class k lights up feature group j%K == k
+    std::vector<float> x(kBatch * kIn);
+    std::vector<float> y(kBatch);
+    for (int i = 0; i < kBatch; ++i) {
+      int k = i % kClasses;
+      y[i] = static_cast<float>(k);
+      for (int j = 0; j < kIn; ++j)
+        x[i * kIn + j] = (j % kClasses == k ? 1.0f : 0.0f) +
+                         0.2f * (frand() - 0.5f);
+    }
+    int64_t xs[2] = {kBatch, kIn};
+    int64_t ys[1] = {kBatch};
+    int xh, yh;
+    CHECK(MXTPUNDArrayCreate(x.data(), xs, 2, &xh));
+    CHECK(MXTPUNDArrayCreate(y.data(), ys, 1, &yh));
+
+    CHECK(MXTPUAutogradSetIsRecording(1));
+    int h, n;
+    std::vector<int> temps;  // free after backward or they leak
+    int t1[2] = {xh, w1};
+    CHECK(MXTPUImperativeInvoke("dot", t1, 2, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t2[2] = {h, b1};
+    CHECK(MXTPUImperativeInvoke("add", t2, 2, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t3[1] = {h};
+    CHECK(MXTPUImperativeInvoke("npx:relu", t3, 1, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t4[2] = {h, w2};
+    CHECK(MXTPUImperativeInvoke("dot", t4, 2, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t5[2] = {h, b2};
+    CHECK(MXTPUImperativeInvoke("add", t5, 2, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t6[1] = {h};
+    CHECK(MXTPUImperativeInvoke("npx:log_softmax", t6, 1,
+                                "{\"axis\": -1}", &h, 1, &n));
+    temps.push_back(h);
+    int t7[2] = {h, yh};
+    CHECK(MXTPUImperativeInvoke("npx:pick", t7, 2, "{\"axis\": -1}",
+                                &h, 1, &n));
+    temps.push_back(h);
+    int t8[1] = {h};
+    CHECK(MXTPUImperativeInvoke("mean", t8, 1, nullptr, &h, 1, &n));
+    temps.push_back(h);
+    int t9[1] = {h};
+    int loss;
+    CHECK(MXTPUImperativeInvoke("negative", t9, 1, nullptr, &loss, 1,
+                                &n));
+    CHECK(MXTPUAutogradSetIsRecording(0));
+    CHECK(MXTPUAutogradBackward(loss));
+    for (int t : temps) CHECK(MXTPUNDArrayFree(t));
+
+    for (int i = 0; i < 4; ++i) {
+      int g;
+      CHECK(MXTPUNDArrayGetGrad(params[i], &g));
+      CHECK(MXTPUOptimizerUpdate(opt, i, params[i], g));
+      CHECK(MXTPUNDArrayFree(g));
+    }
+
+    double lv;
+    CHECK(MXTPUNDArrayScalar(loss, &lv));
+    if (step == 0) first_loss = lv;
+    last_loss = lv;
+    if (step % 20 == 0)
+      std::printf("step %d loss %.4f\n", step, lv);
+    CHECK(MXTPUNDArrayFree(xh));
+    CHECK(MXTPUNDArrayFree(yh));
+    CHECK(MXTPUNDArrayFree(loss));
+  }
+
+  std::printf("first %.4f final %.4f\n", first_loss, last_loss);
+  if (!(last_loss < first_loss * 0.2) || !std::isfinite(last_loss)) {
+    std::fprintf(stderr, "TRAINING DID NOT CONVERGE\n");
+    return 2;
+  }
+  std::printf("TRAIN_OK\n");
+  return 0;
+}

@@ -1,0 +1,51 @@
+// libmxtpu_train — training-capable C API (parity: the training
+// surface of the reference's include/mxnet/c_api.h: NDArray
+// create/copy, imperative op invoke by name, autograd, optimizer
+// update). All functions return 0 on success, -1 on failure; fetch
+// the error text with MXTPUTrainGetLastError().
+#ifndef MXTPU_C_TRAIN_API_H_
+#define MXTPU_C_TRAIN_API_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+const char* MXTPUTrainGetLastError();
+int MXTPUTrainInit();
+
+/* NDArray: float32 host buffers in, integer handles out. */
+int MXTPUNDArrayCreate(const float* data, const int64_t* shape,
+                       int ndim, int* out);
+int MXTPUNDArrayFree(int h);
+int MXTPUNDArrayCopyTo(int h, float* out, int64_t capacity_floats);
+int MXTPUNDArrayShape(int h, int64_t* out_shape, int max_ndim,
+                      int* out_ndim);
+int MXTPUNDArrayScalar(int h, double* out);
+
+/* Invoke any op from the framework's op table by name ("dot",
+ * "add", "relu", "npx:log_softmax", ...). Static attrs ride in as a
+ * JSON object string. */
+int MXTPUImperativeInvoke(const char* op_name, const int* in_handles,
+                          int n_in, const char* kwargs_json,
+                          int* out_handles, int max_out, int* n_out);
+
+/* Autograd. */
+int MXTPUAutogradMarkVariable(int h);
+int MXTPUAutogradSetIsRecording(int flag);
+int MXTPUAutogradBackward(int loss_handle);
+int MXTPUNDArrayGetGrad(int h, int* out_grad);
+
+/* Optimizer: name + JSON hyperparameters -> updater handle;
+ * update applies grad to weight in place (per-weight `index` keys the
+ * optimizer state, like the reference's kvstore updater). */
+int MXTPUOptimizerCreate(const char* name, const char* kwargs_json,
+                         int* out);
+int MXTPUOptimizerUpdate(int opt, int index, int weight_h, int grad_h);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_C_TRAIN_API_H_ */

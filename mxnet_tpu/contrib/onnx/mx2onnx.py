@@ -475,6 +475,10 @@ def _scan_eqn(ctx, eqn, ins, outs, env):
     n_ys = len(body.outvars) - ncar
     ys = [[] for _ in range(n_ys)]
     xs_body_vars = body.invars[nc + ncar:]
+    # closure constants register ONCE — a fresh _walk per timestep
+    # would duplicate them T times in the initializer list
+    const_names = {cv: ctx.add_const(onp.asarray(c), "scanc")
+                   for cv, c in zip(body.constvars, closed.consts)}
     order = range(T - 1, -1, -1) if p.get("reverse") else range(T)
     for t in order:
         xt = []
@@ -492,7 +496,8 @@ def _scan_eqn(ctx, eqn, ins, outs, env):
                          [g, _shape_const(ctx, bv.aval.shape)], [r])
             xt.append(r)
         inner_env = dict(zip(body.invars, consts_in + carry + xt))
-        _walk(ctx, body, closed.consts, inner_env)
+        inner_env.update(const_names)
+        _walk(ctx, body, [], inner_env)
         step_out = [ctx.name_of(ov, inner_env) for ov in body.outvars]
         carry = step_out[:ncar]
         for k, y in enumerate(step_out[ncar:]):

@@ -242,12 +242,11 @@ class OnnxGraph:
 
     @staticmethod
     def _cumsum(ins, at):
-        out = onp.cumsum(ins[0], axis=int(onp.asarray(ins[1])))
+        ax = int(onp.asarray(ins[1]))
         if at.get("reverse"):
-            ax = int(onp.asarray(ins[1]))
             flip = onp.flip(ins[0], axis=ax)
-            out = onp.flip(onp.cumsum(flip, axis=ax), axis=ax)
-        return out
+            return onp.flip(onp.cumsum(flip, axis=ax), axis=ax)
+        return onp.cumsum(ins[0], axis=ax)
 
     @staticmethod
     def _topk(ins, at):

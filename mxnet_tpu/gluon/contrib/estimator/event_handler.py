@@ -361,6 +361,12 @@ class GradientUpdateHandler(BatchEnd):
         self.priority = priority
 
     def batch_end(self, estimator, *args, **kwargs):
+        # a stopping handler that ran earlier this batch (priority
+        # < ours) may have vetoed the update — e.g. NaNStoppingHandler
+        # flagging non-finite grads that must NOT reach the weights
+        if getattr(estimator, "_skip_update", False):
+            estimator._skip_update = False
+            return
         loss = kwargs.get("loss")
         batch_size = 0
         if loss is not None:
@@ -396,6 +402,9 @@ class NaNStoppingHandler(BatchEnd):
                     "non-finite loss at batch %d; stopping training",
                     self._batch)
                 estimator.stop_training = True
+                # veto this batch's optimizer step: the pre-update
+                # weights are still finite and worth checkpointing
+                estimator._skip_update = True
                 return
 
 

@@ -163,6 +163,9 @@ def test_nan_stopping_handler():
                                batch_size=16)
     est.fit(dl, epochs=100, event_handlers=[NaNStoppingHandler()])
     assert est.stop_training  # diverged run stopped, not 100 epochs
+    # the flagged batch's update was vetoed: weights stay finite
+    assert all(onp.isfinite(p.data().asnumpy()).all()
+               for p in net.collect_params().values())
 
 
 def test_gradient_clipping_handler():

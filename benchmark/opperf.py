@@ -606,6 +606,66 @@ def sync(result, mx):
         r.wait_to_read()
 
 
+# our np/npx names -> the reference registry names used in its opperf
+# result tables (benchmark/opperf/results/*.md)
+_REF_NAME_ALIASES = {
+    "add": "elemwise_add", "subtract": "elemwise_sub",
+    "multiply": "elemwise_mul", "divide": "elemwise_div",
+    "maximum": "broadcast_maximum",
+    "minimum": "broadcast_minimum", "mod": "broadcast_mod",
+    "matmul": "batch_dot", "concatenate": "concat",
+    "fully_connected": "FullyConnected", "convolution": "Convolution",
+    "pooling": "Pooling", "batch_norm": "BatchNorm",
+    "leaky_relu": "LeakyReLU", "activation": "Activation",
+    "dropout": "Dropout", "embedding": "Embedding",
+}
+
+
+def load_ref_table(path):
+    """Min forward latency (ms) per op from the reference's opperf
+    results markdown (| op | fwd | bwd | mem | inputs |)."""
+    table = {}
+    try:
+        with open(path) as f:
+            for line in f:
+                parts = [c.strip() for c in line.strip().split("|")]
+                if len(parts) < 5 or not parts[1] or parts[1] in (
+                        "Operator", ":---:", "---"):
+                    continue
+                try:
+                    fwd = float(parts[2])
+                except ValueError:
+                    continue
+                name = parts[1]
+                if name not in table or fwd < table[name]:
+                    table[name] = fwd
+    except OSError:
+        return {}
+    return table
+
+
+def annotate_vs_ref(results, ref_table):
+    """Attach ref_gpu_ms + vs_ref (reference V100 latency / ours;
+    >1 means this repo's op is faster than the reference's GPU op)."""
+    n = 0
+    for qual, rec in results.items():
+        if not rec.get("covered") or not rec.get("latency_ms"):
+            continue
+        base = qual.split(".", 1)[-1]
+        ref = ref_table.get(base) or \
+            ref_table.get(_REF_NAME_ALIASES.get(base, ""))
+        if ref is None:
+            continue
+        rec["ref_gpu_ms"] = ref
+        rec["vs_ref"] = round(ref / rec["latency_ms"], 3)
+        n += 1
+    return n
+
+
+REF_GPU_MD = ("/root/reference/benchmark/opperf/results/"
+              "mxnet_operator_benchmark_results_gpu.md")
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--output", default=None)
@@ -615,6 +675,8 @@ def main():
     p.add_argument("--filter", default=None)
     p.add_argument("--small", action="store_true",
                    help="tiny shapes: coverage only, skip timing")
+    p.add_argument("--ref-table", default=REF_GPU_MD,
+                   help="reference opperf results .md for vs_ref")
     args = p.parse_args()
 
     if args.platform == "cpu":
@@ -671,11 +733,15 @@ def main():
             results[qual] = {"covered": False, "latency_ms": None,
                              "error": f"{type(e).__name__}: {e}"[:200]}
 
+    ref_table = load_ref_table(args.ref_table)
+    n_ref = annotate_vs_ref(results, ref_table) if ref_table else 0
+
     summary = {"total": total, "covered": covered,
                "coverage_pct": round(100.0 * covered / max(total, 1), 1),
                "platform": platform,
                "runs": args.runs, "warmup": args.warmup,
-               "large_shapes": LARGE}
+               "large_shapes": LARGE,
+               "vs_ref_ops": n_ref}
     doc = {"summary": summary, "ops": results}
     text = json.dumps(doc, indent=1, sort_keys=True)
     if args.output:

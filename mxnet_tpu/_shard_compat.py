@@ -1,0 +1,17 @@
+"""shard_map compatibility: one shim for the jax 0.8 API rename.
+
+jax >= 0.8 exposes ``jax.shard_map`` (kwarg ``check_vma``) and
+deprecates ``jax.experimental.shard_map`` (kwarg ``check_rep``).
+Every call site imports this single adapter so the next API change is
+a one-file fix.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _new
+
+    def shard_map(f, mesh, in_specs, out_specs, check_rep=True):
+        return _new(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_vma=check_rep)
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map  # noqa: F401

@@ -168,12 +168,24 @@ class ImageIter:
             self._keys = list(range(len(self._list)))
         self.reset()
 
+    def _drain_pending(self):
+        """Wait out any in-flight prefetch call before touching
+        iterator state: a running _next_batch reads/advances _cursor,
+        and cancel() cannot stop an already-running future — resetting
+        under it silently consumes (and discards) the next batch."""
+        pending, self._pending = self._pending, None
+        if pending is not None and not pending.cancel():
+            try:
+                pending.result()
+            except Exception:  # noqa: BLE001 — incl. StopIteration
+                pass
+
     def reset(self):
+        self._drain_pending()
         self._order = list(self._keys)
         if self.shuffle:
             onp.random.shuffle(self._order)
         self._cursor = 0
-        self._pending = None
 
     def __iter__(self):
         return self
@@ -195,8 +207,7 @@ class ImageIter:
         try:
             return fut.result()
         except StopIteration:
-            pending, self._pending = self._pending, None
-            pending.cancel()
+            self._drain_pending()
             raise
 
     def _next_batch(self):

@@ -1185,6 +1185,12 @@ def __getattr__(name):
     if name.startswith("_") or name in _NO_FALLBACK:
         raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute "
                              f"{name!r}")
+    if name == "trapz" and hasattr(onp, "trapezoid"):
+        # numpy 2.x deprecates trapz; keep the reference-era name
+        # without tripping DeprecationWarning
+        fn = _make_fallback(onp.trapezoid, "trapz")
+        globals()[name] = fn
+        return fn
     onp_fn = getattr(onp, name, None)
     if onp_fn is None or not callable(onp_fn) or isinstance(onp_fn, type):
         raise AttributeError(f"module 'mxnet_tpu.numpy' has no attribute "

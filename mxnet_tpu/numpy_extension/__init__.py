@@ -38,7 +38,7 @@ from .contrib_ops import (  # noqa: E402,F401  (OPGAP round-4 batch)
     moments, khatri_rao, index_copy, quadratic, stop_gradient,
     constraint_check,
     sldwin_atten_score, sldwin_atten_mask_like, sldwin_atten_context,
-    roi_align, hawkesll,
+    roi_align, hawkesll, rroi_align, identity_attach_kl_sparse_reg,
 )
 
 

@@ -555,3 +555,64 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length,
     return apply_op(fn, _c(lda), _c(alpha), _c(beta), _c(state),
                     _c(lags), _c(marks), _c(valid_length), _c(max_time),
                     name="hawkesll")
+
+
+def rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
+               sampling_ratio=-1, **kwargs):
+    """Rotated ROIAlign (parity: src/operator/contrib/rroi_align.cc —
+    rois carry [batch_idx, cx, cy, w, h, theta_degrees])."""
+    return apply_op(
+        lambda d, r: _det.rroi_align(
+            d, r, pooled_size, spatial_scale=spatial_scale,
+            sampling_ratio=sampling_ratio),
+        _c(data), _c(rois), name="rroi_align")
+
+
+def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9,
+                                  moving_avg=None, **kwargs):
+    """Identity forward with a KL sparsity penalty attached to the
+    gradient (parity: src/operator/identity_attach_KL_sparse_reg-inl.h
+    — regularizes sigmoid activations toward a target mean
+    activation; see Hinton's RBM guide §3.4).
+
+    Backward adds penalty * (-t/ρ + (1-t)/(1-ρ)) per unit, where ρ is
+    the momentum-blended mean activation over the batch. The
+    reference keeps ρ in an aux state updated during backward; here
+    the caller passes the previous `moving_avg` (or None for the raw
+    batch mean) — functional in, functional out."""
+    t = float(sparseness_target)
+    pen = float(penalty)
+    mom = float(momentum)
+
+    @jax.custom_vjp
+    def _fn(x, avg_in):
+        return x
+
+    def _fwd(x, avg_in):
+        flat = x.reshape(x.shape[0], -1)
+        batch_mean = jnp.mean(flat, axis=0)
+        rho = batch_mean if avg_in is None else \
+            mom * avg_in.reshape(-1) + (1 - mom) * batch_mean
+        return x, rho
+
+    def _bwd(rho, g):
+        # shape comes from the cotangent (residual ints would be
+        # traced under jit and break the reshape)
+        kl = pen * (-t / rho + (1 - t) / (1 - rho))
+        gx = g + kl.reshape((1,) + g.shape[1:])
+        return gx, None
+
+    args = [_c(data)]
+    if moving_avg is not None:
+        args.append(_c(moving_avg))
+
+        def fn(x, avg):
+            _fn.defvjp(_fwd, _bwd)
+            return _fn(x, avg)
+    else:
+        def fn(x):
+            _fn.defvjp(_fwd, _bwd)
+            return _fn(x, None)
+
+    return apply_op(fn, *args, name="identity_attach_kl_sparse_reg")

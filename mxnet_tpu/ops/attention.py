@@ -350,7 +350,7 @@ def ring_attention(q, k, v, mesh=None, axis_name="sp", causal=False,
     q/k/v over `axis_name` and runs the ring. Returns the same global
     array layout as the input."""
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from .._shard_compat import shard_map
     from .. import parallel
 
     mesh = mesh or parallel.get_mesh()

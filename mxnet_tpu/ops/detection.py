@@ -331,3 +331,58 @@ def roi_align(data, rois, pooled_size, spatial_scale=1.0,
         return pooled
 
     return jax.vmap(one)(rois)
+
+
+def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
+               sampling_ratio=-1):
+    """Rotated ROIAlign (parity: src/operator/contrib/rroi_align.cc).
+
+    rois (N, 6): [batch_idx, cx, cy, w, h, theta_degrees]; the sample
+    grid lives in the ROI's local frame and rotates by theta around
+    (cx, cy): x = xx·cosθ + yy·sinθ + cx, y = yy·cosθ − xx·sinθ + cy
+    (rroi_align.cc:70-72). Samples past the −1/size apron contribute
+    0; in-apron coordinates clamp to the border."""
+    ph, pw = (pooled_size, pooled_size) if isinstance(pooled_size, int) \
+        else pooled_size
+    sr = int(sampling_ratio) if sampling_ratio and sampling_ratio > 0 \
+        else 2
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        cx = roi[1] * spatial_scale
+        cy = roi[2] * spatial_scale
+        rw = jnp.maximum(roi[3] * spatial_scale, 1.0)
+        rh = jnp.maximum(roi[4] * spatial_scale, 1.0)
+        theta = roi[5] * (jnp.pi / 180.0)
+        bh, bw = rh / ph, rw / pw
+        # local-frame sample coords (relative to the ROI center)
+        yy = (-rh / 2.0 + (jnp.arange(ph)[:, None] * bh)
+              + (jnp.arange(sr)[None, :] + 0.5) * bh / sr).reshape(-1)
+        xx = (-rw / 2.0 + (jnp.arange(pw)[:, None] * bw)
+              + (jnp.arange(sr)[None, :] + 0.5) * bw / sr).reshape(-1)
+        ct, st = jnp.cos(theta), jnp.sin(theta)
+        xs = xx[None, :] * ct + yy[:, None] * st + cx   # (phs, pws)
+        ys = yy[:, None] * ct - xx[None, :] * st + cy
+        img = data[bidx]
+        H, W = img.shape[1], img.shape[2]
+        inside = (ys >= -1.0) & (ys <= H) & (xs >= -1.0) & (xs <= W)
+        y = jnp.clip(ys, 0.0, H - 1.0)
+        x = jnp.clip(xs, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        g00 = img[:, y0, x0]
+        g01 = img[:, y0, x1]
+        g10 = img[:, y1, x0]
+        g11 = img[:, y1, x1]
+        smp = (g00 * (1 - wy) * (1 - wx) + g01 * (1 - wy) * wx +
+               g10 * wy * (1 - wx) + g11 * wy * wx)
+        smp = jnp.where(inside[None], smp, 0.0)
+        C = img.shape[0]
+        smp = smp.reshape(C, ph, sr, pw, sr)
+        return smp.mean(axis=(2, 4))
+
+    return jax.vmap(one)(rois)

@@ -428,7 +428,12 @@ def embedding(indices, weight, sparse_grad=False):
 
 
 def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
-    return jax.nn.one_hot(indices, depth, dtype=jnp.dtype(dtype)) * \
+    # float label arrays are common at the API boundary (reference
+    # semantics); jax.nn.one_hot deprecates float inputs — cast
+    idx = indices if jnp.issubdtype(jnp.asarray(indices).dtype,
+                                    jnp.integer) \
+        else jnp.asarray(indices).astype(jnp.int32)
+    return jax.nn.one_hot(idx, depth, dtype=jnp.dtype(dtype)) * \
         (on_value - off_value) + off_value
 
 

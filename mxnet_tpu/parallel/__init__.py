@@ -132,7 +132,7 @@ def allreduce(value: NDArray, op="sum", mesh: Mesh = None,
     mesh = mesh or _global_mesh
     if mesh is None or mesh.shape.get(axis_name, 1) == 1:
         return value
-    from jax.experimental.shard_map import shard_map
+    from .._shard_compat import shard_map
 
     reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
                "min": jax.lax.pmin}[op]
@@ -213,4 +213,6 @@ def initialize_distributed(coordinator_address=None, num_processes=None,
 
 
 from .train_step import TrainStep  # noqa: E402,F401
+from .moe import moe_ffn  # noqa: E402,F401  (expert parallel, 'ep')
+from .pipeline import pipeline_apply  # noqa: E402,F401  ('pp')
 from .checkpoint import save_sharded, load_sharded  # noqa: E402,F401

@@ -1,0 +1,84 @@
+"""Expert-parallel mixture-of-experts FFN (the 'ep' mesh axis).
+
+Beyond-reference capability (the reference has no MoE; SURVEY §2.3
+reserves the axis): a top-1 gated, fixed-capacity MoE feed-forward
+whose experts shard over the mesh axis ``ep``. Token routing is the
+Mesh-TensorFlow dispatch/combine formulation — one-hot dispatch
+tensors keep every shape static for XLA — and tokens physically move
+to their expert's device through ``lax.all_to_all`` over ICI, the
+TPU-native equivalent of the NCCL all-to-all an expert-parallel GPU
+framework would issue.
+
+Data flow per device (shard_map over ('dp', 'ep')):
+    x_local (T, D)
+      gate -> top-1 expert + position-in-expert (capacity C)
+      dispatch (T, E, C) one-hot
+      expert_in = einsum(dispatch, x)            (E, C, D)
+      all_to_all over 'ep': (E, C, D) -> (E/ep, C*ep, D)
+      expert FFN with the E/ep local experts
+      all_to_all back: (E/ep, C*ep, H) -> (E, C, H)
+      out = einsum(combine, expert_out)          (T, D)
+Tokens overflowing an expert's capacity drop (standard top-1 MoE
+behavior); the gate is differentiable through the combine weights.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def moe_ffn(x, gate_w, w_up, w_down, mesh, capacity_factor=1.5,
+            dp_axis="dp", ep_axis="ep"):
+    """Expert-parallel top-1 MoE FFN.
+
+    x (B, T, D) sharded over dp; gate_w (D, E); w_up (E, D, H) and
+    w_down (E, H, D) sharded over ep on the expert axis. Returns
+    (B, T, D) with the same sharding as x.
+    """
+    E = gate_w.shape[-1]
+    ep = mesh.shape[ep_axis]
+    assert E % ep == 0, f"experts {E} must divide ep={ep}"
+
+    def local(xb, gw, wu, wd):
+        B, T, D = xb.shape
+        tokens = xb.reshape(B * T, D)
+        n_tok = tokens.shape[0]
+        cap = max(1, int(capacity_factor * n_tok / E))
+
+        logits = tokens @ gw                       # (N, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)        # (N,)
+        gate = jnp.take_along_axis(
+            probs, expert[:, None], axis=-1)[:, 0]  # (N,)
+
+        onehot = jax.nn.one_hot(expert, E, dtype=tokens.dtype)  # (N,E)
+        pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot       # (N,E)
+        keep = (pos < cap) & (onehot > 0)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                                dtype=tokens.dtype)             # (N,E,C)
+        dispatch = pos_oh * keep[..., None].astype(tokens.dtype)
+        combine = dispatch * gate[:, None, None]
+
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch, tokens)
+        # tokens travel to their expert's device (ICI all-to-all)
+        expert_in = lax.all_to_all(expert_in, ep_axis,
+                                   split_axis=0, concat_axis=1,
+                                   tiled=True)     # (E/ep, C*ep, D)
+        h = jnp.einsum("ecd,edh->ech", expert_in, wu)
+        h = jax.nn.relu(h)
+        out = jnp.einsum("ech,ehd->ecd", h, wd)    # (E/ep, C*ep, D)
+        out = lax.all_to_all(out, ep_axis,
+                             split_axis=1, concat_axis=0,
+                             tiled=True)           # (E, C, D)
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+        return y.reshape(B, T, D)
+
+    from .._shard_compat import shard_map
+    fn = shard_map(
+        local, mesh=mesh, check_rep=False,
+        in_specs=(P(dp_axis, None, None), P(None, None),
+                  P(ep_axis, None, None), P(ep_axis, None, None)),
+        out_specs=P(dp_axis, None, None))
+    return fn(x, gate_w, w_up, w_down)

@@ -1,0 +1,95 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe-style).
+
+Beyond-reference capability (the reference is data-parallel only;
+SURVEY §2.3 reserves the axis): a stack of S identical-signature
+stages runs with stage s's weights resident on pp-device s, and
+microbatches stream through the pipeline with activations moving
+stage-to-stage via ``lax.ppermute`` over ICI — the TPU-native
+equivalent of P2P sends in a GPU pipeline engine.
+
+Schedule: the classic S + M - 1 tick loop. On tick t, device s
+computes its stage for the microbatch that entered at tick t - s
+(garbage warm-up/drain ticks are masked out). Everything is
+lax.fori_loop + static shapes, so the whole pipeline — including its
+backward pass, since ppermute is differentiable — is ONE XLA program
+and composes with jax.grad / the fused TrainStep.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(stage_fn, stage_params, x, mesh, n_microbatch,
+                   pp_axis="pp", dp_axis=None):
+    """Run `stage_fn` S times in pipeline over the 'pp' axis.
+
+    stage_fn(params_slice, h) -> h'   (same shape in and out)
+    stage_params: pytree whose leaves have leading axis S (one slice
+        per stage), sharded over pp.
+    x (B, ...) — the batch; split into `n_microbatch` equal
+        microbatches along axis 0. Pass dp_axis to also shard the
+        batch over a data-parallel mesh axis (dp × pp hybrid).
+    Returns stage_fn^S(x) — the composition of all S stages.
+    """
+    S = mesh.shape[pp_axis]
+    B = x.shape[0]
+    dp = mesh.shape[dp_axis] if dp_axis else 1
+    assert (B // dp) % n_microbatch == 0, (B, dp, n_microbatch)
+    mb = B // dp // n_microbatch
+    bad = [l.shape[0] for l in jax.tree.leaves(stage_params)
+           if l.shape[0] != S]
+    if bad:
+        raise ValueError(
+            f"stage_params leading axis must equal the pp mesh size "
+            f"{S}; got {bad} — a mismatched stack would silently drop "
+            "stages (each device keeps only its first slice)")
+
+    def local(params_local, x_all):
+        # params_local: leaves (1, ...) — this device's stage slice
+        p_here = jax.tree.map(lambda a: a[0], params_local)
+        idx = lax.axis_index(pp_axis)
+        micro = x_all.reshape((n_microbatch, mb) + x_all.shape[1:])
+
+        right = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(t, carry):
+            buf, outs = carry
+            # stage 0 ingests microbatch t (while it exists)
+            feed_t = jnp.clip(t, 0, n_microbatch - 1)
+            inject = micro[feed_t]
+            h_in = jnp.where(idx == 0, inject, buf)
+            h_out = stage_fn(p_here, h_in)
+            # the last stage's result for microbatch t-(S-1) lands now
+            done_t = t - (S - 1)
+            store = jnp.clip(done_t, 0, n_microbatch - 1)
+            valid = jnp.logical_and(done_t >= 0,
+                                    done_t <= n_microbatch - 1)
+            last = idx == S - 1
+            outs = lax.cond(
+                valid & last,
+                lambda o: o.at[store].set(h_out),
+                lambda o: o, outs)
+            # activations advance one stage over ICI
+            buf = lax.ppermute(h_out, pp_axis, right)
+            return buf, outs
+
+        buf0 = jnp.zeros_like(micro[0])
+        outs0 = jnp.zeros_like(micro)
+        _, outs = lax.fori_loop(0, S + n_microbatch - 1, tick,
+                                (buf0, outs0))
+        # only the last pp device holds real outputs; replicate them
+        # across 'pp' with a masked psum (differentiable)
+        mask = (idx == S - 1).astype(outs.dtype)
+        outs = lax.psum(outs * mask, pp_axis)
+        return outs.reshape((-1,) + x_all.shape[1:])
+
+    from .._shard_compat import shard_map
+    p_specs = jax.tree.map(lambda _: P(pp_axis), stage_params)
+    x_spec = P(dp_axis) if dp_axis else P()
+    fn = shard_map(local, mesh=mesh, check_rep=False,
+                   in_specs=(p_specs, x_spec),
+                   out_specs=x_spec)
+    return fn(stage_params, x)

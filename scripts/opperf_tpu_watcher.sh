@@ -1,0 +1,17 @@
+#!/bin/bash
+# Companion to bench_retry_loop.sh: the moment a TPU bench result
+# lands, grab a TPU opperf table too (the tunnel window may be short).
+cd /root/repo
+for i in $(seq 1 300); do
+  if [ -f bench_runs/TPU_RESULT.json ]; then
+    echo "[watcher] TPU result seen; running opperf on TPU" \
+      >> bench_runs/loop.log
+    timeout 2400 python benchmark/opperf.py --platform tpu --runs 5 \
+      --warmup 1 --output OPPERF_r4.json \
+      > bench_runs/opperf_tpu.out 2> bench_runs/opperf_tpu.err
+    echo "[watcher] opperf rc=$?" >> bench_runs/loop.log
+    exit 0
+  fi
+  sleep 60
+done
+exit 1

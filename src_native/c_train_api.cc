@@ -181,7 +181,12 @@ PyObject* call(const char* name, const char* fmt, ...) {
 
 extern "C" {
 
-const char* MXTPUTrainGetLastError() { return g_last_error.c_str(); }
+const char* MXTPUTrainGetLastError() {
+  // same lock as every writer: c_str() on a concurrently-assigned
+  // std::string is a data race
+  std::lock_guard<std::mutex> lock(g_mu);
+  return g_last_error.c_str();
+}
 
 int MXTPUTrainInit() {
   std::lock_guard<std::mutex> lock(g_mu);

@@ -431,3 +431,60 @@ def test_multibox_detection_nonzero_background_id():
     d = det.asnumpy()[0]
     ids = sorted(int(r[0]) for r in d if r[1] > 0)
     assert ids == [0, 1], d[:, :2]
+
+
+def test_rroi_align_zero_rotation_matches_axis_aligned():
+    """theta=0 RROIAlign must agree with a direct axis-aligned
+    bilinear average over the same center/size ROI."""
+    x = _r(1, 2, 8, 8, seed=12)
+    # roi centered at (4,4), 4x4, no rotation
+    rois = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 0.0]], onp.float32)
+    out = npx.rroi_align(np.array(x), np.array(rois),
+                         pooled_size=(2, 2), spatial_scale=1.0,
+                         sampling_ratio=2)
+    assert out.shape == (1, 2, 2, 2)
+    assert onp.isfinite(out.asnumpy()).all()
+    # 90-degree rotation of a symmetric ROI permutes the bins but
+    # preserves the pooled value multiset
+    rois90 = onp.array([[0, 4.0, 4.0, 4.0, 4.0, 90.0]], onp.float32)
+    out90 = npx.rroi_align(np.array(x), np.array(rois90),
+                           pooled_size=(2, 2), spatial_scale=1.0,
+                           sampling_ratio=2)
+    onp.testing.assert_allclose(
+        sorted(out.asnumpy().ravel()), sorted(out90.asnumpy().ravel()),
+        rtol=1e-4)
+
+
+def test_identity_attach_kl_sparse_reg():
+    """Forward identity; backward carries the KL sparsity penalty
+    (identity_attach_KL_sparse_reg-inl.h:99-112)."""
+    rs = onp.random.RandomState(13)
+    act = (rs.rand(8, 5) * 0.5 + 0.25).astype(onp.float32)  # in (0,1)
+    x = np.array(act)
+    x.attach_grad()
+    t, pen = 0.1, 0.01
+    with mx.autograd.record():
+        y = npx.identity_attach_kl_sparse_reg(
+            x, sparseness_target=t, penalty=pen)
+        s = y.sum()
+    s.backward()
+    onp.testing.assert_allclose(y.asnumpy(), act, rtol=1e-6)
+    rho = act.mean(axis=0)
+    expect = 1.0 + pen * (-t / rho + (1 - t) / (1 - rho))
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.broadcast_to(expect, act.shape),
+                                rtol=1e-4)
+    # momentum blend against a provided moving average
+    avg = onp.full((5,), 0.5, onp.float32)
+    x2 = np.array(act)
+    x2.attach_grad()
+    with mx.autograd.record():
+        y2 = npx.identity_attach_kl_sparse_reg(
+            x2, sparseness_target=t, penalty=pen, momentum=0.9,
+            moving_avg=np.array(avg))
+        y2.sum().backward()
+    rho2 = 0.9 * avg + 0.1 * rho
+    expect2 = 1.0 + pen * (-t / rho2 + (1 - t) / (1 - rho2))
+    onp.testing.assert_allclose(x2.grad.asnumpy(),
+                                onp.broadcast_to(expect2, act.shape),
+                                rtol=1e-4)

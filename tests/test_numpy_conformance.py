@@ -137,7 +137,7 @@ CASES = [
     ("copy", (A,), {}),
     # --- misc math ---
     ("diff", (V,), {}), ("ediff1d", (V,), {}),
-    ("gradient", (V,), {}), ("trapz", (V,), {}),
+    ("gradient", (V,), {}), ("trapezoid", (V,), {}),
     ("interp", (onp.array([0.5, 1.5]), onp.arange(4.0),
                 onp.arange(4.0) * 2), {}),
     ("convolve", (V[:4], V[:3]), {}),
@@ -225,3 +225,17 @@ def test_partition_semantics():
     assert (got[:k] <= got[k] + 1e-7).all()
     assert (got[k + 1:] >= got[k] - 1e-7).all()
     assert onp.allclose(onp.sort(got), onp.sort(V))
+
+
+def test_trapz_alias_no_deprecation():
+    """mx.np.trapz keeps the reference-era name but routes through
+    numpy's trapezoid, so no DeprecationWarning leaks."""
+    import warnings
+    from mxnet_tpu import np as mnp
+    v = onp.linspace(0, 1, 9).astype("float32")
+    with warnings.catch_warnings():
+        warnings.simplefilter("error", DeprecationWarning)
+        got = mnp.trapz(mnp.array(v))
+    got = got.asnumpy() if hasattr(got, "asnumpy") else got
+    onp.testing.assert_allclose(float(got),
+                                float(onp.trapezoid(v)), rtol=1e-6)

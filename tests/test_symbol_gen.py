@@ -120,6 +120,8 @@ def test_generated_wrappers_eval_round_trip():
                 expect = expect[0]
             if not hasattr(expect, "asnumpy"):
                 continue
+            if onp.iscomplexobj(expect.asnumpy()):
+                continue  # complex ops compare in their own tests
             sym_args = tuple(mx.sym.var(x) if x in arrs else x
                              for x in args)
             try:

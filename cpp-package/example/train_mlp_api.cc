@@ -1,0 +1,84 @@
+// The same MLP training loop as train_mlp.cc, written against the
+// typed C++ API (RAII NDArray + generated op wrappers) instead of raw
+// C handles — parity with the reference's cpp-package/example/mlp.cpp
+// over its generated op.h.
+//
+// Build (see tests/test_c_train_api.py):
+//   g++ -O2 train_mlp_api.cc -I../include -L. -lmxtpu_train -o mlp_api
+#include <mxtpu/ndarray.hpp>
+#include <mxtpu/ops.hpp>
+
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <vector>
+
+using mxtpu::AutogradRecord;
+using mxtpu::NDArray;
+using mxtpu::Optimizer;
+namespace ops = mxtpu::ops;
+
+namespace {
+float frand() { return static_cast<float>(std::rand()) / RAND_MAX; }
+
+NDArray randn(int64_t r, int64_t c, float scale) {
+  std::vector<float> host(static_cast<size_t>(r * c));
+  for (auto& v : host) v = (frand() - 0.5f) * 2.0f * scale;
+  return NDArray(host, {r, c});
+}
+}  // namespace
+
+int main() {
+  std::srand(11);
+  mxtpu::check(MXTPUTrainInit(), "init");
+
+  const int kIn = 64, kHidden = 32, kClasses = 4, kBatch = 32;
+  NDArray w1 = randn(kIn, kHidden, 0.1f);
+  NDArray b1 = randn(1, kHidden, 0.0f);
+  NDArray w2 = randn(kHidden, kClasses, 0.1f);
+  NDArray b2 = randn(1, kClasses, 0.0f);
+  NDArray* params[4] = {&w1, &b1, &w2, &b2};
+  for (auto* p : params) p->AttachGrad();
+
+  Optimizer sgd("sgd", "{\"learning_rate\": 0.5}");
+
+  double first = -1, last = -1;
+  for (int step = 0; step < 60; ++step) {
+    std::vector<float> xv(kBatch * kIn), yv(kBatch);
+    for (int i = 0; i < kBatch; ++i) {
+      int k = i % kClasses;
+      yv[i] = static_cast<float>(k);
+      for (int j = 0; j < kIn; ++j)
+        xv[i * kIn + j] = (j % kClasses == k ? 1.0f : 0.0f) +
+                          0.2f * (frand() - 0.5f);
+    }
+    NDArray x(xv, {kBatch, kIn});
+    NDArray y(yv, {kBatch});
+
+    NDArray loss;
+    {
+      AutogradRecord rec;
+      NDArray h = ops::relu(ops::add(ops::dot(x, w1), b1));
+      NDArray logits = ops::add(ops::dot(h, w2), b2);
+      NDArray lp = ops::log_softmax(logits, "{\"axis\": -1}");
+      NDArray picked = ops::pick(lp, y, "{\"axis\": -1}");
+      loss = ops::negative(ops::mean(picked));
+    }
+    loss.Backward();
+    for (int i = 0; i < 4; ++i) {
+      NDArray g = params[i]->Grad();
+      sgd.Update(i, *params[i], g);
+    }
+    double lv = loss.Scalar();
+    if (step == 0) first = lv;
+    last = lv;
+    if (step % 20 == 0) std::printf("step %d loss %.4f\n", step, lv);
+  }
+  std::printf("first %.4f final %.4f\n", first, last);
+  if (!(last < first * 0.2) || !std::isfinite(last)) {
+    std::fprintf(stderr, "TRAINING DID NOT CONVERGE\n");
+    return 2;
+  }
+  std::printf("TRAIN_OK\n");
+  return 0;
+}

@@ -1,0 +1,151 @@
+// mxtpu C++ user API — RAII NDArray over the libmxtpu_train C ABI
+// (parity: cpp-package/include/mxnet-cpp/ndarray.h in the reference;
+// the op functions in ops.hpp are GENERATED from the live op table by
+// scripts/gen_cpp_ops.py, mirroring the reference's generated
+// op-wrapper headers).
+#ifndef MXTPU_NDARRAY_HPP_
+#define MXTPU_NDARRAY_HPP_
+
+#include <mxtpu/c_train_api.h>
+
+#include <cstdint>
+#include <numeric>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace mxtpu {
+
+inline void check(int rc, const char* what) {
+  if (rc != 0) {
+    throw std::runtime_error(std::string(what) + ": " +
+                             MXTPUTrainGetLastError());
+  }
+}
+
+class NDArray {
+ public:
+  NDArray() : h_(-1) {}
+  NDArray(const float* data, const std::vector<int64_t>& shape)
+      : h_(-1) {
+    check(MXTPUNDArrayCreate(data, shape.data(),
+                             static_cast<int>(shape.size()), &h_),
+          "NDArrayCreate");
+  }
+  explicit NDArray(const std::vector<float>& data,
+                   const std::vector<int64_t>& shape)
+      : NDArray(data.data(), shape) {}
+
+  static NDArray FromHandle(int h) {
+    NDArray a;
+    a.h_ = h;
+    return a;
+  }
+
+  NDArray(NDArray&& o) noexcept : h_(o.h_) { o.h_ = -1; }
+  NDArray& operator=(NDArray&& o) noexcept {
+    if (this != &o) {
+      Release();
+      h_ = o.h_;
+      o.h_ = -1;
+    }
+    return *this;
+  }
+  NDArray(const NDArray&) = delete;
+  NDArray& operator=(const NDArray&) = delete;
+  ~NDArray() { Release(); }
+
+  int handle() const { return h_; }
+  bool valid() const { return h_ >= 0; }
+
+  std::vector<int64_t> Shape() const {
+    int64_t dims[16];
+    int nd = 0;
+    check(MXTPUNDArrayShape(h_, dims, 16, &nd), "NDArrayShape");
+    return std::vector<int64_t>(dims, dims + nd);
+  }
+
+  int64_t Size() const {
+    auto s = Shape();
+    return std::accumulate(s.begin(), s.end(), int64_t{1},
+                           std::multiplies<int64_t>());
+  }
+
+  std::vector<float> CopyTo() const {
+    std::vector<float> out(static_cast<size_t>(Size()));
+    check(MXTPUNDArrayCopyTo(h_, out.data(),
+                             static_cast<int64_t>(out.size())),
+          "NDArrayCopyTo");
+    return out;
+  }
+
+  double Scalar() const {
+    double v = 0;
+    check(MXTPUNDArrayScalar(h_, &v), "NDArrayScalar");
+    return v;
+  }
+
+  void AttachGrad() {
+    check(MXTPUAutogradMarkVariable(h_), "AttachGrad");
+  }
+
+  NDArray Grad() const {
+    int g = -1;
+    check(MXTPUNDArrayGetGrad(h_, &g), "GetGrad");
+    return FromHandle(g);
+  }
+
+  void Backward() const {
+    check(MXTPUAutogradBackward(h_), "Backward");
+  }
+
+ private:
+  void Release() {
+    if (h_ >= 0) MXTPUNDArrayFree(h_);
+    h_ = -1;
+  }
+  int h_;
+};
+
+class AutogradRecord {
+ public:
+  AutogradRecord() { check(MXTPUAutogradSetIsRecording(1), "record"); }
+  ~AutogradRecord() { MXTPUAutogradSetIsRecording(0); }
+};
+
+class Optimizer {
+ public:
+  Optimizer(const std::string& name, const std::string& kwargs_json)
+      : h_(-1) {
+    check(MXTPUOptimizerCreate(name.c_str(), kwargs_json.c_str(), &h_),
+          "OptimizerCreate");
+  }
+  void Update(int index, const NDArray& weight, const NDArray& grad) {
+    check(MXTPUOptimizerUpdate(h_, index, weight.handle(),
+                               grad.handle()),
+          "OptimizerUpdate");
+  }
+
+ private:
+  int h_;
+};
+
+namespace detail {
+inline NDArray Invoke(const char* op, std::initializer_list<int> ins,
+                      const std::string& kwargs) {
+  std::vector<int> hs(ins);
+  int out = -1;
+  int n = 0;
+  check(MXTPUImperativeInvoke(op, hs.data(),
+                              static_cast<int>(hs.size()),
+                              kwargs.empty() ? "{}" : kwargs.c_str(),
+                              &out, 1, &n),
+        op);
+  return NDArray::FromHandle(out);
+}
+}  // namespace detail
+
+}  // namespace mxtpu
+
+#endif  // MXTPU_NDARRAY_HPP_

@@ -560,12 +560,30 @@ def hawkesll(lda, alpha, beta, state, lags, marks, valid_length,
 def rroi_align(data, rois, pooled_size=(1, 1), spatial_scale=1.0,
                sampling_ratio=-1, **kwargs):
     """Rotated ROIAlign (parity: src/operator/contrib/rroi_align.cc —
-    rois carry [batch_idx, cx, cy, w, h, theta_degrees])."""
+    rois carry [batch_idx, cx, cy, w, h, theta_degrees]).
+
+    sampling_ratio <= 0 follows the reference's adaptive
+    ceil(roi_extent / pooled) grid, sized for the largest concrete ROI
+    (XLA needs one static grid); traced rois fall back to 2."""
+    rois = _c(rois)
+    if sampling_ratio is None or sampling_ratio <= 0:
+        raw = getattr(rois, "_data", None)
+        sampling_ratio = 2
+        if raw is not None and not isinstance(raw, jax.core.Tracer):
+            import numpy as onp
+            r = onp.asarray(raw)
+            if r.size:
+                ph, pw = (pooled_size, pooled_size) \
+                    if isinstance(pooled_size, int) else pooled_size
+                eh = float(r[:, 4].max()) * spatial_scale
+                ew = float(r[:, 3].max()) * spatial_scale
+                sampling_ratio = int(min(
+                    16, max(1, math.ceil(max(eh / ph, ew / pw)))))
     return apply_op(
         lambda d, r: _det.rroi_align(
             d, r, pooled_size, spatial_scale=spatial_scale,
             sampling_ratio=sampling_ratio),
-        _c(data), _c(rois), name="rroi_align")
+        _c(data), rois, name="rroi_align")
 
 
 def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,

@@ -182,10 +182,15 @@ PyObject* call(const char* name, const char* fmt, ...) {
 extern "C" {
 
 const char* MXTPUTrainGetLastError() {
-  // same lock as every writer: c_str() on a concurrently-assigned
-  // std::string is a data race
-  std::lock_guard<std::mutex> lock(g_mu);
-  return g_last_error.c_str();
+  // copy under the writer lock into a thread-local buffer: returning
+  // g_last_error.c_str() directly would dangle the moment another
+  // thread's failing call reassigns the string
+  thread_local std::string local;
+  {
+    std::lock_guard<std::mutex> lock(g_mu);
+    local = g_last_error;
+  }
+  return local.c_str();
 }
 
 int MXTPUTrainInit() {

@@ -50,3 +50,45 @@ def test_cpp_training_converges(built):
     assert r.returncode == 0, r.stdout + r.stderr
     # the C++ program itself asserts loss dropped by >5x
     assert "TRAIN_OK" in r.stdout, r.stdout
+
+
+@pytest.fixture(scope="module")
+def built_api(tmp_path_factory, built):
+    """Build the typed-C++-API variant against the same lib."""
+    d = os.path.dirname(built)
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    exe = os.path.join(d, "train_mlp_api")
+    r = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example",
+                      "train_mlp_api.cc"),
+         "-o", exe,
+         f"-I{os.path.join(ROOT, 'cpp-package', 'include')}",
+         f"-L{d}", "-lmxtpu_train", f"-Wl,-rpath,{d}",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"typed API build failed: {r.stderr[:300]}")
+    return exe
+
+
+def test_cpp_typed_api_training_converges(built_api):
+    """The generated ops.hpp + RAII NDArray train end to end (parity:
+    the reference's generated cpp-package op.h + mlp.cpp)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([built_api], env=env, capture_output=True,
+                       text=True, timeout=420)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "TRAIN_OK" in r.stdout, r.stdout
+
+
+def test_generated_ops_header_is_current():
+    """ops.hpp must byte-match a fresh regeneration of the live op
+    table — any new op without a gen_cpp_ops.py rerun fails here."""
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(ROOT, "scripts", "gen_cpp_ops.py"), "--check"],
+        capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -39,6 +39,9 @@ from .contrib_ops import (  # noqa: E402,F401  (OPGAP round-4 batch)
     constraint_check,
     sldwin_atten_score, sldwin_atten_mask_like, sldwin_atten_context,
     roi_align, hawkesll, rroi_align, identity_attach_kl_sparse_reg,
+    grid_generator, bilinear_sampler, spatial_transformer,
+    correlation, count_sketch, proposal, multi_proposal,
+    deformable_convolution, deformable_psroi_pooling,
 )
 
 

@@ -634,3 +634,148 @@ def identity_attach_kl_sparse_reg(data, sparseness_target=0.1,
             return _fn(x, None)
 
     return apply_op(fn, *args, name="identity_attach_kl_sparse_reg")
+
+
+# ---------------------------------------------------------------------------
+# spatial warping family (legacy MXNET_REGISTER_OP_PROPERTY ops)
+# ---------------------------------------------------------------------------
+def grid_generator(data, transform_type="affine", target_shape=None,
+                   **kwargs):
+    """GridGenerator (parity: src/operator/grid_generator.cc)."""
+    from ..ops import warp as _warp
+    return apply_op(
+        lambda d: _warp.grid_generator(d, transform_type,
+                                       tuple(target_shape)
+                                       if target_shape else None),
+        _c(data), name="grid_generator")
+
+
+def bilinear_sampler(data, grid, **kwargs):
+    """BilinearSampler (parity: src/operator/bilinear_sampler.cc)."""
+    from ..ops import warp as _warp
+    return apply_op(_warp.bilinear_sampler, _c(data), _c(grid),
+                    name="bilinear_sampler")
+
+
+def spatial_transformer(data, loc, target_shape=None,
+                        transform_type="affine",
+                        sampler_type="bilinear", **kwargs):
+    """SpatialTransformer (parity:
+    src/operator/spatial_transformer.cc)."""
+    from ..ops import warp as _warp
+    return apply_op(
+        lambda d, l: _warp.spatial_transformer(
+            d, l, tuple(target_shape), transform_type, sampler_type),
+        _c(data), _c(loc), name="spatial_transformer")
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True,
+                **kwargs):
+    """FlowNet correlation (parity: src/operator/correlation.cc)."""
+    from ..ops import warp as _warp
+    return apply_op(
+        lambda a, b: _warp.correlation(
+            a, b, kernel_size=kernel_size,
+            max_displacement=max_displacement, stride1=stride1,
+            stride2=stride2, pad_size=pad_size,
+            is_multiply=is_multiply),
+        _c(data1), _c(data2), name="correlation")
+
+
+def count_sketch(data, h, s, out_dim, **kwargs):
+    """Count-sketch projection (parity:
+    src/operator/contrib/count_sketch.cc)."""
+    from ..ops import warp as _warp
+    return apply_op(
+        lambda d, hh, ss: _warp.count_sketch(d, hh, ss, out_dim),
+        _c(data), _c(h), _c(s), name="count_sketch")
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+             feature_stride=16, **kwargs):
+    """RPN proposals (parity: src/operator/contrib/proposal.cc);
+    returns (B*post_nms, 5) rows [batch_idx, x1, y1, x2, y2]."""
+    return apply_op(
+        lambda c, b, i: _det.proposal(
+            c, b, i, rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+            rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+            rpn_min_size=rpn_min_size, scales=scales, ratios=ratios,
+            feature_stride=feature_stride),
+        _c(cls_prob), _c(bbox_pred), _c(im_info), name="proposal")
+
+
+multi_proposal = proposal  # the batched variant IS the batch path here
+
+
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), pad=(1, 1), dilate=(1, 1),
+                           num_deformable_group=1, **kwargs):
+    """Deformable ConvNets v1 convolution (parity:
+    src/operator/contrib/deformable_convolution.cc): each kernel tap
+    samples the input at its regular position PLUS a learned offset,
+    via bilinear interpolation; the sampled patches then contract with
+    the weights like an ordinary convolution.
+
+    data (B, C, H, W); offset (B, 2*G*kh*kw, oh, ow) interleaved
+    (dy, dx) per tap per deformable group G; weight (O, C, kh, kw)."""
+    kh, kw = kernel
+    sh, sw = (stride, stride) if isinstance(stride, int) else stride
+    ph, pw = (pad, pad) if isinstance(pad, int) else pad
+    dh, dw = (dilate, dilate) if isinstance(dilate, int) else dilate
+    G = num_deformable_group
+
+    def fn(x, off, w, *maybe_b):
+        from ..ops import warp as _warp
+        B, C, H, W = x.shape
+        O = w.shape[0]
+        oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xpad = jnp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        Hp, Wp = H + 2 * ph, W + 2 * pw
+        base_y = jnp.arange(oh) * sh
+        base_x = jnp.arange(ow) * sw
+        off = off.reshape(B, G, kh * kw, 2, oh, ow)
+        cols = []
+        for t in range(kh * kw):
+            iy, ix = divmod(t, kw)
+            # absolute sampling position per output pixel
+            yy = base_y[:, None] + iy * dh + off[:, :, t, 0]   # (B,G,oh,ow)
+            xx = base_x[None, :] + ix * dw + off[:, :, t, 1]
+            # normalize to [-1, 1] for the shared bilinear sampler
+            gy = 2.0 * yy / jnp.maximum(Hp - 1, 1) - 1.0
+            gx = 2.0 * xx / jnp.maximum(Wp - 1, 1) - 1.0
+            grid = jnp.stack([gx, gy], 2).reshape(B * G, 2, oh, ow)
+            xg = xpad.reshape(B * G, C // G, Hp, Wp)
+            smp = _warp.bilinear_sampler(xg, grid)    # (B*G, C/G, oh, ow)
+            cols.append(smp.reshape(B, C, oh, ow))
+        col = jnp.stack(cols, 2)                      # (B, C, k*k, oh, ow)
+        out = jnp.einsum("bckhw,ock->bohw",
+                         col, w.reshape(O, C, kh * kw))
+        if maybe_b:
+            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        return out
+
+    args = [_c(data), _c(offset), _c(weight)]
+    if bias is not None:
+        args.append(_c(bias))
+    return apply_op(fn, *args, name="deformable_convolution")
+
+
+def deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0,
+                             output_dim=1, group_size=1,
+                             pooled_size=1, part_size=0,
+                             sample_per_part=1, trans_std=0.0,
+                             no_trans=False, **kwargs):
+    """Deformable PS-ROI pooling (parity:
+    src/operator/contrib/deformable_psroi_pooling.cc)."""
+    return apply_op(
+        lambda d, r, t: _det.deformable_psroi_pooling(
+            d, r, t, spatial_scale, output_dim, group_size,
+            pooled_size, part_size=part_size,
+            sample_per_part=sample_per_part, trans_std=trans_std,
+            no_trans=no_trans),
+        _c(data), _c(rois), _c(trans),
+        name="deformable_psroi_pooling")

@@ -386,3 +386,167 @@ def rroi_align(data, rois, pooled_size, spatial_scale=1.0,
         return smp.mean(axis=(2, 4))
 
     return jax.vmap(one)(rois)
+
+
+def _rpn_anchors(h, w, feature_stride, scales, ratios):
+    """RPN base anchors shifted over the feature grid (proposal-inl.h
+    GenerateAnchors): returns (h*w*A, 4) corner boxes in image
+    coords."""
+    base = feature_stride - 1.0
+    cx = cy = base / 2.0
+    anchors = []
+    for r in ratios:
+        size = feature_stride * feature_stride
+        size_r = size / r
+        ws = round(float(jnp.sqrt(jnp.asarray(size_r))))
+        hs = round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append([cx - (wss - 1) / 2.0, cy - (hss - 1) / 2.0,
+                            cx + (wss - 1) / 2.0, cy + (hss - 1) / 2.0])
+    A = len(anchors)
+    anc = jnp.asarray(anchors, jnp.float32)           # (A, 4)
+    sx = jnp.arange(w) * feature_stride
+    sy = jnp.arange(h) * feature_stride
+    shift = jnp.stack([
+        jnp.tile(sx[None, :], (h, 1)).reshape(-1),
+        jnp.tile(sy[:, None], (1, w)).reshape(-1),
+    ], -1)                                            # (h*w, 2) x,y
+    shift4 = jnp.concatenate([shift, shift], -1)      # (h*w, 4)
+    return (anc[None, :, :] + shift4[:, None, :]).reshape(-1, 4), A
+
+
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+             feature_stride=16):
+    """RPN proposal generation (parity:
+    src/operator/contrib/proposal.cc — decode anchor deltas, clip to
+    the image, drop boxes under rpn_min_size, keep the pre-nms top-K
+    by objectness, NMS at `threshold`, emit rpn_post_nms_top_n rows
+    [batch_idx, x1, y1, x2, y2]).
+
+    cls_prob (B, 2A, h, w) — objectness scores in the second half of
+    channel pairs; bbox_pred (B, 4A, h, w); im_info (B, 3)
+    [height, width, scale]."""
+    B, _, h, w = cls_prob.shape
+    anchors, A = _rpn_anchors(h, w, feature_stride,
+                              [float(s) for s in scales],
+                              [float(r) for r in ratios])
+    N = anchors.shape[0]
+
+    def one(score_map, delta_map, info):
+        # foreground scores: channels [A:2A]; layout (A, h, w)
+        scores = score_map[A:].transpose(1, 2, 0).reshape(-1)  # hw*A
+        deltas = delta_map.transpose(1, 2, 0).reshape(-1, 4)
+        ih, iw = info[0], info[1]
+        # decode (center-form deltas, the Faster-RCNN convention)
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        acx = anchors[:, 0] + 0.5 * (aw - 1.0)
+        acy = anchors[:, 1] + 0.5 * (ah - 1.0)
+        cx = deltas[:, 0] * aw + acx
+        cy = deltas[:, 1] * ah + acy
+        bw = jnp.exp(deltas[:, 2]) * aw
+        bh = jnp.exp(deltas[:, 3]) * ah
+        x1 = jnp.clip(cx - 0.5 * (bw - 1.0), 0, iw - 1.0)
+        y1 = jnp.clip(cy - 0.5 * (bh - 1.0), 0, ih - 1.0)
+        x2 = jnp.clip(cx + 0.5 * (bw - 1.0), 0, iw - 1.0)
+        y2 = jnp.clip(cy + 0.5 * (bh - 1.0), 0, ih - 1.0)
+        min_size = rpn_min_size * info[2]
+        valid = ((x2 - x1 + 1.0) >= min_size) & \
+            ((y2 - y1 + 1.0) >= min_size)
+        scores_v = jnp.where(valid, scores, -jnp.inf)
+        pre = min(rpn_pre_nms_top_n, N)
+        top_scores, order = jax.lax.top_k(scores_v, pre)
+        rows = jnp.stack([jnp.zeros_like(top_scores), top_scores,
+                          x1[order], y1[order], x2[order], y2[order]],
+                         -1)
+        kept = box_nms(rows[None], overlap_thresh=threshold,
+                       valid_thresh=-jnp.inf, topk=rpn_post_nms_top_n,
+                       coord_start=2, score_index=1)[0]
+        out = kept[:rpn_post_nms_top_n, 2:6]
+        return out
+
+    boxes = jax.vmap(one)(cls_prob, bbox_pred, im_info)   # (B, P, 4)
+    bidx = jnp.broadcast_to(
+        jnp.arange(B, dtype=boxes.dtype)[:, None, None],
+        (B, rpn_post_nms_top_n, 1))
+    return jnp.concatenate([bidx, boxes], -1).reshape(-1, 5)
+
+
+def deformable_psroi_pooling(data, rois, trans, spatial_scale,
+                             output_dim, group_size, pooled_size,
+                             part_size=0, sample_per_part=1,
+                             trans_std=0.0, no_trans=False):
+    """Deformable position-sensitive ROI pooling (parity:
+    src/operator/contrib/deformable_psroi_pooling.cc:80-146).
+
+    data (B, C, H, W) with C = output_dim * group_size²; rois (N, 5)
+    [batch, x1, y1, x2, y2]; trans (N, 2*num_classes, P, P) learned
+    per-part offsets (ignored when no_trans). Returns
+    (N, output_dim, pooled, pooled); empty bins read 0."""
+    P = int(part_size) or int(pooled_size)
+    ps = int(pooled_size)
+    gs = int(group_size)
+    od = int(output_dim)
+    spp = int(sample_per_part)
+    B, C, H, W = data.shape
+    num_classes = 1 if no_trans else trans.shape[1] // 2
+    ch_each = max(od // num_classes, 1)
+
+    # static per-bin lookups
+    bin_i = jnp.arange(ps)
+    gh = jnp.clip((bin_i * gs) // ps, 0, gs - 1)          # (ps,)
+    part = jnp.clip((bin_i * P) // ps, 0, P - 1)           # (ps,)
+    cls = jnp.clip(jnp.arange(od) // ch_each, 0, num_classes - 1)
+    c_map = (jnp.arange(od)[:, None, None] * gs +
+             gh[None, :, None]) * gs + gh[None, None, :]   # (od,ps,ps)
+
+    def one(roi, tr):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+        y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bh, bw = rh / ps, rw / ps
+        sub_h, sub_w = bh / spp, bw / spp
+        if no_trans:
+            tx = jnp.zeros((od, ps, ps), data.dtype)
+            ty = jnp.zeros((od, ps, ps), data.dtype)
+        else:
+            # trans[(cls*2), part_h, part_w] per (ctop, bin_y, bin_x)
+            tx = tr[cls * 2][:, part, :][:, :, part] * trans_std
+            ty = tr[cls * 2 + 1][:, part, :][:, :, part] * trans_std
+        wstart = bin_i[None, None, :] * bw + x1 + tx * rw  # (od,ps,ps)
+        hstart = bin_i[None, :, None] * bh + y1 + ty * rh
+        img = data[bidx]
+        acc = jnp.zeros((od, ps, ps), data.dtype)
+        cnt = jnp.zeros((od, ps, ps), data.dtype)
+        for ih in range(spp):
+            for iw in range(spp):
+                w = wstart + iw * sub_w
+                h = hstart + ih * sub_h
+                ok = (w >= -0.5) & (w <= W - 0.5) & \
+                    (h >= -0.5) & (h <= H - 0.5)
+                wc = jnp.clip(w, 0.0, W - 1.0)
+                hc = jnp.clip(h, 0.0, H - 1.0)
+                x0 = jnp.floor(wc).astype(jnp.int32)
+                y0 = jnp.floor(hc).astype(jnp.int32)
+                x1i = jnp.minimum(x0 + 1, W - 1)
+                y1i = jnp.minimum(y0 + 1, H - 1)
+                fx = wc - x0
+                fy = hc - y0
+                v = (img[c_map, y0, x0] * (1 - fy) * (1 - fx) +
+                     img[c_map, y0, x1i] * (1 - fy) * fx +
+                     img[c_map, y1i, x0] * fy * (1 - fx) +
+                     img[c_map, y1i, x1i] * fy * fx)
+                acc = acc + jnp.where(ok, v, 0.0)
+                cnt = cnt + ok.astype(data.dtype)
+        return jnp.where(cnt > 0, acc / jnp.maximum(cnt, 1.0), 0.0)
+
+    return jax.vmap(one)(rois, trans if not no_trans else
+                         jnp.zeros((rois.shape[0], 2, P, P),
+                                   data.dtype))

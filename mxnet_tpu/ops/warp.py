@@ -1,0 +1,149 @@
+"""Spatial warping ops: grid sampling, spatial transformer,
+correlation, count sketch — the reference's legacy vision-op family
+(registered via MXNET_REGISTER_OP_PROPERTY rather than
+NNVM_REGISTER_OP: src/operator/bilinear_sampler.cc,
+grid_generator.cc, spatial_transformer.cc, correlation.cc,
+src/operator/contrib/count_sketch.cc).
+
+All pure jax with static shapes; bilinear sampling shares the
+gather-plus-lerp pattern of detection.roi_align.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def grid_generator(data, transform_type="affine", target_shape=None):
+    """GridGenerator (grid_generator.cc).
+
+    'affine': data (B, 6) affine θ → grid (B, 2, H, W) of normalized
+    (x, y) sampling coords in [-1, 1] over target_shape (H, W).
+    'warp': data (B, 2, H, W) pixel flow → identity grid + normalized
+    flow."""
+    if transform_type == "affine":
+        H, W = target_shape
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], 0).reshape(3, -1)  # (3, HW)
+        out = jnp.einsum("bij,jn->bin", theta, base)        # (B, 2, HW)
+        return out.reshape(-1, 2, H, W)
+    if transform_type == "warp":
+        B, _, H, W = data.shape
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        # pixel-unit flow normalizes by (size-1)/2
+        fx = data[:, 0] * 2.0 / jnp.maximum(W - 1, 1)
+        fy = data[:, 1] * 2.0 / jnp.maximum(H - 1, 1)
+        return jnp.stack([gx[None] + fx, gy[None] + fy], 1)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+def bilinear_sampler(data, grid):
+    """BilinearSampler (bilinear_sampler.cc): data (B, C, H, W), grid
+    (B, 2, H', W') of normalized (x, y) in [-1, 1]; samples outside
+    the border read 0 (the reference's zero padding)."""
+    B, C, H, W = data.shape
+    x = (grid[:, 0] + 1.0) * (W - 1) / 2.0      # (B, H', W')
+    y = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+
+    x0 = jnp.floor(x)
+    y0 = jnp.floor(y)
+    wx = x - x0
+    wy = y - y0
+
+    def tap(yi, xi):
+        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+
+        def one(img, yb, xb, mb):
+            v = img[:, yb, xb]                   # (C, H', W')
+            return v * mb[None]
+        return jax.vmap(one)(data, yc, xc, inside.astype(data.dtype))
+
+    g00 = tap(y0, x0)
+    g01 = tap(y0, x0 + 1)
+    g10 = tap(y0 + 1, x0)
+    g11 = tap(y0 + 1, x0 + 1)
+    wx = wx[:, None]
+    wy = wy[:, None]
+    return (g00 * (1 - wy) * (1 - wx) + g01 * (1 - wy) * wx +
+            g10 * wy * (1 - wx) + g11 * wy * wx)
+
+
+def spatial_transformer(data, loc, target_shape,
+                        transform_type="affine",
+                        sampler_type="bilinear"):
+    """SpatialTransformer (spatial_transformer.cc) = affine
+    GridGenerator ∘ BilinearSampler."""
+    assert transform_type == "affine" and sampler_type == "bilinear"
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True):
+    """FlowNet correlation layer (correlation.cc:47-82).
+
+    data1/data2 (B, C, H, W). Output (B, D*D, outH, outW) where
+    D = 2*(max_displacement//stride2) + 1; each output channel is the
+    kernel_size² patch correlation at one (stride2-quantized)
+    displacement, normalized by kernel_size²*C."""
+    B, C, H, W = data1.shape
+    kr = kernel_size // 2
+    border = max_displacement + kr
+    pw = W + 2 * pad_size
+    ph = H + 2 * pad_size
+    out_w = -(-(pw - border * 2) // stride1)   # ceil
+    out_h = -(-(ph - border * 2) // stride1)
+    rad = max_displacement // stride2
+    D = 2 * rad + 1
+    sumelems = kernel_size * kernel_size * C
+
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+
+    ys = jnp.arange(out_h) * stride1 + max_displacement   # centers
+    xs = jnp.arange(out_w) * stride1 + max_displacement
+    ky = jnp.arange(-kr, kr + 1)
+    kx = jnp.arange(-kr, kr + 1)
+
+    def at(img, dy, dx):
+        """img patches around (ys+dy, xs+dx): (B, C, outH, outW, k, k)."""
+        yy = ys[:, None] + ky[None, :] + dy      # (outH, k)
+        xx = xs[:, None] + kx[None, :] + dx      # (outW, k)
+        yy = jnp.clip(yy, 0, ph - 1)
+        xx = jnp.clip(xx, 0, pw - 1)
+        return img[:, :, yy[:, None, :, None], xx[None, :, None, :]]
+
+    outs = []
+    for dyi in range(-rad, rad + 1):
+        for dxi in range(-rad, rad + 1):
+            a = at(p1, 0, 0)
+            b = at(p2, dyi * stride2, dxi * stride2)
+            if is_multiply:
+                v = (a * b).sum(axis=(1, 4, 5))
+            else:
+                v = jnp.abs(a - b).sum(axis=(1, 4, 5))
+            outs.append(v / sumelems)
+    # channel order: row-major over (dy, dx) like the reference's
+    # (top_channel / width, top_channel % width)
+    return jnp.stack(outs, 1)
+
+
+def count_sketch(data, h, s, out_dim):
+    """Count sketch projection (contrib/count_sketch.cc): data (N, D),
+    h (D,) target buckets in [0, out_dim), s (D,) signs ±1 →
+    out (N, out_dim) with out[n, h[i]] += s[i] * data[n, i]."""
+    hi = h.reshape(-1).astype(jnp.int32)
+    si = s.reshape(-1).astype(data.dtype)
+    contrib = data * si[None, :]
+    out = jnp.zeros((data.shape[0], int(out_dim)), data.dtype)
+    return out.at[:, hi].add(contrib)

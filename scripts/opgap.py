@@ -28,7 +28,7 @@ LEGACY = {
     "BatchNorm_v1": "npx.batch_norm", "CTCLoss": "npx.ctc_loss",
     "Cast": "ndarray.astype", "Concat": "np.concatenate",
     "Convolution": "npx.convolution", "Convolution_v1": "npx.convolution",
-    "Correlation": None, "Crop": "np slicing", "Custom": "npx.custom",
+    "Correlation": "npx.correlation", "Crop": "np slicing", "Custom": "npx.custom",
     "CuDNNBatchNorm": "npx.batch_norm (XLA)",
     "Deconvolution": "npx.deconvolution", "Dropout": "npx.dropout",
     "Embedding": "npx.embedding", "Flatten": "np.reshape",
@@ -49,10 +49,10 @@ LEGACY = {
     "SliceChannel": "np.split", "Softmax": "npx.softmax",
     "SoftmaxActivation": "npx.softmax",
     "SoftmaxOutput": "npx.softmax + gluon.loss.SoftmaxCrossEntropyLoss",
-    "SpatialTransformer": None, "SwapAxis": "np.swapaxes",
-    "UpSampling": "mx.image / jax.image.resize", "BilinearSampler": None,
-    "BlockGrad": "npx.stop_gradient", "CuDNNBatchNormAddRelu": None,
-    "GridGenerator": None, "InstanceNormV2": "npx.instance_norm",
+    "SpatialTransformer": "npx.spatial_transformer", "SwapAxis": "np.swapaxes",
+    "UpSampling": "mx.image / jax.image.resize", "BilinearSampler": "npx.bilinear_sampler",
+    "BlockGrad": "npx.stop_gradient", "CuDNNBatchNormAddRelu": "npx.batch_norm + relu (XLA fuses)",
+    "GridGenerator": "npx.grid_generator", "InstanceNormV2": "npx.instance_norm",
 }
 
 # Legacy linalg op names (BLAS/LAPACK-flavored) -> np.linalg et al.
@@ -128,15 +128,24 @@ INFRA = {
     "_cvimread": "mx.image.imread",
     "_cvimresize": "mx.image.imresize",
     "_cvcopyMakeBorder": "mx.image.copyMakeBorder",
+    "_CrossDeviceCopy": "ndarray.copyto (engine copy op)",
+    "_NDArray": "deferred-compute internals (CachedOp tracing)",
+    "_Native": "deprecated PythonOp bridge -> operator.py shims",
 }
 
 
 def ref_ops():
-    out = subprocess.run(
-        ["grep", "-rhoP", r"NNVM_REGISTER_OP\(\K[^)]+", REF,
-         "--include=*.cc"], capture_output=True, text=True, check=True)
-    names = sorted(set(out.stdout.split()))
-    return [n for n in names if "$" not in n]  # drop macro templates
+    """Every registered op name: the nnvm registry PLUS the legacy
+    MXNET_REGISTER_OP_PROPERTY registrations (the pre-nnvm op system a
+    handful of vision ops still use)."""
+    names = set()
+    for pat in (r"NNVM_REGISTER_OP\(\K[^)]+",
+                r"MXNET_REGISTER_OP_PROPERTY\(\K[^,)]+"):
+        out = subprocess.run(
+            ["grep", "-rhoP", pat, REF, "--include=*.cc"],
+            capture_output=True, text=True, check=True)
+        names.update(out.stdout.split())
+    return sorted(n for n in names if "$" not in n)  # drop macros
 
 
 def build_resolver():
@@ -250,12 +259,19 @@ def build_resolver():
                 "MultiBoxDetection": "npx.multibox_detection",
                 "MultiBoxPrior": "npx.multibox_prior",
                 "MultiBoxTarget": "npx.multibox_target",
+                "Proposal": "npx.proposal",
+                "MultiProposal": "npx.multi_proposal",
+                "PSROIPooling":
+                    "npx.roi_align(position_sensitive=True)",
+                "DeformablePSROIPooling": "npx.deformable_psroi_pooling",
+                "fft": "np.fft.fft",
                 "dynamic_reshape": "np.reshape",
                 "getnnz": "sparse CSR .nnz",
                 "edge_id": "sparse CSR indexing",
             }
             if base in camel_alias:
-                return ("contrib", camel_alias[base])
+                tgt = camel_alias[base]
+                return ("contrib", tgt) if tgt else ("gap", None)
             for space in ("npx", "np"):
                 if has(space, base):
                     return ("contrib", f"{space}.{base}")

@@ -488,3 +488,181 @@ def test_identity_attach_kl_sparse_reg():
     onp.testing.assert_allclose(x2.grad.asnumpy(),
                                 onp.broadcast_to(expect2, act.shape),
                                 rtol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# spatial warping family (legacy MXNET_REGISTER_OP_PROPERTY ops)
+# ---------------------------------------------------------------------------
+def test_grid_generator_affine_identity_and_sampler():
+    """Identity affine theta reproduces the input exactly (grid spans
+    [-1,1]; bilinear at integer coords is exact)."""
+    x = _r(2, 3, 5, 7, seed=21)
+    theta = onp.tile(onp.array([1., 0., 0., 0., 1., 0.], onp.float32),
+                     (2, 1))
+    grid = npx.grid_generator(np.array(theta), "affine",
+                              target_shape=(5, 7))
+    assert grid.shape == (2, 2, 5, 7)
+    out = npx.bilinear_sampler(np.array(x), grid)
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-5, atol=1e-6)
+
+    # half-scale zoom samples the center region
+    theta2 = onp.tile(onp.array([0.5, 0., 0., 0., 0.5, 0.], onp.float32),
+                      (2, 1))
+    st = npx.spatial_transformer(np.array(x), np.array(theta2),
+                                 target_shape=(5, 7))
+    assert st.shape == (2, 3, 5, 7)
+    assert onp.isfinite(st.asnumpy()).all()
+
+
+def test_bilinear_sampler_zero_padding_outside():
+    x = np.array(onp.ones((1, 1, 4, 4), onp.float32))
+    # grid entirely outside [-1,1] -> zeros
+    grid = onp.full((1, 2, 2, 2), 3.0, onp.float32)
+    out = npx.bilinear_sampler(x, np.array(grid))
+    onp.testing.assert_allclose(out.asnumpy(), 0.0)
+
+
+def test_grid_generator_warp_flow():
+    # +1-pixel x-flow shifts sampling one pixel right
+    x = _r(1, 1, 4, 6, seed=22)
+    flow = onp.zeros((1, 2, 4, 6), onp.float32)
+    flow[:, 0] = 1.0
+    grid = npx.grid_generator(np.array(flow), "warp")
+    out = npx.bilinear_sampler(np.array(x), grid).asnumpy()
+    onp.testing.assert_allclose(out[0, 0, :, :-1], x[0, 0, :, 1:],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_matches_reference_loop():
+    """Direct re-derivation of correlation.cc:47-82."""
+    rs = onp.random.RandomState(23)
+    B, C, H, W = 1, 3, 6, 6
+    d1 = rs.rand(B, C, H, W).astype(onp.float32)
+    d2 = rs.rand(B, C, H, W).astype(onp.float32)
+    ks, md, s1, s2, pad = 1, 2, 1, 1, 2
+    out = npx.correlation(np.array(d1), np.array(d2), kernel_size=ks,
+                          max_displacement=md, stride1=s1, stride2=s2,
+                          pad_size=pad).asnumpy()
+
+    kr = ks // 2
+    border = md + kr
+    ph, pw = H + 2 * pad, W + 2 * pad
+    oh = -(-(ph - 2 * border) // s1)
+    ow = -(-(pw - 2 * border) // s1)
+    rad = md // s2
+    Dn = 2 * rad + 1
+    p1 = onp.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = onp.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    expect = onp.zeros((B, Dn * Dn, oh, ow), onp.float32)
+    sumelems = ks * ks * C
+    for i in range(oh):
+        for j in range(ow):
+            y1 = i * s1 + md
+            x1 = j * s1 + md
+            for tc in range(Dn * Dn):
+                s2o = (tc % Dn - rad) * s2
+                s2p = (tc // Dn - rad) * s2
+                acc = 0.0
+                for hh in range(-kr, kr + 1):
+                    for ww in range(-kr, kr + 1):
+                        acc += (p1[0, :, y1 + hh, x1 + ww] *
+                                p2[0, :, y1 + s2p + hh,
+                                   x1 + s2o + ww]).sum()
+                expect[0, tc, i, j] = acc / sumelems
+    onp.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_count_sketch():
+    rs = onp.random.RandomState(24)
+    N, D, K = 3, 10, 5
+    data = rs.rand(N, D).astype(onp.float32)
+    h = rs.randint(0, K, D).astype(onp.int32)
+    s = (rs.randint(0, 2, D) * 2 - 1).astype(onp.float32)
+    out = npx.count_sketch(np.array(data), np.array(h), np.array(s),
+                           out_dim=K).asnumpy()
+    expect = onp.zeros((N, K), onp.float32)
+    for i in range(D):
+        expect[:, h[i]] += s[i] * data[:, i]
+    onp.testing.assert_allclose(out, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_proposal_emits_clipped_nms_boxes():
+    """RPN proposal: rows are [batch_idx, x1, y1, x2, y2], clipped to
+    the image, ordered by objectness, non-overlapping past the NMS
+    threshold."""
+    rs = onp.random.RandomState(25)
+    B, A, h, w = 1, 3, 4, 4
+    cls_prob = rs.rand(B, 2 * A, h, w).astype(onp.float32)
+    bbox_pred = (rs.rand(B, 4 * A, h, w).astype(onp.float32) - 0.5) * 0.2
+    im_info = onp.array([[64.0, 64.0, 1.0]], onp.float32)
+    out = npx.proposal(np.array(cls_prob), np.array(bbox_pred),
+                       np.array(im_info), rpn_pre_nms_top_n=20,
+                       rpn_post_nms_top_n=8, rpn_min_size=1,
+                       scales=(8.0,), ratios=(0.5, 1.0, 2.0),
+                       feature_stride=16).asnumpy()
+    assert out.shape == (8, 5)
+    assert (out[:, 0] == 0).all()
+    kept = out[out[:, 3] > out[:, 1]]          # non-degenerate rows
+    assert len(kept) >= 1
+    assert (kept[:, 1] >= 0).all() and (kept[:, 3] <= 63).all()
+    assert (kept[:, 2] >= 0).all() and (kept[:, 4] <= 63).all()
+
+
+def test_deformable_convolution_zero_offset_matches_convolution():
+    """With all offsets zero, deformable conv must equal the ordinary
+    convolution (the defining property of the op)."""
+    rs = onp.random.RandomState(26)
+    B, C, H, W, O = 1, 3, 6, 6, 4
+    x = rs.rand(B, C, H, W).astype(onp.float32)
+    wgt = rs.rand(O, C, 3, 3).astype(onp.float32) * 0.3
+    off = onp.zeros((B, 2 * 9, 4, 4), onp.float32)
+    out = npx.deformable_convolution(
+        np.array(x), np.array(off), np.array(wgt), kernel=(3, 3),
+        stride=(1, 1), pad=(0, 0)).asnumpy()
+    import jax.numpy as jnp
+    from jax import lax
+    ref = lax.conv_general_dilated(jnp.asarray(x), jnp.asarray(wgt),
+                                   (1, 1), [(0, 0), (0, 0)])
+    onp.testing.assert_allclose(out, onp.asarray(ref), rtol=1e-4,
+                                atol=1e-5)
+    # a +1 x-offset on every tap equals convolving the x-shifted input
+    off1 = onp.zeros((B, 2 * 9, 4, 4), onp.float32)
+    off1[:, 1::2] = 1.0                        # (dy, dx) pairs: dx=1
+    out1 = npx.deformable_convolution(
+        np.array(x), np.array(off1), np.array(wgt), kernel=(3, 3),
+        stride=(1, 1), pad=(0, 0)).asnumpy()
+    xs = onp.zeros_like(x)
+    xs[..., :-1] = x[..., 1:]                  # shift left = sample x+1
+    ref1 = lax.conv_general_dilated(jnp.asarray(xs), jnp.asarray(wgt),
+                                    (1, 1), [(0, 0), (0, 0)])
+    onp.testing.assert_allclose(out1[..., :-1], onp.asarray(ref1)[..., :-1],
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_psroi_pooling_no_trans_matches_ps_average():
+    """With no_trans and group_size=1, deformable PSROI pooling
+    reduces to plain average pooling of each bin's channel."""
+    rs = onp.random.RandomState(27)
+    B, od, H, W = 1, 2, 8, 8
+    data = rs.rand(B, od, H, W).astype(onp.float32)  # gs=1 -> C=od
+    rois = onp.array([[0, 0, 0, 7, 7]], onp.float32)
+    trans = onp.zeros((1, 2, 2, 2), onp.float32)
+    out = npx.deformable_psroi_pooling(
+        np.array(data), np.array(rois), np.array(trans),
+        spatial_scale=1.0, output_dim=od, group_size=1,
+        pooled_size=2, part_size=2, sample_per_part=4,
+        no_trans=True).asnumpy()
+    assert out.shape == (1, od, 2, 2)
+    assert onp.isfinite(out).all()
+    # dense sampling of the whole ROI approximates per-bin means
+    for c in range(od):
+        onp.testing.assert_allclose(
+            out[0, c].mean(), data[0, c].mean(), rtol=0.1)
+    # offsets shift the sampled content: nonzero trans changes output
+    trans2 = onp.full((1, 2, 2, 2), 1.0, onp.float32)
+    out2 = npx.deformable_psroi_pooling(
+        np.array(data), np.array(rois), np.array(trans2),
+        spatial_scale=1.0, output_dim=od, group_size=1,
+        pooled_size=2, part_size=2, sample_per_part=4,
+        trans_std=0.1, no_trans=False).asnumpy()
+    assert onp.abs(out2 - out).max() > 1e-4

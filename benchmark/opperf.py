@@ -665,6 +665,25 @@ def annotate_vs_ref(results, ref_table):
 REF_GPU_MD = ("/root/reference/benchmark/opperf/results/"
               "mxnet_operator_benchmark_results_gpu.md")
 
+# Model-importance ordering for --top N (budget-gated TPU windows run
+# the ops that dominate real models first; the rest alphabetical).
+PRIORITY_SUBSTR = [
+    "dot", "matmul", "conv", "dense", "fully", "batch_norm", "layer_norm",
+    "relu", "activation", "softmax", "log_softmax", "add", "multiply",
+    "subtract", "divide", "exp", "sum", "mean", "max", "transpose",
+    "reshape", "concatenate", "split", "where", "pool", "embedding",
+    "take", "gather", "tanh", "sigmoid", "sqrt", "power", "norm",
+    "argmax", "topk", "einsum", "cumsum", "clip", "pad", "stack",
+]
+
+
+def _priority_key(name: str):
+    low = name.lower()
+    for i, sub in enumerate(PRIORITY_SUBSTR):
+        if sub in low:
+            return (0, i, name)
+    return (1, 0, name)
+
 
 def main():
     p = argparse.ArgumentParser()
@@ -675,9 +694,19 @@ def main():
     p.add_argument("--filter", default=None)
     p.add_argument("--small", action="store_true",
                    help="tiny shapes: coverage only, skip timing")
+    p.add_argument("--top", type=int, default=None,
+                   help="only the N most model-important ops (TPU "
+                        "window budget fitting)")
+    p.add_argument("--budget", type=float, default=None,
+                   help="wall-clock seconds; stop sweeping (and still "
+                        "write output) when exceeded")
+    p.add_argument("--resume", action="store_true",
+                   help="seed from an existing --output file and skip "
+                        "already-covered ops (window accumulation)")
     p.add_argument("--ref-table", default=REF_GPU_MD,
                    help="reference opperf results .md for vs_ref")
     args = p.parse_args()
+    t_start = time.monotonic()
 
     if args.platform == "cpu":
         import tpu_platform
@@ -696,12 +725,53 @@ def main():
     results = {}
     covered = 0
     total = 0
-    names = sorted(ops)
+    names = sorted(n for n in ops if n not in SKIP)
     if args.filter:
         names = [n for n in names if args.filter in n]
+    if args.top is not None:
+        names = sorted(names, key=_priority_key)[:args.top]
+
+    # --resume: a prior (possibly partial) output file seeds results,
+    # and already-measured ops are skipped — short accelerator windows
+    # accumulate across runs instead of each restart clobbering the
+    # biggest table collected so far.
+    prior_ops = {}
+    if args.resume and args.output and os.path.exists(args.output):
+        try:
+            with open(args.output) as f:
+                prior_ops = json.load(f).get("ops", {})
+        except (OSError, json.JSONDecodeError):
+            prior_ops = {}
+
+    def flush_output(partial):
+        if not args.output:
+            return
+        summary = {"total": total, "covered": covered,
+                   "platform": platform, "runs": args.runs,
+                   "warmup": args.warmup, "partial": partial}
+        tmp = args.output + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"summary": summary, "ops": results}, f,
+                      indent=1, sort_keys=True)
+        os.replace(tmp, args.output)
+
+    budget_hit = False
     for qual in names:
-        if qual in SKIP:
+        prev = prior_ops.get(qual)
+        if prev and prev.get("covered"):
+            results[qual] = prev
+            covered += 1
+            total += 1
             continue
+        if args.budget is not None \
+                and time.monotonic() - t_start > args.budget:
+            budget_hit = True
+            print(f"[opperf] budget {args.budget}s exceeded after "
+                  f"{total} ops; emitting partial table",
+                  file=sys.stderr, flush=True)
+            break
+        if args.output and total and total % 20 == 0:
+            flush_output(partial=True)  # killed child still leaves data
         total += 1
         thunk = specs.get(qual)
         err = None
@@ -741,7 +811,9 @@ def main():
                "platform": platform,
                "runs": args.runs, "warmup": args.warmup,
                "large_shapes": LARGE,
-               "vs_ref_ops": n_ref}
+               "vs_ref_ops": n_ref,
+               "budget_hit": budget_hit,
+               "elapsed_s": round(time.monotonic() - t_start, 1)}
     doc = {"summary": summary, "ops": results}
     text = json.dumps(doc, indent=1, sort_keys=True)
     if args.output:

@@ -14,14 +14,42 @@ Typical usage mirrors the reference:
 """
 from __future__ import annotations
 
+import os as _os
+
 import jax as _jax
+
+# Platform pinning (reference parity: context selection never blocks on
+# an absent device — /root/reference/python/mxnet/context.py:24-249).
+# The axon TPU plugin registers itself regardless of JAX_PLATFORMS and
+# its PJRT init can hang indefinitely when the tunnel is down, so a
+# plain `JAX_PLATFORMS=cpu` env var is not enough: the platform list
+# must be pinned via jax.config BEFORE any backend probe.
+# MXTPU_PLATFORM (ours) always wins; the JAX_PLATFORMS env var is
+# honored best-effort but never overrides a jax_platforms value user
+# code already set via jax.config.update before importing us.
+_platform_pin = _os.environ.get("MXTPU_PLATFORM")
+if not _platform_pin:
+    # The axon plugin clobbers jax_platforms to exactly "axon,cpu" at
+    # jax import time — that is why the JAX_PLATFORMS env var is dead
+    # on this image. Re-assert the env var over the plugin's clobber,
+    # but respect any OTHER value (one user code set via
+    # jax.config.update before importing us — including an explicit
+    # "axon" to force the TPU).
+    _jp = _os.environ.get("JAX_PLATFORMS")
+    _cfg = getattr(_jax.config, "jax_platforms", None)
+    if _jp and (not _cfg or _cfg == _jp or _cfg == "axon,cpu"):
+        _platform_pin = _jp
+if _platform_pin:
+    try:
+        _jax.config.update("jax_platforms", _platform_pin)
+    except Exception:  # pragma: no cover - older jax without the knob
+        pass
 
 # float64/int64 arrays are first-class in the reference, but a
 # process-global x64 flag inflates every trace/compile and risks silent
 # f64 on TPU hot paths (f64 is emulated there).  x64 is therefore
 # opt-in via MXTPU_ENABLE_X64=1; the default keeps JAX's f32 world,
 # which matches the reference's creation-op defaults (float32).
-import os as _os
 
 if _os.environ.get("MXTPU_ENABLE_X64", "") not in ("", "0"):
     _jax.config.update("jax_enable_x64", True)

@@ -24,13 +24,57 @@ from typing import Optional
 import jax
 
 
+_BACKEND_PROBE_TIMEOUT_S = float(
+    __import__("os").environ.get("MXTPU_BACKEND_TIMEOUT", "90"))
+_backend_probe_cache: list = []  # [platform_or_None] once resolved
+_backend_probe_lock = threading.Lock()
+_backend_probe_thread: dict = {}  # {"t": Thread} while a probe runs
+
+
 def _accelerator_platform():
-    """Return the preferred accelerator platform name, or None (cpu only)."""
-    try:
-        backend = jax.default_backend()
-    except Exception:  # pragma: no cover - no backend at all
-        return None
-    return None if backend == "cpu" else backend
+    """Return the preferred accelerator platform name, or None (cpu only).
+
+    Time-boxed: the axon TPU plugin's PJRT init can hang indefinitely
+    when its tunnel is down, and ``jax.default_backend()`` blocks inside
+    that init. The probe runs on a daemon thread with a
+    ``MXTPU_BACKEND_TIMEOUT`` (default 90s) deadline; on timeout we warn
+    and fall back to CPU for this call — the thread keeps waiting, so a
+    late-arriving backend is picked up by subsequent calls. Reference
+    parity: context selection never blocks on an absent device
+    (/root/reference/python/mxnet/context.py:24-249).
+    """
+    if _backend_probe_cache:
+        return _backend_probe_cache[0]
+
+    # ONE probe thread process-wide: while init is hung, later calls
+    # join the same in-flight thread (and pay at most one full
+    # deadline each) instead of each leaking a fresh stuck thread.
+    with _backend_probe_lock:
+        t = _backend_probe_thread.get("t")
+        if t is None:
+            def probe():
+                try:
+                    backend = jax.default_backend()
+                except Exception:  # pragma: no cover - no backend
+                    backend = "cpu"
+                _backend_probe_cache[:] = [
+                    None if backend == "cpu" else backend]
+
+            t = threading.Thread(target=probe, daemon=True,
+                                 name="mxtpu-backend-probe")
+            _backend_probe_thread["t"] = t
+            t.start()
+    t.join(_BACKEND_PROBE_TIMEOUT_S)
+    if _backend_probe_cache:
+        return _backend_probe_cache[0]
+    import warnings
+    warnings.warn(
+        f"jax backend init did not finish within "
+        f"{_BACKEND_PROBE_TIMEOUT_S:.0f}s (accelerator tunnel down?); "
+        f"falling back to CPU. Set MXTPU_PLATFORM=cpu to skip the "
+        f"probe, or MXTPU_BACKEND_TIMEOUT to change the deadline.",
+        RuntimeWarning, stacklevel=3)
+    return None
 
 
 class Context:

@@ -1,0 +1,53 @@
+"""Cheap axon-tunnel liveness probe (child of tpu_supervisor.py).
+
+Prints ONE JSON line: {"ok": bool, "init_s": .., "fetch_s": ..,
+"device_kind": ..}. The parent enforces a hard timeout (the axon
+plugin can hang indefinitely inside PJRT init — timing out IS the
+"down" signal). Kept minimal on purpose: one backend init, one small
+matmul, one value fetch (the only sync the tunnel honors — wait APIs
+return early; see bench.py module docstring).
+"""
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "bench_runs", "xla_cache"))
+
+t_start = time.time()
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+except Exception:
+    pass
+
+t0 = time.time()
+devs = jax.devices()
+init_s = time.time() - t0
+
+platform = devs[0].platform
+kind = devs[0].device_kind
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as onp  # noqa: E402
+
+t0 = time.time()
+x = jnp.ones((1024, 1024), jnp.bfloat16)
+v = float(onp.asarray((x @ x)[0, 0]))
+fetch_s = time.time() - t0
+
+print(json.dumps({
+    "ok": bool(v == 1024.0 and platform != "cpu"),
+    "init_s": round(init_s, 2),
+    "fetch_s": round(fetch_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+    "n_devices": len(devs),
+    "matmul_val": v,
+}), flush=True)
+sys.exit(0)

@@ -1,0 +1,83 @@
+"""Stage 1 of the staged TPU bench: matmul-MFU calibration (seconds).
+
+Measures sustained bf16 matmul TFLOP/s via fetch-delta timing (chained
+matmuls ended by a scalar fetch, two chain lengths differenced — the
+tunnel's wait APIs are async no-ops, so only materializing bytes proves
+execution). Prints ONE JSON line with sustained TFLOPs and mfu vs the
+chip's nominal peak. This is the cheapest possible real-FLOPs datapoint
+— it fits a ~2-minute tunnel window where a ResNet-50 compile cannot.
+"""
+import json
+import os
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, "bench_runs", "xla_cache"))
+
+import sys  # noqa: E402
+
+sys.path.insert(0, _REPO)
+
+import jax  # noqa: E402
+
+try:
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ["JAX_COMPILATION_CACHE_DIR"])
+except Exception:
+    pass
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as onp  # noqa: E402
+
+from bench import _peak_flops  # noqa: E402
+
+t0 = time.time()
+devs = jax.devices()
+init_s = time.time() - t0
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+N = int(os.environ.get("MATMUL_N", "8192"))
+LO, HI = 4, 36
+
+
+def chain_body(x, n):
+    """n dependent matmuls; scaled so values stay finite in bf16."""
+    def body(carry, _):
+        return (carry @ x) * (1.0 / N), None
+    y, _ = jax.lax.scan(body, x, None, length=n)
+    return y[0, 0]
+
+
+x = jnp.ones((N, N), jnp.bfloat16)
+f_lo = jax.jit(lambda x: chain_body(x, LO))
+f_hi = jax.jit(lambda x: chain_body(x, HI))
+
+
+def fetch(f):
+    t0 = time.perf_counter()
+    float(onp.asarray(f(x)))
+    return time.perf_counter() - t0
+
+
+compile_s = fetch(f_lo) + fetch(f_hi)  # compile both chain lengths
+t_lo, t_hi = fetch(f_lo), fetch(f_hi)
+
+sec = max(t_hi - t_lo, 1e-9)
+flops = 2.0 * N * N * N * (HI - LO)
+tflops = flops / sec / 1e12
+peak = _peak_flops(kind)
+mfu = (flops / sec / peak) if peak else None
+
+print(json.dumps({
+    "metric": "matmul_bf16_sustained_tflops",
+    "value": round(tflops, 1),
+    "unit": "TFLOP/s",
+    "mfu": round(mfu, 4) if mfu is not None else None,
+    "n": N,
+    "init_s": round(init_s, 2),
+    "compile_s": round(compile_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+}), flush=True)

@@ -734,14 +734,19 @@ def main():
     # --resume: a prior (possibly partial) output file seeds results,
     # and already-measured ops are skipped — short accelerator windows
     # accumulate across runs instead of each restart clobbering the
-    # biggest table collected so far.
-    prior_ops = {}
+    # biggest table collected so far. Covered prior entries are seeded
+    # UPFRONT (not lazily as the loop reaches them) so a budget break
+    # or mid-sweep SIGKILL can never rewrite the file without them.
     if args.resume and args.output and os.path.exists(args.output):
         try:
             with open(args.output) as f:
-                prior_ops = json.load(f).get("ops", {})
+                for q, rec in json.load(f).get("ops", {}).items():
+                    if rec.get("covered"):
+                        results[q] = rec
+                        covered += 1
+                        total += 1
         except (OSError, json.JSONDecodeError):
-            prior_ops = {}
+            pass
 
     def flush_output(partial):
         if not args.output:
@@ -757,11 +762,7 @@ def main():
 
     budget_hit = False
     for qual in names:
-        prev = prior_ops.get(qual)
-        if prev and prev.get("covered"):
-            results[qual] = prev
-            covered += 1
-            total += 1
+        if qual in results:  # seeded from a prior resumed run
             continue
         if args.budget is not None \
                 and time.monotonic() - t_start > args.budget:

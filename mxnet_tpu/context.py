@@ -38,10 +38,17 @@ def _accelerator_platform():
     when its tunnel is down, and ``jax.default_backend()`` blocks inside
     that init. The probe runs on a daemon thread with a
     ``MXTPU_BACKEND_TIMEOUT`` (default 90s) deadline; on timeout we warn
-    and fall back to CPU for this call — the thread keeps waiting, so a
+    and report CPU for this call — the thread keeps waiting, so a
     late-arriving backend is picked up by subsequent calls. Reference
     parity: context selection never blocks on an absent device
     (/root/reference/python/mxnet/context.py:24-249).
+
+    Honesty note: the hung probe thread holds jax's global backend
+    lock, so once this timeout fires, any subsequent jax operation in
+    this process will still block until the tunnel recovers. The
+    time-box converts a silent infinite hang into a diagnosed one —
+    full immunity requires pinning MXTPU_PLATFORM=cpu before import,
+    which skips the accelerator probe entirely.
     """
     if _backend_probe_cache:
         return _backend_probe_cache[0]
@@ -70,9 +77,11 @@ def _accelerator_platform():
     import warnings
     warnings.warn(
         f"jax backend init did not finish within "
-        f"{_BACKEND_PROBE_TIMEOUT_S:.0f}s (accelerator tunnel down?); "
-        f"falling back to CPU. Set MXTPU_PLATFORM=cpu to skip the "
-        f"probe, or MXTPU_BACKEND_TIMEOUT to change the deadline.",
+        f"{_BACKEND_PROBE_TIMEOUT_S:.0f}s (accelerator tunnel down?). "
+        f"Reporting CPU, but jax operations in this process may still "
+        f"block on the wedged backend init — restart with "
+        f"MXTPU_PLATFORM=cpu to skip the accelerator probe entirely "
+        f"(MXTPU_BACKEND_TIMEOUT changes this deadline).",
         RuntimeWarning, stacklevel=3)
     return None
 

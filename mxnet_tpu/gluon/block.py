@@ -205,7 +205,18 @@ class Block:
             if cast_dtype:
                 params[name].cast(val.dtype if dtype_source == "saved"
                                   else params[name].dtype)
-            params[name].set_data(val)
+            p = params[name]
+            expected = p.shape if p._shape_known() else None
+            if (expected is not None and getattr(val, "ndim", 0) == 4
+                    and tuple(val.shape) != tuple(expected)
+                    and (val.shape[0], val.shape[2], val.shape[3],
+                         val.shape[1]) == tuple(expected)):
+                # reference-written NCHW conv kernel (O,I,H,W) loading
+                # into an NHWC-layout model expecting (O,H,W,I):
+                # transpose automatically so reference checkpoints port
+                # without a conversion script (MIGRATION.md recipe)
+                val = val.transpose((0, 2, 3, 1))
+            p.set_data(val)
 
     def save(self, prefix):
         self.save_parameters(f"{prefix}-model.params")

@@ -15,6 +15,16 @@ def register(klass):
     return klass
 
 
+def alias(*names):
+    """Register creation-name aliases (parity: the reference's
+    @alias decorator, gluon/metric.py:190 — 'acc', 'ce', ...)."""
+    def reg(klass):
+        for n in names:
+            _REGISTRY[n.lower()] = klass
+        return klass
+    return reg
+
+
 def create(metric, *args, **kwargs):
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
@@ -85,6 +95,7 @@ class EvalMetric:
 
 
 @register
+@alias('composite')
 class CompositeEvalMetric(EvalMetric):
     def __init__(self, metrics=None, name="composite", output_names=None,
                  label_names=None):
@@ -125,6 +136,7 @@ def _flat_pairs(labels, preds):
 
 
 @register
+@alias('acc')
 class Accuracy(EvalMetric):
     def __init__(self, axis=-1, name="accuracy", output_names=None,
                  label_names=None):
@@ -146,6 +158,7 @@ class Accuracy(EvalMetric):
 
 
 @register
+@alias('top_k_accuracy', 'top_k_acc')
 class TopKAccuracy(EvalMetric):
     def __init__(self, top_k=1, name="top_k_accuracy", output_names=None,
                  label_names=None):
@@ -286,6 +299,7 @@ class RMSE(MSE):
 
 
 @register
+@alias('ce')
 class CrossEntropy(EvalMetric):
     def __init__(self, eps=1e-12, name="cross-entropy", output_names=None,
                  label_names=None):
@@ -304,6 +318,7 @@ class CrossEntropy(EvalMetric):
 
 
 @register
+@alias('nll_loss')
 class NegativeLogLikelihood(CrossEntropy):
     def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
                  label_names=None):
@@ -336,6 +351,7 @@ class Perplexity(CrossEntropy):
 
 
 @register
+@alias('pearsonr')
 class PearsonCorrelation(EvalMetric):
     def __init__(self, name="pearsonr", output_names=None, label_names=None):
         super().__init__(name, output_names, label_names)

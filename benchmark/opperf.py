@@ -737,14 +737,15 @@ def main():
     # biggest table collected so far. Covered prior entries are seeded
     # UPFRONT (not lazily as the loop reaches them) so a budget break
     # or mid-sweep SIGKILL can never rewrite the file without them.
+    # (counters stay sweep-scoped: seeded ops only count when the
+    # current names selection reaches them, so --filter/--top stats
+    # aren't inflated by prior full-sweep records)
     if args.resume and args.output and os.path.exists(args.output):
         try:
             with open(args.output) as f:
                 for q, rec in json.load(f).get("ops", {}).items():
                     if rec.get("covered"):
                         results[q] = rec
-                        covered += 1
-                        total += 1
         except (OSError, json.JSONDecodeError):
             pass
 
@@ -763,6 +764,8 @@ def main():
     budget_hit = False
     for qual in names:
         if qual in results:  # seeded from a prior resumed run
+            total += 1
+            covered += 1
             continue
         if args.budget is not None \
                 and time.monotonic() - t_start > args.budget:

@@ -39,6 +39,55 @@ from . import _deferred
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
 
+def _maybe_transpose_conv_kernel(name, p, val):
+    """Auto-transpose a reference-written NCHW conv kernel (O,I,H,W)
+    into a channels-last model expecting (O,H,W,I).
+
+    Fires ONLY on parameters a Conv2D layer tagged with
+    ``_kernel_layout == "OHWI"`` (conv_layers.py) — never on arbitrary
+    4-d parameters, so genuinely incompatible checkpoints still raise
+    the usual shape error. Layout is detected by locating the known
+    kernel (H, W) dims in the loaded array; a square-kernel array where
+    both interpretations fit (e.g. 3x3 kernel over 3 channels with
+    in_channels still deferred) is ambiguous and raises with guidance
+    instead of silently guessing (MIGRATION.md porting recipe).
+    """
+    if getattr(p, "_kernel_layout", None) != "OHWI" \
+            or getattr(val, "ndim", 0) != 4:
+        return val
+    kh, kw = p._kernel_hw
+    shape = tuple(val.shape)
+    if p._shape_known():
+        expected = tuple(p.shape)
+        if shape == expected:
+            return val
+        if (shape[0], shape[2], shape[3], shape[1]) == expected:
+            import warnings
+            warnings.warn(
+                f"Parameter '{name}': loaded kernel {shape} treated as "
+                f"reference NCHW (O,I,H,W) and transposed to {expected}"
+                f" (O,H,W,I). If this checkpoint was NOT written by an "
+                f"NCHW model, the weights are mis-permuted.",
+                UserWarning, stacklevel=4)
+            return val.transpose((0, 2, 3, 1))
+        return val  # let set_data raise its usual shape error
+    # deferred in_channels: expected is (O, kh, kw, 0) — decide by
+    # where the known kernel dims sit in the loaded array
+    looks_ohwi = shape[1:3] == (kh, kw)
+    looks_oihw = shape[2:4] == (kh, kw)
+    if looks_ohwi and looks_oihw:
+        raise ValueError(
+            f"Parameter '{name}': cannot tell whether the checkpoint "
+            f"kernel {shape} is NCHW (O,I,H,W) or NHWC (O,H,W,I) — "
+            f"kernel {kh}x{kw} with matching channel count is "
+            f"ambiguous while in_channels is deferred. Run one forward "
+            f"pass (or construct the layer with in_channels=...) "
+            f"before load_parameters.")
+    if looks_oihw:
+        return val.transpose((0, 2, 3, 1))
+    return val
+
+
 def _flatten_arrays(args):
     """Flatten nested (list/tuple/dict) args into NDArray leaves +
     a rebuild spec. Non-array leaves become static."""
@@ -206,16 +255,7 @@ class Block:
                 params[name].cast(val.dtype if dtype_source == "saved"
                                   else params[name].dtype)
             p = params[name]
-            expected = p.shape if p._shape_known() else None
-            if (expected is not None and getattr(val, "ndim", 0) == 4
-                    and tuple(val.shape) != tuple(expected)
-                    and (val.shape[0], val.shape[2], val.shape[3],
-                         val.shape[1]) == tuple(expected)):
-                # reference-written NCHW conv kernel (O,I,H,W) loading
-                # into an NHWC-layout model expecting (O,H,W,I):
-                # transpose automatically so reference checkpoints port
-                # without a conversion script (MIGRATION.md recipe)
-                val = val.transpose((0, 2, 3, 1))
+            val = _maybe_transpose_conv_kernel(name, p, val)
             p.set_data(val)
 
     def save(self, prefix):

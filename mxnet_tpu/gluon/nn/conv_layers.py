@@ -49,6 +49,14 @@ class _Conv(HybridBlock):
         self.weight = Parameter("weight", shape=wshape, dtype=dtype,
                                 init=weight_initializer,
                                 allow_deferred_init=True)
+        if op_name == "convolution" and ndim == 2 \
+                and not layout.startswith("NC"):
+            # mark channels-last conv kernels so load_parameters can
+            # auto-transpose reference-written NCHW checkpoints
+            # (O,I,H,W) -> (O,H,W,I) without guessing on other 4-d
+            # parameters (MIGRATION.md porting recipe)
+            self.weight._kernel_layout = "OHWI"
+            self.weight._kernel_hw = tuple(kernel_size)
         self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
                               init=bias_initializer,
                               allow_deferred_init=True) if use_bias else None

@@ -1,0 +1,133 @@
+"""Backwards-compat: load NDArray files byte-authored from the
+reference serialization SPEC, independently of the repo's own writer
+(round-4 VERDICT task #5, third bullet).
+
+The real reference cannot run in this environment (no built
+libmxnet.so), so fixtures a real reference process would have written
+are reproduced here by an independent struct.pack writer transcribing
+the on-disk layout straight from the reference sources:
+
+- /root/reference/src/ndarray/ndarray.cc:1964 (list save: u64 magic
+  0x112, u64 reserved, u64 count, entries, u64 name-count, names)
+- /root/reference/src/ndarray/ndarray.cc:1729 (NDArray::Save: u32
+  V2 magic 0xF993FAC9, i32 stype, shape, i32x2 context, i32 dtype
+  flag, raw data; V3 adds np-shape semantics)
+
+If the repo's reader and this writer agree, both independently match
+the spec — a stronger check than the repo round-tripping itself.
+"""
+import struct
+
+import numpy as onp
+
+import mxnet_tpu as mx
+
+LIST_MAGIC = 0x112
+V2 = 0xF993FAC9
+V3 = 0xF993FACA
+
+# reference dtype flags (mshadow/base.h: kFloat32=0, kFloat64=1,
+# kFloat16=2, kUint8=3, kInt32=4, kInt8=5, kInt64=6)
+FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+         "int32": 4, "int8": 5, "int64": 6}
+
+
+def _entry(a, magic=V2):
+    b = [struct.pack("<I", magic),
+         struct.pack("<i", 0)]                       # kDefaultStorage
+    b.append(struct.pack("<i", a.ndim))              # TShape::Save
+    b.append(struct.pack(f"<{a.ndim}q", *a.shape))
+    b.append(struct.pack("<ii", 1, 0))               # Context cpu(0)
+    b.append(struct.pack("<i", FLAGS[str(a.dtype)]))
+    b.append(onp.ascontiguousarray(a).tobytes())
+    return b"".join(b)
+
+
+def _write_list(path, arrays, names=()):
+    with open(path, "wb") as f:
+        f.write(struct.pack("<QQ", LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", len(arrays)))
+        for a in arrays:
+            f.write(_entry(a))
+        f.write(struct.pack("<Q", len(names)))
+        for n in names:
+            nb = n.encode("utf-8")
+            f.write(struct.pack("<Q", len(nb)) + nb)
+
+
+def test_load_reference_spec_dict(tmp_path):
+    p = str(tmp_path / "ref_dict.params")
+    w = onp.arange(12, dtype="float32").reshape(3, 4) * 0.5
+    b = onp.array([1, -2, 3], dtype="int32")
+    h = onp.arange(6, dtype="float16").reshape(2, 3)
+    _write_list(p, [w, b, h], ["arg:fc_weight", "arg:fc_bias", "half"])
+    loaded = mx.nd.load(p)
+    assert set(loaded) == {"arg:fc_weight", "arg:fc_bias", "half"}
+    onp.testing.assert_array_equal(loaded["arg:fc_weight"].asnumpy(), w)
+    onp.testing.assert_array_equal(loaded["arg:fc_bias"].asnumpy(), b)
+    onp.testing.assert_array_equal(
+        loaded["half"].asnumpy().astype("float16"), h)
+
+
+def test_load_reference_spec_list(tmp_path):
+    p = str(tmp_path / "ref_list.nd")
+    xs = [onp.arange(5, dtype="int64"),
+          onp.ones((2, 2), dtype="float64")]
+    _write_list(p, xs)  # empty names -> list semantics
+    loaded = mx.nd.load(p)
+    assert isinstance(loaded, list) and len(loaded) == 2
+    onp.testing.assert_array_equal(
+        loaded[0].asnumpy().astype("int64"), xs[0])
+    onp.testing.assert_allclose(loaded[1].asnumpy(), xs[1])
+
+
+def test_load_v3_npshape_entry(tmp_path):
+    """2.x (np-shape) V3 entries load identically for dense arrays."""
+    p = str(tmp_path / "ref_v3.nd")
+    a = onp.random.RandomState(0).uniform(size=(4, 3)).astype("float32")
+    with open(p, "wb") as f:
+        f.write(struct.pack("<QQ", LIST_MAGIC, 0))
+        f.write(struct.pack("<Q", 1))
+        f.write(_entry(a, magic=V3))
+        f.write(struct.pack("<Q", 0))
+    loaded = mx.nd.load(p)
+    onp.testing.assert_allclose(loaded[0].asnumpy(), a)
+
+
+def test_save_emits_reference_spec_bytes(tmp_path):
+    """The repo's writer must be byte-parseable by an independent
+    reader transcribed from the reference spec (the reverse check)."""
+    p = str(tmp_path / "out.params")
+    w = onp.arange(6, dtype="float32").reshape(2, 3)
+    mx.legacy_serialization.save_legacy(p, {"w": mx.np.array(w)})
+    with open(p, "rb") as f:
+        raw = f.read()
+    off = 0
+
+    def take(fmt):
+        nonlocal off
+        vals = struct.unpack_from("<" + fmt, raw, off)
+        off += struct.calcsize("<" + fmt)
+        return vals
+
+    magic, _res = take("QQ")
+    assert magic == LIST_MAGIC
+    (count,) = take("Q")
+    assert count == 1
+    (vmagic,) = take("I")
+    assert vmagic in (V2, V3)
+    (stype,) = take("i")
+    assert stype == 0
+    (ndim,) = take("i")
+    shape = take(f"{ndim}q")
+    assert shape == (2, 3)
+    take("ii")  # context
+    (flag,) = take("i")
+    assert flag == FLAGS["float32"]
+    data = onp.frombuffer(raw, dtype="float32", count=6, offset=off)
+    onp.testing.assert_array_equal(data.reshape(2, 3), w)
+    off += 24
+    (n_names,) = take("Q")
+    assert n_names == 1
+    (ln,) = take("Q")
+    assert raw[off:off + ln].decode() == "w"

@@ -1,0 +1,904 @@
+"""Operator-semantics conformance corpus derived from the reference's
+operator tests (round-4 VERDICT task #5).
+
+Each case pins the semantics the reference's unit tests assert —
+shapes, dtypes, and numerics — against an INDEPENDENT numpy
+implementation written here (the reference tests do the same:
+compare the op against a hand-rolled numpy forward). Sources mined:
+
+- /root/reference/tests/python/unittest/test_operator.py
+  (activations, leaky_relu family, softmax family, sequence ops,
+  pooling, normalization, pick/one_hot/topk, smooth_l1, embedding, ...)
+- /root/reference/tests/python/unittest/test_numpy_op.py
+  (np/npx dispatch forms, boolean_mask, gather/scatter_nd, ...)
+
+No reference code is copied: expected values come from the numpy
+closures below, with shapes/dtypes/tolerances matching what the
+reference exercises.
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import npx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+RNG = onp.random.RandomState(1234)
+
+
+def _u(shape, lo=-1.0, hi=1.0, dtype="float32"):
+    return RNG.uniform(lo, hi, shape).astype(dtype)
+
+
+# ---------------------------------------------------------------------------
+# numpy reference implementations (independent — written from op
+# semantics, not from reference code)
+# ---------------------------------------------------------------------------
+
+def np_sigmoid(x):
+    return 1.0 / (1.0 + onp.exp(-x))
+
+
+def np_softplus(x):
+    return onp.log1p(onp.exp(-onp.abs(x))) + onp.maximum(x, 0)
+
+
+def np_softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = onp.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+def np_log_softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    s = onp.log(onp.exp(x - m).sum(axis=axis, keepdims=True))
+    return x - m - s
+
+
+def np_gelu_erf(x):
+    return 0.5 * x * (1.0 + onp.vectorize(math.erf)(x / math.sqrt(2.0)))
+
+
+def np_selu(x):
+    # scale/alpha constants from Klambauer et al. (the reference's
+    # leaky_relu act_type='selu' uses the same published constants)
+    scale, alpha = 1.0507009873554805, 1.6732632423543772
+    return scale * onp.where(x > 0, x, alpha * (onp.exp(x) - 1.0))
+
+
+def np_smooth_l1(x, sigma):
+    s2 = sigma * sigma
+    return onp.where(onp.abs(x) < 1.0 / s2,
+                     0.5 * s2 * x * x, onp.abs(x) - 0.5 / s2)
+
+
+def np_one_hot(idx, depth, on=1.0, off=0.0):
+    out = onp.full(idx.shape + (depth,), off, dtype="float32")
+    it = onp.nditer(idx, flags=["multi_index"])
+    for v in it:
+        if 0 <= int(v) < depth:
+            out[it.multi_index + (int(v),)] = on
+    return out
+
+
+def np_pick(data, index, axis=-1):
+    return onp.take_along_axis(
+        data, onp.expand_dims(index.astype("int64"), axis),
+        axis=axis).squeeze(axis)
+
+
+def np_sequence_mask(x, lens, value=0.0, axis=0):
+    # time-major default (reference SequenceMask: data (T, N, ...))
+    out = x.copy()
+    T = x.shape[axis]
+    for n in range(x.shape[1 - axis]):
+        ln = int(lens[n])
+        sl = [slice(None)] * x.ndim
+        sl[axis] = slice(ln, T)
+        sl[1 - axis] = n
+        out[tuple(sl)] = value
+    return out
+
+
+def np_layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
+    mu = x.mean(axis=axis, keepdims=True)
+    var = x.var(axis=axis, keepdims=True)
+    return (x - mu) / onp.sqrt(var + eps) * gamma + beta
+
+
+def np_l2_normalization(x, mode="instance", eps=1e-10):
+    if mode == "instance":
+        n = onp.sqrt((x.reshape(x.shape[0], -1) ** 2).sum(-1) + eps)
+        return x / n.reshape((-1,) + (1,) * (x.ndim - 1))
+    if mode == "channel":
+        n = onp.sqrt((x ** 2).sum(1, keepdims=True) + eps)
+        return x / n
+    raise ValueError(mode)
+
+
+def np_lrn(x, nsize, alpha=1e-4, beta=0.75, knorm=2.0):
+    # cross-channel local response normalization, NCHW
+    out = onp.empty_like(x)
+    C = x.shape[1]
+    half = nsize // 2
+    for c in range(C):
+        lo, hi = max(0, c - half), min(C, c + half + 1)
+        ss = (x[:, lo:hi] ** 2).sum(axis=1)
+        out[:, c] = x[:, c] / (knorm + alpha * ss) ** beta
+    return out
+
+
+def np_pool2d(x, kernel, stride, pad, mode="max", count_include_pad=True):
+    # NCHW pooling with explicit padding
+    N, C, H, W = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    if mode == "max":
+        xp = onp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)),
+                     constant_values=-onp.inf)
+    else:
+        xp = onp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    out = onp.empty((N, C, Ho, Wo), dtype=x.dtype)
+    for i in range(Ho):
+        for j in range(Wo):
+            win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+            if mode == "max":
+                out[:, :, i, j] = win.max(axis=(2, 3))
+            elif mode == "sum":
+                out[:, :, i, j] = win.sum(axis=(2, 3))
+            else:  # avg
+                if count_include_pad:
+                    out[:, :, i, j] = win.mean(axis=(2, 3))
+                else:
+                    hi0, wj0 = i * sh - ph, j * sw - pw
+                    hcnt = min(hi0 + kh, H) - max(hi0, 0)
+                    wcnt = min(wj0 + kw, W) - max(wj0, 0)
+                    out[:, :, i, j] = win.sum(axis=(2, 3)) / (hcnt * wcnt)
+    return out
+
+
+def np_conv2d(x, w, b=None, stride=(1, 1), pad=(0, 0)):
+    # direct correlation, NCHW / OIHW
+    N, C, H, W = x.shape
+    O, _, kh, kw = w.shape
+    sh, sw = stride
+    ph, pw = pad
+    xp = onp.pad(x, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    Ho = (H + 2 * ph - kh) // sh + 1
+    Wo = (W + 2 * pw - kw) // sw + 1
+    out = onp.zeros((N, O, Ho, Wo), dtype="float32")
+    for o in range(O):
+        for i in range(Ho):
+            for j in range(Wo):
+                win = xp[:, :, i * sh:i * sh + kh, j * sw:j * sw + kw]
+                out[:, o, i, j] = (win * w[o]).sum(axis=(1, 2, 3))
+    if b is not None:
+        out += b.reshape(1, -1, 1, 1)
+    return out
+
+
+def np_ctc_loss_bruteforce(probs, label):
+    """-log p(label) by enumerating every blank-augmented alignment.
+    probs: (T, V) post-softmax with blank = index 0; label: list of
+    nonzero ids. Independent of any CTC implementation: walks all V^T
+    paths and keeps those collapsing to the label."""
+    T, V = probs.shape
+    total = 0.0
+    paths = [[]]
+    for _ in range(T):
+        paths = [p + [v] for p in paths for v in range(V)]
+    want = list(label)
+    for p in paths:
+        col = []
+        prev = None
+        for s in p:
+            if s != prev and s != 0:
+                col.append(s)
+            prev = s
+        if col == want:
+            pr = 1.0
+            for t, s in enumerate(p):
+                pr *= probs[t, s]
+            total += pr
+    return -math.log(total)
+
+
+# ---------------------------------------------------------------------------
+# Case table: (id, thunk, expected numpy array, rtol, atol)
+# ---------------------------------------------------------------------------
+
+X34 = _u((3, 4))
+X234 = _u((2, 3, 4))
+XPOS = _u((3, 4), 0.1, 2.0)
+TNC = _u((5, 3, 4))          # (T, N, C) sequence data
+LENS = onp.array([2, 5, 3], dtype="float32")
+IDX23 = onp.array([[0, 3, 1], [2, 2, 0]], dtype="int64")
+W42 = _u((4, 2))             # embedding table / dense weight
+
+CASES = []
+
+
+def case(cid, thunk, expected, rtol=1e-5, atol=1e-6):
+    CASES.append(pytest.param(thunk, expected, rtol, atol, id=cid))
+
+
+# --- activations (ref test_operator.py: test_relu/test_sigmoid/
+#     test_softsign/test_leaky_relu & friends) ---
+case("activation_relu",
+     lambda: npx.activation(mnp.array(X34), act_type="relu"),
+     onp.maximum(X34, 0))
+case("activation_sigmoid",
+     lambda: npx.activation(mnp.array(X34), act_type="sigmoid"),
+     np_sigmoid(X34))
+case("activation_tanh",
+     lambda: npx.activation(mnp.array(X34), act_type="tanh"),
+     onp.tanh(X34))
+case("activation_softrelu",
+     lambda: npx.activation(mnp.array(X34), act_type="softrelu"),
+     np_softplus(X34))
+case("activation_softsign",
+     lambda: npx.activation(mnp.array(X34), act_type="softsign"),
+     X34 / (1.0 + onp.abs(X34)))
+case("relu", lambda: npx.relu(mnp.array(X34)), onp.maximum(X34, 0))
+case("sigmoid", lambda: npx.sigmoid(mnp.array(X34)), np_sigmoid(X34))
+case("log_sigmoid", lambda: npx.log_sigmoid(mnp.array(X34)),
+     onp.log(np_sigmoid(X34)))
+case("softplus", lambda: npx.softplus(mnp.array(X34)), np_softplus(X34))
+case("softsign", lambda: npx.softsign(mnp.array(X34)),
+     X34 / (1.0 + onp.abs(X34)))
+case("silu", lambda: npx.silu(mnp.array(X34)), X34 * np_sigmoid(X34))
+case("gelu_erf", lambda: npx.gelu(mnp.array(X34)), np_gelu_erf(X34),
+     1e-4, 1e-5)
+case("mish", lambda: npx.mish(mnp.array(X34)),
+     X34 * onp.tanh(np_softplus(X34)), 1e-4, 1e-5)
+case("hard_sigmoid",
+     lambda: npx.hard_sigmoid(mnp.array(X34)),
+     onp.clip(0.2 * X34 + 0.5, 0.0, 1.0))
+case("hard_swish", lambda: npx.hard_swish(mnp.array(X34)),
+     X34 * onp.clip(X34 + 3.0, 0.0, 6.0) / 6.0)
+case("leaky_relu_leaky",
+     lambda: npx.leaky_relu(mnp.array(X34), act_type="leaky",
+                            slope=0.25),
+     onp.where(X34 > 0, X34, 0.25 * X34))
+case("leaky_relu_elu",
+     lambda: npx.leaky_relu(mnp.array(X34), act_type="elu", slope=1.0),
+     onp.where(X34 > 0, X34, onp.exp(X34) - 1.0), 1e-4, 1e-5)
+case("leaky_relu_selu",
+     lambda: npx.leaky_relu(mnp.array(X34), act_type="selu"),
+     np_selu(X34), 1e-4, 1e-5)
+case("rsqrt", lambda: npx.rsqrt(mnp.array(XPOS)),
+     1.0 / onp.sqrt(XPOS), 1e-5, 1e-6)
+case("rcbrt", lambda: npx.rcbrt(mnp.array(XPOS)),
+     1.0 / onp.cbrt(XPOS), 1e-5, 1e-6)
+case("smooth_l1_s1",
+     lambda: npx.smooth_l1(mnp.array(X34 * 3), scalar=1.0),
+     np_smooth_l1(X34 * 3, 1.0))
+case("smooth_l1_s2",
+     lambda: npx.smooth_l1(mnp.array(X34 * 3), scalar=2.0),
+     np_smooth_l1(X34 * 3, 2.0))
+case("quadratic",
+     lambda: npx.quadratic(mnp.array(X34), a=2.0, b=-1.0, c=0.5),
+     2.0 * X34 ** 2 - 1.0 * X34 + 0.5)
+case("erf", lambda: npx.erf(mnp.array(X34)),
+     onp.vectorize(math.erf)(X34), 1e-4, 1e-5)
+case("gammaln", lambda: npx.gammaln(mnp.array(XPOS)),
+     onp.vectorize(math.lgamma)(XPOS), 1e-4, 1e-4)
+
+# --- softmax family (ref test_operator.py test_softmax_*) ---
+case("softmax_axis-1",
+     lambda: npx.softmax(mnp.array(X234)), np_softmax(X234))
+case("softmax_axis0",
+     lambda: npx.softmax(mnp.array(X234), axis=0),
+     np_softmax(X234, axis=0))
+case("softmax_temperature",
+     lambda: npx.softmax(mnp.array(X234), temperature=2.0),
+     np_softmax(X234 / 2.0), 1e-4, 1e-5)
+case("log_softmax",
+     lambda: npx.log_softmax(mnp.array(X234)), np_log_softmax(X234))
+case("softmin",
+     lambda: npx.softmin(mnp.array(X234)), np_softmax(-X234))
+case("masked_softmax",
+     lambda: npx.masked_softmax(
+         mnp.array(X34),
+         mnp.array(onp.array([[1, 1, 0, 1], [1, 0, 1, 1],
+                              [1, 1, 1, 1]], dtype=bool))),
+     onp.where(
+         onp.array([[1, 1, 0, 1], [1, 0, 1, 1], [1, 1, 1, 1]],
+                   dtype=bool),
+         np_softmax(onp.where(
+             onp.array([[1, 1, 0, 1], [1, 0, 1, 1], [1, 1, 1, 1]],
+                       dtype=bool), X34, -onp.inf)), 0.0),
+     1e-4, 1e-5)
+
+# --- sequence ops (ref test_operator.py test_sequence_*) ---
+case("sequence_mask_zero",
+     lambda: npx.sequence_mask(mnp.array(TNC), mnp.array(LENS),
+                               use_sequence_length=True),
+     np_sequence_mask(TNC, LENS))
+case("sequence_mask_value",
+     lambda: npx.sequence_mask(mnp.array(TNC), mnp.array(LENS),
+                               use_sequence_length=True, value=-2.5),
+     np_sequence_mask(TNC, LENS, value=-2.5))
+case("sequence_last",
+     lambda: npx.sequence_last(mnp.array(TNC), mnp.array(LENS),
+                               use_sequence_length=True),
+     onp.stack([TNC[int(LENS[n]) - 1, n] for n in range(3)]))
+case("sequence_reverse",
+     lambda: npx.sequence_reverse(mnp.array(TNC), mnp.array(LENS),
+                                  use_sequence_length=True),
+     onp.stack([onp.concatenate(
+         [TNC[:int(LENS[n]), n][::-1], TNC[int(LENS[n]):, n]])
+         for n in range(3)], axis=1))
+
+# --- indexing (ref test_operator.py test_one_hot/test_pick,
+#     test_numpy_op.py boolean_mask/gather_nd/scatter_nd) ---
+case("one_hot", lambda: npx.one_hot(mnp.array(IDX23), 5),
+     np_one_hot(IDX23, 5))
+case("one_hot_onoff",
+     lambda: npx.one_hot(mnp.array(IDX23), 4, on_value=8.0,
+                         off_value=-1.0),
+     np_one_hot(IDX23, 4, on=8.0, off=-1.0))
+case("pick",
+     lambda: npx.pick(mnp.array(X34),
+                      mnp.array(onp.array([1, 0, 3], dtype="int64"))),
+     np_pick(X34, onp.array([1, 0, 3]))),
+case("pick_axis0",
+     lambda: npx.pick(mnp.array(X34),
+                      mnp.array(onp.array([2, 0, 1, 2], dtype="int64")),
+                      axis=0),
+     np_pick(X34, onp.array([2, 0, 1, 2]), axis=0))
+case("embedding",
+     lambda: npx.embedding(
+         mnp.array(IDX23), mnp.array(W42), input_dim=4, output_dim=2),
+     W42[IDX23])
+case("gather_nd",
+     lambda: npx.gather_nd(
+         mnp.array(X34),
+         mnp.array(onp.array([[0, 2, 1], [3, 1, 0]], dtype="int64"))),
+     X34[[0, 2, 1], [3, 1, 0]])
+case("boolean_mask",
+     lambda: npx.boolean_mask(
+         mnp.array(X34),
+         mnp.array(onp.array([True, False, True]))),
+     X34[[0, 2]])
+case("topk_value",
+     lambda: npx.topk(mnp.array(X34), k=2, ret_typ="value"),
+     -onp.sort(-X34, axis=-1)[:, :2])
+case("topk_indices",
+     lambda: npx.topk(mnp.array(X34), k=2, ret_typ="indices"),
+     onp.argsort(-X34, kind="stable", axis=-1)[:, :2].astype("float32"))
+case("topk_ascend",
+     lambda: npx.topk(mnp.array(X34), k=2, ret_typ="value",
+                      is_ascend=True),
+     onp.sort(X34, axis=-1)[:, :2])
+case("shape_array", lambda: npx.shape_array(mnp.array(X234)),
+     onp.array([2, 3, 4], dtype="int64"))
+case("index_array",
+     lambda: npx.index_array(mnp.array(_u((2, 3)))),
+     onp.stack(onp.meshgrid(onp.arange(2), onp.arange(3),
+                            indexing="ij"), -1).astype("int64"))
+
+# --- slicing (ref test_operator.py test_slice_*) ---
+case("slice",
+     lambda: npx.slice(mnp.array(X234), begin=(0, 1), end=(2, 3)),
+     X234[0:2, 1:3])
+case("slice_step",
+     lambda: npx.slice(mnp.array(X234), begin=(None, None, 3),
+                       end=(None, None, None), step=(None, None, -2)),
+     X234[:, :, 3::-2])
+case("slice_axis",
+     lambda: npx.slice_axis(mnp.array(X234), axis=2, begin=1, end=3),
+     X234[:, :, 1:3])
+case("slice_like",
+     lambda: npx.slice_like(mnp.array(X234), mnp.array(_u((2, 2, 2)))),
+     X234[:2, :2, :2])
+case("reshape_like",
+     lambda: npx.reshape_like(mnp.array(X34), mnp.array(_u((2, 6)))),
+     X34.reshape(2, 6))
+case("broadcast_like",
+     lambda: npx.broadcast_like(mnp.array(_u((1, 4))),
+                                mnp.array(X34)),
+     None)  # placeholder replaced below
+
+CASES.pop()  # drop placeholder (broadcast_like built separately below)
+_B14 = _u((1, 4))
+case("broadcast_like",
+     lambda: npx.broadcast_like(mnp.array(_B14), mnp.array(X34)),
+     onp.broadcast_to(_B14, (3, 4)))
+case("depth_to_space",
+     lambda: npx.depth_to_space(mnp.array(_u((1, 8, 2, 3))), 2),
+     None)
+CASES.pop()
+_D2S = _u((1, 8, 2, 3))
+
+
+def _np_d2s(x, block):
+    n, c, h, w = x.shape
+    t = x.reshape(n, block, block, c // (block * block), h, w)
+    t = t.transpose(0, 3, 4, 1, 5, 2)
+    return t.reshape(n, c // (block * block), h * block, w * block)
+
+
+case("depth_to_space",
+     lambda: npx.depth_to_space(mnp.array(_D2S), 2), _np_d2s(_D2S, 2))
+_S2D = _np_d2s(_D2S, 2)
+case("space_to_depth",
+     lambda: npx.space_to_depth(mnp.array(_S2D), 2), _D2S)
+
+# --- normalization (ref test_operator.py test_layer_norm/
+#     test_l2_normalization/test_lrn/test_batchnorm_*) ---
+_G4, _B4 = _u((4,), 0.5, 1.5), _u((4,))
+case("layer_norm",
+     lambda: npx.layer_norm(mnp.array(X234), mnp.array(_G4),
+                            mnp.array(_B4), axis=-1, eps=1e-5),
+     np_layer_norm(X234, _G4, _B4), 1e-4, 1e-5)
+case("rms_norm",
+     lambda: npx.rms_norm(mnp.array(X234), mnp.array(_G4), eps=1e-6),
+     X234 / onp.sqrt((X234 ** 2).mean(-1, keepdims=True) + 1e-6) * _G4,
+     1e-4, 1e-5)
+case("l2_normalization_instance",
+     lambda: npx.l2_normalization(mnp.array(X234), mode="instance"),
+     np_l2_normalization(X234, "instance"), 1e-4, 1e-5)
+case("l2_normalization_channel",
+     lambda: npx.l2_normalization(mnp.array(X234), mode="channel"),
+     np_l2_normalization(X234, "channel"), 1e-4, 1e-5)
+_LRN_X = _u((2, 7, 3, 3))
+case("lrn",
+     lambda: npx.lrn(mnp.array(_LRN_X), nsize=3, alpha=1e-4,
+                     beta=0.75, knorm=2.0),
+     np_lrn(_LRN_X, 3), 1e-4, 1e-5)
+_BN_X = _u((2, 4, 3, 3))
+_BN_MEAN, _BN_VAR = _u((4,)), _u((4,), 0.5, 1.5)
+case("batch_norm_inference",
+     lambda: npx.batch_norm(
+         mnp.array(_BN_X), mnp.array(_G4), mnp.array(_B4),
+         mnp.array(_BN_MEAN), mnp.array(_BN_VAR), eps=1e-3,
+         use_global_stats=True),
+     ((_BN_X - _BN_MEAN.reshape(1, -1, 1, 1))
+      / onp.sqrt(_BN_VAR.reshape(1, -1, 1, 1) + 1e-3)
+      * _G4.reshape(1, -1, 1, 1) + _B4.reshape(1, -1, 1, 1)),
+     1e-4, 1e-5)
+_MOM_X = _u((2, 3, 4))
+case("moments_keepdims",
+     lambda: npx.moments(mnp.array(_MOM_X), axes=(0, 2),
+                         keepdims=True)[0],
+     _MOM_X.mean(axis=(0, 2), keepdims=True), 1e-5, 1e-6)
+case("moments_var",
+     lambda: npx.moments(mnp.array(_MOM_X), axes=(0, 2))[1],
+     _MOM_X.var(axis=(0, 2)), 1e-4, 1e-5)
+
+# --- linear algebra style (ref test_operator.py test_fullyconnected/
+#     test_batch_dot/test_dot) ---
+_FC_X, _FC_W, _FC_B = _u((3, 4)), _u((5, 4)), _u((5,))
+case("fully_connected",
+     lambda: npx.fully_connected(mnp.array(_FC_X), mnp.array(_FC_W),
+                                 mnp.array(_FC_B), num_hidden=5),
+     _FC_X @ _FC_W.T + _FC_B, 1e-4, 1e-5)
+case("fully_connected_nobias",
+     lambda: npx.fully_connected(mnp.array(_FC_X), mnp.array(_FC_W),
+                                 num_hidden=5, no_bias=True),
+     _FC_X @ _FC_W.T, 1e-4, 1e-5)
+_BD_A, _BD_B = _u((2, 3, 4)), _u((2, 4, 5))
+case("batch_dot",
+     lambda: npx.batch_dot(mnp.array(_BD_A), mnp.array(_BD_B)),
+     onp.einsum("bij,bjk->bik", _BD_A, _BD_B), 1e-4, 1e-5)
+case("batch_dot_transpose_b",
+     lambda: npx.batch_dot(mnp.array(_BD_A),
+                           mnp.array(_BD_B.transpose(0, 2, 1)),
+                           transpose_b=True),
+     onp.einsum("bij,bjk->bik", _BD_A, _BD_B), 1e-4, 1e-5)
+case("div_sqrt_dim",
+     lambda: npx.div_sqrt_dim(mnp.array(X234)),
+     X234 / math.sqrt(4.0), 1e-5, 1e-6)
+# column-wise Kronecker: (M1,N),(M2,N) -> (M1*M2,N), col k =
+# outer(A[:,k], B[:,k]) flattened (ref src/operator/contrib/krprod.cc)
+_KR_A, _KR_B = _u((3, 4)), _u((2, 4))
+case("khatri_rao",
+     lambda: npx.khatri_rao(mnp.array(_KR_A), mnp.array(_KR_B)),
+     onp.stack([onp.outer(_KR_A[:, k], _KR_B[:, k]).reshape(-1)
+                for k in range(4)], axis=1), 1e-4, 1e-5)
+
+# --- pooling (ref test_operator.py test_pooling_*) ---
+_P_X = _u((2, 3, 6, 6))
+case("pool_max_k2s2",
+     lambda: npx.pooling(mnp.array(_P_X), kernel=(2, 2), stride=(2, 2),
+                         pool_type="max"),
+     np_pool2d(_P_X, (2, 2), (2, 2), (0, 0), "max"), 1e-5, 1e-6)
+case("pool_avg_k3s1p1",
+     lambda: npx.pooling(mnp.array(_P_X), kernel=(3, 3), stride=(1, 1),
+                         pad=(1, 1), pool_type="avg"),
+     np_pool2d(_P_X, (3, 3), (1, 1), (1, 1), "avg"), 1e-4, 1e-5)
+case("pool_avg_exclude_pad",
+     lambda: npx.pooling(mnp.array(_P_X), kernel=(3, 3), stride=(2, 2),
+                         pad=(1, 1), pool_type="avg",
+                         count_include_pad=False),
+     np_pool2d(_P_X, (3, 3), (2, 2), (1, 1), "avg",
+               count_include_pad=False), 1e-4, 1e-5)
+case("pool_sum",
+     lambda: npx.pooling(mnp.array(_P_X), kernel=(2, 2), stride=(2, 2),
+                         pool_type="sum"),
+     np_pool2d(_P_X, (2, 2), (2, 2), (0, 0), "sum"), 1e-4, 1e-5)
+case("pool_global",
+     lambda: npx.pooling(mnp.array(_P_X), kernel=(2, 2),
+                         pool_type="max", global_pool=True),
+     _P_X.max(axis=(2, 3), keepdims=True), 1e-5, 1e-6)
+case("adaptive_avg_pool2d_1",
+     lambda: npx.adaptive_avg_pool2d(mnp.array(_P_X), output_size=1),
+     _P_X.mean(axis=(2, 3), keepdims=True), 1e-5, 1e-6)
+
+# --- convolution (ref test_operator.py test_convolution_*; exact
+#     small-case correlation) ---
+_CV_X, _CV_W, _CV_B = _u((2, 3, 5, 5)), _u((4, 3, 3, 3)), _u((4,))
+case("conv2d_k3",
+     lambda: npx.convolution(mnp.array(_CV_X), mnp.array(_CV_W),
+                             mnp.array(_CV_B), kernel=(3, 3),
+                             num_filter=4),
+     np_conv2d(_CV_X, _CV_W, _CV_B), 1e-3, 1e-4)
+case("conv2d_k3s2p1",
+     lambda: npx.convolution(mnp.array(_CV_X), mnp.array(_CV_W),
+                             mnp.array(_CV_B), kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1), num_filter=4),
+     np_conv2d(_CV_X, _CV_W, _CV_B, (2, 2), (1, 1)), 1e-3, 1e-4)
+
+# --- misc np ops the reference's test_numpy_op.py pins ---
+case("clip", lambda: mnp.clip(mnp.array(X34 * 3), -1.0, 1.0),
+     onp.clip(X34 * 3, -1.0, 1.0))
+case("where",
+     lambda: mnp.where(mnp.array(X34) > 0, mnp.array(X34),
+                       mnp.array(X34) * 2),
+     onp.where(X34 > 0, X34, X34 * 2))
+case("cumsum_axis1", lambda: mnp.cumsum(mnp.array(X34), axis=1),
+     onp.cumsum(X34, axis=1), 1e-5, 1e-6)
+case("flip", lambda: mnp.flip(mnp.array(X234), axis=1),
+     onp.flip(X234, axis=1))
+case("tile", lambda: mnp.tile(mnp.array(X34), (2, 3)),
+     onp.tile(X34, (2, 3)))
+case("repeat", lambda: mnp.repeat(mnp.array(X34), 2, axis=0),
+     onp.repeat(X34, 2, axis=0))
+case("diag", lambda: mnp.diag(mnp.array(_u((4, 4)))), None)
+CASES.pop()
+_DG = _u((4, 4))
+case("diag", lambda: mnp.diag(mnp.array(_DG)), onp.diag(_DG))
+case("trace", lambda: mnp.trace(mnp.array(_DG)), onp.trace(_DG),
+     1e-5, 1e-6)
+case("argsort", lambda: mnp.argsort(mnp.array(X34), axis=1),
+     onp.argsort(X34, kind="stable", axis=1))
+case("meshgrid",
+     lambda: mnp.meshgrid(mnp.array(onp.arange(3.0)),
+                          mnp.array(onp.arange(4.0)))[0],
+     onp.meshgrid(onp.arange(3.0), onp.arange(4.0))[0])
+
+
+@pytest.mark.parametrize("thunk,expected,rtol,atol", CASES)
+def test_operator_conformance(thunk, expected, rtol, atol):
+    out = thunk()
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    assert got.shape == onp.asarray(expected).shape, \
+        f"shape {got.shape} vs {onp.asarray(expected).shape}"
+    assert_almost_equal(got, onp.asarray(expected), rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# CTC loss against a brute-force alignment enumeration
+# (ref test_operator.py test_ctc_loss*)
+# ---------------------------------------------------------------------------
+
+def test_ctc_loss_bruteforce():
+    T, N, V = 4, 2, 3  # time, batch, vocab (0 = blank)
+    logits = _u((T, N, V), -2.0, 2.0)
+    labels = onp.array([[1, 2], [2, 0]], dtype="float32")  # 0-padded
+    out = npx.ctc_loss(mnp.array(logits), mnp.array(labels))
+    probs = np_softmax(logits, axis=-1)
+    want0 = np_ctc_loss_bruteforce(probs[:, 0], [1, 2])
+    want1 = np_ctc_loss_bruteforce(probs[:, 1], [2])
+    got = out.asnumpy()
+    assert_almost_equal(got, onp.array([want0, want1], dtype="float32"),
+                        rtol=1e-3, atol=1e-4)
+
+
+# ---------------------------------------------------------------------------
+# Batch 2: spatial / detection / index-mutation / linalg decompositions
+# (ref test_operator.py test_grid_generator/test_bilinear_sampler/
+#  test_roi_pooling/test_box_iou/test_multibox_prior/...;
+#  test_numpy_op.py test_np_linalg_*)
+# ---------------------------------------------------------------------------
+
+CASES2 = []
+
+
+def case2(cid, thunk, expected, rtol=1e-5, atol=1e-6):
+    CASES2.append(pytest.param(thunk, expected, rtol, atol, id=cid))
+
+
+# identity affine theta -> sampling grid == identity -> sampler returns x
+_ST_X = _u((2, 3, 4, 4))
+_ID_THETA = onp.tile(onp.array([[1.0, 0, 0, 0, 1.0, 0]], "float32"),
+                     (2, 1))
+case2("grid_generator_identity_affine",
+      lambda: npx.grid_generator(mnp.array(_ID_THETA),
+                                 transform_type="affine",
+                                 target_shape=(4, 4)),
+      onp.tile(onp.stack(
+          [onp.tile(onp.linspace(-1, 1, 4, dtype="float32"), (4, 1)),
+           onp.tile(onp.linspace(-1, 1, 4, dtype="float32")[:, None],
+                    (1, 4))]), (2, 1, 1, 1)),
+      1e-4, 1e-5)
+case2("bilinear_sampler_identity",
+      lambda: npx.bilinear_sampler(
+          mnp.array(_ST_X),
+          npx.grid_generator(mnp.array(_ID_THETA),
+                             transform_type="affine",
+                             target_shape=(4, 4))),
+      _ST_X, 1e-4, 1e-5)
+case2("spatial_transformer_identity",
+      lambda: npx.spatial_transformer(
+          mnp.array(_ST_X), mnp.array(_ID_THETA),
+          target_shape=(4, 4), transform_type="affine",
+          sampler_type="bilinear"),
+      _ST_X, 1e-4, 1e-5)
+
+# roi_pooling: rois exactly on bin boundaries -> exact max-pool
+_ROI_X = _u((1, 2, 8, 8))
+_ROIS = onp.array([[0, 0, 0, 7, 7]], dtype="float32")  # whole image
+
+
+def _np_roi_pool_whole(x, out_hw):
+    # whole-image roi, 8x8 -> 2x2: each bin is a 4x4 max
+    return np_pool2d(x, (4, 4), (4, 4), (0, 0), "max")
+
+
+case2("roi_pooling_whole_image",
+      lambda: npx.roi_pooling(mnp.array(_ROI_X), mnp.array(_ROIS),
+                              pooled_size=(2, 2), spatial_scale=1.0),
+      _np_roi_pool_whole(_ROI_X, (2, 2)), 1e-5, 1e-6)
+
+# box_iou: hand-computable intersection-over-union (corner format)
+_BA = onp.array([[0.0, 0, 2, 2], [1, 1, 3, 3]], dtype="float32")
+_BB = onp.array([[0.0, 0, 2, 2], [2, 2, 4, 4]], dtype="float32")
+
+
+def _np_iou(a, b):
+    out = onp.zeros((a.shape[0], b.shape[0]), "float32")
+    for i in range(a.shape[0]):
+        for j in range(b.shape[0]):
+            xx1, yy1 = max(a[i, 0], b[j, 0]), max(a[i, 1], b[j, 1])
+            xx2, yy2 = min(a[i, 2], b[j, 2]), min(a[i, 3], b[j, 3])
+            inter = max(0.0, xx2 - xx1) * max(0.0, yy2 - yy1)
+            ua = ((a[i, 2] - a[i, 0]) * (a[i, 3] - a[i, 1])
+                  + (b[j, 2] - b[j, 0]) * (b[j, 3] - b[j, 1]) - inter)
+            out[i, j] = inter / ua if ua > 0 else 0.0
+    return out
+
+
+case2("box_iou_corner",
+      lambda: npx.box_iou(mnp.array(_BA), mnp.array(_BB),
+                          format="corner"),
+      _np_iou(_BA, _BB), 1e-5, 1e-6)
+
+# multibox_prior: first-pixel anchors from the documented formula
+case2("multibox_prior_first_anchor",
+      lambda: npx.multibox_prior(mnp.array(_u((1, 3, 4, 4))),
+                                 sizes=[0.5], ratios=[1.0])[0, 0],
+      onp.array([0.125 - 0.25, 0.125 - 0.25,
+                 0.125 + 0.25, 0.125 + 0.25], dtype="float32"),
+      1e-5, 1e-6)
+
+# index mutation (ref test_operator.py test_index_copy/
+#  test_numpy_op.py npx.index_add/index_update)
+_IC_X = _u((5, 3))
+_IC_T = onp.array([0, 3], dtype="int64")
+_IC_V = _u((2, 3))
+_exp_copy = _IC_X.copy()
+_exp_copy[[0, 3]] = _IC_V
+case2("index_copy",
+      lambda: npx.index_copy(mnp.array(_IC_X), mnp.array(_IC_T),
+                             mnp.array(_IC_V)),
+      _exp_copy)
+_exp_add = _IC_X.copy()
+_exp_add[[0, 3]] += _IC_V
+case2("index_add",
+      lambda: npx.index_add(mnp.array(_IC_X),
+                            mnp.array(_IC_T.reshape(1, 2)),
+                            mnp.array(_IC_V)),
+      _exp_add)
+
+# scatter_nd (inverse of gather_nd)
+_SC_IDX = onp.array([[0, 2], [3, 1]], dtype="int64")
+_SC_VAL = onp.array([5.0, 7.0], dtype="float32")
+_exp_scatter = onp.zeros((4, 4), "float32")
+_exp_scatter[0, 3] = 5.0
+_exp_scatter[2, 1] = 7.0
+case2("scatter_nd",
+      lambda: npx.scatter_nd(mnp.array(_SC_VAL), mnp.array(_SC_IDX),
+                             (4, 4)),
+      _exp_scatter)
+
+# arange_like (ref npx.arange_like)
+case2("arange_like",
+      lambda: npx.arange_like(mnp.array(X34), start=2.0, step=0.5,
+                              axis=1),
+      onp.arange(2.0, 2.0 + 0.5 * 4, 0.5, dtype="float32"))
+
+# all_finite / multi_all_finite
+case2("all_finite_true",
+      lambda: npx.all_finite(mnp.array(X34)),
+      onp.array(True))
+_NANX = X34.copy()
+_NANX[0, 0] = onp.nan
+case2("all_finite_false",
+      lambda: npx.all_finite(mnp.array(_NANX)),
+      onp.array(False))
+
+# dropout in inference mode is identity (ref test_operator.py
+# test_dropout: mode='training' gates; eval passes through)
+case2("dropout_eval_identity",
+      lambda: npx.dropout(mnp.array(X34), p=0.5),
+      X34)
+
+# im2col / col2im roundtrip on non-overlapping patches
+_I2C_X = _u((1, 2, 4, 4))
+case2("im2col_shape_and_sum",
+      lambda: npx.im2col(mnp.array(_I2C_X), kernel=(2, 2),
+                         stride=(2, 2)).sum(axis=1),
+      np_pool2d(_I2C_X, (2, 2), (2, 2), (0, 0), "sum")
+      .sum(axis=1).reshape(1, -1), 1e-4, 1e-5)
+
+# interleaved self-attention qk: projected q@k^T scaled
+_SA_Q = _u((3, 2, 12))  # (T, N, 3*H*D) with H=2, D=2: qkv packed
+case2("interleaved_matmul_selfatt_qk_shape",
+      lambda: mnp.array(
+          npx.interleaved_matmul_selfatt_qk(
+              mnp.array(_SA_Q), heads=2).shape, dtype="int64"),
+      onp.array([4, 3, 3], dtype="int64"))
+
+# --- linalg decompositions: verify by reconstruction, not by
+#     comparing factor conventions (ref test_numpy_op.py
+#     test_np_linalg_svd/qr/cholesky/eigh/inv/solve) ---
+_SQ = _u((4, 4)) + 4.0 * onp.eye(4, dtype="float32")
+_SPD = (_SQ @ _SQ.T).astype("float32")
+
+
+def _recon_svd():
+    u, s, vh = mnp.linalg.svd(mnp.array(_SQ))
+    return (u * s[..., None, :]) @ vh
+
+
+def _recon_qr():
+    q, r = mnp.linalg.qr(mnp.array(_SQ))
+    return q @ r
+
+
+def _recon_chol():
+    l = mnp.linalg.cholesky(mnp.array(_SPD))
+    return l @ l.T
+
+
+def _recon_eigh():
+    w, v = mnp.linalg.eigh(mnp.array(_SPD))
+    return (v * w[..., None, :]) @ v.T
+
+
+case2("linalg_svd_reconstruction", _recon_svd, _SQ, 1e-3, 1e-4)
+case2("linalg_qr_reconstruction", _recon_qr, _SQ, 1e-3, 1e-4)
+case2("linalg_cholesky_reconstruction", _recon_chol, _SPD, 1e-3, 1e-3)
+case2("linalg_eigh_reconstruction", _recon_eigh, _SPD, 1e-3, 1e-3)
+case2("linalg_inv",
+      lambda: mnp.linalg.inv(mnp.array(_SQ)) @ mnp.array(_SQ),
+      onp.eye(4, dtype="float32"), 1e-3, 1e-3)
+_RHS = _u((4, 2))
+case2("linalg_solve",
+      lambda: mnp.array(_SQ) @ mnp.linalg.solve(mnp.array(_SQ),
+                                                mnp.array(_RHS)),
+      _RHS, 1e-3, 1e-3)
+case2("linalg_lstsq",
+      lambda: mnp.linalg.lstsq(mnp.array(_SQ), mnp.array(_RHS),
+                               rcond=None)[0],
+      onp.linalg.lstsq(_SQ.astype("float64"),
+                       _RHS.astype("float64"), rcond=None)[0]
+      .astype("float32"), 1e-2, 1e-3)
+case2("linalg_pinv",
+      lambda: mnp.linalg.pinv(mnp.array(_SQ)) @ mnp.array(_SQ),
+      onp.eye(4, dtype="float32"), 1e-3, 1e-3)
+case2("linalg_eigvalsh",
+      lambda: mnp.linalg.eigvalsh(mnp.array(_SPD)),
+      onp.linalg.eigvalsh(_SPD.astype("float64")).astype("float32"),
+      1e-3, 1e-3)
+case2("linalg_tensorsolve",
+      lambda: mnp.linalg.tensorsolve(
+          mnp.array(_SQ.reshape(2, 2, 2, 2)),
+          mnp.array(_RHS[:, 0].reshape(2, 2))),
+      onp.linalg.tensorsolve(
+          _SQ.reshape(2, 2, 2, 2).astype("float64"),
+          _RHS[:, 0].reshape(2, 2).astype("float64")).astype("float32"),
+      1e-2, 1e-3)
+
+
+@pytest.mark.parametrize("thunk,expected,rtol,atol", CASES2)
+def test_operator_conformance_batch2(thunk, expected, rtol, atol):
+    out = thunk()
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    assert got.shape == onp.asarray(expected).shape, \
+        f"shape {got.shape} vs {onp.asarray(expected).shape}"
+    assert_almost_equal(got, onp.asarray(expected), rtol=rtol, atol=atol)
+
+
+def test_box_nms_suppresses_overlaps():
+    """box_nms keeps the higher-score box of an overlapping pair and
+    marks the suppressed one invalid (ref test_operator.py
+    test_box_nms: score/id/coords layout [score, x1, y1, x2, y2])."""
+    boxes = onp.array([[[0.9, 0.0, 0.0, 2.0, 2.0],
+                        [0.8, 0.1, 0.1, 2.1, 2.1],   # iou > 0.5 vs #0
+                        [0.7, 5.0, 5.0, 7.0, 7.0]]], dtype="float32")
+    out = npx.box_nms(mnp.array(boxes), overlap_thresh=0.5,
+                      coord_start=1, score_index=0).asnumpy()
+    scores = out[0, :, 0]
+    assert scores[0] == pytest.approx(0.9)
+    kept = scores[scores > 0]
+    assert len(kept) == 2 and pytest.approx(0.7) == sorted(kept)[0]
+
+
+# ---------------------------------------------------------------------------
+# Gradient sub-corpus: finite differences vs autograd for ops NOT in
+# tests/test_op_gradients.py (ref test_operator.py uses
+# check_numeric_gradient the same way)
+# ---------------------------------------------------------------------------
+from mxnet_tpu.test_utils import check_numeric_gradient  # noqa: E402
+
+_GX = _u((3, 4), -1.5, 1.5).astype("float64")
+# keep away from |x| = 1/sigma^2 kinks and 0
+_GSAFE = onp.where(onp.abs(_GX) < 0.2, _GX + 0.45, _GX)
+
+
+@pytest.mark.parametrize("name,f,inputs", [
+    ("smooth_l1",
+     lambda x: npx.smooth_l1(x, scalar=1.0), [_GSAFE * 3]),
+    ("silu", lambda x: npx.silu(x), [_GX]),
+    ("mish", lambda x: npx.mish(x), [_GX]),
+    ("batch_dot",
+     lambda a, b: npx.batch_dot(a, b),
+     [_u((2, 3, 4), dtype="float64"), _u((2, 4, 2), dtype="float64")]),
+    ("fully_connected",
+     lambda x, w, b: npx.fully_connected(x, w, b, num_hidden=5),
+     [_u((3, 4), dtype="float64"), _u((5, 4), dtype="float64"),
+      _u((5,), dtype="float64")]),
+    ("l2_normalization",
+     lambda x: npx.l2_normalization(x, mode="channel"),
+     [_u((2, 3, 4), dtype="float64", lo=0.5, hi=1.5)]),
+    ("sequence_mask",
+     lambda x: npx.sequence_mask(
+         x, mnp.array(LENS), use_sequence_length=True),
+     [_u((5, 3, 2), dtype="float64")]),
+    ("pick",
+     lambda x: npx.pick(
+         x, mnp.array(onp.array([1, 0, 3], dtype="int64"))),
+     [_u((3, 4), dtype="float64")]),
+    ("rms_norm",
+     lambda x: npx.rms_norm(x, mnp.array(onp.ones(4)), eps=1e-6),
+     [_u((2, 3, 4), dtype="float64", lo=0.5, hi=1.5)]),
+    ("masked_softmax",
+     lambda x: npx.masked_softmax(
+         x, mnp.array(onp.array([[1, 1, 0, 1], [1, 0, 1, 1],
+                                 [1, 1, 1, 1]], dtype=bool))),
+     [_u((3, 4), dtype="float64")]),
+])
+def test_gradient_conformance(name, f, inputs):
+    # float32 under jit (x64 off): eps near sqrt(eps_f32), tolerance to
+    # match — the convention tests/test_op_gradients.py documents
+    check_numeric_gradient(f, inputs, eps=2e-3, rtol=2e-2, atol=2e-3)
+
+
+def test_ctc_loss_gradient():
+    """CTC loss grads vs finite differences (ref test_operator.py
+    test_ctc_loss_grad)."""
+    T, N, V = 3, 2, 3
+    logits = _u((T, N, V), -1.0, 1.0).astype("float64")
+    labels = mnp.array(onp.array([[1, 2], [2, 0]], dtype="float32"))
+    check_numeric_gradient(
+        lambda x: npx.ctc_loss(x, labels), [logits],
+        eps=2e-3, rtol=3e-2, atol=3e-3)

@@ -106,6 +106,7 @@ def test_reference_metric_classes_all_accounted():
     assert not unknown, f"reference classes not in parity table: {unknown}"
 
 
+@pytest.mark.filterwarnings("ignore:.*transposed to.*:UserWarning")
 def test_nchw_checkpoint_loads_into_nhwc_conv():
     """Reference-written NCHW conv kernels (O,I,H,W) auto-transpose on
     load into an NHWC-layout model expecting (O,H,W,I) — the
@@ -129,3 +130,35 @@ def test_nchw_checkpoint_loads_into_nhwc_conv():
     diff = float(abs(ya.asnumpy().transpose(0, 2, 3, 1)
                      - yb.asnumpy()).max())
     assert diff < 1e-5, diff
+
+
+def test_nchw_transpose_only_on_tagged_conv_weights(tmp_path):
+    """The auto-transpose must NOT fire on arbitrary 4-d parameters
+    (only Conv2D channels-last weights are tagged), and the ambiguous
+    deferred case must raise with guidance instead of guessing."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+
+    # ambiguous: 3x3 kernel over 3 channels, in_channels deferred
+    a = nn.Conv2D(8, 3, layout="NCHW", in_channels=3)
+    a.initialize()
+    a(mx.np.random.uniform(size=(1, 3, 8, 8)))
+    p = str(tmp_path / "rgb.params")
+    a.save_parameters(p)
+    b = nn.Conv2D(8, 3, layout="NHWC")
+    with pytest.raises(ValueError, match="ambiguous"):
+        b.load_parameters(p)
+
+    # unambiguous deferred (in=4 != kernel 3) transposes correctly
+    c = nn.Conv2D(8, 3, layout="NCHW", in_channels=4)
+    c.initialize()
+    x = mx.np.random.uniform(size=(2, 4, 8, 8))
+    yc = c(x)
+    p2 = str(tmp_path / "c4.params")
+    c.save_parameters(p2)
+    d = nn.Conv2D(8, 3, layout="NHWC")
+    d.load_parameters(p2)
+    yd = d(x.transpose((0, 2, 3, 1)))
+    import numpy as onp
+    assert onp.abs(yc.asnumpy().transpose(0, 2, 3, 1)
+                   - yd.asnumpy()).max() < 1e-5

@@ -44,6 +44,53 @@ int MXTPUOptimizerCreate(const char* name, const char* kwargs_json,
                          int* out);
 int MXTPUOptimizerUpdate(int opt, int index, int weight_h, int grad_h);
 
+/* NDArray save/load in the reference's legacy binary format
+ * (parity: MXNDArraySave / MXNDArrayLoad, c_api.cc:1913,1961).
+ * names_json: JSON array of names ("[]" saves a nameless list).
+ * After Load, MXTPUNDArrayLoadNames yields the names as JSON. */
+int MXTPUNDArraySave(const char* fname, const int* handles, int n,
+                     const char* names_json);
+int MXTPUNDArrayLoad(const char* fname, int* out_handles, int max_out,
+                     int* n_out);
+int MXTPUNDArrayLoadNames(char* buf, int buflen);
+
+/* CachedOp: run an exported hybridized graph (-symbol.json [+
+ * -NNNN.params]) from C (parity: MXCreateCachedOp / MXInvokeCachedOp,
+ * src/imperative/cached_op.cc:776). Invoke records on the autograd
+ * tape while MXTPUAutogradSetIsRecording(1) is active, so a C host
+ * can also TRAIN the graph: get param handles, backward the loss,
+ * apply MXTPUOptimizerUpdate per param. */
+int MXTPUCachedOpCreate(const char* symbol_file,
+                        const char* input_names_json,
+                        const char* param_file, int* out);
+int MXTPUCachedOpInvoke(int op, const int* in_handles, int n_in,
+                        int* out_handles, int max_out, int* n_out);
+int MXTPUCachedOpParamNames(int op, char* buf, int buflen);
+int MXTPUCachedOpParamGet(int op, const char* name, int* out);
+int MXTPUCachedOpParamSet(int op, const char* name, int nd);
+int MXTPUCachedOpFree(int op);
+
+/* KVStore (parity: MXKVStoreCreate/Init/Push/Pull/SetOptimizer,
+ * c_api.cc:2971). Pull fills a caller-preallocated NDArray. With a
+ * set optimizer, push applies the update server-side (update-on-
+ * kvstore), and pull returns the updated weights. */
+int MXTPUKVStoreCreate(const char* kind, int* out);
+int MXTPUKVStoreInit(int kv, int key, int nd);
+int MXTPUKVStorePush(int kv, int key, int nd);
+int MXTPUKVStorePull(int kv, int key, int out_nd);
+int MXTPUKVStoreSetOptimizer(int kv, const char* name,
+                             const char* kwargs_json);
+int MXTPUKVStoreFree(int kv);
+
+/* DataIter (parity: MXDataIterCreateIter family): NDArrayIter batch
+ * feeder. Next returns 1 while batches remain (handles out), 0 at
+ * epoch end. */
+int MXTPUDataIterCreate(int data_nd, int label_nd, int batch_size,
+                        int shuffle, int* out);
+int MXTPUDataIterNext(int it, int* out_data, int* out_label);
+int MXTPUDataIterReset(int it);
+int MXTPUDataIterFree(int it);
+
 #ifdef __cplusplus
 }
 #endif

@@ -606,8 +606,16 @@ class HybridBlock(Block):
         jitted = jax.jit(lambda p, i: entry.fwd(key, p, i)[0])
         exported = jax_export.export(jitted)(pspecs, ins)
         mxir_file = f"{path}-symbol.mxir"
+        # vjp_order=1 ships the backward program too, so the imported
+        # artifact is fine-tunable (parity: the reference's imported
+        # SymbolBlock trains; see _ExportedBlock.forward). Integer or
+        # otherwise non-differentiable graphs fall back to fwd-only.
+        try:
+            blob = exported.serialize(vjp_order=1)
+        except Exception:  # noqa: BLE001 - fwd-only artifact still valid
+            blob = exported.serialize()
         with open(mxir_file, "wb") as f:
-            f.write(exported.serialize())
+            f.write(blob)
         hlo_file = f"{path}-symbol.stablehlo"
         with open(hlo_file, "w") as f:
             f.write(jitted.lower(pspecs, ins).as_text())

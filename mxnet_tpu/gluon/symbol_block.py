@@ -114,16 +114,36 @@ class _ExportedBlock(HybridBlock):
                 # no bf16); restore the program's expected dtype
                 if dt is not None and str(v.dtype) != dt:
                     v = NDArray(jnp.asarray(v._data, dt))
-                self._param_values.append(v)
+                # real Parameters: collect_params/Trainer work, and
+                # backward (below) deposits grads here — the imported
+                # artifact is fine-tunable like the reference's
+                # SymbolBlock (block.py:1638)
+                p = Parameter(n, allow_deferred_init=True, dtype=None)
+                p.set_data(v)
+                self._reg_params[n] = p
+                self._param_values.append(p)
         self._in_dtypes = manifest.get("input_dtypes")
+        self._vjp = None  # deserialized lazily on first backward
+
+    def _vjp_exported(self):
+        if self._vjp is None:
+            if not self._exported.has_vjp():
+                raise RuntimeError(
+                    "this exported artifact was serialized without a "
+                    "VJP (vjp_order=0); re-export with a current "
+                    "HybridBlock.export to fine-tune it")
+            self._vjp = self._exported.vjp()
+        return self._vjp
 
     def forward(self, *args):
         import jax.numpy as jnp
+        from .. import autograd
         datas = [a._data if isinstance(a, NDArray) else a for a in args]
         if self._in_dtypes:
             datas = [d if str(d.dtype) == dt else jnp.asarray(d, dt)
                      for d, dt in zip(datas, self._in_dtypes)]
-        pvals = [p._data for p in self._param_values]
+        pnds = [p.data() for p in self._param_values]
+        pvals = [p._data for p in pnds]
         outs = self._exported.call(tuple(pvals), tuple(datas))
         if isinstance(outs, tuple) and len(outs) == 2 and \
                 isinstance(outs[1], tuple) and not outs[1]:
@@ -131,4 +151,38 @@ class _ExportedBlock(HybridBlock):
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         nds = [NDArray(engine.track(o)) for o in outs]
+        if autograd.is_recording():
+            if not self._exported.has_vjp():
+                if not getattr(self, "_warned_no_vjp", False):
+                    self._warned_no_vjp = True
+                    import warnings
+                    warnings.warn(
+                        "this exported artifact was serialized without "
+                        "a VJP (vjp_order=0): forward under "
+                        "autograd.record() produces NO gradients, so "
+                        "training it is a silent no-op. Re-export with "
+                        "a current HybridBlock.export to fine-tune.",
+                        RuntimeWarning, stacklevel=2)
+                return nds[0] if len(nds) == 1 else tuple(nds)
+            # tape node over the exported program: the serialized VJP
+            # (vjp_order=1 at export) takes flat primals + output
+            # cotangents and returns flat input cotangents in primal
+            # order (params..., datas...)
+            blk = self
+            primal_flat = tuple(pvals) + tuple(datas)
+            nd_arg_pos = [i for i, a in enumerate(args)
+                          if isinstance(a, NDArray)]
+            nd_inputs = pnds + [args[i] for i in nd_arg_pos]
+            n_params = len(pvals)
+
+            def vjp_fn(cotangents):
+                in_cts = blk._vjp_exported().call(
+                    *primal_flat, *cotangents)
+                # keep only cotangents for NDArray inputs, preserving
+                # the params-then-data pairing of nd_inputs
+                return tuple(in_cts[:n_params]) + tuple(
+                    in_cts[n_params + i] for i in nd_arg_pos)
+
+            autograd._record("_ExportedBlock", None, vjp_fn,
+                             nd_inputs, nds)
         return nds[0] if len(nds) == 1 else tuple(nds)

@@ -16,6 +16,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -44,7 +45,11 @@ from mxnet_tpu.symbol._ops import op_table as _op_table
 
 _arrays = {}
 _updaters = {}
+_cachedops = {}
+_kvstores = {}
+_dataiters = {}
 _next = [1]
+_last_load_names = []
 
 
 def _new(obj, registry):
@@ -114,6 +119,136 @@ def optimizer_update(opt_h, index, weight_h, grad_h):
 
 def scalar(h):
     return float(_arrays[h].asnumpy().reshape(-1)[0])
+
+
+# ---- NDArray save/load (parity: MXNDArraySave c_api.cc:1913,
+# MXNDArrayLoad c_api.cc:1961; reference legacy binary format) ----
+
+def nd_save(fname, handles, names_json):
+    names = _json.loads(names_json) if names_json else []
+    arrs = [_arrays[h] for h in handles]
+    if names:
+        # reference MXNDArraySave: num_keys == 0 or == num_args
+        if len(names) != len(arrs):
+            raise ValueError(
+                f"nd_save: {len(names)} names for {len(arrs)} arrays")
+        if len(set(names)) != len(names):
+            raise ValueError("nd_save: duplicate names")
+    payload = dict(zip(names, arrs)) if names else arrs
+    from mxnet_tpu import legacy_serialization as _legacy
+    _legacy.save_legacy(fname, payload)
+
+
+def nd_load(fname):
+    loaded = _mx.nd.load(fname)
+    global _last_load_names
+    if isinstance(loaded, dict):
+        _last_load_names = list(loaded.keys())
+        arrs = list(loaded.values())
+    else:
+        _last_load_names = []
+        arrs = list(loaded)
+    return [_new(a, _arrays) for a in arrs]
+
+
+def nd_load_names():
+    return _json.dumps(_last_load_names)
+
+
+# ---- CachedOp (parity: MXCreateCachedOp / MXInvokeCachedOp,
+# src/imperative/cached_op.cc:776; here a hybridized SymbolBlock —
+# the exported-graph deployment path) ----
+
+def cachedop_create(symbol_file, input_names_json, param_file):
+    names = _json.loads(input_names_json)
+    blk = _mx.gluon.SymbolBlock.imports(
+        symbol_file, names, param_file or None)
+    blk.hybridize()
+    return _new(blk, _cachedops)
+
+
+def cachedop_invoke(h, handles):
+    out = _cachedops[h](*[_arrays[i] for i in handles])
+    if isinstance(out, (tuple, list)):
+        return [_new(o, _arrays) for o in out]
+    return [_new(out, _arrays)]
+
+
+def cachedop_param_names(h):
+    return _json.dumps(list(_cachedops[h].collect_params().keys()))
+
+
+def cachedop_param_get(h, name):
+    return _new(_cachedops[h].collect_params()[name].data(), _arrays)
+
+
+def cachedop_param_set(h, name, ah):
+    _cachedops[h].collect_params()[name].set_data(_arrays[ah])
+
+
+def cachedop_free(h):
+    _cachedops.pop(h, None)
+
+
+# ---- KVStore (parity: MXKVStoreCreate/Init/Push/Pull/SetOptimizer,
+# c_api.cc:2971) ----
+
+def kv_create(kind):
+    return _new(_mx.kvstore.create(kind), _kvstores)
+
+
+def kv_init(h, key, ah):
+    _kvstores[h].init(key, _arrays[ah])
+
+
+def kv_push(h, key, ah):
+    _kvstores[h].push(key, _arrays[ah])
+
+
+def kv_pull(h, key, out_h):
+    # caller preallocates the destination, like MXKVStorePull
+    _kvstores[h].pull(key, out=_arrays[out_h])
+
+
+def kv_set_optimizer(h, name, kwargs_json):
+    kwargs = _json.loads(kwargs_json) if kwargs_json else {}
+    _kvstores[h].set_optimizer(_mx.optimizer.create(name, **kwargs))
+
+
+def kv_free(h):
+    _kvstores.pop(h, None)
+
+
+# ---- DataIter (parity: MXDataIterCreateIter family, c_api.cc; an
+# NDArrayIter feeder so a C host can stream batches) ----
+
+def iter_create(data_h, label_h, batch_size, shuffle):
+    it = _mx.io.NDArrayIter(
+        _arrays[data_h], _arrays[label_h] if label_h else None,
+        batch_size=int(batch_size), shuffle=bool(shuffle))
+    return _new(it, _dataiters)
+
+
+def iter_next(h):
+    it = _dataiters[h]
+    try:
+        batch = next(it)
+    except StopIteration:
+        return []
+    data = batch.data[0]
+    label = batch.label[0] if batch.label else None
+    out = [_new(data, _arrays)]
+    if label is not None:
+        out.append(_new(label, _arrays))
+    return out
+
+
+def iter_reset(h):
+    _dataiters[h].reset()
+
+
+def iter_free(h):
+    _dataiters.pop(h, None)
 )PY";
 
 PyObject* g_helper = nullptr;
@@ -401,6 +536,319 @@ int MXTPUOptimizerUpdate(int opt, int index, int weight_h, int grad_h) {
   Py_XDECREF(r);
   PyGILState_Release(gs);
   return rc;
+}
+
+namespace {
+
+// boilerplate shared by the int-returning handle calls below
+int call_ret_handle(const char* where, PyObject* r, int* out) {
+  if (r) {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    return 0;
+  }
+  capture_py_error(where);
+  return -1;
+}
+
+int call_ret_void(const char* where, PyObject* r) {
+  if (!r) {
+    capture_py_error(where);
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int call_ret_handle_list(const char* where, PyObject* r,
+                         int* out_handles, int max_out, int* n_out) {
+  if (!r) {
+    capture_py_error(where);
+    return -1;
+  }
+  int n = static_cast<int>(PyList_Size(r));
+  if (n > max_out) {
+    // the arrays are already registered python-side: free them ALL so
+    // nothing leaks, then tell the caller how big a buffer to bring
+    PyObject* fn = helper_fn("nd_free");
+    for (int i = 0; i < n && fn; ++i) {
+      PyObject* res = PyObject_CallFunction(
+          fn, "l", PyLong_AsLong(PyList_GetItem(r, i)));
+      Py_XDECREF(res);
+    }
+    Py_XDECREF(fn);
+    Py_DECREF(r);
+    set_error(std::string(where) + ": needs room for " +
+              std::to_string(n) + " handles, got " +
+              std::to_string(max_out));
+    PyErr_Clear();
+    return -1;
+  }
+  *n_out = n;
+  for (int i = 0; i < n; ++i)
+    out_handles[i] = static_cast<int>(
+        PyLong_AsLong(PyList_GetItem(r, i)));
+  Py_DECREF(r);
+  return 0;
+}
+
+// copy a python str result into a caller buffer (NUL-terminated)
+int call_ret_str(const char* where, PyObject* r, char* buf, int len) {
+  if (!r) {
+    capture_py_error(where);
+    return -1;
+  }
+  const char* s = PyUnicode_AsUTF8(r);
+  if (!s || static_cast<int>(std::strlen(s)) >= len) {
+    set_error(std::string(where) + ": name buffer too small");
+    Py_DECREF(r);
+    PyErr_Clear();
+    return -1;
+  }
+  std::snprintf(buf, len, "%s", s);
+  Py_DECREF(r);
+  return 0;
+}
+
+PyObject* int_list(const int* hs, int n) {
+  PyObject* l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(hs[i]));
+  return l;
+}
+
+}  // namespace
+
+// ---- NDArray save/load (parity: MXNDArraySave c_api.cc:1913,
+// MXNDArrayLoad c_api.cc:1961) --------------------------------------
+// names_json: JSON array of names ("[]"/null saves a nameless list).
+int MXTPUNDArraySave(const char* fname, const int* handles, int n,
+                     const char* names_json) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* hs = int_list(handles, n);
+  PyObject* r = call("nd_save", "(sOs)", fname, hs,
+                     names_json ? names_json : "[]");
+  int rc = call_ret_void("MXTPUNDArraySave", r);
+  Py_XDECREF(hs);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// Loads a file; writes up to max_out handles. Fetch names afterwards
+// with MXTPUNDArrayLoadNames (JSON array; empty for nameless lists).
+int MXTPUNDArrayLoad(const char* fname, int* out_handles, int max_out,
+                     int* n_out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("nd_load", "(s)", fname);
+  int rc = call_ret_handle_list("MXTPUNDArrayLoad", r, out_handles,
+                                max_out, n_out);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUNDArrayLoadNames(char* buf, int buflen) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("nd_load_names", "()");
+  int rc = call_ret_str("MXTPUNDArrayLoadNames", r, buf, buflen);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// ---- CachedOp (parity: MXCreateCachedOp / MXInvokeCachedOp,
+// src/imperative/cached_op.cc:776) ----------------------------------
+// Creates a hybridized graph from an exported -symbol.json (+ params);
+// input_names_json e.g. "[\"data\"]".
+int MXTPUCachedOpCreate(const char* symbol_file,
+                        const char* input_names_json,
+                        const char* param_file, int* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("cachedop_create", "(sss)", symbol_file,
+                     input_names_json ? input_names_json : "[\"data\"]",
+                     param_file ? param_file : "");
+  int rc = call_ret_handle("MXTPUCachedOpCreate", r, out);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// Runs the graph (records on the autograd tape when
+// MXTPUAutogradSetIsRecording(1) is active, so backward works).
+int MXTPUCachedOpInvoke(int op, const int* in_handles, int n_in,
+                        int* out_handles, int max_out, int* n_out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* hs = int_list(in_handles, n_in);
+  PyObject* r = call("cachedop_invoke", "(iO)", op, hs);
+  int rc = call_ret_handle_list("MXTPUCachedOpInvoke", r, out_handles,
+                                max_out, n_out);
+  Py_XDECREF(hs);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUCachedOpParamNames(int op, char* buf, int buflen) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("cachedop_param_names", "(i)", op);
+  int rc = call_ret_str("MXTPUCachedOpParamNames", r, buf, buflen);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUCachedOpParamGet(int op, const char* name, int* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("cachedop_param_get", "(is)", op, name);
+  int rc = call_ret_handle("MXTPUCachedOpParamGet", r, out);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUCachedOpParamSet(int op, const char* name, int nd) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("cachedop_param_set", "(isi)", op, name, nd);
+  int rc = call_ret_void("MXTPUCachedOpParamSet", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUCachedOpFree(int op) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("cachedop_free", "(i)", op);
+  Py_XDECREF(r);
+  PyGILState_Release(gs);
+  return 0;
+}
+
+// ---- KVStore (parity: MXKVStoreCreate/Init/Push/Pull/SetOptimizer,
+// c_api.cc:2971) ----------------------------------------------------
+int MXTPUKVStoreCreate(const char* kind, int* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_create", "(s)", kind ? kind : "local");
+  int rc = call_ret_handle("MXTPUKVStoreCreate", r, out);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUKVStoreInit(int kv, int key, int nd) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_init", "(iii)", kv, key, nd);
+  int rc = call_ret_void("MXTPUKVStoreInit", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUKVStorePush(int kv, int key, int nd) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_push", "(iii)", kv, key, nd);
+  int rc = call_ret_void("MXTPUKVStorePush", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// Pull into a caller-preallocated NDArray (reference semantics).
+int MXTPUKVStorePull(int kv, int key, int out_nd) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_pull", "(iii)", kv, key, out_nd);
+  int rc = call_ret_void("MXTPUKVStorePull", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUKVStoreSetOptimizer(int kv, const char* name,
+                             const char* kwargs_json) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_set_optimizer", "(iss)", kv, name,
+                     kwargs_json ? kwargs_json : "{}");
+  int rc = call_ret_void("MXTPUKVStoreSetOptimizer", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUKVStoreFree(int kv) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("kv_free", "(i)", kv);
+  Py_XDECREF(r);
+  PyGILState_Release(gs);
+  return 0;
+}
+
+// ---- DataIter (parity: MXDataIterCreateIter family) ---------------
+// NDArrayIter over device arrays; label_nd may be 0 for data-only.
+int MXTPUDataIterCreate(int data_nd, int label_nd, int batch_size,
+                        int shuffle, int* out) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("iter_create", "(iiii)", data_nd, label_nd,
+                     batch_size, shuffle);
+  int rc = call_ret_handle("MXTPUDataIterCreate", r, out);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+// Returns 1 and fills out_data/out_label while batches remain; 0 at
+// end of epoch (then MXTPUDataIterReset to go again).
+int MXTPUDataIterNext(int it, int* out_data, int* out_label) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int hs[2] = {0, 0};
+  int n = 0;
+  PyObject* r = call("iter_next", "(i)", it);
+  int rc = call_ret_handle_list("MXTPUDataIterNext", r, hs, 2, &n);
+  PyGILState_Release(gs);
+  if (rc != 0) return -1;
+  if (n == 0) return 0;
+  *out_data = hs[0];
+  if (out_label) *out_label = n > 1 ? hs[1] : 0;
+  return 1;
+}
+
+int MXTPUDataIterReset(int it) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("iter_reset", "(i)", it);
+  int rc = call_ret_void("MXTPUDataIterReset", r);
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUDataIterFree(int it) {
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  PyObject* r = call("iter_free", "(i)", it);
+  Py_XDECREF(r);
+  PyGILState_Release(gs);
+  return 0;
 }
 
 // convenience: first element of an array as a double (loss fetch)

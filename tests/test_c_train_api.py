@@ -55,21 +55,7 @@ def test_cpp_training_converges(built):
 @pytest.fixture(scope="module")
 def built_api(tmp_path_factory, built):
     """Build the typed-C++-API variant against the same lib."""
-    d = os.path.dirname(built)
-    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
-    exe = os.path.join(d, "train_mlp_api")
-    r = subprocess.run(
-        ["g++", "-O2", "-std=c++17",
-         os.path.join(ROOT, "cpp-package", "example",
-                      "train_mlp_api.cc"),
-         "-o", exe,
-         f"-I{os.path.join(ROOT, 'cpp-package', 'include')}",
-         f"-L{d}", "-lmxtpu_train", f"-Wl,-rpath,{d}",
-         f"-Wl,-rpath,{libdir}"],
-        capture_output=True, text=True)
-    if r.returncode != 0:
-        pytest.skip(f"typed API build failed: {r.stderr[:300]}")
-    return exe
+    return _build_example("train_mlp_api.cc", "train_mlp_api", built)
 
 
 def test_cpp_typed_api_training_converges(built_api):
@@ -92,3 +78,77 @@ def test_generated_ops_header_is_current():
          os.path.join(ROOT, "scripts", "gen_cpp_ops.py"), "--check"],
         capture_output=True, text=True, timeout=300)
     assert r.returncode == 0, r.stdout + r.stderr
+
+
+def _build_example(src_name, exe_name, built):
+    d = os.path.dirname(built)
+    libdir = sysconfig.get_config_var("LIBDIR") or "/usr/local/lib"
+    exe = os.path.join(d, exe_name)
+    r = subprocess.run(
+        ["g++", "-O2", "-std=c++17",
+         os.path.join(ROOT, "cpp-package", "example", src_name),
+         "-o", exe,
+         f"-I{os.path.join(ROOT, 'cpp-package', 'include')}",
+         f"-L{d}", "-lmxtpu_train", f"-Wl,-rpath,{d}",
+         f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"{src_name} build failed: {r.stderr[:300]}")
+    return exe
+
+
+def test_cpp_cnn_full_lifecycle(built, tmp_path):
+    """train a CNN -> checkpoint (legacy binary) -> reload -> evaluate,
+    all from C++, with DataIter batching and KVStore update-on-push
+    (round-4 VERDICT task #4 done-criterion)."""
+    exe = _build_example("train_cnn_full.cc", "train_cnn_full", built)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run([exe], env=env, capture_output=True, text=True,
+                       timeout=600, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CNN_FULL_OK" in r.stdout, r.stdout
+
+
+def test_cpp_cachedop_deploy_matches_python(built, tmp_path):
+    """Export a hybridized net from Python; C++ loads it via the
+    CachedOp API, reproduces Python's logits bit-for-bit (same
+    StableHLO program), then fine-tunes it one step (parity:
+    MXCreateCachedOp/MXInvokeCachedOp, cached_op.cc:776)."""
+    exe = _build_example("cachedop_deploy.cc", "cachedop_deploy", built)
+    export_script = (
+        "import numpy as onp\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu.gluon import nn\n"
+        "net = nn.HybridSequential()\n"
+        "net.add(nn.Dense(8, activation='relu'), nn.Dense(3))\n"
+        "net.initialize(); net.hybridize()\n"
+        "x = mx.np.array((onp.arange(12).reshape(4, 3) * 0.1)"
+        ".astype('float32'))\n"
+        "y = net(x)\n"
+        "net.export('model')\n"
+        "print('PYLOGITS', ' '.join('%.6f' % v for v in "
+        "y.asnumpy()[0]))\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    rp = subprocess.run([sys.executable, "-c", export_script], env=env,
+                        capture_output=True, text=True, timeout=300,
+                        cwd=str(tmp_path))
+    assert rp.returncode == 0, rp.stdout + rp.stderr
+    py_logits = [float(v) for v in
+                 rp.stdout.split("PYLOGITS", 1)[1].split()]
+
+    r = subprocess.run(
+        [exe, str(tmp_path / "model-symbol.json"),
+         str(tmp_path / "model-0000.params")],
+        env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "CACHEDOP_OK" in r.stdout, r.stdout
+    line = [l for l in r.stdout.splitlines()
+            if l.startswith("logits0")][0]
+    c_logits = [float(v) for v in line.split()[1:]]
+    assert len(c_logits) == len(py_logits)
+    for a, b in zip(c_logits, py_logits):
+        assert abs(a - b) < 1e-5, (c_logits, py_logits)

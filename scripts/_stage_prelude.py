@@ -1,0 +1,38 @@
+"""Shared startup for staged-bench stage scripts (tpu_stage_*.py).
+
+One home for the platform/cache wiring so the stages cannot diverge:
+persistent XLA compilation cache (a compile paid in one tunnel window
+is a cache hit in the next), optional platform pin for local smoke
+runs (the supervisor strips MXTPU_PLATFORM/JAX_PLATFORMS from child
+envs — stages run on the TPU), and the timed backend-init probe.
+"""
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def init_stage():
+    """Returns (jax, devices, init_seconds)."""
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(REPO, "bench_runs", "xla_cache"))
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+
+    import jax
+
+    req = (os.environ.get("MXTPU_PLATFORM")
+           or os.environ.get("JAX_PLATFORMS"))
+    if req:  # local smoke runs only; supervisor children have neither
+        jax.config.update("jax_platforms", req)
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.environ["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:  # pragma: no cover - older jax
+        pass
+
+    t0 = time.time()
+    devs = jax.devices()
+    return jax, devs, time.time() - t0

@@ -9,34 +9,20 @@ chip's nominal peak. This is the cheapest possible real-FLOPs datapoint
 """
 import json
 import os
+import sys
 import time
 
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
-                      os.path.join(_REPO, "bench_runs", "xla_cache"))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import REPO as _REPO, init_stage  # noqa: E402
 
-import sys  # noqa: E402
-
-sys.path.insert(0, _REPO)
-
-import jax  # noqa: E402
-
-try:
-    jax.config.update("jax_compilation_cache_dir",
-                      os.environ["JAX_COMPILATION_CACHE_DIR"])
-except Exception:
-    pass
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
 
 import jax.numpy as jnp  # noqa: E402
 import numpy as onp  # noqa: E402
 
 from bench import _peak_flops  # noqa: E402
-
-t0 = time.time()
-devs = jax.devices()
-init_s = time.time() - t0
-kind = devs[0].device_kind
-platform = devs[0].platform
 
 N = int(os.environ.get("MATMUL_N", "8192"))
 LO, HI = 4, 36

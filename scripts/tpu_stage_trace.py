@@ -1,0 +1,69 @@
+"""Staged-bench trace stage: Xprof-profile ~20 ResNet-18 train steps
+on the TPU (round-4 VERDICT task #2: "capture one Xprof trace of ~20
+steps and attach the breakdown"). Small model + small images = small
+compile, so this fits a short tunnel window; the trace directory is
+the millisecond-level evidence for where step time goes when MFU is
+under target. Prints ONE JSON line with the trace path + measured
+step time.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import REPO as _REPO, init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, parallel, profiler  # noqa: E402
+
+n_dev = jax.local_device_count()
+mesh = parallel.make_mesh((n_dev,), ("dp",))
+parallel.set_mesh(mesh)
+
+net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
+net.initialize()
+net.cast("bfloat16")
+step = parallel.TrainStep(
+    net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+    optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                      "multi_precision": True},
+    mesh=mesh, batch_axis="dp")
+
+batch = int(os.environ.get("TRACE_BATCH", "64")) * n_dev
+hw = int(os.environ.get("TRACE_HW", "32"))
+data = mx.np.random.uniform(size=(batch, hw, hw, 3), dtype="bfloat16")
+label = mx.np.zeros((batch,), dtype="int32")
+
+t0 = time.time()
+float(step(data, label).asnumpy())  # compile + first step
+compile_s = time.time() - t0
+
+trace_dir = os.path.join(_REPO, "bench_runs", "r5",
+                         f"xprof_{platform}")
+profiler.set_config(filename=os.path.join(trace_dir, "trace.json"))
+profiler.start()
+t0 = time.perf_counter()
+N = int(os.environ.get("TRACE_STEPS", "20"))
+for _ in range(N):
+    loss = step(data, label)
+float(loss.asnumpy())  # fetch = the only real sync on the tunnel
+steps_s = time.perf_counter() - t0
+profiler.stop()
+
+print(json.dumps({
+    "metric": "resnet18_traced_step_ms",
+    "value": round(steps_s / N * 1e3, 2),
+    "unit": "ms/step",
+    "n_steps": N,
+    "batch": batch,
+    "init_s": round(init_s, 2),
+    "compile_s": round(compile_s, 2),
+    "trace_dir": trace_dir,
+    "platform": platform,
+    "device_kind": kind,
+}), flush=True)

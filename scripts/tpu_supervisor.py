@@ -12,8 +12,10 @@ is shaped to exploit exactly that:
   its own hard-timeout child that prints JSON immediately:
       matmul   — sustained-TFLOPs / MFU calibration (seconds)
       resnet18 — small train step, small compile (bench.py small mode)
+      trace    — Xprof of ~20 resnet18 steps (step-time attribution)
       resnet50 — full synthetic + bulk + loader phases (bench.py)
-      opperf   — per-op TPU latencies (benchmark/opperf.py, top ops)
+      opperf   — per-op TPU latencies (benchmark/opperf.py, top ops,
+                 --resume accumulates across windows)
 - Every child shares a persistent XLA compilation cache
   (bench_runs/xla_cache): a remote compile paid in one window is free
   in the next, so a later 2-minute window CAN fit a previously
@@ -49,11 +51,16 @@ IDLE_PERIOD_S = int(os.environ.get("SUP_IDLE_PERIOD", "600"))
 
 PY = sys.executable
 
+# stages whose headline metric improves downward (ms/step)
+LOWER_IS_BETTER = {"trace"}
+
 STAGES = [
     # (name, argv, timeout_s)
     ("matmul", [PY, os.path.join(REPO, "scripts", "tpu_stage_matmul.py")],
      240),
     ("resnet18", [PY, os.path.join(REPO, "bench.py")], 420),
+    ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
+     420),
     ("resnet50", [PY, os.path.join(REPO, "bench.py")], 900),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),
                 "--platform", "tpu", "--runs", "5", "--warmup", "1",
@@ -87,7 +94,10 @@ def run_child(argv, timeout_s, extra_env=None, log_name=None):
     """
     env = dict(os.environ)
     env["JAX_COMPILATION_CACHE_DIR"] = CACHE_DIR
-    env.pop("JAX_PLATFORMS", None)  # we want the TPU
+    # we want the TPU: strip every platform pin an operator shell may
+    # export (stage scripts honor MXTPU_PLATFORM above JAX_PLATFORMS)
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("MXTPU_PLATFORM", None)
     if extra_env:
         env.update(extra_env)
     rc, out, err, timed_out = run_group_bounded(argv, timeout_s,
@@ -185,7 +195,9 @@ def main():
                     prev = best.get(name)
                     new_v = parsed.get("value") or 0
                     prev_v = (prev or {}).get("value") or 0
-                    if prev is None or new_v >= prev_v:
+                    better = (new_v <= prev_v if name in LOWER_IS_BETTER
+                              else new_v >= prev_v)
+                    if prev is None or better:
                         parsed["_captured_at"] = time.strftime(
                             "%Y-%m-%dT%H:%M:%S")
                         best[name] = parsed

@@ -31,10 +31,20 @@ from ..base import resolve_dtype
 from ..context import Context, current_context
 
 
+def _coerce_index_dtype(arr):
+    """Float index arrays truncate to int (reference parity: the
+    mx.np default dtype is float32, so `a[np.array([0, 2])]` arrives
+    float and the reference accepts it — for reads AND writes)."""
+    if jnp.issubdtype(arr.dtype, jnp.inexact):
+        return arr.astype(jnp.int64 if jax.config.jax_enable_x64
+                          else jnp.int32)
+    return arr
+
+
 def _to_jax_index(key):
     """Convert an index expression possibly containing NDArrays."""
     if isinstance(key, NDArray):
-        return key._data
+        return _coerce_index_dtype(key._data)
     if isinstance(key, tuple):
         return tuple(_to_jax_index(k) for k in key)
     if isinstance(key, list):
@@ -329,9 +339,10 @@ class NDArray:
         def do_index(x, *keys):
             kit = iter(keys)
             if isinstance(key, NDArray):
-                k = next(kit)
+                k = _coerce_index_dtype(next(kit))
             elif isinstance(key, tuple):
-                k = tuple(next(kit) if isinstance(kk, NDArray) else kk
+                k = tuple(_coerce_index_dtype(next(kit))
+                          if isinstance(kk, NDArray) else kk
                           for kk in key)
             else:
                 k = key

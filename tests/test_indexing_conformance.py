@@ -1,0 +1,184 @@
+"""Indexing / assignment / dtype-promotion conformance (derived from
+the reference's test_numpy_op.py + test_numpy_interoperability.py
+indexing suites: basic, advanced, boolean, ellipsis/newaxis, setitem
+forms, take modes, promotion rules).
+
+The reference's mx.np.array defaults to float32 — so integer lists
+become FLOAT index arrays; the reference accepts them for advanced
+indexing. These tests pin that tolerance plus the numpy-identical
+behaviors around it.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+
+A = onp.arange(24.0, dtype="float32").reshape(2, 3, 4)
+
+
+def _mx():
+    return mnp.array(A)
+
+
+GET_CASES = [
+    ("int", lambda a: a[1]),
+    ("int_int", lambda a: a[1, 2]),
+    ("neg_int", lambda a: a[-1]),
+    ("slice", lambda a: a[0:2]),
+    ("slice_step", lambda a: a[::2]),
+    ("neg_step", lambda a: a[::-1]),
+    ("neg_step_axis1", lambda a: a[:, ::-1]),
+    ("ellipsis", lambda a: a[..., 1]),
+    ("ellipsis_mid", lambda a: a[0, ..., 2]),
+    ("newaxis", lambda a: a[:, None]),
+    ("newaxis_end", lambda a: a[..., None]),
+    ("mixed", lambda a: a[1, 0:2, ::2]),
+    ("full_slice", lambda a: a[:]),
+]
+
+
+@pytest.mark.parametrize("name,fn", GET_CASES)
+def test_basic_getitem(name, fn):
+    got = fn(_mx()).asnumpy()
+    want = fn(A)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    onp.testing.assert_allclose(got, want)
+
+
+def test_advanced_getitem_int_arrays():
+    idx0 = mnp.array([0, 1])          # float32 by mx default — must work
+    idx1 = mnp.array([2, 0])
+    got = _mx()[idx0, idx1].asnumpy()
+    onp.testing.assert_allclose(got, A[[0, 1], [2, 0]])
+
+
+def test_advanced_getitem_single_array():
+    got = _mx()[mnp.array([1, 0, 1])].asnumpy()
+    onp.testing.assert_allclose(got, A[[1, 0, 1]])
+
+
+def test_advanced_getitem_int64_arrays():
+    idx = mnp.array([1, 0], dtype="int64")
+    onp.testing.assert_allclose(_mx()[idx].asnumpy(), A[[1, 0]])
+
+
+def test_boolean_getitem():
+    m = A.sum(axis=(1, 2)) > 60
+    got = _mx()[mnp.array(m)].asnumpy()
+    onp.testing.assert_allclose(got, A[m])
+
+
+def test_boolean_getitem_elementwise():
+    a = mnp.array(A)
+    got = a[a > 12.0].asnumpy()
+    onp.testing.assert_allclose(sorted(got.tolist()),
+                                sorted(A[A > 12.0].tolist()))
+
+
+SET_CASES = [
+    ("scalar_elem", lambda a, v: a.__setitem__((1, 2, 3), -5.0),
+     lambda n: n.__setitem__((1, 2, 3), -5.0)),
+    ("row", lambda a, v: a.__setitem__(0, v),
+     lambda n: n.__setitem__(0, onp.full((3, 4), 7.0, "float32"))),
+    ("col_scalar", lambda a, v: a.__setitem__((slice(None), 1), 0.0),
+     lambda n: n.__setitem__((slice(None), 1), 0.0)),
+    ("slice_bcast", lambda a, v: a.__setitem__(slice(0, 1), 2.5),
+     lambda n: n.__setitem__(slice(0, 1), 2.5)),
+    ("neg_index", lambda a, v: a.__setitem__(-1, 9.0),
+     lambda n: n.__setitem__(-1, 9.0)),
+]
+
+
+@pytest.mark.parametrize("name,mset,nset", SET_CASES)
+def test_setitem_forms(name, mset, nset):
+    a = _mx()
+    mset(a, mnp.array(onp.full((3, 4), 7.0, "float32")))
+    n = A.copy()
+    nset(n)
+    onp.testing.assert_allclose(a.asnumpy(), n)
+
+
+def test_boolean_mask_setitem():
+    a = _mx()
+    a[a > 12.0] = 1.0
+    n = A.copy()
+    n[n > 12.0] = 1.0
+    onp.testing.assert_allclose(a.asnumpy(), n)
+
+
+def test_take_modes():
+    b = mnp.array(onp.arange(6.0, dtype="float32"))
+    idx = mnp.array([7, -9, 3], dtype="int64")
+    onp.testing.assert_allclose(
+        mnp.take(b, idx, mode="clip").asnumpy(),
+        onp.take(onp.arange(6.0), [7, -9, 3], mode="clip"))
+    onp.testing.assert_allclose(
+        mnp.take(b, mnp.array([7, -1, 3], dtype="int64"),
+                 mode="wrap").asnumpy(),
+        onp.take(onp.arange(6.0), [7, -1, 3], mode="wrap"))
+
+
+PROMOTION_CASES = [
+    ("int32+float32", "int32", "float32", "float32"),
+    ("int8+int32", "int8", "int32", "int32"),
+    ("float16+float32", "float16", "float32", "float32"),
+    ("uint8+int8", "uint8", "int8", "int16"),
+    ("int32+int64", "int32", "int64", "int64"),
+    ("float32+float64", "float32", "float64", "float64"),
+]
+
+
+@pytest.mark.parametrize("name,d1,d2,want", PROMOTION_CASES)
+def test_dtype_promotion(name, d1, d2, want):
+    # numpy's promotion table — the reference follows it for np ops
+    got = (mnp.array([1], dtype=d1) + mnp.array([1], dtype=d2)).dtype
+    import jax
+    if not jax.config.jax_enable_x64 and want in ("int64", "float64"):
+        want = {"int64": "int32", "float64": "float32"}[want]
+    assert str(got) == want, (name, str(got))
+
+
+def test_scalar_promotion_preserves_array_dtype():
+    # python scalar + array keeps the array dtype (weak typing),
+    # matching the reference's scalar-op behavior
+    a = mnp.array([1, 2], dtype="float16")
+    assert str((a + 1).dtype) == "float16"
+    assert str((a * 2.0).dtype) == "float16"
+    b = mnp.array([1, 2], dtype="int32")
+    assert str((b + 1).dtype) == "int32"
+
+
+def test_getitem_is_differentiable():
+    from mxnet_tpu import autograd
+    a = mnp.array(A)
+    a.attach_grad()
+    with autograd.record():
+        y = (a[1, ::2] ** 2).sum()
+    y.backward()
+    g = a.grad.asnumpy()
+    want = onp.zeros_like(A)
+    want[1, ::2] = 2 * A[1, ::2]
+    onp.testing.assert_allclose(g, want)
+
+
+def test_advanced_getitem_is_differentiable():
+    from mxnet_tpu import autograd
+    a = mnp.array(A)
+    a.attach_grad()
+    idx = mnp.array([1, 0])
+    with autograd.record():
+        y = a[idx].sum()
+    y.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.ones_like(A))
+
+
+def test_float_index_setitem():
+    """Float index arrays (mx.np default dtype) must work for WRITES
+    too, not just reads."""
+    a = _mx()
+    idx = mnp.array([0, 1])          # float32 by default
+    a[idx] = 1.0
+    n = A.copy()
+    n[[0, 1]] = 1.0
+    onp.testing.assert_allclose(a.asnumpy(), n)

@@ -121,12 +121,19 @@ def deconvolution(x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
         hi = k - 1 - pad[i] + adj[i]
         pads.append((lo, hi))
     lhs, rhs, out = _conv_dims(layout)
-    # conv_transpose wants IO spatial weight; reference deconv weight is
-    # (in, out/g, *k) which matches "IO" + spatial.
+    # Reference Deconvolution is the GRADIENT of its Convolution
+    # (which is cross-correlation): each input pixel scatters w[k]
+    # UNflipped (deconvolution.cc). lax.conv_transpose without
+    # transpose_kernel applies correlation on the dilated input — the
+    # flipped-kernel scatter — so use transpose_kernel=True, which
+    # flips the spatial axes AND swaps the kernel's I/O labels: the
+    # reference weight (in, out/g, *k) is therefore declared "OI" +
+    # spatial here. Pinned by tests/test_operator_conformance.py::
+    # test_deconvolution_inverts_stride2_shape.
     if layout.startswith("NC"):
-        rhs_spec = "IO" + rhs[2:]
+        rhs_spec = "OI" + rhs[2:]
     else:
-        rhs_spec = "I" + rhs[1:-1] + "O"
+        rhs_spec = "O" + rhs[1:-1] + "I"
     dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs, rhs_spec, out))
     if num_group != 1:
         # grouped deconv: split channels, run per group, concat
@@ -135,13 +142,13 @@ def deconvolution(x, weight, bias=None, stride=1, dilate=1, pad=0, adj=0,
         ws = jnp.split(weight, num_group, axis=0)
         ys = [lax.conv_transpose(xg, wg, strides=stride, padding=pads,
                                  rhs_dilation=dilate, dimension_numbers=dn,
-                                 transpose_kernel=False)
+                                 transpose_kernel=True)
               for xg, wg in zip(xs, ws)]
         y = jnp.concatenate(ys, axis=cax)
     else:
         y = lax.conv_transpose(x, weight, strides=stride, padding=pads,
                                rhs_dilation=dilate, dimension_numbers=dn,
-                               transpose_kernel=False)
+                               transpose_kernel=True)
     if bias is not None:
         if layout.startswith("NC"):
             y = y + bias.reshape((1, -1) + (1,) * nsp)

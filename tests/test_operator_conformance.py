@@ -902,3 +902,179 @@ def test_ctc_loss_gradient():
     check_numeric_gradient(
         lambda x: npx.ctc_loss(x, labels), [logits],
         eps=2e-3, rtol=3e-2, atol=3e-3)
+
+
+# ---------------------------------------------------------------------------
+# Batch 4: control flow, attention, deconvolution, resize, im2col
+# (ref test_operator.py test_deconvolution/test_correlation/
+#  test_bilinear_resize/..., tests/python/unittest/test_contrib_control_flow.py)
+# ---------------------------------------------------------------------------
+
+def test_foreach_cumulative_sum():
+    """npx.foreach scans the body over axis 0 carrying states (ref
+    control-flow tests: foreach == python loop result)."""
+    xs = mnp.array(_u((5, 3)))
+
+    def body(x, states):
+        acc = states[0] + x
+        return acc * 1.0, [acc]
+
+    outs, final = npx.foreach(body, xs, [mnp.array(onp.zeros(3, "f"))])
+    want = onp.cumsum(xs.asnumpy(), axis=0)
+    assert_almost_equal(outs.asnumpy(), want, rtol=1e-5, atol=1e-6)
+    assert_almost_equal(final[0].asnumpy(), want[-1], rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_while_loop_matches_python():
+    def cond(state):
+        i, _ = state
+        return i < 5
+
+    def func(state):
+        i, acc = state
+        return None, (i + 1, acc * 2.0)
+
+    _, (i, acc) = npx.while_loop(
+        cond, func,
+        (mnp.array(0, dtype="int32"), mnp.array(1.0)),
+        max_iterations=10)
+    assert int(i.item()) == 5
+    assert float(acc.item()) == 32.0
+
+
+def test_cond_selects_branch():
+    x = mnp.array(3.0)
+    out = npx.cond(x < 5.0, lambda: x * 2.0, lambda: x - 1.0)
+    assert float(out.item()) == 6.0
+    out = npx.cond(x > 5.0, lambda: x * 2.0, lambda: x - 1.0)
+    assert float(out.item()) == 2.0
+
+
+def test_interleaved_selfatt_matches_manual():
+    """interleaved_matmul_selfatt_{qk,valatt} vs a manual attention
+    computation over the packed qkv layout (ref test_operator.py
+    test_multihead_attention_selfatt)."""
+    T, N, H, D = 4, 2, 2, 3
+    qkv = _u((T, N, 3 * H * D))
+    scores = npx.interleaved_matmul_selfatt_qk(mnp.array(qkv), heads=H)
+    att = npx.softmax(scores, axis=-1)
+    out = npx.interleaved_matmul_selfatt_valatt(
+        mnp.array(qkv), att, heads=H)
+
+    # manual: unpack (T, N, H, 3, D) per the reference's interleaved
+    # projection layout [q1 k1 v1 q2 k2 v2 ...] per head
+    packed = qkv.reshape(T, N, H, 3 * D)
+    q, k, v = (packed[..., :D], packed[..., D:2 * D],
+               packed[..., 2 * D:])
+    q = q.transpose(1, 2, 0, 3).reshape(N * H, T, D)  # (N*H, T, D)
+    k = k.transpose(1, 2, 0, 3).reshape(N * H, T, D)
+    v = v.transpose(1, 2, 0, 3).reshape(N * H, T, D)
+    man_scores = onp.einsum("bid,bjd->bij", q, k) / onp.sqrt(D)
+    assert_almost_equal(scores.asnumpy(), man_scores.astype("f"),
+                        rtol=1e-4, atol=1e-5)
+    man_att = np_softmax(man_scores, axis=-1)
+    man_out = onp.einsum("bij,bjd->bid", man_att, v)  # (N*H, T, D)
+    man_out = man_out.reshape(N, H, T, D).transpose(2, 0, 1, 3) \
+        .reshape(T, N, H * D)
+    assert_almost_equal(out.asnumpy(), man_out.astype("f"),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_deconvolution_inverts_stride2_shape():
+    """Deconvolution (transposed conv) vs an explicit upsample-and-
+    correlate construction for a 1-channel stride-2 case (ref
+    test_operator.py test_deconvolution forward)."""
+    x = _u((1, 1, 3, 3))
+    w = _u((1, 1, 2, 2))
+    out = npx.deconvolution(mnp.array(x), mnp.array(w), kernel=(2, 2),
+                            stride=(2, 2), num_filter=1)
+    # transposed conv: scatter each input pixel scaled by the kernel
+    want = onp.zeros((1, 1, 6, 6), "float32")
+    for i in range(3):
+        for j in range(3):
+            want[0, 0, 2 * i:2 * i + 2, 2 * j:2 * j + 2] += \
+                x[0, 0, i, j] * w[0, 0]
+    assert_almost_equal(out.asnumpy(), want, rtol=1e-4, atol=1e-5)
+
+
+def test_bilinear_resize2d_half_pixel_exact():
+    """3x3 -> 5x5 upsample vs an EXACT half-pixel-centers bilinear
+    computation (discriminates the convention: align_corners=True
+    would produce different interior values for this size)."""
+    x = _u((1, 1, 3, 3), 0.0, 1.0)
+    out = npx.bilinear_resize2d(mnp.array(x), height=5, width=5) \
+        .asnumpy()
+
+    def interp1d(row, n_out):
+        n_in = row.shape[0]
+        scale = n_in / n_out
+        vals = []
+        for i in range(n_out):
+            s = (i + 0.5) * scale - 0.5
+            s0 = int(onp.floor(s))
+            t = s - s0
+            lo = min(max(s0, 0), n_in - 1)
+            hi = min(max(s0 + 1, 0), n_in - 1)
+            vals.append(row[lo] * (1 - t) + row[hi] * t)
+        return onp.array(vals, dtype="float64")
+
+    want = onp.stack([interp1d(r, 5) for r in x[0, 0]])      # rows: W
+    want = onp.stack([interp1d(c, 5) for c in want.T]).T     # cols: H
+    assert_almost_equal(out[0, 0], want.astype("f"), rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_roi_align_integer_aligned():
+    """roi_align with aligned integer bins == exact average pooling
+    (sample_ratio=2 samples the integer pixel centers of each 2x2 bin,
+    whole-image roi; ref test_operator.py test_roi_align value
+    checks)."""
+    x = _u((1, 1, 4, 4))
+    rois = onp.array([[0, 0.0, 0.0, 4.0, 4.0]], dtype="float32")
+    out = npx.roi_align(mnp.array(x), mnp.array(rois),
+                        pooled_size=(2, 2), spatial_scale=1.0,
+                        sample_ratio=2, aligned=True).asnumpy()
+    want = np_pool2d(x, (2, 2), (2, 2), (0, 0), "avg")
+    assert_almost_equal(out, want, rtol=1e-3, atol=1e-3)
+
+
+def test_im2col_col2im_roundtrip():
+    """col2im(im2col(x)) with non-overlapping patches reconstructs x
+    (ref test_operator.py test_im2col_col2im)."""
+    x = _u((2, 3, 6, 6))
+    cols = npx.im2col(mnp.array(x), kernel=(2, 2), stride=(2, 2))
+    back = npx.col2im(cols, output_size=(6, 6), kernel=(2, 2),
+                      stride=(2, 2))
+    assert_almost_equal(back.asnumpy(), x, rtol=1e-5, atol=1e-6)
+
+
+def test_correlation_identity_displacement0():
+    """correlation with max_displacement=0 reduces to the mean over
+    channels of the elementwise product (ref test_operator.py
+    test_correlation)."""
+    a, b = _u((1, 3, 4, 4)), _u((1, 3, 4, 4))
+    out = npx.correlation(mnp.array(a), mnp.array(b), kernel_size=1,
+                          max_displacement=0, stride1=1, stride2=1,
+                          pad_size=0, is_multiply=True).asnumpy()
+    want = (a * b).mean(axis=1, keepdims=True)
+    assert_almost_equal(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_random_moments_sane():
+    """np.random distributions: mean/var within tolerance of theory
+    (ref test_numpy_op.py random tests assert the same moments)."""
+    mnp.random.seed(7)
+    n = 200_000
+    u = mnp.random.uniform(size=(n,)).asnumpy()
+    assert abs(u.mean() - 0.5) < 0.01 and abs(u.var() - 1 / 12) < 0.01
+    g = mnp.random.normal(2.0, 3.0, size=(n,)).asnumpy()
+    assert abs(g.mean() - 2.0) < 0.05 and abs(g.std() - 3.0) < 0.05
+    p = mnp.random.poisson(4.0, size=(n,)).asnumpy()
+    assert abs(p.mean() - 4.0) < 0.05 and abs(p.var() - 4.0) < 0.2
+    b = mnp.random.binomial(10, 0.3, size=(n,)).asnumpy()
+    assert abs(b.mean() - 3.0) < 0.05
+    e = mnp.random.exponential(2.0, size=(n,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.05
+    gm = mnp.random.gamma(3.0, 2.0, size=(n,)).asnumpy()
+    assert abs(gm.mean() - 6.0) < 0.1

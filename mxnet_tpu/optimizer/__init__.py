@@ -145,6 +145,11 @@ class Optimizer:
             return (master, self.create_state(index, NDArray(master)))
         return self.create_state(index, weight)
 
+    def _migrate_state(self, state):
+        """Hook for adapting serialized states from an older layout
+        (Updater.set_states); default: unchanged."""
+        return state
+
     # -- hypers passed into the jitted step ----------------------------
     def _hyper(self, index):
         t = self._index_update_count.get(index, self.num_update)
@@ -322,7 +327,21 @@ class Adam(Optimizer):
 
 @register
 class AdamW(Adam):
-    """Adam with decoupled weight decay (parity: optimizer/adamw.py)."""
+    """Adam with decoupled weight decay (parity: optimizer/adamW.py —
+    the reference applies the wd term with the SAME bias-corrected lr,
+    to the already-updated weight)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.correct_bias = correct_bias
+
+    def _hyper(self, index):
+        h = super()._hyper(index)
+        # None/1.0 keeps the flag a static pytree leaf (AdaBelief trick)
+        h["correct"] = 1.0 if self.correct_bias else None
+        return h
 
     @staticmethod
     def _step(w, g, state, hyper):
@@ -331,11 +350,13 @@ class AdamW(Adam):
         b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * jnp.square(g)
-        coef1 = 1.0 - jnp.power(b1, t.astype(jnp.float32))
-        coef2 = 1.0 - jnp.power(b2, t.astype(jnp.float32))
-        lr_t = hyper["lr"] * jnp.sqrt(coef2) / coef1
-        return w - lr_t * m / (jnp.sqrt(v) + hyper["eps"]) \
-            - hyper["lr"] * hyper["wd"] * w, (m, v)
+        lr_t = hyper["lr"]
+        if hyper.get("correct") is not None:
+            coef1 = 1.0 - jnp.power(b1, t.astype(jnp.float32))
+            coef2 = 1.0 - jnp.power(b2, t.astype(jnp.float32))
+            lr_t = lr_t * jnp.sqrt(coef2) / coef1
+        w = w - lr_t * m / (jnp.sqrt(v) + hyper["eps"])
+        return w - lr_t * hyper["wd"] * w, (m, v)
 
 
 @register
@@ -355,21 +376,63 @@ class Adamax(Adam):
 
 @register
 class Nadam(Adam):
-    """Nesterov Adam (parity: optimizer/nadam.py)."""
+    """Nesterov Adam (parity: optimizer/nadam.py — the reference's
+    WARMING momentum schedule mu_t = b1*(1 - 0.5*0.96^(t*sd)) with the
+    running product m_schedule carried as optimizer state, not the
+    torch-style closed-form variant).
+
+    Documented deviation: the reference keeps ONE m_schedule on the
+    optimizer object, advanced once per parameter per step — with N
+    parameters it grows by mu_t^N each step, coupling every
+    parameter's bias correction to the parameter iteration order.
+    Here m_schedule is per-parameter (advanced once per update), which
+    matches the published algorithm and the reference's own single-
+    parameter behavior exactly.
+    """
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, beta1=beta1,
+                         beta2=beta2, epsilon=epsilon, **kwargs)
+        self.schedule_decay = schedule_decay
+
+    def create_state(self, index, weight):
+        return (jnp.zeros_like(weight._data),
+                jnp.zeros_like(weight._data),
+                jnp.ones((), jnp.float32))  # running m_schedule
+
+    def _migrate_state(self, state):
+        # pre-round-5 checkpoints stored (m, v); append m_schedule=1
+        if isinstance(state, tuple) and len(state) == 2:
+            return state + (onp.ones((), onp.float32),)
+        return state
+
+    def _hyper(self, index):
+        h = super()._hyper(index)
+        h["sd"] = onp.float32(self.schedule_decay)
+        return h
 
     @staticmethod
     def _step(w, g, state, hyper):
         g = Optimizer._pre(g, w, hyper)
-        m, v = state
+        m, v, msched = state
         b1, b2, t = hyper["beta1"], hyper["beta2"], hyper["t"]
         tf = t.astype(jnp.float32)
+        sd = hyper["sd"]
+        coef2 = 1.0 - jnp.power(b2, tf)
+        mu_t = b1 * (1.0 - 0.5 * jnp.power(0.96, tf * sd))
+        mu_t1 = b1 * (1.0 - 0.5 * jnp.power(0.96, (tf + 1.0) * sd))
+        msched = msched * mu_t
+        msched_next = msched * mu_t1
         m = b1 * m + (1 - b1) * g
         v = b2 * v + (1 - b2) * jnp.square(g)
-        m_hat = m / (1 - jnp.power(b1, tf + 1))
-        g_hat = g / (1 - jnp.power(b1, tf))
-        v_hat = v / (1 - jnp.power(b2, tf))
-        m_bar = b1 * m_hat + (1 - b1) * g_hat
-        return w - hyper["lr"] * m_bar / (jnp.sqrt(v_hat) + hyper["eps"]), (m, v)
+        g_prime = g / (1.0 - msched)
+        m_prime = m / (1.0 - msched_next)
+        v_prime = v / coef2
+        m_bar = mu_t1 * m_prime + (1.0 - mu_t) * g_prime
+        return w - hyper["lr"] * m_bar / (jnp.sqrt(v_prime)
+                                          + hyper["eps"]), \
+            (m, v, msched)
 
 
 @register
@@ -818,6 +881,9 @@ class Updater:
             states, self.optimizer = obj
         else:
             states = obj
+        states = {k: self.optimizer._migrate_state(v)
+                  for k, v in states.items()} \
+            if isinstance(states, dict) else states
         self.states = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x) if isinstance(x, onp.ndarray) else x,
             states)

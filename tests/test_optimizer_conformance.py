@@ -1,0 +1,307 @@
+"""Optimizer update-rule conformance vs numpy simulators transcribing
+the reference's documented step() semantics (round-4 VERDICT task #5 /
+weak #8: grow the numerically-verified subset).
+
+Each simulator follows the update pseudocode of the corresponding
+reference optimizer (/root/reference/python/mxnet/optimizer/<name>.py,
+`step()`), re-implemented independently in numpy. Three consecutive
+updates with weight decay, gradient rescaling, and clipping exercise
+state evolution and the per-index update counters.
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as optmod
+
+RNG = onp.random.RandomState(77)
+SHAPE = (5, 3)
+
+
+def _clip(g, c):
+    return onp.clip(g, -c, c) if c is not None else g
+
+
+# Every simulator: (state0_fn, step_fn(w, g, state, t, lr, wd, kw)).
+# grads arrive PRE-rescale; simulators apply rescale/clip/wd as the
+# reference's step() does.
+
+def sim_sgd(w, g, s, t, lr, wd, kw):
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    mom = kw.get("momentum", 0.0)
+    if mom:
+        s["mom"] = s.get("mom", 0.0) * mom - lr * g
+        return w + s["mom"]
+    return w - lr * g
+
+
+def sim_nag(w, g, s, t, lr, wd, kw):
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    mom = kw["momentum"]
+    s["mom"] = s.get("mom", 0.0) * mom - lr * g
+    return w + mom * s["mom"] - lr * g
+
+
+def sim_adam(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.9), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-8)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    lr = lr * math.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    s["m"] = b1 * s.get("m", 0.0) + (1 - b1) * g
+    s["v"] = b2 * s.get("v", 0.0) + (1 - b2) * g * g
+    return w - lr * s["m"] / (onp.sqrt(s["v"]) + eps)
+
+
+def sim_adamw(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.9), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-6)
+    g = _clip(g * kw.get("rescale_grad", 1.0), kw.get("clip_gradient"))
+    if kw.get("correct_bias", True):
+        lr = lr * math.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+    s["m"] = b1 * s.get("m", 0.0) + (1 - b1) * g
+    s["v"] = b2 * s.get("v", 0.0) + (1 - b2) * g * g
+    w = w - lr * s["m"] / (onp.sqrt(s["v"]) + eps)
+    if wd > 0:
+        w = w - lr * wd * w
+    return w
+
+
+def sim_adamax(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.9), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-8)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["m"] = b1 * s.get("m", 0.0) + (1 - b1) * g
+    s["u"] = onp.maximum(b2 * s.get("u", onp.zeros_like(w)), onp.abs(g))
+    return w - lr / (1 - b1 ** t) * s["m"] / (s["u"] + eps)
+
+
+def sim_nadam(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.9), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-8)
+    sd = kw.get("schedule_decay", 0.004)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    coef2 = 1 - b2 ** t
+    mt = b1 * (1 - 0.5 * 0.96 ** (t * sd))
+    mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * sd))
+    s["msched"] = s.get("msched", 1.0) * mt
+    msched_next = s["msched"] * mt1
+    s["m"] = b1 * s.get("m", 0.0) + (1 - b1) * g
+    s["v"] = b2 * s.get("v", 0.0) + (1 - b2) * g * g
+    g_prime = g / (1 - s["msched"])
+    m_prime = s["m"] / (1 - msched_next)
+    v_prime = s["v"] / coef2
+    m_bar = mt1 * m_prime + (1 - mt) * g_prime
+    return w - lr * m_bar / (onp.sqrt(v_prime) + eps)
+
+
+def sim_rmsprop(w, g, s, t, lr, wd, kw):
+    rho, eps = kw.get("rho", 0.9), kw.get("epsilon", 1e-8)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["v"] = rho * s.get("v", 0.0) + (1 - rho) * g * g
+    return w - lr * g / (onp.sqrt(s["v"]) + eps)
+
+
+def sim_rmsprop_centered(w, g, s, t, lr, wd, kw):
+    rho, eps = kw.get("rho", 0.9), kw.get("epsilon", 1e-8)
+    mom = kw.get("momentum", 0.9)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["mean"] = rho * s.get("mean", 0.0) + (1 - rho) * g
+    s["v"] = rho * s.get("v", 0.0) + (1 - rho) * g * g
+    s["mom"] = mom * s.get("mom", 0.0) - lr * g / onp.sqrt(
+        s["v"] - s["mean"] ** 2 + eps)
+    return w + s["mom"]
+
+
+def sim_adagrad(w, g, s, t, lr, wd, kw):
+    eps = kw.get("epsilon", 1e-7)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["h"] = s.get("h", 0.0) + g * g
+    return w - lr * g / (onp.sqrt(s["h"]) + eps)
+
+
+def sim_adadelta(w, g, s, t, lr, wd, kw):
+    rho, eps = kw.get("rho", 0.9), kw.get("epsilon", 1e-5)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["acc_g"] = rho * s.get("acc_g", 0.0) + (1 - rho) * g * g
+    delta = onp.sqrt(s.get("acc_d", onp.zeros_like(w)) + eps) \
+        / onp.sqrt(s["acc_g"] + eps) * g
+    s["acc_d"] = rho * s.get("acc_d", 0.0) + (1 - rho) * delta * delta
+    return w - lr * delta
+
+
+def sim_ftrl(w, g, s, t, lr, wd, kw):
+    lamda1, beta = kw.get("lamda1", 0.01), kw.get("beta", 1.0)
+    g = _clip(g * kw.get("rescale_grad", 1.0), kw.get("clip_gradient"))
+    n = s.get("n", onp.zeros_like(w))
+    z = s.get("z", onp.zeros_like(w))
+    z = z + g - (onp.sqrt(n + g * g) - onp.sqrt(n)) * w / lr
+    n = n + g * g
+    s["n"], s["z"] = n, z
+    return (onp.sign(z) * lamda1 - z) / ((beta + onp.sqrt(n)) / lr + wd) \
+        * (onp.abs(z) > lamda1)
+
+
+def sim_ftml(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.6), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-8)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    coef1, coef2 = 1 - b1 ** t, 1 - b2 ** t
+    d = s.get("d", onp.zeros_like(w))
+    v = s.get("v", onp.zeros_like(w))
+    z = s.get("z", onp.zeros_like(w))
+    v = b2 * v + (1 - b2) * g * g
+    sigma = -b1 * d
+    d = (onp.sqrt(v / coef2) + eps) * (coef1 / lr)
+    sigma = sigma + d
+    z = b1 * z + (1 - b1) * g - sigma * w
+    s["d"], s["v"], s["z"] = d, v, z
+    return -z / d
+
+
+def sim_signum(w, g, s, t, lr, wd, kw):
+    mom = kw.get("momentum", 0.9)
+    wd_lh = kw.get("wd_lh", 0.0)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    s["mom"] = mom * s.get("mom", 0.0) - (1 - mom) * g
+    return w * (1 - lr * wd_lh) + lr * onp.sign(s["mom"])
+
+
+CASES = [
+    ("sgd", sim_sgd, {"learning_rate": 0.1, "momentum": 0.9,
+                      "wd": 0.01}),
+    ("sgd", sim_sgd, {"learning_rate": 0.2, "momentum": 0.0,
+                      "wd": 0.001, "rescale_grad": 0.5,
+                      "clip_gradient": 0.3}),
+    ("nag", sim_nag, {"learning_rate": 0.1, "momentum": 0.9,
+                      "wd": 0.01}),
+    ("adam", sim_adam, {"learning_rate": 0.01, "wd": 0.01}),
+    ("adam", sim_adam, {"learning_rate": 0.01, "beta1": 0.8,
+                        "beta2": 0.99, "rescale_grad": 0.25,
+                        "clip_gradient": 0.5, "wd": 0.05}),
+    ("adamw", sim_adamw, {"learning_rate": 0.01, "wd": 0.1}),
+    ("adamw", sim_adamw, {"learning_rate": 0.01, "wd": 0.1,
+                          "correct_bias": False,
+                          "rescale_grad": 0.5, "clip_gradient": 0.4}),
+    ("adamax", sim_adamax, {"learning_rate": 0.002, "wd": 0.01}),
+    ("nadam", sim_nadam, {"learning_rate": 0.005, "wd": 0.01}),
+    ("nadam", sim_nadam, {"learning_rate": 0.005, "wd": 0.02,
+                          "schedule_decay": 0.01,
+                          "rescale_grad": 0.5, "clip_gradient": 0.8}),
+    ("rmsprop", sim_rmsprop, {"learning_rate": 0.01, "wd": 0.01}),
+    ("rmsprop", sim_rmsprop_centered,
+     {"learning_rate": 0.01, "wd": 0.01, "centered": True,
+      "momentum": 0.9}),
+    ("adagrad", sim_adagrad, {"learning_rate": 0.05, "wd": 0.01}),
+    ("adadelta", sim_adadelta, {"learning_rate": 1.0, "rho": 0.9,
+                                "wd": 0.01}),
+    ("ftrl", sim_ftrl, {"learning_rate": 0.1, "lamda1": 0.01,
+                        "beta": 1.0, "wd": 0.01}),
+    ("ftml", sim_ftml, {"learning_rate": 0.01, "wd": 0.01}),
+    ("signum", sim_signum, {"learning_rate": 0.01, "momentum": 0.9,
+                            "wd": 0.01, "wd_lh": 0.001}),
+]
+
+@pytest.mark.parametrize(
+    "name,sim,kw", CASES,
+    ids=[f"{n}-{i}" for i, (n, _, _) in enumerate(CASES)])
+def test_optimizer_update_matches_reference_formula(name, sim, kw):
+    kw = dict(kw)
+    wd = kw.pop("wd", 0.0)
+    opt = optmod.create(name, wd=wd, **kw)
+    updater = optmod.get_updater(opt)
+
+    w_mx = mx.np.array(RNG.uniform(-1, 1, SHAPE).astype("float32"))
+    w_np = w_mx.asnumpy().astype("float64")
+    state = {}
+    lr = kw.get("learning_rate")
+    for t in range(1, 4):
+        g = RNG.uniform(-2, 2, SHAPE).astype("float32")
+        updater(0, mx.np.array(g), w_mx)
+        w_np = sim(w_np, g.astype("float64"), state, t, lr, wd, kw)
+        onp.testing.assert_allclose(
+            w_mx.asnumpy(), w_np, rtol=2e-4, atol=2e-5,
+            err_msg=f"{name} diverged at step {t} ({kw})")
+
+
+def sim_lamb(w, g, s, t, lr, wd, kw):
+    b1, b2, eps = kw.get("beta1", 0.9), kw.get("beta2", 0.999), \
+        kw.get("epsilon", 1e-6)
+    g = _clip(g * kw.get("rescale_grad", 1.0), kw.get("clip_gradient"))
+    s["m"] = b1 * s.get("m", 0.0) + (1 - b1) * g
+    s["v"] = b2 * s.get("v", 0.0) + (1 - b2) * g * g
+    r1 = onp.linalg.norm(w)
+    if kw.get("lower_bound") is not None:
+        r1 = max(r1, kw["lower_bound"])
+    if kw.get("upper_bound") is not None:
+        r1 = min(r1, kw["upper_bound"])
+    if kw.get("bias_correction", True):
+        m_hat = s["m"] / (1 - b1 ** t)
+        v_hat = s["v"] / (1 - b2 ** t)
+        upd = m_hat / (onp.sqrt(v_hat) + eps) + wd * w
+    else:
+        upd = s["m"] / (onp.sqrt(s["v"]) + eps)
+    r2 = onp.linalg.norm(upd)
+    ratio = r1 / r2
+    if not onp.isfinite(ratio) or ratio == 0:
+        ratio = 1.0
+    return w - lr * ratio * upd
+
+
+def sim_dcasgd(w, g, s, t, lr, wd, kw):
+    lamda = kw.get("lamda", 0.04)
+    mom = kw.get("momentum", 0.0)
+    g = _clip(g * kw.get("rescale_grad", 1.0),
+              kw.get("clip_gradient")) + wd * w
+    prev = s.get("prev", w.copy())
+    d = g * g * (w - prev) * lamda + g
+    if mom:
+        s["mom"] = mom * s.get("mom", 0.0) - lr * d
+    else:
+        s["mom"] = -lr * d
+    s["prev"] = w.copy()
+    return w + s["mom"]
+
+
+LAYERWISE_CASES = [
+    ("lamb", sim_lamb, {"learning_rate": 0.01, "wd": 0.01}),
+    ("lamb", sim_lamb, {"learning_rate": 0.01, "wd": 0.1,
+                        "bias_correction": False,
+                        "upper_bound": 1.0}),
+    ("dcasgd", sim_dcasgd, {"learning_rate": 0.05, "momentum": 0.9,
+                            "wd": 0.01, "lamda": 0.04}),
+]
+
+
+@pytest.mark.parametrize(
+    "name,sim,kw", LAYERWISE_CASES,
+    ids=[f"{n}-{i}" for i, (n, _, _) in enumerate(LAYERWISE_CASES)])
+def test_layerwise_optimizer_matches_reference_formula(name, sim, kw):
+    kw = dict(kw)
+    wd = kw.pop("wd", 0.0)
+    opt = optmod.create(name, wd=wd, **kw)
+    updater = optmod.get_updater(opt)
+
+    w_mx = mx.np.array(RNG.uniform(-1, 1, SHAPE).astype("float32"))
+    w_np = w_mx.asnumpy().astype("float64")
+    state = {}
+    lr = kw.get("learning_rate")
+    for t in range(1, 4):
+        g = RNG.uniform(-2, 2, SHAPE).astype("float32")
+        updater(0, mx.np.array(g), w_mx)
+        w_np = sim(w_np, g.astype("float64"), state, t, lr, wd, kw)
+        onp.testing.assert_allclose(
+            w_mx.asnumpy(), w_np, rtol=3e-4, atol=3e-5,
+            err_msg=f"{name} diverged at step {t} ({kw})")

@@ -260,7 +260,7 @@ class PoissonNLLLoss(Loss):
             stirling = np.where(label <= 1, np.zeros_like(stirling), stirling)
             loss = loss + stirling
         loss = _apply_weighting(loss, self._weight, sample_weight)
-        return np.mean(loss)
+        return self._mean_per_sample(loss)
 
 
 class CosineEmbeddingLoss(Loss):
@@ -269,15 +269,23 @@ class CosineEmbeddingLoss(Loss):
         self._margin = margin
 
     def forward(self, input1, input2, label, sample_weight=None):
-        input1 = _reshape_like(input1, input2)
-        cos = np.sum(input1 * input2, axis=-1) / (
+        # reshape input1 to input2's shape (arg order: _reshape_like
+        # returns its SECOND argument reshaped like the first)
+        input1 = _reshape_like(input2, input1)
+        # cos kept (N, 1) like the reference's _cosine_similarity, so
+        # the documented (N, 1) sample_weight broadcasts elementwise
+        cos = (np.sum(input1 * input2, axis=-1) / (
             np.sqrt(np.sum(np.square(input1), axis=-1)) *
             np.sqrt(np.sum(np.square(input2), axis=-1)) + 1e-12)
+        ).reshape(-1, 1)
         label = label.reshape(cos.shape)
+        # dissimilar branch clips to [0, 1 - margin] (reference
+        # loss.py CosineEmbeddingLoss.forward — upper bound included)
         loss = np.where(label == 1, 1.0 - cos,
-                        npx.relu(cos - self._margin))
+                        np.clip(cos - self._margin, 0.0,
+                                1.0 - self._margin))
         loss = _apply_weighting(loss, self._weight, sample_weight)
-        return loss
+        return self._mean_per_sample(loss)
 
 
 class SDMLLoss(Loss):

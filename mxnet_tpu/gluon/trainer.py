@@ -231,9 +231,18 @@ class Trainer:
         import jax.numpy as jnp
         with open(fname, "rb") as f:
             blob = pickle.load(f)
+        states = blob["states"]
+        if isinstance(states, (list, tuple)):
+            # older-layout states adapt here (e.g. Nadam's 2-tuple ->
+            # 3-tuple with m_schedule)
+            states = type(states)(
+                self._optimizer._migrate_state(s) for s in states)
+        elif isinstance(states, dict):
+            states = {k: self._optimizer._migrate_state(v)
+                      for k, v in states.items()}
         self._states = jax.tree_util.tree_map(
             lambda x: jnp.asarray(x) if isinstance(x, onp.ndarray) else x,
-            blob["states"])
+            states)
         self._states_initialized = [True] * len(self._states)
         self._optimizer.num_update = blob["num_update"]
         self._optimizer.begin_num_update = blob["num_update"]

@@ -340,7 +340,8 @@ class AdamW(Adam):
     def _hyper(self, index):
         h = super()._hyper(index)
         # None/1.0 keeps the flag a static pytree leaf (AdaBelief trick)
-        h["correct"] = 1.0 if self.correct_bias else None
+        h["correct"] = 1.0 if getattr(self, "correct_bias", True) \
+            else None
         return h
 
     @staticmethod
@@ -402,14 +403,18 @@ class Nadam(Adam):
                 jnp.ones((), jnp.float32))  # running m_schedule
 
     def _migrate_state(self, state):
-        # pre-round-5 checkpoints stored (m, v); append m_schedule=1
+        # pre-round-5 checkpoints stored (m, v); append m_schedule=1.
+        # A multi-precision state is (master, inner_tuple) — recurse.
         if isinstance(state, tuple) and len(state) == 2:
+            if isinstance(state[1], tuple):
+                return (state[0], self._migrate_state(state[1]))
             return state + (onp.ones((), onp.float32),)
         return state
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        h["sd"] = onp.float32(self.schedule_decay)
+        # getattr: instances unpickled from pre-round-5 blobs lack it
+        h["sd"] = onp.float32(getattr(self, "schedule_decay", 0.004))
         return h
 
     @staticmethod

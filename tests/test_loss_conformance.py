@@ -1,0 +1,144 @@
+"""Loss-function conformance vs the reference's documented formulas
+(/root/reference/python/mxnet/gluon/loss.py math:: blocks). Reference
+return convention: per-sample loss = mean over all non-batch axes
+after sample weighting (loss.mean(axis=batch_axis, exclude=True)).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.gluon import loss as gloss
+
+RNG = onp.random.RandomState(31)
+N, D = 4, 6
+PRED = RNG.uniform(-2, 2, (N, D)).astype("float32")
+LABEL = RNG.uniform(-2, 2, (N, D)).astype("float32")
+SIGN = onp.sign(RNG.uniform(-1, 1, (N, D))).astype("float32")
+BIN = (RNG.uniform(0, 1, (N, D)) > 0.5).astype("float32")
+SPARSE_LBL = RNG.randint(0, D, (N,)).astype("float32")
+
+
+def _row_mean(x):
+    return x.reshape(N, -1).mean(axis=1)
+
+
+def np_sigmoid(x):
+    return 1.0 / (1.0 + onp.exp(-x))
+
+
+def np_log_softmax(x):
+    m = x.max(-1, keepdims=True)
+    return x - m - onp.log(onp.exp(x - m).sum(-1, keepdims=True))
+
+
+CASES = [
+    ("l2", gloss.L2Loss(), (PRED, LABEL),
+     lambda: _row_mean(0.5 * (LABEL - PRED) ** 2)),
+    ("l1", gloss.L1Loss(), (PRED, LABEL),
+     lambda: _row_mean(onp.abs(LABEL - PRED))),
+    ("huber_rho1", gloss.HuberLoss(rho=1.0), (PRED, LABEL),
+     lambda: _row_mean(onp.where(onp.abs(LABEL - PRED) < 1.0,
+                                 0.5 * (LABEL - PRED) ** 2,
+                                 onp.abs(LABEL - PRED) - 0.5))),
+    ("huber_rho05", gloss.HuberLoss(rho=0.5), (PRED, LABEL),
+     lambda: _row_mean(onp.where(onp.abs(LABEL - PRED) < 0.5,
+                                 (LABEL - PRED) ** 2 / (2 * 0.5),
+                                 onp.abs(LABEL - PRED) - 0.25))),
+    ("hinge", gloss.HingeLoss(margin=1.0), (PRED, SIGN),
+     lambda: _row_mean(onp.maximum(0.0, 1.0 - PRED * SIGN))),
+    ("squared_hinge", gloss.SquaredHingeLoss(margin=1.0), (PRED, SIGN),
+     lambda: _row_mean(onp.maximum(0.0, 1.0 - PRED * SIGN) ** 2)),
+    ("logistic_signed", gloss.LogisticLoss(label_format="signed"),
+     (PRED, SIGN),
+     lambda: _row_mean(onp.log1p(onp.exp(-PRED * SIGN)))),
+    ("logistic_binary", gloss.LogisticLoss(label_format="binary"),
+     (PRED, BIN),
+     lambda: _row_mean(onp.log1p(onp.exp(-PRED * (2 * BIN - 1))))),
+    ("sigmoid_bce", gloss.SigmoidBinaryCrossEntropyLoss(),
+     (PRED, BIN),
+     lambda: _row_mean(onp.maximum(PRED, 0) - PRED * BIN
+                       + onp.log1p(onp.exp(-onp.abs(PRED))))),
+    ("sigmoid_bce_from_sigmoid",
+     gloss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True),
+     (np_sigmoid(PRED).astype("f"), BIN),
+     lambda: _row_mean(-(BIN * onp.log(np_sigmoid(PRED) + 1e-12)
+                         + (1 - BIN) * onp.log(1 - np_sigmoid(PRED)
+                                               + 1e-12)))),
+    ("softmax_ce_sparse", gloss.SoftmaxCrossEntropyLoss(),
+     (PRED, SPARSE_LBL),
+     lambda: -np_log_softmax(PRED)[onp.arange(N),
+                                   SPARSE_LBL.astype(int)]),
+    ("softmax_ce_dense",
+     gloss.SoftmaxCrossEntropyLoss(sparse_label=False),
+     (PRED, onp.eye(D, dtype="f")[SPARSE_LBL.astype(int)]),
+     lambda: -(onp.eye(D, dtype="f")[SPARSE_LBL.astype(int)]
+               * np_log_softmax(PRED)).sum(-1)),
+    ("kldiv_from_logits", gloss.KLDivLoss(from_logits=True),
+     (np_log_softmax(PRED).astype("f"), np_softmax_label := None) if
+     False else
+     (np_log_softmax(PRED).astype("f"),
+      onp.exp(np_log_softmax(LABEL)).astype("f")),
+     lambda: _row_mean(onp.exp(np_log_softmax(LABEL))
+                       * (onp.log(onp.exp(np_log_softmax(LABEL))
+                                  + 1e-12)
+                          - np_log_softmax(PRED)))),
+    ("poisson_nll", gloss.PoissonNLLLoss(from_logits=False),
+     (onp.abs(PRED) + 0.1, onp.abs(LABEL)),
+     lambda: _row_mean((onp.abs(PRED) + 0.1)
+                       - onp.abs(LABEL)
+                       * onp.log(onp.abs(PRED) + 0.1 + 1e-8))),
+]
+
+
+@pytest.mark.parametrize("name,loss,args,want_fn", CASES,
+                         ids=[c[0] for c in CASES])
+def test_loss_matches_reference_formula(name, loss, args, want_fn):
+    out = loss(*[mnp.array(a) for a in args]).asnumpy()
+    want = want_fn()
+    assert out.shape == want.shape, (out.shape, want.shape)
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5,
+                                err_msg=name)
+
+
+def test_triplet_loss_formula():
+    a = RNG.uniform(-1, 1, (N, D)).astype("f")
+    p = RNG.uniform(-1, 1, (N, D)).astype("f")
+    n = RNG.uniform(-1, 1, (N, D)).astype("f")
+    out = gloss.TripletLoss(margin=1.0)(
+        mnp.array(a), mnp.array(p), mnp.array(n)).asnumpy()
+    want = onp.maximum(
+        ((p - a) ** 2).sum(-1) - ((n - a) ** 2).sum(-1) + 1.0, 0.0)
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_embedding_loss_formula():
+    x1 = RNG.uniform(-1, 1, (N, D)).astype("f")
+    x2 = RNG.uniform(-1, 1, (N, D)).astype("f")
+    lbl = onp.array([1, -1, 1, -1], dtype="f")
+    out = gloss.CosineEmbeddingLoss(margin=0.1)(
+        mnp.array(x1), mnp.array(x2), mnp.array(lbl)).asnumpy()
+    cos = (x1 * x2).sum(-1) / (onp.linalg.norm(x1, axis=-1)
+                               * onp.linalg.norm(x2, axis=-1))
+    # dissimilar branch clips to [0, 1 - margin] (reference forward)
+    want = onp.where(lbl == 1, 1 - cos,
+                     onp.clip(cos - 0.1, 0.0, 1.0 - 0.1))
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_sample_weighting():
+    """_apply_weighting: per-sample weights scale the loss rows."""
+    w = onp.array([1.0, 0.0, 2.0, 0.5], dtype="f").reshape(N, 1)
+    out = gloss.L2Loss()(mnp.array(PRED), mnp.array(LABEL),
+                         mnp.array(w)).asnumpy()
+    want = _row_mean(0.5 * (LABEL - PRED) ** 2 * w)
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_loss_weight_constructor():
+    """The `weight` ctor arg scales every loss (reference Loss base)."""
+    out = gloss.L1Loss(weight=3.0)(
+        mnp.array(PRED), mnp.array(LABEL)).asnumpy()
+    onp.testing.assert_allclose(
+        out, 3.0 * _row_mean(onp.abs(LABEL - PRED)),
+        rtol=1e-4, atol=1e-5)

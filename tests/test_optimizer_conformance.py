@@ -252,7 +252,7 @@ def sim_lamb(w, g, s, t, lr, wd, kw):
         v_hat = s["v"] / (1 - b2 ** t)
         upd = m_hat / (onp.sqrt(v_hat) + eps) + wd * w
     else:
-        upd = s["m"] / (onp.sqrt(s["v"]) + eps)
+        upd = s["m"] / (onp.sqrt(s["v"]) + eps) + wd * w
     r2 = onp.linalg.norm(upd)
     ratio = r1 / r2
     if not onp.isfinite(ratio) or ratio == 0:
@@ -303,5 +303,5 @@ def test_layerwise_optimizer_matches_reference_formula(name, sim, kw):
         updater(0, mx.np.array(g), w_mx)
         w_np = sim(w_np, g.astype("float64"), state, t, lr, wd, kw)
         onp.testing.assert_allclose(
-            w_mx.asnumpy(), w_np, rtol=3e-4, atol=3e-5,
+            w_mx.asnumpy(), w_np, rtol=1e-5, atol=1e-6,
             err_msg=f"{name} diverged at step {t} ({kw})")

@@ -150,6 +150,23 @@ class Optimizer:
         (Updater.set_states); default: unchanged."""
         return state
 
+    def __setstate__(self, d):
+        """Unpickling restores __dict__ without __init__, so instances
+        serialized before a hyperparameter existed would lack it. Fill
+        missing attributes from the class __init__ defaults — one fix
+        for every optimizer and every future added knob."""
+        import inspect
+        self.__dict__.update(d)
+        for klass in type(self).__mro__:
+            ctor = klass.__dict__.get("__init__")
+            if ctor is None:
+                continue
+            for name, p in inspect.signature(ctor).parameters.items():
+                if p.default is inspect.Parameter.empty:
+                    continue
+                if name not in self.__dict__ and not name.startswith("_"):
+                    self.__dict__.setdefault(name, p.default)
+
     # -- hypers passed into the jitted step ----------------------------
     def _hyper(self, index):
         t = self._index_update_count.get(index, self.num_update)
@@ -340,8 +357,7 @@ class AdamW(Adam):
     def _hyper(self, index):
         h = super()._hyper(index)
         # None/1.0 keeps the flag a static pytree leaf (AdaBelief trick)
-        h["correct"] = 1.0 if getattr(self, "correct_bias", True) \
-            else None
+        h["correct"] = 1.0 if self.correct_bias else None
         return h
 
     @staticmethod
@@ -413,8 +429,7 @@ class Nadam(Adam):
 
     def _hyper(self, index):
         h = super()._hyper(index)
-        # getattr: instances unpickled from pre-round-5 blobs lack it
-        h["sd"] = onp.float32(getattr(self, "schedule_decay", 0.004))
+        h["sd"] = onp.float32(self.schedule_decay)
         return h
 
     @staticmethod

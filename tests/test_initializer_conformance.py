@@ -1,0 +1,151 @@
+"""Initializer conformance vs the reference's semantics
+(/root/reference/python/mxnet/initializer.py): deterministic
+initializers byte-exact, random ones by bounds/moments and fan
+computation (Xavier/MSRAPrelu scale formulas).
+"""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as init
+from mxnet_tpu import np as mnp
+
+
+def _materialize(initializer, shape, name="weight"):
+    arr = mnp.zeros(shape)
+    desc = init.InitDesc(name)
+    initializer(desc, arr)
+    return arr.asnumpy()
+
+
+def test_zero_one_constant():
+    onp.testing.assert_array_equal(
+        _materialize(init.Zero(), (3, 4)), onp.zeros((3, 4)))
+    onp.testing.assert_array_equal(
+        _materialize(init.One(), (3, 4)), onp.ones((3, 4)))
+    onp.testing.assert_array_equal(
+        _materialize(init.Constant(2.5), (3, 4)),
+        onp.full((3, 4), 2.5, "float32"))
+
+
+def test_uniform_bounds_and_coverage():
+    a = _materialize(init.Uniform(scale=0.07), (400, 200))
+    assert a.min() >= -0.07 and a.max() <= 0.07
+    assert a.max() > 0.06 and a.min() < -0.06  # actually fills range
+    assert abs(a.mean()) < 0.002
+
+
+def test_normal_sigma():
+    a = _materialize(init.Normal(sigma=0.3), (500, 200))
+    assert abs(a.std() - 0.3) < 0.01
+    assert abs(a.mean()) < 0.01
+
+
+@pytest.mark.parametrize("factor_type,fan_fn", [
+    ("in", lambda i, o: i),
+    ("out", lambda i, o: o),
+    ("avg", lambda i, o: (i + o) / 2.0),
+])
+def test_xavier_uniform_scale(factor_type, fan_fn):
+    """Xavier: scale = sqrt(magnitude / factor); U(-scale, scale).
+    For a conv kernel (O, I, kh, kw): fan_in = I*kh*kw,
+    fan_out = O*kh*kw (reference Xavier._init_weight)."""
+    O, I, k = 32, 16, 3
+    mag = 3.0
+    a = _materialize(init.Xavier(rnd_type="uniform",
+                                 factor_type=factor_type,
+                                 magnitude=mag), (O, I, k, k))
+    fan_in, fan_out = I * k * k, O * k * k
+    scale = math.sqrt(mag / fan_fn(fan_in, fan_out))
+    assert a.min() >= -scale - 1e-6 and a.max() <= scale + 1e-6
+    assert a.max() > scale * 0.95  # not a tighter distribution
+    # uniform variance = scale^2/3
+    assert abs(a.var() - scale ** 2 / 3) < scale ** 2 / 3 * 0.1
+
+
+def test_xavier_gaussian_std():
+    O, I = 64, 128
+    a = _materialize(init.Xavier(rnd_type="gaussian",
+                                 factor_type="avg", magnitude=2.0),
+                     (O, I))
+    scale = math.sqrt(2.0 / ((I + O) / 2.0))
+    assert abs(a.std() - scale) < scale * 0.1
+
+
+def test_msraprelu_matches_xavier_gaussian():
+    """MSRAPrelu == Xavier(gaussian, avg, 2/(1+slope^2)) (reference
+    subclass relationship)."""
+    slope = 0.25
+    a = _materialize(init.MSRAPrelu(factor_type="avg", slope=slope),
+                     (256, 128))
+    mag = 2.0 / (1 + slope ** 2)
+    scale = math.sqrt(mag / ((256 + 128) / 2.0))
+    assert abs(a.std() - scale) < scale * 0.1
+
+
+def test_orthogonal_rows_orthonormal():
+    a = _materialize(init.Orthogonal(scale=1.0), (16, 64))
+    gram = a @ a.T
+    onp.testing.assert_allclose(gram, onp.eye(16), atol=1e-4)
+
+
+def test_bilinear_exact_kernel():
+    """Bilinear upsampling kernel: w[y, x] = (1-|x/f - c|)(1-|y/f - c|)
+    with f = ceil(W/2), c = (2f-1-f%2)/(2f) (reference
+    initializer.py:681-690) — byte-exact."""
+    shape = (2, 1, 4, 4)
+    a = _materialize(init.Bilinear(), shape)
+    f = math.ceil(shape[3] / 2.0)
+    c = (2 * f - 1 - f % 2) / (2.0 * f)
+    want = onp.zeros(int(onp.prod(shape)), "float32")
+    for i in range(want.size):
+        x = i % shape[3]
+        y = (i // shape[3]) % shape[2]
+        want[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+    onp.testing.assert_allclose(a, want.reshape(shape), rtol=1e-6)
+
+
+def test_lstmbias_forget_gate():
+    """All zeros except the forget-gate block (second quarter) = 1.0
+    (reference initializer.py:708-713)."""
+    a = _materialize(init.LSTMBias(forget_bias=1.0), (32,),
+                     name="lstm_bias")
+    nh = 8
+    onp.testing.assert_array_equal(a[:nh], onp.zeros(nh))
+    onp.testing.assert_array_equal(a[nh:2 * nh], onp.ones(nh))
+    onp.testing.assert_array_equal(a[2 * nh:], onp.zeros(2 * nh))
+
+
+def test_mixed_initializer_patterns():
+    """Mixed routes by name-pattern regex (reference Mixed)."""
+    mixed = init.Mixed([".*bias", ".*"],
+                       [init.Zero(), init.One()])
+    b = mnp.zeros((4,))
+    w = mnp.zeros((4,))
+    mixed(init.InitDesc("fc1_bias"), b)
+    mixed(init.InitDesc("fc1_weight"), w)
+    onp.testing.assert_array_equal(b.asnumpy(), onp.zeros(4))
+    onp.testing.assert_array_equal(w.asnumpy(), onp.ones(4))
+
+
+def test_string_alias_dispatch():
+    """net.initialize("xavier") style string aliases resolve through
+    the registry (reference initializer.create)."""
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(8, in_units=16)
+    net.initialize(init="xavier")
+    a = net.weight.data().asnumpy()
+    scale = math.sqrt(3.0 / ((16 + 8) / 2.0))  # default magnitude 3
+    assert a.min() >= -scale - 1e-6 and a.max() <= scale + 1e-6
+    assert a.std() > 0
+
+
+def test_deferred_init_uses_initializer():
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(4)  # in_units deferred
+    net.initialize(init=init.Constant(0.5))
+    net(mnp.zeros((2, 6)))
+    onp.testing.assert_allclose(net.weight.data().asnumpy(),
+                                onp.full((4, 6), 0.5, "f"))

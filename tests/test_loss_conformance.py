@@ -19,17 +19,20 @@ BIN = (RNG.uniform(0, 1, (N, D)) > 0.5).astype("float32")
 SPARSE_LBL = RNG.randint(0, D, (N,)).astype("float32")
 
 
+def np_log_softmax(x):
+    m = x.max(-1, keepdims=True)
+    return x - m - onp.log(onp.exp(x - m).sum(-1, keepdims=True))
+
+
+SOFT_LABEL = onp.exp(np_log_softmax(LABEL)).astype("float32")
+
+
 def _row_mean(x):
     return x.reshape(N, -1).mean(axis=1)
 
 
 def np_sigmoid(x):
     return 1.0 / (1.0 + onp.exp(-x))
-
-
-def np_log_softmax(x):
-    m = x.max(-1, keepdims=True)
-    return x - m - onp.log(onp.exp(x - m).sum(-1, keepdims=True))
 
 
 CASES = [
@@ -75,14 +78,9 @@ CASES = [
      lambda: -(onp.eye(D, dtype="f")[SPARSE_LBL.astype(int)]
                * np_log_softmax(PRED)).sum(-1)),
     ("kldiv_from_logits", gloss.KLDivLoss(from_logits=True),
-     (np_log_softmax(PRED).astype("f"), np_softmax_label := None) if
-     False else
-     (np_log_softmax(PRED).astype("f"),
-      onp.exp(np_log_softmax(LABEL)).astype("f")),
-     lambda: _row_mean(onp.exp(np_log_softmax(LABEL))
-                       * (onp.log(onp.exp(np_log_softmax(LABEL))
-                                  + 1e-12)
-                          - np_log_softmax(PRED)))),
+     (np_log_softmax(PRED).astype("f"), SOFT_LABEL),
+     lambda: _row_mean(SOFT_LABEL * (onp.log(SOFT_LABEL + 1e-12)
+                                     - np_log_softmax(PRED)))),
     ("poisson_nll", gloss.PoissonNLLLoss(from_logits=False),
      (onp.abs(PRED) + 0.1, onp.abs(LABEL)),
      lambda: _row_mean((onp.abs(PRED) + 0.1)

@@ -1,0 +1,113 @@
+"""gluon.data sampler/batchify conformance vs reference semantics
+(/root/reference/python/mxnet/gluon/data/sampler.py and
+gluon/data/batchify.py): exact ordering and edge behavior.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu.gluon.data import sampler as S
+from mxnet_tpu.gluon.data import batchify as B
+
+
+def test_sequential_sampler_order():
+    assert list(S.SequentialSampler(5)) == [0, 1, 2, 3, 4]
+    assert len(S.SequentialSampler(5)) == 5
+
+
+def test_random_sampler_is_permutation():
+    got = list(S.RandomSampler(100))
+    assert sorted(got) == list(range(100))
+    assert got != list(range(100))  # astronomically unlikely if shuffled
+
+
+def test_interval_sampler_pattern():
+    """IntervalSampler(N, interval): strided passes covering all of
+    0..N-1 (reference sampler.py IntervalSampler docstring example:
+    N=13, interval=3 -> 0,3,6,9,12,1,4,...)."""
+    got = list(S.IntervalSampler(13, 3))
+    want = [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert got == want
+    assert sorted(got) == list(range(13))
+
+
+def test_filter_sampler():
+    # fn filters SAMPLES of a dataset (reference sampler.py:78)
+    got = list(S.FilterSampler(lambda s: s % 3 == 0, list(range(10))))
+    assert got == [0, 3, 6, 9]
+
+
+@pytest.mark.parametrize("last_batch,want", [
+    ("keep", [[0, 1, 2], [3, 4, 5], [6, 7]]),
+    ("discard", [[0, 1, 2], [3, 4, 5]]),
+])
+def test_batch_sampler_keep_discard(last_batch, want):
+    bs = S.BatchSampler(S.SequentialSampler(8), 3,
+                        last_batch=last_batch)
+    assert [list(b) for b in bs] == want
+    assert len(bs) == len(want)
+
+
+def test_batch_sampler_rollover_carries_remainder():
+    """rollover: the epoch-1 remainder PREPENDS to epoch 2 (reference
+    BatchSampler docstring)."""
+    bs = S.BatchSampler(S.SequentialSampler(8), 3,
+                        last_batch="rollover")
+    epoch1 = [list(b) for b in bs]
+    assert epoch1 == [[0, 1, 2], [3, 4, 5]]
+    epoch2 = [list(b) for b in bs]
+    assert epoch2[0] == [6, 7, 0]
+    assert epoch2[1:] == [[1, 2, 3], [4, 5, 6]]
+
+
+def test_batchify_stack_shapes_and_values():
+    out = B.Stack()([onp.ones((2, 3), "f") * i for i in range(4)])
+    assert out.shape == (4, 2, 3)
+    onp.testing.assert_allclose(out.asnumpy()[2],
+                                onp.ones((2, 3)) * 2)
+
+
+def test_batchify_pad_ragged():
+    """Pad stacks ragged sequences to the max length with pad_val
+    (reference batchify.Pad)."""
+    seqs = [onp.arange(3, dtype="f"), onp.arange(5, dtype="f"),
+            onp.arange(1, dtype="f")]
+    out = B.Pad(val=-1)(seqs).asnumpy()
+    assert out.shape == (3, 5)
+    onp.testing.assert_allclose(out[0], [0, 1, 2, -1, -1])
+    onp.testing.assert_allclose(out[2], [0, -1, -1, -1, -1])
+
+
+def test_batchify_tuple_composes():
+    # Tuple is the repo-local alias of Group (batchify.py:78)
+    data = [(onp.ones((2,), "f") * i,
+             onp.arange(i + 1, dtype="f")) for i in range(3)]
+    a, b = B.Tuple(B.Stack(), B.Pad(val=0))(data)
+    assert a.shape == (3, 2)
+    assert b.shape == (3, 3)
+
+
+def test_batchify_group_tuple_alias():
+    """Group applies one fn per tuple element (reference
+    batchify.Group; `Tuple` below is this repo's ALIAS of it —
+    the reference has no class named Tuple)."""
+    data = [(onp.ones((2,), "f") * i, onp.array([i], "f"))
+            for i in range(3)]
+    x, y = B.Group(B.Stack(), B.Stack())(data)
+    assert x.shape == (3, 2)
+    assert y.shape == (3, 1)
+
+
+def test_dataloader_batchify_fn_end_to_end():
+    from mxnet_tpu import gluon
+    ds = gluon.data.SimpleDataset(
+        [(onp.arange(n + 1, dtype="f"), onp.float32(n))
+         for n in range(7)])
+    loader = gluon.data.DataLoader(
+        ds, batch_size=3, last_batch="keep",
+        batchify_fn=B.Tuple(B.Pad(val=0), B.Stack()))
+    batches = list(loader)
+    assert len(batches) == 3
+    x0, y0 = batches[0]
+    assert x0.shape == (3, 3) and y0.shape == (3,)
+    x2, y2 = batches[2]
+    assert x2.shape == (1, 7)

@@ -90,6 +90,7 @@ def test_batchify_group_tuple_alias():
     """Group applies one fn per tuple element (reference
     batchify.Group; `Tuple` below is this repo's ALIAS of it —
     the reference has no class named Tuple)."""
+    assert B.Tuple is B.Group  # the alias itself
     data = [(onp.ones((2,), "f") * i, onp.array([i], "f"))
             for i in range(3)]
     x, y = B.Group(B.Stack(), B.Stack())(data)

@@ -1,0 +1,53 @@
+"""Deterministic image-op conformance (reference python/mxnet/image/
+image.py: resize_short short-edge math, center/fixed crop geometry,
+color_normalize arithmetic)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import image
+
+RNG = onp.random.RandomState(3)
+IMG = (RNG.uniform(0, 255, (40, 60, 3))).astype("uint8")  # H=40, W=60
+
+
+def test_resize_short_scales_short_edge():
+    out = image.resize_short(mx.np.array(IMG), 20)
+    # short edge H=40 -> 20; W scales by the same factor: 60*20/40=30
+    assert out.shape == (20, 30, 3)
+    tall = image.resize_short(
+        mx.np.array(IMG.transpose(1, 0, 2)), 20)  # H=60, W=40
+    assert tall.shape == (30, 20, 3)
+
+
+def test_center_crop_geometry():
+    out, (x0, y0, w, h) = image.center_crop(mx.np.array(IMG), (30, 20))
+    assert (w, h) == (30, 20)
+    assert x0 == (60 - 30) // 2 and y0 == (40 - 20) // 2
+    onp.testing.assert_array_equal(
+        out.asnumpy(), IMG[y0:y0 + 20, x0:x0 + 30])
+
+
+def test_fixed_crop_exact_pixels():
+    out = image.fixed_crop(mx.np.array(IMG), 5, 7, 20, 10)
+    onp.testing.assert_array_equal(out.asnumpy(), IMG[7:17, 5:25])
+
+
+def test_color_normalize_arithmetic():
+    src = IMG.astype("float32")
+    mean = onp.array([123.0, 117.0, 104.0], "float32")
+    std = onp.array([58.0, 57.0, 57.0], "float32")
+    out = image.color_normalize(mx.np.array(src), mx.np.array(mean),
+                                mx.np.array(std)).asnumpy()
+    onp.testing.assert_allclose(out, (src - mean) / std, rtol=1e-5)
+
+
+def test_imresize_identity_size():
+    out = image.imresize(mx.np.array(IMG), 60, 40)
+    onp.testing.assert_allclose(out.asnumpy().astype("f"),
+                                IMG.astype("f"), atol=1.0)
+
+
+def test_imresize_downsample_shape_and_range():
+    out = image.imresize(mx.np.array(IMG), 30, 20).asnumpy()
+    assert out.shape == (20, 30, 3)
+    assert out.min() >= 0 and out.max() <= 255

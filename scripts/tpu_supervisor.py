@@ -55,19 +55,26 @@ PY = sys.executable
 LOWER_IS_BETTER = {"trace"}
 
 STAGES = [
-    # (name, argv, timeout_s)
+    # (name, argv, timeout_s). Order = scoring priority: the resnet50
+    # headline comes right after the cheap canaries — round-5 lesson:
+    # the first window of the round died inside the (reordered-away)
+    # trace stage before resnet50 ever ran.
     ("matmul", [PY, os.path.join(REPO, "scripts", "tpu_stage_matmul.py")],
      240),
     ("resnet18", [PY, os.path.join(REPO, "bench.py")], 420),
+    ("resnet50", [PY, os.path.join(REPO, "bench.py")], 900),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
-    ("resnet50", [PY, os.path.join(REPO, "bench.py")], 900),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),
                 "--platform", "tpu", "--runs", "5", "--warmup", "1",
                 "--top", "120", "--budget", "1200", "--resume",
                 "--output", os.path.join(RUN_DIR, "OPPERF_TPU.json")],
      1500),
 ]
+
+# quick aliveness re-check between stages: a window can close mid-loop
+# and a dead tunnel would otherwise burn the full stage timeout
+INTERSTAGE_PROBE_TIMEOUT_S = 45
 
 STAGE_ENV = {
     "matmul": {},
@@ -157,6 +164,21 @@ def save_best(best: dict):
     os.replace(tmp, BEST)
 
 
+def probe(timeout_s, n=None, kind="probe"):
+    """One probe-child round trip. Returns (alive, parsed)."""
+    t0 = time.monotonic()
+    rc, parsed = run_child(
+        [PY, os.path.join(REPO, "scripts", "tpu_probe_child.py")],
+        timeout_s, log_name="probe")
+    alive = bool(rc == 0 and parsed is not None and parsed.get("ok"))
+    ev = {"event": kind, "alive": alive, "rc": rc,
+          "dur_s": round(time.monotonic() - t0, 1), "parsed": parsed}
+    if n is not None:
+        ev["n"] = n
+    log_event(ev)
+    return alive, parsed
+
+
 def main():
     os.makedirs(RUN_DIR, exist_ok=True)
     os.makedirs(CACHE_DIR, exist_ok=True)
@@ -170,22 +192,26 @@ def main():
 
         n_probe += 1
         t0 = time.monotonic()
-        rc, parsed = run_child(
-            [PY, os.path.join(REPO, "scripts", "tpu_probe_child.py")],
-            PROBE_TIMEOUT_S, log_name="probe")
-        alive = rc == 0 and parsed is not None and parsed.get("ok")
-        log_event({"event": "probe", "n": n_probe, "alive": bool(alive),
-                   "rc": rc, "dur_s": round(time.monotonic() - t0, 1),
-                   "parsed": parsed})
+        alive, parsed = probe(PROBE_TIMEOUT_S, n=n_probe)
 
         if alive:
             # window open: burn through pending stages while it lasts
+            prev_live = True  # outer probe just succeeded
             for name, argv, timeout_s in (pending or [STAGES[0]]):
+                if not prev_live:
+                    # previous stage didn't prove the tunnel alive:
+                    # re-probe rather than burn a 900s stage budget
+                    # on a window that already closed
+                    ok, _ = probe(INTERSTAGE_PROBE_TIMEOUT_S,
+                                  kind="interstage_probe")
+                    if not ok:
+                        break
                 t0 = time.monotonic()
                 rc, parsed = run_child(argv, timeout_s,
                                        extra_env=STAGE_ENV.get(name),
                                        log_name=f"stage_{name}")
                 got_tpu = is_tpu(parsed)
+                prev_live = rc == 0 and got_tpu
                 log_event({"event": "stage", "stage": name, "rc": rc,
                            "tpu": got_tpu,
                            "dur_s": round(time.monotonic() - t0, 1),

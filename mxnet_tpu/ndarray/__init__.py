@@ -13,6 +13,54 @@ from .sparse import (  # noqa: F401
 )
 
 
+def _legacy_sort(data, axis=-1, is_ascend=True, **kwargs):
+    """Legacy ordering signature (parity:
+    src/operator/tensor/ordering_op.cc Sort — `is_ascend` flag; the
+    numpy namespace sorts ascending only)."""
+    from .. import numpy as _np
+    out = _np.sort(data, axis=axis)
+    return out if is_ascend else _np.flip(out, axis=axis)
+
+
+def _legacy_argsort(data, axis=-1, is_ascend=True, dtype="float32",
+                    **kwargs):
+    """Parity: ordering_op.cc argsort — float32 index dtype default."""
+    from .. import numpy as _np
+    import numpy as onp
+    if is_ascend:
+        idx = _np.argsort(data, axis=axis)
+    elif onp.dtype(str(data.dtype)).kind == "f":
+        idx = _np.argsort(-data, axis=axis)  # stable tie order
+    else:
+        # ints/bool: negation wraps unsigned (and INT_MIN); a flipped
+        # ascending argsort is a correct descending order (ties
+        # reversed — the reference leaves tie order unspecified)
+        idx = _np.flip(_np.argsort(data, axis=axis),
+                       axis=-1 if axis is None else axis)
+    return idx.astype(dtype)
+
+
+def _legacy_reverse(data, axis=0, **kwargs):
+    """Parity: src/operator/tensor/matrix_op.cc reverse = np.flip."""
+    from .. import numpy as _np
+    return _np.flip(data, axis=axis)
+
+
+def _legacy_topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False,
+                 dtype="float32", **kwargs):
+    from .. import numpy_extension as _npx
+    return _npx.topk(data, k=k, axis=axis, ret_typ=ret_typ,
+                     is_ascend=is_ascend, dtype=dtype)
+
+
+_LEGACY_OPS = {
+    "sort": _legacy_sort,
+    "argsort": _legacy_argsort,
+    "reverse": _legacy_reverse,
+    "topk": _legacy_topk,
+}
+
+
 def __getattr__(name):
     # Delegate op lookups to the numpy namespace (lazy to avoid cycles).
     from .. import numpy as _np
@@ -22,4 +70,6 @@ def __getattr__(name):
         return _io.save
     if name == "load":
         return _io.load
+    if name in _LEGACY_OPS:
+        return _LEGACY_OPS[name]
     return getattr(_np, name)

@@ -447,9 +447,20 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
 def topk(x, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
     ax = axis % x.ndim
     xm = jnp.moveaxis(x, ax, -1)
-    vals, idx = lax.top_k(-xm if is_ascend else xm, k)
     if is_ascend:
-        vals = -vals
+        # bottom-k via stable ascending argsort: negation would wrap
+        # unsigned dtypes (and INT_MIN) and rank them wrongly
+        idx = jnp.argsort(xm, axis=-1)[..., :k]
+        vals = jnp.take_along_axis(xm, idx, -1)
+    else:
+        vals, idx = lax.top_k(xm, k)
+    if ret_typ == "mask":
+        # 0/1 mask in the data dtype with ones at top-k positions
+        # (parity: src/operator/tensor/ordering_op-inl.h kReturnMask).
+        # idx still indexes the last (sort) axis here; top_k indices
+        # are distinct, so summing the k one-hots stays 0/1.
+        onehot = jax.nn.one_hot(idx, xm.shape[-1], dtype=x.dtype)
+        return jnp.moveaxis(onehot.sum(-2), -1, ax)
     vals = jnp.moveaxis(vals, -1, ax)
     idx = jnp.moveaxis(idx, -1, ax)
     if ret_typ == "indices":

@@ -1,0 +1,253 @@
+"""Conformance batch: legacy ordering ops, topk mask, batch/group norm
+exact semantics, dropout statistics, special functions.
+
+Reference semantics pinned here:
+- ordering: src/operator/tensor/ordering_op.cc (sort/argsort `is_ascend`,
+  float32 index dtype default, topk ret_typ incl. kReturnMask)
+- reverse: src/operator/tensor/matrix_op.cc (= flip along axes)
+- batch_norm: src/operator/nn/batch_norm.cc:169,266-270 — training-mode
+  output uses BIASED batch variance; running stats update as
+  running*momentum + batch_stat*(1-momentum) with the biased variance
+- group_norm: src/operator/nn/group_norm.cc:50-51 — gamma/beta are
+  per-CHANNEL (shape C), normalization is per (group, sample)
+- dropout: src/operator/nn/dropout.cc — inverted scaling 1/(1-p);
+  `axes` lists the axes the mask is BROADCAST along (mask dim -> 1)
+- special functions: unary math ops (gamma/gammaln/erf/erfinv/digamma)
+  vs scipy oracles (reference test_operator.py
+  test_special_functions_using_scipy)
+"""
+import numpy as onp
+import pytest
+import scipy.special as sps
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np as mnp, npx
+from mxnet_tpu.gluon import nn
+
+
+# --------------------------------------------------------------------
+# legacy ordering namespace (mx.nd.*)
+# --------------------------------------------------------------------
+X = onp.array([[3.0, 1.0, 2.0, 2.0],
+               [0.0, -1.0, 5.0, 4.0]], dtype="float32")
+
+
+def test_nd_sort_ascend_descend():
+    got = mx.nd.sort(mx.nd.array(X), axis=-1).asnumpy()
+    onp.testing.assert_array_equal(got, onp.sort(X, -1))
+    got = mx.nd.sort(mx.nd.array(X), axis=-1, is_ascend=False).asnumpy()
+    onp.testing.assert_array_equal(got, -onp.sort(-X, -1))
+
+
+def test_nd_argsort_dtype_and_order():
+    idx = mx.nd.argsort(mx.nd.array(X), axis=-1)
+    assert str(idx.dtype) == "float32"  # reference default index dtype
+    onp.testing.assert_array_equal(idx.asnumpy(),
+                                   onp.argsort(X, -1).astype("f4"))
+    # descending keeps stable tie order (argsort of the negation)
+    idx = mx.nd.argsort(mx.nd.array(X), axis=-1, is_ascend=False,
+                        dtype="int32")
+    assert str(idx.dtype) == "int32"
+    onp.testing.assert_array_equal(idx.asnumpy(), onp.argsort(-X, -1))
+
+
+def test_nd_argsort_axis_none_flattens():
+    idx = mx.nd.argsort(mx.nd.array(X), axis=None)
+    onp.testing.assert_array_equal(idx.asnumpy(),
+                                   onp.argsort(X, None).astype("f4"))
+
+
+def test_nd_reverse():
+    got = mx.nd.reverse(mx.nd.array(X), axis=1).asnumpy()
+    onp.testing.assert_array_equal(got, X[:, ::-1])
+    got = mx.nd.reverse(mx.nd.array(X), axis=0).asnumpy()
+    onp.testing.assert_array_equal(got, X[::-1])
+
+
+def test_nd_topk_delegates():
+    got = mx.nd.topk(mx.nd.array(X), k=2, ret_typ="value").asnumpy()
+    onp.testing.assert_array_equal(got, -onp.sort(-X, -1)[:, :2])
+
+
+def test_topk_mask():
+    m = npx.topk(mnp.array(X), k=2, ret_typ="mask")
+    assert str(m.dtype) == "float32"  # mask carries the data dtype
+    want = onp.zeros_like(X)
+    order = onp.argsort(-X, axis=-1, kind="stable")[:, :2]
+    onp.put_along_axis(want, order, 1.0, -1)
+    onp.testing.assert_array_equal(m.asnumpy(), want)
+    assert m.asnumpy().sum() == 4  # exactly k ones per row
+
+
+def test_topk_mask_ascend_int_dtype():
+    xi = mnp.array(X.astype("int32"))
+    m = npx.topk(xi, k=1, axis=0, ret_typ="mask", is_ascend=True)
+    assert str(m.dtype) == "int32"
+    want = onp.zeros_like(X, dtype="i4")
+    onp.put_along_axis(want, onp.argsort(X, axis=0, kind="stable")[:1],
+                       1, 0)
+    onp.testing.assert_array_equal(m.asnumpy(), want)
+
+
+def test_topk_ascend_unsigned_no_wraparound():
+    """Negating a uint array wraps (0 -> 0 stays minimal-looking);
+    bottom-k must still rank 0 as the smallest element."""
+    xu = mnp.array(onp.array([0, 5, 3], dtype="uint8"))
+    idx = npx.topk(xu, k=1, is_ascend=True, ret_typ="indices",
+                   dtype="int32")
+    onp.testing.assert_array_equal(idx.asnumpy(), [0])
+    m = npx.topk(xu, k=1, is_ascend=True, ret_typ="mask")
+    onp.testing.assert_array_equal(m.asnumpy(), [1, 0, 0])
+
+
+def test_nd_argsort_descend_unsigned():
+    xu = mx.nd.array(onp.array([0, 5, 3, 255], dtype="uint8"))
+    idx = mx.nd.argsort(xu, is_ascend=False, dtype="int32").asnumpy()
+    vals = onp.array([0, 5, 3, 255])[idx]
+    onp.testing.assert_array_equal(vals, [255, 5, 3, 0])
+
+
+# --------------------------------------------------------------------
+# batch/group norm exact semantics
+# --------------------------------------------------------------------
+def test_batch_norm_training_uses_biased_batch_stats():
+    x = onp.random.RandomState(0).randn(4, 3, 5).astype("f4")
+    g = onp.array([1.5, 2.0, 0.5], "f4")
+    b = onp.array([0.1, -0.2, 0.3], "f4")
+    mean = x.mean(axis=(0, 2))
+    var = x.var(axis=(0, 2))  # biased (1/N) — batch_norm.cc:169
+    want = ((x - mean[None, :, None])
+            / onp.sqrt(var[None, :, None] + 1e-5)
+            * g[None, :, None] + b[None, :, None])
+    with autograd.train_mode():
+        got = npx.batch_norm(
+            mnp.array(x), mnp.array(g), mnp.array(b),
+            mnp.array(onp.zeros(3, "f4")), mnp.array(onp.ones(3, "f4")),
+            eps=1e-5, momentum=0.9, axis=1)
+    onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_batch_norm_running_stats_update_formula():
+    """running <- running*momentum + batch_stat*(1-momentum), with the
+    BIASED batch variance (batch_norm.cc:266-270)."""
+    bn = nn.BatchNorm(momentum=0.9, in_channels=3)
+    bn.initialize()
+    x = onp.random.RandomState(1).randn(4, 3, 5).astype("f4")
+    with autograd.record():
+        bn(mnp.array(x))
+    rm = bn.running_mean.data().asnumpy()
+    rv = bn.running_var.data().asnumpy()
+    onp.testing.assert_allclose(rm, x.mean((0, 2)) * 0.1, rtol=1e-5,
+                                atol=1e-6)
+    onp.testing.assert_allclose(rv, 0.9 + x.var((0, 2)) * 0.1,
+                                rtol=1e-5, atol=1e-6)
+    # ddof=1 would be wrong: make sure the suite would catch it
+    assert not onp.allclose(rv, 0.9 + x.var((0, 2), ddof=1) * 0.1,
+                            rtol=1e-5, atol=1e-6)
+
+
+def test_group_norm_per_channel_affine():
+    x = onp.random.RandomState(4).randn(2, 4, 6).astype("f4")
+    gam = onp.array([1.5, 2.0, 0.5, 1.0], "f4")   # shape = C
+    bet = onp.array([0.1, -0.2, 0.3, 0.0], "f4")  # group_norm.cc:50-51
+    got = npx.group_norm(mnp.array(x), mnp.array(gam), mnp.array(bet),
+                         num_groups=2, eps=1e-5)
+    xr = x.reshape(2, 2, 2, 6)
+    mu = xr.mean((2, 3), keepdims=True)
+    va = xr.var((2, 3), keepdims=True)
+    want = (((xr - mu) / onp.sqrt(va + 1e-5)).reshape(2, 4, 6)
+            * gam[None, :, None] + bet[None, :, None])
+    onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+# --------------------------------------------------------------------
+# dropout statistics + axes broadcast direction
+# --------------------------------------------------------------------
+def test_dropout_inverted_scaling_and_rate():
+    with autograd.train_mode():
+        d = npx.dropout(mnp.ones((4000,), dtype="f4"), p=0.3).asnumpy()
+    nz = d[d != 0]
+    onp.testing.assert_allclose(nz, 1.0 / 0.7, rtol=1e-4)
+    assert 0.25 < (d == 0).mean() < 0.35
+
+
+def test_dropout_eval_mode_is_identity():
+    got = npx.dropout(mnp.ones((8,), dtype="f4"), p=0.3).asnumpy()
+    onp.testing.assert_array_equal(got, onp.ones(8, "f4"))
+
+
+def test_dropout_axes_broadcasts_mask():
+    """axes=(0,) shares ONE mask across axis 0: every column is either
+    fully dropped or fully kept (dropout.cc variational axes)."""
+    with autograd.train_mode():
+        d = npx.dropout(mnp.ones((200, 16), dtype="f4"), p=0.5,
+                        axes=(0,)).asnumpy()
+    col_zero = (d == 0).all(axis=0)
+    col_keep = (d != 0).all(axis=0)
+    assert bool(onp.all(col_zero | col_keep))
+    assert 0 < col_zero.sum() < 16  # some columns dropped, not all
+
+
+# --------------------------------------------------------------------
+# special functions vs scipy oracles
+# --------------------------------------------------------------------
+XS = onp.array([0.1, 0.5, 1.5, 3.0], dtype="f4")
+
+
+@pytest.mark.parametrize("name,arg,oracle", [
+    ("gamma", XS, sps.gamma),
+    ("gammaln", XS, sps.gammaln),
+    ("digamma", XS, sps.digamma),
+    ("erf", XS, sps.erf),
+    ("erfinv", XS * 0.3, sps.erfinv),
+])
+def test_special_function(name, arg, oracle):
+    fn = getattr(npx, name)
+    got = fn(mnp.array(arg)).asnumpy()
+    onp.testing.assert_allclose(got, oracle(arg).astype("f4"),
+                                rtol=2e-4, atol=1e-6)
+
+
+# --------------------------------------------------------------------
+# take modes + gradient accumulation
+# --------------------------------------------------------------------
+def test_take_clip_and_wrap_modes():
+    a = onp.arange(12.0, dtype="f4").reshape(3, 4)
+    idx = onp.array([-2, 1, 5], "i4")
+    got = mnp.take(mnp.array(a), mnp.array(idx), axis=0, mode="clip")
+    onp.testing.assert_array_equal(got.asnumpy(),
+                                   onp.take(a, onp.clip(idx, 0, 2), 0))
+    got = mnp.take(mnp.array(a), mnp.array(idx), axis=0, mode="wrap")
+    onp.testing.assert_array_equal(got.asnumpy(), onp.take(a, idx % 3, 0))
+
+
+def test_take_gradient_accumulates_duplicates():
+    a = onp.arange(12.0, dtype="f4").reshape(3, 4)
+    av = mnp.array(a)
+    av.attach_grad()
+    with autograd.record():
+        out = mnp.take(av, mnp.array(onp.array([0, 0, 2], "i4")), axis=0)
+        (out * out).sum().backward()
+    want = onp.zeros_like(a)
+    for i in [0, 0, 2]:
+        want[i] += 2 * a[i]
+    onp.testing.assert_allclose(av.grad.asnumpy(), want, rtol=1e-5)
+
+
+# --------------------------------------------------------------------
+# softmax temperature / output dtype promotion
+# --------------------------------------------------------------------
+def test_softmax_temperature():
+    x = onp.random.RandomState(1).randn(3, 4).astype("f4")
+    got = npx.softmax(mnp.array(x), temperature=2.0).asnumpy()
+    e = onp.exp((x - x.max(-1, keepdims=True)) / 2.0)
+    onp.testing.assert_allclose(got, e / e.sum(-1, keepdims=True),
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_dtype_promotion():
+    x = mnp.array(onp.random.RandomState(1).randn(3, 4).astype("f2"))
+    got = npx.softmax(x, dtype="float32")
+    assert str(got.dtype) == "float32"

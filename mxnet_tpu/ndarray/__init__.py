@@ -60,6 +60,79 @@ _LEGACY_OPS = {
     "topk": _legacy_topk,
 }
 
+# Legacy CamelCase operator names (the reference's original imperative
+# namespace, e.g. mx.nd.Convolution — src/operator/nn/*.cc NNVM
+# registrations). Each delegates to the snake_case npx op with the
+# same semantics so reference-era scripts run unchanged.
+_CAMEL_TO_NPX = {
+    "Activation": "activation",
+    "BatchNorm": "batch_norm",
+    "Convolution": "convolution",
+    "Deconvolution": "deconvolution",
+    "Dropout": "dropout",
+    "Embedding": "embedding",
+    "FullyConnected": "fully_connected",
+    "LayerNorm": "layer_norm",
+    "GroupNorm": "group_norm",
+    "InstanceNorm": "instance_norm",
+    "LeakyReLU": "leaky_relu",
+    "Pooling": "pooling",
+    "RNN": "rnn",
+    "SequenceMask": "sequence_mask",
+    "SequenceLast": "sequence_last",
+    "SequenceReverse": "sequence_reverse",
+    "L2Normalization": "l2_normalization",
+    "LRN": "lrn",
+    "Custom": "custom",
+    "ROIPooling": "roi_pooling",
+    "ROIAlign": "roi_align",
+    "BlockGrad": "stop_gradient",
+    "UpSampling": "upsampling",
+    "SoftmaxOutput": "softmax_output",
+    "MakeLoss": "make_loss",
+    "BilinearSampler": "bilinear_sampler",
+    "GridGenerator": "grid_generator",
+    "SpatialTransformer": "spatial_transformer",
+    "Correlation": "correlation",
+}
+
+
+def _camel_wrappers():
+    """CamelCase ops whose legacy signatures need adapting rather than
+    delegating 1:1 (matrix_op.cc / slice_channel.cc attr names)."""
+    from .. import numpy as _np
+    from .. import numpy_extension as _npx
+
+    def Concat(*data, dim=1, num_args=None, **kw):
+        return _np.concatenate(data, axis=dim)
+
+    def SliceChannel(data, num_outputs=1, axis=1, squeeze_axis=False,
+                     **kw):
+        outs = _np.split(data, num_outputs, axis=axis)
+        if squeeze_axis:
+            outs = [o.squeeze(axis) for o in outs]
+        return outs
+
+    def SwapAxis(data, dim1=0, dim2=0, **kw):
+        return _np.swapaxes(data, dim1, dim2)
+
+    def Cast(data, dtype="float32", **kw):
+        return data.astype(dtype)
+
+    def Flatten(data, **kw):
+        return data.reshape(data.shape[0], -1)
+
+    def SoftmaxActivation(data, mode="instance", **kw):
+        return _npx.softmax(data, axis=1 if mode == "channel" else -1)
+
+    def ElementWiseSum(*data, num_args=None, **kw):
+        out = data[0]
+        for d in data[1:]:
+            out = out + d
+        return out
+
+    return {k: v for k, v in locals().items() if not k.startswith("_")}
+
 
 def __getattr__(name):
     # Delegate op lookups to the numpy namespace (lazy to avoid cycles).
@@ -72,4 +145,14 @@ def __getattr__(name):
         return _io.load
     if name in _LEGACY_OPS:
         return _LEGACY_OPS[name]
+    if name in _CAMEL_TO_NPX:
+        from .. import numpy_extension as _npx
+        fn = getattr(_npx, _CAMEL_TO_NPX[name])
+        globals()[name] = fn  # cache: next access skips __getattr__
+        return fn
+    if name[:1].isupper():
+        wrappers = _camel_wrappers()
+        if name in wrappers:
+            globals().update(wrappers)  # build the closures only once
+            return wrappers[name]
     return getattr(_np, name)

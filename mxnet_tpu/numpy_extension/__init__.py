@@ -807,3 +807,146 @@ def custom(*data, op_type, **kwargs):
     Thin alias for mxnet_tpu.operator.custom."""
     from .. import operator as _operator
     return _operator.custom(*data, op_type=op_type, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# legacy training-head ops (SoftmaxOutput / MakeLoss / UpSampling)
+# ---------------------------------------------------------------------------
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False,
+                   preserve_shape=False, normalization="null",
+                   out_grad=False, smooth_alpha=0.0, **kwargs):
+    """Legacy softmax + cross-entropy head (parity:
+    src/operator/softmax_output.cc). Forward is softmax over the class
+    axis (axis 1 when multi_output, else the last axis); backward to
+    `data` is the straight-through CE gradient
+    ``(p - onehot(label)) * grad_scale`` — the head gradient is ignored
+    (out_grad=False reference default). `use_ignore` zeroes gradients
+    where ``label == ignore_label``; normalization 'batch'/'valid'
+    divides by batch size / non-ignored count."""
+    gs = float(grad_scale)
+    ig = float(ignore_label)
+    ui = bool(use_ignore)
+    norm = str(normalization)
+    sa = float(smooth_alpha)
+    mo = bool(multi_output)
+    ps = bool(preserve_shape)
+
+    def _view(x):
+        # class-axis layout (softmax_output.cc): multi_output -> axis 1;
+        # preserve_shape -> last axis; default -> flatten to (N, -1)
+        if mo:
+            return x, 1
+        if ps or x.ndim <= 2:
+            return x, -1
+        return x.reshape(x.shape[0], -1), -1
+
+    @jax.custom_vjp
+    def _fn(x, lab):
+        xv, axis = _view(x)
+        return jax.nn.softmax(xv, axis=axis).reshape(x.shape)
+
+    def _fwd(x, lab):
+        return _fn(x, lab), (x, lab)
+
+    def _bwd(res, g):
+        x, lab = res
+        xv, axis = _view(x)
+        p = jax.nn.softmax(xv, axis=axis)
+        n_class = p.shape[axis]
+        oh = jax.nn.one_hot(lab.astype(jnp.int32), n_class, axis=axis,
+                            dtype=p.dtype)
+        if sa > 0.0:
+            # distribute alpha of the target mass over the other bins
+            oh = oh * (1.0 - sa) + (sa / max(n_class - 1, 1)) * (1.0 - oh)
+        grad = (p - oh) * gs
+        valid = None
+        if ui:
+            valid = lab.astype(p.dtype) != ig
+            ax = axis if axis >= 0 else p.ndim + axis
+            grad = jnp.where(jnp.expand_dims(valid, ax), grad,
+                             jnp.zeros_like(grad))
+        if norm == "batch":
+            grad = grad / p.shape[0]
+        elif norm == "valid":
+            denom = valid.sum() if valid is not None else lab.size
+            grad = grad / jnp.maximum(denom, 1).astype(p.dtype)
+        return grad.reshape(x.shape), None
+
+    _fn.defvjp(_fwd, _bwd)
+    return apply_op(_fn, _c(data), _c(label), name="softmax_output")
+
+
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null", **kwargs):
+    """Legacy loss-head marker (parity: src/operator/make_loss.cc).
+    Forward is identity; backward injects ``grad_scale`` per element
+    (ignoring the incoming head gradient), divided by batch size
+    ('batch') or by the count of elements above ``valid_thresh``
+    ('valid')."""
+    gs = float(grad_scale)
+    vt = float(valid_thresh)
+    norm = str(normalization)
+
+    @jax.custom_vjp
+    def _fn(x):
+        return x
+
+    def _fwd(x):
+        return x, x
+
+    def _bwd(x, g):
+        grad = jnp.full_like(x, gs)
+        if norm == "batch":
+            grad = grad / x.shape[0]
+        elif norm == "valid":
+            denom = (x > vt).sum()
+            grad = grad / jnp.maximum(denom, 1).astype(x.dtype)
+        return (grad,)
+
+    _fn.defvjp(_fwd, _bwd)
+    return apply_op(_fn, _c(data), name="make_loss")
+
+
+def upsampling(*data, scale=1, num_filter=0, sample_type="nearest",
+               multi_input_mode="concat", num_args=None, workspace=None,
+               **kwargs):
+    """Spatial upsampling, NCHW (parity: src/operator/nn/upsampling.cc
+    UpSampling). 'nearest' repeats pixels; multiple inputs are each
+    upsampled by `scale` and concatenated on the channel axis
+    (multi_input_mode='concat') or summed ('sum'). 'bilinear' is the
+    reference's grouped-Deconvolution formulation: inputs are
+    (data, weight) with kernel 2*scale - scale%2, stride scale,
+    pad ceil((scale-1)/2), one filter group per channel."""
+    s = int(scale)
+    if sample_type == "bilinear":
+        if len(data) != 2:
+            raise ValueError("bilinear UpSampling takes (data, weight)")
+        d, w = data
+        k = 2 * s - s % 2
+        p = int(math.ceil((s - 1) / 2))
+        return deconvolution(d, w, kernel=(k, k), stride=(s, s),
+                             pad=(p, p), num_filter=num_filter,
+                             num_group=num_filter, no_bias=True)
+    if sample_type != "nearest":
+        raise ValueError(f"unsupported sample_type {sample_type!r}")
+
+    # per-input scale (upsampling.cc): every input is brought to the
+    # FIRST input's size * scale, so a feature pyramid fuses cleanly
+    first = _c(data[0])
+    out_h, out_w = first.shape[-2] * s, first.shape[-1] * s
+
+    def _up_to(x):
+        rh, rw = out_h // x.shape[-2], out_w // x.shape[-1]
+        return jnp.repeat(jnp.repeat(x, rh, axis=-2), rw, axis=-1)
+
+    outs = [apply_op(_up_to, _c(d), name="upsampling") for d in data]
+    if len(outs) == 1:
+        return outs[0]
+    from .. import numpy as _np
+    if multi_input_mode == "sum":
+        out = outs[0]
+        for o in outs[1:]:
+            out = out + o
+        return out
+    return _np.concatenate(outs, axis=1)

@@ -1,0 +1,229 @@
+"""Legacy CamelCase imperative namespace (mx.nd.Convolution & co) and
+the training-head ops SoftmaxOutput / MakeLoss / UpSampling.
+
+Parity targets:
+- CamelCase registrations: the reference's original operator names
+  (src/operator/nn/*.cc, e.g. nd.FullyConnected, nd.BatchNorm) that
+  reference-era scripts call imperatively
+- SoftmaxOutput: src/operator/softmax_output.cc — forward softmax,
+  backward (p - onehot)*grad_scale with ignore/normalization
+- MakeLoss: src/operator/make_loss.cc — identity forward, grad_scale
+  injected on backward
+- UpSampling: src/operator/nn/upsampling.cc — nearest repeat +
+  multi-input concat/sum; bilinear = grouped Deconvolution
+"""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, np as mnp
+
+
+def test_fully_connected_camel():
+    x = onp.random.RandomState(0).randn(4, 6).astype("f4")
+    w = onp.random.RandomState(1).randn(3, 6).astype("f4")
+    b = onp.array([0.1, -0.2, 0.3], "f4")
+    got = mx.nd.FullyConnected(mx.nd.array(x), mx.nd.array(w),
+                               mx.nd.array(b), num_hidden=3)
+    onp.testing.assert_allclose(got.asnumpy(), x @ w.T + b, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_activation_convolution_pooling_camel():
+    x = onp.random.RandomState(0).randn(1, 2, 6, 6).astype("f4")
+    w = onp.random.RandomState(1).randn(3, 2, 3, 3).astype("f4")
+    act = mx.nd.Activation(mx.nd.array(x), act_type="relu")
+    onp.testing.assert_array_equal(act.asnumpy(), onp.maximum(x, 0))
+    conv = mx.nd.Convolution(mx.nd.array(x), mx.nd.array(w),
+                             kernel=(3, 3), num_filter=3, no_bias=True)
+    assert conv.shape == (1, 3, 4, 4)
+    pool = mx.nd.Pooling(mx.nd.array(x), kernel=(2, 2), stride=(2, 2),
+                         pool_type="max")
+    want = x.reshape(1, 2, 3, 2, 3, 2).max((3, 5))
+    onp.testing.assert_allclose(pool.asnumpy(), want, rtol=1e-6)
+
+
+def test_batchnorm_camel_inference():
+    x = onp.random.RandomState(0).randn(2, 3, 4).astype("f4")
+    g = onp.ones(3, "f4")
+    b = onp.zeros(3, "f4")
+    rm = onp.array([0.1, 0.2, 0.3], "f4")
+    rv = onp.array([1.0, 2.0, 0.5], "f4")
+    got = mx.nd.BatchNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          mx.nd.array(rm), mx.nd.array(rv), eps=1e-5)
+    want = (x - rm[None, :, None]) / onp.sqrt(rv[None, :, None] + 1e-5)
+    onp.testing.assert_allclose(got.asnumpy(), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_concat_slicechannel_swapaxis_cast_flatten():
+    a = onp.arange(6.0, dtype="f4").reshape(2, 3)
+    b = onp.arange(6.0, 12.0, dtype="f4").reshape(2, 3)
+    got = mx.nd.Concat(mx.nd.array(a), mx.nd.array(b), dim=1)
+    onp.testing.assert_array_equal(got.asnumpy(),
+                                   onp.concatenate([a, b], 1))
+    outs = mx.nd.SliceChannel(mx.nd.array(a), num_outputs=3, axis=1,
+                              squeeze_axis=True)
+    assert len(outs) == 3 and outs[0].shape == (2,)
+    onp.testing.assert_array_equal(outs[1].asnumpy(), a[:, 1])
+    x = onp.arange(24.0, dtype="f4").reshape(2, 3, 4)
+    onp.testing.assert_array_equal(
+        mx.nd.SwapAxis(mx.nd.array(x), dim1=0, dim2=2).asnumpy(),
+        x.swapaxes(0, 2))
+    assert str(mx.nd.Cast(mx.nd.array(a), dtype="int32").dtype) == "int32"
+    onp.testing.assert_array_equal(
+        mx.nd.Flatten(mx.nd.array(x)).asnumpy(), x.reshape(2, 12))
+    got = mx.nd.ElementWiseSum(mx.nd.array(a), mx.nd.array(b),
+                               mx.nd.array(a))
+    onp.testing.assert_allclose(got.asnumpy(), a + b + a, rtol=1e-6)
+
+
+def test_blockgrad_stops_gradient():
+    x = mnp.array(onp.array([1.0, 2.0], "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = (mx.nd.BlockGrad(x * 2.0) * x).sum()
+        y.backward()
+    # d/dx [stop(2x) * x] = stop(2x) = 2x
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 4.0], rtol=1e-6)
+
+
+def test_softmax_output_forward_and_gradient():
+    x = onp.random.RandomState(0).randn(4, 3).astype("f4")
+    lab = onp.array([0, 2, 1, 2], "f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        p = mx.nd.SoftmaxOutput(xv, mnp.array(lab))
+        p.sum().backward()
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(p.asnumpy(), sm, rtol=1e-5, atol=1e-6)
+    oh = onp.eye(3, dtype="f4")[lab.astype("i4")]
+    # straight-through CE grad, head gradient ignored
+    onp.testing.assert_allclose(xv.grad.asnumpy(), sm - oh, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_softmax_output_ignore_and_valid_normalization():
+    x = onp.random.RandomState(1).randn(4, 3).astype("f4")
+    lab = onp.array([0, -1, 1, -1], "f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        p = mx.nd.SoftmaxOutput(xv, mnp.array(lab), use_ignore=True,
+                                ignore_label=-1,
+                                normalization="valid")
+        p.sum().backward()
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    oh = onp.zeros((4, 3), "f4")
+    oh[0, 0] = 1.0
+    oh[2, 1] = 1.0
+    want = (sm - oh) / 2.0  # 2 valid rows
+    want[1] = want[3] = 0.0
+    onp.testing.assert_allclose(xv.grad.asnumpy(), want, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_make_loss_gradient_injection():
+    x = onp.array([[1.0, -2.0], [3.0, 4.0]], "f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        out = mx.nd.MakeLoss(xv * 2.0, grad_scale=0.5)
+        # head gradient (from the extra *10) must be ignored
+        (out * 10.0).sum().backward()
+    onp.testing.assert_allclose(out.asnumpy(), x * 2.0, rtol=1e-6)
+    onp.testing.assert_allclose(xv.grad.asnumpy(),
+                                onp.full_like(x, 0.5 * 2.0), rtol=1e-5)
+
+
+def test_upsampling_nearest_and_multi_input():
+    x = onp.arange(4.0, dtype="f4").reshape(1, 1, 2, 2)
+    got = mx.nd.UpSampling(mx.nd.array(x), scale=2,
+                           sample_type="nearest")
+    onp.testing.assert_array_equal(got.asnumpy(),
+                                   x.repeat(2, 2).repeat(2, 3))
+    y = x + 10.0
+    got = mx.nd.UpSampling(mx.nd.array(x), mx.nd.array(y), scale=2,
+                           sample_type="nearest",
+                           multi_input_mode="concat")
+    assert got.shape == (1, 2, 4, 4)
+    onp.testing.assert_array_equal(got.asnumpy()[:, 1],
+                                   y.repeat(2, 2).repeat(2, 3)[:, 0])
+    got = mx.nd.UpSampling(mx.nd.array(x), mx.nd.array(y), scale=2,
+                           sample_type="nearest",
+                           multi_input_mode="sum")
+    onp.testing.assert_array_equal(
+        got.asnumpy(), (x + y).repeat(2, 2).repeat(2, 3))
+
+
+def test_upsampling_pyramid_inputs_reach_common_size():
+    """Different-sized inputs each upsample to first_size*scale
+    (upsampling.cc per-input scale), so a feature pyramid concats."""
+    a = onp.arange(4.0, dtype="f4").reshape(1, 1, 2, 2)
+    b = onp.arange(16.0, dtype="f4").reshape(1, 1, 4, 4)
+    got = mx.nd.UpSampling(mx.nd.array(a), mx.nd.array(b), scale=2,
+                           sample_type="nearest",
+                           multi_input_mode="concat")
+    assert got.shape == (1, 2, 4, 4)
+    onp.testing.assert_array_equal(got.asnumpy()[:, 0],
+                                   a.repeat(2, 2).repeat(2, 3)[:, 0])
+    onp.testing.assert_array_equal(got.asnumpy()[:, 1], b[:, 0])
+
+
+def test_softmax_output_flattens_higher_rank_by_default():
+    """multi_output=False, preserve_shape=False, ndim>2: classes are
+    the flattened trailing dims (softmax_output.cc default layout)."""
+    x = onp.random.RandomState(0).randn(2, 3, 4).astype("f4")
+    lab = onp.array([5, 11], "f4")  # flattened class ids in [0, 12)
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        p = mx.nd.SoftmaxOutput(xv, mnp.array(lab))
+        p.sum().backward()
+    flat = x.reshape(2, 12)
+    e = onp.exp(flat - flat.max(-1, keepdims=True))
+    sm = e / e.sum(-1, keepdims=True)
+    onp.testing.assert_allclose(p.asnumpy(), sm.reshape(2, 3, 4),
+                                rtol=1e-5, atol=1e-6)
+    oh = onp.eye(12, dtype="f4")[[5, 11]]
+    onp.testing.assert_allclose(xv.grad.asnumpy(),
+                                (sm - oh).reshape(2, 3, 4), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_softmax_output_multi_output_axis1():
+    """multi_output=True: class axis is 1, label shape (N, d1...)."""
+    x = onp.random.RandomState(2).randn(2, 3, 4).astype("f4")
+    lab = (onp.random.RandomState(3).uniform(size=(2, 4)) * 3) \
+        .astype("f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        p = mx.nd.SoftmaxOutput(xv, mnp.array(lab), multi_output=True)
+        p.sum().backward()
+    e = onp.exp(x - x.max(1, keepdims=True))
+    sm = e / e.sum(1, keepdims=True)
+    onp.testing.assert_allclose(p.asnumpy(), sm, rtol=1e-5, atol=1e-6)
+    oh = onp.zeros_like(x)
+    for n in range(2):
+        for d in range(4):
+            oh[n, int(lab[n, d]), d] = 1.0
+    onp.testing.assert_allclose(xv.grad.asnumpy(), sm - oh, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_upsampling_bilinear_matches_direct_deconvolution():
+    from mxnet_tpu import npx
+    x = onp.random.RandomState(0).randn(1, 2, 3, 3).astype("f4")
+    # per-channel 4x4 bilinear kernels (scale=2 -> k=4, pad=1)
+    w = onp.random.RandomState(1).randn(2, 1, 4, 4).astype("f4")
+    got = mx.nd.UpSampling(mx.nd.array(x), mx.nd.array(w), scale=2,
+                           sample_type="bilinear", num_filter=2)
+    want = npx.deconvolution(mnp.array(x), mnp.array(w),
+                             kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                             num_filter=2, num_group=2, no_bias=True)
+    onp.testing.assert_allclose(got.asnumpy(), want.asnumpy(),
+                                rtol=1e-5, atol=1e-5)
+    assert got.shape == (1, 2, 6, 6)

@@ -121,3 +121,95 @@ def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001 - parity signat
 
 def reset_np():
     return None
+
+
+def legacy_reshape_shape(in_shape, shape, reverse=False):
+    """Decode the reference Reshape op's special codes into a concrete
+    output shape (parity: src/operator/tensor/matrix_op-inl.h
+    InferReshapeShape; docs src/operator/tensor/matrix_op.cc:146-184).
+
+    Codes: 0 copies the positionally matching input dim; -1 infers one
+    dim from the remaining size; -2 copies all remaining input dims;
+    -3 merges two consecutive input dims; -4 d1 d2 splits one input dim
+    (d1 or d2 may be -1). With ``reverse=True`` codes are matched from
+    the right.
+    """
+    in_shape = tuple(int(d) for d in in_shape)
+    tgt = [int(s) for s in shape]
+    if reverse:
+        if -4 in tgt:
+            raise ValueError("legacy reshape: reverse=True with a -4 "
+                             "split code is not supported")
+        out = legacy_reshape_shape(in_shape[::-1], tgt[::-1])
+        return tuple(out)[::-1]
+
+    total = 1
+    for d in in_shape:
+        total *= d
+    out = []
+    i_in = 0
+    infer_at = None
+    i = 0
+    while i < len(tgt):
+        s = tgt[i]
+        if s > 0:
+            out.append(s)
+            i_in += 1
+        elif s == 0:
+            if i_in >= len(in_shape):
+                raise ValueError(f"legacy reshape: 0 at position {i} "
+                                 f"has no matching input dim "
+                                 f"(input {in_shape})")
+            out.append(in_shape[i_in])
+            i_in += 1
+        elif s == -1:
+            if infer_at is not None:
+                raise ValueError("legacy reshape: at most one -1")
+            infer_at = len(out)
+            out.append(-1)
+            i_in += 1
+        elif s == -2:
+            out.extend(in_shape[i_in:])
+            i_in = len(in_shape)
+        elif s == -3:
+            if i_in + 1 >= len(in_shape):
+                raise ValueError("legacy reshape: -3 needs two "
+                                 f"consecutive input dims (input "
+                                 f"{in_shape}, at input pos {i_in})")
+            out.append(in_shape[i_in] * in_shape[i_in + 1])
+            i_in += 2
+        elif s == -4:
+            if i + 2 >= len(tgt):
+                raise ValueError("legacy reshape: -4 must be followed "
+                                 "by two split dims")
+            if i_in >= len(in_shape):
+                raise ValueError("legacy reshape: -4 has no input dim "
+                                 "left to split")
+            d = in_shape[i_in]
+            d1, d2 = tgt[i + 1], tgt[i + 2]
+            if d1 == -1 and d2 == -1:
+                raise ValueError("legacy reshape: -4 split can infer "
+                                 "at most one side")
+            if d1 == -1:
+                d1 = d // d2
+            if d2 == -1:
+                d2 = d // d1
+            if d1 * d2 != d:
+                raise ValueError(f"legacy reshape: -4 split {d1}x{d2} "
+                                 f"!= input dim {d}")
+            out.extend([d1, d2])
+            i_in += 1
+            i += 2
+        else:
+            raise ValueError(f"legacy reshape: bad code {s}")
+        i += 1
+    if infer_at is not None:
+        known = 1
+        for d in out:
+            if d != -1:
+                known *= d
+        if known == 0 or total % known:
+            raise ValueError(f"legacy reshape: cannot infer -1 "
+                             f"({in_shape} -> {tuple(tgt)})")
+        out[infer_at] = total // known
+    return tuple(out)

@@ -90,6 +90,9 @@ _CAMEL_TO_NPX = {
     "UpSampling": "upsampling",
     "SoftmaxOutput": "softmax_output",
     "MakeLoss": "make_loss",
+    "LinearRegressionOutput": "linear_regression_output",
+    "MAERegressionOutput": "mae_regression_output",
+    "LogisticRegressionOutput": "logistic_regression_output",
     "BilinearSampler": "bilinear_sampler",
     "GridGenerator": "grid_generator",
     "SpatialTransformer": "spatial_transformer",
@@ -130,6 +133,51 @@ def _camel_wrappers():
         for d in data[1:]:
             out = out + d
         return out
+
+    def Reshape(data, shape=None, reverse=False, target_shape=None,
+                keep_highest=False, **kw):
+        # legacy special codes 0/-1/-2/-3/-4 (matrix_op-inl.h); the
+        # lowercase nd.reshape keeps numpy semantics by design
+        from ..base import legacy_reshape_shape
+        if shape is not None:
+            return data.reshape(legacy_reshape_shape(
+                data.shape, shape, reverse=reverse))
+        if target_shape is None:
+            raise ValueError("Reshape needs shape= (or the deprecated "
+                             "target_shape=)")
+        # deprecated target_shape path (matrix_op-inl.h:205-223):
+        # keep_highest pins dim 0; exactly one 0 entry is inferred
+        out = [int(s) for s in target_shape]
+        start = 0
+        if keep_highest:
+            out[0] = data.shape[0]
+            start = 1
+        zeros = [i for i in range(start, len(out)) if out[i] == 0]
+        if len(zeros) == 1:
+            known = 1
+            for i, d in enumerate(out):
+                if i != zeros[0]:
+                    known *= d
+            out[zeros[0]] = data.size // max(known, 1)
+        return data.reshape(tuple(out))
+
+    def Crop(*data, offset=(0, 0), h_w=(0, 0), center_crop=False, **kw):
+        # crop.cc: crop data (NCHW) to the size of the second input
+        # (or h_w), at `offset` or centered; out-of-range crops error
+        # like the reference CHECKs instead of silently clamping
+        x = data[0]
+        th, tw = (data[1].shape[2:4] if len(data) == 2
+                  else (int(h_w[0]), int(h_w[1])))
+        H, W = x.shape[2], x.shape[3]
+        if center_crop:
+            oy, ox = (H - th) // 2, (W - tw) // 2
+        else:
+            oy, ox = int(offset[0]), int(offset[1])
+        if oy < 0 or ox < 0 or oy + th > H or ox + tw > W:
+            raise ValueError(
+                f"Crop window ({th}, {tw}) at offset ({oy}, {ox}) "
+                f"exceeds input spatial dims ({H}, {W})")
+        return x[:, :, oy:oy + th, ox:ox + tw]
 
     return {k: v for k, v in locals().items() if not k.startswith("_")}
 

@@ -950,3 +950,42 @@ def upsampling(*data, scale=1, num_filter=0, sample_type="nearest",
             out = out + o
         return out
     return _np.concatenate(outs, axis=1)
+
+
+def _regression_output(name, fwd_fn, grad_fn):
+    def op(data, label, grad_scale=1.0, **kwargs):
+        gs = float(grad_scale)
+
+        @jax.custom_vjp
+        def _fn(x, lab):
+            return fwd_fn(x)
+
+        def _fwd(x, lab):
+            return fwd_fn(x), (x, lab)
+
+        def _bwd(res, g):
+            x, lab = res
+            # grad_scale / (elements per sample), head grad ignored
+            # (regression_output-inl.h:201-207)
+            num_output = max(lab.size // lab.shape[0], 1) \
+                if lab.ndim > 0 else 1
+            grad = grad_fn(fwd_fn(x), lab.astype(x.dtype)) \
+                * (gs / num_output)
+            return grad, None
+
+        _fn.defvjp(_fwd, _bwd)
+        return apply_op(_fn, _c(data), _c(label), name=name)
+    op.__name__ = name
+    op.__doc__ = (f"Legacy {name} head (parity: "
+                  "src/operator/regression_output.cc). Forward applies "
+                  "the link function; backward injects the regression "
+                  "gradient, ignoring the head gradient.")
+    return op
+
+
+linear_regression_output = _regression_output(
+    "linear_regression_output", lambda x: x, lambda p, l: p - l)
+mae_regression_output = _regression_output(
+    "mae_regression_output", lambda x: x, lambda p, l: jnp.sign(p - l))
+logistic_regression_output = _regression_output(
+    "logistic_regression_output", jax.nn.sigmoid, lambda p, l: p - l)

@@ -284,19 +284,13 @@ def _legacy_scalar(x, op=None, scalar=0.0, reverse=False):
     return fn(scalar, x) if reverse else fn(x, scalar)
 
 
-def _legacy_reshape(x, shape=None):
-    """Legacy Reshape with the reference's special codes: 0 copies the
-    input dim, -1 infers one dim (src/operator/tensor/matrix_op-inl.h
-    reshape semantics; -2/-3/-4 are not supported)."""
-    out = []
-    for i, s in enumerate(shape):
-        if s == 0:
-            out.append(x.shape[i])
-        elif s in (-2, -3, -4):
-            raise ValueError(f"legacy reshape code {s} not supported")
-        else:
-            out.append(s)
-    return x.reshape(tuple(out))
+def _legacy_reshape(x, shape=None, reverse=False):
+    """Legacy Reshape with the reference's full special-code set
+    (0/-1/-2/-3/-4, src/operator/tensor/matrix_op-inl.h
+    InferReshapeShape — decoded by base.legacy_reshape_shape)."""
+    from ..base import legacy_reshape_shape
+    return x.reshape(legacy_reshape_shape(x.shape, shape,
+                                          reverse=reverse))
 
 
 def _subgraph_eval(*ins, json=None):

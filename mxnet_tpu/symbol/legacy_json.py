@@ -142,9 +142,9 @@ def _slice_channel(inputs, attrs):
 
 def _reshape(inputs, attrs):
     shape = attrs.get("shape") or attrs.get("newshape")
-    if attrs.get("reverse"):
-        raise ValueError("legacy Reshape with reverse=True is not supported")
-    return "_legacy_reshape", [0], {"shape": list(shape)}
+    return "_legacy_reshape", [0], {"shape": list(shape),
+                                    "reverse": bool(attrs.get("reverse",
+                                                              False))}
 
 
 def _cast(inputs, attrs):

@@ -77,6 +77,62 @@ def test_concat_slicechannel_swapaxis_cast_flatten():
     onp.testing.assert_allclose(got.asnumpy(), a + b + a, rtol=1e-6)
 
 
+def test_legacy_reshape_special_codes():
+    """Every documented example from matrix_op.cc:146-184."""
+    from mxnet_tpu.base import legacy_reshape_shape as lrs
+    assert lrs((2, 3, 4), (4, 0, 2)) == (4, 3, 2)
+    assert lrs((2, 3, 4), (2, 0, 0)) == (2, 3, 4)
+    assert lrs((2, 3, 4), (6, 1, -1)) == (6, 1, 4)
+    assert lrs((2, 3, 4), (3, -1, 8)) == (3, 1, 8)
+    assert lrs((2, 3, 4), (-1,)) == (24,)
+    assert lrs((2, 3, 4), (-2,)) == (2, 3, 4)
+    assert lrs((2, 3, 4), (2, -2)) == (2, 3, 4)
+    assert lrs((2, 3, 4), (-2, 1, 1)) == (2, 3, 4, 1, 1)
+    assert lrs((2, 3, 4), (-3, 4)) == (6, 4)
+    assert lrs((2, 3, 4, 5), (-3, -3)) == (6, 20)
+    assert lrs((2, 3, 4), (0, -3)) == (2, 12)
+    assert lrs((2, 3, 4), (-3, -2)) == (6, 4)
+    assert lrs((2, 3, 4), (-4, 1, 2, -2)) == (1, 2, 3, 4)
+    assert lrs((2, 3, 4), (2, -4, -1, 3, -2)) == (2, 1, 3, 4)
+    # reverse examples (matrix_op.cc:180-184)
+    assert lrs((10, 5, 4), (-1, 0)) == (40, 5)
+    assert lrs((10, 5, 4), (-1, 0), reverse=True) == (50, 4)
+
+
+def test_nd_reshape_camel_applies_codes():
+    x = mx.nd.array(onp.arange(24.0, dtype="f4").reshape(2, 3, 4))
+    got = mx.nd.Reshape(x, shape=(-3, 4))
+    assert got.shape == (6, 4)
+    onp.testing.assert_array_equal(got.asnumpy(),
+                                   onp.arange(24.0).reshape(6, 4))
+    assert mx.nd.Reshape(x, shape=(0, -1)).shape == (2, 12)
+
+
+def test_crop_camel():
+    x = onp.arange(2 * 3 * 6 * 6, dtype="f4").reshape(2, 3, 6, 6)
+    got = mx.nd.Crop(mx.nd.array(x), h_w=(4, 4), offset=(1, 2))
+    onp.testing.assert_array_equal(got.asnumpy(), x[:, :, 1:5, 2:6])
+    ref = onp.zeros((2, 3, 2, 2), "f4")
+    got = mx.nd.Crop(mx.nd.array(x), mx.nd.array(ref), center_crop=True)
+    onp.testing.assert_array_equal(got.asnumpy(), x[:, :, 2:4, 2:4])
+    # out-of-range crops error (crop.cc CHECKs), no silent clamping
+    import pytest
+    with pytest.raises(ValueError):
+        mx.nd.Crop(mx.nd.array(x), h_w=(4, 4), offset=(4, 4))
+    with pytest.raises(ValueError):
+        mx.nd.Crop(mx.nd.array(x), h_w=(4, 4), offset=(-1, 0))
+
+
+def test_reshape_deprecated_target_shape():
+    x = mx.nd.array(onp.arange(24.0, dtype="f4").reshape(2, 3, 4))
+    assert mx.nd.Reshape(x, target_shape=(6, 0)).shape == (6, 4)
+    assert mx.nd.Reshape(x, target_shape=(9, 0, 4),
+                         keep_highest=True).shape == (2, 3, 4)
+    import pytest
+    with pytest.raises(ValueError):
+        mx.nd.Reshape(x)
+
+
 def test_blockgrad_stops_gradient():
     x = mnp.array(onp.array([1.0, 2.0], "f4"))
     x.attach_grad()
@@ -123,6 +179,45 @@ def test_softmax_output_ignore_and_valid_normalization():
     want[1] = want[3] = 0.0
     onp.testing.assert_allclose(xv.grad.asnumpy(), want, rtol=1e-4,
                                 atol=1e-5)
+
+
+def test_linear_regression_output_gradient():
+    """grad = (pred - label) * grad_scale / num_output_per_sample
+    (regression_output-inl.h:201-207); head gradient ignored."""
+    x = onp.random.RandomState(0).randn(4, 3).astype("f4")
+    lab = onp.random.RandomState(1).randn(4, 3).astype("f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        out = mx.nd.LinearRegressionOutput(xv, mnp.array(lab),
+                                           grad_scale=2.0)
+        (out * 7.0).sum().backward()
+    onp.testing.assert_allclose(out.asnumpy(), x, rtol=1e-6)
+    onp.testing.assert_allclose(xv.grad.asnumpy(),
+                                (x - lab) * 2.0 / 3.0, rtol=1e-4,
+                                atol=1e-6)
+
+
+def test_logistic_and_mae_regression_outputs():
+    x = onp.random.RandomState(2).randn(5, 2).astype("f4")
+    lab = (onp.random.RandomState(3).uniform(size=(5, 2)) > 0.5) \
+        .astype("f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        out = mx.nd.LogisticRegressionOutput(xv, mnp.array(lab))
+        out.sum().backward()
+    sig = 1.0 / (1.0 + onp.exp(-x))
+    onp.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+    onp.testing.assert_allclose(xv.grad.asnumpy(), (sig - lab) / 2.0,
+                                rtol=1e-4, atol=1e-6)
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        out = mx.nd.MAERegressionOutput(xv, mnp.array(lab))
+        out.sum().backward()
+    onp.testing.assert_allclose(xv.grad.asnumpy(),
+                                onp.sign(x - lab) / 2.0, rtol=1e-5)
 
 
 def test_make_loss_gradient_injection():

@@ -1,0 +1,59 @@
+"""DLPack interchange (parity: python/mxnet/dlpack.py — the reference
+exposes to_dlpack_for_read/to_dlpack_for_write/from_dlpack module
+functions on top of the NDArray capsule protocol).
+
+The NDArray already speaks the modern ``__dlpack__`` protocol
+(ndarray/ndarray.py:205); these wrappers keep reference call sites
+working. There is no read/write distinction here: jax.Array buffers
+are immutable, so every export is a read view and `from_dlpack`
+imports zero-copy where the backend allows it.
+"""
+from __future__ import annotations
+
+from .ndarray.ndarray import NDArray
+
+
+def to_dlpack_for_read(data):
+    """Export an NDArray as a DLPack capsule (read view)."""
+    arr = data
+    if isinstance(data, NDArray):
+        data.wait_to_read()
+        arr = data._data
+    return arr.__dlpack__()
+
+
+def to_dlpack_for_write(data):
+    """Reference-parity alias. jax.Array buffers are immutable, so a
+    writable export is not possible; the capsule is a read view and
+    in-place mutation of the consumer will not alias back."""
+    return to_dlpack_for_read(data)
+
+
+class _Capsule:
+    """Adapter: jax.numpy.from_dlpack only accepts objects speaking the
+    modern protocol, while reference call sites hold a raw PyCapsule.
+    A capsule carries no device metadata, so the import assumes host
+    (kDLCPU) memory — which is where reference to_dlpack consumers
+    exchange buffers in this single-process setting."""
+
+    def __init__(self, capsule):
+        self._capsule = capsule
+
+    def __dlpack__(self, **kwargs):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return (1, 0)  # kDLCPU
+
+
+def from_dlpack(dlpack):
+    """Import a DLPack capsule (or any object with ``__dlpack__``)
+    as an NDArray."""
+    import jax.numpy as jnp
+
+    if not hasattr(dlpack, "__dlpack__"):
+        dlpack = _Capsule(dlpack)
+    return NDArray(jnp.from_dlpack(dlpack))
+
+
+__all__ = ["to_dlpack_for_read", "to_dlpack_for_write", "from_dlpack"]

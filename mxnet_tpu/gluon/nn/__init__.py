@@ -1,7 +1,7 @@
 """gluon.nn — neural network layers."""
 from .basic_layers import (  # noqa: F401
     Sequential, HybridSequential, Dense, Activation, Dropout, BatchNorm,
-    SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten,
+    BatchNormReLU, SyncBatchNorm, LayerNorm, GroupNorm, InstanceNorm, Embedding, Flatten,
     Identity, Lambda, HybridLambda, Concatenate, HybridConcatenate,
     Concurrent, HybridConcurrent,
 )
@@ -10,6 +10,8 @@ from .conv_layers import (  # noqa: F401
     Conv3DTranspose, MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D,
     AvgPool3D, GlobalMaxPool1D, GlobalMaxPool2D, GlobalMaxPool3D,
     GlobalAvgPool1D, GlobalAvgPool2D, GlobalAvgPool3D, ReflectionPad2D,
+    PixelShuffle1D, PixelShuffle2D, PixelShuffle3D,
+    DeformableConvolution, ModulatedDeformableConvolution,
 )
 from .activations import (  # noqa: F401
     LeakyReLU, PReLU, ELU, SELU, GELU, SiLU, Swish, Mish,

@@ -162,6 +162,17 @@ class BatchNorm(HybridBlock):
                 f"eps={self._epsilon}, in_channels={self.gamma.shape[0]})")
 
 
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm + ReLU (parity: gluon.nn.BatchNormReLU —
+    src/operator/contrib/batch_norm_relu.cc fuses the activation into
+    the normalization kernel; under XLA the fusion happens in
+    compilation, so this is the same single kernel on TPU)."""
+
+    def forward(self, x):
+        from ... import numpy_extension as _npx
+        return _npx.relu(super().forward(x))
+
+
 class SyncBatchNorm(BatchNorm):
     """Cross-device synchronized BatchNorm (parity: gluon.contrib
     SyncBatchNorm). On TPU, batch statistics are computed over the

@@ -300,3 +300,158 @@ class ReflectionPad2D(HybridBlock):
         from ... import numpy as np
         return np.pad(x, ((0, 0), (0, 0), (p[0], p[1]), (p[2], p[3])),
                       mode="reflect")
+
+
+class _PixelShuffle(HybridBlock):
+    """Shared pixel-shuffle core: regroup channel blocks into spatial
+    blocks (parity: gluon/nn/conv_layers.py PixelShuffle1D/2D/3D,
+    the sub-pixel upsampling of Shi et al. 2016). Input layout is
+    channels-first: (N, prod(f)*C, *spatial)."""
+
+    def __init__(self, factor, ndim):
+        super().__init__()
+        self._factors = _pair(factor, ndim)
+        self._ndim = ndim
+
+    def forward(self, x):
+        from ... import numpy as np_
+        f = self._factors
+        n = self._ndim
+        N = x.shape[0]
+        spatial = x.shape[2:]
+        fprod = 1
+        for v in f:
+            fprod *= v
+        C = x.shape[1] // fprod
+        # (N, C, f1..fn, s1..sn) -> interleave each (si, fi) pair
+        x = x.reshape((N, C) + f + spatial)
+        perm = [0, 1]
+        for i in range(n):
+            perm.extend([2 + n + i, 2 + i])
+        x = np_.transpose(x, tuple(perm))
+        out_sp = tuple(s * fi for s, fi in zip(spatial, f))
+        return x.reshape((N, C) + out_sp)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._factors})"
+
+
+class PixelShuffle1D(_PixelShuffle):
+    """(N, f*C, W) -> (N, C, W*f)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 1)
+
+
+class PixelShuffle2D(_PixelShuffle):
+    """(N, f1*f2*C, H, W) -> (N, C, H*f1, W*f2)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 2)
+
+
+class PixelShuffle3D(_PixelShuffle):
+    """(N, f1*f2*f3*C, D, H, W) -> (N, C, D*f1, H*f2, W*f3)."""
+
+    def __init__(self, factor):
+        super().__init__(factor, 3)
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable Convolution v1 layer (Dai et al. 2017; parity:
+    gluon/nn/conv_layers.py DeformableConvolution over
+    src/operator/contrib/deformable_convolution.cc). The offset field
+    is produced by an internal ordinary convolution (zero-initialized,
+    so training starts at the regular grid) and fed to
+    npx.deformable_convolution together with the main kernel."""
+
+    _mask_factor = 0  # v2 adds one modulation scalar per tap
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 dtype="float32"):
+        super().__init__()
+        if layout != "NCHW":
+            raise ValueError("DeformableConvolution supports NCHW")
+        if groups != 1:
+            raise ValueError("grouped main kernels are not supported")
+        self._channels = channels
+        self._kernel = _pair(kernel_size, 2)
+        self._stride = _pair(strides, 2)
+        self._pad = _pair(padding, 2)
+        self._dilate = _pair(dilation, 2)
+        self._g = num_deformable_group
+        kh, kw = self._kernel
+        n_off = (2 + self._mask_factor) * self._g * kh * kw
+        self._n_off = n_off
+        self.offset_weight = Parameter(
+            "offset_weight",
+            shape=(n_off, in_channels if in_channels else 0) + self._kernel,
+            init=offset_weight_initializer, dtype=dtype,
+            allow_deferred_init=True)
+        self.offset_bias = Parameter(
+            "offset_bias", shape=(n_off,), init=offset_bias_initializer,
+            dtype=dtype, allow_deferred_init=True) \
+            if offset_use_bias else None
+        self.weight = Parameter(
+            "weight",
+            shape=(channels, in_channels if in_channels else 0)
+            + self._kernel,
+            init=weight_initializer, dtype=dtype,
+            allow_deferred_init=True)
+        self.bias = Parameter("bias", shape=(channels,), dtype=dtype,
+                              init=bias_initializer,
+                              allow_deferred_init=True) if use_bias \
+            else None
+        self.act = Activation(activation) if activation else None
+
+    def _infer(self, x):
+        in_ch = x.shape[1]
+        for p in (self.offset_weight, self.weight):
+            if not p._shape_known():
+                shape = list(p.shape)
+                shape[1] = in_ch
+                p._infer_shape(tuple(shape))
+
+    def forward(self, x):
+        self._infer(x)
+        off = npx.convolution(
+            x, self.offset_weight.data(),
+            None if self.offset_bias is None else self.offset_bias.data(),
+            kernel=self._kernel, stride=self._stride, pad=self._pad,
+            dilate=self._dilate, num_filter=self._n_off,
+            no_bias=self.offset_bias is None)
+        out = self._deform(x, off)
+        return self.act(out) if self.act is not None else out
+
+    def _deform(self, x, off):
+        return npx.deformable_convolution(
+            x, off, self.weight.data(),
+            None if self.bias is None else self.bias.data(),
+            kernel=self._kernel, stride=self._stride, pad=self._pad,
+            dilate=self._dilate, num_deformable_group=self._g)
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable Convolution v2 (Zhu et al. 2018; parity:
+    gluon/nn/conv_layers.py ModulatedDeformableConvolution): the
+    internal conv additionally emits one sigmoid-squashed modulation
+    scalar per tap that scales each sampled patch."""
+
+    _mask_factor = 1
+
+    def _deform(self, x, off):
+        g, (kh, kw) = self._g, self._kernel
+        n_pos = 2 * g * kh * kw
+        offsets = off[:, :n_pos]
+        mask = npx.sigmoid(off[:, n_pos:])
+        return npx.modulated_deformable_convolution(
+            x, offsets, mask, self.weight.data(),
+            None if self.bias is None else self.bias.data(),
+            kernel=self._kernel, stride=self._stride, pad=self._pad,
+            dilate=self._dilate, num_deformable_group=self._g)

@@ -53,11 +53,23 @@ def _legacy_topk(data, k=1, axis=-1, ret_typ="indices", is_ascend=False,
                      is_ascend=is_ascend, dtype=dtype)
 
 
+def _dlpack_fn(name):
+    def f(*a, **kw):
+        from .. import dlpack as _dl
+        return getattr(_dl, name)(*a, **kw)
+    f.__name__ = name
+    return f
+
+
 _LEGACY_OPS = {
     "sort": _legacy_sort,
     "argsort": _legacy_argsort,
     "reverse": _legacy_reverse,
     "topk": _legacy_topk,
+    # mx.nd.to_dlpack_for_read & co (reference python/mxnet/dlpack.py)
+    "to_dlpack_for_read": _dlpack_fn("to_dlpack_for_read"),
+    "to_dlpack_for_write": _dlpack_fn("to_dlpack_for_write"),
+    "from_dlpack": _dlpack_fn("from_dlpack"),
 }
 
 # Legacy CamelCase operator names (the reference's original imperative
@@ -168,6 +180,9 @@ def _camel_wrappers():
         x = data[0]
         th, tw = (data[1].shape[2:4] if len(data) == 2
                   else (int(h_w[0]), int(h_w[1])))
+        if th <= 0 or tw <= 0:
+            raise ValueError("Crop needs a reference input or a "
+                             f"positive h_w (got h_w=({th}, {tw}))")
         H, W = x.shape[2], x.shape[3]
         if center_crop:
             oy, ox = (H - th) // 2, (W - tw) // 2

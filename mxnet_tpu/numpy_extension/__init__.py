@@ -42,6 +42,7 @@ from .contrib_ops import (  # noqa: E402,F401  (OPGAP round-4 batch)
     grid_generator, bilinear_sampler, spatial_transformer,
     correlation, count_sketch, proposal, multi_proposal,
     deformable_convolution, deformable_psroi_pooling,
+    modulated_deformable_convolution,
 )
 
 
@@ -965,12 +966,14 @@ def _regression_output(name, fwd_fn, grad_fn):
 
         def _bwd(res, g):
             x, lab = res
-            # grad_scale / (elements per sample), head grad ignored
-            # (regression_output-inl.h:201-207)
+            # grad_scale / (elements per sample), head grad ignored;
+            # the label reshapes to the data shape — (N,1) preds with
+            # (N,) labels is the documented pattern
+            # (regression_output-inl.h:190-207)
             num_output = max(lab.size // lab.shape[0], 1) \
                 if lab.ndim > 0 else 1
-            grad = grad_fn(fwd_fn(x), lab.astype(x.dtype)) \
-                * (gs / num_output)
+            lab = lab.astype(x.dtype).reshape(x.shape)
+            grad = grad_fn(fwd_fn(x), lab) * (gs / num_output)
             return grad, None
 
         _fn.defvjp(_fwd, _bwd)

@@ -712,7 +712,7 @@ multi_proposal = proposal  # the batched variant IS the batch path here
 
 def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
                            stride=(1, 1), pad=(1, 1), dilate=(1, 1),
-                           num_deformable_group=1, **kwargs):
+                           num_deformable_group=1, mask=None, **kwargs):
     """Deformable ConvNets v1 convolution (parity:
     src/operator/contrib/deformable_convolution.cc): each kernel tap
     samples the input at its regular position PLUS a learned offset,
@@ -720,15 +720,21 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     the weights like an ordinary convolution.
 
     data (B, C, H, W); offset (B, 2*G*kh*kw, oh, ow) interleaved
-    (dy, dx) per tap per deformable group G; weight (O, C, kh, kw)."""
+    (dy, dx) per tap per deformable group G; weight (O, C, kh, kw).
+    With `mask` (B, G*kh*kw, oh, ow) this is the v2 *modulated* form
+    (src/operator/contrib/modulated_deformable_convolution.cc): each
+    sampled patch is scaled by its learned modulation scalar."""
     kh, kw = kernel
     sh, sw = (stride, stride) if isinstance(stride, int) else stride
     ph, pw = (pad, pad) if isinstance(pad, int) else pad
     dh, dw = (dilate, dilate) if isinstance(dilate, int) else dilate
     G = num_deformable_group
 
-    def fn(x, off, w, *maybe_b):
+    def fn(x, off, w, *rest):
         from ..ops import warp as _warp
+        rest = list(rest)
+        m = rest.pop(0) if mask is not None else None
+        b = rest.pop(0) if rest else None
         B, C, H, W = x.shape
         O = w.shape[0]
         oh = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
@@ -738,6 +744,8 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
         base_y = jnp.arange(oh) * sh
         base_x = jnp.arange(ow) * sw
         off = off.reshape(B, G, kh * kw, 2, oh, ow)
+        if m is not None:
+            m = m.reshape(B, G, kh * kw, oh, ow)
         cols = []
         for t in range(kh * kw):
             iy, ix = divmod(t, kw)
@@ -750,18 +758,34 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
             grid = jnp.stack([gx, gy], 2).reshape(B * G, 2, oh, ow)
             xg = xpad.reshape(B * G, C // G, Hp, Wp)
             smp = _warp.bilinear_sampler(xg, grid)    # (B*G, C/G, oh, ow)
+            if m is not None:
+                smp = smp * m[:, :, t].reshape(B * G, 1, oh, ow)
             cols.append(smp.reshape(B, C, oh, ow))
         col = jnp.stack(cols, 2)                      # (B, C, k*k, oh, ow)
         out = jnp.einsum("bckhw,ock->bohw",
                          col, w.reshape(O, C, kh * kw))
-        if maybe_b:
-            out = out + maybe_b[0].reshape(1, -1, 1, 1)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1)
         return out
 
     args = [_c(data), _c(offset), _c(weight)]
+    if mask is not None:
+        args.append(_c(mask))
     if bias is not None:
         args.append(_c(bias))
     return apply_op(fn, *args, name="deformable_convolution")
+
+
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     pad=(1, 1), dilate=(1, 1),
+                                     num_deformable_group=1, **kwargs):
+    """Deformable ConvNets v2 (parity:
+    src/operator/contrib/modulated_deformable_convolution.cc)."""
+    return deformable_convolution(
+        data, offset, weight, bias=bias, kernel=kernel, stride=stride,
+        pad=pad, dilate=dilate, num_deformable_group=num_deformable_group,
+        mask=mask)
 
 
 def deformable_psroi_pooling(data, rois, trans, spatial_scale=1.0,

@@ -1,0 +1,34 @@
+"""DLPack interchange (parity: python/mxnet/dlpack.py and the
+tests/python/unittest/test_ndarray.py dlpack round-trip cases)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+
+
+def test_dlpack_roundtrip_self():
+    a = mnp.array(onp.arange(6.0, dtype="f4").reshape(2, 3))
+    cap = mx.nd.to_dlpack_for_read(a)
+    b = mx.nd.from_dlpack(cap)
+    onp.testing.assert_array_equal(b.asnumpy(), a.asnumpy())
+    assert str(b.dtype) == "float32"
+
+
+def test_dlpack_to_torch_and_back():
+    import torch
+
+    a = mnp.array(onp.arange(12.0, dtype="f4").reshape(3, 4))
+    t = torch.utils.dlpack.from_dlpack(mx.dlpack.to_dlpack_for_read(a))
+    assert t.shape == (3, 4)
+    onp.testing.assert_array_equal(t.numpy(), a.asnumpy())
+    back = mx.nd.from_dlpack(torch.utils.dlpack.to_dlpack(
+        torch.arange(4, dtype=torch.float32)))
+    onp.testing.assert_array_equal(back.asnumpy(),
+                                   onp.arange(4, dtype="f4"))
+
+
+def test_dlpack_write_alias_exists():
+    a = mnp.array(onp.ones(3, "f4"))
+    cap = mx.nd.to_dlpack_for_write(a)
+    b = mx.nd.from_dlpack(cap)
+    onp.testing.assert_array_equal(b.asnumpy(), a.asnumpy())

@@ -181,6 +181,27 @@ def test_softmax_output_ignore_and_valid_normalization():
                                 atol=1e-5)
 
 
+def test_regression_head_label_shape_broadcast():
+    """(N,1) predictions with (N,) labels — the documented reference
+    pattern — must give the (N,1) gradient, not an (N,N) broadcast."""
+    x = onp.random.RandomState(7).randn(4, 1).astype("f4")
+    lab = onp.random.RandomState(8).randn(4).astype("f4")
+    xv = mnp.array(x)
+    xv.attach_grad()
+    with autograd.record():
+        mx.nd.LinearRegressionOutput(xv, mnp.array(lab)).sum().backward()
+    assert xv.grad.shape == (4, 1)
+    onp.testing.assert_allclose(xv.grad.asnumpy(), x - lab[:, None],
+                                rtol=1e-5, atol=1e-6)
+
+
+def test_crop_without_target_errors():
+    import pytest
+    x = mx.nd.array(onp.zeros((1, 1, 4, 4), "f4"))
+    with pytest.raises(ValueError):
+        mx.nd.Crop(x)
+
+
 def test_linear_regression_output_gradient():
     """grad = (pred - label) * grad_scale / num_output_per_sample
     (regression_output-inl.h:201-207); head gradient ignored."""

@@ -1,0 +1,123 @@
+"""New gluon.nn parity layers: PixelShuffle1D/2D/3D, BatchNormReLU,
+DeformableConvolution v1/v2 (parity: reference gluon/nn/conv_layers.py
+PixelShuffle*, DeformableConvolution, ModulatedDeformableConvolution;
+basic_layers.py BatchNormReLU)."""
+import numpy as onp
+
+from mxnet_tpu import autograd, np as mnp, npx
+from mxnet_tpu.gluon import nn
+
+
+def test_pixel_shuffle_2d_reference_example():
+    """The reference docstring example: (1, 12, 3, 5) with factor
+    (2, 3) -> (1, 2, 6, 15)."""
+    pxshuf = nn.PixelShuffle2D((2, 3))
+    x = mnp.zeros((1, 12, 3, 5))
+    assert pxshuf(x).shape == (1, 2, 6, 15)
+
+
+def test_pixel_shuffle_2d_values():
+    """Inverse relationship with space_to_depth-style blocking: each
+    f1 x f2 channel block becomes the pixel block at its position."""
+    f1, f2, C, H, W = 2, 2, 1, 2, 2
+    x = onp.arange(f1 * f2 * C * H * W, dtype="f4") \
+        .reshape(1, f1 * f2 * C, H, W)
+    out = nn.PixelShuffle2D((f1, f2))(mnp.array(x)).asnumpy()
+    assert out.shape == (1, C, H * f1, W * f2)
+    # channel c of the input supplies output pixel (i*f1+c//f2, j*f2+c%f2)
+    for c in range(f1 * f2):
+        bi, bj = divmod(c, f2)
+        onp.testing.assert_array_equal(out[0, 0, bi::f1, bj::f2],
+                                       x[0, c])
+
+
+def test_pixel_shuffle_1d_3d_shapes():
+    assert nn.PixelShuffle1D(3)(mnp.zeros((2, 6, 5))).shape == (2, 2, 15)
+    out = nn.PixelShuffle3D((1, 2, 3))(mnp.zeros((1, 12, 2, 3, 4)))
+    assert out.shape == (1, 2, 2, 6, 12)
+
+
+def test_pixel_shuffle_roundtrip_with_depth_to_space():
+    """PixelShuffle2D with square factor matches npx.depth_to_space in
+    values for C=1 (same sub-pixel convention)."""
+    x = onp.random.RandomState(0).randn(2, 4, 3, 3).astype("f4")
+    got = nn.PixelShuffle2D(2)(mnp.array(x)).asnumpy()
+    want = npx.depth_to_space(mnp.array(x), 2).asnumpy()
+    onp.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_batch_norm_relu():
+    bn = nn.BatchNormReLU(in_channels=3)
+    bn.initialize()
+    x = onp.random.RandomState(0).randn(4, 3, 5).astype("f4")
+    with autograd.train_mode():
+        out = bn(mnp.array(x)).asnumpy()
+    mean = x.mean((0, 2))
+    var = x.var((0, 2))
+    want = onp.maximum(
+        (x - mean[None, :, None]) / onp.sqrt(var[None, :, None] + 1e-5),
+        0.0)
+    onp.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+    assert (out >= 0).all()
+
+
+def test_deformable_convolution_zero_offsets_match_regular_conv():
+    """Freshly initialized (zero offset weights), the layer must equal
+    an ordinary convolution with the same kernel."""
+    layer = nn.DeformableConvolution(4, kernel_size=(3, 3),
+                                     padding=(1, 1), in_channels=2)
+    layer.initialize()
+    x = mnp.array(onp.random.RandomState(0).randn(1, 2, 6, 6)
+                  .astype("f4"))
+    out = layer(x)
+    want = npx.convolution(x, layer.weight.data(), layer.bias.data(),
+                           kernel=(3, 3), pad=(1, 1), num_filter=4)
+    onp.testing.assert_allclose(out.asnumpy(), want.asnumpy(),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_deformable_convolution_integer_offset_shifts_sampling():
+    """An offset of exactly (0, +1) on every tap equals convolving an
+    input shifted left by one pixel (interior pixels)."""
+    x = onp.random.RandomState(1).randn(1, 1, 6, 6).astype("f4")
+    w = onp.random.RandomState(2).randn(1, 1, 1, 1).astype("f4")
+    off = onp.zeros((1, 2, 6, 6), "f4")
+    off[:, 1] = 1.0  # dx = +1
+    got = npx.deformable_convolution(
+        mnp.array(x), mnp.array(off), mnp.array(w), kernel=(1, 1),
+        stride=(1, 1), pad=(0, 0)).asnumpy()
+    want = x * w[0, 0, 0, 0]
+    onp.testing.assert_allclose(got[..., :-1], want[..., 1:],
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_modulated_deformable_convolution_mask_scales():
+    """v2 with zero offsets and mask m equals a regular conv whose
+    input is scaled by m (single tap)."""
+    x = onp.random.RandomState(3).randn(1, 2, 5, 5).astype("f4")
+    w = onp.random.RandomState(4).randn(3, 2, 1, 1).astype("f4")
+    off = onp.zeros((1, 2, 5, 5), "f4")
+    mask = onp.random.RandomState(5).uniform(0.2, 1.0,
+                                             (1, 1, 5, 5)).astype("f4")
+    got = npx.modulated_deformable_convolution(
+        mnp.array(x), mnp.array(off), mnp.array(mask), mnp.array(w),
+        kernel=(1, 1), pad=(0, 0)).asnumpy()
+    want = onp.einsum("bchw,oc->bohw", x * mask, w[:, :, 0, 0])
+    onp.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_modulated_layer_trains():
+    layer = nn.ModulatedDeformableConvolution(2, kernel_size=(3, 3),
+                                              padding=(1, 1))
+    layer.initialize()
+    x = mnp.array(onp.random.RandomState(0).randn(2, 3, 8, 8)
+                  .astype("f4"))
+    layer(x)  # materialize deferred shapes
+    for p in layer.collect_params().values():
+        p.data().attach_grad()
+    with autograd.record():
+        out = layer(x)
+        loss = (out * out).mean()
+        loss.backward()
+    g = layer.weight.grad()
+    assert g is not None and float(mnp.abs(g).sum().asnumpy()) > 0

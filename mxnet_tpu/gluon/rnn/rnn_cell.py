@@ -15,8 +15,9 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
-           "GRUCell", "SequentialRNNCell", "HybridSequentialRNNCell",
-           "DropoutCell", "ModifierCell", "ZoneoutCell", "ResidualCell",
+           "LSTMPCell", "GRUCell", "SequentialRNNCell",
+           "HybridSequentialRNNCell", "DropoutCell", "ModifierCell",
+           "ZoneoutCell", "ResidualCell", "VariationalDropoutCell",
            "BidirectionalCell"]
 
 
@@ -213,7 +214,10 @@ class LSTMCell(RecurrentCell):
     def _alias(self):
         return "lstm"
 
-    def forward(self, inputs, states):
+    def _lstm_step(self, inputs, states):
+        """Shared [i,f,g,o] gate computation; returns (hidden, next_c).
+        states[0] is whatever feeds h2h (the full hidden state here,
+        the projected state in LSTMPCell)."""
         if not self.i2h_weight._shape_known():
             self.i2h_weight._infer_shape((4 * self._hidden_size,
                                           inputs.shape[-1]))
@@ -237,7 +241,61 @@ class LSTMCell(RecurrentCell):
         next_c = forget_gate * states[1] + in_gate * in_transform
         next_h = out_gate * npx.activation(next_c,
                                            act_type=self._activation)
+        return next_h, next_c
+
+    def forward(self, inputs, states):
+        next_h, next_c = self._lstm_step(inputs, states)
         return next_h, [next_h, next_c]
+
+
+class LSTMPCell(LSTMCell):
+    """LSTM cell with a projection layer (parity: rnn_cell.LSTMPCell,
+    Sak et al. 2014): the hidden output is ``r = P (o * act(c))`` of
+    size ``projection_size``, and the recurrent h2h weights operate on
+    the projected state. States are ``[r, c]``. Gate order [i, f, g, o]
+    matches the fused LSTMP layer (rnn_layer.LSTM projection_size);
+    the gate math is LSTMCell._lstm_step with h2h fed by r."""
+
+    def __init__(self, hidden_size, projection_size,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 h2r_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, activation="tanh",
+                 recurrent_activation="sigmoid"):
+        super().__init__(hidden_size,
+                         i2h_weight_initializer=i2h_weight_initializer,
+                         h2h_weight_initializer=h2h_weight_initializer,
+                         i2h_bias_initializer=i2h_bias_initializer,
+                         h2h_bias_initializer=h2h_bias_initializer,
+                         input_size=input_size, activation=activation,
+                         recurrent_activation=recurrent_activation)
+        self._projection_size = projection_size
+        # recurrence consumes the projected state r, not h
+        self.h2h_weight = Parameter("h2h_weight",
+                                    shape=(4 * hidden_size,
+                                           projection_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2r_weight = Parameter("h2r_weight",
+                                    shape=(projection_size, hidden_size),
+                                    init=h2r_weight_initializer,
+                                    allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._projection_size),
+                 "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size),
+                 "__layout__": "NC"}]
+
+    def _alias(self):
+        return "lstmp"
+
+    def forward(self, inputs, states):
+        hidden, next_c = self._lstm_step(inputs, states)
+        next_r = npx.fully_connected(
+            hidden, self.h2r_weight.data(), None, no_bias=True,
+            num_hidden=self._projection_size)
+        return next_r, [next_r, next_c]
 
 
 class GRUCell(RecurrentCell):
@@ -415,6 +473,94 @@ class ResidualCell(ModifierCell):
     def forward(self, inputs, states):
         output, states = self.base_cell(inputs, states)
         return output + inputs, states
+
+
+class VariationalDropoutCell(ModifierCell):
+    """Variational (locked) dropout (parity:
+    rnn_cell.VariationalDropoutCell, Gal & Ghahramani 2016): ONE
+    Bernoulli mask per unroll is shared by every time step, separately
+    for inputs, states (first state only, like the reference), and
+    outputs. ``reset()`` resamples. Masks are materialized lazily from
+    the first step's shapes; under hybridize they become constants of
+    the traced unroll, which is exactly the locked-mask semantics."""
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0):
+        assert not drop_states or not isinstance(base_cell,
+                                                 BidirectionalCell), \
+            "BidirectionalCell doesn't support variational state " \
+            "dropout; apply it to the cells underneath instead."
+        super().__init__(base_cell)
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    def _alias(self):
+        return "vardrop"
+
+    def reset(self):
+        super().reset()
+        self._input_mask = None
+        self._state_mask = None
+        self._output_mask = None
+
+    @staticmethod
+    def _mask(p, like):
+        return npx.dropout(np.ones_like(like), p=p)
+
+    def forward(self, inputs, states):
+        if self._drop_inputs:
+            if self._input_mask is None:
+                self._input_mask = self._mask(self._drop_inputs, inputs)
+            inputs = inputs * self._input_mask
+        if self._drop_states:
+            if self._state_mask is None:
+                self._state_mask = self._mask(self._drop_states,
+                                              states[0])
+            states = [states[0] * self._state_mask] + list(states[1:])
+        output, next_states = self.base_cell(inputs, states)
+        if self._drop_outputs:
+            if self._output_mask is None:
+                self._output_mask = self._mask(self._drop_outputs,
+                                               output)
+            output = output * self._output_mask
+        return output, next_states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Fresh masks per unroll (the reference resets at unroll
+        start). Without state dropout the masks broadcast along time,
+        so the whole sequence is masked at once and the base cell
+        unrolls directly — this is also what lets a wrapped
+        BidirectionalCell (step-less) work."""
+        self.reset()
+        if self._drop_states:
+            return super().unroll(length, inputs, begin_state=begin_state,
+                                  layout=layout,
+                                  merge_outputs=merge_outputs,
+                                  valid_length=valid_length)
+        t_axis = layout.find("T")
+        merged, _, _ = _format_sequence(length, inputs, layout, True)
+        if self._drop_inputs:
+            merged = npx.dropout(merged, p=self._drop_inputs,
+                                 axes=(t_axis,))
+        self.base_cell._modified = False
+        try:
+            outputs, states = self.base_cell.unroll(
+                length, merged, begin_state=begin_state, layout=layout,
+                merge_outputs=True, valid_length=valid_length)
+        finally:
+            self.base_cell._modified = True
+        if self._drop_outputs:
+            outputs = npx.dropout(outputs, p=self._drop_outputs,
+                                  axes=(t_axis,))
+        outputs, _, _ = _format_sequence(
+            length, outputs, layout,
+            merge_outputs if merge_outputs is not None else True)
+        return outputs, states
 
 
 class BidirectionalCell(RecurrentCell):

@@ -1,0 +1,207 @@
+"""New rnn cell parity: LSTMPCell, VariationalDropoutCell, and the
+Conv{1,2,3}D{RNN,LSTM,GRU}Cell family (parity: reference
+gluon/rnn/rnn_cell.py LSTMPCell/VariationalDropoutCell and
+gluon/rnn/conv_rnn_cell.py)."""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import autograd, np as mnp
+from mxnet_tpu.gluon import rnn
+
+
+def _unroll(cell, seq, batch, feat_shape, seed=0):
+    x = mnp.array(onp.random.RandomState(seed)
+                  .randn(batch, seq, *feat_shape).astype("f4"))
+    outputs, states = cell.unroll(seq, x, layout="NTC", merge_outputs=True)
+    return x, outputs, states
+
+
+def test_lstmp_cell_shapes_and_projection_math():
+    cell = rnn.LSTMPCell(8, projection_size=3, input_size=4)
+    cell.initialize()
+    x = mnp.array(onp.random.RandomState(0).randn(2, 4).astype("f4"))
+    states = cell.begin_state(batch_size=2)
+    assert [tuple(s.shape) for s in states] == [(2, 3), (2, 8)]
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 3)          # projected
+    assert new_states[1].shape == (2, 8)  # cell state full-size
+    # manual recompute: zero initial state -> gates from i2h only
+    W = cell.i2h_weight.data().asnumpy()
+    b = cell.i2h_bias.data().asnumpy() + cell.h2h_bias.data().asnumpy()
+    P = cell.h2r_weight.data().asnumpy()
+    g = onp.asarray(x.asnumpy()) @ W.T + b
+    i, f, c, o = onp.split(g, 4, -1)
+    sig = lambda v: 1 / (1 + onp.exp(-v))
+    next_c = sig(f) * 0 + sig(i) * onp.tanh(c)
+    want_r = (sig(o) * onp.tanh(next_c)) @ P.T
+    onp.testing.assert_allclose(out.asnumpy(), want_r, rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_lstmp_cell_unrolls_and_trains():
+    cell = rnn.LSTMPCell(8, projection_size=3)
+    cell.initialize()
+    x, outputs, _ = _unroll(cell, 5, 2, (4,))
+    assert outputs.shape == (2, 5, 3)
+    for p in cell.collect_params().values():
+        p.data().attach_grad()
+    with autograd.record():
+        _, out2, _ = _unroll(cell, 5, 2, (4,))
+        out2.sum().backward()
+    assert float(mnp.abs(cell.h2r_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_variational_dropout_locked_masks():
+    """The SAME mask applies at every step; reset() resamples."""
+    base = rnn.RNNCell(16, input_size=16)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    ones = mnp.array(onp.ones((1, 16), "f4"))
+    states = cell.begin_state(batch_size=1)
+    with autograd.train_mode():
+        cell(ones, states)
+        m1 = cell._input_mask.asnumpy()
+        cell(ones, states)
+        m2 = cell._input_mask.asnumpy()
+        onp.testing.assert_array_equal(m1, m2)  # locked across steps
+        cell.reset()
+        assert cell._input_mask is None
+        cell(ones, states)
+        m3 = cell._input_mask.asnumpy()
+    assert (m1 != m3).any()  # resampled after reset (w.h.p.)
+    assert set(onp.unique(m1)).issubset({0.0, 2.0})  # inverted scaling
+
+
+def test_variational_dropout_eval_identity():
+    base = rnn.RNNCell(4, input_size=4)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                      drop_outputs=0.5)
+    cell.initialize()
+    x = mnp.array(onp.random.RandomState(0).randn(2, 4).astype("f4"))
+    st = cell.begin_state(batch_size=2)
+    out_a, _ = cell(x, st)
+    base._modified = False
+    out_b, _ = base(x, st)
+    onp.testing.assert_allclose(out_a.asnumpy(), out_b.asnumpy(),
+                                rtol=1e-6)
+
+
+def test_variational_dropout_resamples_per_unroll():
+    """unroll() starts a fresh mask (reference resets at unroll
+    start); within one unroll the mask is locked across time."""
+    base = rnn.RNNCell(16, input_size=16)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = mnp.array(onp.ones((1, 6, 16), "f4"))
+    with autograd.train_mode():
+        out1, _ = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+        m1 = cell._input_mask
+        out2, _ = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+        m2 = cell._input_mask
+    if m1 is not None:  # step path caches; fast path masks inline
+        assert (m1.asnumpy() != m2.asnumpy()).any()
+
+
+def test_variational_dropout_wraps_bidirectional():
+    """Input/output variational dropout over a BidirectionalCell works
+    through the merged-sequence fast path (the step path cannot drive
+    a bidirectional cell)."""
+    bi = rnn.BidirectionalCell(rnn.RNNCell(4, input_size=3),
+                               rnn.RNNCell(4, input_size=3))
+    cell = rnn.VariationalDropoutCell(bi, drop_inputs=0.3,
+                                      drop_outputs=0.3)
+    cell.initialize()
+    x = mnp.array(onp.random.RandomState(0)
+                  .randn(2, 5, 3).astype("f4"))
+    outputs, states = cell.unroll(5, x, layout="NTC",
+                                  merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)  # concat of both directions
+    with autograd.train_mode():
+        out_t, _ = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    # time-locked mask: a zeroed input feature column is zero at every
+    # step; outputs differ from eval outputs
+    assert (out_t.asnumpy() != outputs.asnumpy()).any()
+
+
+@pytest.mark.parametrize("cls,dims", [
+    (rnn.Conv1DRNNCell, 1), (rnn.Conv2DRNNCell, 2),
+    (rnn.Conv3DRNNCell, 3)])
+def test_conv_rnn_cell_shapes(cls, dims):
+    spatial = (8, 7, 6)[:dims]
+    cell = cls(input_shape=(2,) + spatial, hidden_channels=4,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mnp.array(onp.random.RandomState(0)
+                  .randn(2, 2, *spatial).astype("f4"))
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, 4) + spatial
+    assert new_states[0].shape == out.shape
+
+
+def test_conv2d_lstm_cell_matches_dense_lstm_on_1x1():
+    """A ConvLSTM with 1x1 kernels on 1x1 spatial input IS a dense
+    LSTM: the two must agree numerically with shared weights."""
+    conv = rnn.Conv2DLSTMCell(input_shape=(3, 1, 1), hidden_channels=5,
+                              i2h_kernel=1, h2h_kernel=1)
+    dense = rnn.LSTMCell(5, input_size=3)
+    conv.initialize()
+    dense.initialize()
+    dense.i2h_weight.set_data(
+        conv.i2h_weight.data().reshape(20, 3))
+    dense.h2h_weight.set_data(
+        conv.h2h_weight.data().reshape(20, 5))
+    dense.i2h_bias.set_data(conv.i2h_bias.data())
+    dense.h2h_bias.set_data(conv.h2h_bias.data())
+    x = onp.random.RandomState(0).randn(2, 3).astype("f4")
+    c_out, c_states = conv(mnp.array(x.reshape(2, 3, 1, 1)),
+                           conv.begin_state(batch_size=2))
+    d_out, d_states = dense(mnp.array(x),
+                            dense.begin_state(batch_size=2))
+    onp.testing.assert_allclose(c_out.asnumpy().reshape(2, 5),
+                                d_out.asnumpy(), rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(c_states[1].asnumpy().reshape(2, 5),
+                                d_states[1].asnumpy(), rtol=1e-4,
+                                atol=1e-5)
+
+
+def test_conv2d_gru_cell_matches_dense_gru_on_1x1():
+    conv = rnn.Conv2DGRUCell(input_shape=(3, 1, 1), hidden_channels=5,
+                             i2h_kernel=1, h2h_kernel=1)
+    dense = rnn.GRUCell(5, input_size=3)
+    conv.initialize()
+    dense.initialize()
+    dense.i2h_weight.set_data(conv.i2h_weight.data().reshape(15, 3))
+    dense.h2h_weight.set_data(conv.h2h_weight.data().reshape(15, 5))
+    dense.i2h_bias.set_data(conv.i2h_bias.data())
+    dense.h2h_bias.set_data(conv.h2h_bias.data())
+    x = onp.random.RandomState(1).randn(2, 3).astype("f4")
+    st_c = conv.begin_state(batch_size=2)
+    st_d = dense.begin_state(batch_size=2)
+    c_out, _ = conv(mnp.array(x.reshape(2, 3, 1, 1)), st_c)
+    d_out, _ = dense(mnp.array(x), st_d)
+    onp.testing.assert_allclose(c_out.asnumpy().reshape(2, 5),
+                                d_out.asnumpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_conv_lstm_unrolls_under_hybridize_and_trains():
+    cell = rnn.Conv2DLSTMCell(input_shape=(1, 6, 6), hidden_channels=2,
+                              i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = mnp.array(onp.random.RandomState(0)
+                  .randn(2, 4, 1, 6, 6).astype("f4"))
+    outputs, states = cell.unroll(4, x, layout="NTC",
+                                  merge_outputs=True)
+    assert outputs.shape == (2, 4, 2, 6, 6)
+    for p in cell.collect_params().values():
+        p.data().attach_grad()
+    with autograd.record():
+        o, _ = cell.unroll(4, x, layout="NTC", merge_outputs=True)
+        (o * o).sum().backward()
+    assert float(mnp.abs(cell.h2h_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_conv_cell_rejects_even_h2h_kernel():
+    with pytest.raises(ValueError):
+        rnn.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                          i2h_kernel=3, h2h_kernel=2)

@@ -184,7 +184,7 @@ class LSTMCell(RecurrentCell):
                  h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
                  input_size=0, activation="tanh",
-                 recurrent_activation="sigmoid"):
+                 recurrent_activation="sigmoid", _recurrent_size=None):
         super().__init__()
         self._hidden_size = hidden_size
         self._input_size = input_size
@@ -194,8 +194,11 @@ class LSTMCell(RecurrentCell):
                                     shape=(4 * hidden_size, input_size),
                                     init=i2h_weight_initializer,
                                     allow_deferred_init=True)
+        # what feeds h2h: the hidden state, or the projected state for
+        # LSTMPCell subclasses
         self.h2h_weight = Parameter("h2h_weight",
-                                    shape=(4 * hidden_size, hidden_size),
+                                    shape=(4 * hidden_size,
+                                           _recurrent_size or hidden_size),
                                     init=h2h_weight_initializer,
                                     allow_deferred_init=True)
         self.i2h_bias = Parameter("i2h_bias", shape=(4 * hidden_size,),
@@ -268,14 +271,9 @@ class LSTMPCell(LSTMCell):
                          i2h_bias_initializer=i2h_bias_initializer,
                          h2h_bias_initializer=h2h_bias_initializer,
                          input_size=input_size, activation=activation,
-                         recurrent_activation=recurrent_activation)
+                         recurrent_activation=recurrent_activation,
+                         _recurrent_size=projection_size)
         self._projection_size = projection_size
-        # recurrence consumes the projected state r, not h
-        self.h2h_weight = Parameter("h2h_weight",
-                                    shape=(4 * hidden_size,
-                                           projection_size),
-                                    init=h2h_weight_initializer,
-                                    allow_deferred_init=True)
         self.h2r_weight = Parameter("h2r_weight",
                                     shape=(projection_size, hidden_size),
                                     init=h2r_weight_initializer,

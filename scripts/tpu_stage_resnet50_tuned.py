@@ -1,0 +1,104 @@
+"""Tuned ResNet-50 stage: push bulk-mode MFU past 0.30 (round-5 task #2).
+
+The first window-captured resnet50 result (batch 384) measured MFU
+0.258 per-step / 0.289 bulk — per-step host dispatch costs ~11%, so the
+remaining lever is arithmetic intensity: bigger per-chip batch + longer
+bulk chains (more steps amortized into ONE XLA program). This stage
+sweeps batch sizes under `TrainStep.run_chain` with fetch-delta timing
+and reports the best configuration as the headline resnet50 metric
+(same metric name — it is the same model/task, just a tuned batch).
+
+Skips a batch size on RESOURCE_EXHAUSTED instead of dying: the largest
+config that fits wins.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, parallel  # noqa: E402
+from bench import RESNET50_TRAIN_FLOPS_PER_IMG, _peak_flops  # noqa: E402
+
+BATCHES = [int(b) for b in
+           os.environ.get("TUNED_BATCHES", "512,640").split(",")]
+LO = int(os.environ.get("TUNED_CHAIN_LO", "2"))
+HI = int(os.environ.get("TUNED_CHAIN_HI", "8"))
+HW = 224
+
+n_dev = jax.local_device_count()
+mesh = parallel.make_mesh((n_dev,), ("dp",))
+parallel.set_mesh(mesh)
+peak = _peak_flops(kind)
+
+best = None
+for batch in BATCHES:
+    try:
+        net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
+        net.initialize()
+        net.cast("bfloat16")
+        step = parallel.TrainStep(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                              "multi_precision": True},
+            mesh=mesh, batch_axis="dp")
+
+        def chain_args(n):
+            return (mx.np.random.uniform(
+                        size=(n, batch, HW, HW, 3), dtype="bfloat16"),
+                    mx.np.zeros((n, batch), dtype="int32"))
+
+        def timed(args):
+            t0 = time.perf_counter()
+            step.run_chain(*args).asnumpy()
+            return time.perf_counter() - t0
+
+        args_lo, args_hi = chain_args(LO), chain_args(HI)
+        t0 = time.perf_counter()
+        timed(args_lo)          # compile + run (cache-warm across windows)
+        timed(args_hi)
+        compile_s = time.perf_counter() - t0
+        t_lo, t_hi = timed(args_lo), timed(args_hi)
+        sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+        ips = batch / sec_per_step
+        mfu = (RESNET50_TRAIN_FLOPS_PER_IMG * batch / sec_per_step
+               / (peak * n_dev)) if peak else None
+        rec = {
+            "metric": "resnet50_train_images_per_sec_per_chip",
+            "value": round(ips / n_dev, 2),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(ips / n_dev / 360.0, 4),
+            "mfu": round(mfu, 4) if mfu is not None else None,
+            "ips_bulk": round(ips, 2),
+            "batch": batch,
+            "chain": [LO, HI],
+            "compile_s": round(compile_s, 1),
+            "mode": "bulk_tuned",
+            "init_s": round(init_s, 2),
+            "platform": platform,
+            "device_kind": kind,
+            "n_devices": n_dev,
+        }
+        print(json.dumps(rec), flush=True)
+        if best is None or rec["value"] > best["value"]:
+            best = rec
+    except Exception as e:  # noqa: BLE001 — OOM or transient: try next
+        print(f"[tuned] batch {batch} failed: "
+              f"{type(e).__name__}: {str(e)[:200]}",
+              file=sys.stderr, flush=True)
+
+if best is None:
+    print(json.dumps({"metric": "bench_error", "value": 0.0,
+                      "error": "all tuned batches failed",
+                      "platform": platform}), flush=True)
+    sys.exit(1)
+print(json.dumps(best), flush=True)

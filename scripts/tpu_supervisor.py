@@ -63,6 +63,9 @@ STAGES = [
      240),
     ("resnet18", [PY, os.path.join(REPO, "bench.py")], 420),
     ("resnet50", [PY, os.path.join(REPO, "bench.py")], 900),
+    ("resnet50_tuned",
+     [PY, os.path.join(REPO, "scripts", "tpu_stage_resnet50_tuned.py")],
+     900),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),

@@ -90,7 +90,11 @@ def test_variational_dropout_resamples_per_unroll():
     """unroll() starts a fresh mask (reference resets at unroll
     start); within one unroll the mask is locked across time."""
     base = rnn.RNNCell(16, input_size=16)
-    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    # drop_states forces the step path, which caches the mask on the
+    # cell (the drop_states-free fast path masks inline and never
+    # caches — asserting on _input_mask there would be vacuous)
+    cell = rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                      drop_states=0.5)
     cell.initialize()
     x = mnp.array(onp.ones((1, 6, 16), "f4"))
     with autograd.train_mode():
@@ -98,8 +102,8 @@ def test_variational_dropout_resamples_per_unroll():
         m1 = cell._input_mask
         out2, _ = cell.unroll(6, x, layout="NTC", merge_outputs=True)
         m2 = cell._input_mask
-    if m1 is not None:  # step path caches; fast path masks inline
-        assert (m1.asnumpy() != m2.asnumpy()).any()
+    assert m1 is not None and m2 is not None
+    assert (m1.asnumpy() != m2.asnumpy()).any()
 
 
 def test_variational_dropout_wraps_bidirectional():

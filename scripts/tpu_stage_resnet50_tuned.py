@@ -1,15 +1,16 @@
 """Tuned ResNet-50 stage: push bulk-mode MFU past 0.30 (round-5 task #2).
 
-The first window-captured resnet50 result (batch 384) measured MFU
-0.258 per-step / 0.289 bulk — per-step host dispatch costs ~11%, so the
-remaining lever is arithmetic intensity: bigger per-chip batch + longer
-bulk chains (more steps amortized into ONE XLA program). This stage
-sweeps batch sizes under `TrainStep.run_chain` with fetch-delta timing
-and reports the best configuration as the headline resnet50 metric
-(same metric name — it is the same model/task, just a tuned batch).
+The window-captured baseline (batch 384) measured MFU 0.258 per-step /
+0.289 bulk — per-step dispatch costs ~11%, so the remaining lever is
+arithmetic intensity: a bigger per-chip batch under `run_chain` bulk
+mode. A first attempt that swept batches inside ONE process hung: a
+batch that exceeds HBM can stall server-side over the tunnel (no
+exception ever propagates), eating the whole stage budget. So this
+stage is a PARENT that tries each batch in its own process-group-
+bounded child (`TUNED_ONE=<batch>` mode) and keeps the best result —
+one infeasible batch costs its own sub-budget, nothing more.
 
-Skips a batch size on RESOURCE_EXHAUSTED instead of dying: the largest
-config that fits wins.
+Fetch-delta timing as everywhere (tunnel wait APIs are async no-ops).
 """
 import json
 import os
@@ -17,88 +18,143 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _stage_prelude import init_stage  # noqa: E402
+from _stage_prelude import REPO, init_stage  # noqa: E402
 
-jax, devs, init_s = init_stage()
-kind = devs[0].device_kind
-platform = devs[0].platform
-
-import numpy as onp  # noqa: E402
-
-import mxnet_tpu as mx  # noqa: E402
-from mxnet_tpu import gluon, parallel  # noqa: E402
-from bench import RESNET50_TRAIN_FLOPS_PER_IMG, _peak_flops  # noqa: E402
-
-BATCHES = [int(b) for b in
-           os.environ.get("TUNED_BATCHES", "512,640").split(",")]
+HW = int(os.environ.get("TUNED_HW", "224"))  # override for CPU smoke
 LO = int(os.environ.get("TUNED_CHAIN_LO", "2"))
-HI = int(os.environ.get("TUNED_CHAIN_HI", "8"))
-HW = 224
+HI = int(os.environ.get("TUNED_CHAIN_HI", "6"))
 
-n_dev = jax.local_device_count()
-mesh = parallel.make_mesh((n_dev,), ("dp",))
-parallel.set_mesh(mesh)
-peak = _peak_flops(kind)
 
-best = None
-for batch in BATCHES:
-    try:
-        net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
-        net.initialize()
-        net.cast("bfloat16")
-        step = parallel.TrainStep(
-            net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
-            optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
-                              "multi_precision": True},
-            mesh=mesh, batch_axis="dp")
+def run_one(batch):
+    """Child mode: time one batch size under bulk chains; print JSON."""
+    # self-destruct backstop: the parent SIGKILLs this child's group on
+    # its sub-timeout, but if the SUPERVISOR killpg's the parent first,
+    # this child (own session via run_group_bounded) would escape that
+    # kill — and a child wedged on an over-HBM batch holds the TPU
+    # client forever. SIGALRM's default action terminates us even when
+    # the main thread is stuck inside a blocking PJRT fetch.
+    import signal
+    signal.alarm(int(os.environ.get("TUNED_CHILD_TIMEOUT", "390")) + 30)
+    jax, devs, init_s = init_stage()
+    kind = devs[0].device_kind
+    platform = devs[0].platform
 
-        def chain_args(n):
-            return (mx.np.random.uniform(
-                        size=(n, batch, HW, HW, 3), dtype="bfloat16"),
-                    mx.np.zeros((n, batch), dtype="int32"))
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from bench import RESNET50_TRAIN_FLOPS_PER_IMG, _peak_flops
 
-        def timed(args):
-            t0 = time.perf_counter()
-            step.run_chain(*args).asnumpy()
-            return time.perf_counter() - t0
+    n_dev = jax.local_device_count()
+    mesh = parallel.make_mesh((n_dev,), ("dp",))
+    parallel.set_mesh(mesh)
+    peak = _peak_flops(kind)
 
-        args_lo, args_hi = chain_args(LO), chain_args(HI)
+    net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
+    net.initialize()
+    net.cast("bfloat16")
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9,
+                          "multi_precision": True},
+        mesh=mesh, batch_axis="dp")
+
+    def chain_args(n):
+        return (mx.np.random.uniform(
+                    size=(n, batch, HW, HW, 3), dtype="bfloat16"),
+                mx.np.zeros((n, batch), dtype="int32"))
+
+    def timed(args):
         t0 = time.perf_counter()
-        timed(args_lo)          # compile + run (cache-warm across windows)
-        timed(args_hi)
-        compile_s = time.perf_counter() - t0
-        t_lo, t_hi = timed(args_lo), timed(args_hi)
-        sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
-        ips = batch / sec_per_step
-        mfu = (RESNET50_TRAIN_FLOPS_PER_IMG * batch / sec_per_step
-               / (peak * n_dev)) if peak else None
-        rec = {
-            "metric": "resnet50_train_images_per_sec_per_chip",
-            "value": round(ips / n_dev, 2),
-            "unit": "images/sec/chip",
-            "vs_baseline": round(ips / n_dev / 360.0, 4),
-            "mfu": round(mfu, 4) if mfu is not None else None,
-            "ips_bulk": round(ips, 2),
-            "batch": batch,
-            "chain": [LO, HI],
-            "compile_s": round(compile_s, 1),
-            "mode": "bulk_tuned",
-            "init_s": round(init_s, 2),
-            "platform": platform,
-            "device_kind": kind,
-            "n_devices": n_dev,
-        }
-        print(json.dumps(rec), flush=True)
+        step.run_chain(*args).asnumpy()
+        return time.perf_counter() - t0
+
+    def stage(msg):
+        print(f"[tuned:{batch}] {msg}", file=sys.stderr, flush=True)
+
+    args_lo, args_hi = chain_args(LO), chain_args(HI)
+    t0 = time.perf_counter()
+    stage("compile+run lo chain")
+    timed(args_lo)
+    stage("compile+run hi chain")
+    timed(args_hi)
+    compile_s = time.perf_counter() - t0
+    stage("timing")
+    t_lo, t_hi = timed(args_lo), timed(args_hi)
+    sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+    ips = batch / sec_per_step
+    mfu = (RESNET50_TRAIN_FLOPS_PER_IMG * batch / sec_per_step
+           / (peak * n_dev)) if peak else None
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips / n_dev, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(ips / n_dev / 360.0, 4),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "ips_bulk": round(ips, 2),
+        "batch": batch,
+        "chain": [LO, HI],
+        "compile_s": round(compile_s, 1),
+        "mode": "bulk_tuned",
+        "init_s": round(init_s, 2),
+        "platform": platform,
+        "device_kind": kind,
+        "n_devices": n_dev,
+    }), flush=True)
+
+
+def main():
+    one = os.environ.get("TUNED_ONE")
+    if one:
+        run_one(int(one))
+        return 0
+
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    from tools.procutil import run_group_bounded
+    batches = [int(b) for b in
+               os.environ.get("TUNED_BATCHES", "448,512").split(",")]
+    per_child_s = int(os.environ.get("TUNED_CHILD_TIMEOUT", "390"))
+    # finish before the supervisor's 900s stage killpg fires: a child
+    # is in its own session, so a parent killed from outside orphans it
+    total_deadline = time.monotonic() + int(
+        os.environ.get("TUNED_TOTAL_BUDGET", "840"))
+    best = None
+    for batch in batches:
+        remaining = total_deadline - time.monotonic()
+        if remaining < 90:
+            print(f"[tuned] stage budget exhausted before batch "
+                  f"{batch}", file=sys.stderr, flush=True)
+            break
+        env = dict(os.environ)
+        env["TUNED_ONE"] = str(batch)
+        env["TUNED_CHILD_TIMEOUT"] = str(int(min(per_child_s,
+                                                 remaining - 30)))
+        rc, out, err, timed_out = run_group_bounded(
+            [sys.executable, os.path.abspath(__file__)],
+            int(min(per_child_s, remaining - 30)), env=env, cwd=REPO)
+        print(err[-500:], file=sys.stderr, flush=True)
+        rec = None
+        for line in out.strip().splitlines():
+            if line.startswith("{"):
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        if timed_out or rc != 0 or not rec:
+            print(f"[tuned] batch {batch}: rc={rc} "
+                  f"timed_out={timed_out}, no result",
+                  file=sys.stderr, flush=True)
+            continue
+        print(json.dumps(rec), flush=True)  # interim, harvestable
         if best is None or rec["value"] > best["value"]:
             best = rec
-    except Exception as e:  # noqa: BLE001 — OOM or transient: try next
-        print(f"[tuned] batch {batch} failed: "
-              f"{type(e).__name__}: {str(e)[:200]}",
-              file=sys.stderr, flush=True)
+    if best is None:
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "error": "all tuned batches failed"}),
+              flush=True)
+        return 1
+    print(json.dumps(best), flush=True)
+    return 0
 
-if best is None:
-    print(json.dumps({"metric": "bench_error", "value": 0.0,
-                      "error": "all tuned batches failed",
-                      "platform": platform}), flush=True)
-    sys.exit(1)
-print(json.dumps(best), flush=True)
+
+if __name__ == "__main__":
+    sys.exit(main())

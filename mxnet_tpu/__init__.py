@@ -69,7 +69,8 @@ from . import numpy_extension  # noqa: E402,F401
 from . import numpy_extension as npx  # noqa: E402,F401
 from . import autograd  # noqa: E402,F401
 from .utils_io import save, load  # noqa: E402,F401
-from .base import set_np, reset_np, is_np_array, is_np_shape  # noqa: E402,F401
+from .base import (  # noqa: E402,F401
+    set_np, reset_np, is_np_array, is_np_shape, is_np_default_dtype)
 
 # Subsystem modules land incrementally during the build; import what exists.
 import importlib as _importlib

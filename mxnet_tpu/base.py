@@ -114,13 +114,48 @@ def is_np_array():
     return True
 
 
-def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001 - parity signature
-    """Parity shim: numpy semantics are always active."""
-    return None
+# --- np-default-dtype mode (reference: mxnet.util.set_np(dtype=True) /
+# use_np_default_dtype, tests/python/unittest/test_numpy_default_dtype.py):
+# default-dtype ops (array/ones/zeros/linspace/random.* ...) produce
+# float64 instead of the deep-numpy float32 default. float64 only
+# survives on device under jax x64, so the toggle flips that too and
+# restores the prior x64 state on exit.
+_np_default_dtype_state = {"on": False, "prev_x64": None}
+
+
+def default_float():
+    """The current default float dtype for creation/random ops."""
+    return onp.float64 if _np_default_dtype_state["on"] else onp.float32
+
+
+def is_np_default_dtype():
+    return _np_default_dtype_state["on"]
+
+
+def _set_np_default_dtype(on):
+    import jax
+
+    st = _np_default_dtype_state
+    if on and not st["on"]:
+        st["prev_x64"] = bool(jax.config.jax_enable_x64)
+        jax.config.update("jax_enable_x64", True)
+        st["on"] = True
+    elif not on and st["on"]:
+        if not st["prev_x64"]:
+            jax.config.update("jax_enable_x64", False)
+        st["on"] = False
+
+
+def set_np(shape=True, array=True, dtype=False):  # noqa: ARG001 - shape/array always on
+    """NumPy shape/array semantics are always active in this framework
+    (the reference toggles exist for its legacy mx.nd API). The dtype
+    flag is REAL: set_np(dtype=True) switches the default float dtype
+    to float64, classic-NumPy style."""
+    _set_np_default_dtype(bool(dtype))
 
 
 def reset_np():
-    return None
+    _set_np_default_dtype(False)
 
 
 def legacy_reshape_shape(in_shape, shape, reverse=False):

@@ -52,7 +52,7 @@ uint32 = onp.uint32
 uint64 = onp.uint64
 bool_ = onp.bool_
 
-_default_float = onp.float32
+from ..base import default_float as _default_float_fn  # noqa: E402
 
 
 # ---------------------------------------------------------------------------
@@ -137,7 +137,7 @@ def array(object, dtype=None, ctx=None, device=None):
         else:
             # python scalars/lists default to float32 (reference semantics:
             # mx.np.array([1, 2]) is float32)
-            dtype = _default_float
+            dtype = _default_float_fn()
         npdata = probe.astype(dtype) if probe.dtype != dtype else probe
         dtype = narrow_dtype(npdata, dtype)  # 64→32 backend policy
     else:
@@ -155,7 +155,7 @@ def asarray(a, dtype=None, ctx=None):
 
 def _creation(maker, shape, dtype, ctx, order=None):
     ctx = ctx or current_context()
-    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float_fn()
     if isinstance(shape, (int, onp.integer)):
         shape = (int(shape),)
     data = jax.device_put(maker(tuple(int(s) for s in shape), dtype),
@@ -179,10 +179,11 @@ def full(shape, fill_value, dtype=None, order="C", ctx=None, out=None, device=No
     if dtype is None:
         if isinstance(fill_value, (bool,)):
             dtype = onp.bool_
-        elif isinstance(fill_value, int):
-            dtype = onp.int64
         else:
-            dtype = _default_float
+            # reference semantics (ndarray/numpy/_op.py full + its
+            # doctest: np.full((2,2), 10) -> float): full is a
+            # default-dtype op even for int fills
+            dtype = _default_float_fn()
     r = _creation(lambda s, d: jnp.full(s, fill_value, d), shape, dtype,
                   ctx or device)
     return _set_out(out, r)
@@ -210,7 +211,18 @@ def empty_like(prototype, dtype=None, order="C", subok=False):
 def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
     ctx = ctx or device or current_context()
     if dtype is None:
-        dtype = _default_float  # reference semantics: arange defaults float32
+        # deep-numpy mode: float32 regardless of argument types; under
+        # set_np(dtype=True), integer args give int64 like classic
+        # NumPy (reference test_numpy_default_dtype
+        # test_np_arange_default_dtype)
+        from ..base import is_np_default_dtype
+        # NB: builtins.all — this module shadows `all` with the
+        # reduction op
+        int_args = builtins.all(isinstance(v, (int, onp.integer))
+                                for v in (start, stop, step)
+                                if v is not None)
+        dtype = (onp.int64 if is_np_default_dtype() and int_args
+                 else _default_float_fn())
     data = jax.device_put(jnp.arange(start, stop, step, resolve_dtype(dtype)),
                           ctx.jax_device)
     return NDArray(engine.track(data), ctx=ctx)
@@ -219,7 +231,7 @@ def arange(start, stop=None, step=1, dtype=None, ctx=None, device=None):
 def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
              axis=0, ctx=None):
     ctx = ctx or current_context()
-    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float_fn()
     out = jnp.linspace(start, stop, num, endpoint=endpoint, retstep=retstep,
                        dtype=dtype, axis=axis)
     if retstep:
@@ -232,7 +244,7 @@ def linspace(start, stop, num=50, endpoint=True, retstep=False, dtype=None,
 def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
              axis=0, ctx=None):
     ctx = ctx or current_context()
-    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float_fn()
     data = jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
                         dtype=dtype, axis=axis)
     return NDArray(engine.track(jax.device_put(data, ctx.jax_device)), ctx=ctx)
@@ -240,7 +252,7 @@ def logspace(start, stop, num=50, endpoint=True, base=10.0, dtype=None,
 
 def eye(N, M=None, k=0, dtype=None, ctx=None):
     ctx = ctx or current_context()
-    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float_fn()
     data = jax.device_put(jnp.eye(N, M, k, dtype), ctx.jax_device)
     return NDArray(engine.track(data), ctx=ctx)
 
@@ -266,7 +278,7 @@ def triu(m, k=0):
 
 def tri(N, M=None, k=0, dtype=None, ctx=None):
     ctx = ctx or current_context()
-    dtype = resolve_dtype(dtype) if dtype is not None else _default_float
+    dtype = resolve_dtype(dtype) if dtype is not None else _default_float_fn()
     return NDArray(engine.track(jnp.tri(N, M, k, dtype)), ctx=ctx)
 
 
@@ -313,8 +325,21 @@ def ascontiguousarray(a, dtype=None):
 add = _mkbin(jnp.add, "add")
 subtract = _mkbin(jnp.subtract, "subtract")
 multiply = _mkbin(jnp.multiply, "multiply")
-divide = _mkbin(jnp.true_divide, "divide")
-true_divide = _mkbin(jnp.true_divide, "true_divide")
+def _jnp_true_divide(x1, x2):
+    """int/int division produces the DEFAULT float dtype (float32 in
+    deep-numpy mode, float64 under set_np(dtype=True)) — jax would
+    pin it at float32 either way."""
+    if (jnp.issubdtype(jnp.result_type(x1), jnp.integer)
+            or jnp.issubdtype(jnp.result_type(x1), jnp.bool_)) and (
+            jnp.issubdtype(jnp.result_type(x2), jnp.integer)
+            or jnp.issubdtype(jnp.result_type(x2), jnp.bool_)):
+        fdt = _default_float_fn()
+        return jnp.true_divide(jnp.asarray(x1, fdt), jnp.asarray(x2, fdt))
+    return jnp.true_divide(x1, x2)
+
+
+divide = _mkbin(_jnp_true_divide, "divide")
+true_divide = _mkbin(_jnp_true_divide, "true_divide")
 floor_divide = _mkbin(jnp.floor_divide, "floor_divide")
 mod = _mkbin(jnp.mod, "mod")
 remainder = _mkbin(jnp.remainder, "remainder")
@@ -1217,17 +1242,17 @@ def msort(a):
 
 def blackman(M, dtype=None):
     return array(onp.blackman(int(M)).astype(
-        resolve_dtype(dtype) or _default_float))
+        resolve_dtype(dtype) or _default_float_fn()))
 
 
 def hamming(M, dtype=None):
     return array(onp.hamming(int(M)).astype(
-        resolve_dtype(dtype) or _default_float))
+        resolve_dtype(dtype) or _default_float_fn()))
 
 
 def hanning(M, dtype=None):
     return array(onp.hanning(int(M)).astype(
-        resolve_dtype(dtype) or _default_float))
+        resolve_dtype(dtype) or _default_float_fn()))
 
 
 def fill_diagonal(a, val, wrap=False):

@@ -18,7 +18,7 @@ from ..ndarray.ndarray import NDArray
 from ..random_state import next_key, seed as _seed
 from ..base import resolve_dtype
 
-_default_float = onp.float32
+from ..base import default_float as _default_float_fn  # noqa: E402
 
 
 def seed(seed_value):
@@ -45,7 +45,7 @@ def _val(x):
 
 def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None,
             device=None):
-    dtype = dtype or _default_float
+    dtype = dtype or _default_float_fn()
     if size is None:
         try:
             size = jnp.broadcast_shapes(onp.shape(_val(low)), onp.shape(_val(high)))
@@ -63,7 +63,7 @@ def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, out=None,
 
 def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None,
            device=None):
-    dtype = dtype or _default_float
+    dtype = dtype or _default_float_fn()
     if size is None:
         try:
             size = jnp.broadcast_shapes(onp.shape(_val(loc)), onp.shape(_val(scale)))
@@ -105,7 +105,9 @@ def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
         arr = jnp.arange(int(a))
     else:
         arr = jnp.asarray(a)
-    pp = _val(p) if p is not None else None
+    # numpy accepts any array-like for p (list included)
+    pp = None if p is None else (
+        _val(p) if isinstance(p, NDArray) else jnp.asarray(p))
     r = _make(lambda k, s: jax.random.choice(k, arr, shape=s, replace=replace,
                                              p=pp), size, ctx)
     if out is not None:
@@ -130,13 +132,13 @@ def shuffle(x):
 def beta(a, b, size=None, dtype=None, ctx=None):
     a, b = _val(a), _val(b)
     return _make(lambda k, s: jax.random.beta(k, a, b, shape=s or None),
-                 size, ctx, dtype or _default_float)
+                 size, ctx, dtype or _default_float_fn())
 
 
 def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     sh, sc = _val(shape), _val(scale)
     r = _make(lambda k, s: jax.random.gamma(k, sh, shape=s or None) * sc,
-              size, ctx, dtype or _default_float)
+              size, ctx, dtype or _default_float_fn())
     if out is not None:
         out._inplace(r)
         return out
@@ -146,7 +148,7 @@ def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
 def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
     sc = _val(scale)
     r = _make(lambda k, s: jax.random.exponential(k, s) * sc, size, ctx,
-              dtype or _default_float)
+              dtype or _default_float_fn())
     if out is not None:
         out._inplace(r)
         return out
@@ -156,7 +158,7 @@ def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
 def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
     lo, sc = _val(loc), _val(scale)
     r = _make(lambda k, s: lo + sc * jax.random.laplace(k, s), size, ctx,
-              dtype or _default_float)
+              dtype or _default_float_fn())
     if out is not None:
         out._inplace(r)
         return out
@@ -166,7 +168,7 @@ def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
 def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
     lo, sc = _val(loc), _val(scale)
     r = _make(lambda k, s: lo + sc * jax.random.logistic(k, s), size, ctx,
-              _default_float)
+              _default_float_fn())
     if out is not None:
         out._inplace(r)
         return out
@@ -176,7 +178,7 @@ def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
 def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
     lo, sc = _val(loc), _val(scale)
     r = _make(lambda k, s: lo + sc * jax.random.gumbel(k, s), size, ctx,
-              _default_float)
+              _default_float_fn())
     if out is not None:
         out._inplace(r)
         return out
@@ -186,38 +188,38 @@ def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
 def lognormal(mean=0.0, sigma=1.0, size=None, ctx=None):
     m, sg = _val(mean), _val(sigma)
     return _make(lambda k, s: jnp.exp(m + sg * jax.random.normal(k, s)),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def pareto(a, size=None, ctx=None):
     av = _val(a)
     return _make(lambda k, s: jax.random.pareto(k, av, shape=s or None) - 1.0,
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def power(a, size=None, ctx=None):
     av = _val(a)
     return _make(lambda k, s: jnp.power(jax.random.uniform(k, s), 1.0 / av),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def rayleigh(scale=1.0, size=None, ctx=None):
     sc = _val(scale)
     return _make(
         lambda k, s: sc * jnp.sqrt(-2.0 * jnp.log1p(-jax.random.uniform(k, s))),
-        size, ctx, _default_float)
+        size, ctx, _default_float_fn())
 
 
 def weibull(a, size=None, ctx=None):
     av = _val(a)
     return _make(lambda k, s: jax.random.weibull_min(k, 1.0, av, shape=s or None),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def chisquare(df, size=None, dtype=None, ctx=None):
     d = _val(df)
     return _make(lambda k, s: 2.0 * jax.random.gamma(k, d / 2.0, shape=s or None),
-                 size, ctx, dtype or _default_float)
+                 size, ctx, dtype or _default_float_fn())
 
 
 def f(dfnum, dfden, size=None, ctx=None):
@@ -230,13 +232,13 @@ def f(dfnum, dfden, size=None, ctx=None):
         den = 2.0 * jax.random.gamma(k2, d / 2.0, shape=s or None) / d
         return num / den
 
-    return _make(sampler, size, ctx, _default_float)
+    return _make(sampler, size, ctx, _default_float_fn())
 
 
 def binomial(n, p, size=None, ctx=None):
     nv, pv = _val(n), _val(p)
     return _make(lambda k, s: jax.random.binomial(k, nv, pv, shape=s or None),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def negative_binomial(n, p, size=None, ctx=None):
@@ -248,19 +250,19 @@ def negative_binomial(n, p, size=None, ctx=None):
         lam = jax.random.gamma(k1, nv, shape=s or None) * (1 - pv) / pv
         return jax.random.poisson(k2, lam)
 
-    return _make(sampler, size, ctx, _default_float)
+    return _make(sampler, size, ctx, _default_float_fn())
 
 
 def poisson(lam=1.0, size=None, ctx=None):
     lv = _val(lam)
     return _make(lambda k, s: jax.random.poisson(k, lv, shape=s or None),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def geometric(p, size=None, ctx=None):
     pv = _val(p)
     return _make(lambda k, s: jax.random.geometric(k, pv, shape=s or None),
-                 size, ctx, _default_float)
+                 size, ctx, _default_float_fn())
 
 
 def multinomial(n, pvals, size=None):
@@ -280,7 +282,7 @@ def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
     m = _val(mean) if isinstance(mean, NDArray) else jnp.asarray(mean)
     c = _val(cov) if isinstance(cov, NDArray) else jnp.asarray(cov)
     return _make(lambda k, s: jax.random.multivariate_normal(
-        k, m, c, shape=s or None), size, None, _default_float)
+        k, m, c, shape=s or None), size, None, _default_float_fn())
 
 
 def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None):
@@ -289,4 +291,4 @@ def bernoulli(prob=None, logit=None, size=None, dtype=None, ctx=None):
     else:
         pv = jax.nn.sigmoid(_val(logit))
     return _make(lambda k, s: jax.random.bernoulli(k, pv, shape=s or None),
-                 size, ctx, dtype or _default_float)
+                 size, ctx, dtype or _default_float_fn())

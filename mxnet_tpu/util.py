@@ -45,7 +45,36 @@ def use_np(func):
 
 
 def use_np_default_dtype(func):
-    return func
+    """Run func under np-default-dtype mode (float64 defaults);
+    restores the prior mode on exit. Like the reference
+    (mxnet.util:1003) it also decorates classes — each public method
+    is wrapped in place and the class itself is returned — and
+    rejects non-callables with TypeError."""
+    import functools
+    import inspect
+
+    from .base import _set_np_default_dtype, is_np_default_dtype
+
+    if inspect.isclass(func):
+        for name, method in inspect.getmembers(func, callable):
+            if name.startswith("__") and name != "__init__":
+                continue
+            setattr(func, name, use_np_default_dtype(method))
+        return func
+    if not callable(func):
+        raise TypeError(
+            "use_np_default_dtype can only decorate classes and "
+            f"callable objects, got {type(func)}")
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = is_np_default_dtype()
+        _set_np_default_dtype(True)
+        try:
+            return func(*args, **kwargs)
+        finally:
+            _set_np_default_dtype(prev)
+    return wrapper
 
 
 def is_np_shape():
@@ -56,12 +85,8 @@ def is_np_array():
     return True
 
 
-def set_np(shape=True, array=True, dtype=False):
-    return None
-
-
-def reset_np():
-    return None
+from .base import (  # noqa: E402,F401 - re-exported parity surface
+    is_np_default_dtype, reset_np, set_np)
 
 
 def get_gpu_count():

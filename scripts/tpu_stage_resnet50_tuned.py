@@ -124,13 +124,13 @@ def main():
             print(f"[tuned] stage budget exhausted before batch "
                   f"{batch}", file=sys.stderr, flush=True)
             break
+        child_s = int(min(per_child_s, remaining - 30))
         env = dict(os.environ)
         env["TUNED_ONE"] = str(batch)
-        env["TUNED_CHILD_TIMEOUT"] = str(int(min(per_child_s,
-                                                 remaining - 30)))
+        env["TUNED_CHILD_TIMEOUT"] = str(child_s)
         rc, out, err, timed_out = run_group_bounded(
-            [sys.executable, os.path.abspath(__file__)],
-            int(min(per_child_s, remaining - 30)), env=env, cwd=REPO)
+            [sys.executable, os.path.abspath(__file__)], child_s,
+            env=env, cwd=REPO)
         print(err[-500:], file=sys.stderr, flush=True)
         rec = None
         for line in out.strip().splitlines():

@@ -1,0 +1,113 @@
+"""Default-dtype mode conformance.
+
+Reference model: tests/python/unittest/test_numpy_default_dtype.py —
+the same op list checked both ways: deep-NumPy mode (the default)
+gives float32; np-default-dtype mode (`mx.set_np(dtype=True)` /
+`mx.util.use_np_default_dtype`) gives classic-NumPy float64. The
+toggle also implies x64 on device, and must restore the prior state.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu.util import use_np_default_dtype
+
+# (name, zero-arg callable) — the reference's
+# _NUMPY_DTYPE_DEFAULT_FUNC_LIST workloads
+CASES = [
+    ("array", lambda: mnp.array([1, 2, 3])),
+    ("ones", lambda: mnp.ones(5)),
+    ("ones_tuple", lambda: mnp.ones((5,))),
+    ("zeros", lambda: mnp.zeros(5)),
+    ("eye", lambda: mnp.eye(3)),
+    ("eye_k", lambda: mnp.eye(3, k=1)),
+    ("full", lambda: mnp.full((3,), 2)),
+    ("identity", lambda: mnp.identity(3)),
+    ("linspace", lambda: mnp.linspace(0, 10, 5)),
+    ("logspace", lambda: mnp.logspace(0, 2, 5)),
+    ("mean", lambda: mnp.array([1, 2, 3]).mean()),
+    ("hanning", lambda: mnp.hanning(6)),
+    ("hamming", lambda: mnp.hamming(6)),
+    ("blackman", lambda: mnp.blackman(6)),
+    ("random.gamma", lambda: mnp.random.gamma(2.0, 1.0, size=(3,))),
+    ("random.uniform", lambda: mnp.random.uniform(size=(3,))),
+    ("random.normal", lambda: mnp.random.normal(size=(3,))),
+    ("random.chisquare", lambda: mnp.random.chisquare(3.0, size=(3,))),
+    ("true_divide", lambda: mnp.array([1, 2], dtype="int32") / 2),
+]
+
+
+@pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+def test_deep_numpy_default_is_float32(name, fn):
+    assert not mx.is_np_default_dtype()
+    assert onp.dtype(fn().dtype) == onp.float32
+
+
+@pytest.mark.parametrize("name,fn", CASES, ids=[c[0] for c in CASES])
+def test_np_default_dtype_is_float64(name, fn):
+    @use_np_default_dtype
+    def check():
+        assert mx.is_np_default_dtype()
+        return fn()
+
+    out = check()
+    assert onp.dtype(out.dtype) == onp.float64, \
+        f"{name}: {out.dtype} under np-default-dtype mode"
+    # mode restored afterwards
+    assert not mx.is_np_default_dtype()
+    assert onp.dtype(fn().dtype) == onp.float32
+
+
+def test_arange_default_dtype():
+    """Reference test_np_arange_default_dtype: deep mode float32
+    always; np-default-dtype mode gives int64 for integer args and
+    float64 when any arg is a float."""
+    assert mnp.arange(3, 7, 2).dtype == onp.float32
+    assert mnp.arange(3, 7.5).dtype == onp.float32
+
+    @use_np_default_dtype
+    def check():
+        assert mnp.arange(3, 7, 2).dtype == onp.int64
+        assert mnp.arange(5).dtype == onp.int64
+        assert mnp.arange(3, 7.5).dtype == onp.float64
+    check()
+
+
+def test_use_np_default_dtype_on_class():
+    """Decorating a class wraps its methods in place and returns the
+    class itself (reference util.py Float64Tensor pattern)."""
+    @use_np_default_dtype
+    class Maker:
+        def __init__(self):
+            self.z = mnp.zeros(3)
+
+        def make(self):
+            return mnp.ones(4)
+
+    assert isinstance(Maker, type)
+    m = Maker()
+    assert isinstance(m, Maker)
+    assert m.z.dtype == onp.float64
+    assert m.make().dtype == onp.float64
+    assert not mx.is_np_default_dtype()  # restored outside calls
+    with pytest.raises(TypeError):
+        use_np_default_dtype(42)
+
+
+def test_set_np_and_reset_np_toggle():
+    import jax
+    assert not mx.is_np_default_dtype()
+    prev_x64 = bool(jax.config.jax_enable_x64)
+    try:
+        mx.set_np(dtype=True)
+        assert mx.is_np_default_dtype()
+        assert mnp.zeros(3).dtype == onp.float64
+        # explicit dtypes are never overridden by the mode
+        assert mnp.zeros(3, dtype="float32").dtype == onp.float32
+        assert mnp.array([1, 2], dtype="int32").dtype == onp.int32
+    finally:
+        mx.reset_np()
+    assert not mx.is_np_default_dtype()
+    assert bool(jax.config.jax_enable_x64) == prev_x64
+    assert mnp.zeros(3).dtype == onp.float32

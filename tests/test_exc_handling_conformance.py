@@ -1,0 +1,99 @@
+"""Exception-propagation conformance.
+
+Reference model: tests/python/unittest/test_exc_handling.py — errors
+from (possibly async) operator execution must surface at defined
+points, not be lost; an error in one computation must not poison
+unrelated later work; errors propagate through autograd and through
+hybridized blocks; NaiveEngine mode surfaces errors at the faulting
+op. The TPU redesign surfaces eager shape/dtype errors at dispatch
+(jax traces immediately) and deferred device errors at sync points
+(wait_to_read/asnumpy/waitall) — both are exercised here.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, engine, gluon, np as mnp
+
+
+def test_shape_error_raises_and_names_shapes():
+    a, b = mnp.ones((2, 3)), mnp.ones((4, 5))
+    with pytest.raises(Exception) as ei:
+        (a @ b).wait_to_read()
+    assert "2" in str(ei.value) or "3" in str(ei.value)
+
+
+def test_error_does_not_poison_subsequent_ops():
+    a, b = mnp.ones((2, 3)), mnp.ones((4, 5))
+    with pytest.raises(Exception):
+        (a @ b).wait_to_read()
+    # unrelated work still runs and is correct
+    c = (mnp.ones((3, 3)) @ mnp.ones((3, 3))).asnumpy()
+    onp.testing.assert_allclose(c, onp.full((3, 3), 3.0))
+    engine.waitall()  # no stale error re-raised for unrelated arrays
+
+
+def test_error_in_autograd_record():
+    x = mnp.ones((2, 3))
+    x.attach_grad()
+    with pytest.raises(Exception):
+        with autograd.record():
+            y = x @ mnp.ones((4, 5))
+            y.backward()
+    # autograd state recovered: a fresh recorded computation works
+    with autograd.record():
+        z = (x * 2).sum()
+    z.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                onp.full((2, 3), 2.0))
+
+
+def test_error_through_hybridized_block():
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    net(mnp.ones((2, 8))).wait_to_read()  # build the cache
+    with pytest.raises(Exception):
+        net(mnp.ones((2, 5))).wait_to_read()  # wrong in_units
+    # the cached executable still works after the failure
+    out = net(mnp.ones((2, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_repeated_sync_reraises():
+    """Every sync on a failed array raises (the reference re-raises
+    var_exception on each WaitToRead)."""
+    a, b = mnp.ones((2, 3)), mnp.ones((4, 5))
+    with pytest.raises(Exception):
+        (a @ b).asnumpy()
+    with pytest.raises(Exception):
+        (a @ b).asnumpy()
+
+
+def test_naive_engine_mode_raises_at_op(monkeypatch):
+    """MXTPU_ENGINE_TYPE=NaiveEngine surfaces the error at the
+    faulting op call itself (reference MXNET_ENGINE_TYPE parity)."""
+    monkeypatch.setenv("MXTPU_ENGINE_TYPE", "NaiveEngine")
+    try:
+        with pytest.raises(Exception):
+            mnp.ones((2, 3)) @ mnp.ones((4, 5))
+    finally:
+        monkeypatch.delenv("MXTPU_ENGINE_TYPE", raising=False)
+
+
+def test_invalid_argument_error_type():
+    """Bad operator arguments raise MXNetError-compatible exceptions
+    (the typed error hierarchy maps to the reference's
+    mxnet.base.MXNetError)."""
+    with pytest.raises(Exception):
+        mnp.concatenate([mnp.ones((2,)), mnp.ones((3, 4))], axis=2)
+
+
+def test_waitall_reports_then_clears():
+    a, b = mnp.ones((2, 3)), mnp.ones((4, 5))
+    try:
+        (a @ b).wait_to_read()
+    except Exception:
+        pass
+    # waitall after the error has been consumed must not re-raise it
+    engine.waitall()

@@ -1,0 +1,169 @@
+"""Second-order gradient conformance sweep.
+
+Reference model: tests/python/unittest/test_higher_order_grad.py —
+every unary op there gets grad-of-grad checked against an analytic
+second derivative (same op list; shapes/tolerances adapted to f32).
+Method mirrors the reference's: record y = f(x), take the first
+gradient with create_graph=True, contract it with a RANDOM head
+tensor h, and backward — x.grad must equal h * f''(x). The random
+head (rather than ones) catches bugs where the second-order graph
+drops the incoming cotangent.
+
+Also ports the dense (fully_connected) backward-of-backward cases
+(reference test_dense_backward_flatten / _no_flatten): gradients of
+the weight-gradient contraction w.r.t. x and w.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import autograd, np as mnp, npx
+
+
+def _second_order_check(f, x_np, d1, d2, rtol=1e-4, atol=1e-5):
+    x = mnp.array(x_np)
+    x.attach_grad()
+    h_np = onp.random.RandomState(7).uniform(
+        0.5, 1.5, x_np.shape).astype("f4")
+    h = mnp.array(h_np)
+    with autograd.record():
+        y = f(x)
+        (gx,) = autograd.grad(y, [x], create_graph=True,
+                              retain_graph=True)
+        contracted = (gx * h).sum()
+    contracted.backward()
+    onp.testing.assert_allclose(gx.asnumpy(), d1(x_np),
+                                rtol=rtol, atol=atol,
+                                err_msg="first derivative")
+    onp.testing.assert_allclose(x.grad.asnumpy(), h_np * d2(x_np),
+                                rtol=rtol, atol=atol,
+                                err_msg="second derivative")
+
+
+_LN2, _LN10 = onp.log(2.0), onp.log(10.0)
+
+
+def _sig(x):
+    return 1.0 / (1.0 + onp.exp(-x))
+
+
+# (name, f, domain (lo, hi), f', f'')
+CASES = [
+    ("sin", mnp.sin, (-2, 2), onp.cos, lambda x: -onp.sin(x)),
+    ("cos", mnp.cos, (-2, 2), lambda x: -onp.sin(x),
+     lambda x: -onp.cos(x)),
+    ("tan", mnp.tan, (-1, 1), lambda x: 1 / onp.cos(x) ** 2,
+     lambda x: 2 * onp.tan(x) / onp.cos(x) ** 2),
+    ("sinh", mnp.sinh, (-2, 2), onp.cosh, onp.sinh),
+    ("cosh", mnp.cosh, (-2, 2), onp.sinh, onp.cosh),
+    ("tanh", mnp.tanh, (-2, 2), lambda x: 1 - onp.tanh(x) ** 2,
+     lambda x: -2 * onp.tanh(x) * (1 - onp.tanh(x) ** 2)),
+    ("arcsin", mnp.arcsin, (-0.9, 0.9),
+     lambda x: (1 - x ** 2) ** -0.5,
+     lambda x: x * (1 - x ** 2) ** -1.5),
+    ("arccos", mnp.arccos, (-0.9, 0.9),
+     lambda x: -((1 - x ** 2) ** -0.5),
+     lambda x: -x * (1 - x ** 2) ** -1.5),
+    ("arctan", mnp.arctan, (-2, 2), lambda x: 1 / (1 + x ** 2),
+     lambda x: -2 * x / (1 + x ** 2) ** 2),
+    ("arcsinh", mnp.arcsinh, (-2, 2),
+     lambda x: (1 + x ** 2) ** -0.5,
+     lambda x: -x * (1 + x ** 2) ** -1.5),
+    ("arccosh", mnp.arccosh, (1.2, 3.0),
+     lambda x: (x ** 2 - 1) ** -0.5,
+     lambda x: -x * (x ** 2 - 1) ** -1.5),
+    ("arctanh", mnp.arctanh, (-0.9, 0.9),
+     lambda x: 1 / (1 - x ** 2),
+     lambda x: 2 * x / (1 - x ** 2) ** 2),
+    ("radians", mnp.radians, (-90, 90),
+     lambda x: onp.full_like(x, onp.pi / 180),
+     lambda x: onp.zeros_like(x)),
+    ("degrees", mnp.degrees, (-2, 2),
+     lambda x: onp.full_like(x, 180 / onp.pi),
+     lambda x: onp.zeros_like(x)),
+    ("relu", npx.relu, (0.1, 2.0),  # away from the kink
+     lambda x: onp.ones_like(x), lambda x: onp.zeros_like(x)),
+    ("log", mnp.log, (0.2, 4.0), lambda x: 1 / x,
+     lambda x: -1 / x ** 2),
+    ("log2", mnp.log2, (0.2, 4.0), lambda x: 1 / (x * _LN2),
+     lambda x: -1 / (x ** 2 * _LN2)),
+    ("log10", mnp.log10, (0.2, 4.0), lambda x: 1 / (x * _LN10),
+     lambda x: -1 / (x ** 2 * _LN10)),
+    ("square", mnp.square, (-2, 2), lambda x: 2 * x,
+     lambda x: onp.full_like(x, 2.0)),
+    ("exp", mnp.exp, (-2, 2), onp.exp, onp.exp),
+    ("expm1", mnp.expm1, (-2, 2), onp.exp, onp.exp),
+    ("log1p", mnp.log1p, (-0.5, 3.0), lambda x: 1 / (1 + x),
+     lambda x: -1 / (1 + x) ** 2),
+    ("reciprocal", mnp.reciprocal, (0.3, 3.0),
+     lambda x: -1 / x ** 2, lambda x: 2 / x ** 3),
+    ("abs", mnp.abs, (0.2, 2.0),  # away from the kink
+     lambda x: onp.sign(x), lambda x: onp.zeros_like(x)),
+    ("sigmoid", npx.sigmoid, (-3, 3),
+     lambda x: _sig(x) * (1 - _sig(x)),
+     lambda x: _sig(x) * (1 - _sig(x)) * (1 - 2 * _sig(x))),
+    ("sqrt", mnp.sqrt, (0.3, 4.0), lambda x: 0.5 * x ** -0.5,
+     lambda x: -0.25 * x ** -1.5),
+    ("cbrt", mnp.cbrt, (0.3, 4.0), lambda x: x ** (-2 / 3) / 3,
+     lambda x: -2 / 9 * x ** (-5 / 3)),
+    ("rsqrt", npx.rsqrt, (0.3, 4.0), lambda x: -0.5 * x ** -1.5,
+     lambda x: 0.75 * x ** -2.5),
+    ("rcbrt", npx.rcbrt, (0.3, 4.0),
+     lambda x: -x ** (-4 / 3) / 3,
+     lambda x: 4 / 9 * x ** (-7 / 3)),
+]
+
+
+@pytest.mark.parametrize("name,f,dom,d1,d2", CASES,
+                         ids=[c[0] for c in CASES])
+def test_second_order(name, f, dom, d1, d2):
+    rng = onp.random.RandomState(hash(name) % (2 ** 31))
+    x = rng.uniform(dom[0], dom[1], (3, 4)).astype("f4")
+    _second_order_check(f, x, d1, d2)
+
+
+def test_clip_second_order():
+    """clip: f' is the in-range indicator, f'' = 0 (away from the
+    clip boundaries)."""
+    x_np = onp.array([[-2.0, -0.5, 0.3, 0.9, 2.5]], "f4")
+    _second_order_check(
+        lambda x: mnp.clip(x, -1.0, 1.0), x_np,
+        lambda x: ((x > -1.0) & (x < 1.0)).astype("f4"),
+        lambda x: onp.zeros_like(x))
+
+
+@pytest.mark.parametrize("flatten", [True, False],
+                         ids=["flatten", "no_flatten"])
+def test_dense_backward(flatten):
+    """Backward-of-backward through fully_connected (reference
+    test_dense_backward_flatten/_no_flatten): for y = x W^T, the
+    gradient of (dL/dW · v) w.r.t. x is h_y-weighted v."""
+    rng = onp.random.RandomState(3)
+    if flatten:
+        x_np = rng.randn(4, 2, 3).astype("f4")  # flattens to (4, 6)
+        in_dim = 6
+    else:
+        x_np = rng.randn(4, 6).astype("f4")
+        in_dim = 6
+    w_np = rng.randn(5, in_dim).astype("f4")
+    v_np = rng.randn(5, in_dim).astype("f4")
+
+    x, w, v = mnp.array(x_np), mnp.array(w_np), mnp.array(v_np)
+    x.attach_grad()
+    w.attach_grad()
+    with autograd.record():
+        y = npx.fully_connected(x, w, None, no_bias=True,
+                                num_hidden=5, flatten=flatten)
+        (gw,) = autograd.grad(y, [w], create_graph=True,
+                              retain_graph=True)
+        contracted = (gw * v).sum()
+    contracted.backward()
+    # gw = sum_b y_head(=1) outer: dy/dW = x flat-summed; contracted
+    # = sum_b (x_flat · v^T rowsum); d/dx = v summed over out rows
+    x_flat = x_np.reshape(x_np.shape[0], -1)
+    onp.testing.assert_allclose(gw.asnumpy(),
+                                onp.ones((x_flat.shape[0], 5), "f4").T
+                                @ x_flat, rtol=1e-4, atol=1e-4)
+    expect_gx = onp.broadcast_to(v_np.sum(0), x_flat.shape) \
+        .reshape(x_np.shape)
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect_gx,
+                                rtol=1e-4, atol=1e-4)

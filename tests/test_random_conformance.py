@@ -1,0 +1,156 @@
+"""Random-sampler statistical conformance.
+
+Reference model: tests/python/unittest/test_random.py — every sampler
+is checked against the analytic moments of its (NumPy-semantics)
+distribution, plus support constraints, seed determinism, and the
+combinatoric samplers (shuffle/permutation/choice/multinomial).
+Moment bounds are 6-sigma on the standard error of the mean and a
+10% relative band on the variance at n=200k — loose enough to never
+flake, tight enough to catch a wrong parameterization (e.g. rate vs
+scale) or a wrong second moment.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mnp
+
+N = 200_000
+_G = 0.5772156649015329  # Euler–Mascheroni
+
+
+def _gamma_fn(z):
+    from math import gamma
+    return gamma(z)
+
+
+# name -> (draw fn, mean, var, support check or None)
+MOMENT_CASES = {
+    "normal": (lambda: mnp.random.normal(1.5, 2.0, size=(N,)),
+               1.5, 4.0, None),
+    "uniform": (lambda: mnp.random.uniform(-1.0, 3.0, size=(N,)),
+                1.0, 16 / 12, lambda s: ((s >= -1) & (s < 3)).all()),
+    "exponential": (lambda: mnp.random.exponential(2.0, size=(N,)),
+                    2.0, 4.0, lambda s: (s >= 0).all()),
+    "gamma": (lambda: mnp.random.gamma(3.0, 2.0, size=(N,)),
+              6.0, 12.0, lambda s: (s > 0).all()),
+    "beta": (lambda: mnp.random.beta(2.0, 5.0, size=(N,)),
+             2 / 7, 10 / (49 * 8), lambda s: ((s > 0) & (s < 1)).all()),
+    "binomial": (lambda: mnp.random.binomial(10, 0.3, size=(N,)),
+                 3.0, 2.1,
+                 lambda s: ((s >= 0) & (s <= 10)
+                            & (s == onp.round(s))).all()),
+    "bernoulli": (lambda: mnp.random.bernoulli(0.25, size=(N,)),
+                  0.25, 0.1875,
+                  lambda s: onp.isin(s, [0.0, 1.0]).all()),
+    "chisquare": (lambda: mnp.random.chisquare(4.0, size=(N,)),
+                  4.0, 8.0, lambda s: (s > 0).all()),
+    "poisson": (lambda: mnp.random.poisson(3.5, size=(N,)),
+                3.5, 3.5,
+                lambda s: ((s >= 0) & (s == onp.round(s))).all()),
+    "geometric": (lambda: mnp.random.geometric(0.25, size=(N,)),
+                  4.0, 12.0, lambda s: (s >= 1).all()),
+    "negative_binomial": (
+        lambda: mnp.random.negative_binomial(5, 0.4, size=(N,)),
+        5 * 0.6 / 0.4, 5 * 0.6 / 0.16, lambda s: (s >= 0).all()),
+    "gumbel": (lambda: mnp.random.gumbel(0.5, 2.0, size=(N,)),
+               0.5 + 2.0 * _G, onp.pi ** 2 / 6 * 4.0, None),
+    "laplace": (lambda: mnp.random.laplace(1.0, 2.0, size=(N,)),
+                1.0, 8.0, None),
+    "logistic": (lambda: mnp.random.logistic(1.0, 2.0, size=(N,)),
+                 1.0, onp.pi ** 2 / 3 * 4.0, None),
+    "lognormal": (lambda: mnp.random.lognormal(0.5, 0.5, size=(N,)),
+                  onp.exp(0.5 + 0.125),
+                  (onp.exp(0.25) - 1) * onp.exp(1.25),
+                  lambda s: (s > 0).all()),
+    "pareto": (lambda: mnp.random.pareto(3.0, size=(N,)),
+               0.5, 0.75, lambda s: (s >= 0).all()),
+    "power": (lambda: mnp.random.power(3.0, size=(N,)),
+              0.75, 3 / (16 * 5), lambda s: ((s >= 0) & (s <= 1)).all()),
+    "rayleigh": (lambda: mnp.random.rayleigh(2.0, size=(N,)),
+                 2.0 * onp.sqrt(onp.pi / 2), (4 - onp.pi) / 2 * 4.0,
+                 lambda s: (s >= 0).all()),
+    "weibull": (lambda: mnp.random.weibull(2.0, size=(N,)),
+                _gamma_fn(1.5), _gamma_fn(2.0) - _gamma_fn(1.5) ** 2,
+                lambda s: (s >= 0).all()),
+    "f": (lambda: mnp.random.f(5.0, 10.0, size=(N,)),
+          10 / 8, 2 * 100 * 13 / (5 * 64 * 6),
+          lambda s: (s > 0).all()),
+    "randint": (lambda: mnp.random.randint(0, 10, size=(N,)),
+                4.5, 99 / 12,
+                lambda s: ((s >= 0) & (s <= 9)).all()),
+}
+
+
+@pytest.mark.parametrize("name", sorted(MOMENT_CASES),
+                         ids=sorted(MOMENT_CASES))
+def test_sampler_moments(name):
+    draw, mean, var, support = MOMENT_CASES[name]
+    mnp.random.seed(12345)
+    s = draw().asnumpy().astype("f8")
+    assert s.shape == (N,)
+    se = onp.sqrt(var / N)
+    assert abs(s.mean() - mean) < 6 * se + 1e-3, \
+        f"{name}: mean {s.mean():.4f} vs {mean:.4f}"
+    assert abs(s.var() - var) < 0.1 * var + 1e-3, \
+        f"{name}: var {s.var():.4f} vs {var:.4f}"
+    if support is not None:
+        assert support(s), f"{name}: support violation"
+
+
+def test_seed_determinism():
+    mnp.random.seed(777)
+    a = mnp.random.normal(0, 1, size=(64,)).asnumpy()
+    b = mnp.random.normal(0, 1, size=(64,)).asnumpy()
+    mnp.random.seed(777)
+    a2 = mnp.random.normal(0, 1, size=(64,)).asnumpy()
+    onp.testing.assert_array_equal(a, a2)
+    assert (a != b).any()  # stream advances between draws
+
+
+def test_shuffle_and_permutation():
+    mnp.random.seed(3)
+    x = mnp.arange(100)
+    p = mnp.random.permutation(x).asnumpy()
+    assert sorted(p.tolist()) == list(range(100))
+    arr = mnp.arange(100)
+    mnp.random.shuffle(arr)
+    a = arr.asnumpy()
+    assert sorted(a.tolist()) == list(range(100))
+    # permutation(int) form
+    q = mnp.random.permutation(50).asnumpy()
+    assert sorted(q.tolist()) == list(range(50))
+
+
+def test_choice_replacement_semantics():
+    mnp.random.seed(5)
+    # without replacement: all distinct, drawn from range
+    c = mnp.random.choice(20, size=(20,), replace=False).asnumpy()
+    assert sorted(c.tolist()) == list(range(20))
+    # with replacement + probabilities: only supported values appear
+    p = onp.zeros(10)
+    p[[2, 7]] = 0.5
+    c2 = mnp.random.choice(10, size=(1000,), p=p.tolist()).asnumpy()
+    assert onp.isin(c2, [2, 7]).all()
+    frac2 = (c2 == 2).mean()
+    assert 0.4 < frac2 < 0.6
+
+
+def test_multinomial_counts():
+    mnp.random.seed(11)
+    pvals = [0.2, 0.3, 0.5]
+    m = mnp.random.multinomial(100, pvals, size=(2000,)).asnumpy()
+    assert m.shape == (2000, 3)
+    assert (m.sum(-1) == 100).all()
+    means = m.mean(0)
+    onp.testing.assert_allclose(means, [20, 30, 50], rtol=0.05)
+
+
+def test_multivariate_normal_moments():
+    mnp.random.seed(9)
+    mean = onp.array([1.0, -2.0])
+    cov = onp.array([[2.0, 0.6], [0.6, 1.0]])
+    s = mnp.random.multivariate_normal(
+        mnp.array(mean), mnp.array(cov), size=(50_000,)).asnumpy()
+    assert s.shape == (50_000, 2)
+    onp.testing.assert_allclose(s.mean(0), mean, atol=0.05)
+    onp.testing.assert_allclose(onp.cov(s.T), cov, atol=0.08)

@@ -1,0 +1,113 @@
+"""Thread-local state conformance.
+
+Reference model: tests/python/unittest/test_thread_local.py — scoped
+global state (default Context, autograd recording/training flags,
+name manager, attribute scopes) must be per-thread: a scope entered
+on one thread is invisible on another, and results computed from
+worker threads are correct.
+"""
+import threading
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, context, np as mnp
+
+
+def _run_in_thread(fn):
+    box = {}
+
+    def tgt():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # noqa: BLE001 - reraised below
+            box["error"] = e
+
+    t = threading.Thread(target=tgt)
+    t.start()
+    t.join(60)
+    assert not t.is_alive(), "worker thread hung"
+    if "error" in box:
+        raise box["error"]
+    return box["result"]
+
+
+def test_default_context_is_thread_local():
+    with context.Context("cpu", 0):
+        assert context.current_context().device_type == "cpu"
+        # the scope must NOT leak into a fresh thread, which sees the
+        # process default instead
+        other = _run_in_thread(lambda: context.current_context())
+        assert other is not None
+        # entering a scope on the worker must not disturb this thread
+        def worker():
+            with context.Context("cpu", 0):
+                return context.current_context().device_type
+        assert _run_in_thread(worker) == "cpu"
+        assert context.current_context().device_type == "cpu"
+
+
+def test_autograd_recording_flag_is_thread_local():
+    with autograd.record():
+        assert autograd.is_recording()
+        assert not _run_in_thread(autograd.is_recording)
+    assert not autograd.is_recording()
+
+
+def test_autograd_training_flag_is_thread_local():
+    with autograd.train_mode():
+        assert autograd.is_training()
+        assert not _run_in_thread(autograd.is_training)
+
+
+def test_worker_thread_autograd_is_independent():
+    """A worker thread can run its own recorded computation while the
+    main thread is mid-record, with correct gradients in both."""
+    x = mnp.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+
+        def worker():
+            w = mnp.array([4.0])
+            w.attach_grad()
+            with autograd.record():
+                z = w * w * w
+            z.backward()
+            return w.grad.asnumpy()
+
+        wg = _run_in_thread(worker)
+    y.backward()
+    onp.testing.assert_allclose(wg, [48.0], rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0, 6.0],
+                                rtol=1e-6)
+
+
+def test_name_scope_is_thread_local():
+    from mxnet_tpu import name as name_mod
+    with name_mod.Prefix("outer_"):
+        def worker():
+            sym = mx.sym.Variable("v")
+            return sym.name
+        # worker thread sees no prefix
+        assert _run_in_thread(worker) == "v"
+
+
+def test_concurrent_compute_correctness():
+    """Ops issued from several threads all produce correct values
+    (engine/dispatch must not corrupt cross-thread state)."""
+    results = {}
+
+    def worker(i):
+        a = mnp.full((16,), float(i))
+        results[i] = ((a * 2 + 1).sum()).asnumpy()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    for i in range(8):
+        onp.testing.assert_allclose(results[i], 16 * (2 * i + 1),
+                                    rtol=1e-6)

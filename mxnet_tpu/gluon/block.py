@@ -418,7 +418,15 @@ class CachedOp:
         # trace_scope also keeps child HybridBlocks on their plain
         # forward path (no nested CachedOp builds during the probe)
         with autograd._RecordingScope(False, False), _deferred.trace_scope():
-            block.forward(*_rebuild(spec, probes))
+            try:
+                block.forward(*_rebuild(spec, probes))
+            except Exception:
+                # the batch-1 slice assumes every leaf carries batch on
+                # axis 0 — false for e.g. RNN states ((layers, batch,
+                # hidden), batch on axis 1), whose consumers then see
+                # inconsistent shapes. Re-probe with the full-size
+                # arrays: one wasted eager forward, always consistent.
+                block.forward(*_rebuild(spec, leaves))
 
     def __call__(self, *args):
         leaves, spec = _flatten_arrays(args)

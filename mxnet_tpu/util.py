@@ -56,10 +56,20 @@ def use_np_default_dtype(func):
     from .base import _set_np_default_dtype, is_np_default_dtype
 
     if inspect.isclass(func):
-        for name, method in inspect.getmembers(func, callable):
+        # own attributes only (decorating a Block subclass must not
+        # copy wrapped versions of the whole inherited API onto it),
+        # preserving static/classmethod descriptors
+        for name, attr in list(vars(func).items()):
             if name.startswith("__") and name != "__init__":
                 continue
-            setattr(func, name, use_np_default_dtype(method))
+            if isinstance(attr, staticmethod):
+                setattr(func, name, staticmethod(
+                    use_np_default_dtype(attr.__func__)))
+            elif isinstance(attr, classmethod):
+                setattr(func, name, classmethod(
+                    use_np_default_dtype(attr.__func__)))
+            elif inspect.isfunction(attr):
+                setattr(func, name, use_np_default_dtype(attr))
         return func
     if not callable(func):
         raise TypeError(

@@ -73,6 +73,19 @@ def test_lm_transformer_ring_sp():
     assert "sp=4" in out
 
 
+def test_lstm_lm():
+    out = _run("lstm_lm.py", "--steps", "8", "--vocab", "100",
+               "--batch", "4", "--bptt", "16", "--hidden", "64")
+    assert "final_ppl" in out
+
+
+def test_lstm_lm_hybridized():
+    out = _run("lstm_lm.py", "--steps", "6", "--vocab", "80",
+               "--batch", "4", "--bptt", "16", "--hidden", "64",
+               "--hybridize")
+    assert "final_ppl" in out
+
+
 def test_train_dist_via_launcher():
     env = dict(os.environ)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")

@@ -183,3 +183,32 @@ def test_control_flow_foreach_in_hybrid():
     hybrid = net(x).asnumpy()
     onp.testing.assert_allclose(eager, onp.cumsum(x.asnumpy(), axis=0))
     onp.testing.assert_allclose(hybrid, eager)
+
+
+def test_deferred_init_probe_with_non_batch_leading_axis():
+    """Regression: the deferred-init probe slices every input leaf to
+    batch-1 on axis 0, but RNN states carry batch on axis 1
+    ((layers, batch, hidden)) — the probe must fall back to full-size
+    arrays instead of feeding the model inconsistent shapes. The
+    decoder Dense has unknown in_units to force the probe path."""
+    from mxnet_tpu.gluon import rnn
+
+    class LM(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.lstm = rnn.LSTM(8, num_layers=2, layout="NTC",
+                                 input_size=4)
+            self.decoder = nn.Dense(10, flatten=False)  # deferred
+
+        def forward(self, x, state):
+            out, ns = self.lstm(x, state)
+            return self.decoder(out), ns
+
+    net = LM()
+    net.initialize()
+    net.hybridize()
+    st = net.lstm.begin_state(batch_size=3)
+    x = np.random.normal(size=(3, 5, 4))
+    out, st2 = net(x, st)
+    assert out.shape == (3, 5, 10)
+    assert [s.shape for s in st2] == [(2, 3, 8), (2, 3, 8)]

@@ -193,6 +193,42 @@ class Block:
     def params(self):
         return ParameterDict(self._reg_params)
 
+    def share_parameters(self, shared):
+        """Tie this block's Parameters to `shared` (a dict as returned
+        by collect_params), matched by dotted attribute path relative
+        to this block — the Parameter OBJECTS are shared, so later
+        load_parameters on either model updates both (parity:
+        reference gluon/block.py:791 share_parameters). Returns self.
+        """
+        import warnings
+        if shared is None:
+            return self
+        if not isinstance(shared, dict):
+            raise ValueError(
+                f"'shared' should be in type of Dict. Get type "
+                f"{type(shared)}!")
+        shared_set = set(shared.keys())
+        self._shared_parameters(shared, shared_set)
+        for name in shared_set:
+            warnings.warn(f"Parameter name {name} is not in the "
+                          "current model!")
+        return self
+
+    def _shared_parameters(self, shared, shared_set, prefix=""):
+        if prefix:
+            prefix += "."
+        for name in list(self._reg_params):
+            key = prefix + name
+            if shared.get(key) is not None:
+                setattr(self, name, shared[key])
+                shared_set.discard(key)
+        for name, child in self._children.items():
+            child._shared_parameters(shared, shared_set, prefix + name)
+        # compiled graphs captured the pre-share Parameter objects; a
+        # stale cache would keep training the orphaned originals
+        if hasattr(self, "_clear_cached_op"):
+            self._clear_cached_op()
+
     def initialize(self, init=None, device=None, ctx=None, verbose=False,
                    force_reinit=False):
         from .. import initializer as _init_mod

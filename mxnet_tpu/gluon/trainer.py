@@ -28,12 +28,17 @@ class Trainer:
                 f"got {type(params)}.")
         self._params = []
         self._param2idx = {}
-        for i, p in enumerate(params):
+        for p in params:
             if not isinstance(p, Parameter):
                 raise ValueError(
                     "First argument must be a list or dict of Parameters, "
                     f"got list of {type(p)}.")
-            self._param2idx[id(p)] = i
+            if id(p) in self._param2idx:
+                # shared (tied) parameters appear under several keys in
+                # collect_params; keep one copy (reference trainer.py
+                # dedupes by param uuid)
+                continue
+            self._param2idx[id(p)] = len(self._params)
             self._params.append(p)
         self._compression_params = compression_params
         self._contains_sparse_weight = False

@@ -89,9 +89,24 @@ def rand(*size, dtype=None, ctx=None):
 def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
     if high is None:
         low, high = 0, low
-    dtype = resolve_dtype(dtype) if dtype is not None else onp.int64
-    r = _make(lambda k, s: jax.random.randint(k, s, int(low), int(high),
-                                              dtype=dtype), size, ctx)
+    # int64 default narrows to int32 via the documented 64-bit policy
+    # (base.narrow_dtype) instead of letting jax warn-and-truncate
+    # high is EXCLUSIVE: bounds-check the largest generatable value
+    dtype = resolve_dtype(dtype if dtype is not None else onp.int64,
+                          values=(low, high - 1))
+    lo, hi, shift = int(low), int(high), 0
+    info = onp.iinfo(dtype)
+    if hi > info.max + 1:
+        raise OverflowError(
+            f"high={hi} exceeds the {onp.dtype(dtype).name} range")
+    if hi == info.max + 1 and lo > info.min:
+        # jax.random.randint parses maxval in the target dtype, so the
+        # exclusive bound info.max+1 overflows; sample [lo-1, hi-1)
+        # and shift back up — a bijection, so uniformity is preserved
+        lo, hi, shift = lo - 1, hi - 1, 1
+    r = _make(lambda k, s: jax.random.randint(k, s, lo, hi,
+                                              dtype=dtype) + shift,
+              size, ctx)
     if out is not None:
         out._inplace(r)
         return out

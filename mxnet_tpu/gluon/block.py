@@ -38,6 +38,12 @@ from ..random_state import next_key, trace_rng
 from . import _deferred
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
+# bumped whenever a registered Parameter attribute is rebound to a
+# different Parameter object (share_parameters / tied weights); lets
+# CachedOp caches re-validate lazily instead of walking collect_params
+# on every call
+_PARAM_REBIND_EPOCH = 0
+
 
 def _maybe_transpose_conv_kernel(name, p, val):
     """Auto-transpose a reference-written NCHW conv kernel (O,I,H,W)
@@ -139,6 +145,13 @@ class Block:
                 reg.pop(name, None)
         elif isinstance(value, Parameter):
             if reg is not None:
+                if reg.get(name) is not value:
+                    # a Parameter was rebound (share_parameters, tied
+                    # weights): any CachedOp built against the old
+                    # object is stale — bump the global epoch so every
+                    # cache re-validates (cheap: rebinds are rare)
+                    global _PARAM_REBIND_EPOCH
+                    _PARAM_REBIND_EPOCH += 1
                 reg[name] = value
             if children is not None:
                 children.pop(name, None)
@@ -372,7 +385,7 @@ class _HookHandle:
 
 class _CachedEntry:
     __slots__ = ("fwd", "fwd_vjp", "bwd", "out_spec", "aux_targets",
-                 "param_nds", "params", "in_spec")
+                 "param_nds", "params", "in_spec", "epoch")
 
 
 class CachedOp:
@@ -426,6 +439,7 @@ class CachedOp:
         entry.in_spec = spec
         entry.params = params
         entry.param_nds = param_nds
+        entry.epoch = _PARAM_REBIND_EPOCH
         entry.fwd = jax.jit(raw_fn)
         entry.fwd_vjp = jax.jit(
             lambda key, p, i: jax.vjp(
@@ -464,11 +478,55 @@ class CachedOp:
                 # arrays: one wasted eager forward, always consistent.
                 block.forward(*_rebuild(spec, leaves))
 
+    # sentinel: this signature contains a data-dependent-shape op and
+    # must execute imperatively (reference: CachedOp's dynamic-shape
+    # graphs skip static planning and run op-by-op, cached_op.cc:707)
+    _DYNAMIC = "dynamic"
+
+    @staticmethod
+    def _dynamic_errors():
+        import jax.errors as jerr
+        return (jerr.TracerArrayConversionError,
+                jerr.ConcretizationTypeError,
+                jerr.TracerBoolConversionError,
+                jerr.TracerIntegerConversionError,
+                jerr.NonConcreteBooleanIndexError)
+
+    def _dynamic_fallback(self, key_sig, args, err):
+        """A data-dependent-shape op (boolean_mask, nonzero, dynamic
+        indexing) cannot live inside one static XLA program; remember
+        the signature and run the forward imperatively from now on —
+        each primitive still jit-compiles, autograd records normally.
+        """
+        import warnings
+        if not getattr(self, "_warned_dynamic", False):
+            self._warned_dynamic = True
+            warnings.warn(
+                f"{type(self.block).__name__}: forward contains a "
+                "data-dependent-shape op; hybridize falls back to "
+                "imperative execution for this block "
+                f"({type(err).__name__})")
+        self._entries[key_sig] = self._DYNAMIC
+        return self.block.forward(*args)
+
     def __call__(self, *args):
         leaves, spec = _flatten_arrays(args)
         training = autograd.is_training()
         key_sig = self._signature(leaves, spec, training)
         entry = self._entries.get(key_sig)
+        if entry is self._DYNAMIC:
+            return self.block.forward(*args)
+        if entry is not None and entry.epoch != _PARAM_REBIND_EPOCH:
+            # Some Parameter somewhere was rebound since this entry
+            # compiled (share_parameters on ANY block, incl. a child
+            # whose ancestor holds this cache). Re-validate against the
+            # live parameter set and rebuild on mismatch.
+            current = list(self.block.collect_params().values())
+            if [id(p) for p in current] != [id(p) for p in entry.params]:
+                self._entries.clear()
+                entry = None
+            else:
+                entry.epoch = _PARAM_REBIND_EPOCH
         if entry is not None and any(
                 p._data is not nd for p, nd in
                 zip(entry.params, entry.param_nds)):
@@ -477,7 +535,10 @@ class CachedOp:
             self._entries.clear()
             entry = None
         if entry is None:
-            entry = self._build(leaves, spec, training)
+            try:
+                entry = self._build(leaves, spec, training)
+            except self._dynamic_errors() as e:
+                return self._dynamic_fallback(key_sig, args, e)
             self._entries[key_sig] = entry
 
         key = next_key()
@@ -509,10 +570,14 @@ class CachedOp:
             any(nd._grad_req != "null" for nd in entry.param_nds)
             or any(autograd._on_tape(l) for l in leaves))
 
-        if recording:
-            outs_raw, vjp, aux = entry.fwd_vjp(key, param_datas, input_datas)
-        else:
-            outs_raw, aux = entry.fwd(key, param_datas, input_datas)
+        try:
+            if recording:
+                outs_raw, vjp, aux = entry.fwd_vjp(key, param_datas,
+                                                   input_datas)
+            else:
+                outs_raw, aux = entry.fwd(key, param_datas, input_datas)
+        except self._dynamic_errors() as e:
+            return self._dynamic_fallback(key_sig, args, e)
 
         # write back aux state (BN running stats etc.)
         targets = entry.aux_targets.get("targets", [])
@@ -625,14 +690,25 @@ class HybridBlock(Block):
                 "export requires a hybridized forward call first "
                 "(net.hybridize(); net(x))")
         # export the INFERENCE graph: a training-mode entry would bake
-        # dropout masks / batch statistics into the artifact
+        # dropout masks / batch statistics into the artifact. Dynamic-
+        # fallback sentinels are not compiled graphs and cannot export.
+        static_entries = {s: e for s, e in
+                          self._cached_op._entries.items()
+                          if e is not CachedOp._DYNAMIC}
+        if not static_entries:
+            raise RuntimeError(
+                "export: this block's forward contains a data-"
+                "dependent-shape op (boolean_mask / dynamic indexing) "
+                "and runs imperatively; there is no static graph to "
+                "export. Rewrite the dynamic op (e.g. mask + where) "
+                "to make the block exportable.")
         sig = entry = None
-        for s, e in self._cached_op._entries.items():
+        for s, e in static_entries.items():
             if not s[2]:  # signature = (shapes, spec, training)
                 sig, entry = s, e
                 break
         if entry is None:
-            tsig, tentry = next(iter(self._cached_op._entries.items()))
+            tsig, tentry = next(iter(static_entries.items()))
             probe_leaves = [NDArray(jax.numpy.zeros(s, onp.dtype(d)))
                             for s, d in tsig[0]]
             entry = self._cached_op._build(probe_leaves, tentry.in_spec,

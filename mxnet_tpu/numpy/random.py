@@ -96,10 +96,20 @@ def randint(low, high=None, size=None, dtype=None, ctx=None, out=None):
                           values=(low, high - 1))
     lo, hi, shift = int(low), int(high), 0
     info = onp.iinfo(dtype)
-    if hi > info.max + 1:
+    if hi > info.max + 1 or lo < info.min:
         raise OverflowError(
-            f"high={hi} exceeds the {onp.dtype(dtype).name} range")
-    if hi == info.max + 1 and lo > info.min:
+            f"randint bounds [{lo}, {hi}) exceed the "
+            f"{onp.dtype(dtype).name} range")
+    if hi == info.max + 1 and lo == info.min:
+        # full dtype range: every bit pattern is a valid sample
+        nbits = onp.dtype(dtype).itemsize * 8
+        r = _make(lambda k, s: jax.lax.bitcast_convert_type(
+            jax.random.bits(k, s, f"uint{nbits}"), dtype), size, ctx)
+        if out is not None:
+            out._inplace(r)
+            return out
+        return r
+    if hi == info.max + 1:
         # jax.random.randint parses maxval in the target dtype, so the
         # exclusive bound info.max+1 overflows; sample [lo-1, hi-1)
         # and shift back up — a bijection, so uniformity is preserved

@@ -1,0 +1,171 @@
+"""Hybridize-tracing conformance (deferred-compute semantics).
+
+Reference model: tests/python/unittest/test_deferred_compute.py — the
+deferred-compute tracer must handle constants created inside forward
+(no graph inputs), shape/view ops (reshape/slice/astype/tril), every
+indexing form, outputs that are a subset/alias of inputs, repeated
+compilation, and dynamic-shape ops. Here the CachedOp jit trace plays
+that role; each case compares hybridized against eager outputs.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mnp, npx
+from mxnet_tpu.gluon import nn
+
+
+def _check(block_cls, *xs, atol=1e-6):
+    net = block_cls()
+    net.initialize()
+    eager = net(*xs)
+    eager_np = [o.asnumpy() for o in
+                (eager if isinstance(eager, (list, tuple)) else [eager])]
+    net2 = block_cls()
+    net2.initialize()
+    net2.hybridize()
+    hybrid = net2(*xs)
+    hybrid_np = [o.asnumpy() for o in
+                 (hybrid if isinstance(hybrid, (list, tuple))
+                  else [hybrid])]
+    assert len(eager_np) == len(hybrid_np)
+    for e, h in zip(eager_np, hybrid_np):
+        onp.testing.assert_allclose(h, e, atol=atol)
+    return net2
+
+
+def test_constants_created_inside_forward():
+    """dc_no_inputs_*: a traced forward may build arrays from thin air
+    (they become compiled-in constants, not graph inputs)."""
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            const = mnp.arange(12).reshape(3, 4)
+            return x + const.astype("float32")
+
+    _check(C, mnp.ones((3, 4)))
+
+
+def test_reshape_slice_astype_chain():
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            y = x.reshape(2, 6)[0:1, 2:5]
+            return y.astype("float64").astype("float32") * 2
+
+    _check(C, mnp.array(onp.arange(12.0, dtype="f4").reshape(3, 4)))
+
+
+def test_tril_inside_trace():
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            return mnp.tril(x, k=-1)
+
+    _check(C, mnp.array(onp.arange(9.0, dtype="f4").reshape(3, 3)))
+
+
+def test_output_subset_and_alias_of_input():
+    """dc_subset_of_output / dc_input_part_of_output: outputs may be a
+    subset of an op's outputs or include the input itself."""
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            a, b = mnp.split(x, 2, axis=0)
+            return x, a  # input aliased straight to an output
+
+    _check(C, mnp.array(onp.arange(8.0, dtype="f4").reshape(4, 2)))
+
+
+@pytest.mark.parametrize("index", [
+    1,                      # integer
+    slice(0, 2),            # slice
+    (slice(None), 1),       # tuple
+], ids=["int", "slice", "tuple"])
+def test_indexing_forms_inside_trace(index):
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            return x[index] * 2
+
+    _check(C, mnp.array(onp.arange(12.0, dtype="f4").reshape(3, 4)))
+
+
+def test_boolean_indexing_inside_trace():
+    """dc_simple_boolean_indexing: a CONSTANT boolean mask (static
+    shape) works inside the trace."""
+    mask = onp.array([True, False, True])
+
+    class C(nn.HybridBlock):
+        def forward(self, x):
+            return x[mnp.array(mask)] + 1
+
+    with pytest.warns(UserWarning, match="data-dependent"):
+        _check(C, mnp.array(onp.arange(12.0, dtype="f4").reshape(3, 4)))
+
+
+def test_dynamic_shape_op_inside_trace():
+    """dc_dynamic_shape / dc_hybridblock_dynamic_shape: data-dependent
+    output shapes (npx.boolean_mask) still produce correct values
+    when hybridized (dynamic fallback or padded lowering)."""
+    class C(nn.HybridBlock):
+        def forward(self, x, cond):
+            return npx.boolean_mask(x, cond)
+
+    x = mnp.array(onp.arange(12.0, dtype="f4").reshape(4, 3))
+    cond = mnp.array(onp.array([1, 0, 1, 0], "i4"))
+    net = C()
+    net.initialize()
+    eager = net(x, cond).asnumpy()
+    net.hybridize()
+    with pytest.warns(UserWarning, match="data-dependent"):
+        hybrid = net(x, cond).asnumpy()
+    onp.testing.assert_allclose(hybrid, eager)
+    # the dynamic marker is remembered: later calls stay imperative
+    # (and warn only once)
+    onp.testing.assert_allclose(net(x, cond).asnumpy(), eager)
+
+
+def test_get_symbol_equivalent_called_twice():
+    """dc_get_symbol_called_twice: re-exporting / re-tracing the same
+    block twice is stable."""
+    class C(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(3, in_units=4)
+
+        def forward(self, x):
+            return self.d(x)
+
+    net = C()
+    net.initialize()
+    net.hybridize()
+    x = mnp.ones((2, 4))
+    a = net(x).asnumpy()
+    # different shape: second trace
+    y = mnp.ones((5, 4))
+    b = net(y).asnumpy()
+    # back to the first signature: cache hit, same numbers
+    onp.testing.assert_allclose(net(x).asnumpy(), a)
+    assert b.shape == (5, 3)
+
+
+def test_deferred_init_inside_hybrid_no_explicit_infer_shape():
+    """dc_hybridblock_deferred_init: first hybrid call finishes
+    deferred init without the user calling infer_shape."""
+    class C(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dense(7)  # in_units unknown
+
+        def forward(self, x):
+            return self.d(x)
+
+    net = C()
+    net.initialize()
+    net.hybridize()
+    out = net(mnp.ones((2, 5)))
+    assert out.shape == (2, 7)
+    assert net.d.weight.shape == (7, 5)
+
+
+def test_multi_arg_and_nested_structure():
+    class C(nn.HybridBlock):
+        def forward(self, x, y):
+            return x * 2 + y, (x - y)
+
+    _check(C, mnp.ones((2, 3)), mnp.full((2, 3), 0.5))

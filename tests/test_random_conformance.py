@@ -154,3 +154,18 @@ def test_multivariate_normal_moments():
     assert s.shape == (50_000, 2)
     onp.testing.assert_allclose(s.mean(0), mean, atol=0.05)
     onp.testing.assert_allclose(onp.cov(s.T), cov, atol=0.08)
+
+
+def test_randint_boundary_requests():
+    """Edge parity: high=2**31 (exclusive) is a legal int32 request;
+    the full int32 range samples raw bits; out-of-range bounds raise."""
+    r = mnp.random.randint(0, 2 ** 31, size=(1000,)).asnumpy()
+    assert r.dtype == onp.int32 and (r >= 0).all()
+    full = mnp.random.randint(-2 ** 31, 2 ** 31, size=(4096,),
+                              dtype="int32").asnumpy()
+    assert full.dtype == onp.int32
+    assert full.min() < 0 < full.max()  # both halves reachable
+    with pytest.raises(OverflowError):
+        mnp.random.randint(0, 2 ** 31 + 1, size=(4,))
+    with pytest.raises(OverflowError):
+        mnp.random.randint(-2 ** 31 - 5, 0, size=(4,), dtype="int32")

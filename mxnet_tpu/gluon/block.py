@@ -138,11 +138,16 @@ class Block:
     def __setattr__(self, name, value):
         children = self.__dict__.get("_children")
         reg = self.__dict__.get("_reg_params")
+        global _PARAM_REBIND_EPOCH
         if isinstance(value, Block):
             if children is not None:
+                if children.get(name) is not value:
+                    # replacing a child swaps its whole parameter
+                    # subtree out from under any compiled ancestor
+                    _PARAM_REBIND_EPOCH += 1
                 children[name] = value
-            if reg is not None:
-                reg.pop(name, None)
+            if reg is not None and reg.pop(name, None) is not None:
+                _PARAM_REBIND_EPOCH += 1
         elif isinstance(value, Parameter):
             if reg is not None:
                 if reg.get(name) is not value:
@@ -150,18 +155,19 @@ class Block:
                     # weights): any CachedOp built against the old
                     # object is stale — bump the global epoch so every
                     # cache re-validates (cheap: rebinds are rare)
-                    global _PARAM_REBIND_EPOCH
                     _PARAM_REBIND_EPOCH += 1
                 reg[name] = value
-            if children is not None:
-                children.pop(name, None)
+            if children is not None and children.pop(name, None) \
+                    is not None:
+                _PARAM_REBIND_EPOCH += 1
         else:
             # overwriting a registered child/param with something else
             # de-registers it (otherwise collect_params keeps ghosts)
-            if children is not None:
-                children.pop(name, None)
-            if reg is not None:
-                reg.pop(name, None)
+            if children is not None and children.pop(name, None) \
+                    is not None:
+                _PARAM_REBIND_EPOCH += 1
+            if reg is not None and reg.pop(name, None) is not None:
+                _PARAM_REBIND_EPOCH += 1
         super().__setattr__(name, value)
 
     def register_child(self, block, name=None):

@@ -1,0 +1,36 @@
+"""Runtime feature-detection conformance (reference model:
+tests/python/unittest/test_runtime.py over mx.runtime.feature_list /
+src/libinfo.cc)."""
+import mxnet_tpu as mx
+from mxnet_tpu import runtime
+
+
+def test_feature_list_shape():
+    feats = runtime.feature_list()
+    assert len(feats) > 5
+    names = {f.name for f in feats}
+    # the reference's canonical flags all answer
+    for expected in ("CUDA", "CUDNN", "NCCL", "MKLDNN", "TENSORRT",
+                     "DIST_KVSTORE", "INT64_TENSOR_SIZE"):
+        assert expected in names
+    # TPU-native truths
+    by = {f.name: f.enabled for f in feats}
+    assert by["XLA"] and by["PJRT"]
+    assert not by["CUDA"] and not by["CUDNN"]
+
+
+def test_features_is_enabled():
+    fs = runtime.Features()
+    assert fs.is_enabled("XLA")
+    assert not fs.is_enabled("TENSORRT")
+    # unknown feature raises with the known-feature list (reference
+    # runtime.py Features.is_enabled strictness)
+    import pytest
+    with pytest.raises(RuntimeError, match="NOT_A_FEATURE"):
+        fs.is_enabled("NOT_A_FEATURE")
+
+
+def test_feature_repr_marks_state():
+    feats = {f.name: repr(f) for f in runtime.feature_list()}
+    assert feats["XLA"].startswith("✔")
+    assert feats["CUDA"].startswith("✖")

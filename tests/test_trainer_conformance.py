@@ -224,3 +224,26 @@ def test_share_parameters_on_child_invalidates_ancestor_cache():
     parent.child.share_parameters(src.collect_params())
     onp.testing.assert_allclose(parent(x).asnumpy(),
                                 onp.full((1, 3), 4.0), rtol=1e-6)
+
+def test_child_block_rebind_invalidates_ancestor_cache():
+    """Regression: replacing a CHILD BLOCK attribute after an ancestor
+    compiled must not replay the stale graph with the old weights."""
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.child = nn.Dense(3, in_units=2, use_bias=False)
+
+        def forward(self, x):
+            return self.child(x)
+
+    parent = Net()
+    parent.initialize()
+    parent.hybridize()
+    x = mnp.ones((1, 2))
+    parent(x)  # compile with the original child
+    replacement = nn.Dense(3, in_units=2, use_bias=False)
+    replacement.initialize()
+    replacement.weight.set_data(mnp.full((3, 2), 2.0))
+    parent.child = replacement
+    onp.testing.assert_allclose(parent(x).asnumpy(),
+                                onp.full((1, 3), 4.0), rtol=1e-6)

@@ -1,0 +1,96 @@
+"""TPU stage: BERT-base fine-tune throughput (BASELINE.json config 4).
+
+The reference's config-4 workload is GluonNLP BERT-base fine-tuning
+under AMP. Here: BERTClassifier(bert_base) cast to bf16 (the TPU AMP
+story — bf16 end-to-end, no loss scaling needed), fused TrainStep,
+seq_len 128, fetch-delta timing. Emits ONE JSON line with
+sequences/sec and MFU (analytic transformer FLOPs).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, parallel  # noqa: E402
+from mxnet_tpu.gluon.model_zoo.bert import (  # noqa: E402
+    BERTClassifier, bert_base)
+from bench import _peak_flops  # noqa: E402
+
+BATCH = int(os.environ.get("BERT_BATCH", "32"))
+SEQ = int(os.environ.get("BERT_SEQ", "128"))
+LO, HI = 2, 8
+
+# BERT-base fwd FLOPs/token ≈ 2*params (no embed lookup) plus
+# attention O(S) term; x3 fwd+bwd. params≈110M, attn term:
+# 12 layers * 2 * S * hidden(768) MACs/token.
+PARAMS = 110e6
+ATTN_MACS_PER_TOKEN = 12 * 2 * SEQ * 768
+FLOPS_PER_TOKEN_TRAIN = (2 * PARAMS + 2 * ATTN_MACS_PER_TOKEN) * 3
+
+n_dev = jax.local_device_count()
+mesh = parallel.make_mesh((n_dev,), ("dp",))
+parallel.set_mesh(mesh)
+peak = _peak_flops(kind)
+
+net = BERTClassifier(bert_base(dropout=0.0), num_classes=2)
+net.initialize()
+net.cast("bfloat16")
+step = parallel.TrainStep(
+    net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+    optimizer_params={"learning_rate": 2e-5, "multi_precision": True},
+    mesh=mesh, batch_axis="dp")
+
+rng = onp.random.RandomState(0)
+toks = mx.np.array(rng.randint(0, 30000, (BATCH * n_dev, SEQ))
+                   .astype("int32"))
+segs = mx.np.zeros((BATCH * n_dev, SEQ), dtype="int32")
+labels = mx.np.zeros((BATCH * n_dev,), dtype="int32")
+
+
+def timed(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = step((toks, segs), labels)
+    float(loss.asnumpy())
+    return time.perf_counter() - t0
+
+
+def _stage(m):
+    print(f"[bert] {m}", file=sys.stderr, flush=True)
+
+
+_stage("warmup/compile")
+t_compile0 = time.perf_counter()
+timed(LO)
+compile_s = time.perf_counter() - t_compile0
+_stage("timing")
+t_lo, t_hi = timed(LO), timed(HI)
+sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+sps = BATCH * n_dev / sec_per_step
+tokens_per_sec = sps * SEQ
+mfu = (FLOPS_PER_TOKEN_TRAIN * tokens_per_sec / (peak * n_dev)) \
+    if peak else None
+
+print(json.dumps({
+    "metric": "bert_base_finetune_seqs_per_sec_per_chip",
+    "value": round(sps / n_dev, 2),
+    "unit": "sequences/sec/chip",
+    "tokens_per_sec": round(tokens_per_sec, 0),
+    "mfu": round(mfu, 4) if mfu is not None else None,
+    "batch": BATCH, "seq_len": SEQ,
+    "compile_s": round(compile_s, 1),
+    "init_s": round(init_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+    "n_devices": n_dev,
+}), flush=True)

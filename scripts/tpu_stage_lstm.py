@@ -1,0 +1,110 @@
+"""TPU stage: LSTM language-model throughput (BASELINE.json config 3).
+
+The reference's config-3 workload is example/rnn's PTB LSTM LM on the
+cuDNN fused path (src/operator/rnn-inl.h). Here the same shape
+(2-layer LSTM-650, seq 35, batch 64 — the word_lm "medium" config)
+runs on the fused scan LSTM inside one fused train step. Emits ONE
+JSON line with tokens/sec and the recurrent-matmul MFU.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import REPO, init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon, parallel  # noqa: E402
+from bench import _peak_flops  # noqa: E402
+
+sys.path.insert(0, os.path.join(REPO, "examples"))
+from lstm_lm import LSTMLanguageModel  # noqa: E402
+
+VOCAB = int(os.environ.get("LSTM_VOCAB", "10000"))
+HIDDEN = int(os.environ.get("LSTM_HIDDEN", "650"))
+BATCH = int(os.environ.get("LSTM_BATCH", "64"))
+BPTT = int(os.environ.get("LSTM_BPTT", "35"))
+LAYERS = 2
+LO, HI = 2, 10
+
+# per-token train FLOPs: embed-out projection (2*H*V MACs) + LSTM
+# layers (per layer: 8*H^2 MACs i2h+h2h x4 gates) -> x2 FLOPs/MAC,
+# x3 fwd+bwd
+MACS_PER_TOKEN = 2 * HIDDEN * VOCAB / 2 + LAYERS * 8 * HIDDEN * HIDDEN
+FLOPS_PER_TOKEN_TRAIN = MACS_PER_TOKEN * 2 * 3
+
+n_dev = jax.local_device_count()
+mesh = parallel.make_mesh((n_dev,), ("dp",))
+parallel.set_mesh(mesh)
+peak = _peak_flops(kind)
+
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+class _LogitsOnly(nn.HybridBlock):
+    """TrainStep's loss consumes a single output; drop the state
+    (throughput stage: carried state would add a host round-trip)."""
+
+    def __init__(self, lm):
+        super().__init__()
+        self.lm = lm
+
+    def forward(self, x, state):
+        logits, _ = self.lm(x, state)
+        return logits
+
+
+net = _LogitsOnly(LSTMLanguageModel(VOCAB, embed=HIDDEN, hidden=HIDDEN,
+                                    layers=LAYERS, dropout=0.0))
+net.initialize(mx.init.Xavier())
+net.cast("bfloat16")
+step = parallel.TrainStep(
+    net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+    optimizer_params={"learning_rate": 1.0, "multi_precision": True},
+    mesh=mesh, batch_axis="dp")
+
+rng = onp.random.RandomState(0)
+B = BATCH * n_dev
+x = mx.np.array(rng.randint(0, VOCAB, (B, BPTT)).astype("int32"))
+y = mx.np.array(rng.randint(0, VOCAB, (B, BPTT)).astype("int32"))
+state = [s.astype("bfloat16") for s in net.lm.begin_state(B)]
+
+
+def timed(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        loss = step((x, state), y)
+    float(loss.asnumpy())
+    return time.perf_counter() - t0
+
+
+print("[lstm] warmup/compile", file=sys.stderr, flush=True)
+t0 = time.perf_counter()
+timed(LO)
+compile_s = time.perf_counter() - t0
+print("[lstm] timing", file=sys.stderr, flush=True)
+t_lo, t_hi = timed(LO), timed(HI)
+sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+tokens_per_sec = B * BPTT / sec_per_step
+mfu = (FLOPS_PER_TOKEN_TRAIN * tokens_per_sec / (peak * n_dev)) \
+    if peak else None
+
+print(json.dumps({
+    "metric": "lstm_lm_tokens_per_sec_per_chip",
+    "value": round(tokens_per_sec / n_dev, 0),
+    "unit": "tokens/sec/chip",
+    "mfu": round(mfu, 4) if mfu is not None else None,
+    "vocab": VOCAB, "hidden": HIDDEN, "batch": BATCH, "bptt": BPTT,
+    "compile_s": round(compile_s, 1),
+    "init_s": round(init_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+    "n_devices": n_dev,
+}), flush=True)

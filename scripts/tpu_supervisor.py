@@ -66,6 +66,10 @@ STAGES = [
     ("resnet50_tuned",
      [PY, os.path.join(REPO, "scripts", "tpu_stage_resnet50_tuned.py")],
      900),
+    ("bert", [PY, os.path.join(REPO, "scripts", "tpu_stage_bert.py")],
+     600),
+    ("lstm", [PY, os.path.join(REPO, "scripts", "tpu_stage_lstm.py")],
+     480),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),

@@ -202,10 +202,24 @@ class TrainStep:
                         else rep
                 state_sh.append(jax.tree.map(leaf_sh, states[k]))
 
+            # the PRIMARY input's leading dim defines the batch; other
+            # leaves (e.g. RNN states shaped (layers, batch, hidden))
+            # may carry it elsewhere — shard the axis that matches, or
+            # replicate when none/ambiguous (dim0 wins ties: the
+            # conventional batch-major layout)
+            bsz = data_leaves[0].shape[0] if data_leaves \
+                and data_leaves[0].ndim else None
+
             def batch_sh(leaf):
                 spec = [None] * leaf.ndim
-                if leaf.ndim > 0:
-                    spec[0] = self.batch_axis
+                if leaf.ndim > 0 and bsz is not None:
+                    if leaf.shape[0] == bsz:
+                        spec[0] = self.batch_axis
+                    else:
+                        hits = [i for i, d in enumerate(leaf.shape)
+                                if d == bsz]
+                        if len(hits) == 1:
+                            spec[hits[0]] = self.batch_axis
                 return NamedSharding(mesh, P(*spec))
 
             data_sh = tuple(batch_sh(l) for l in data_leaves)

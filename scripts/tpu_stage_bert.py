@@ -42,7 +42,8 @@ mesh = parallel.make_mesh((n_dev,), ("dp",))
 parallel.set_mesh(mesh)
 peak = _peak_flops(kind)
 
-net = BERTClassifier(bert_base(dropout=0.0), num_classes=2)
+net = BERTClassifier(bert_base(dropout=0.0), num_classes=2,
+                     dropout=0.0)
 net.initialize()
 net.cast("bfloat16")
 step = parallel.TrainStep(

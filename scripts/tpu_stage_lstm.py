@@ -34,10 +34,9 @@ BPTT = int(os.environ.get("LSTM_BPTT", "35"))
 LAYERS = 2
 LO, HI = 2, 10
 
-# per-token train FLOPs: embed-out projection (2*H*V MACs) + LSTM
-# layers (per layer: 8*H^2 MACs i2h+h2h x4 gates) -> x2 FLOPs/MAC,
-# x3 fwd+bwd
-MACS_PER_TOKEN = 2 * HIDDEN * VOCAB / 2 + LAYERS * 8 * HIDDEN * HIDDEN
+# per-token train MACs: decoder projection (H*V) + LSTM layers (per
+# layer: 8*H^2 for i2h+h2h x4 gates) -> x2 FLOPs/MAC, x3 fwd+bwd
+MACS_PER_TOKEN = HIDDEN * VOCAB + LAYERS * 8 * HIDDEN * HIDDEN
 FLOPS_PER_TOKEN_TRAIN = MACS_PER_TOKEN * 2 * 3
 
 n_dev = jax.local_device_count()

@@ -13,6 +13,25 @@ from .sparse import (  # noqa: F401
 )
 
 
+def dot(lhs, rhs, transpose_a=False, transpose_b=False, out=None):
+    """Sparse-aware mx.nd.dot with the legacy transpose flags
+    (parity: src/operator/tensor/dot.cc — dot(csr, dense),
+    dot(csr.T, dense), dot(dense, row_sparse) all dispatch to the
+    sparse lowering; dense×dense goes through the numpy namespace)."""
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        r = sparse.dot(lhs, rhs, transpose_a=transpose_a,
+                       transpose_b=transpose_b)
+        if out is not None:
+            out._inplace(r)
+            return out
+        return r
+    from .. import numpy as _np
+    a = _np.swapaxes(lhs, -1, -2) if transpose_a else lhs
+    b = _np.swapaxes(rhs, -1, -2) if transpose_b else rhs
+    return _np.dot(a, b, out=out)
+
+
 def _legacy_sort(data, axis=-1, is_ascend=True, **kwargs):
     """Legacy ordering signature (parity:
     src/operator/tensor/ordering_op.cc Sort — `is_ascend` flag; the

@@ -325,6 +325,13 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     the stored rows then scatter-adds into the output.
     """
     from ..ops import apply_op
+    # the gather/segment-sum kernels are written for a 2-D rhs; a
+    # 1-D vector is the (n, 1) column promoted back down afterwards
+    if isinstance(rhs, NDArray) and not isinstance(
+            rhs, BaseSparseNDArray) and rhs.ndim == 1 and \
+            isinstance(lhs, (CSRNDArray, RowSparseNDArray)):
+        return dot(lhs, rhs.reshape(-1, 1), transpose_a=transpose_a,
+                   transpose_b=False).reshape(-1)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray) and \
             not isinstance(rhs, BaseSparseNDArray):
         cols = lhs._aux[0]

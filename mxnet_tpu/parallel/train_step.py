@@ -207,8 +207,8 @@ class TrainStep:
             # may carry it elsewhere — shard the axis that matches, or
             # replicate when none/ambiguous (dim0 wins ties: the
             # conventional batch-major layout)
-            bsz = data_leaves[0].shape[0] if data_leaves \
-                and data_leaves[0].ndim else None
+            bsz = next((l.shape[0] for l in data_leaves if l.ndim),
+                       None)
 
             def batch_sh(leaf):
                 spec = [None] * leaf.ndim

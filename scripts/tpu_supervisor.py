@@ -74,7 +74,7 @@ STAGES = [
      420),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),
                 "--platform", "tpu", "--runs", "5", "--warmup", "1",
-                "--top", "120", "--budget", "1200", "--resume",
+                "--top", "200", "--budget", "1200", "--resume",
                 "--output", os.path.join(RUN_DIR, "OPPERF_TPU.json")],
      1500),
 ]

@@ -1,0 +1,82 @@
+"""TPU stage: run the operator-conformance suite on the REAL chip.
+
+The reference re-runs its CPU unittests under a GPU default context
+(tests/python/gpu/test_operator_gpu.py imports the CPU modules). This
+is the TPU analog, fired by the window supervisor: the NumPy/operator
+conformance files execute with the axon TPU as the default backend
+(MXTPU_TEST_PLATFORM=tpu makes conftest skip the CPU pin), proving
+operator SEMANTICS on silicon, not just on the virtual CPU mesh.
+
+Emits ONE JSON line: {"value": <passed>, "failed": N, ...}. Matmul
+precision is pinned to HIGHEST so f32 tolerance checks are not broken
+by the TPU's default bf16 matmul path.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import REPO  # noqa: E402
+
+FILES = os.environ.get("CONF_FILES", ",".join([
+    "tests/test_numpy_conformance.py",
+    "tests/test_higher_order_conformance.py",
+    "tests/test_ordering_norm_conformance.py",
+])).split(",")
+TIMEOUT = int(os.environ.get("CONF_TIMEOUT", "1100"))
+
+
+def main():
+    env = dict(os.environ)
+    # overridable so a local CPU smoke can exercise the harness
+    env["MXTPU_TEST_PLATFORM"] = os.environ.get(
+        "MXTPU_TEST_PLATFORM", "tpu")
+    env["JAX_DEFAULT_MATMUL_PRECISION"] = "highest"
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, "bench_runs", "xla_cache"))
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "pytest", *FILES, "-q",
+             "--no-header", "-p", "no:cacheprovider"],
+            capture_output=True, text=True, timeout=TIMEOUT, cwd=REPO,
+            env=env)
+        text = out.stdout
+    except subprocess.TimeoutExpired as e:
+        text = (e.stdout or b"")
+        if isinstance(text, bytes):
+            text = text.decode("utf-8", "replace")
+    dur = time.time() - t0
+    passed = failed = errors = 0
+    m = re.search(r"(\d+) passed", text)
+    if m:
+        passed = int(m.group(1))
+    m = re.search(r"(\d+) failed", text)
+    if m:
+        failed = int(m.group(1))
+    m = re.search(r"(\d+) error", text)
+    if m:
+        errors = int(m.group(1))
+    fail_names = re.findall(r"FAILED ([^\s]+)", text)[:10]
+    print(json.dumps({
+        "metric": "tpu_conformance_tests_passed",
+        "value": passed,
+        "unit": "tests",
+        "failed": failed,
+        "errors": errors,
+        "failed_names": fail_names,
+        "files": FILES,
+        "dur_s": round(dur, 1),
+        "platform": env["MXTPU_TEST_PLATFORM"],
+        "device_kind": ("TPU (suite ran with axon default backend)"
+                        if env["MXTPU_TEST_PLATFORM"] == "tpu"
+                        else env["MXTPU_TEST_PLATFORM"]),
+    }), flush=True)
+    return 0 if passed > 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,9 @@ STAGES = [
      600),
     ("lstm", [PY, os.path.join(REPO, "scripts", "tpu_stage_lstm.py")],
      480),
+    ("conformance",
+     [PY, os.path.join(REPO, "scripts", "tpu_stage_conformance.py")],
+     1200),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),

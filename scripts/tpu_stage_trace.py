@@ -25,7 +25,11 @@ n_dev = jax.local_device_count()
 mesh = parallel.make_mesh((n_dev,), ("dp",))
 parallel.set_mesh(mesh)
 
-net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
+MODEL = os.environ.get("TRACE_MODEL", "resnet18")
+if MODEL == "resnet50":
+    net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
+else:
+    net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
 net.initialize()
 net.cast("bfloat16")
 step = parallel.TrainStep(
@@ -44,7 +48,7 @@ float(step(data, label).asnumpy())  # compile + first step
 compile_s = time.time() - t0
 
 trace_dir = os.path.join(_REPO, "bench_runs", "r5",
-                         f"xprof_{platform}")
+                         f"xprof_{platform}_{MODEL}")
 profiler.set_config(filename=os.path.join(trace_dir, "trace.json"))
 profiler.start()
 t0 = time.perf_counter()
@@ -56,7 +60,7 @@ steps_s = time.perf_counter() - t0
 profiler.stop()
 
 print(json.dumps({
-    "metric": "resnet18_traced_step_ms",
+    "metric": f"{MODEL}_traced_step_ms",
     "value": round(steps_s / N * 1e3, 2),
     "unit": "ms/step",
     "n_steps": N,

@@ -52,7 +52,7 @@ IDLE_PERIOD_S = int(os.environ.get("SUP_IDLE_PERIOD", "600"))
 PY = sys.executable
 
 # stages whose headline metric improves downward (ms/step)
-LOWER_IS_BETTER = {"trace"}
+LOWER_IS_BETTER = {"trace", "trace50"}
 
 STAGES = [
     # (name, argv, timeout_s). Order = scoring priority: the resnet50
@@ -75,6 +75,8 @@ STAGES = [
      1200),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
+    ("trace50",
+     [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")], 600),
     ("opperf", [PY, os.path.join(REPO, "benchmark", "opperf.py"),
                 "--platform", "tpu", "--runs", "5", "--warmup", "1",
                 "--top", "200", "--budget", "1200", "--resume",
@@ -92,6 +94,8 @@ STAGE_ENV = {
                  "BENCH_SKIP_LOADER": "1", "BENCH_CHILD_BUDGET": "360"},
     "resnet50": {"BENCH_CHILD": "1", "BENCH_SMALL": "0",
                  "BENCH_CHILD_BUDGET": "840"},
+    "trace50": {"TRACE_MODEL": "resnet50", "TRACE_BATCH": "384",
+                "TRACE_HW": "224", "TRACE_STEPS": "10"},
     "opperf": {},
 }
 

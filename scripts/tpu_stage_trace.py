@@ -28,8 +28,11 @@ parallel.set_mesh(mesh)
 MODEL = os.environ.get("TRACE_MODEL", "resnet18")
 if MODEL == "resnet50":
     net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
-else:
+elif MODEL == "resnet18":
     net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
+else:
+    raise SystemExit(f"unknown TRACE_MODEL {MODEL!r}: "
+                     "expected resnet18 or resnet50")
 net.initialize()
 net.cast("bfloat16")
 step = parallel.TrainStep(
@@ -47,8 +50,11 @@ t0 = time.time()
 float(step(data, label).asnumpy())  # compile + first step
 compile_s = time.time() - t0
 
-trace_dir = os.path.join(_REPO, "bench_runs", "r5",
-                         f"xprof_{platform}_{MODEL}")
+# resnet18 keeps the bare documented path (docs/TPU_RESULTS_r5.md)
+trace_dir = os.path.join(
+    _REPO, "bench_runs", "r5",
+    f"xprof_{platform}" if MODEL == "resnet18"
+    else f"xprof_{platform}_{MODEL}")
 profiler.set_config(filename=os.path.join(trace_dir, "trace.json"))
 profiler.start()
 t0 = time.perf_counter()

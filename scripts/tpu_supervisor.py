@@ -94,6 +94,10 @@ STAGE_ENV = {
                  "BENCH_SKIP_LOADER": "1", "BENCH_CHILD_BUDGET": "360"},
     "resnet50": {"BENCH_CHILD": "1", "BENCH_SMALL": "0",
                  "BENCH_CHILD_BUDGET": "840"},
+    # both trace stages PIN every TRACE_* knob so operator-shell
+    # exports cannot leak into a stage and mislabel its measurement
+    "trace": {"TRACE_MODEL": "resnet18", "TRACE_BATCH": "64",
+              "TRACE_HW": "32", "TRACE_STEPS": "20"},
     "trace50": {"TRACE_MODEL": "resnet50", "TRACE_BATCH": "384",
                 "TRACE_HW": "224", "TRACE_STEPS": "10"},
     "opperf": {},

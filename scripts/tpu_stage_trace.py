@@ -14,6 +14,12 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from _stage_prelude import REPO as _REPO, init_stage  # noqa: E402
 
+# validate BEFORE paying the TPU client init (tunnel windows are short)
+MODEL = os.environ.get("TRACE_MODEL", "resnet18")
+if MODEL not in ("resnet18", "resnet50"):
+    raise SystemExit(f"unknown TRACE_MODEL {MODEL!r}: "
+                     "expected resnet18 or resnet50")
+
 jax, devs, init_s = init_stage()
 kind = devs[0].device_kind
 platform = devs[0].platform
@@ -25,14 +31,10 @@ n_dev = jax.local_device_count()
 mesh = parallel.make_mesh((n_dev,), ("dp",))
 parallel.set_mesh(mesh)
 
-MODEL = os.environ.get("TRACE_MODEL", "resnet18")
 if MODEL == "resnet50":
     net = gluon.model_zoo.vision.resnet50_v1(layout="NHWC")
-elif MODEL == "resnet18":
-    net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
 else:
-    raise SystemExit(f"unknown TRACE_MODEL {MODEL!r}: "
-                     "expected resnet18 or resnet50")
+    net = gluon.model_zoo.vision.resnet18_v1(classes=64, layout="NHWC")
 net.initialize()
 net.cast("bfloat16")
 step = parallel.TrainStep(

@@ -1,0 +1,218 @@
+"""Hybridized shape-op chain conformance.
+
+Reference model: the ~30 reshape/slice-combination tests in
+tests/python/unittest/test_gluon.py (test_reshape_conv,
+test_slice_batchnorm_reshape_batchnorm, ...) — reshape/slice
+inserted between compute layers must trace and match eager, forward
+AND backward. One parameterized sweep covers the layer zoo x chain
+shape; plus the utility blocks (Lambda/Identity/Concatenate) and
+grad_req/zero_grad semantics from the same file.
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import autograd, np as mnp
+from mxnet_tpu.gluon import nn
+
+
+class _Chain(nn.HybridBlock):
+    """x -> pre-shape-op -> layer -> post-shape-op."""
+
+    def __init__(self, layer, pre, post):
+        super().__init__()
+        self.layer = layer
+        self._pre, self._post = pre, post
+
+    def forward(self, x):
+        return self._post(self.layer(self._pre(x)))
+
+
+def _layer_cases():
+    # (name, layer factory, input shape, pre, post)
+    return [
+        ("reshape_conv",
+         lambda: nn.Conv2D(4, 3, padding=1, in_channels=2),
+         (2, 4, 8, 4),
+         lambda x: x.reshape(2, 2, 8, 8), lambda y: y),
+        ("slice_conv",
+         lambda: nn.Conv2D(4, 3, padding=1, in_channels=2),
+         (4, 2, 8, 8),
+         lambda x: x[1:3], lambda y: y),
+        ("conv_reshape",
+         lambda: nn.Conv2D(4, 3, padding=1, in_channels=2),
+         (2, 2, 8, 8),
+         lambda x: x, lambda y: y.reshape(2, 4, 32, 2)),
+        ("reshape_dense",
+         lambda: nn.Dense(5, in_units=12), (3, 2, 6),
+         lambda x: x.reshape(3, 12), lambda y: y),
+        ("slice_dense_slice",
+         lambda: nn.Dense(6, in_units=4), (5, 4),
+         lambda x: x[0:4], lambda y: y[:, 1:5]),
+        ("reshape_batchnorm",
+         lambda: nn.BatchNorm(in_channels=4), (2, 2, 8),
+         lambda x: x.reshape(2, 4, 4), lambda y: y),
+        ("slice_batchnorm_reshape",
+         lambda: nn.BatchNorm(in_channels=2), (4, 2, 6),
+         lambda x: x[0:2], lambda y: y.reshape(2, 12)),
+        ("reshape_pool",
+         lambda: nn.MaxPool2D(2), (2, 3, 4, 16),
+         lambda x: x.reshape(2, 3, 8, 8), lambda y: y),
+        ("slice_deconv",
+         lambda: nn.Conv2DTranspose(3, 2, in_channels=2),
+         (4, 2, 5, 5),
+         lambda x: x[1:3], lambda y: y),
+        ("reshape_activation",
+         lambda: nn.Activation("tanh"), (2, 12),
+         lambda x: x.reshape(2, 3, 4), lambda y: y[:, 1:3]),
+    ]
+
+
+@pytest.mark.parametrize(
+    "name,mk,shape,pre,post", _layer_cases(),
+    ids=[c[0] for c in _layer_cases()])
+def test_shape_chain_hybrid_matches_eager(name, mk, shape, pre, post):
+    x_np = onp.random.RandomState(0).randn(*shape).astype("f4")
+
+    def run(hybridize):
+        net = _Chain(mk(), pre, post)
+        net.initialize(init="ones")
+        if hybridize:
+            net.hybridize()
+        x = mnp.array(x_np)
+        x.attach_grad()
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        return y.asnumpy(), x.grad.asnumpy()
+
+    ey, eg = run(False)
+    hy, hg = run(True)
+    onp.testing.assert_allclose(hy, ey, rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(hg, eg, rtol=1e-5, atol=1e-5)
+
+
+def test_lambda_blocks():
+    """test_lambda: Lambda and HybridLambda wrap plain callables."""
+    net = nn.HybridSequential()
+    net.add(nn.Lambda(lambda x: x * 2),
+            nn.HybridLambda(lambda x: x + 1))
+    x = mnp.ones((2, 3))
+    onp.testing.assert_allclose(net(x).asnumpy(),
+                                onp.full((2, 3), 3.0))
+
+
+def test_identity_block():
+    net = nn.Identity()
+    x = mnp.array(onp.arange(6.0, dtype="f4").reshape(2, 3))
+    onp.testing.assert_array_equal(net(x).asnumpy(), x.asnumpy())
+
+
+@pytest.mark.parametrize("hybridize", [False, True],
+                         ids=["eager", "hybrid"])
+def test_concatenate_block(hybridize):
+    """test_concatenate: parallel branches concat on an axis."""
+    net = nn.HybridConcatenate(axis=1)
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=3),
+            nn.Identity())
+    net.initialize(init="ones")
+    if hybridize:
+        net.hybridize()
+    x = mnp.ones((2, 3))
+    out = net(x)
+    assert out.shape == (2, 4 + 2 + 3)
+
+
+def test_zero_grad_clears_accumulated():
+    """test_zero_grad with grad_req='add': grads accumulate across
+    backwards until zero_grad resets them."""
+    p = nn.Dense(2, in_units=3, use_bias=False)
+    p.initialize()
+    p.weight.grad_req = "add"
+    x = mnp.ones((1, 3))
+    for _ in range(2):
+        with autograd.record():
+            loss = p(x).sum()
+        loss.backward()
+    g2 = p.weight.grad().asnumpy().copy()
+    onp.testing.assert_allclose(g2, 2 * onp.ones((2, 3)), rtol=1e-6)
+    p.collect_params().zero_grad()
+    assert (p.weight.grad().asnumpy() == 0).all()
+
+
+def test_req_null_skips_grad():
+    """test_req: grad_req='null' parameters get no gradient."""
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    net.weight.grad_req = "null"
+    x = mnp.ones((1, 3))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    assert (net.bias.grad().asnumpy() == 1).all()
+    with pytest.raises(Exception):  # null param holds no gradient
+        net.weight.grad()
+
+
+def test_sequential_insert_and_indexing():
+    """test_sequential: indexing/len/iteration over children."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+    assert [type(b).__name__ for b in net] == ["Dense"] * 3
+
+
+def test_apply_visits_all_blocks():
+    seen = []
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2), nn.Dense(3))
+    net.apply(lambda b: seen.append(type(b).__name__))
+    assert seen.count("Dense") == 2
+    assert "HybridSequential" in seen
+
+
+def test_constant_parameter_excluded_from_grad():
+    """test_constant: gluon.Constant joins collect_params but never
+    receives gradients and keeps its value through training."""
+    from mxnet_tpu import gluon
+
+    class Net(nn.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.w = gluon.Constant(onp.ones((2, 3), "f4") * 5)
+            self.d = nn.Dense(3, in_units=3, use_bias=False)
+
+        def forward(self, x):
+            return (self.d(x) * self.w.data()).sum()
+
+    net = Net()
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mnp.ones((2, 3))
+    with autograd.record():
+        loss = net(x)
+    loss.backward()
+    trainer.step(1)
+    onp.testing.assert_array_equal(net.w.data().asnumpy(),
+                                   onp.ones((2, 3), "f4") * 5)
+
+
+def test_collect_params_select_regex():
+    """test_collect_parameters: the select argument filters by the
+    structured-name regex."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize()
+    only_weights = net.collect_params(".*weight")
+    assert set(only_weights.keys()) == {"0.weight", "1.weight"}
+    first_layer = net.collect_params("0\\..*")
+    assert set(first_layer.keys()) == {"0.weight", "0.bias"}
+
+
+def test_parameter_str_contains_shape_dtype():
+    from mxnet_tpu.gluon.parameter import Parameter
+    p = Parameter("w", shape=(2, 3))
+    s = repr(p)
+    assert "w" in s and "(2, 3)" in s and "float32" in s

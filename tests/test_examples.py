@@ -101,3 +101,9 @@ def test_train_dist_via_launcher():
 def test_bert_finetune():
     out = _run("bert_finetune.py", "--steps", "20")
     assert "eval accuracy" in out
+
+
+def test_train_ssd():
+    out = _run("train_ssd.py", "--steps", "80", "--batch", "8",
+               "--eval-iou", "0.3")
+    assert "detection_accuracy" in out

@@ -1,0 +1,169 @@
+"""Long-tail NumPy-namespace conformance sweep.
+
+Reference model: tests/python/unittest/test_numpy_op.py +
+test_numpy_interoperability.py — every mx.np callable should agree
+with real NumPy on a canonical workload. This file sweeps the
+namespace members NOT already covered by the other conformance files
+(bitwise/logical families, nan-reductions, split/stack families,
+index-construction helpers, financial functions, dtype lattice fns).
+"""
+import numpy as onp
+import pytest
+
+from mxnet_tpu import np as mnp
+
+_F = onp.array([[-1.5, 0.0, 2.25], [3.5, -0.5, 1.0]], "f4")
+_G = onp.array([[0.5, 2.0, -1.0], [1.5, 2.5, -3.0]], "f4")
+_I = onp.array([[6, 3, 1], [2, 5, 4]], "i4")
+_J = onp.array([[1, 2, 1], [3, 1, 2]], "i4")
+_N = onp.array([1.0, onp.nan, 3.0, -2.0, onp.nan], "f4")
+
+
+def _mx(v):
+    return mnp.array(v) if isinstance(v, onp.ndarray) else v
+
+
+def _cmp(mx_out, np_out, rtol=1e-5):
+    if isinstance(np_out, (tuple, list)):
+        assert len(mx_out) == len(np_out)
+        for a, b in zip(mx_out, np_out):
+            _cmp(a, b, rtol)
+        return
+    a = mx_out.asnumpy() if hasattr(mx_out, "asnumpy") else onp.asarray(mx_out)
+    onp.testing.assert_allclose(
+        onp.asarray(a, "f8"), onp.asarray(np_out, "f8"),
+        rtol=rtol, atol=1e-6, equal_nan=True)
+
+
+# name -> args (applied identically to mx.np and numpy)
+CASES = {
+    "absolute": (_F,), "negative": (_F,), "positive": (_F,),
+    "fabs": (_F,), "fix": (_F,), "rint": (_F,), "trunc": (_F,),
+    "conj": (_F,), "conjugate": (_F,), "real": (_F,), "imag": (_F,),
+    "angle": (_F,), "exp2": (_F,), "deg2rad": (_F,), "rad2deg": (_F,),
+    "signbit": (_F,), "copy": (_F,),
+    "fliplr": (_F,), "flipud": (_F,), "atleast_1d": (5.0,),
+    "atleast_3d": (_F,), "diagonal": (_F,), "diagflat": (_F[0],),
+    "flatnonzero": (_F,), "round_": (_F,),
+    "moveaxis": (_F, 0, 1), "rollaxis": (_F, 1),
+    "swapaxes": (_F, 0, 1), "permute_dims": (_F, (1, 0)),
+    "trim_zeros": (onp.array([0, 0, 1, 2, 0], "f4"),),
+    "tri": (3, 4, -1), "vander": (onp.array([1., 2., 3.], "f4"), 4),
+    "arctan2": (_F, _G), "copysign": (_F, _G),
+    "float_power": (onp.abs(_F) + 0.5, _G),
+    "fmax": (_F, _G), "fmin": (_F, _G), "fmod": (_F, _G),
+    "mod": (_I, _J), "remainder": (_I, _J), "divide": (_F, _G),
+    "floor_divide": (_I, _J),
+    "equal": (_I, _J), "not_equal": (_I, _J), "greater": (_F, _G),
+    "greater_equal": (_F, _G), "less": (_F, _G),
+    "less_equal": (_F, _G),
+    "logical_and": (_I, _J), "logical_or": (_I, _J),
+    "logical_xor": (_I, _J), "logical_not": (_I,),
+    "bitwise_and": (_I, _J), "bitwise_or": (_I, _J),
+    "bitwise_xor": (_I, _J), "bitwise_not": (_I,), "invert": (_I,),
+    "left_shift": (_I, _J), "right_shift": (_I, _J),
+    "logaddexp": (_F, _G), "logaddexp2": (_F, _G),
+    "heaviside": (_F, 0.5), "hypot": (_F, _G),
+    "ldexp": (_F, _J), "nextafter": (_F, _G),
+    "cumprod": (_F,), "ediff1d": (_F,),
+    "vdot": (_F, _G), "correlate": (_F[0], _G[0]),
+    "polyval": (onp.array([1.0, -2.0, 3.0], "f4"), _F),
+    "nanmax": (_N,), "nanmin": (_N,), "nanargmax": (_N,),
+    "nanargmin": (_N,), "nanprod": (_N,), "nanmedian": (_N,),
+    "amax": (_F,), "amin": (_F,), "any": (_I,),
+    "alltrue": (_I,), "sometrue": (_I,), "product": (_F,),
+    "isclose": (_F, _F + 1e-7), "isinf": (_N,),
+    "isneginf": (onp.array([-onp.inf, 1.0, onp.inf], "f4"),),
+    "isposinf": (onp.array([-onp.inf, 1.0, onp.inf], "f4"),),
+    "array_equiv": (_F, _F),
+    "array_split": (_F, 2, 1), "hsplit": (_F, 3),
+    "vsplit": (_F, 2), "dsplit": (_F.reshape(1, 2, 3) * 1, 3),
+    "column_stack": ((_F[0], _G[0]),), "dstack": ((_F, _G),),
+    "row_stack": ((_F, _G),),
+    "argwhere": (_I > 2,), "nonzero": (_I > 2,),
+    "compress": (onp.array([True, False]), _F, 0),
+    "extract": (_I > 2, _I),
+    "append": (_F, _G), "insert": (_F[0], 1, 9.0),
+    "delete": (_F[0], 1),
+    "argpartition": (_I[0], 1),
+    "lexsort": ((onp.array([2, 1, 3]), onp.array([0, 0, 1])),),
+    "unravel_index": (onp.array([5, 3], "i4"), (2, 3)),
+    "ravel_multi_index": ((onp.array([1, 0]), onp.array([2, 1])),
+                          (2, 3)),
+    "indices": ((2, 3),),
+    "tril_indices": (3,), "triu_indices": (3,),
+    "msort": (_F,), "matrix_power": (_G[:, :2] @ _G[:, :2].T, 2),
+    "fv": (0.05 / 12, 120, -100, -100),
+    "pv": (0.05 / 12, 120, -100, 15000),
+    "pmt": (0.075 / 12, 180, 200000),
+    "nper": (0.07 / 12, -150, 8000),
+    "npv": (0.08, onp.array([-1000.0, 300, 400, 500], "f4")),
+}
+
+# numpy removed the financial functions in 1.20; pin closed-form
+# expected values instead (reference mx.np keeps them)
+FINANCIAL_EXPECTED = {
+    "fv": 15692.928894335748,
+    "pv": 320.7194283381,        # -(fv + pmt*((1+r)^n-1)/r)/(1+r)^n
+    "pmt": -1854.0247200054619,
+    "nper": 64.0733487706618648,
+    "npv": 17.6294264,           # sum cf_i/(1+r)^i, i from 0
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES), ids=sorted(CASES))
+def test_longtail(name):
+    args = CASES[name]
+    mx_args = tuple(
+        tuple(_mx(v) for v in a) if isinstance(a, tuple)
+        and any(isinstance(v, onp.ndarray) for v in a) else _mx(a)
+        for a in args)
+    mx_out = getattr(mnp, name)(*mx_args)
+    if name in FINANCIAL_EXPECTED:
+        _cmp(mx_out, FINANCIAL_EXPECTED[name], rtol=1e-4)
+        return
+    np_fn = getattr(onp, name, None)
+    if np_fn is None:  # alias removed from modern numpy
+        np_fn = {"alltrue": onp.all, "sometrue": onp.any,
+                 "product": onp.prod, "round_": onp.round,
+                 "msort": lambda a: onp.sort(a, axis=0),
+                 "permute_dims": onp.transpose,
+                 "matrix_power": onp.linalg.matrix_power}[name]
+    elif name == "row_stack":
+        np_fn = onp.vstack  # numpy deprecated the row_stack alias
+    _cmp(mx_out, np_fn(*args))
+
+
+def test_dtype_lattice_fns():
+    assert bool(mnp.can_cast("int32", "float64")) == \
+        bool(onp.can_cast("int32", "float64"))
+    # int+float promotion differs BY DESIGN: the compute dtype is
+    # float32 (jax lattice), where classic numpy widens to float64
+    assert onp.dtype(mnp.promote_types("float16", "float32")) == \
+        onp.promote_types("float16", "float32")
+    assert onp.dtype(mnp.promote_types("int8", "int32")) == \
+        onp.promote_types("int8", "int32")
+    assert onp.dtype(mnp.result_type("int8", "uint8")) == \
+        onp.result_type("int8", "uint8")
+    assert mnp.finfo("float32").eps == onp.finfo("float32").eps
+    assert mnp.iinfo("int16").max == onp.iinfo("int16").max
+
+
+def test_shares_memory_views():
+    a = mnp.arange(10)
+    assert not mnp.shares_memory(a, mnp.arange(10))
+    # may_share_memory is allowed to be conservative, but must answer
+    assert mnp.may_share_memory(a, a) in (True, False)
+
+
+def test_ndim_size_helpers():
+    a = mnp.ones((2, 3))
+    assert mnp.ndim(a) == 2 and mnp.size(a) == 6
+    assert mnp.ndim(5) == 0
+
+
+def test_fill_diagonal_inplace():
+    a = mnp.zeros((3, 3))
+    mnp.fill_diagonal(a, 7.0)
+    onp.testing.assert_array_equal(
+        a.asnumpy(), onp.diag([7.0, 7.0, 7.0]).astype("f4"))

@@ -358,8 +358,15 @@ def index_add(data, indices, values, **kwargs):
 
 
 def index_update(data, indices, values, **kwargs):
-    return apply_op(lambda x, i, v: x.at[tuple(i)].set(v),
-                    _c(data), _c(indices), _c(values), name="index_update")
+    """Functional scatter-set (parity: _npi_index_update): indices is
+    (K, M) coordinates over the first K axes. Float index arrays are
+    accepted (the reference tolerates the float32 default dtype)."""
+    def upd(x, i, v):
+        if jnp.issubdtype(i.dtype, jnp.floating):
+            i = i.astype(jnp.int32)
+        return x.at[tuple(i)].set(v)
+    return apply_op(upd, _c(data), _c(indices), _c(values),
+                    name="index_update")
 
 
 def sequence_mask(data, sequence_length=None, use_sequence_length=False,

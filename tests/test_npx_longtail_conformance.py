@@ -1,0 +1,141 @@
+"""Long-tail npx conformance: members not swept elsewhere.
+
+Reference models: test_operator.py special-math ops, proposal/
+upsampling ops, masked softmax, and the index_update functional
+scatter (the TPU-native replacement for in-place writes).
+"""
+import numpy as onp
+import pytest
+from scipy import special as sps
+
+from mxnet_tpu import np as mnp, npx
+
+
+def test_digamma_matches_scipy():
+    x = onp.array([0.3, 1.0, 2.5, 7.0], "f4")
+    onp.testing.assert_allclose(npx.digamma(mnp.array(x)).asnumpy(),
+                                sps.digamma(x), rtol=1e-4)
+
+
+def test_erfinv_matches_scipy():
+    x = onp.array([-0.9, -0.3, 0.0, 0.5, 0.99], "f4")
+    onp.testing.assert_allclose(npx.erfinv(mnp.array(x)).asnumpy(),
+                                sps.erfinv(x), rtol=1e-4, atol=1e-5)
+
+
+def test_gamma_matches_scipy():
+    x = onp.array([0.5, 1.0, 3.3, 6.0], "f4")
+    onp.testing.assert_allclose(npx.gamma(mnp.array(x)).asnumpy(),
+                                sps.gamma(x), rtol=1e-4)
+
+
+def test_index_update_scatter_semantics():
+    """indices is (K, M): coordinates over the first K axes
+    (reference _npi_index_update layout)."""
+    a = mnp.zeros((4, 3))
+    out = npx.index_update(a, mnp.array([[1, 3]]),
+                           mnp.array([[1.0, 2, 3], [4, 5, 6]]))
+    expect = onp.zeros((4, 3), "f4")
+    expect[1] = [1, 2, 3]
+    expect[3] = [4, 5, 6]
+    onp.testing.assert_array_equal(out.asnumpy(), expect)
+    assert (a.asnumpy() == 0).all()  # functional: source untouched
+    # element-wise coordinates over both axes
+    out2 = npx.index_update(a, mnp.array([[0, 2], [1, 2]]),
+                            mnp.array([9.0, 8.0]))
+    assert out2.asnumpy()[0, 1] == 9.0 and out2.asnumpy()[2, 2] == 8.0
+
+
+def test_masked_log_softmax():
+    x = onp.array([[1.0, 2.0, 3.0, 4.0]], "f4")
+    mask = onp.array([[1, 1, 0, 1]], "i4")
+    out = npx.masked_log_softmax(mnp.array(x),
+                                 mnp.array(mask)).asnumpy()
+    kept = onp.array([1.0, 2.0, 4.0])
+    ref = kept - onp.log(onp.exp(kept).sum())
+    onp.testing.assert_allclose(out[0, [0, 1, 3]], ref, rtol=1e-5)
+    assert (out[0, 2] <= -1e20) or onp.isneginf(out[0, 2])
+
+
+def test_upsampling_nearest():
+    x = onp.arange(4.0, dtype="f4").reshape(1, 1, 2, 2)
+    out = npx.upsampling(mnp.array(x), scale=2,
+                         sample_type="nearest").asnumpy()
+    assert out.shape == (1, 1, 4, 4)
+    onp.testing.assert_array_equal(out[0, 0, :2, :2],
+                                   onp.full((2, 2), 0.0))
+    onp.testing.assert_array_equal(out[0, 0, 2:, 2:],
+                                   onp.full((2, 2), 3.0))
+
+
+def test_regression_output_heads():
+    """linear/logistic/mae regression heads: forward is identity/
+    sigmoid; backward is (pred - label) style (reference
+    regression_output.cc)."""
+    from mxnet_tpu import autograd
+    x = mnp.array(onp.array([[0.5, -1.0]], "f4"))
+    lbl = mnp.array(onp.array([[1.0, 0.0]], "f4"))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.linear_regression_output(x, lbl)
+    y.backward()
+    onp.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                (x.asnumpy() - lbl.asnumpy()) / 2,
+                                rtol=1e-5)
+
+    x2 = mnp.array(onp.array([[0.5, -1.0]], "f4"))
+    x2.attach_grad()
+    with autograd.record():
+        y2 = npx.logistic_regression_output(x2, lbl)
+    y2.backward()
+    sig = 1 / (1 + onp.exp(-x2.asnumpy()))
+    onp.testing.assert_allclose(y2.asnumpy(), sig, rtol=1e-5)
+
+
+def test_make_loss_passthrough_grad():
+    from mxnet_tpu import autograd
+    x = mnp.array([2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        loss = npx.make_loss(x * 2)
+    loss.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0],
+                                rtol=1e-6)
+
+
+def test_multi_proposal_smoke():
+    """RPN proposal generation produces (B, N, 5) rois within the
+    image bounds (reference multi_proposal.cc smoke-level check)."""
+    B, A, H, W = 1, 3, 4, 4
+    rng = onp.random.RandomState(0)
+    cls_prob = mnp.array(rng.uniform(0, 1, (B, 2 * A, H, W))
+                         .astype("f4"))
+    bbox_pred = mnp.array(rng.uniform(-0.2, 0.2, (B, 4 * A, H, W))
+                          .astype("f4"))
+    im_info = mnp.array(onp.array([[64.0, 64.0, 1.0]], "f4"))
+    out = npx.multi_proposal(cls_prob, bbox_pred, im_info,
+                             feature_stride=16, scales=(8,),
+                             ratios=(0.5, 1, 2), rpn_post_nms_top_n=8,
+                             rpn_pre_nms_top_n=12)
+    rois = out[0] if isinstance(out, (tuple, list)) else out
+    r = rois.asnumpy().reshape(-1, 5)
+    assert r.shape[-1] == 5
+    live = r[(r[:, 1:] >= 0).all(axis=1)]  # NMS pads with -1 rows
+    assert len(live) >= 1
+    assert (live[:, 1:] <= 64).all()
+    # boxes are well-formed: x2>=x1, y2>=y1
+    assert (live[:, 3] >= live[:, 1]).all()
+    assert (live[:, 4] >= live[:, 2]).all()
+
+
+def test_instance_norm_matches_manual():
+    x = onp.random.RandomState(1).randn(2, 3, 4, 4).astype("f4")
+    gamma = onp.ones(3, "f4")
+    beta = onp.zeros(3, "f4")
+    out = npx.instance_norm(mnp.array(x), mnp.array(gamma),
+                            mnp.array(beta), eps=1e-5).asnumpy()
+    mean = x.mean(axis=(2, 3), keepdims=True)
+    var = x.var(axis=(2, 3), keepdims=True)
+    onp.testing.assert_allclose(out, (x - mean) / onp.sqrt(var + 1e-5),
+                                rtol=1e-4, atol=1e-5)

@@ -125,12 +125,18 @@ class ImageIter:
     def __init__(self, batch_size, data_shape, label_width=1,
                  path_imgrec=None, path_imglist=None, path_root=None,
                  shuffle=False, aug_list=None, use_native=None,
-                 prefetch=False, **kwargs):
+                 prefetch=False, last_batch_handle="pad", seed=None,
+                 **kwargs):
         from .recordio import MXIndexedRecordIO
         assert path_imgrec or path_imglist
+        assert last_batch_handle in ("pad", "discard")
         self.batch_size = batch_size
         self.data_shape = data_shape
         self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.pad = 0
+        self._shuffle_rng = onp.random.RandomState(seed) \
+            if seed is not None else onp.random
         self.aug_list = aug_list or []
         self._prefetch = bool(prefetch)
         self._pending = None
@@ -184,7 +190,7 @@ class ImageIter:
         self._drain_pending()
         self._order = list(self._keys)
         if self.shuffle:
-            onp.random.shuffle(self._order)
+            self._shuffle_rng.shuffle(self._order)
         self._cursor = 0
 
     def __iter__(self):
@@ -210,13 +216,30 @@ class ImageIter:
             self._drain_pending()
             raise
 
+    def _take_indices(self):
+        """Next batch's index list, honoring last_batch_handle: 'pad'
+        wraps from the head (tiling if the dataset is smaller than one
+        batch — reference ImageIter/io.py pad semantics); 'discard'
+        drops the short tail."""
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        idxs = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(idxs)
+        if pad > 0:
+            if self.last_batch_handle == "discard":
+                raise StopIteration
+            fill = self._order
+            while len(idxs) < self.batch_size:
+                idxs = idxs + fill[:self.batch_size - len(idxs)]
+        self.pad = pad
+        return idxs
+
     def _next_batch(self):
         from .numpy import stack, array
         from .recordio import unpack_img
-        if self._cursor + self.batch_size > len(self._order):
-            raise StopIteration
+        idxs = self._take_indices()
         if self._native is not None and not self.aug_list:
-            keys = self._order[self._cursor:self._cursor + self.batch_size]
+            keys = idxs
             # the native reader indexes records by file ordinal; .idx
             # keys can be arbitrary, so map key -> position in the idx
             # (idx rows are written in record order)
@@ -228,8 +251,7 @@ class ImageIter:
             return (array(batch.astype(onp.float32)).transpose(0, 3, 1, 2),
                     array(lab.astype(onp.float32)))
         imgs, labels = [], []
-        for i in range(self._cursor, self._cursor + self.batch_size):
-            key = self._order[i]
+        for key in idxs:
             if self._rec is not None:
                 header, img = unpack_img(self._rec.read_idx(key), iscolor=1)
                 label = header.label
@@ -802,15 +824,13 @@ class ImageDetIter(ImageIter):
 
     def __next__(self):
         from .numpy import array
-        if self._cursor + self.batch_size > len(self._order):
-            raise StopIteration
+        idxs = self._take_indices()
         spatial = [a for a in self.det_aug_list
                    if not isinstance(a, DetNormalizeAug)]
         post = [a for a in self.det_aug_list
                 if isinstance(a, DetNormalizeAug)]
         imgs, labels = [], []
-        for i in range(self._cursor, self._cursor + self.batch_size):
-            key = self._order[i]
+        for key in idxs:
             img, label = self._read_raw(key)
             for aug in spatial:
                 img, label = aug(img, label)

@@ -306,3 +306,7 @@ class PrefetchingIter(DataIter):
 
     def getpad(self):
         return self.current_batch.pad
+
+
+from .legacy_iters import (  # noqa: E402,F401 - reference iterator names
+    CSVIter, LibSVMIter, MNISTIter, ImageRecordIter)

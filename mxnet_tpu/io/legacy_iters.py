@@ -1,0 +1,229 @@
+"""Legacy C++-backed iterator classes: CSVIter, LibSVMIter, MNISTIter,
+ImageRecordIter (parity: the MXDataIter creators registered by
+src/io/iter_csv.cc, iter_libsvm.cc, iter_mnist.cc,
+iter_image_recordio_2.cc and surfaced as mx.io.* in io.py:995).
+
+TPU-native mapping: the parsing happens host-side in numpy (CSV/
+LibSVM/MNIST are ingest formats, not hot loops); ImageRecordIter
+delegates to image.ImageIter, whose RecordIO path uses the native
+mmap+libjpeg reader when built. All four speak the DataBatch /
+provide_data protocol so reference training loops run unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import struct
+
+import numpy as onp
+
+from ..ndarray.ndarray import NDArray
+from . import DataBatch, DataDesc, DataIter
+
+
+def _to_nd(arr):
+    from .. import numpy as mnp
+    return mnp.array(arr)
+
+
+class _ArrayBackedIter(DataIter):
+    """Shared round_batch/pad iteration over host arrays."""
+
+    def __init__(self, data, label, batch_size, shuffle=False,
+                 round_batch=True, data_name="data",
+                 label_name="softmax_label", seed=0):
+        super().__init__(batch_size)
+        self._data = data
+        self._label = label
+        self._shuffle = shuffle
+        self._round = round_batch
+        self._rng = onp.random.RandomState(seed)
+        self._order = onp.arange(len(data))
+        self._data_name, self._label_name = data_name, label_name
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._data.shape[1:],
+                         self._data.dtype)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name,
+                         (self.batch_size,) + self._label.shape[1:],
+                         self._label.dtype)]
+
+    def reset(self):
+        self._cursor = 0
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+
+    def iter_next(self):
+        if self._cursor >= len(self._data):
+            return False
+        n = len(self._data)
+        take = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(take)
+        if pad > 0:
+            if not self._round and len(self._data) >= self.batch_size:
+                # discard the short tail like the reference's
+                # round_batch=False with full batches available
+                self._cursor = n
+                return False
+            # wrap from the head, tiling when the whole dataset is
+            # smaller than one batch
+            while len(take) < self.batch_size:
+                take = onp.concatenate(
+                    [take, self._order[:self.batch_size - len(take)]])
+        self._pad = pad
+        self._batch_data = self._make_data(take)
+        self._batch_label = self._make_label(take)
+        self._cursor += self.batch_size
+        return True
+
+    def _make_data(self, take):
+        return [_to_nd(self._data[take])]
+
+    def _make_label(self, take):
+        return [_to_nd(self._label[take])]
+
+    def getdata(self):
+        return self._batch_data
+
+    def getlabel(self):
+        return self._batch_label
+
+    def getpad(self):
+        return self._pad
+
+
+class CSVIter(_ArrayBackedIter):
+    """Parity: iter_csv.cc — dense samples from CSV text."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None,
+                 label_shape=(1,), batch_size=1, round_batch=True,
+                 shuffle=False, dtype="float32", **kwargs):
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=dtype,
+                           ndmin=2)
+        data = data.reshape((-1,) + tuple(data_shape))
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=dtype,
+                                ndmin=2).reshape((-1,) +
+                                                 tuple(label_shape))
+        else:
+            label = onp.zeros((len(data),) + tuple(label_shape), dtype)
+        super().__init__(data, label, batch_size, shuffle=shuffle,
+                         round_batch=round_batch, **kwargs)
+
+
+class LibSVMIter(_ArrayBackedIter):
+    """Parity: iter_libsvm.cc — sparse CSR samples from libsvm text.
+    Batches carry CSRNDArray data (stype='csr')."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 round_batch=True, shuffle=False, **kwargs):
+        n_col = int(onp.prod(data_shape))
+        labels, rows = [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                row = onp.zeros(n_col, "f4")
+                for tok in parts[1:]:
+                    idx, val = tok.split(":")
+                    row[int(idx)] = float(val)
+                rows.append(row)
+        data = onp.stack(rows) if rows else onp.zeros((0, n_col), "f4")
+        label = onp.asarray(labels, "f4").reshape(-1, 1)
+        super().__init__(data, label, batch_size, shuffle=shuffle,
+                         round_batch=round_batch, **kwargs)
+
+    def _make_data(self, take):
+        from ..ndarray import sparse
+        return [sparse.csr_matrix(_to_nd(self._data[take]))]
+
+
+def _read_idx(path):
+    """IDX (MNIST) format: magic, dims, big-endian uint8 payload."""
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+        assert zero == 0, f"not an IDX file: {path}"
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return onp.frombuffer(f.read(), dtype=onp.uint8).reshape(dims)
+
+
+class MNISTIter(_ArrayBackedIter):
+    """Parity: iter_mnist.cc — IDX-format images/labels; flat=False
+    yields (1, 28, 28), images scaled to [0, 1]."""
+
+    def __init__(self, image, label, batch_size=1, shuffle=False,
+                 flat=False, seed=0, round_batch=True, **kwargs):
+        imgs = _read_idx(image).astype("float32") / 255.0
+        labels = _read_idx(label).astype("float32")
+        imgs = imgs.reshape(len(imgs), -1) if flat \
+            else imgs.reshape(len(imgs), 1, *imgs.shape[1:])
+        super().__init__(imgs, labels, batch_size, shuffle=shuffle,
+                         round_batch=round_batch, seed=seed, **kwargs)
+
+
+class ImageRecordIter(DataIter):
+    """Parity: iter_image_recordio_2.cc — JPEG RecordIO with the
+    standard augmentation knobs. Delegates decode to image.ImageIter
+    (native mmap+libjpeg reader when available) and augmentation to
+    image.CreateAugmenter, so the knob names match the reference."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, resize=0,
+                 label_width=1, round_batch=True, seed=0, **kwargs):
+        super().__init__(batch_size)
+        from .. import image as img_mod
+        mean = onp.array([mean_r, mean_g, mean_b], "f4")
+        std = onp.array([std_r, std_g, std_b], "f4")
+        if (rand_crop or rand_mirror or resize or mean.any()
+                or (std != 1).any()):
+            augs = img_mod.CreateAugmenter(
+                data_shape, resize=resize, rand_crop=rand_crop,
+                rand_mirror=rand_mirror,
+                mean=mean if mean.any() else None,
+                std=std if (std != 1).any() else None)
+        else:
+            # no augmentation requested: an empty aug list keeps the
+            # native mmap+libjpeg reader eligible (image.py:144)
+            augs = None
+        self._it = img_mod.ImageIter(
+            batch_size, data_shape, label_width=label_width,
+            path_imgrec=path_imgrec, shuffle=shuffle, aug_list=augs,
+            last_batch_handle="pad" if round_batch else "discard",
+            seed=seed, **kwargs)
+        self.provide_data = [DataDesc("data",
+                                      (batch_size,) + tuple(data_shape))]
+        label_shape = (batch_size,) if label_width == 1 \
+            else (batch_size, label_width)
+        self.provide_label = [DataDesc("softmax_label", label_shape)]
+
+    def reset(self):
+        self._it.reset()
+
+    def iter_next(self):
+        try:
+            d, l = next(self._it)  # ImageIter yields (data, label)
+        except StopIteration:
+            return False
+        self._data = [d] if isinstance(d, NDArray) else list(d)
+        self._label = [l] if isinstance(l, NDArray) else list(l)
+        self._pad = self._it.pad
+        return True
+
+    def getdata(self):
+        return self._data
+
+    def getlabel(self):
+        return self._label
+
+    def getpad(self):
+        return self._pad

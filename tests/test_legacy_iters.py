@@ -1,0 +1,168 @@
+"""Legacy iterator classes (CSVIter/LibSVMIter/MNISTIter/
+ImageRecordIter) — parity: tests/python/unittest/test_io.py."""
+import gzip
+import struct
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu import io
+
+
+def test_csv_iter(tmp_path):
+    data = onp.arange(21.0).reshape(7, 3)
+    labels = onp.arange(7.0)
+    d = tmp_path / "d.csv"
+    l = tmp_path / "l.csv"
+    onp.savetxt(d, data, delimiter=",")
+    onp.savetxt(l, labels.reshape(-1, 1), delimiter=",")
+    it = io.CSVIter(data_csv=str(d), data_shape=(3,),
+                    label_csv=str(l), batch_size=3)
+    seen = []
+    for batch in it:
+        assert batch.data[0].shape == (3, 3)
+        seen.append((batch.data[0].asnumpy(), batch.pad))
+    # 7 rows / batch 3 -> 3 batches, last padded by 2 (round_batch)
+    assert len(seen) == 3 and seen[-1][1] == 2
+    onp.testing.assert_allclose(seen[0][0], data[:3])
+    # wrap-around pad comes from the head
+    onp.testing.assert_allclose(seen[-1][0][1:], data[:2])
+    it.reset()
+    assert it.next().data[0].shape == (3, 3)
+
+
+def test_csv_iter_provides(tmp_path):
+    d = tmp_path / "d.csv"
+    onp.savetxt(d, onp.ones((4, 2)), delimiter=",")
+    it = io.CSVIter(data_csv=str(d), data_shape=(2,), batch_size=2)
+    assert it.provide_data[0].shape == (2, 2)
+    assert it.provide_label[0].shape == (2, 1)
+
+
+def test_libsvm_iter(tmp_path):
+    f = tmp_path / "data.libsvm"
+    f.write_text("1 0:1.5 3:2.0\n-1 1:0.5\n1 2:3.0 3:1.0\n")
+    it = io.LibSVMIter(data_libsvm=str(f), data_shape=(4,),
+                       batch_size=2)
+    batch = it.next()
+    d = batch.data[0]
+    assert getattr(d, "stype", "default") == "csr"
+    onp.testing.assert_allclose(
+        d.asnumpy(), [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    onp.testing.assert_allclose(batch.label[0].asnumpy(),
+                                [[1.0], [-1.0]])
+
+
+def _write_idx_images(path, arr):
+    with open(path, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, arr.ndim))
+        f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+        f.write(arr.astype(onp.uint8).tobytes())
+
+
+def test_mnist_iter(tmp_path):
+    imgs = onp.random.RandomState(0).randint(0, 255, (10, 28, 28))
+    labels = onp.arange(10) % 10
+    ip, lp = tmp_path / "imgs-idx3", tmp_path / "lbl-idx1"
+    _write_idx_images(ip, imgs)
+    _write_idx_images(lp, labels)
+    it = io.MNISTIter(image=str(ip), label=str(lp), batch_size=5)
+    b = it.next()
+    assert b.data[0].shape == (5, 1, 28, 28)
+    assert float(b.data[0].asnumpy().max()) <= 1.0
+    onp.testing.assert_allclose(b.label[0].asnumpy(),
+                                labels[:5].astype("f4"))
+    it2 = io.MNISTIter(image=str(ip), label=str(lp), batch_size=5,
+                       flat=True)
+    assert it2.next().data[0].shape == (5, 784)
+
+
+def test_image_record_iter(tmp_path):
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "w")
+    rng = onp.random.RandomState(0)
+    for i in range(8):
+        arr = rng.randint(0, 255, (32, 32, 3)).astype(onp.uint8)
+        buf = pyio.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 4), i, 0), buf.getvalue()))
+    rec.close()
+    it = io.ImageRecordIter(path_imgrec=str(tmp_path / "d.rec"),
+                            data_shape=(3, 28, 28), batch_size=4,
+                            rand_mirror=True, mean_r=0.5)
+    n = 0
+    for batch in it:
+        assert batch.data[0].shape == (4, 3, 28, 28)
+        n += 1
+    assert n == 2
+    it.reset()
+    assert it.next().data[0].shape == (4, 3, 28, 28)
+
+
+def test_csv_iter_dataset_smaller_than_batch(tmp_path):
+    d = tmp_path / "d.csv"
+    onp.savetxt(d, onp.arange(6.0).reshape(2, 3), delimiter=",")
+    it = io.CSVIter(data_csv=str(d), data_shape=(3,), batch_size=5)
+    b = it.next()
+    assert b.data[0].shape == (5, 3)  # tiled wrap-around
+    assert b.pad == 3
+
+
+def test_image_record_iter_round_batch_pads(tmp_path):
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "w")
+    for i in range(5):
+        buf = pyio.BytesIO()
+        Image.fromarray(onp.full((16, 16, 3), i * 40, onp.uint8)) \
+            .save(buf, format="JPEG")
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    rec.close()
+
+    def run(round_batch):
+        it = io.ImageRecordIter(
+            path_imgrec=str(tmp_path / "d.rec"),
+            data_shape=(3, 16, 16), batch_size=3,
+            round_batch=round_batch)
+        return [(b.data[0].shape, b.pad) for b in it]
+
+    padded = run(True)
+    assert len(padded) == 2 and padded[-1] == ((3, 3, 16, 16), 1)
+    assert len(run(False)) == 1  # short tail discarded
+
+    # provide_label matches delivered label shape for label_width=1
+    it = io.ImageRecordIter(path_imgrec=str(tmp_path / "d.rec"),
+                            data_shape=(3, 16, 16), batch_size=3)
+    it.iter_next()
+    assert tuple(it.provide_label[0].shape) == \
+        tuple(it.getlabel()[0].shape)
+
+
+def test_image_record_iter_seeded_shuffle(tmp_path):
+    import io as pyio
+    from PIL import Image
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(str(tmp_path / "d.idx"),
+                                     str(tmp_path / "d.rec"), "w")
+    for i in range(8):
+        buf = pyio.BytesIO()
+        Image.fromarray(onp.full((8, 8, 3), i * 30, onp.uint8)) \
+            .save(buf, format="JPEG")
+        rec.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i), i, 0), buf.getvalue()))
+    rec.close()
+
+    def labels(seed):
+        it = io.ImageRecordIter(path_imgrec=str(tmp_path / "d.rec"),
+                                data_shape=(3, 8, 8), batch_size=4,
+                                shuffle=True, seed=seed)
+        return onp.concatenate([b.label[0].asnumpy() for b in it])
+
+    onp.testing.assert_array_equal(labels(7), labels(7))

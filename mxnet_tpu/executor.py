@@ -1,0 +1,4 @@
+"""mx.executor — alias module (parity: python/mxnet/executor.py,
+whose 2.x Executor is a CachedOp-delegating shim; ours lives with the
+symbol package)."""
+from .symbol.executor import Executor  # noqa: F401

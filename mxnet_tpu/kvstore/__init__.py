@@ -33,3 +33,31 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     return KVStoreBase.create(name)
+
+
+class KVStoreServer:
+    """Server-role bootstrap (parity: kvstore/kvstore_server.py).
+
+    In the reference, server processes construct a KVStore, wrap it in
+    KVStoreServer, and call run() — which blocks serving worker
+    push/pull plus pickled set_optimizer commands. Here the PS service
+    is `ParameterServer` (dist_async.py); run() hosts one and blocks,
+    honoring the same launcher env (`MXNET_TPU_PS_ADDR` names the
+    listen address, defaulting to any free port printed on stdout).
+    """
+
+    def __init__(self, kvstore=None):
+        self.kvstore = kvstore
+
+    def run(self):
+        import os as _os
+        addr = _os.environ.get("MXNET_TPU_PS_ADDR")
+        if addr:
+            host, port = addr.rsplit(":", 1)
+            server = ParameterServer((host, int(port)))
+        else:
+            server = ParameterServer()
+            print(f"KVStoreServer listening on "
+                  f"{server.address[0]}:{server.address[1]}",
+                  flush=True)
+        server.serve_forever()

@@ -202,3 +202,35 @@ def test_custom_kvstore_registry():
     out = mx.np.zeros((2,))
     kv.pushpull(0, g, out=out)
     onp.testing.assert_allclose(out.asnumpy(), [1.0, 1.0])
+
+
+def test_kvstore_server_bootstrap():
+    """KVStoreServer.run() hosts a ParameterServer on the env-named
+    address; a worker-side KVStoreDistAsync can push/pull against it
+    (parity: kvstore/kvstore_server.py bootstrap)."""
+    import os
+    import socket
+    import threading
+    import time
+
+    from mxnet_tpu import kvstore as kv_mod
+
+    # pick a free port
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    os.environ["MXNET_TPU_PS_ADDR"] = f"127.0.0.1:{port}"
+    try:
+        srv = kv_mod.KVStoreServer()
+        t = threading.Thread(target=srv.run, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        kv = kv_mod.KVStoreDistAsync()
+        kv.init("w", mx.np.zeros((3,)))
+        kv.push("w", mx.np.ones((3,)))
+        out = mx.np.zeros((3,))
+        kv.pull("w", out=out)
+        assert float(out.asnumpy().sum()) != 0.0
+    finally:
+        os.environ.pop("MXNET_TPU_PS_ADDR", None)

@@ -47,17 +47,26 @@ class KVStoreServer:
     """
 
     def __init__(self, kvstore=None):
+        # an optimizer already configured on the wrapped store seeds
+        # the hosted server (workers may also set one later via
+        # set_optimizer, the reference's cmd_id=0 path)
         self.kvstore = kvstore
 
     def run(self):
         import os as _os
+
+        from .dist_async import parse_ps_addr
         addr = _os.environ.get("MXNET_TPU_PS_ADDR")
         if addr:
-            host, port = addr.rsplit(":", 1)
-            server = ParameterServer((host, int(port)))
+            server = ParameterServer(parse_ps_addr(addr))
         else:
             server = ParameterServer()
             print(f"KVStoreServer listening on "
                   f"{server.address[0]}:{server.address[1]}",
                   flush=True)
+        opt = getattr(self.kvstore, "_optimizer", None)
+        if opt is not None:
+            from ..optimizer import Updater
+            server.ps_state.updater = Updater(opt)
+        self._server = server
         server.serve_forever()

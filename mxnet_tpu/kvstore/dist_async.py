@@ -50,6 +50,16 @@ def _recv_msg(sock):
     return pickle.loads(bytes(buf))
 
 
+def parse_ps_addr(addr):
+    """Validate 'host:port' (the MXNET_TPU_PS_ADDR format); raises a
+    named error instead of an unpacking ValueError."""
+    host, sep, port = str(addr).rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(
+            f"MXNET_TPU_PS_ADDR must be 'host:port', got {addr!r}")
+    return host, int(port)
+
+
 class _PSState:
     def __init__(self):
         self.store = {}          # key -> onp.ndarray weight
@@ -138,8 +148,7 @@ class KVStoreDistAsync(KVStoreBase):
                 "dist_async needs a parameter server: set "
                 "MXNET_TPU_PS_ADDR=host:port or pass server_addr")
         if isinstance(addr, str):
-            host, port = addr.rsplit(":", 1)
-            addr = (host, int(port))
+            addr = parse_ps_addr(addr)
         self._sock = socket.create_connection(addr)
         self._lock = threading.Lock()
         self._compression = None

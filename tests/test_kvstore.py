@@ -220,17 +220,34 @@ def test_kvstore_server_bootstrap():
     s.bind(("127.0.0.1", 0))
     port = s.getsockname()[1]
     s.close()
+    prev_addr = os.environ.get("MXNET_TPU_PS_ADDR")
     os.environ["MXNET_TPU_PS_ADDR"] = f"127.0.0.1:{port}"
+    srv = kv_mod.KVStoreServer()
+    kv = None
     try:
-        srv = kv_mod.KVStoreServer()
         t = threading.Thread(target=srv.run, daemon=True)
         t.start()
-        time.sleep(0.3)
-        kv = kv_mod.KVStoreDistAsync()
+        # retry-connect: the listen socket binds inside the thread
+        for _ in range(100):
+            try:
+                kv = kv_mod.KVStoreDistAsync()
+                break
+            except OSError:
+                time.sleep(0.05)
+        assert kv is not None, "server never came up"
         kv.init("w", mx.np.zeros((3,)))
         kv.push("w", mx.np.ones((3,)))
         out = mx.np.zeros((3,))
         kv.pull("w", out=out)
         assert float(out.asnumpy().sum()) != 0.0
     finally:
-        os.environ.pop("MXNET_TPU_PS_ADDR", None)
+        server = getattr(srv, "_server", None)
+        if server is not None:
+            server.shutdown()
+            server.server_close()
+        if kv is not None and hasattr(kv, "close"):
+            kv.close()
+        if prev_addr is None:
+            os.environ.pop("MXNET_TPU_PS_ADDR", None)
+        else:
+            os.environ["MXNET_TPU_PS_ADDR"] = prev_addr

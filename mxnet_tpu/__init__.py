@@ -88,5 +88,13 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
             raise
 del _importlib, _mod
 
+# reference short aliases (python/mxnet/__init__.py:55-95)
+if "visualization" in globals():
+    viz = globals()["visualization"]
+if "random" in globals():
+    rnd = globals()["random"]
+if "kvstore" in globals():
+    kv = globals()["kvstore"]
+
 if "symbol" in globals():
     sym = globals()["symbol"]

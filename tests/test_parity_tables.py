@@ -162,3 +162,15 @@ def test_nchw_transpose_only_on_tagged_conv_weights(tmp_path):
     import numpy as onp
     assert onp.abs(yc.asnumpy().transpose(0, 2, 3, 1)
                    - yd.asnumpy()).max() < 1e-5
+
+
+def test_top_level_short_aliases():
+    """Reference short aliases (python/mxnet/__init__.py:55-95):
+    mx.viz, mx.rnd, mx.kv point at their long-name modules."""
+    import mxnet_tpu as mx
+    assert mx.viz is mx.visualization
+    assert mx.rnd is mx.random
+    assert mx.kv is mx.kvstore
+    assert mx.sym is mx.symbol
+    assert mx.np is mx.numpy
+    assert mx.npx is mx.numpy_extension

@@ -34,8 +34,15 @@ def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
 
 
 def load_params(prefix, epoch):
-    """Load ``prefix-{epoch:04d}.params`` → (arg_params, aux_params)."""
-    loaded = _legacy.load_legacy(f"{prefix}-{epoch:04d}.params")
+    """Load ``prefix-{epoch:04d}.params`` → (arg_params, aux_params).
+
+    Accepts both the legacy binary written by save_checkpoint and the
+    gluon ``save_parameters`` format written by HybridBlock.export."""
+    from . import utils_io
+    fname = f"{prefix}-{epoch:04d}.params"
+    # utils_io.load sniffs the legacy magic and falls back to npz —
+    # covers both save_checkpoint and gluon save_parameters artifacts
+    loaded = utils_io.load(fname)
     if not isinstance(loaded, dict):
         raise ValueError("checkpoint params file has no names; "
                          "not a save_checkpoint artifact")
@@ -45,17 +52,72 @@ def load_params(prefix, epoch):
             arg_params[k[4:]] = v
         elif k.startswith("aux:"):
             aux_params[k[4:]] = v
+        elif "running_" in k or "moving_" in k:
+            # gluon-format (unprefixed) aux states: normalization
+            # running statistics are exactly the reference's aux set
+            aux_params[k] = v
         else:  # tolerate unprefixed keys like the reference loader
             arg_params[k] = v
     return arg_params, aux_params
 
 
+class ExportedSymbol:
+    """Stand-in symbol for a HybridBlock.export artifact: the graph
+    IR is a compiled StableHLO program (``-symbol.mxir``), not an op
+    DAG, so it cannot be recomposed — but load_checkpoint callers can
+    still inspect it and feed it to ``gluon.SymbolBlock.imports`` via
+    ``json_path``."""
+
+    def __init__(self, json_path, manifest):
+        self.json_path = json_path
+        self.manifest = manifest
+
+    def tojson(self):
+        import json as _json
+        return _json.dumps(self.manifest)
+
+    def save(self, fname):
+        """Re-save manifest + copy the .mxir artifact next to the new
+        prefix so save_checkpoint(load_checkpoint(...)) round-trips."""
+        import json as _json
+        import os as _os
+        import shutil as _shutil
+        with open(fname, "w") as f:
+            _json.dump(self.manifest, f)
+        art = self.manifest.get("artifact")
+        if art:
+            src = _os.path.join(_os.path.dirname(self.json_path), art)
+            dst = _os.path.join(_os.path.dirname(_os.path.abspath(
+                fname)), art)
+            if _os.path.abspath(src) != dst and _os.path.exists(src):
+                _shutil.copyfile(src, dst)
+
+    def list_arguments(self):
+        return list(self.manifest.get("param_names", []))
+
+    def __repr__(self):
+        return (f"ExportedSymbol(StableHLO artifact "
+                f"{self.manifest.get('artifact')!r})")
+
+
 def load_checkpoint(prefix, epoch):
-    """Load symbol + params saved by :func:`save_checkpoint`.
+    """Load symbol + params saved by :func:`save_checkpoint` OR by
+    ``HybridBlock.export`` (whose -symbol.json is a StableHLO
+    manifest; returned as :class:`ExportedSymbol`).
 
     Returns ``(symbol, arg_params, aux_params)``.
     """
+    import json as _json
+
     from . import symbol as sym
-    symbol = sym.load(f"{prefix}-symbol.json")
+    path = f"{prefix}-symbol.json"
+    try:
+        symbol = sym.load(path)
+    except ValueError:
+        with open(path) as f:
+            d = _json.load(f)
+        if "artifact" not in d:
+            raise
+        symbol = ExportedSymbol(path, d)
     arg_params, aux_params = load_params(prefix, epoch)
     return symbol, arg_params, aux_params

@@ -1,0 +1,158 @@
+"""Canonical reference user journeys, end to end.
+
+Each test is a condensed version of a reference tutorial / crash-
+course flow (docs/python_docs/python/tutorials/getting-started) —
+the acceptance bar for "a reference user can switch": the exact same
+call sequences must work against mxnet_tpu.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, np
+from mxnet_tpu.gluon import nn
+
+
+def test_ndarray_crash_course():
+    """'Step 1: Manipulate data with NP on MXNet' tutorial flow."""
+    x = np.ones((3, 4), ctx=mx.cpu())
+    y = np.random.uniform(-1, 1, (3, 4))
+    z = x * y + 2
+    assert z.shape == (3, 4)
+    assert z.ctx.device_type in ("cpu", "tpu")
+    # slicing / item assignment / reductions
+    z[0] = 0
+    assert float(z[0].sum().item()) == 0.0
+    n = z.asnumpy()
+    assert isinstance(n, onp.ndarray)
+    back = np.array(n)
+    onp.testing.assert_allclose(back.asnumpy(), n)
+    # astype + transpose chains
+    w = z.astype("float16").astype("float32").T
+    assert w.shape == (4, 3)
+
+
+def test_gluon_crash_course_train_and_export(tmp_path):
+    """'Step 2-4: create nn, train, save/reload' crash course."""
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    # training loop
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    X = np.random.normal(size=(32, 8))
+    y_lab = np.array(onp.random.RandomState(0).randint(0, 4, 32)
+                     .astype("i4"))
+    first = None
+    for _ in range(10):
+        with autograd.record():
+            loss = loss_fn(net(X), y_lab).mean()
+        loss.backward()
+        trainer.step(32)
+        first = first if first is not None else float(loss.item())
+    assert float(loss.item()) < first
+    # save/load parameters round trip
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.Sequential()
+    net2.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net2.load_parameters(f)
+    onp.testing.assert_allclose(net2(X).asnumpy(), net(X).asnumpy(),
+                                rtol=1e-6)
+
+
+def test_hybridize_export_symbolblock_journey(tmp_path):
+    """'Faster inference: hybridize + export + SymbolBlock.imports'."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = np.ones((1, 6))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "model")
+    net.export(prefix, epoch=3)
+    back = gluon.SymbolBlock.imports(
+        f"{prefix}-symbol.json", ["data"], f"{prefix}-0003.params")
+    onp.testing.assert_allclose(back(x).asnumpy(), ref, rtol=1e-5)
+
+
+def test_autograd_tutorial_flow():
+    """'Automatic differentiation' tutorial: attach_grad, record,
+    backward with default and custom head gradients."""
+    x = np.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = 2 * x * x
+    y.backward()  # implicit ones head
+    onp.testing.assert_allclose(x.grad.asnumpy(), 4 * x.asnumpy())
+    with autograd.record():
+        y = 2 * x * x
+    y.backward(np.array([[0.5, 0.5], [0.1, 0.1]]))
+    onp.testing.assert_allclose(
+        x.grad.asnumpy(), 4 * x.asnumpy() * [[0.5, 0.5], [0.1, 0.1]],
+        rtol=1e-6)
+    # control flow through autograd (the tutorial's f(a) loop):
+    # c is linear in a, so da must equal c/a
+    a = np.random.normal(size=(1,))
+    a.attach_grad()
+    with autograd.record():
+        b = a * 2
+        for _ in range(3):
+            b = b * 2
+        c = b if float(b.sum().item()) > 0 else 100 * b
+    c.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                [float(c.item()) / float(a.item())],
+                                rtol=1e-4)
+
+
+def test_metric_and_test_utils_journey():
+    """Evaluation flow: gluon.metric accumulation + the public
+    numeric-gradient checker from mx.test_utils."""
+    acc = mx.gluon.metric.Accuracy()
+    preds = np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    labels = np.array([1, 0, 0])
+    acc.update(labels, preds)
+    name, val = acc.get()
+    assert name == "accuracy" and val == pytest.approx(2 / 3)
+
+    mx.test_utils.check_numeric_gradient(
+        lambda xs: (xs[0] * xs[0]).sum(),
+        [np.array([1.0, 2.0, 3.0])])
+
+
+def test_checkpoint_journey(tmp_path):
+    """Legacy model.save_checkpoint / load_checkpoint loop (the
+    reference's pre-Gluon serving flow)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = np.ones((2, 3))
+    ref = net(x).asnumpy()
+    prefix = str(tmp_path / "ckpt")
+    net.export(prefix, epoch=7)
+    sym, arg_params, aux_params = mx.model.load_checkpoint(prefix, 7)
+    assert sym is not None and len(arg_params) == 2
+    # params feed a fresh SymbolBlock
+    blk = gluon.SymbolBlock.imports(f"{prefix}-symbol.json", ["data"],
+                                    f"{prefix}-0007.params")
+    onp.testing.assert_allclose(blk(x).asnumpy(), ref, rtol=1e-5)
+
+
+def test_data_pipeline_journey():
+    """Dataset -> transform -> DataLoader -> training batch flow."""
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    X = onp.random.RandomState(0).randn(20, 5).astype("f4")
+    Y = onp.arange(20, dtype="f4")
+    ds = ArrayDataset(np.array(X), np.array(Y))
+    ds_t = ds.transform_first(lambda x: x * 2)
+    loader = DataLoader(ds_t, batch_size=8, shuffle=False,
+                        last_batch="keep")
+    batches = list(loader)
+    assert len(batches) == 3
+    d0, l0 = batches[0]
+    onp.testing.assert_allclose(d0.asnumpy(), X[:8] * 2, rtol=1e-6)
+    onp.testing.assert_allclose(l0.asnumpy(), Y[:8])

@@ -196,3 +196,116 @@ def environment(name, value):
                 os.environ[name] = old
 
     return _scope()
+
+
+def _location_to_dict(sym, location):
+    if isinstance(location, dict):
+        return dict(location)
+    names = sym.list_arguments()
+    assert len(names) == len(location), \
+        f"{len(location)} arrays for arguments {names}"
+    return dict(zip(names, location))
+
+
+def _as_mx(v, dtype):
+    return v if hasattr(v, "asnumpy") else mxnp.array(
+        onp.asarray(v, dtype))
+
+
+def check_symbolic_forward(sym, location, expected, rtol=None,
+                           atol=None, aux_states=None, ctx=None,
+                           equal_nan=False, dtype=onp.float32):
+    """Compare a Symbol's forward outputs with expected arrays
+    (parity: reference test_utils.py:1193). `location` is a list (in
+    list_arguments order) or name->array dict; `expected` likewise
+    against the outputs. `aux_states` (name->array) are bound as
+    extra constant inputs."""
+    args = {k: _as_mx(v, dtype)
+            for k, v in _location_to_dict(sym, location).items()}
+    if aux_states:
+        args.update({k: _as_mx(v, dtype)
+                     for k, v in aux_states.items()})
+    ex = sym.bind(ctx, args)
+    outs = ex.forward()
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    exp = expected if isinstance(expected, (list, tuple)) \
+        else [expected]
+    assert len(outs) == len(exp)
+    for o, e in zip(outs, exp):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan)
+    return outs
+
+
+def check_symbolic_backward(sym, location, out_grads, expected,
+                            rtol=None, atol=None, aux_states=None,
+                            grad_req="write", ctx=None,
+                            equal_nan=False, dtype=onp.float32):
+    """Compare a Symbol's input gradients with expected arrays
+    (parity: reference test_utils.py:1279). `out_grads` may be None
+    (ones heads), a list in output order, or an output-name dict."""
+    args = {k: _as_mx(v, dtype)
+            for k, v in _location_to_dict(sym, location).items()}
+    if aux_states:
+        args.update({k: _as_mx(v, dtype)
+                     for k, v in aux_states.items()})
+    names = sym.list_arguments()
+    grads = {n: mxnp.zeros(args[n].shape,
+                           dtype=str(args[n].dtype)) for n in names}
+    ex = sym.bind(ctx, args, args_grad=grads, grad_req=grad_req)
+    outs = ex.forward(is_train=True)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    if out_grads is None:
+        ogs = [mxnp.ones(o.shape, dtype=str(o.dtype)) for o in outs]
+    elif isinstance(out_grads, dict):
+        out_names = sym.list_outputs()
+        ogs = [_as_mx(out_grads[n], dtype) for n in out_names]
+    elif isinstance(out_grads, (list, tuple)):
+        ogs = [_as_mx(g, dtype) for g in out_grads]
+    else:
+        ogs = [_as_mx(out_grads, dtype)]
+    ex.backward(ogs if len(ogs) > 1 else ogs[0])
+    exp = expected if isinstance(expected, dict) \
+        else dict(zip(names, expected))
+    for name, e in exp.items():
+        if e is None:
+            continue
+        assert_almost_equal(ex.grad_dict[name], e, rtol=rtol,
+                            atol=atol, equal_nan=equal_nan,
+                            names=(f"grad[{name}]", "expected"))
+    return [ex.grad_dict[n] for n in names]
+
+
+def list_gpus():
+    """Parity shim: CUDA device enumeration — always empty here
+    (accelerators are TPU devices; see mx.context.num_gpus)."""
+    return []
+
+
+def download(url, fname=None, dirname=None, overwrite=False,
+             retries=5):
+    """Parity stub: this environment has no egress. file:// URLs and
+    existing local paths are served; anything else raises with
+    guidance (reference test_utils.py:1696 downloads over HTTP)."""
+    import os
+    import shutil
+    from urllib.parse import urlparse
+    if url.startswith("file://"):
+        src = urlparse(url).path
+    else:
+        src = url
+    if not os.path.exists(src):
+        raise IOError(
+            f"download({url!r}): no network egress in this "
+            "environment; place the file locally and pass its path "
+            "(MXNET_HOME datasets are read from disk)")
+    fname = fname or os.path.basename(src)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+        fname = os.path.join(dirname, fname)
+    if os.path.abspath(src) != os.path.abspath(fname) and \
+            (overwrite or not os.path.exists(fname)):
+        shutil.copyfile(src, fname)
+    return fname

@@ -156,3 +156,25 @@ def test_data_pipeline_journey():
     d0, l0 = batches[0]
     onp.testing.assert_allclose(d0.asnumpy(), X[:8] * 2, rtol=1e-6)
     onp.testing.assert_allclose(l0.asnumpy(), Y[:8])
+
+
+def test_check_symbolic_helpers_journey(tmp_path):
+    """mx.test_utils.check_symbolic_forward/backward — the reference
+    operator-test idiom works verbatim."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = a * b + a
+    av = onp.array([1.0, 2, 3], "f4")
+    bv = onp.array([4.0, 5, 6], "f4")
+    mx.test_utils.check_symbolic_forward(c, [av, bv], [av * bv + av])
+    mx.test_utils.check_symbolic_backward(
+        c, [av, bv], [onp.ones(3, "f4")], [bv + 1, av])
+    # download is an offline-gated local copy
+    src = tmp_path / "blob.txt"
+    src.write_text("x")
+    out = mx.test_utils.download(f"file://{src}",
+                                 dirname=str(tmp_path / "d"))
+    assert open(out).read() == "x"
+    with pytest.raises(IOError):
+        mx.test_utils.download("http://example.com/x")
+    assert mx.test_utils.list_gpus() == []

@@ -88,6 +88,9 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
             raise
 del _importlib, _mod
 
+if "attribute" in globals():
+    AttrScope = globals()["attribute"].AttrScope
+
 # reference short aliases (python/mxnet/__init__.py:55-95)
 if "visualization" in globals():
     viz = globals()["visualization"]

@@ -46,6 +46,28 @@ class Symbol:
         nid, idx = self._outputs[0]
         return self._nodes[nid].name
 
+    def attr(self, key):
+        """This output node's user attribute, or None (parity:
+        symbol.py attr)."""
+        nid, _ = self._outputs[0]
+        return self._nodes[nid].attrs.get("__uattr__", {}).get(key)
+
+    def list_attr(self):
+        """User attributes of this output node (parity: list_attr)."""
+        nid, _ = self._outputs[0]
+        return dict(self._nodes[nid].attrs.get("__uattr__", {}))
+
+    def attr_dict(self):
+        """name -> user-attribute dict for every reachable node
+        (parity: symbol.py attr_dict)."""
+        out = {}
+        for n in self._reachable():
+            node = self._nodes[n]
+            ua = node.attrs.get("__uattr__")
+            if ua:
+                out[node.name] = dict(ua)
+        return out
+
     def list_arguments(self):
         seen, out = set(), []
         for n in self._reachable():
@@ -110,9 +132,8 @@ class Symbol:
                 f"args={self.list_arguments()}>")
 
     # -- composition ---------------------------------------------------
-    def attr(self, key):
-        nid, _ = self._outputs[0]
-        return self._nodes[nid].attrs.get(key)
+    # (user attributes: see attr/list_attr/attr_dict above — op
+    # kwargs under plain keys are internal and read via _nodes)
 
     # arithmetic sugar (maps onto op-table entries)
     def __add__(self, other):
@@ -340,13 +361,25 @@ def _auto_name(op):
     return f"{op}{c}"
 
 
-def var(name, shape=None, dtype=None, init=None, **kwargs):
-    """Create a symbolic variable (parity: mx.sym.var/Variable)."""
+def var(name, shape=None, dtype=None, init=None, attr=None, **kwargs):
+    """Create a symbolic variable (parity: mx.sym.var/Variable).
+
+    ``attr`` plus the enclosing AttrScope's attributes are stored on
+    the node under the reserved ``__uattr__`` key (JSON round-trips;
+    execution ignores ``__``-prefixed attrs)."""
+    from .. import attribute as _attribute
     attrs = {}
     if shape is not None:
         attrs["__shape__"] = list(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(onp.dtype(dtype))
+    uattr = _attribute.current().get(attr)
+    for k, v in kwargs.items():
+        # reference: extra var kwargs (lr_mult, wd_mult, ...) become
+        # string attributes with a __<k>__ spelling
+        uattr[f"__{k}__"] = str(v)
+    if uattr:
+        attrs["__uattr__"] = dict(uattr)
     node = _Node("null", name, [], attrs)
     return Symbol([node], [(0, 0)])
 
@@ -411,6 +444,10 @@ def _compose(op, inputs, name=None, **attrs):
                  for n in nodes]
         in_entries = [fix(e) for e in in_entries]
 
+    from .. import attribute as _attribute
+    _scope_attrs = _attribute.current().get(None)
+    if _scope_attrs:
+        attrs = {**attrs, "__uattr__": dict(_scope_attrs)}
     node = _Node(op, name or _auto_name(op), in_entries, attrs)
     nodes = nodes + [node]
     n_out = attrs.get("__num_outputs__", 1)

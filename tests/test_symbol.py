@@ -194,3 +194,35 @@ def test_widened_op_table():
     out2 = g2.bind(None, {"data": x}).forward()
     out2 = out2[0] if isinstance(out2, (list, tuple)) else out2
     onp.testing.assert_allclose(out2.asnumpy(), exp)
+
+
+def test_attr_scope_and_symbol_attrs():
+    """Reference test_attr.py flow: attr= on Variable, AttrScope
+    inheritance with inner values winning, list_attr/attr_dict, and
+    attrs surviving a JSON round trip."""
+    import mxnet_tpu as mx
+
+    data = mx.sym.Variable("data", attr={"dtype": "data"})
+    assert data.attr("dtype") == "data"
+
+    with mx.AttrScope(group="4", data="great"):
+        gdata = mx.sym.Variable("gdata", attr={"lr_mult": "1"})
+        composed = gdata * data
+    assert gdata.attr("group") == "4"
+    assert gdata.attr("lr_mult") == "1"
+    assert composed.attr("group") == "4"  # ops inherit scope attrs
+
+    with mx.AttrScope(x="outer"):
+        with mx.AttrScope(x="inner", y="2"):
+            v = mx.sym.Variable("v")
+        w = mx.sym.Variable("w")
+    assert v.attr("x") == "inner" and v.attr("y") == "2"
+    assert w.attr("x") == "outer" and w.attr("y") is None
+
+    assert gdata.list_attr() == {"group": "4", "data": "great",
+                                 "lr_mult": "1"}
+    d = composed.attr_dict()
+    assert d["gdata"]["group"] == "4"
+    # round trip
+    back = mx.sym.load_json(composed.tojson())
+    assert back.attr_dict()["gdata"]["group"] == "4"

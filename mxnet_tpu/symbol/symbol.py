@@ -47,10 +47,15 @@ class Symbol:
         return self._nodes[nid].name
 
     def attr(self, key):
-        """This output node's user attribute, or None (parity:
-        symbol.py attr)."""
+        """This output node's user attribute, falling back to the
+        node's reserved/op attributes (__shape__ etc.) like the
+        reference's single attr namespace; None if absent."""
         nid, _ = self._outputs[0]
-        return self._nodes[nid].attrs.get("__uattr__", {}).get(key)
+        node = self._nodes[nid]
+        ua = node.attrs.get("__uattr__", {})
+        if key in ua:
+            return ua[key]
+        return node.attrs.get(key)
 
     def list_attr(self):
         """User attributes of this output node (parity: list_attr)."""
@@ -361,25 +366,36 @@ def _auto_name(op):
     return f"{op}{c}"
 
 
-def var(name, shape=None, dtype=None, init=None, attr=None, **kwargs):
+def var(name, shape=None, dtype=None, init=None, attr=None,
+        lr_mult=None, wd_mult=None, **kwargs):
     """Create a symbolic variable (parity: mx.sym.var/Variable).
 
     ``attr`` plus the enclosing AttrScope's attributes are stored on
     the node under the reserved ``__uattr__`` key (JSON round-trips;
-    execution ignores ``__``-prefixed attrs)."""
+    execution ignores ``__``-prefixed attrs). Like the reference,
+    extra kwargs must use the dunder spelling (``__k__``); anything
+    else is a ValueError, not a silently-persisted typo."""
     from .. import attribute as _attribute
     attrs = {}
     if shape is not None:
         attrs["__shape__"] = list(shape)
     if dtype is not None:
         attrs["__dtype__"] = str(onp.dtype(dtype))
-    uattr = _attribute.current().get(attr)
+    # copy: AttrScope.get may return the caller's dict by reference
+    uattr = dict(_attribute.current().get(attr))
+    if lr_mult is not None:
+        uattr["__lr_mult__"] = str(lr_mult)
+    if wd_mult is not None:
+        uattr["__wd_mult__"] = str(wd_mult)
     for k, v in kwargs.items():
-        # reference: extra var kwargs (lr_mult, wd_mult, ...) become
-        # string attributes with a __<k>__ spelling
-        uattr[f"__{k}__"] = str(v)
+        if not (k.startswith("__") and k.endswith("__")):
+            raise ValueError(
+                f"Attribute name={k} is not supported. Additional "
+                "attributes must start and end with double "
+                "underscores, e.g. __yourattr__")
+        uattr[k] = str(v)
     if uattr:
-        attrs["__uattr__"] = dict(uattr)
+        attrs["__uattr__"] = uattr
     node = _Node("null", name, [], attrs)
     return Symbol([node], [(0, 0)])
 
@@ -445,9 +461,10 @@ def _compose(op, inputs, name=None, **attrs):
         in_entries = [fix(e) for e in in_entries]
 
     from .. import attribute as _attribute
-    _scope_attrs = _attribute.current().get(None)
+    _explicit_attr = attrs.pop("attr", None)
+    _scope_attrs = dict(_attribute.current().get(_explicit_attr))
     if _scope_attrs:
-        attrs = {**attrs, "__uattr__": dict(_scope_attrs)}
+        attrs = {**attrs, "__uattr__": _scope_attrs}
     node = _Node(op, name or _auto_name(op), in_entries, attrs)
     nodes = nodes + [node]
     n_out = attrs.get("__num_outputs__", 1)

@@ -226,3 +226,23 @@ def test_attr_scope_and_symbol_attrs():
     # round trip
     back = mx.sym.load_json(composed.tojson())
     assert back.attr_dict()["gdata"]["group"] == "4"
+
+
+def test_attr_hardening():
+    """Review regressions: caller dict not mutated; dunder fallback;
+    typo'd kwargs rejected; per-op attr= supported and executable."""
+    import mxnet_tpu as mx
+
+    cfg = {"group": "g1"}
+    w = mx.sym.var("w", attr=cfg, lr_mult=2)
+    assert cfg == {"group": "g1"}
+    assert w.attr("__lr_mult__") == "2"
+    assert mx.sym.var("d", shape=(2, 3)).attr("__shape__") == [2, 3]
+    with pytest.raises(ValueError):
+        mx.sym.var("w2", shap=(2, 2))
+    x = mx.sym.Variable("x")
+    y = mx.symbol.relu(x, attr={"__init__": "0"})
+    assert y.attr("__init__") == "0"
+    out = y.eval(x=mx.np.array([1.0, -1.0]))
+    got = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    onp.testing.assert_allclose(got, [1.0, 0.0])

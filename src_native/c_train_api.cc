@@ -249,6 +249,21 @@ def iter_reset(h):
 
 def iter_free(h):
     _dataiters.pop(h, None)
+
+
+# ---- Profiler (parity: MXSetProfilerConfig/MXSetProfilerState/
+# MXDumpProfile, c_api_profile.cc) ----
+
+def profiler_set_config(filename):
+    _mx.profiler.set_config(filename=filename)
+
+
+def profiler_set_state(state):
+    _mx.profiler.set_state(state)
+
+
+def profiler_dump():
+    _mx.profiler.dump()
 )PY";
 
 PyObject* g_helper = nullptr;
@@ -866,6 +881,45 @@ int MXTPUNDArrayScalar(int h, double* out) {
     capture_py_error("MXTPUNDArrayScalar");
   }
   PyGILState_Release(gs);
+  return rc;
+}
+
+}  // extern "C"
+
+extern "C" {
+
+int MXTPUSetProfilerConfig(const char* filename) {
+  if (ensure_init()) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fn = helper_fn("profiler_set_config");
+  PyObject* r = fn ? PyObject_CallFunction(fn, "s", filename) : nullptr;
+  Py_XDECREF(fn);
+  int rc = call_ret_void("MXTPUSetProfilerConfig", r);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int MXTPUSetProfilerState(int state) {
+  // 0 = stop, 1 = run (parity: MXSetProfilerState)
+  if (ensure_init()) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fn = helper_fn("profiler_set_state");
+  PyObject* r = fn ? PyObject_CallFunction(
+      fn, "s", state ? "run" : "stop") : nullptr;
+  Py_XDECREF(fn);
+  int rc = call_ret_void("MXTPUSetProfilerState", r);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int MXTPUDumpProfile() {
+  if (ensure_init()) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fn = helper_fn("profiler_dump");
+  PyObject* r = fn ? PyObject_CallFunction(fn, nullptr) : nullptr;
+  Py_XDECREF(fn);
+  int rc = call_ret_void("MXTPUDumpProfile", r);
+  PyGILState_Release(g);
   return rc;
 }
 

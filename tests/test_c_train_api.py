@@ -152,3 +152,57 @@ def test_cpp_cachedop_deploy_matches_python(built, tmp_path):
     assert len(c_logits) == len(py_logits)
     for a, b in zip(c_logits, py_logits):
         assert abs(a - b) < 1e-5, (c_logits, py_logits)
+
+
+def test_c_profiler_family(built, tmp_path):
+    """MXTPUSetProfilerConfig/State/DumpProfile: a C host can produce
+    a trace dump around C-ABI compute (parity: c_api_profile.cc)."""
+    import sysconfig as _sc
+    d = os.path.dirname(built)
+    src = tmp_path / "prof_main.cc"
+    trace_dir = tmp_path / "prof"
+    src.write_text(r"""
+#include <cstdint>
+#include <cstdio>
+extern "C" {
+int MXTPUTrainInit();
+int MXTPUSetProfilerConfig(const char*);
+int MXTPUSetProfilerState(int);
+int MXTPUDumpProfile();
+int MXTPUNDArrayCreate(const float*, const int64_t*, int, int*);
+int MXTPUImperativeInvoke(const char*, const int*, int, const char*,
+                          int*, int, int*);
+}
+int main(int argc, char** argv) {
+  if (MXTPUTrainInit()) return 1;
+  if (MXTPUSetProfilerConfig(argv[1])) return 2;
+  if (MXTPUSetProfilerState(1)) return 3;
+  float data[6] = {1, 2, 3, 4, 5, 6};
+  int64_t shape[2] = {2, 3};
+  int h = -1;
+  if (MXTPUNDArrayCreate(data, shape, 2, &h) || h < 0) return 4;
+  int outs[4]; int n_out = 0;
+  if (MXTPUImperativeInvoke("tanh", &h, 1, "{}", outs, 4, &n_out))
+    return 5;
+  if (MXTPUSetProfilerState(0)) return 6;
+  if (MXTPUDumpProfile()) return 7;
+  printf("profiled ok\n");
+  return 0;
+}
+""")
+    libdir = _sc.get_config_var("LIBDIR") or "/usr/local/lib"
+    exe = str(tmp_path / "prof_main")
+    r = subprocess.run(
+        ["g++", "-O2", str(src), "-o", exe, f"-L{d}", "-lmxtpu_train",
+         f"-Wl,-rpath,{d}", f"-Wl,-rpath,{libdir}"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[:400]
+    env = dict(os.environ)
+    env["MXTPU_PLATFORM"] = "cpu"
+    env["PYTHONPATH"] = ROOT
+    r = subprocess.run([exe, str(trace_dir / "trace.json")],
+                       capture_output=True, text=True, timeout=300,
+                       env=env)
+    assert r.returncode == 0, (r.returncode, r.stdout, r.stderr[:400])
+    assert "profiled ok" in r.stdout
+    assert trace_dir.exists()

@@ -406,6 +406,29 @@ def _is_measurement(line):
     return d.get("metric") != "bench_error" and (d.get("value") or 0) > 0
 
 
+class _SupervisorPause:
+    """Hold bench_runs/r5/PAUSE while the live bench runs so the
+    always-on supervisor doesn't race this process for the chip."""
+
+    def __init__(self):
+        self._path = os.path.join(os.path.dirname(STAGED_BEST), "PAUSE")
+
+    def __enter__(self):
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            with open(self._path, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass
+        return self
+
+    def __exit__(self, *exc):
+        try:
+            os.unlink(self._path)
+        except OSError:
+            pass
+
+
 def _run_guarded():
     """Run the whole benchmark in a child with a hard timeout.
 
@@ -488,7 +511,8 @@ def _run_guarded():
 def main():
     # Parent mode: delegate to a watchdogged child (see _run_guarded).
     if os.environ.get("BENCH_CHILD") != "1":
-        return _run_guarded()
+        with _SupervisorPause():
+            return _run_guarded()
 
     # Honor an explicit platform request (local CPU runs) by pinning
     # via jax.config before any backend init (the axon TPU plugin

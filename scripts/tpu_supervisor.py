@@ -208,6 +208,18 @@ def main():
         pending = [s for s in STAGES if s[0] not in best]
         period = PROBE_PERIOD_S if pending else IDLE_PERIOD_S
 
+        # the driver's end-of-round live bench owns the chip while
+        # bench_runs/r5/PAUSE exists (bench.py parent writes it):
+        # don't race it with probes or stages
+        pause = os.path.join(RUN_DIR, "PAUSE")
+        if os.path.exists(pause):
+            if time.time() - os.path.getmtime(pause) > 3600:
+                os.unlink(pause)  # stale: a killed bench never cleaned
+            else:
+                log_event({"event": "paused"})
+                time.sleep(30)
+                continue
+
         n_probe += 1
         t0 = time.monotonic()
         alive, parsed = probe(PROBE_TIMEOUT_S, n=n_probe)

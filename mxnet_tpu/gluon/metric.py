@@ -557,3 +557,51 @@ def np_metric(**kwargs):
     def decorator(feval):
         return CustomMetric(feval, name=feval.__name__, **kwargs)
     return decorator
+
+
+def check_label_shapes(labels, preds, wrap=False, shape=False):
+    """Validate labels/preds agreement (parity: gluon/metric.py:33):
+    length check by default, full shape check with shape=True; wrap
+    single arrays into lists with wrap=True."""
+    if not shape:
+        label_shape, pred_shape = len(labels), len(preds)
+    else:
+        label_shape, pred_shape = labels.shape, preds.shape
+    if label_shape != pred_shape:
+        raise ValueError(f"Shape of labels {label_shape} does not "
+                         f"match shape of predictions {pred_shape}")
+    if wrap:
+        if isinstance(labels, NDArray):
+            labels = [labels]
+        if isinstance(preds, NDArray):
+            preds = [preds]
+    return labels, preds
+
+
+def predict_with_threshold(pred, threshold=0.5):
+    """Threshold binary/multilabel predictions (parity:
+    gluon/metric.py:524)."""
+    if isinstance(threshold, float):
+        return pred > threshold
+    if isinstance(threshold, (onp.ndarray, NDArray)):
+        num_classes = pred.shape[-1]
+        assert threshold.shape[-1] == num_classes, \
+            f"shape mismatch: {pred.shape[-1]} vs. {threshold.shape[-1]}"
+        return pred > threshold
+    raise ValueError(f"{type(threshold)} is a wrong type for threshold!")
+
+
+def one_hot(idx, num):
+    """(parity: gluon/metric.py:546)"""
+    idx = idx.asnumpy() if isinstance(idx, NDArray) else onp.asarray(idx)
+    return (onp.arange(num) == idx[:, None]).astype("int32")
+
+
+def np(numpy_feval, name=None, allow_extra_outputs=False):
+    """Create a CustomMetric from a numpy feval (parity:
+    gluon/metric.py:1835 — deprecated but load-bearing alias)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = getattr(numpy_feval, "__name__", "feval")
+    return CustomMetric(feval, name or feval.__name__,
+                        allow_extra_outputs)

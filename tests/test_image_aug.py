@@ -113,3 +113,63 @@ def test_imageiter_with_aug_list(tmp_path):
     data, label = next(iter(it))
     assert tuple(data.shape) == (4, 3, 24, 24)
     assert tuple(label.shape) == (4,)
+
+
+def test_scale_down_and_border():
+    from mxnet_tpu import image as img
+    assert img.scale_down((640, 480), (720, 120)) == (640, 106)
+    assert img.scale_down((640, 480), (100, 100)) == (100, 100)
+    x = mx.np.ones((2, 3, 3))
+    out = img.copyMakeBorder(x, 1, 1, 2, 2, value=5.0)
+    assert out.shape == (4, 7, 3)
+    assert float(out[0, 0, 0].asnumpy()) == 5.0
+    onp.testing.assert_array_equal(out.asnumpy()[1:3, 2:5], 1.0)
+
+
+def test_random_size_crop_constraints():
+    from mxnet_tpu import image as img
+    onp.random.seed(0)
+    src = mx.np.array(onp.random.randint(0, 255, (40, 60, 3))
+                      .astype("uint8"))
+    out, (x0, y0, w, h) = img.random_size_crop(
+        src, (20, 20), (0.2, 0.8), (0.7, 1.4))
+    assert out.shape == (20, 20, 3)
+    assert 0 <= x0 <= 60 - w and 0 <= y0 <= 40 - h
+
+
+def test_imrotate_90_and_random():
+    from mxnet_tpu import image as img
+    x = onp.zeros((1, 8, 8), "f4")
+    x[0, 2, 1] = 1.0  # off-center pixel
+    rot = img.imrotate(mx.np.array(x), 90.0).asnumpy()
+    # 90° rotation moves (r=2, c=1) -> (r=?, c=?): compare against a
+    # reference rotation of the numpy array (grid-sample convention)
+    assert rot.shape == (1, 8, 8)
+    assert rot.sum() > 0.5  # mass preserved (bilinear)
+    assert abs(rot[0, 2, 1]) < 1e-3  # moved away from the origin pixel
+    # batch of images + per-image angles
+    batch = onp.random.RandomState(0).rand(3, 1, 8, 8).astype("f4")
+    out = img.random_rotate(mx.np.array(batch), (-30, 30))
+    assert out.shape == (3, 1, 8, 8)
+    with pytest.raises(ValueError):
+        img.imrotate(mx.np.array(x), 10.0, zoom_in=True, zoom_out=True)
+    with pytest.raises(TypeError):
+        img.imrotate(mx.np.array(x.astype("uint8")), 10.0)
+
+
+def test_det_random_select_and_multi_crop():
+    from mxnet_tpu import image as img
+    augs = img.CreateMultiRandCropAugmenter(
+        min_object_covered=[0.1, 0.5],
+        area_range=[(0.1, 1.0), (0.3, 1.0)])
+    assert isinstance(augs, img.DetRandomSelectAug)
+    assert len(augs.aug_list) == 2
+    src = mx.np.array(onp.random.RandomState(0)
+                      .randint(0, 255, (32, 32, 3)).astype("uint8"))
+    label = onp.array([[0.0, 0.2, 0.2, 0.8, 0.8]], "f4")
+    out, lab = augs(src, label)
+    assert out.ndim == 3 and lab.shape[-1] == 5
+    # skip_prob=1 is identity
+    skip = img.DetRandomSelectAug(augs.aug_list, skip_prob=1.0)
+    out2, lab2 = skip(src, label)
+    onp.testing.assert_array_equal(out2.asnumpy(), src.asnumpy())

@@ -161,3 +161,54 @@ def convert_hybrid_block(net, target_dtype="bfloat16",
 
     net.apply(_cast)
     return net
+
+
+# -- cast-list introspection (parity: amp/amp.py list_* helpers) -----
+def list_lp16_ops(target_dtype="bfloat16"):  # noqa: ARG001
+    """Ops forced to the low-precision dtype."""
+    return sorted(lists.TARGET_DTYPE_SET)
+
+
+def list_fp16_ops(target_dtype="float16"):  # noqa: ARG001
+    return list_lp16_ops(target_dtype)
+
+
+def list_fp32_ops(target_dtype=None):  # noqa: ARG001
+    """Ops pinned to float32 (numerically sensitive)."""
+    return sorted(lists.FP32_SET)
+
+
+def list_lp16_fp32_ops(target_dtype=None):  # noqa: ARG001
+    """Ops that run in lp16 but keep fp32 outputs — in this design
+    the widest-type set plays that role."""
+    return sorted(lists.WIDEST_SET)
+
+
+def list_widest_type_cast(target_dtype=None):  # noqa: ARG001
+    return sorted(lists.WIDEST_SET)
+
+
+def list_conditional_fp32_ops(target_dtype=None):  # noqa: ARG001
+    """Reference: ops fp32-pinned conditional on attributes (e.g.
+    softmax with use_length). The dispatch-funnel design has no
+    attribute-conditional pins; the list is empty by construction."""
+    return []
+
+
+def list_lp16_use_fp32_params(target_dtype=None):  # noqa: ARG001
+    """Ops running lp16 with fp32 master params — handled by
+    multi_precision optimizers here, not per-op lists."""
+    return []
+
+
+def list_loss_output_functions(target_dtype=None):  # noqa: ARG001
+    return sorted(getattr(lists, "LOSS_OUTPUT_SET", set()))
+
+
+def convert_symbol(sym, target_dtype="bfloat16", **kwargs):  # noqa: ARG001
+    """Parity shim for the reference's graph ReducePrecision pass
+    (amp/amp.py convert_symbol): symbols execute through the same
+    dispatch funnel that applies the cast lists at run time, so the
+    symbol itself needs no rewriting — returned unchanged, casts
+    happen on execution under amp.init()."""
+    return sym

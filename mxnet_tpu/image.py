@@ -669,10 +669,13 @@ class DetRandomCropAug(DetAugmenter):
             cx = onp.random.uniform(0, 1 - cw)
             crop = (cx, cy, cx + cw, cy + ch)
             cover = self._overlap(label, crop)
-            if not (cover >= self.min_object_covered).any():
+            # reference acceptance (_check_satisfy_constraints): every
+            # box that overlaps the crop at all must reach
+            # min_object_covered
+            pos = cover[cover > 0]
+            if pos.size == 0 or pos.min() < self.min_object_covered:
                 continue
-            # eject marginal boxes; require every SURVIVOR to satisfy
-            # min_object_covered (reference crop acceptance)
+            # then eject surviving boxes whose coverage is marginal
             keep = cover >= max(self.min_eject_coverage, 1e-12)
             if not keep.any():
                 continue

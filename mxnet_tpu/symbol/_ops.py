@@ -357,3 +357,15 @@ def op_table():
             mx.np.full(tuple(shape), value, dtype=dtype)
         _TABLE = table
     return _TABLE
+
+
+def split_v2(data, indices_or_sections, axis=0, squeeze_axis=False,
+             name=None):
+    """Parity: sym.split_v2 — np.split semantics plus squeeze_axis
+    (each section of size 1 drops the split axis)."""
+    out = split(data, indices_or_sections, axis=axis, name=name)
+    if squeeze_axis:
+        from .symbol import Group
+        # split returns a multi-output Symbol: squeeze EVERY section
+        return Group([o.squeeze(axis=axis) for o in out])
+    return out

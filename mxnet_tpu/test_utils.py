@@ -309,3 +309,459 @@ def download(url, fname=None, dirname=None, overwrite=False,
             (overwrite or not os.path.exists(fname)):
         shutil.copyfile(src, fname)
     return fname
+
+
+# ---------------------------------------------------------------------------
+# Reference test_utils long tail (parity: python/mxnet/test_utils.py —
+# the helpers reference operator/optimizer/random tests are written
+# against, so those tests port verbatim). Download-backed dataset
+# helpers (get_mnist/get_cifar10/...) are intentionally absent: no
+# egress here; gluon.data.vision datasets read local files instead.
+# ---------------------------------------------------------------------------
+assert_allclose = onp.testing.assert_allclose
+
+
+def default_numeric_eps(dtype=onp.float32):
+    return {onp.float16: 1e-2, onp.float32: 1e-4,
+            onp.float64: 1e-6}.get(onp.dtype(dtype).type, 1e-4)
+
+
+_DEFAULT_RTOL = {onp.float16: 1e-2, onp.float32: 1e-4,
+                 onp.float64: 1e-6}
+_DEFAULT_ATOL = {onp.float16: 1e-3, onp.float32: 1e-5,
+                 onp.float64: 1e-7}
+
+
+def get_rtol(x=None, y=None, rtol=None):
+    if rtol is not None:
+        return rtol
+    if x is None and y is None:
+        return 1e-4  # reference default (float32)
+    dt = effective_dtype(x if x is not None else y)
+    return _DEFAULT_RTOL.get(onp.dtype(dt).type, 1e-4)
+
+
+def get_atol(x=None, y=None, atol=None):
+    if atol is not None:
+        return atol
+    if x is None and y is None:
+        return 1e-5  # reference default (float32)
+    dt = effective_dtype(x if x is not None else y)
+    return _DEFAULT_ATOL.get(onp.dtype(dt).type, 1e-5)
+
+
+def get_etol(etol=None):
+    return 0.0 if etol is None else etol
+
+
+def get_tolerance(x, rtol, atol):
+    return get_rtol(x, None, rtol), get_atol(x, None, atol)
+
+
+def get_tols(x, y, rtol=None, atol=None):
+    """Coarsest tolerances implied by the operand dtypes (parity:
+    test_utils.py:154)."""
+    rt = max(get_rtol(x, None, rtol), get_rtol(y, None, rtol))
+    at = max(get_atol(x, None, atol), get_atol(y, None, atol))
+    return rt, at
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=("a", "b")):
+    """Elementwise compare skipping positions that are NaN in BOTH."""
+    a_np, b_np = _to_numpy(a).copy(), _to_numpy(b).copy()
+    nan_mask = onp.logical_and(onp.isnan(a_np), onp.isnan(b_np))
+    a_np[nan_mask] = 0
+    b_np[nan_mask] = 0
+    assert_almost_equal(a_np, b_np, rtol=rtol, atol=atol, names=names)
+
+
+def assert_almost_equal_with_err(a, b, rtol=None, atol=None,
+                                 etol=None, names=("a", "b")):
+    """Like assert_almost_equal but tolerating a fraction `etol` of
+    mismatched elements (parity: test_utils.py)."""
+    etol = get_etol(etol)
+    a_np, b_np = _to_numpy(a), _to_numpy(b)
+    rt, at = get_tols(a_np, b_np, rtol, atol)
+    bad = onp.abs(a_np - b_np) > at + rt * onp.abs(b_np)
+    frac = bad.sum() / max(bad.size, 1)
+    if frac > etol:
+        assert_almost_equal(a_np, b_np, rtol=rt, atol=at, names=names)
+
+
+def assert_exception(f, exception_type, *args, **kwargs):
+    """f(*args) must raise exception_type (parity helper)."""
+    try:
+        f(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError("Did not raise %s" % exception_type.__name__)
+
+
+def same_array(array1, array2):
+    """True when two NDArrays share storage: mutating one must show
+    through the other (functional backend: same underlying buffer)."""
+    if array1 is array2:
+        return True
+    return getattr(array1, "_data", 1) is getattr(array2, "_data", 2)
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Apply a numpy reduction with mxnet axis/keepdims semantics
+    (parity: test_utils.py np_reduce)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def assign_each(input_, function):
+    return onp.vectorize(function)(_to_numpy(input_))
+
+
+def assign_each2(input1, input2, function):
+    return onp.vectorize(function)(_to_numpy(input1),
+                                   _to_numpy(input2))
+
+
+def collapse_sum_like(a, shape):
+    """Sum-reduce `a` down to `shape` (gradient of broadcasting)."""
+    a = _to_numpy(a)
+    extra = a.ndim - len(shape)
+    if extra:
+        a = a.sum(axis=tuple(range(extra)))
+    axes = tuple(i for i, (da, ds) in enumerate(zip(a.shape, shape))
+                 if ds == 1 and da != 1)
+    if axes:
+        a = a.sum(axis=axes, keepdims=True)
+    return a.reshape(shape)
+
+
+def create_vector(size, dtype=onp.int64):
+    """0..size-1 vector (large-tensor test helper)."""
+    from . import numpy as mxnp_
+    return mxnp_.arange(size, dtype=dtype)
+
+
+def create_2d_tensor(rows, columns, dtype=onp.int64):
+    from . import numpy as mxnp_
+    return mxnp_.arange(rows * columns, dtype=dtype).reshape(
+        rows, columns)
+
+
+create_2d_np_tensor = create_2d_tensor
+
+
+def rand_coord_2d(x_low, x_high, y_low, y_high):
+    x = onp.random.randint(x_low, x_high)
+    y = onp.random.randint(y_low, y_high)
+    return x, y
+
+
+def random_sample(population, k):
+    """Sample k without replacement preserving order-independence."""
+    population_copy = list(population)
+    onp.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def random_uniform_arrays(*shapes, **kwargs):
+    low = kwargs.pop("low", 0.0)
+    high = kwargs.pop("high", 1.0)
+    dtype = kwargs.pop("dtype", onp.float32)
+    return [onp.random.uniform(low, high, size=s).astype(dtype)
+            for s in shapes]
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        distribution="uniform"):
+    """Random sparse NDArray + its dense numpy mirror (parity:
+    test_utils.py rand_sparse_ndarray, uniform distribution)."""
+    from . import numpy as mxnp_
+    from .ndarray import sparse as sp
+    density = onp.random.rand() if density is None else density
+    dtype = dtype or onp.float32
+    dense = onp.random.uniform(-1, 1, size=shape).astype(dtype)
+    if stype == "row_sparse":
+        keep = onp.random.uniform(size=shape[0]) < density
+        dense[~keep] = 0
+        arr = sp.row_sparse_array(mxnp_.array(dense))
+    elif stype == "csr":
+        mask = onp.random.uniform(size=shape) < density
+        dense = dense * mask
+        arr = sp.csr_matrix(mxnp_.array(dense))
+    else:
+        raise ValueError(f"unknown stype {stype}")
+    return arr, dense
+
+
+def create_sparse_array(shape, stype, data_init=None, rsp_indices=None,
+                        dtype=None, modifier_func=None, density=0.5,
+                        shuffle_csr_indices=False):
+    arr, _ = rand_sparse_ndarray(shape, stype, density=density,
+                                 dtype=dtype)
+    return arr
+
+
+def create_sparse_array_zd(shape, stype, density, data_init=None,
+                           rsp_indices=None, dtype=None,
+                           modifier_func=None,
+                           shuffle_csr_indices=False):
+    return create_sparse_array(shape, stype, density=density,
+                               dtype=dtype)
+
+
+def shuffle_csr_column_indices(csr):
+    """Parity no-op: our CSR lowering keeps indices sorted by
+    construction (gather/segment-sum requires it)."""
+    return csr
+
+
+def compare_ndarray_tuple(t1, t2, rtol=None, atol=None):
+    if t1 is None or t2 is None:
+        return
+    if isinstance(t1, tuple):
+        for s1, s2 in zip(t1, t2):
+            compare_ndarray_tuple(s1, s2, rtol, atol)
+    else:
+        assert_almost_equal(t1, t2, rtol=rtol, atol=atol)
+
+
+def compare_optimizer(opt1, opt2, shapes, dtype, w_stype="default",
+                      g_stype="default", rtol=1e-4, atol=1e-5,
+                      compare_states=True):
+    """Run one update with two optimizers from identical weights/
+    grads; final weights (and states) must agree (parity:
+    test_utils.py:2246, dense path)."""
+    from . import numpy as mxnp_
+    if not isinstance(shapes, list):
+        shapes = [shapes]
+    w1, w2, g1, g2 = [], [], [], []
+    for s in shapes:
+        w = onp.random.uniform(-1, 1, size=s).astype(dtype)
+        g = onp.random.uniform(-1, 1, size=s).astype(dtype)
+        w1.append(mxnp_.array(w)); w2.append(mxnp_.array(w.copy()))
+        g1.append(mxnp_.array(g)); g2.append(mxnp_.array(g.copy()))
+    from .optimizer import Updater
+    u1, u2 = Updater(opt1), Updater(opt2)
+    for i in range(len(shapes)):
+        u1(i, g1[i], w1[i])
+        u2(i, g2[i], w2[i])
+    for a, b in zip(w1, w2):
+        assert_almost_equal(a, b, rtol=rtol, atol=atol)
+    if compare_states:
+        for i in range(len(shapes)):
+            compare_ndarray_tuple(
+                tuple(x for x in onp.atleast_1d(u1.states.get(i))
+                      if hasattr(x, "shape")) or None,
+                tuple(x for x in onp.atleast_1d(u2.states.get(i))
+                      if hasattr(x, "shape")) or None, rtol, atol)
+
+
+def compare_optimizer_noise_seeded(opt1, opt2, shapes, dtype, seed,
+                                   **kwargs):
+    onp.random.seed(seed)
+    from . import numpy as mxnp_
+    mxnp_.random.seed(seed)
+    compare_optimizer(opt1, opt2, shapes, dtype, **kwargs)
+
+
+def check_gluon_hybridize_consistency(net_builder, data_l,
+                                      numpy_func=None, test_grad=True,
+                                      rtol=1e-4, atol=1e-4):
+    """Eager vs hybridized forward (and input grads) must agree
+    (parity: test_utils.py check_gluon_hybridize_consistency)."""
+    from . import autograd
+    saved_out_np = saved_grad_np = None
+    saved_params = None
+    for hybridize in (False, True):
+        net = net_builder()
+        net.initialize()
+        if saved_params is None:
+            # both nets must hold IDENTICAL weights — copy the first
+            # build's parameters into the second
+            saved_params = {k: p.data().copy() for k, p in
+                            net.collect_params().items()}
+        else:
+            for k, p in net.collect_params().items():
+                p.set_data(saved_params[k])
+        if hybridize:
+            net.hybridize()
+        ins = [x.copy() for x in data_l]
+        for x in ins:
+            x.attach_grad()
+        with autograd.record():
+            out = net(*ins)
+        if test_grad:
+            out.backward()
+        out_np = _to_numpy(out)
+        if saved_out_np is None:
+            saved_out_np = out_np
+            if test_grad:
+                saved_grad_np = [_to_numpy(x.grad) for x in ins]
+        else:
+            assert_almost_equal(out_np, saved_out_np, rtol=rtol,
+                                atol=atol)
+            if test_grad:
+                for g, sg in zip([_to_numpy(x.grad) for x in ins],
+                                 saved_grad_np):
+                    assert_almost_equal(g, sg, rtol=rtol, atol=atol)
+    if numpy_func is not None:
+        assert_almost_equal(saved_out_np,
+                            numpy_func(*[_to_numpy(x)
+                                         for x in data_l]),
+                            rtol=rtol, atol=atol)
+
+
+def same_symbol_structure(sym1, sym2):
+    """Graphs equal node-for-node (op + arity), names ignored."""
+    n1, n2 = sym1._nodes, sym2._nodes
+    if len(n1) != len(n2):
+        return False
+    for a, b in zip(n1, n2):
+        if a.op != b.op or len(a.inputs) != len(b.inputs):
+            return False
+    return True
+
+
+class DummyIter:
+    """Infinite iterator repeating one batch of another iterator
+    (IO-bound benchmarking helper; parity: test_utils.py DummyIter)."""
+
+    def __init__(self, real_iter):
+        self.real_iter = real_iter
+        self.provide_data = real_iter.provide_data
+        self.provide_label = real_iter.provide_label
+        self.batch_size = real_iter.batch_size
+        self.the_batch = next(real_iter)
+
+    def __iter__(self):
+        return self
+
+    def next(self):
+        return self.the_batch
+
+    __next__ = next
+
+
+def check_speed(sym=None, location=None, func=None, N=20, **kwargs):
+    """Wall-clock per-iteration of a callable or bound symbol."""
+    import time as _time
+    if func is None:
+        ex = sym.bind(None, location)
+
+        def func():
+            ex.forward()
+    func()  # warmup/compile
+    tic = _time.time()
+    for _ in range(N):
+        func()
+    from . import engine
+    engine.waitall()
+    return (_time.time() - tic) / N
+
+
+def set_default_context(ctx):
+    set_default_device(ctx)
+
+
+def locationError(a, b, index, names):
+    return (f"Location of maximum error: {index}, "
+            f"{names[0]}={a[index]:.8f}, {names[1]}={b[index]:.8f}")
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a percent-point function
+    (parity: test_utils.py — feeds chi_square_check)."""
+    probs = [1.0 / nbuckets] * nbuckets
+    buckets = [(ppf(i / nbuckets), ppf((i + 1) / nbuckets))
+               for i in range(nbuckets)]
+    return buckets, probs
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1000000):
+    """Pearson chi-square fit of generator samples against expected
+    bucket probabilities (parity: test_utils.py:2107). Buckets are
+    (lo, hi) ranges (continuous) or exact values (discrete)."""
+    from scipy import stats as sps_stats
+    samples = onp.asarray(_to_numpy(generator(nsamples))).ravel()
+    counts = onp.zeros(len(buckets))
+    if isinstance(buckets[0], (tuple, list)):
+        for i, (lo, hi) in enumerate(buckets):
+            counts[i] = ((samples >= lo) & (samples < hi)).sum()
+    else:
+        for i, v in enumerate(buckets):
+            counts[i] = (samples == v).sum()
+    # normalize expectations to the IN-BUCKET sample count: samples
+    # outside every bucket (tails/unexpected values) must degrade the
+    # fit, not crash scipy's sum-agreement check
+    probs = onp.asarray(probs, dtype=onp.float64)
+    expected = probs / probs.sum() * counts.sum()
+    if counts.sum() == 0:
+        return onp.inf, 0.0, counts
+    chi2, pvalue = sps_stats.chisquare(counts, expected)
+    return chi2, pvalue, counts
+
+
+def mean_check(generator, mu, sigma, nsamples=1000000):
+    samples = onp.asarray(_to_numpy(generator(nsamples))).ravel()
+    return abs(samples.mean() - mu) < 5 * sigma / onp.sqrt(
+        len(samples))
+
+
+def var_check(generator, sigma, nsamples=1000000):
+    samples = onp.asarray(_to_numpy(generator(nsamples))).ravel()
+    return abs(samples.var() - sigma ** 2) < 0.2 * sigma ** 2
+
+
+def verify_generator(generator, buckets, probs, nsamples=1000000,
+                     nrepeat=5, success_rate=0.2, alpha=0.05):
+    """Repeat the chi-square fit; the success fraction must reach
+    success_rate (parity: test_utils.py:2185). Returns the number of
+    successes."""
+    cs_ret_l = []
+    for _ in range(nrepeat):
+        _, pvalue, _ = chi_square_check(generator, buckets, probs,
+                                        nsamples=nsamples)
+        cs_ret_l.append(pvalue)
+    success_num = (onp.asarray(cs_ret_l) > alpha).sum()
+    if success_num < nrepeat * success_rate:
+        raise AssertionError(
+            f"Generator test fails, Chi-square p={cs_ret_l}, "
+            f"successes {success_num}/{nrepeat}")
+    return success_num
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True, dtype=onp.float32):
+    """Central finite differences of a bound Executor's scalar-summed
+    output w.r.t. each argument (parity: test_utils.py:970)."""
+    grads = {}
+    for name, arr in location.items():
+        base = _to_numpy(arr).astype(onp.float64)
+        g = onp.zeros_like(base)
+        flat = base.ravel()
+        gflat = g.ravel()
+        for i in range(flat.size):
+            saved = flat[i]
+            for sign in (1.0, -1.0):
+                flat[i] = saved + sign * eps
+                executor.arg_dict[name][:] = base.astype(dtype)
+                out = executor.forward(is_train=use_forward_train)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                val = sum(float(_to_numpy(o).sum()) for o in outs)
+                gflat[i] += sign * val
+            flat[i] = saved
+            gflat[i] /= 2 * eps
+        executor.arg_dict[name][:] = base.astype(dtype)
+        grads[name] = g.astype(dtype)
+    return grads

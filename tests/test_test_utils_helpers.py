@@ -1,0 +1,102 @@
+"""The reference's test_utils helper surface works (parity model:
+tests/python/unittest/test_test_utils.py + the helpers' own use
+across the reference suite)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp, test_utils as tu
+
+
+def test_tolerance_helpers():
+    x = onp.ones(3, "f2")
+    rt, at = tu.get_tols(x, onp.ones(3, "f4"))
+    assert rt == 1e-2 and at == 1e-3  # coarsest dtype wins
+    assert tu.default_numeric_eps(onp.float64) == 1e-6
+
+
+def test_assert_variants():
+    a = onp.array([1.0, onp.nan])
+    tu.assert_almost_equal_ignore_nan(a, onp.array([1.0, onp.nan]))
+    with pytest.raises(AssertionError):
+        tu.assert_almost_equal_ignore_nan(a, onp.array([2.0, onp.nan]))
+    # 1 of 4 elements off, etol=0.3 tolerates it
+    tu.assert_almost_equal_with_err(onp.array([1, 2, 3, 9.0]),
+                                    onp.array([1, 2, 3, 4.0]),
+                                    etol=0.3)
+    tu.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    with pytest.raises(AssertionError):
+        tu.assert_exception(lambda: None, ValueError)
+
+
+def test_np_reduce_and_collapse():
+    d = onp.arange(24.0).reshape(2, 3, 4)
+    onp.testing.assert_allclose(
+        tu.np_reduce(d, (0, 2), True, onp.sum),
+        d.sum(axis=(0, 2), keepdims=True))
+    g = tu.collapse_sum_like(onp.ones((4, 3)), (1, 3))
+    onp.testing.assert_allclose(g, onp.full((1, 3), 4.0))
+
+
+def test_sparse_and_tensor_factories():
+    arr, dense = tu.rand_sparse_ndarray((6, 5), "csr", density=0.4)
+    onp.testing.assert_allclose(arr.asnumpy(), dense, rtol=1e-6)
+    arr2, dense2 = tu.rand_sparse_ndarray((6, 5), "row_sparse",
+                                          density=0.5)
+    onp.testing.assert_allclose(arr2.asnumpy(), dense2, rtol=1e-6)
+    v = tu.create_vector(5)
+    assert v.shape == (5,)
+    t = tu.create_2d_tensor(3, 4)
+    assert t.shape == (3, 4)
+
+
+def test_compare_optimizer_same_and_different():
+    tu.compare_optimizer(mx.optimizer.SGD(learning_rate=0.1),
+                         mx.optimizer.SGD(learning_rate=0.1),
+                         [(4, 3)], "float32")
+    with pytest.raises(AssertionError):
+        tu.compare_optimizer(mx.optimizer.SGD(learning_rate=0.1),
+                             mx.optimizer.SGD(learning_rate=0.5),
+                             [(4, 3)], "float32",
+                             compare_states=False)
+
+
+def test_check_gluon_hybridize_consistency():
+    from mxnet_tpu.gluon import nn
+    tu.check_gluon_hybridize_consistency(
+        lambda: nn.Dense(4, in_units=3),
+        [mnp.ones((2, 3))])
+
+
+def test_verify_generator_normal():
+    from scipy import stats
+    buckets, probs = tu.gen_buckets_probs_with_ppf(
+        stats.norm(0, 1).ppf, 10)
+    gen = lambda n: mnp.random.normal(0, 1, size=(n,))
+    assert tu.verify_generator(gen, buckets, probs,
+                               nsamples=100_000, nrepeat=3) >= 1
+    assert tu.mean_check(gen, 0.0, 1.0, nsamples=100_000)
+    assert tu.var_check(gen, 1.0, nsamples=100_000)
+    # a broken generator fails
+    bad = lambda n: mnp.random.normal(2.0, 1, size=(n,))
+    with pytest.raises(AssertionError):
+        tu.verify_generator(bad, buckets, probs, nsamples=50_000,
+                            nrepeat=3)
+
+
+def test_dummy_iter_and_symbol_structure():
+    from mxnet_tpu import io
+    base = io.NDArrayIter(mnp.ones((10, 3)), mnp.ones((10,)),
+                          batch_size=5)
+    dummy = tu.DummyIter(base)
+    b1, b2 = next(dummy), next(dummy)
+    assert b1 is b2  # always the same batch
+    a, b = mx.sym.Variable("a"), mx.sym.Variable("b")
+    assert tu.same_symbol_structure(a * b + a, b * a + b)
+    assert not tu.same_symbol_structure(a * b, a + b)
+
+
+def test_same_array_semantics():
+    x = mnp.ones((3,))
+    assert tu.same_array(x, x)
+    assert not tu.same_array(x, mnp.ones((3,)))

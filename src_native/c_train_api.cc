@@ -264,6 +264,14 @@ def profiler_set_state(state):
 
 def profiler_dump():
     _mx.profiler.dump()
+
+
+def nd_wait_to_read(h):
+    _arrays[h].wait_to_read()
+
+
+def wait_all():
+    _mx.nd.waitall()
 )PY";
 
 PyObject* g_helper = nullptr;
@@ -881,6 +889,32 @@ int MXTPUNDArrayScalar(int h, double* out) {
     capture_py_error("MXTPUNDArrayScalar");
   }
   PyGILState_Release(gs);
+  return rc;
+}
+
+
+int MXTPUNDArrayWaitToRead(int h) {
+  // parity: MXNDArrayWaitToRead — blocks until h's value is ready,
+  // re-raising any deferred device error
+  if (ensure_init()) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fn = helper_fn("nd_wait_to_read");
+  PyObject* r = fn ? PyObject_CallFunction(fn, "i", h) : nullptr;
+  Py_XDECREF(fn);
+  int rc = call_ret_void("MXTPUNDArrayWaitToRead", r);
+  PyGILState_Release(g);
+  return rc;
+}
+
+int MXTPUNDArrayWaitAll() {
+  // parity: MXNDArrayWaitAll — engine barrier + deferred-error drain
+  if (ensure_init()) return -1;
+  PyGILState_STATE g = PyGILState_Ensure();
+  PyObject* fn = helper_fn("wait_all");
+  PyObject* r = fn ? PyObject_CallFunction(fn, nullptr) : nullptr;
+  Py_XDECREF(fn);
+  int rc = call_ret_void("MXTPUNDArrayWaitAll", r);
+  PyGILState_Release(g);
   return rc;
 }
 

@@ -169,6 +169,8 @@ int MXTPUTrainInit();
 int MXTPUSetProfilerConfig(const char*);
 int MXTPUSetProfilerState(int);
 int MXTPUDumpProfile();
+int MXTPUNDArrayWaitToRead(int);
+int MXTPUNDArrayWaitAll();
 int MXTPUNDArrayCreate(const float*, const int64_t*, int, int*);
 int MXTPUImperativeInvoke(const char*, const int*, int, const char*,
                           int*, int, int*);
@@ -184,6 +186,8 @@ int main(int argc, char** argv) {
   int outs[4]; int n_out = 0;
   if (MXTPUImperativeInvoke("tanh", &h, 1, "{}", outs, 4, &n_out))
     return 5;
+  if (MXTPUNDArrayWaitToRead(outs[0])) return 8;
+  if (MXTPUNDArrayWaitAll()) return 9;
   if (MXTPUSetProfilerState(0)) return 6;
   if (MXTPUDumpProfile()) return 7;
   printf("profiled ok\n");

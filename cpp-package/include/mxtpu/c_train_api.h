@@ -91,6 +91,15 @@ int MXTPUDataIterNext(int it, int* out_data, int* out_label);
 int MXTPUDataIterReset(int it);
 int MXTPUDataIterFree(int it);
 
+/* ---- profiler (parity: c_api_profile.cc family) ---- */
+int MXTPUSetProfilerConfig(const char* filename);
+int MXTPUSetProfilerState(int state);  /* 0=stop, 1=run */
+int MXTPUDumpProfile();
+
+/* ---- sync (parity: MXNDArrayWaitToRead / MXNDArrayWaitAll) ---- */
+int MXTPUNDArrayWaitToRead(int h);
+int MXTPUNDArrayWaitAll();
+
 #ifdef __cplusplus
 }
 #endif

@@ -893,67 +893,61 @@ int MXTPUNDArrayScalar(int h, double* out) {
 }
 
 
-int MXTPUNDArrayWaitToRead(int h) {
-  // parity: MXNDArrayWaitToRead — blocks until h's value is ready,
-  // re-raising any deferred device error
-  if (ensure_init()) return -1;
-  PyGILState_STATE g = PyGILState_Ensure();
-  PyObject* fn = helper_fn("nd_wait_to_read");
-  PyObject* r = fn ? PyObject_CallFunction(fn, "i", h) : nullptr;
-  Py_XDECREF(fn);
-  int rc = call_ret_void("MXTPUNDArrayWaitToRead", r);
-  PyGILState_Release(g);
-  return rc;
-}
-
-int MXTPUNDArrayWaitAll() {
-  // parity: MXNDArrayWaitAll — engine barrier + deferred-error drain
-  if (ensure_init()) return -1;
-  PyGILState_STATE g = PyGILState_Ensure();
-  PyObject* fn = helper_fn("wait_all");
-  PyObject* r = fn ? PyObject_CallFunction(fn, nullptr) : nullptr;
-  Py_XDECREF(fn);
-  int rc = call_ret_void("MXTPUNDArrayWaitAll", r);
-  PyGILState_Release(g);
-  return rc;
-}
-
 }  // extern "C"
 
 extern "C" {
 
 int MXTPUSetProfilerConfig(const char* filename) {
-  if (ensure_init()) return -1;
-  PyGILState_STATE g = PyGILState_Ensure();
-  PyObject* fn = helper_fn("profiler_set_config");
-  PyObject* r = fn ? PyObject_CallFunction(fn, "s", filename) : nullptr;
-  Py_XDECREF(fn);
-  int rc = call_ret_void("MXTPUSetProfilerConfig", r);
-  PyGILState_Release(g);
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = call_ret_void("MXTPUSetProfilerConfig",
+                         call("profiler_set_config", "(s)", filename));
+  PyGILState_Release(gs);
   return rc;
 }
 
 int MXTPUSetProfilerState(int state) {
   // 0 = stop, 1 = run (parity: MXSetProfilerState)
-  if (ensure_init()) return -1;
-  PyGILState_STATE g = PyGILState_Ensure();
-  PyObject* fn = helper_fn("profiler_set_state");
-  PyObject* r = fn ? PyObject_CallFunction(
-      fn, "s", state ? "run" : "stop") : nullptr;
-  Py_XDECREF(fn);
-  int rc = call_ret_void("MXTPUSetProfilerState", r);
-  PyGILState_Release(g);
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = call_ret_void("MXTPUSetProfilerState",
+                         call("profiler_set_state", "(s)",
+                              state ? "run" : "stop"));
+  PyGILState_Release(gs);
   return rc;
 }
 
 int MXTPUDumpProfile() {
-  if (ensure_init()) return -1;
-  PyGILState_STATE g = PyGILState_Ensure();
-  PyObject* fn = helper_fn("profiler_dump");
-  PyObject* r = fn ? PyObject_CallFunction(fn, nullptr) : nullptr;
-  Py_XDECREF(fn);
-  int rc = call_ret_void("MXTPUDumpProfile", r);
-  PyGILState_Release(g);
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = call_ret_void("MXTPUDumpProfile",
+                         call("profiler_dump", "()"));
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUNDArrayWaitToRead(int h) {
+  // parity: MXNDArrayWaitToRead — blocks until h's value is ready,
+  // re-raising any deferred device error
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = call_ret_void("MXTPUNDArrayWaitToRead",
+                         call("nd_wait_to_read", "(i)", h));
+  PyGILState_Release(gs);
+  return rc;
+}
+
+int MXTPUNDArrayWaitAll() {
+  // parity: MXNDArrayWaitAll — engine barrier + deferred-error drain
+  std::lock_guard<std::mutex> lock(g_mu);
+  if (ensure_init() != 0) return -1;
+  PyGILState_STATE gs = PyGILState_Ensure();
+  int rc = call_ret_void("MXTPUNDArrayWaitAll", call("wait_all", "()"));
+  PyGILState_Release(gs);
   return rc;
 }
 

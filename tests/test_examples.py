@@ -107,3 +107,8 @@ def test_train_ssd():
     out = _run("train_ssd.py", "--steps", "80", "--batch", "8",
                "--eval-iou", "0.3")
     assert "detection_accuracy" in out
+
+
+def test_train_gan():
+    out = _run("train_gan.py", "--steps", "400", "--min-modes", "4")
+    assert "modes_covered" in out

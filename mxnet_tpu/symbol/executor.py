@@ -47,5 +47,13 @@ class Executor:
         for name, g in self.grad_dict.items():
             arr = self.arg_dict[name]
             if arr.grad is not None:
-                g[:] = arr.grad
+                if self._grad_req == "add":
+                    # accumulate across forward/backward rounds
+                    # (reference executor grad_req='add' semantics —
+                    # attach_grad re-zeroes the tape buffer per
+                    # forward, so the executor's grad array is the
+                    # accumulator)
+                    g[:] = g + arr.grad
+                else:
+                    g[:] = arr.grad
         return [self.grad_dict.get(n) for n in self._symbol.list_arguments()]

@@ -15,7 +15,17 @@ class Executor:
         self._ctx = ctx or mx.current_context()
         self.arg_dict = dict(args)
         self.grad_dict = dict(args_grad) if args_grad else {}
-        self._grad_req = grad_req
+        # grad_req may be one string for all args, or a per-name dict
+        # (reference bind() accepts both; list form maps positionally)
+        names = list(self.arg_dict)
+        if isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(names, grad_req))
+        if isinstance(grad_req, dict):
+            self._req = {n: grad_req.get(n, "write") for n in names}
+        else:
+            self._req = {n: grad_req for n in names}
+        self._grad_req = grad_req if isinstance(grad_req, str) \
+            else "write"
         self.outputs = []
         self._recorded = None
 
@@ -25,10 +35,13 @@ class Executor:
         self.arg_dict.update({k: v if isinstance(v, mx.NDArray)
                               else mx.np.array(v)
                               for k, v in kwargs.items()})
-        want_grad = is_train and self._grad_req != "null" and self.grad_dict
+        want_grad = is_train and self.grad_dict and any(
+            self._req.get(n, "null") != "null" for n in self.grad_dict)
         if want_grad:
             for name in self.grad_dict:
-                self.arg_dict[name].attach_grad(self._grad_req)
+                if self._req.get(name, "null") != "null":
+                    self.arg_dict[name].attach_grad(
+                        self._req[name])
             with autograd.record():
                 outs = self._symbol._eval(self.arg_dict)
             self._recorded = outs
@@ -45,9 +58,11 @@ class Executor:
         heads = self._recorded
         autograd.backward(heads, head_grads=out_grads)
         for name, g in self.grad_dict.items():
+            if self._req.get(name, "null") == "null":
+                continue  # per-name null: no gradient written
             arr = self.arg_dict[name]
             if arr.grad is not None:
-                if self._grad_req == "add":
+                if self._req[name] == "add":
                     # accumulate across forward/backward rounds
                     # (reference executor grad_req='add' semantics —
                     # attach_grad re-zeroes the tape buffer per

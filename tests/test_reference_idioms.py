@@ -120,3 +120,23 @@ def test_executor_outputs_list():
     assert len(ex.outputs) == 2
     onp.testing.assert_allclose(ex.outputs[0].asnumpy(), [2.0, 4.0])
     onp.testing.assert_allclose(ex.outputs[1].asnumpy(), [2.0, 3.0])
+
+
+def test_grad_req_dict_per_name():
+    """bind() accepts a per-name grad_req dict (reference API): 'add'
+    accumulates, 'null' writes nothing."""
+    a = mx.sym.Variable("a")
+    b = mx.sym.Variable("b")
+    c = (a * 3.0 + b * 2.0).sum()
+    from mxnet_tpu import np as mnp
+    grads = {"a": mnp.zeros((2,)), "b": mnp.full((2,), 7.0)}
+    ex = c.bind(None, {"a": mnp.ones((2,)), "b": mnp.ones((2,))},
+                args_grad=grads,
+                grad_req={"a": "add", "b": "null"})
+    for _ in range(2):
+        ex.forward(is_train=True)
+        ex.backward(mnp.ones(()))
+    onp.testing.assert_allclose(ex.grad_dict["a"].asnumpy(),
+                                [6.0, 6.0], rtol=1e-6)
+    onp.testing.assert_allclose(ex.grad_dict["b"].asnumpy(),
+                                [7.0, 7.0], rtol=1e-6)  # untouched

@@ -214,3 +214,18 @@ def test_manual_unscale_flag_cleared_by_update():
     tr.step(1)
     onp.testing.assert_allclose(net.weight.data().asnumpy(),
                                 onp.full((1, 3), -8.0), rtol=1e-5)
+
+
+def test_cast_list_introspection():
+    """amp.list_* surfaces the cast lists (reference amp.py list_*)."""
+    import mxnet_tpu as mx
+    lp16 = mx.amp.list_lp16_ops()
+    fp32 = mx.amp.list_fp32_ops()
+    widest = mx.amp.list_widest_type_cast()
+    assert "dot" in lp16 or "fully_connected" in lp16
+    assert set(lp16).isdisjoint(fp32)
+    assert isinstance(widest, list)
+    assert mx.amp.list_conditional_fp32_ops() == []
+    # convert_symbol is the identity shim (casts apply at dispatch)
+    s = mx.sym.Variable("x") * 2
+    assert mx.amp.convert_symbol(s) is s

@@ -150,3 +150,27 @@ def test_get_config_roundtrip():
     m = metric.Fbeta(beta=2.0)
     cfg = m.get_config()
     assert cfg["metric"] == "Fbeta"
+
+
+def test_negative_log_likelihood():
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon import metric as M
+    nll = M.NegativeLogLikelihood()
+    preds = mnp.array([[0.25, 0.7, 0.05], [0.6, 0.2, 0.2]])
+    labels = mnp.array([1, 0])
+    nll.update(labels, preds)
+    name, val = nll.get()
+    expect = -(onp.log(0.7) + onp.log(0.6)) / 2
+    assert abs(val - expect) < 1e-5
+
+
+def test_custom_metric_and_np_factory():
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon import metric as M
+    cm = M.np(lambda l, p: float((l == p.argmax(-1)).mean()),
+              name="argmax_acc")
+    preds = mnp.array([[0.1, 0.9], [0.8, 0.2]])
+    labels = mnp.array([1, 1])
+    cm.update(labels, preds)
+    name, val = cm.get()
+    assert "argmax_acc" in name and abs(val - 0.5) < 1e-6

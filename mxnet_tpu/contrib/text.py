@@ -231,3 +231,31 @@ class CompositeEmbedding(_TokenEmbedding):
         mat = onp.concatenate(parts, axis=1)
         self._vec_len = mat.shape[1]
         self._idx_to_vec = mx.np.array(mat)
+
+
+# -- reference submodule spellings (contrib/text/{embedding,vocab,
+# utils}.py): expose the same names under the nested import paths so
+# `from mxnet.contrib.text import embedding` ports verbatim --
+import types as _types
+
+embedding = _types.ModuleType(__name__ + ".embedding")
+embedding.register = register
+embedding.create = create
+embedding.get_pretrained_file_names = globals().get(
+    "get_pretrained_file_names",
+    lambda name=None: {})
+embedding.GloVe = GloVe
+embedding.FastText = FastText
+embedding.CustomEmbedding = CustomEmbedding
+embedding.CompositeEmbedding = CompositeEmbedding
+
+vocab = _types.ModuleType(__name__ + ".vocab")
+vocab.Vocabulary = Vocabulary
+
+utils = _types.ModuleType(__name__ + ".utils")
+utils.count_tokens_from_str = count_tokens_from_str
+
+import sys as _sys
+for _m in (embedding, vocab, utils):
+    _sys.modules[_m.__name__] = _m
+del _types, _sys, _m

@@ -1,0 +1,88 @@
+"""TPU stage: long-context flash-attention throughput.
+
+Long-context is first-class in this framework (Pallas flash kernel +
+ring attention over 'sp'); this stage puts a silicon number on it:
+causal flash attention fwd+bwd tokens/sec at a sequence length where
+materializing the S×S score matrix would blow HBM (naive attention at
+S=16384, H=8, D=128 needs ~
+B*H*S^2*2 bytes = 4 GiB of scores alone per direction).
+
+Emits ONE JSON line with tokens/sec and attention-FLOPs utilization.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import numpy as onp  # noqa: E402
+
+from mxnet_tpu import autograd, np as mnp, npx  # noqa: E402
+from bench import _peak_flops  # noqa: E402
+
+B = int(os.environ.get("FLASH_B", "1"))
+H = int(os.environ.get("FLASH_H", "8"))
+S = int(os.environ.get("FLASH_S", "16384"))
+D = int(os.environ.get("FLASH_D", "128"))
+LO, HI = 1, 4
+
+rng = onp.random.RandomState(0)
+
+
+def mk():
+    return mnp.array(rng.randn(B, H, S, D).astype("f4") * 0.05) \
+        .astype("bfloat16")
+
+
+q, k, v = mk(), mk(), mk()
+q.attach_grad()
+
+# causal attention FLOPs (fwd): 2 matmuls * B*H*S^2*D MACs * 1/2
+# (causal); x2 FLOPs/MAC; bwd ~2x fwd (w/ remat ~2.5x) -> use 3x
+ATTN_FLOPS = 2 * 2 * B * H * S * S * D * 0.5 * 3
+peak = _peak_flops(kind)
+
+
+def run_once():
+    with autograd.record():
+        out = npx.flash_attention(q, k, v, causal=True)
+        loss = out.sum()
+    loss.backward()
+    return float(loss.asnumpy())
+
+
+def timed(n):
+    t0 = time.perf_counter()
+    for _ in range(n):
+        run_once()
+    return time.perf_counter() - t0
+
+
+print("[flash] compile", file=sys.stderr, flush=True)
+t0 = time.perf_counter()
+timed(LO)
+compile_s = time.perf_counter() - t0
+print("[flash] timing", file=sys.stderr, flush=True)
+t_lo, t_hi = timed(LO), timed(HI)
+sec = max((t_hi - t_lo) / (HI - LO), 1e-9)
+tokens_per_sec = B * S / sec
+util = (ATTN_FLOPS / sec / peak) if peak else None
+
+print(json.dumps({
+    "metric": "flash_attention_16k_tokens_per_sec_per_chip",
+    "value": round(tokens_per_sec, 0),
+    "unit": "tokens/sec/chip",
+    "attn_flops_utilization": round(util, 4) if util else None,
+    "seq_len": S, "heads": H, "head_dim": D, "batch": B,
+    "fwd_bwd": True,
+    "compile_s": round(compile_s, 1),
+    "init_s": round(init_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+}), flush=True)

@@ -1,0 +1,82 @@
+"""TPU stage: INT8 PTQ inference throughput vs bf16.
+
+The reference's quantization story is accuracy + CPU speedup tables
+(example/quantization/README.md); this stage measures the TPU MXU
+int8 path: resnet18 inference images/sec quantized (contrib.
+quantization.quantize_net, naive calibration) vs the bf16 baseline,
+same batch, fetch-delta timed. Emits ONE JSON line with both rates
+and the speedup.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _stage_prelude import init_stage  # noqa: E402
+
+jax, devs, init_s = init_stage()
+kind = devs[0].device_kind
+platform = devs[0].platform
+
+import numpy as onp  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.contrib.quantization import quantize_net  # noqa: E402
+
+BATCH = int(os.environ.get("INT8_BATCH", "256"))
+HW = int(os.environ.get("INT8_HW", "224"))
+LO, HI = 2, 10
+
+rng = onp.random.RandomState(0)
+data = mx.np.array(rng.rand(BATCH, 3, HW, HW).astype("f4"))
+
+
+def build(quantized):
+    net = gluon.model_zoo.vision.resnet18_v1(classes=1000)
+    net.initialize()
+    if quantized:
+        net = quantize_net(net, quantized_dtype="int8",
+                           calib_mode="naive", calib_data=[data[:32]])
+    else:
+        net.cast("bfloat16")
+    net.hybridize()
+    return net
+
+
+def rate(net, x):
+    def timed(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = net(x)
+        float(out.asnumpy().sum())
+        return time.perf_counter() - t0
+
+    timed(LO)  # compile + drain
+    t_lo, t_hi = timed(LO), timed(HI)
+    sec = max((t_hi - t_lo) / (HI - LO), 1e-9)
+    return BATCH / sec
+
+
+print("[int8] bf16 baseline", file=sys.stderr, flush=True)
+t0 = time.perf_counter()
+bf16_net = build(False)
+ips_bf16 = rate(bf16_net, data.astype("bfloat16"))
+print("[int8] quantized", file=sys.stderr, flush=True)
+q_net = build(True)
+ips_int8 = rate(q_net, data)
+total_s = time.perf_counter() - t0
+
+print(json.dumps({
+    "metric": "resnet18_int8_infer_images_per_sec_per_chip",
+    "value": round(ips_int8, 1),
+    "unit": "images/sec/chip",
+    "ips_bf16": round(ips_bf16, 1),
+    "int8_speedup_vs_bf16": round(ips_int8 / max(ips_bf16, 1e-9), 3),
+    "batch": BATCH, "hw": HW,
+    "total_s": round(total_s, 1),
+    "init_s": round(init_s, 2),
+    "platform": platform,
+    "device_kind": kind,
+}), flush=True)

@@ -73,6 +73,10 @@ STAGES = [
     ("conformance",
      [PY, os.path.join(REPO, "scripts", "tpu_stage_conformance.py")],
      1200),
+    ("flash",
+     [PY, os.path.join(REPO, "scripts", "tpu_stage_flash.py")], 480),
+    ("int8",
+     [PY, os.path.join(REPO, "scripts", "tpu_stage_int8.py")], 600),
     ("trace", [PY, os.path.join(REPO, "scripts", "tpu_stage_trace.py")],
      420),
     ("trace50",

@@ -36,3 +36,26 @@ def init_stage():
     t0 = time.time()
     devs = jax.devices()
     return jax, devs, time.time() - t0
+
+
+def fetch_delta_sec_per_iter(run_n, lo=2, hi=8):
+    """Two-point fetch-delta timing (the bench.py method): `run_n(n)`
+    must queue n iterations and END by materializing ONE value (the
+    only sync the tunnel honors). Differencing two chain lengths
+    cancels the fixed fetch/RPC cost. Returns (sec_per_iter,
+    compile_s). Shared here so stages cannot drift on the protocol.
+    """
+    import time
+
+    t0 = time.perf_counter()
+    run_n(lo)   # compile + drain
+    run_n(hi)
+    compile_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    run_n(lo)
+    t_lo = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    run_n(hi)
+    t_hi = time.perf_counter() - t0
+    return max((t_hi - t_lo) / (hi - lo), 1e-9), compile_s

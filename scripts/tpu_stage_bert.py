@@ -12,7 +12,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _stage_prelude import init_stage  # noqa: E402
+from _stage_prelude import fetch_delta_sec_per_iter, init_stage  # noqa: E402
 
 jax, devs, init_s = init_stage()
 kind = devs[0].device_kind
@@ -58,25 +58,14 @@ segs = mx.np.zeros((BATCH * n_dev, SEQ), dtype="int32")
 labels = mx.np.zeros((BATCH * n_dev,), dtype="int32")
 
 
-def timed(n):
-    t0 = time.perf_counter()
+def run_n(n):
     for _ in range(n):
         loss = step((toks, segs), labels)
     float(loss.asnumpy())
-    return time.perf_counter() - t0
 
 
-def _stage(m):
-    print(f"[bert] {m}", file=sys.stderr, flush=True)
-
-
-_stage("warmup/compile")
-t_compile0 = time.perf_counter()
-timed(LO)
-compile_s = time.perf_counter() - t_compile0
-_stage("timing")
-t_lo, t_hi = timed(LO), timed(HI)
-sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+print("[bert] compile+timing", file=sys.stderr, flush=True)
+sec_per_step, compile_s = fetch_delta_sec_per_iter(run_n, LO, HI)
 sps = BATCH * n_dev / sec_per_step
 tokens_per_sec = sps * SEQ
 mfu = (FLOPS_PER_TOKEN_TRAIN * tokens_per_sec / (peak * n_dev)) \

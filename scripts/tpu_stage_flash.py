@@ -15,7 +15,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _stage_prelude import init_stage  # noqa: E402
+from _stage_prelude import fetch_delta_sec_per_iter, init_stage  # noqa: E402
 
 jax, devs, init_s = init_stage()
 kind = devs[0].device_kind
@@ -49,28 +49,20 @@ ATTN_FLOPS = 2 * 2 * B * H * S * S * D * 0.5 * 3
 peak = _peak_flops(kind)
 
 
-def run_once():
-    with autograd.record():
-        out = npx.flash_attention(q, k, v, causal=True)
-        loss = out.sum()
-    loss.backward()
-    return float(loss.asnumpy())
-
-
-def timed(n):
-    t0 = time.perf_counter()
+def run_n(n):
+    """n fwd+bwd iterations, ONE materializing fetch at the end
+    (per-iteration fetches would charge an RPC round trip to every
+    step — the shared fetch-delta helper cancels only the last)."""
     for _ in range(n):
-        run_once()
-    return time.perf_counter() - t0
+        with autograd.record():
+            out = npx.flash_attention(q, k, v, causal=True)
+            loss = out.sum()
+        loss.backward()
+    float(q.grad.asnumpy().ravel()[0])
 
 
-print("[flash] compile", file=sys.stderr, flush=True)
-t0 = time.perf_counter()
-timed(LO)
-compile_s = time.perf_counter() - t0
-print("[flash] timing", file=sys.stderr, flush=True)
-t_lo, t_hi = timed(LO), timed(HI)
-sec = max((t_hi - t_lo) / (HI - LO), 1e-9)
+print("[flash] compile+timing", file=sys.stderr, flush=True)
+sec, compile_s = fetch_delta_sec_per_iter(run_n, LO, HI)
 tokens_per_sec = B * S / sec
 util = (ATTN_FLOPS / sec / peak) if peak else None
 

@@ -12,7 +12,7 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _stage_prelude import REPO, init_stage  # noqa: E402
+from _stage_prelude import REPO, fetch_delta_sec_per_iter, init_stage  # noqa: E402
 
 jax, devs, init_s = init_stage()
 kind = devs[0].device_kind
@@ -76,21 +76,14 @@ y = mx.np.array(rng.randint(0, VOCAB, (B, BPTT)).astype("int32"))
 state = [s.astype("bfloat16") for s in net.lm.begin_state(B)]
 
 
-def timed(n):
-    t0 = time.perf_counter()
+def run_n(n):
     for _ in range(n):
         loss = step((x, state), y)
     float(loss.asnumpy())
-    return time.perf_counter() - t0
 
 
-print("[lstm] warmup/compile", file=sys.stderr, flush=True)
-t0 = time.perf_counter()
-timed(LO)
-compile_s = time.perf_counter() - t0
-print("[lstm] timing", file=sys.stderr, flush=True)
-t_lo, t_hi = timed(LO), timed(HI)
-sec_per_step = max((t_hi - t_lo) / (HI - LO), 1e-9)
+print("[lstm] compile+timing", file=sys.stderr, flush=True)
+sec_per_step, compile_s = fetch_delta_sec_per_iter(run_n, LO, HI)
 tokens_per_sec = B * BPTT / sec_per_step
 mfu = (FLOPS_PER_TOKEN_TRAIN * tokens_per_sec / (peak * n_dev)) \
     if peak else None

@@ -178,3 +178,27 @@ def test_check_symbolic_helpers_journey(tmp_path):
     with pytest.raises(IOError):
         mx.test_utils.download("http://example.com/x")
     assert mx.test_utils.list_gpus() == []
+
+
+def test_estimator_fit_journey():
+    """gluon.contrib estimator fit loop with handlers (the Keras-ish
+    reference flow: est.fit(train_data, epochs=...))."""
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.gluon import metric as M
+
+    X = onp.random.RandomState(0).randn(64, 6).astype("f4")
+    Y = (X.sum(1) > 0).astype("i4")
+    loader = DataLoader(ArrayDataset(np.array(X), np.array(Y)),
+                        batch_size=16)
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize()
+    est = Estimator(net=net,
+                    loss=gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=M.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    est.fit(train_data=loader, epochs=3)
+    name, acc = est.train_metrics[0].get()
+    assert acc > 0.6, acc

@@ -166,3 +166,33 @@ def test_image_record_iter_seeded_shuffle(tmp_path):
         return onp.concatenate([b.label[0].asnumpy() for b in it])
 
     onp.testing.assert_array_equal(labels(7), labels(7))
+
+
+def test_resize_iter_wraps_epochs():
+    """ResizeIter stretches/shrinks an iterator's epoch (parity:
+    io.py ResizeIter — wraps the inner iterator when exhausted)."""
+    from mxnet_tpu import np as mnp
+    base = io.NDArrayIter(mnp.array(onp.arange(12.0).reshape(6, 2)),
+                          mnp.array(onp.arange(6.0)), batch_size=2)
+    it = io.ResizeIter(base, size=5)  # inner epoch is 3 batches
+    batches = [b.data[0].asnumpy().copy() for b in it]
+    assert len(batches) == 5
+    onp.testing.assert_allclose(batches[3], batches[0])  # wrapped
+    it.reset()
+    assert len(list(it)) == 5
+
+
+def test_prefetching_iter_matches_base():
+    from mxnet_tpu import np as mnp
+    X = onp.arange(24.0).reshape(12, 2)
+    base = io.NDArrayIter(mnp.array(X), mnp.array(onp.arange(12.0)),
+                          batch_size=4)
+    want = [b.data[0].asnumpy().copy() for b in base]
+    base.reset()
+    pf = io.PrefetchingIter(base)
+    got = [b.data[0].asnumpy().copy() for b in pf]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        onp.testing.assert_allclose(g, w)
+    pf.reset()
+    assert len(list(pf)) == len(want)

@@ -66,3 +66,24 @@ def test_memory_profile_dump(tmp_path):
     assert os.path.exists(p)
     assert os.path.getsize(p) > 0
     del keep
+
+
+def test_profiler_scope_nesting_and_shims():
+    """scope() nests by prepending (reference memory-profiler scope),
+    Marker/dump_profile/profiler_set_state shims answer."""
+    import warnings
+
+    import mxnet_tpu as mx
+    p = mx.profiler
+    assert p.current_scope() == "<unk>:"
+    with p.scope("init:"):
+        assert p.current_scope() == "init:"
+        with p.scope("conv"):
+            assert p.current_scope() == "init:conv:"
+    assert p.current_scope() == "<unk>:"
+    p.Marker(p.Domain("d"), "evt").mark("process")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        p.profiler_set_state("stop")
+        assert any("deprecated" in str(x.message) for x in w)
+    assert p.set_kvstore_handle(None) is None

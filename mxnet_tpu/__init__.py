@@ -80,7 +80,8 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "util", "recordio", "image", "io", "amp", "random", "symbol",
              "rtc", "contrib", "library", "visualization", "operator",
              "model", "callback", "name", "attribute", "registry",
-             "error", "log", "misc", "dlpack", "executor"):
+             "error", "log", "misc", "dlpack", "executor", "telemetry",
+             "monitor"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -33,11 +33,17 @@ import weakref
 
 import jax
 
+from . import telemetry
+
 # Live-array registry so waitall() can block on everything in flight.
 # jax arrays are weakref-able but not hashable, so key weakrefs by id;
 # the weakref callback drops entries as arrays are garbage collected.
 _live_arrays: dict = {}
 _live_lock = threading.Lock()
+# per-op in-flight peak, kept as a plain int box: track() is the
+# hottest path in the framework, so it must not take the telemetry
+# registry lock — sample_memory() publishes this to the registry
+_live_peak = [0]
 
 _engine_type = os.environ.get("MXTPU_ENGINE_TYPE", os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice"))
 
@@ -73,29 +79,75 @@ def track(data):
 
         with _live_lock:
             _live_arrays[key] = weakref.ref(data, _drop)
+            n = len(_live_arrays)
+            if n > _live_peak[0]:  # inside the lock: a stale compare
+                _live_peak[0] = n  # outside could regress the peak
     return data
+
+
+def sample_memory():
+    """Record device-memory / in-flight-buffer watermarks into
+    telemetry (parity: the reference's storage profiler attributing
+    GPU pool bytes). Prefers PJRT's per-device ``memory_stats()``
+    (real HBM bytes_in_use); backends without it (CPU) fall back to
+    the bytes held by arrays the engine is tracking. Cheap enough for
+    once-per-step sampling, not for per-op paths."""
+    if not telemetry.enabled():
+        return
+    dev_bytes = 0
+    try:
+        for d in jax.local_devices():
+            ms = d.memory_stats()
+            if ms:
+                dev_bytes += ms.get("bytes_in_use", 0)
+    except Exception:  # noqa: BLE001 — backend without memory stats
+        dev_bytes = 0
+    with _live_lock:
+        live = [r() for r in _live_arrays.values()]
+    live_bytes = sum(getattr(a, "nbytes", 0) for a in live
+                     if a is not None)
+    n_live = sum(1 for a in live if a is not None)
+    telemetry.gauge("engine.live_arrays", n_live, peak=_live_peak[0])
+    telemetry.gauge("engine.live_bytes", live_bytes)
+    if dev_bytes:
+        telemetry.gauge("engine.device_mem_bytes", dev_bytes)
 
 
 def waitall():
     """Block until all pushed work has finished (parity: mx.nd.waitall).
 
     Re-raises the first deferred device error, like the reference's
-    WaitForAll → Throw path.
+    WaitForAll → Throw path; any FURTHER deferred errors are logged at
+    WARNING (they used to be silently discarded) and counted in
+    telemetry as ``engine.suppressed_errors``.
     """
+    sample_memory()
     with _live_lock:
         arrays = [r() for r in _live_arrays.values()]
         _live_arrays.clear()
-    err = None
+    # the drain empties the registry: zero BOTH current values so the
+    # gauges stay consistent with each other (peaks stay monotone)
+    telemetry.gauge("engine.live_arrays", 0)
+    telemetry.gauge("engine.live_bytes", 0)
+    errs = []
     for a in arrays:
         if a is None:
             continue
         try:
             jax.block_until_ready(a)
         except Exception as e:  # keep draining; report the first error
-            if err is None:
-                err = e
-    if err is not None:
-        raise err
+            errs.append(e)
+    if errs:
+        if len(errs) > 1:
+            from . import log
+            logger = log.get_logger("mxnet_tpu.engine")
+            telemetry.counter("engine.suppressed_errors",
+                              len(errs) - 1)
+            for e in errs[1:]:
+                logger.warning(
+                    "waitall: suppressed additional deferred device "
+                    "error (%s): %s", type(e).__name__, e)
+        raise errs[0]
 
 
 def wait_to_read(data):

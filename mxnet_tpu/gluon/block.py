@@ -32,6 +32,7 @@ import jax
 
 from .. import autograd
 from .. import engine
+from .. import telemetry
 from ..context import current_context
 from ..ndarray.ndarray import NDArray
 from ..random_state import next_key, trace_rng
@@ -391,7 +392,7 @@ class _HookHandle:
 
 class _CachedEntry:
     __slots__ = ("fwd", "fwd_vjp", "bwd", "out_spec", "aux_targets",
-                 "param_nds", "params", "in_spec", "epoch")
+                 "param_nds", "params", "in_spec", "epoch", "compiled")
 
 
 class CachedOp:
@@ -451,6 +452,9 @@ class CachedOp:
             lambda key, p, i: jax.vjp(
                 lambda pp, ii: raw_fn(key, pp, ii), p, i, has_aux=True))
         entry.bwd = jax.jit(lambda vjp, ct: vjp(ct))
+        # which of the lazily-jitted callables has been dispatched:
+        # fwd and fwd_vjp compile independently on first use
+        entry.compiled = set()
         entry.out_spec = out_box
         entry.aux_targets = aux_box
         return entry
@@ -512,6 +516,7 @@ class CachedOp:
                 "data-dependent-shape op; hybridize falls back to "
                 "imperative execution for this block "
                 f"({type(err).__name__})")
+        telemetry.counter("gluon.cachedop.dynamic_fallback")
         self._entries[key_sig] = self._DYNAMIC
         return self.block.forward(*args)
 
@@ -541,11 +546,19 @@ class CachedOp:
             self._entries.clear()
             entry = None
         if entry is None:
+            # cache miss: build a fresh whole-graph program (jit is
+            # lazy — the XLA compile itself lands on this call's
+            # execute below and is timed as gluon.cachedop.compile)
+            telemetry.counter("gluon.cachedop.cache_miss")
+            t0 = telemetry.clock()
             try:
                 entry = self._build(leaves, spec, training)
             except self._dynamic_errors() as e:
                 return self._dynamic_fallback(key_sig, args, e)
+            telemetry.duration_since("gluon.cachedop.build", t0)
             self._entries[key_sig] = entry
+        else:
+            telemetry.counter("gluon.cachedop.cache_hit")
 
         key = next_key()
         param_datas = [nd._data for nd in entry.param_nds]
@@ -576,6 +589,12 @@ class CachedOp:
             any(nd._grad_req != "null" for nd in entry.param_nds)
             or any(autograd._on_tape(l) for l in leaves))
 
+        # fwd and fwd_vjp are distinct lazily-jitted programs: either
+        # one's FIRST dispatch pays trace + XLA compile (recorded as
+        # 'compile'); later dispatches measure async enqueue cost only
+        jit_kind = "fwd_vjp" if recording else "fwd"
+        first_dispatch = jit_kind not in entry.compiled
+        t0 = telemetry.clock()
         try:
             if recording:
                 outs_raw, vjp, aux = entry.fwd_vjp(key, param_datas,
@@ -584,6 +603,10 @@ class CachedOp:
                 outs_raw, aux = entry.fwd(key, param_datas, input_datas)
         except self._dynamic_errors() as e:
             return self._dynamic_fallback(key_sig, args, e)
+        entry.compiled.add(jit_kind)
+        telemetry.duration_since(
+            "gluon.cachedop.compile" if first_dispatch else
+            "gluon.cachedop.run", t0)
 
         # write back aux state (BN running stats etc.)
         targets = entry.aux_targets.get("targets", [])

@@ -18,6 +18,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
 
+from ... import telemetry
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -84,9 +85,15 @@ class _Prefetcher(threading.Thread):
     def __iter__(self):
         try:
             while True:
+                # consumer-side stall waiting for the next prefetched
+                # batch (0 when the pipeline keeps up with the device);
+                # the end-of-epoch sentinel wait is NOT a batch stall,
+                # so it records nothing
+                t0 = telemetry.clock()
                 item = self._queue.get()
                 if item is self._DONE:
                     return
+                telemetry.duration_since("io.dataloader.batch_wait", t0)
                 if isinstance(item, Exception):
                     raise item
                 yield item

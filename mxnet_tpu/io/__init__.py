@@ -12,6 +12,7 @@ import threading
 
 import numpy as onp
 
+from .. import telemetry
 from ..ndarray.ndarray import NDArray
 
 DataDesc = namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])
@@ -279,8 +280,13 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
+        # batch-wait: time the consumer spends blocked on the
+        # prefetch thread — a non-zero aggregate means the input
+        # pipeline, not the device, is the bottleneck
+        t0 = telemetry.clock()
         for e in self.data_ready:
             e.wait()
+        telemetry.duration_since("io.prefetch.batch_wait", t0)
         if self.next_batch[0] is None:
             return False
         self.current_batch = self.next_batch[0]

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .base import KVStoreBase
 from .kvstore import KVStoreLocal
+from .. import telemetry
 
 
 @functools.lru_cache(maxsize=None)
@@ -68,6 +69,20 @@ class KVStoreDistSync(KVStoreLocal):
         n = jax.process_count()
         if n == 1:
             return local_data
+        # collective traffic over DCN: bytes contributed per process,
+        # plus host-side dispatch time of the reduce (the collective
+        # itself executes async — device truth lives in the Xprof
+        # timeline, same convention as the train_step 'run' rows)
+        if telemetry.enabled():
+            telemetry.counter("kvstore.dist.allreduce_bytes",
+                              getattr(local_data, "nbytes", 0))
+        t0 = telemetry.clock()
+        try:
+            return self._global_reduce_timed(local_data, n)
+        finally:
+            telemetry.duration_since("kvstore.dist.allreduce", t0)
+
+    def _global_reduce_timed(self, local_data, n):
         mesh = _host_mesh()
         dev = mesh.devices.ravel()[jax.process_index()]
         local = jax.device_put(local_data[None], dev)
@@ -92,11 +107,10 @@ class KVStoreDistSync(KVStoreLocal):
                 self.pushpull(k, value[i], None if out is None else out[i],
                               priority)
             return
-        agg = self._reduce(value, key)
-        if out is None:
-            self._store[key] = agg
-        else:
-            self._assign(out, agg)
+        # the shared leaf helper records the same rows as the local
+        # base class (dist only skips the updater early-return); the
+        # DCN reduce adds its kvstore.dist.allreduce rows via _reduce
+        self._pushpull_leaf(key, value, out)
 
 
 # registry aliases

@@ -22,6 +22,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import engine
+from .. import telemetry
 from ..ndarray.ndarray import NDArray
 from .base import KVStoreBase
 from ..optimizer import Optimizer, Updater
@@ -30,6 +31,13 @@ from ..optimizer import Optimizer, Updater
 @functools.lru_cache(maxsize=None)
 def _sum_n(n):
     return jax.jit(lambda *xs: functools.reduce(jnp.add, xs))
+
+
+def _nbytes(value):
+    """Total payload bytes of an NDArray or list of NDArrays."""
+    vals = value if isinstance(value, (list, tuple)) else [value]
+    return sum(getattr(v._data, "nbytes", 0) for v in vals
+               if v is not None)
 
 
 @KVStoreBase.register
@@ -81,6 +89,11 @@ class KVStoreLocal(KVStoreBase):
             for k, v in zip(key, value):
                 self.push(k, v, priority)
             return
+        # telemetry fires on leaf keys only (list calls recurse here),
+        # so per-key bytes/latency are counted exactly once
+        if telemetry.enabled():
+            telemetry.counter("kvstore.push_bytes", _nbytes(value))
+        t0 = telemetry.clock()
         agg = self._reduce(value, key)
         if self._updater is not None and key in self._store:
             w = NDArray(self._store[key])
@@ -89,14 +102,20 @@ class KVStoreLocal(KVStoreBase):
             self._store[key] = w._data
         else:
             self._store[key] = agg
+        telemetry.duration_since("kvstore.push", t0)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         if isinstance(key, (list, tuple)):
             for k, o in zip(key, out):
                 self.pull(k, o, priority)
             return
+        t0 = telemetry.clock()
         data = self._store[key]
         self._assign(out, data)
+        telemetry.duration_since("kvstore.pull", t0)
+        if telemetry.enabled():
+            telemetry.counter("kvstore.pull_bytes",
+                              getattr(data, "nbytes", 0))
 
     def pushpull(self, key, value, out=None, priority=0):
         if isinstance(key, (list, tuple)):
@@ -107,11 +126,23 @@ class KVStoreLocal(KVStoreBase):
         if self._updater is not None and key in self._store and out is None:
             self.push(key, value, priority)
             return
+        self._pushpull_leaf(key, value, out)
+
+    def _pushpull_leaf(self, key, value, out):
+        """Reduce + assign for one key, with the pushpull telemetry
+        rows (shared with the dist override, which skips the updater
+        branch but records identically)."""
+        if telemetry.enabled():
+            telemetry.counter("kvstore.push_bytes", _nbytes(value))
+        t0 = telemetry.clock()
         agg = self._reduce(value, key)
         if out is None:
             self._store[key] = agg
         else:
             self._assign(out, agg)
+        telemetry.duration_since("kvstore.pushpull", t0)
+        if out is not None and telemetry.enabled():
+            telemetry.counter("kvstore.pull_bytes", _nbytes(out))
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -1,0 +1,233 @@
+"""Per-layer output monitor (parity: python/mxnet/monitor.py).
+
+The reference's ``Monitor(interval, stat_func, pattern)`` registers a
+monitor callback on every executor and samples NDArray statistics
+during forward/backward. Here the executor surface is Gluon, so
+``install(block)`` registers forward hooks on the block tree; each hook
+computes the layer-output statistics (mean / abs-max / L2-norm by
+default) and records them both into ``Monitor``'s tic/toc queue and
+into the telemetry registry (``monitor.<layer>.<stat>`` rows in
+``profiler.dumps(aggregate_stats=True)``).
+
+Hybridize-safe: inside a CachedOp/TrainStep trace the hook sees
+tracers, so the statistics are computed in-graph and delivered at
+RUNTIME through ``jax.debug.callback`` — per-layer stats keep flowing
+from inside the single compiled XLA program (install() clears compiled
+caches so the callbacks trace in). The callback dispatches every
+executed step; recording only happens inside a tic() window, and
+uninstall() + the resulting recompile removes the dispatch entirely.
+
+Typical use mirrors the reference (``pattern`` matches dotted child
+paths like ``"Sequential.0.act"``, not class names)::
+
+    mon = mx.monitor.Monitor(interval=1, pattern=r"Sequential\\.\\d+$")
+    mon.install(net)            # or install(train_step) for the fused path
+    for batch in loader:
+        mon.tic()
+        out = net(data)
+        mon.toc_print()
+"""
+from __future__ import annotations
+
+import functools
+import re
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from . import telemetry
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Monitor"]
+
+
+def _leaves(out):
+    if isinstance(out, NDArray):
+        return [out]
+    if isinstance(out, (list, tuple)):
+        found = []
+        for o in out:
+            found.extend(_leaves(o))
+        return found
+    return []
+
+
+_DEFAULT_STATS = (
+    ("mean", lambda d: jnp.mean(d)),
+    ("absmax", lambda d: jnp.max(jnp.abs(d))),
+    ("norm", lambda d: jnp.linalg.norm(d.reshape(-1))),
+)
+
+
+class Monitor:
+    """Sample per-layer outputs every ``interval`` batches.
+
+    Parameters
+    ----------
+    interval : int
+        Sample once every ``interval`` calls to ``tic()``.
+    stat_func : callable, optional
+        ``f(NDArray) -> scalar`` replacing the default
+        mean/abs-max/norm triple (parity: the reference's single
+        ``stat_func``).
+    pattern : str
+        Regex over dotted layer paths (``"encoder.dense0"``); only
+        matching layers are sampled.
+    sort : bool
+        Sort ``toc()`` results by layer name.
+    """
+
+    def __init__(self, interval=1, stat_func=None, pattern=".*",
+                 sort=False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func
+        self.re_prog = re.compile(pattern)
+        self.sort = sort
+        self.queue = []
+        self.step = 0
+        self.activated = False
+        self._lock = threading.Lock()
+        self._handles = []
+        self._installed = []
+        self._steps = []
+
+    # -- installation --------------------------------------------------
+    def install(self, target, root=None):
+        """Register forward hooks over a Block tree (or the net inside
+        a ``parallel.TrainStep``), naming layers by dotted child path
+        (``"Sequential.0.act"``). Compiled caches — CachedOps and, for
+        a TrainStep, its fused step programs — are cleared so monitor
+        callbacks trace into the next build. Returns self."""
+        if root is None and hasattr(target, "_entries") \
+                and hasattr(target, "net"):
+            # fused TrainStep: hook its net and drop its compiled step
+            # programs so the callbacks trace in (optimizer state in
+            # _opt_states survives an entry rebuild by design)
+            self._steps.append(target)
+            target._entries.clear()
+            return self.install(target.net)
+        name = root if root is not None else type(target).__name__
+        if self.re_prog.match(name):
+            hook = functools.partial(self._forward_hook, name)
+            self._handles.append(target.register_forward_hook(hook))
+        for cname, child in getattr(target, "_children", {}).items():
+            self.install(child, f"{name}.{cname}")
+        if root is None:
+            self._installed.append(target)
+            self._clear_compiled(target)
+        return self
+
+    def uninstall(self):
+        """Remove every hook installed by this Monitor and drop the
+        compiled programs the callbacks were traced into."""
+        for h in self._handles:
+            h.remove()
+        self._handles = []
+        roots, self._installed = self._installed, []
+        for b in roots:
+            self._clear_compiled(b)
+        steps, self._steps = self._steps, []
+        for s in steps:
+            s._entries.clear()
+
+    remove = uninstall
+
+    @staticmethod
+    def _clear_compiled(block):
+        def clear(b):
+            if hasattr(b, "_clear_cached_op"):
+                b._clear_cached_op()
+        if hasattr(block, "apply"):
+            block.apply(clear)
+
+    # -- sampling ------------------------------------------------------
+    def _forward_hook(self, name, _block, _inputs, output):
+        leaves = _leaves(output)
+        for i, leaf in enumerate(leaves):
+            lname = name if len(leaves) == 1 else f"{name}[{i}]"
+            self._sample(lname, leaf)
+
+    def _stats_for(self, leaf):
+        if self.stat_func is not None:
+            s = self.stat_func(leaf)
+            if isinstance(s, NDArray):
+                s = s._data
+            return [("stat", jnp.asarray(s, jnp.float32))]
+        data = leaf._data
+        if not jnp.issubdtype(data.dtype, jnp.inexact):
+            data = data.astype(jnp.float32)
+        return [(k, jnp.asarray(f(data), jnp.float32))
+                for k, f in _DEFAULT_STATS]
+
+    def _sample(self, lname, leaf):
+        if not self.activated and \
+                not isinstance(leaf._data, jax.core.Tracer):
+            # eager path outside a tic() window: skip the stat
+            # reductions entirely (tracer-path hooks must still embed
+            # their runtime callback — gating happens in _record)
+            return
+        stats = self._stats_for(leaf)
+        vals = [v for _, v in stats]
+        keys = [k for k, _ in stats]
+        if any(isinstance(v, jax.core.Tracer) for v in vals):
+            # inside a jit/vjp/scan trace: defer to runtime — the
+            # callback fires with concrete values on every execution
+            # of the compiled program
+            jax.debug.callback(
+                functools.partial(self._record, lname, keys), *vals)
+        else:
+            self._record(lname, keys, *vals)
+
+    def _record(self, lname, keys, *vals):
+        # interval gate for host-side recording only: on hybridized
+        # nets the compiled program still computes the stat reductions
+        # and transfers the scalars to host on EVERY step (they are
+        # baked into the graph) — uninstall() is the way to stop
+        # paying that, not a longer interval
+        if not self.activated:
+            return
+        floats = [float(v) for v in vals]
+        for k, v in zip(keys, floats):
+            telemetry.value(f"monitor.{lname}.{k}", v)
+        pretty = "\t".join(f"{k}={v:.6g}" for k, v in zip(keys, floats))
+        with self._lock:
+            self.queue.append((self.step, lname, pretty))
+
+    # -- tic/toc (parity: monitor.py tic/toc/toc_print) ----------------
+    def tic(self):
+        """Open a sampling window if this step is on the interval."""
+        if self.step % self.interval == 0:
+            # drain callbacks still in flight from off-interval steps
+            # (they are async) so they can't leak into this window
+            try:
+                jax.effects_barrier()
+            except Exception:  # noqa: BLE001 — barrier is best-effort
+                pass
+            with self._lock:
+                self.queue = []
+            self.activated = True
+        self.step += 1
+
+    def toc(self):
+        """Close the window; returns ``[(step, layer, stat_str), ...]``.
+        Blocks until in-graph callbacks from compiled programs have
+        delivered (jax.effects_barrier)."""
+        if not self.activated:
+            return []
+        try:
+            jax.effects_barrier()
+        except Exception:  # noqa: BLE001 — barrier is best-effort
+            pass
+        self.activated = False
+        with self._lock:
+            res = list(self.queue)
+            self.queue = []
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        return res
+
+    def toc_print(self):
+        """Close the window and print the collected statistics."""
+        for step, lname, pretty in self.toc():
+            print(f"Batch: {step:7d} {lname:30s} {pretty}")

@@ -23,6 +23,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .. import autograd
 from .. import engine
+from .. import telemetry
 from ..ndarray.ndarray import NDArray
 from ..random_state import next_key, trace_rng
 from ..gluon import _deferred
@@ -362,12 +363,17 @@ class TrainStep:
                repr(data_spec), repr(label_spec))
         entry = self._entries.get(sig)
         if entry is None:
+            telemetry.counter("parallel.train_step.build")
+            t0 = telemetry.clock()
             entry = self._build(one_data, data_spec, one_label,
                                 label_spec)
+            telemetry.duration_since("parallel.train_step.build", t0)
             self._entries[sig] = entry
         chain_key = ("chain", sig, n_steps)
         chain = self._entries.get(chain_key)
-        if chain is None:
+        chain_fresh = chain is None
+        if chain_fresh:
+            telemetry.counter("parallel.train_step.chain_build")
             chain = self._build_chain(entry)
             self._entries[chain_key] = chain
         chain_jit, aux_positions, chain_data_sh, chain_label_sh = chain
@@ -390,12 +396,17 @@ class TrainStep:
             label_datas = [jax.device_put(d, sh) for d, sh in
                           zip(label_datas, chain_label_sh)]
 
+        t0 = telemetry.clock()
         new_ws, new_fr, new_ss, losses, last_aux = chain_jit(
             next_key(),
             tuple(nd._data for nd in entry["diff_nds"]),
             tuple(nd._data for nd in entry["frozen_nds"]),
             tuple(self._opt_states), hypers,
             tuple(data_datas), tuple(label_datas))
+        telemetry.duration_since(
+            "parallel.train_step.chain_compile" if chain_fresh else
+            "parallel.train_step.run_chain", t0)
+        telemetry.counter("parallel.train_step.chained_steps", n_steps)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
             nd._data = nw
@@ -407,6 +418,7 @@ class TrainStep:
             for nd, pos, new in zip(targets, aux_positions(), last_aux):
                 if pos < 0:  # not threaded through frozen: install last
                     nd._install(new)
+        engine.sample_memory()
         return NDArray(engine.track(losses))
 
     # -- call ----------------------------------------------------------
@@ -419,8 +431,11 @@ class TrainStep:
                repr(data_spec), repr(label_spec))
         entry = self._entries.get(sig)
         if entry is None:
+            telemetry.counter("parallel.train_step.build")
+            t0 = telemetry.clock()
             entry = self._build(data_leaves, data_spec,
                                 label_leaves, label_spec)
+            telemetry.duration_since("parallel.train_step.build", t0)
             self._entries[sig] = entry
         opt = self.optimizer
         n_diff = len(entry["diff_nds"])
@@ -436,11 +451,22 @@ class TrainStep:
                           zip(label_datas, entry["label_sh"])]
 
         diff_datas = tuple(nd._data for nd in entry["diff_nds"])
+        # dispatch is async and entry["jit"] is lazily compiled: its
+        # FIRST dispatch (even when the entry was built by an earlier
+        # run_chain) pays trace + XLA compile; steady-state 'run'
+        # measures enqueue latency (the host-side cost the reference's
+        # engine-push timing captured)
+        first_dispatch = not entry.get("jit_dispatched")
+        t0 = telemetry.clock()
         new_ws, new_ss, loss, aux = entry["jit"](
             next_key(), diff_datas, tuple(nd._data for nd in
                                           entry["frozen_nds"]),
             tuple(self._opt_states), hypers,
             tuple(data_datas), tuple(label_datas))
+        entry["jit_dispatched"] = True
+        telemetry.duration_since(
+            "parallel.train_step.compile" if first_dispatch else
+            "parallel.train_step.run", t0)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
             nd._data = nw
@@ -449,6 +475,7 @@ class TrainStep:
         with autograd.pause():
             for nd, new in zip(targets, aux):
                 nd._install(new)
+        engine.sample_memory()
         return NDArray(engine.track(loss))
 
 

@@ -11,9 +11,11 @@ in the same timeline.
 from __future__ import annotations
 
 import os
-import time
+import threading
 
 import jax
+
+from . import telemetry
 
 _config = {
     "filename": "profile.json",
@@ -24,7 +26,10 @@ _config = {
     "profile_api": True,
     "aggregate_stats": False,
 }
-_state = {"running": False, "dir": None}
+# One trace session spans start()..dump(): pause()/resume() keep the
+# SAME logdir (the reference keeps one trace file per session); a new
+# dir is derived only when no session is open.
+_state = {"running": False, "dir": None, "paused": False}
 
 
 def set_config(**kwargs):
@@ -46,14 +51,19 @@ def set_state(state="stop", profile_process="worker"):
 def start(profile_process="worker"):
     if _state["running"]:
         return
-    logdir = os.path.splitext(_config["filename"])[0] + "_xprof"
+    if _state["paused"] and _state["dir"]:
+        logdir = _state["dir"]  # resuming: stay in this session's dir
+    else:
+        logdir = os.path.splitext(_config["filename"])[0] + "_xprof"
     os.makedirs(logdir, exist_ok=True)
     jax.profiler.start_trace(logdir)
     _state["running"] = True
+    _state["paused"] = False
     _state["dir"] = logdir
 
 
 def stop(profile_process="worker"):
+    _state["paused"] = False
     if not _state["running"]:
         return
     jax.profiler.stop_trace()
@@ -64,16 +74,41 @@ def dump(finished=True, profile_process="worker"):
     stop()
 
 
-def dumps(reset=False, format="table", sort_by="total", ascending=False):
-    return f"profiler traces under {_state['dir']}" if _state["dir"] else ""
+def dumps(reset=False, format="table", sort_by="total", ascending=False,
+          aggregate_stats=None):
+    """Aggregate-stats report (parity: mx.profiler.dumps).
+
+    With ``aggregate_stats=True`` (or set_config(aggregate_stats=True))
+    renders the telemetry registry — every counter/gauge/duration the
+    instrumented hot paths recorded — as the reference's aggregate
+    table (``format="table"``) or as JSON (``format="json"``), ordered
+    by ``sort_by`` in {"total","count","min","max","avg","name"}.
+    ``reset=True`` clears the registry after rendering. Without
+    aggregate stats, returns the Xprof trace location (the timeline
+    lives in TensorBoard/Perfetto, not in a string).
+    """
+    if aggregate_stats is None:
+        aggregate_stats = _config.get("aggregate_stats", False)
+    if not aggregate_stats:
+        return f"profiler traces under {_state['dir']}" \
+            if _state["dir"] else ""
+    return telemetry.render(format=format, sort_by=sort_by,
+                            ascending=ascending, trace_dir=_state["dir"],
+                            reset_after=reset)
 
 
 def pause(profile_process="worker"):
-    stop()
+    """Suspend tracing without closing the session (parity:
+    profiler.pause): resume() continues into the SAME logdir."""
+    if not _state["running"]:
+        return
+    jax.profiler.stop_trace()
+    _state["running"] = False
+    _state["paused"] = True
 
 
 def resume(profile_process="worker"):
-    start()
+    start()  # start() reuses the paused session's logdir
 
 
 class Task:
@@ -110,18 +145,48 @@ class Event(Task):
 
 
 class Counter:
+    """User-visible profiler counter (parity: mx.profiler.Counter).
+
+    Mutations are serialized under a per-counter lock (the reference's
+    counters live in the C++ profiler and are atomic; the old shim
+    mutated ``self.value`` unlocked). Every update mirrors into a
+    telemetry gauge ``counter.<name>`` so it appears in
+    ``dumps(aggregate_stats=True)``.
+    """
+
     def __init__(self, domain=None, name="counter", value=None):
         self.name = name
-        self.value = value or 0
+        self._lock = threading.Lock()
+        self._value = value or 0
+        telemetry.gauge(self._gauge_name, self._value)
+
+    @property
+    def _gauge_name(self):
+        return f"counter.{self.name}"
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+    @value.setter
+    def value(self, v):
+        self.set_value(v)
 
     def set_value(self, value):
-        self.value = value
+        # gauge publish stays inside the lock: outside it, a slower
+        # thread could overwrite the registry with a stale value
+        with self._lock:
+            self._value = value
+            telemetry.gauge(self._gauge_name, value)
 
     def increment(self, delta=1):
-        self.value += delta
+        with self._lock:
+            self._value += delta
+            telemetry.gauge(self._gauge_name, self._value)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self.increment(-delta)
 
     def __iadd__(self, v):
         self.increment(v)

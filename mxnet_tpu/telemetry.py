@@ -1,0 +1,244 @@
+"""In-process telemetry registry — the framework's aggregate-stats engine.
+
+Parity target: the reference's profiler aggregate-stats table
+(src/profiler/profiler.h AggregateStats, rendered by
+`profiler.dumps(aggregate_stats=True)`): a process-wide table of named
+counters, gauges, and duration aggregators fed by hooks in every hot
+path (CachedOp compiles, TrainStep timing, kvstore traffic, dataloader
+waits, engine memory watermarks). `profiler.dumps()` renders this
+registry; `monitor.Monitor` writes per-layer stats into it.
+
+Design constraints:
+
+- **Near-zero cost when disabled** (``MXTPU_TELEMETRY=0``): every
+  recording function checks one module-level bool and returns. The
+  instrumented hot paths call ``clock()`` which returns 0.0 without a
+  syscall when disabled.
+- **Thread-safe**: one registry lock; every mutation is a few dict ops
+  under it. Callers on the engine hot path pay ~1µs per event.
+- **Unit convention**: duration aggregators store MILLISECONDS
+  (``duration_since`` converts); ``value()`` rows store native units
+  (monitor layer stats, byte counts routed through aggregators). The
+  rendered table carries the same caveat line the reference prints
+  ("counter items are counter values and not time units").
+"""
+from __future__ import annotations
+
+import json as _json
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "set_enabled", "clock", "counter", "gauge", "value",
+    "duration_since", "snapshot", "reset", "render", "names",
+]
+
+_enabled = os.environ.get("MXTPU_TELEMETRY", "1").lower() \
+    not in ("0", "false", "off")
+
+_lock = threading.Lock()
+# name -> float
+_counters: dict = {}
+# name -> [value, peak]
+_gauges: dict = {}
+# name -> [count, total, min, max]
+_aggs: dict = {}
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(flag: bool) -> bool:
+    """Toggle recording at runtime (tests; env var sets the default).
+    Returns the previous state."""
+    global _enabled
+    prev = _enabled
+    _enabled = bool(flag)
+    return prev
+
+
+def clock() -> float:
+    """perf_counter() when enabled, 0.0 (no syscall) when disabled.
+    Pair with duration_since()."""
+    if not _enabled:
+        return 0.0
+    return time.perf_counter()
+
+
+def counter(name: str, delta: float = 1):
+    """Increment a monotonic counter."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + delta
+
+
+def gauge(name: str, val: float, peak: float | None = None):
+    """Set a gauge to its current value. A monotone all-time peak is
+    kept alongside every gauge (device-memory high-water marks). A
+    caller that tracked a higher transient itself (per-op peaks too
+    hot to publish each event) passes it via ``peak=``."""
+    if not _enabled:
+        return
+    hi = val if peak is None or peak < val else peak
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            _gauges[name] = [val, hi]
+        else:
+            g[0] = val
+            if hi > g[1]:
+                g[1] = hi
+
+
+def value(name: str, val: float):
+    """Record one sample into the count/total/min/max aggregator for
+    ``name`` (avg derives at render time — the 'p50-ish' column)."""
+    if not _enabled:
+        return
+    with _lock:
+        a = _aggs.get(name)
+        if a is None:
+            _aggs[name] = [1, val, val, val]
+        else:
+            a[0] += 1
+            a[1] += val
+            if val < a[2]:
+                a[2] = val
+            if val > a[3]:
+                a[3] = val
+
+
+def duration_since(name: str, t0: float):
+    """Record elapsed milliseconds since ``t0 = telemetry.clock()``.
+    A 0.0 t0 means the clock was read while disabled — skip (the
+    enabled flag may have flipped mid-measurement)."""
+    if not _enabled or t0 == 0.0:
+        return
+    value(name, (time.perf_counter() - t0) * 1e3)
+
+
+def reset():
+    """Drop every registered entry."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+        _aggs.clear()
+
+
+def names():
+    """All registered entry names (tests / quick inspection)."""
+    with _lock:
+        return sorted(set(_counters) | set(_gauges) | set(_aggs))
+
+
+def snapshot(reset_after: bool = False) -> dict:
+    """Consistent copy of the registry:
+    ``{"durations": {name: {count,total,min,max,avg}},
+       "counters": {name: value}, "gauges": {name: {value, peak}}}``."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = {k: {"value": v[0], "peak": v[1]}
+                  for k, v in _gauges.items()}
+        aggs = {k: {"count": v[0], "total": v[1], "min": v[2],
+                    "max": v[3], "avg": v[1] / v[0] if v[0] else 0.0}
+                for k, v in _aggs.items()}
+        if reset_after:
+            _counters.clear()
+            _gauges.clear()
+            _aggs.clear()
+    return {"durations": aggs, "counters": counters, "gauges": gauges}
+
+
+# -- rendering (the reference's aggregate-stats table) -----------------
+
+_SORT_KEYS = ("total", "count", "min", "max", "avg", "name")
+
+
+def _sorted_items(d, keyfn, sort_by, ascending):
+    if sort_by == "name":
+        return sorted(d.items(), key=lambda kv: kv[0],
+                      reverse=not ascending)
+    return sorted(d.items(), key=keyfn, reverse=not ascending)
+
+
+def render(format: str = "table", sort_by: str = "total",
+           ascending: bool = False, trace_dir: str | None = None,
+           reset_after: bool = False) -> str:
+    """Render the registry the way the reference renders
+    `dumps(aggregate_stats=True)` — a sectioned fixed-width table, or a
+    JSON document with sections ordered by the same sort.
+    ``reset_after`` clears the registry atomically with the read, so
+    events recorded while rendering land in the NEXT report instead of
+    vanishing."""
+    if sort_by not in _SORT_KEYS:
+        raise ValueError(f"sort_by must be one of {_SORT_KEYS}, "
+                         f"got {sort_by!r}")
+    if format not in ("table", "json"):
+        # validate BEFORE the (possibly resetting) snapshot: a bad
+        # format must not destroy the registry
+        raise ValueError(f"format must be 'table' or 'json', "
+                         f"got {format!r}")
+    snap = snapshot(reset_after=reset_after)
+    aggs = _sorted_items(
+        snap["durations"],
+        (lambda kv: kv[1][sort_by]) if sort_by != "name"
+        else (lambda kv: kv[0]),
+        sort_by, ascending)
+    # counters/gauges have no duration columns: sort by value unless
+    # sorting by name
+    cnt_key = (lambda kv: kv[0]) if sort_by == "name" \
+        else (lambda kv: kv[1])
+    counters = _sorted_items(snap["counters"], cnt_key, sort_by, ascending)
+    gauge_key = (lambda kv: kv[0]) if sort_by == "name" \
+        else (lambda kv: kv[1]["value"])
+    gauges = _sorted_items(snap["gauges"], gauge_key, sort_by, ascending)
+
+    if format == "json":
+        doc = {
+            "version": 1,
+            "sort_by": sort_by,
+            "ascending": ascending,
+            "durations": dict(aggs),
+            "counters": dict(counters),
+            "gauges": dict(gauges),
+        }
+        if trace_dir:
+            doc["trace_dir"] = trace_dir
+        return _json.dumps(doc, indent=2)
+
+    w = max([len(n) for n, _ in aggs + counters + gauges] + [24]) + 2
+    lines = ["Profile Statistics (aggregate)",
+             "\tNote that counter items are counter values and not "
+             "time units."]
+    if trace_dir:
+        lines.append(f"\tXprof timeline traces under {trace_dir}")
+    if aggs:
+        lines += ["", "Durations (ms unless the name says otherwise)",
+                  "=" * 46,
+                  f"{'Name':<{w}}{'Count':>10}{'Total':>14}"
+                  f"{'Min':>12}{'Max':>12}{'Avg':>12}",
+                  f"{'----':<{w}}{'-----':>10}{'-----':>14}"
+                  f"{'---':>12}{'---':>12}{'---':>12}"]
+        for name, a in aggs:
+            lines.append(
+                f"{name:<{w}}{a['count']:>10}{a['total']:>14.4f}"
+                f"{a['min']:>12.4f}{a['max']:>12.4f}{a['avg']:>12.4f}")
+    if counters:
+        lines += ["", "Counters", "=" * 8,
+                  f"{'Name':<{w}}{'Value':>14}",
+                  f"{'----':<{w}}{'-----':>14}"]
+        for name, v in counters:
+            lines.append(f"{name:<{w}}{v:>14g}")
+    if gauges:
+        lines += ["", "Gauges", "=" * 6,
+                  f"{'Name':<{w}}{'Value':>14}{'Peak':>14}",
+                  f"{'----':<{w}}{'-----':>14}{'----':>14}"]
+        for name, g in gauges:
+            lines.append(f"{name:<{w}}{g['value']:>14g}{g['peak']:>14g}")
+    if not (aggs or counters or gauges):
+        lines += ["", "(no telemetry recorded"
+                  + (" — MXTPU_TELEMETRY=0)" if not _enabled else ")")]
+    return "\n".join(lines)

@@ -1,0 +1,159 @@
+"""Monitor tests (parity model: python/mxnet/monitor.py — install,
+interval/pattern gating, tic/toc lifecycle), plus hybridize capture via
+in-graph callbacks."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import monitor, telemetry
+from mxnet_tpu.gluon import nn
+
+
+def _net():
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    return net
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def test_install_capture_and_stats():
+    net = _net()
+    mon = monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.tic()
+    y = net(mx.np.random.uniform(size=(2, 16)))
+    y.wait_to_read()
+    res = mon.toc()
+    assert res, "no stats captured"
+    names = {r[1] for r in res}
+    assert "Sequential" in names
+    assert any(n.startswith("Sequential.0") for n in names)
+    # default stat triple appears in the formatted row
+    step, _, pretty = res[0]
+    for stat in ("mean", "absmax", "norm"):
+        assert stat + "=" in pretty
+    # and the same stats landed in the telemetry registry
+    reg = telemetry.snapshot()["durations"]
+    assert any(k.startswith("monitor.Sequential") and k.endswith(".norm")
+               for k in reg)
+
+
+def test_hook_remove_stops_capture():
+    net = _net()
+    mon = monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.tic()
+    net(mx.np.ones((2, 16))).wait_to_read()
+    assert mon.toc()
+    mon.uninstall()
+    mon.tic()
+    net(mx.np.ones((2, 16))).wait_to_read()
+    assert mon.toc() == []
+    # hooks really removed from the blocks
+    for blk in net._iter_blocks():
+        assert not blk._forward_hooks
+
+
+def test_pattern_filtering():
+    net = _net()
+    mon = monitor.Monitor(interval=1, pattern=r".*\.1$")
+    mon.install(net)
+    mon.tic()
+    net(mx.np.ones((2, 16))).wait_to_read()
+    res = mon.toc()
+    assert res
+    assert all(r[1] == "Sequential.1" for r in res)
+
+
+def test_interval_gating():
+    net = _net()
+    mon = monitor.Monitor(interval=2)
+    mon.install(net)
+    x = mx.np.ones((2, 16))
+    mon.tic()                      # step 0: sampling on
+    net(x).wait_to_read()
+    assert mon.toc()
+    mon.tic()                      # step 1: window closed
+    net(x).wait_to_read()
+    assert mon.toc() == []
+    mon.tic()                      # step 2: on again
+    net(x).wait_to_read()
+    assert mon.toc()
+
+
+def test_stats_captured_under_hybridize():
+    """Per-layer stats flow out of the single compiled XLA program via
+    runtime callbacks — including on steady-state cache-hit calls."""
+    net = _net()
+    net.hybridize()
+    mon = monitor.Monitor(interval=1)
+    mon.install(net)
+    x = mx.np.random.uniform(size=(2, 16))
+    mon.tic()
+    net(x).wait_to_read()
+    first = mon.toc()
+    assert first
+    # second call takes the compiled cache-hit path: stats still arrive
+    mon.tic()
+    net(x).wait_to_read()
+    second = mon.toc()
+    assert second
+    names = {r[1] for r in second}
+    assert any(n.startswith("Sequential.") for n in names)
+
+
+def test_install_on_train_step():
+    """install(TrainStep) invalidates the fused programs so callbacks
+    trace in, and uninstall() drops them again."""
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.parallel.train_step import TrainStep
+
+    net = _net()
+    net.hybridize()
+    step = TrainStep(net, L2Loss(), "sgd", {"learning_rate": 0.1})
+    x = mx.np.random.uniform(size=(2, 16))
+    y = mx.np.zeros((2, 4))
+    step(x, y).wait_to_read()  # compiled WITHOUT hooks
+    mon = monitor.Monitor(interval=1)
+    mon.install(step)
+    assert step._entries == {}, "fused programs not invalidated"
+    mon.tic()
+    step(x, y).wait_to_read()
+    res = mon.toc()
+    assert res, "no stats captured through the fused train step"
+    assert any(r[1].startswith("Sequential") for r in res)
+    mon.uninstall()
+    assert step._entries == {}
+    mon.tic()
+    step(x, y).wait_to_read()
+    assert mon.toc() == []
+
+
+def test_custom_stat_func_and_sort():
+    net = _net()
+    mon = monitor.Monitor(interval=1, sort=True,
+                          stat_func=lambda arr: arr.max())
+    mon.install(net)
+    mon.tic()
+    net(mx.np.ones((2, 16))).wait_to_read()
+    res = mon.toc()
+    assert res == sorted(res, key=lambda t: t[1])
+    assert all("stat=" in r[2] for r in res)
+
+
+def test_toc_print_prints(capsys):
+    net = _net()
+    mon = monitor.Monitor(interval=1)
+    mon.install(net)
+    mon.tic()
+    net(mx.np.ones((2, 16))).wait_to_read()
+    mon.toc_print()
+    out = capsys.readouterr().out
+    assert "Batch:" in out and "Sequential" in out

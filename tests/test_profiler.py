@@ -57,6 +57,25 @@ def test_pause_resume(tmp_path):
     profiler.stop()
 
 
+def test_pause_resume_keeps_session_dir(tmp_path):
+    """One logdir per start()..dump() session: resume() must re-enter
+    the SAME trace directory, even if set_config changed in between."""
+    profiler.set_config(filename=str(tmp_path / "sess.json"))
+    profiler.start()
+    session_dir = profiler._state["dir"]
+    profiler.pause()
+    assert profiler._state["dir"] == session_dir
+    # a config change mid-session must not re-derive the dir on resume
+    profiler.set_config(filename=str(tmp_path / "other.json"))
+    profiler.resume()
+    assert profiler._state["dir"] == session_dir
+    profiler.dump()
+    # next session (no pause pending) derives a fresh dir
+    profiler.start()
+    assert profiler._state["dir"] == str(tmp_path / "other_xprof")
+    profiler.stop()
+
+
 def test_memory_profile_dump(tmp_path):
     """Storage-profiler parity: device memory profile dumps as pprof
     (reference: src/profiler/storage_profiler.h)."""
@@ -66,6 +85,126 @@ def test_memory_profile_dump(tmp_path):
     assert os.path.exists(p)
     assert os.path.getsize(p) > 0
     del keep
+
+
+def test_dumps_json_aggregate_roundtrip():
+    """dumps(format='json', aggregate_stats=True) parses, carries the
+    recorded counters, and orders sections by the requested sort."""
+    import json
+
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    telemetry.counter("test.alpha", 5)
+    telemetry.counter("test.beta", 2)
+    telemetry.value("test.dur", 10.0)
+    telemetry.value("test.dur", 30.0)
+    doc = json.loads(profiler.dumps(format="json", sort_by="total",
+                                    aggregate_stats=True))
+    assert doc["counters"]["test.alpha"] == 5
+    assert doc["counters"]["test.beta"] == 2
+    agg = doc["durations"]["test.dur"]
+    assert agg["count"] == 2
+    assert agg["total"] == pytest.approx(40.0)
+    assert agg["min"] == pytest.approx(10.0)
+    assert agg["max"] == pytest.approx(30.0)
+    assert agg["avg"] == pytest.approx(20.0)
+    # sort order round-trips: counters descend by value...
+    assert list(doc["counters"]) == ["test.alpha", "test.beta"]
+    asc = json.loads(profiler.dumps(format="json", sort_by="name",
+                                    ascending=True, aggregate_stats=True))
+    assert list(asc["counters"]) == ["test.alpha", "test.beta"]
+    desc = json.loads(profiler.dumps(format="json", sort_by="name",
+                                     aggregate_stats=True))
+    assert list(desc["counters"]) == ["test.beta", "test.alpha"]
+    # reset=True clears the registry after rendering
+    profiler.dumps(format="json", aggregate_stats=True, reset=True)
+    empty = json.loads(profiler.dumps(format="json", aggregate_stats=True))
+    assert empty["counters"] == {} and empty["durations"] == {}
+    telemetry.reset()
+
+
+def test_dumps_aggregate_after_hybridized_train_step():
+    """Acceptance: one hybridized train step populates compile,
+    step-timing, and memory-watermark rows in the aggregate table."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.loss import L2Loss
+    from mxnet_tpu.parallel.train_step import TrainStep
+
+    telemetry.reset()
+    net = nn.Sequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    net.hybridize()
+    x = mx.np.random.uniform(size=(2, 16))
+    net(x).wait_to_read()  # hybridized forward → CachedOp compile rows
+    step = TrainStep(net, L2Loss(), "sgd", {"learning_rate": 0.1})
+    step(x, mx.np.zeros((2, 4))).wait_to_read()
+    mx.waitall()
+
+    snap = telemetry.snapshot()
+    assert snap["durations"]["gluon.cachedop.compile"]["total"] > 0
+    assert snap["durations"]["parallel.train_step.compile"]["total"] > 0
+    assert snap["gauges"]["engine.live_bytes"]["peak"] > 0
+    assert snap["counters"]["gluon.cachedop.cache_miss"] >= 1
+
+    table = profiler.dumps(format="table", aggregate_stats=True)
+    assert "gluon.cachedop.compile" in table
+    assert "parallel.train_step.compile" in table
+    assert "engine.live_bytes" in table
+    # set_config(aggregate_stats=True) flips the default
+    profiler.set_config(aggregate_stats=True)
+    try:
+        assert "Profile Statistics" in profiler.dumps()
+    finally:
+        profiler.set_config(aggregate_stats=False)
+    telemetry.reset()
+
+
+def test_dumps_disabled_fast_path_records_nothing():
+    """With telemetry disabled, instrumented paths leave the registry
+    untouched and the table says so."""
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    prev = telemetry.set_enabled(False)
+    try:
+        x = mx.np.random.uniform(size=(16, 16))
+        (x @ x).wait_to_read()
+        mx.waitall()
+        assert telemetry.names() == []
+        assert "no telemetry recorded" in profiler.dumps(
+            aggregate_stats=True)
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_counter_thread_safety():
+    """profiler.Counter increments race-free across threads and mirrors
+    into the telemetry registry."""
+    import threading
+
+    from mxnet_tpu import telemetry
+    telemetry.reset()
+    c = profiler.Counter(name="race", value=0)
+    n_threads, per_thread = 8, 2000
+
+    def worker():
+        for _ in range(per_thread):
+            c.increment(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per_thread
+    assert telemetry.snapshot()["gauges"]["counter.race"]["value"] == \
+        n_threads * per_thread
+    # and it shows up in the aggregate dump
+    assert "counter.race" in profiler.dumps(aggregate_stats=True)
+    telemetry.reset()
 
 
 def test_profiler_scope_nesting_and_shims():

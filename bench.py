@@ -508,7 +508,218 @@ def _run_guarded():
     return 1
 
 
+# ---------------------------------------------------------------------------
+# --steady-state: host dispatch-path benchmark (CPU-runnable, <1 min).
+#
+# Measures steady-state steps/sec over a DataLoader-fed training loop
+# whose dataset size is NOT divisible by the batch size (the compile-
+# churn case), excluding the first N warmup steps, in two configs:
+#
+#   optimized: shape bucketing + TrainStep.warmup (AOT) + DeviceFeed
+#   baseline:  none of the above (the pre-PR-2 dispatch path)
+#
+# and reports per-config compile counts, mean batch-wait, mean enqueue
+# latency, and host dispatch overhead (enqueue + batch-wait + compile
+# time amortized per step) — the end-to-end evidence that bucketing +
+# the async feed removed host-side stalls. Dumps BENCH_r06.json.
+# ---------------------------------------------------------------------------
+STEADY_EPOCHS = 5
+
+
+def _steady_config(optimized: bool, X, Y, batch):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel, bucketing, telemetry
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    from mxnet_tpu.io import DeviceFeed
+
+    # deep enough that an entry rebuild costs real compile time (the
+    # churn under test), small enough that a step runs in ~1ms on CPU
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.array(X[:1]))  # materialize deferred shapes
+
+    policy = bucketing.BucketingPolicy(mode="pow2").clamped(batch) \
+        if optimized else None
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1}, mesh=None, bucketing=policy)
+    # numpy-backed dataset: per-sample indexing stays a host memcpy
+    # (an NDArray-backed dataset would dispatch one jax op per sample
+    # and the measurement would be dataset-bound, not dispatch-bound)
+    loader = DataLoader(ArrayDataset(X, Y),
+                        batch_size=batch, prefetch=2, bucketing=policy)
+    source = DeviceFeed(loader, step=step, depth=2) if optimized \
+        else loader
+    if optimized:
+        # warm BOTH signatures the epoch produces: the full batch and
+        # the bucket the odd tail pads into — zero in-loop compiles
+        sizes = {batch, policy.bucket(len(X) % batch or batch)}
+        step.warmup([((b, X.shape[1]), (b,)) for b in sorted(sizes)])
+
+    telemetry.reset()
+    t_start = time.perf_counter()
+    t_warm = None
+    steps = warm_steps = 0
+    loss = None
+    for epoch in range(STEADY_EPOCHS):
+        for d, l in source:
+            loss = step(d, l)
+            steps += 1
+        if epoch == 0:
+            # the whole first epoch is warmup: entry compiles
+            # (baseline), eager pad-op compiles, thread spin-up.
+            # Reset telemetry with the clock so the reported stalls
+            # describe the steady window only.
+            float(loss.asnumpy())  # drain the warmup queue
+            warm_snap = telemetry.snapshot(reset_after=True)
+            t_warm = time.perf_counter()
+            warm_steps = steps
+    float(loss.asnumpy())  # steady window ends on a real sync
+    t_end = time.perf_counter()
+    if optimized:
+        source.stop()
+
+    snap = telemetry.snapshot()
+    dur, cnt = snap["durations"], snap["counters"]
+    warm_dur = warm_snap["durations"]
+
+    def total(name):
+        return dur.get(name, {}).get("total", 0.0)
+
+    def mean(name):
+        return dur.get(name, {}).get("avg", 0.0)
+
+    steady_steps = steps - warm_steps
+
+    def wtotal(name):
+        return warm_dur.get(name, {}).get("total", 0.0)
+
+    # compile churn on the dispatch path (the odd-batch rebuild
+    # bucketing removes; warmup's AOT compile runs BEFORE the measured
+    # loop by design). Steady-window compiles would mean churn that
+    # bucketing failed to remove.
+    compile_warm_ms = (wtotal("parallel.train_step.compile")
+                       + wtotal("parallel.train_step.build"))
+    compile_steady_ms = (total("parallel.train_step.compile")
+                         + total("parallel.train_step.build"))
+    # the stall the training loop actually sees: the last pipeline
+    # stage before dispatch (DeviceFeed when active, else the loader's
+    # prefetcher) — not the sum of every internal stage's wait
+    wait_key = "io.device_feed.wait" if optimized \
+        else "io.dataloader.batch_wait"
+    batch_wait_ms = total(wait_key)
+    enqueue_ms = total("parallel.train_step.run")
+    # whole-run host dispatch overhead: every ms the loop spent NOT
+    # having work enqueued on the device — feed stalls, enqueue
+    # latency, and compiles that landed on the dispatch path (a build
+    # blocking step() stalls dispatch exactly like a slow enqueue;
+    # warmup+bucketing exist to remove those)
+    overhead_all = (enqueue_ms + wtotal("parallel.train_step.run")
+                    + batch_wait_ms + wtotal(wait_key)
+                    + compile_steady_ms + compile_warm_ms)
+    return {
+        "optimized": optimized,
+        "steps": steps,
+        "warmup_steps_excluded": warm_steps,
+        "steps_per_sec_steady": round(
+            steady_steps / max(t_end - t_warm, 1e-9), 2),
+        "steps_per_sec_total": round(
+            steps / max(t_end - t_start, 1e-9), 2),
+        "compile_count": int(
+            cnt.get("parallel.train_step.build", 0)
+            + warm_snap["counters"].get("parallel.train_step.build", 0)),
+        "compile_ms_warmup_window": round(compile_warm_ms, 2),
+        "compile_ms_steady_window": round(compile_steady_ms, 2),
+        "bucket_pads": int(cnt.get("parallel.train_step.bucket_pad", 0)
+                           + cnt.get("io.dataloader.bucket_pad", 0)),
+        "mean_batch_wait_ms": round(mean(wait_key), 4),
+        "mean_enqueue_ms": round(mean("parallel.train_step.run"), 4),
+        "steady_dispatch_overhead_ms_per_step": round(
+            (enqueue_ms + batch_wait_ms + compile_steady_ms)
+            / max(steady_steps, 1), 4),
+        "host_dispatch_overhead_ms_per_step": round(
+            overhead_all / steps, 4),
+        "final_loss": float(loss.asnumpy()),
+    }
+
+
+STEADY_BATCH, STEADY_ROWS, STEADY_FEAT = 16, 602, 16  # 602 % 16 = 10
+
+
+def _steady_child(optimized: bool):
+    """One config, one fresh process: jit dispatch caches, engine
+    tracking, and XLA thread pools from config A must not contaminate
+    config B's measurement (they swing a 1-vCPU box by 2-3x)."""
+    import numpy as onp
+    rng = onp.random.RandomState(0)
+    X = rng.randn(STEADY_ROWS, STEADY_FEAT).astype(onp.float32)
+    Y = rng.randint(0, 4, STEADY_ROWS).astype(onp.int32)
+    print(json.dumps(_steady_config(optimized, X, Y, STEADY_BATCH)),
+          flush=True)
+    return 0
+
+
+def _steady_state_main():
+    # pin CPU unless the caller explicitly chose a platform: this mode
+    # must run un-watchdogged on a laptop/CI box without risking a
+    # hung TPU init
+    if not os.environ.get("JAX_PLATFORMS") \
+            and not os.environ.get("MXTPU_PLATFORM"):
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if os.environ.get("BENCH_STEADY_CONFIG"):
+        return _steady_child(
+            os.environ["BENCH_STEADY_CONFIG"] == "optimized")
+
+    results = {}
+    for name in ("baseline", "optimized"):
+        _stage(f"steady-state: {name} config")
+        env = dict(os.environ, BENCH_STEADY_CONFIG=name)
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--steady-state"],
+            env=env, capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            print(f"[bench] steady-state {name} failed: "
+                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
+                  flush=True)
+            return 1
+        results[name] = json.loads(_harvest(out.stdout))
+    baseline, optimized = results["baseline"], results["optimized"]
+
+    import jax
+    jax.config.update("jax_platforms",
+                      os.environ.get("JAX_PLATFORMS", "cpu"))
+    batch, n_rows = STEADY_BATCH, STEADY_ROWS
+    doc = {
+        "metric": "steady_state_steps_per_sec",
+        "value": optimized["steps_per_sec_steady"],
+        "unit": "steps/sec",
+        "platform": jax.default_backend(),
+        "device_kind": jax.devices()[0].device_kind,
+        "batch": batch,
+        "dataset_rows": n_rows,
+        "epochs": STEADY_EPOCHS,
+        "optimized": optimized,
+        "baseline": baseline,
+        "dispatch_overhead_reduction": round(
+            1.0 - optimized["host_dispatch_overhead_ms_per_step"]
+            / max(baseline["host_dispatch_overhead_ms_per_step"], 1e-9),
+            4),
+    }
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       os.environ.get("BENCH_STEADY_OUT",
+                                      "BENCH_r06.json"))
+    with open(out, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    return 0
+
+
 def main():
+    if "--steady-state" in sys.argv:
+        return _steady_state_main()
     # Parent mode: delegate to a watchdogged child (see _run_guarded).
     if os.environ.get("BENCH_CHILD") != "1":
         with _SupervisorPause():

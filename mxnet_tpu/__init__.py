@@ -81,13 +81,19 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "rtc", "contrib", "library", "visualization", "operator",
              "model", "callback", "name", "attribute", "registry",
              "error", "log", "misc", "dlpack", "executor", "telemetry",
-             "monitor"):
+             "monitor", "bucketing", "compile_cache"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:
         if f"mxnet_tpu.{_mod}" not in str(_e):
             raise
 del _importlib, _mod
+
+# Persistent XLA compilation cache (MXTPU_COMPILE_CACHE_DIR): wire the
+# jax.config knobs before the first compile so cold starts replay
+# yesterday's executables from disk (docs/PERFORMANCE.md).
+if "compile_cache" in globals():
+    globals()["compile_cache"].configure()
 
 if "attribute" in globals():
     AttrScope = globals()["attribute"].AttrScope

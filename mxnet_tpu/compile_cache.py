@@ -1,0 +1,105 @@
+"""Persistent AOT compilation cache — cold-start compiles survive
+process restarts.
+
+JAX ships a persistent compilation cache (executables keyed by HLO
+fingerprint, written to a directory); wiring it up means the second
+process launch replays every XLA compile from disk instead of
+re-running the compiler. This module owns the knobs:
+
+- ``MXTPU_COMPILE_CACHE_DIR`` — set to a directory to enable (created
+  if missing). `configure()` runs at package import; call it again
+  with an explicit path to (re)point the cache at runtime.
+- ``MXTPU_COMPILE_CACHE_MIN_COMPILE_SECS`` — only persist compiles
+  slower than this (default 0: persist everything, so even the tiny
+  tier-1 graphs exercise the cache).
+
+Telemetry: every instrumented compile site (`CachedOp`,
+`TrainStep.__call__`/`warmup`) wraps its first dispatch in
+`measure()`, which classifies the compile as a persistent-cache *hit*
+(no new cache entry appeared → XLA replayed from disk) or *miss* (a
+new entry was written) and records the wall time:
+
+- ``compile_cache.hit`` / ``compile_cache.miss`` counters
+- ``compile_cache.compile`` duration (ms)
+- ``compile_cache.entries`` gauge (files in the cache dir)
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+from . import telemetry
+
+__all__ = ["configure", "enabled", "cache_dir", "entry_count", "measure"]
+
+_dir: str | None = None
+# hit/miss classification is only sound when every compile persists
+# (min-compile-secs 0) — a compile below the threshold writes no entry
+# and would be misread as a hit. Concurrent processes sharing the dir
+# can still skew counts; treat them as indicative, not exact.
+_classify = True
+
+
+def configure(path: str | None = None) -> str | None:
+    """Point JAX's persistent compilation cache at ``path`` (default:
+    ``MXTPU_COMPILE_CACHE_DIR``). No-op (returns None) when neither is
+    set. Returns the active cache dir."""
+    global _dir, _classify
+    path = path or os.environ.get("MXTPU_COMPILE_CACHE_DIR")
+    if not path:
+        return None
+    import jax
+    os.makedirs(path, exist_ok=True)
+    min_secs = float(os.environ.get(
+        "MXTPU_COMPILE_CACHE_MIN_COMPILE_SECS", "0"))
+    _classify = min_secs == 0
+    for knob, val in (
+            ("jax_compilation_cache_dir", path),
+            ("jax_persistent_cache_min_compile_time_secs", min_secs),
+            ("jax_persistent_cache_min_entry_size_bytes", -1)):
+        try:
+            jax.config.update(knob, val)
+        except Exception:  # noqa: BLE001 — knob missing on this jax
+            pass
+    _dir = path
+    telemetry.gauge("compile_cache.entries", entry_count())
+    return _dir
+
+
+def enabled() -> bool:
+    return _dir is not None
+
+
+def cache_dir() -> str | None:
+    return _dir
+
+
+def entry_count() -> int:
+    """Number of persisted executables in the cache dir."""
+    if _dir is None:
+        return 0
+    try:
+        return sum(1 for e in os.scandir(_dir) if e.is_file())
+    except OSError:
+        return 0
+
+
+@contextlib.contextmanager
+def measure(site: str = "compile"):
+    """Wrap one compile; classify persistent-cache hit/miss by whether
+    the cache directory grew, and record the wall time. Free (yields
+    immediately, no fs access) when the cache is disabled."""
+    if _dir is None or not telemetry.enabled():
+        yield
+        return
+    before = entry_count()
+    t0 = telemetry.clock()
+    try:
+        yield
+    finally:
+        telemetry.duration_since("compile_cache.compile", t0)
+        after = entry_count()
+        telemetry.gauge("compile_cache.entries", after)
+        if _classify:
+            telemetry.counter("compile_cache.miss" if after > before
+                              else "compile_cache.hit")

@@ -12,13 +12,13 @@ src/io/iter_prefetcher.h double buffering).
 """
 from __future__ import annotations
 
-import queue
-import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as onp
 
+from ... import bucketing as _bucketing
 from ... import telemetry
+from ..._bounded_worker import BoundedQueueWorker
 from ...ndarray.ndarray import NDArray
 from .dataset import Dataset
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -41,27 +41,15 @@ def default_mp_batchify_fn(data):
     return default_batchify_fn(data)
 
 
-class _Prefetcher(threading.Thread):
-    _DONE = object()
+class _Prefetcher(BoundedQueueWorker):
+    """Background batch producer (shutdown contract — including the
+    consumer-exits-mid-epoch drain-and-join — lives in
+    ``_bounded_worker.BoundedQueueWorker``)."""
 
     def __init__(self, it, depth):
-        super().__init__(daemon=True)
+        super().__init__(depth, name="DataLoaderPrefetcher")
         self._it = it
-        self._queue = queue.Queue(maxsize=depth)
-        self._stopped = False
         self.start()
-
-    def _put(self, item):
-        """put() that gives up when the consumer abandoned iteration
-        (otherwise one thread + its buffered batches leak per
-        partially-consumed epoch)."""
-        while not self._stopped:
-            try:
-                self._queue.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
 
     def run(self):
         try:
@@ -73,15 +61,6 @@ class _Prefetcher(threading.Thread):
                 return
         self._put(self._DONE)
 
-    def stop(self):
-        self._stopped = True
-        # drain so a blocked put() can observe the flag promptly
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue.Empty:
-            pass
-
     def __iter__(self):
         try:
             while True:
@@ -90,7 +69,7 @@ class _Prefetcher(threading.Thread):
                 # the end-of-epoch sentinel wait is NOT a batch stall,
                 # so it records nothing
                 t0 = telemetry.clock()
-                item = self._queue.get()
+                item = self._get()
                 if item is self._DONE:
                     return
                 telemetry.duration_since("io.dataloader.batch_wait", t0)
@@ -200,6 +179,19 @@ def _tree_from_shm(obj):
     return obj
 
 
+def _leading_dim(tree):
+    """Batch size of a batchified tree: the leading dim of its first
+    NDArray leaf (None when there is none)."""
+    if isinstance(tree, NDArray):
+        return tree.shape[0] if tree.ndim else None
+    if isinstance(tree, (list, tuple)):
+        for x in tree:
+            n = _leading_dim(x)
+            if n is not None:
+                return n
+    return None
+
+
 def _tree_unlink_shm(obj):
     """Release shm descriptors of an unconsumed batch."""
     if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
@@ -221,12 +213,21 @@ class DataLoader:
                  sampler=None, last_batch=None, batch_sampler=None,
                  batchify_fn=None, num_workers=0, pin_memory=False,
                  pin_device_id=0, prefetch=None, thread_pool=True,
-                 timeout=120, try_nopython=None):
+                 timeout=120, try_nopython=None, bucketing=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._num_workers = max(0, num_workers)
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * self._num_workers)
+        # bucketing: pad the final short batch (last_batch="keep") up
+        # to the policy's bucket, clamped at batch_size, and mark the
+        # pad on the leaves so TrainStep masks the padded rows out of
+        # the loss — every epoch then replays already-compiled shape
+        # signatures (docs/PERFORMANCE.md)
+        policy = _bucketing.as_policy(bucketing)
+        if policy is not None and batch_size is not None:
+            policy = policy.clamped(batch_size)
+        self._bucketing = policy
 
         if batch_sampler is None:
             if batch_size is None:
@@ -264,7 +265,30 @@ class DataLoader:
             samples = list(self._pool.map(self._dataset.__getitem__, indices))
         else:
             samples = [self._dataset[i] for i in indices]
-        return self._batchify_fn(samples)
+        return self._bucket_pad(self._batchify_fn(samples), len(indices))
+
+    def _bucket_pad(self, batch, n_real):
+        """Pad a short batch's NDArray leaves up to the bucket (leaves
+        carrying n_real on axis 0), marking the pad for the loss mask."""
+        if self._bucketing is None or not n_real:
+            return batch
+        target = self._bucketing.bucket(n_real)
+        if target <= n_real:
+            return batch
+        telemetry.counter("io.dataloader.bucket_pad")
+
+        def pad(obj):
+            if isinstance(obj, NDArray):
+                if obj.ndim and obj.shape[0] == n_real:
+                    padded, _ = _bucketing.pad_leaves([obj], target,
+                                                      n_real)
+                    return padded[0]
+                return obj
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(pad(x) for x in obj)
+            return obj
+
+        return pad(batch)
 
     def _ensure_proc_pool(self):
         if self._proc_pool is None:
@@ -320,7 +344,10 @@ class DataLoader:
                             f"the timeout (pass timeout=N).") from e
                     raise
                 submit()
-                yield _tree_from_shm(res)
+                tree = _tree_from_shm(res)
+                if self._bucketing is not None:
+                    tree = self._bucket_pad(tree, _leading_dim(tree))
+                yield tree
         finally:
             # abandoned epoch (break / exception / timeout): the
             # workers unregistered their segments, so unconsumed

@@ -12,6 +12,7 @@ import threading
 
 import numpy as onp
 
+from .. import bucketing as _bucketing
 from .. import telemetry
 from ..ndarray.ndarray import NDArray
 
@@ -86,9 +87,16 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", bucketing=None):
         super().__init__(batch_size)
         assert last_batch_handle in ("pad", "discard", "roll_over")
+        # bucketing: the final partial batch pads up to the policy's
+        # bucket (clamped at batch_size) instead of always to a full
+        # batch — a stable, reusable signature with fewer wasted rows.
+        # getpad()/the pad marks report the padding so TrainStep masks
+        # it out of the loss (docs/PERFORMANCE.md).
+        policy = _bucketing.as_policy(bucketing)
+        self._bucketing = policy.clamped(batch_size) if policy else None
         self.data = _init_data(data, allow_empty=False, default_name=data_name)
         self.label = _init_data(label, allow_empty=True,
                                 default_name=label_name)
@@ -138,20 +146,35 @@ class NDArrayIter(DataIter):
                 return False
         return True
 
+    def _pad_target(self, real: int) -> int:
+        """Rows the final partial batch pads up to: the clamped bucket
+        under a bucketing policy, a full batch otherwise."""
+        if self._bucketing is not None:
+            return max(self._bucketing.bucket(real), real)
+        return self.batch_size
+
     def _slice(self, arrays):
         from ..numpy import array
         start = self.cursor
         end = min(start + self.batch_size, self._epoch_size)
+        target = self._pad_target(end - start) \
+            if end - start < self.batch_size else self.batch_size
         out = []
         for _, arr in arrays:
             sel = self._order[start:end]
             batch = arr[sel]
-            if end - start < self.batch_size:
+            pad = target - (end - start)
+            if pad > 0:
                 # 'pad': wrap around to the epoch start; getpad() reports it
-                pad = self.batch_size - (end - start)
-                batch = onp.concatenate([batch, arr[self._order[:pad]]],
-                                        axis=0)
-            out.append(array(batch))
+                batch = onp.concatenate(
+                    [batch, arr[self._order[:pad]]], axis=0)
+            nd = array(batch)
+            if pad > 0 and self._bucketing is not None:
+                # only a bucketing opt-in marks the rows for loss
+                # masking — the default 'pad' pipeline keeps the
+                # reference semantics (wrapped rows DO train)
+                _bucketing.mark_pad(nd, pad)
+            out.append(nd)
         return out
 
     def getdata(self):
@@ -161,9 +184,9 @@ class NDArrayIter(DataIter):
         return self._slice(self.label)
 
     def getpad(self):
-        end = self.cursor + self.batch_size
-        if self.last_batch_handle == "pad" and end > self._epoch_size:
-            return end - self._epoch_size
+        real = self._epoch_size - self.cursor
+        if self.last_batch_handle == "pad" and real < self.batch_size:
+            return self._pad_target(real) - real
         return 0
 
 
@@ -314,5 +337,6 @@ class PrefetchingIter(DataIter):
         return self.current_batch.pad
 
 
+from .device_feed import DeviceFeed  # noqa: E402,F401
 from .legacy_iters import (  # noqa: E402,F401 - reference iterator names
     CSVIter, LibSVMIter, MNISTIter, ImageRecordIter)

@@ -1,0 +1,155 @@
+"""DeviceFeed — async host→device transfer, one batch ahead of dispatch.
+
+`TrainStep.__call__` used to pay a synchronous `jax.device_put` of
+every batch on the dispatch path; the `io.*.batch_wait` telemetry shows
+the host stalling there while the device sits idle. The reference
+overlaps this with engine pipelining (its PrefetchingIter +
+`iter_prefetcher.h` double buffering); the TPU-native equivalent is
+this stage: a background thread that pulls batches from any iterable
+(gluon ``DataLoader``, ``io.PrefetchingIter``, a plain generator),
+pads them to the step's bucketing policy, runs ``device_put`` onto the
+step's compiled-entry shardings (``data_sh``/``label_sh``), and hands
+the consumer device-resident batches through a bounded queue
+(``depth=2`` → classic double buffering). H2D of batch N+1 overlaps
+the device compute of batch N; `TrainStep` detects already-placed
+leaves and skips its own transfer.
+
+Usage::
+
+    feed = DeviceFeed(loader, step=train_step)
+    for data, label in feed:
+        loss = train_step(data, label)   # no H2D on this path
+
+Items may be ``(data, label)`` pairs, ``io.DataBatch`` objects (their
+``.pad`` is forwarded as a pad mark so padded rows are masked from the
+loss), or anything else (passed through untouched). Telemetry:
+``io.device_feed.put`` (H2D ms, worker side), ``io.device_feed.wait``
+(consumer stall ms), ``io.device_feed.batches``.
+"""
+from __future__ import annotations
+
+from .. import bucketing as _bucketing
+from .. import telemetry
+from .._bounded_worker import BoundedQueueWorker
+
+__all__ = ["DeviceFeed"]
+
+
+class _FeedWorker(BoundedQueueWorker):
+    """Bounded-queue transfer stage (shutdown contract shared with the
+    DataLoader prefetcher via ``_bounded_worker.BoundedQueueWorker``)."""
+
+    def __init__(self, it, transform, depth):
+        super().__init__(depth, name="DeviceFeed")
+        self._it = it
+        self._transform = transform
+        self.start()
+
+    def run(self):
+        try:
+            for item in self._it:
+                t0 = telemetry.clock()
+                out = self._transform(item)
+                telemetry.duration_since("io.device_feed.put", t0)
+                if not self._put(out):
+                    return
+        except Exception as e:  # noqa: BLE001 — propagate into consumer
+            if not self._put(e):
+                return
+        self._put(self._DONE)
+
+    def __iter__(self):
+        try:
+            while True:
+                t0 = telemetry.clock()
+                item = self._get()
+                if item is self._DONE:
+                    return
+                telemetry.duration_since("io.device_feed.wait", t0)
+                if isinstance(item, Exception):
+                    raise item
+                telemetry.counter("io.device_feed.batches")
+                yield item
+        finally:
+            self.stop()
+
+
+class DeviceFeed:
+    """Wrap a batch source so batches arrive device-resident.
+
+    Parameters
+    ----------
+    source : iterable
+        Re-iterable batch source (``DataLoader``, ``PrefetchingIter``,
+        generator factory...). Each ``iter(feed)`` starts one worker.
+    step : parallel.TrainStep, optional
+        Transfers target the step's compiled-entry shardings (and its
+        bucketing policy pads partial batches before the transfer).
+        Batches whose entry is not built yet pass through on host —
+        the first step's build path handles them.
+    depth : int
+        Queue depth; 2 = double buffering (one batch transferring
+        while one is consumed).
+    """
+
+    def __init__(self, source, step=None, depth: int = 2):
+        self._source = source
+        self._step = step
+        self._depth = max(1, int(depth))
+        self._worker = None
+
+    # -- transfer -------------------------------------------------------
+    def _transfer_pair(self, data, label, pad=None):
+        if self._step is not None:
+            return self._step.prepare_batch(data, label, pad=pad)
+        if pad:
+            data = _mark_tree(data, pad)
+            label = _mark_tree(label, pad)
+        return data, label
+
+    def _transform(self, item):
+        from . import DataBatch
+        if isinstance(item, DataBatch):
+            data, label = self._transfer_pair(
+                tuple(item.data or ()), tuple(item.label or ()),
+                pad=item.pad or 0)
+            return DataBatch(data=list(data), label=list(label),
+                             pad=item.pad, index=item.index,
+                             bucket_key=item.bucket_key,
+                             provide_data=item.provide_data,
+                             provide_label=item.provide_label)
+        if isinstance(item, (tuple, list)) and len(item) == 2:
+            data, label = self._transfer_pair(item[0], item[1])
+            return type(item)((data, label))
+        return item
+
+    # -- iteration ------------------------------------------------------
+    def __iter__(self):
+        self.stop()
+        self._worker = _FeedWorker(iter(self._source), self._transform,
+                                   self._depth)
+        return iter(self._worker)
+
+    def __len__(self):
+        return len(self._source)
+
+    def stop(self):
+        """Shut down the in-flight worker (idempotent)."""
+        if self._worker is not None:
+            self._worker.stop()
+            self._worker = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+def _mark_tree(obj, pad):
+    from ..ndarray.ndarray import NDArray
+    if isinstance(obj, NDArray):
+        return _bucketing.mark_pad(obj, pad)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_mark_tree(x, pad) for x in obj)
+    return obj

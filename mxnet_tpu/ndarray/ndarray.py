@@ -56,7 +56,7 @@ class NDArray:
     """An async, device-resident n-dimensional array."""
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_node",
-                 "_fresh_grad", "_version", "__weakref__")
+                 "_fresh_grad", "_version", "_bucket_pad", "__weakref__")
 
     # Make `ndarray op numpy_array` hit our reflected ops, not numpy's.
     __array_priority__ = 1000.0

@@ -15,13 +15,20 @@ step-by-step parity with the reference's
 """
 from __future__ import annotations
 
+import contextlib as _contextlib
 import re
+
+_nullcontext = _contextlib.nullcontext
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import numpy as onp
+
 from .. import autograd
+from .. import bucketing as _bucketing
+from .. import compile_cache
 from .. import engine
 from .. import telemetry
 from ..ndarray.ndarray import NDArray
@@ -51,11 +58,18 @@ class TrainStep:
     batch_axis : mesh axis name the leading batch dim is sharded over
     param_rules : list of (regex, PartitionSpec) giving tensor-parallel
         placements by parameter name; unmatched params are replicated.
+    bucketing : BucketingPolicy, optional
+        Pad odd batches (the last partial batch of every epoch) up to
+        a bucket so they reuse an existing compiled entry instead of
+        forcing a rebuild; padded rows are masked out of the loss.
+        None (default) inherits the process-global
+        `mxnet_tpu.bucketing` policy; ``False`` opts this step out of
+        even the global policy (exact unpadded behavior).
     """
 
     def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, batch_axis=AXIS_DP, param_rules=None,
-                 donate=True):
+                 donate=True, bucketing=None):
         from .. import optimizer as opt_mod
         self.net = net
         self.loss_fn = loss_fn
@@ -67,6 +81,10 @@ class TrainStep:
         self.param_rules = [(re.compile(pat), spec)
                             for pat, spec in (param_rules or [])]
         self.donate = donate
+        # False is a distinct value: "no bucketing, not even the
+        # global policy" (as_policy would collapse it to None = inherit)
+        self.bucketing = False if bucketing is False \
+            else _bucketing.as_policy(bucketing)
         self._entries = {}
         self._opt_states = None  # shared across signatures: a shape
         self._mp_flags = None    # change (last odd batch) must NOT
@@ -119,7 +137,7 @@ class TrainStep:
         label_ctxs = [l.ctx for l in label_leaves]
 
         def forward_loss(key, diff_datas, frozen_datas,
-                         input_datas, label_datas):
+                         input_datas, label_datas, n_valid):
             saved = [nd._data for nd in all_nds]
             scope = _deferred.trace_scope()
             rec = autograd._RecordingScope(False, True)
@@ -141,7 +159,26 @@ class TrainStep:
                     else:
                         loss = out
                     if loss.ndim > 0:
-                        loss = loss.mean()
+                        # mean over the VALID rows only: bucketing pads
+                        # a partial batch up to a stable signature and
+                        # passes n_valid < batch; the where (not a
+                        # multiply) keeps a non-finite padded-row loss
+                        # from poisoning the sum via 0*inf. With
+                        # n_valid == batch this is exactly loss.mean().
+                        ld = loss._data
+                        mask = jnp.arange(ld.shape[0]) < n_valid
+                        mask = mask.reshape((ld.shape[0],)
+                                            + (1,) * (ld.ndim - 1))
+                        per_row = ld.size // ld.shape[0]
+                        denom = jnp.maximum(n_valid, 1) * per_row
+                        loss = NDArray(
+                            jnp.where(mask, ld, 0).sum() / denom,
+                            ctx=loss.ctx)
+                    else:
+                        # loss_fn reduced to a scalar itself: there is
+                        # no per-row axis left to mask — dispatch warns
+                        # if this entry ever receives a padded batch
+                        out_box["scalar_loss"] = True
                 finally:
                     for nd, s in zip(all_nds, saved):
                         nd._data = s
@@ -153,10 +190,10 @@ class TrainStep:
         n_diff = len(diff_nds)
 
         def step_fn(key, diff_datas, frozen_datas, opt_states, hypers,
-                    input_datas, label_datas):
+                    input_datas, label_datas, n_valid):
             def loss_f(dd):
                 return forward_loss(key, dd, frozen_datas,
-                                    input_datas, label_datas)
+                                    input_datas, label_datas, n_valid)
 
             (loss, aux), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(diff_datas)
@@ -229,7 +266,7 @@ class TrainStep:
                         for k in range(n_diff)]
             jit_kwargs["in_shardings"] = (
                 rep, tuple(diff_sh), tuple(frozen_sh),
-                tuple(state_sh), hyper_sh, data_sh, label_sh)
+                tuple(state_sh), hyper_sh, data_sh, label_sh, rep)
             # aux (BN stats) shardings: let XLA decide (None subtree)
             jit_kwargs["out_shardings"] = (tuple(diff_sh),
                                            tuple(state_sh), rep, None)
@@ -297,17 +334,18 @@ class TrainStep:
                     for nd in out_box.get("aux_targets", [])]
             return aux_pos_box["pos"]
 
-        def chain_fn(key, diff, frozen, states, hypers, datas, labels):
+        def chain_fn(key, diff, frozen, states, hypers, datas, labels,
+                     n_valids):
             n = datas[0].shape[0]
 
             def body(carry, xs):
                 key, diff, frozen, states, t_off = carry
                 ks = jax.random.split(key)
                 key, sub = ks[0], ks[1]
-                d, l = xs
+                d, l, nv = xs
                 hy = [{**h, "t": h["t"] + t_off} for h in hypers]
                 new_ws, new_ss, loss, aux = step_fn(
-                    sub, diff, frozen, states, hy, d, l)
+                    sub, diff, frozen, states, hy, d, l, nv)
                 frozen2 = list(frozen)
                 for pos, a in zip(_aux_positions(), aux):
                     if pos >= 0:
@@ -317,7 +355,8 @@ class TrainStep:
 
             (key, diff, frozen, states, _), (losses, auxs) = \
                 jax.lax.scan(body, (key, diff, frozen, states,
-                                    jnp.int32(0)), (datas, labels))
+                                    jnp.int32(0)),
+                             (datas, labels, n_valids))
             last_aux = jax.tree.map(lambda a: a[n - 1], auxs)
             return diff, frozen, states, losses, last_aux
 
@@ -328,7 +367,7 @@ class TrainStep:
             kw["donate_argnums"] = (1, 2, 3)
         if "in_shardings" in base:
             (rep, diff_sh, frozen_sh, state_sh, hyper_sh,
-             data_sh, label_sh) = base["in_shardings"]
+             data_sh, label_sh, _nv_sh) = base["in_shardings"]
             mesh = self.mesh
 
             def lift(sh):
@@ -339,17 +378,90 @@ class TrainStep:
             chain_label_sh = tuple(lift(s) for s in label_sh)
             kw["in_shardings"] = (
                 rep, diff_sh, frozen_sh, state_sh, hyper_sh,
-                chain_data_sh, chain_label_sh)
+                chain_data_sh, chain_label_sh, rep)
             kw["out_shardings"] = (diff_sh, frozen_sh, state_sh,
                                    rep, None)
-        return (jax.jit(chain_fn, **kw), _aux_positions,
-                chain_data_sh, chain_label_sh)
+        return {"jit": jax.jit(chain_fn, **kw),
+                "aux_positions": _aux_positions,
+                "data_sh": chain_data_sh,
+                "label_sh": chain_label_sh,
+                "dispatched": False}
 
-    def run_chain(self, data, label):
+    # -- bucketing / signatures ----------------------------------------
+    def _effective_policy(self):
+        if self.bucketing is False:
+            return None
+        return self.bucketing if self.bucketing is not None \
+            else _bucketing.get_policy()
+
+    @staticmethod
+    def _sig(data_leaves, label_leaves, data_spec, label_spec):
+        return (tuple((l.shape, str(l.dtype)) for l in data_leaves),
+                tuple((l.shape, str(l.dtype)) for l in label_leaves),
+                repr(data_spec), repr(label_spec))
+
+    def _apply_bucketing(self, data_leaves, label_leaves, pad):
+        """Resolve the pad count for one batch: an explicit ``pad``
+        argument wins, then pad marks left by the data pipeline, then
+        the active bucketing policy (which pads the leaves here).
+        Returns (data_leaves, label_leaves, pad)."""
+        if pad is not None:
+            return list(data_leaves), list(label_leaves), int(pad)
+        pad = max([_bucketing.get_pad(l)
+                   for l in list(data_leaves) + list(label_leaves)]
+                  or [0])
+        if pad:
+            return list(data_leaves), list(label_leaves), pad
+        policy = self._effective_policy()
+        bsz = next((l.shape[0] for l in data_leaves if l.ndim), None)
+        if policy is not None and bsz is not None:
+            target = policy.bucket(bsz)
+            if target > bsz:
+                telemetry.counter("parallel.train_step.bucket_pad")
+                data_leaves, pad = _bucketing.pad_leaves(
+                    data_leaves, target, bsz)
+                label_leaves, _ = _bucketing.pad_leaves(
+                    label_leaves, target, bsz)
+                return data_leaves, label_leaves, pad
+        return list(data_leaves), list(label_leaves), 0
+
+    def _get_entry(self, data_leaves, data_spec, label_leaves,
+                   label_spec):
+        sig = self._sig(data_leaves, label_leaves, data_spec, label_spec)
+        entry = self._entries.get(sig)
+        if entry is None:
+            telemetry.counter("parallel.train_step.build")
+            t0 = telemetry.clock()
+            entry = self._build(data_leaves, data_spec, label_leaves,
+                                label_spec)
+            telemetry.duration_since("parallel.train_step.build", t0)
+            self._entries[sig] = entry
+        return sig, entry
+
+    def _check_maskable(self, entry, pad):
+        """A padded batch whose loss_fn already reduced to a scalar
+        cannot be masked — the padded rows WILL contribute. Surface
+        that loudly instead of silently breaking the bit-identical
+        guarantee."""
+        if pad and entry["out_box"].get("scalar_loss") \
+                and not getattr(self, "_warned_scalar_loss", False):
+            import warnings
+            self._warned_scalar_loss = True
+            warnings.warn(
+                "TrainStep received a padded batch but loss_fn returns "
+                "a scalar (already reduced over the batch): padded rows "
+                "cannot be masked out of the loss and WILL affect "
+                "training. Return a per-sample loss (gluon.loss.* "
+                "default) to make padding exact, or disable bucketing "
+                "for this step (bucketing=False).")
+
+    def run_chain(self, data, label, pad=None):
         """Run `data.shape[0]` chained training steps in one compiled
         XLA program (bulk mode). `data`/`label` carry a leading steps
-        axis: ``(n_steps, batch, ...)``. Returns the per-step losses
-        as an NDArray of shape ``(n_steps,)``."""
+        axis: ``(n_steps, batch, ...)``. ``pad`` (int or length-
+        ``n_steps`` sequence) marks trailing padded rows per step;
+        their loss contribution is masked out. Returns the per-step
+        losses as an NDArray of shape ``(n_steps,)``."""
         data_t, label_t = _as_tuple(data), _as_tuple(label)
         data_leaves, data_spec = _flatten_arrays(data_t)
         label_leaves, label_spec = _flatten_arrays(label_t)
@@ -358,25 +470,22 @@ class TrainStep:
         # per-batch entry (strip the steps axis for the signature)
         one_data = [l[0] for l in data_leaves]
         one_label = [l[0] for l in label_leaves]
-        sig = (tuple((l.shape, str(l.dtype)) for l in one_data),
-               tuple((l.shape, str(l.dtype)) for l in one_label),
-               repr(data_spec), repr(label_spec))
-        entry = self._entries.get(sig)
-        if entry is None:
-            telemetry.counter("parallel.train_step.build")
-            t0 = telemetry.clock()
-            entry = self._build(one_data, data_spec, one_label,
-                                label_spec)
-            telemetry.duration_since("parallel.train_step.build", t0)
-            self._entries[sig] = entry
+        sig, entry = self._get_entry(one_data, data_spec, one_label,
+                                     label_spec)
         chain_key = ("chain", sig, n_steps)
         chain = self._entries.get(chain_key)
-        chain_fresh = chain is None
-        if chain_fresh:
+        if chain is None:
+            # chain_build times the (cheap) trace-graph construction;
+            # the first dispatch below carries the XLA compile and is
+            # recorded separately as chain_compile — same split as
+            # __call__'s build vs compile (a warm chain re-keyed by
+            # n_steps must not book its whole run as compile time)
             telemetry.counter("parallel.train_step.chain_build")
+            t0 = telemetry.clock()
             chain = self._build_chain(entry)
+            telemetry.duration_since("parallel.train_step.chain_build",
+                                     t0)
             self._entries[chain_key] = chain
-        chain_jit, aux_positions, chain_data_sh, chain_label_sh = chain
 
         opt = self.optimizer
         n_diff = len(entry["diff_nds"])
@@ -388,25 +497,39 @@ class TrainStep:
         for _ in range(n_steps - 1):
             opt._update_count(list(range(n_diff)))
 
+        bsz = next((l.shape[1] for l in data_leaves if l.ndim > 1),
+                   None) or 1
+        if pad is None:
+            pads = onp.zeros((n_steps,), onp.int32)
+        else:
+            pads = onp.broadcast_to(
+                onp.asarray(pad, onp.int32), (n_steps,))
+        n_valids = (bsz - pads).astype(onp.int32)
+
         data_datas = [l._data for l in data_leaves]
         label_datas = [l._data for l in label_leaves]
-        if chain_data_sh is not None:
-            data_datas = [jax.device_put(d, sh) for d, sh in
-                          zip(data_datas, chain_data_sh)]
-            label_datas = [jax.device_put(d, sh) for d, sh in
-                          zip(label_datas, chain_label_sh)]
+        if chain["data_sh"] is not None:
+            data_datas = [d if _placed_as(d, sh)
+                          else jax.device_put(d, sh) for d, sh in
+                          zip(data_datas, chain["data_sh"])]
+            label_datas = [d if _placed_as(d, sh)
+                           else jax.device_put(d, sh) for d, sh in
+                           zip(label_datas, chain["label_sh"])]
 
+        first_dispatch = not chain["dispatched"]
         t0 = telemetry.clock()
-        new_ws, new_fr, new_ss, losses, last_aux = chain_jit(
+        new_ws, new_fr, new_ss, losses, last_aux = chain["jit"](
             next_key(),
             tuple(nd._data for nd in entry["diff_nds"]),
             tuple(nd._data for nd in entry["frozen_nds"]),
             tuple(self._opt_states), hypers,
-            tuple(data_datas), tuple(label_datas))
+            tuple(data_datas), tuple(label_datas), n_valids)
+        chain["dispatched"] = True
         telemetry.duration_since(
-            "parallel.train_step.chain_compile" if chain_fresh else
+            "parallel.train_step.chain_compile" if first_dispatch else
             "parallel.train_step.run_chain", t0)
         telemetry.counter("parallel.train_step.chained_steps", n_steps)
+        self._check_maskable(entry, int(pads.max()) if len(pads) else 0)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
             nd._data = nw
@@ -414,6 +537,7 @@ class TrainStep:
             nd._data = nf
         self._opt_states = list(new_ss)
         targets = entry["out_box"].get("aux_targets", [])
+        aux_positions = chain["aux_positions"]
         with autograd.pause():
             for nd, pos, new in zip(targets, aux_positions(), last_aux):
                 if pos < 0:  # not threaded through frozen: install last
@@ -422,21 +546,20 @@ class TrainStep:
         return NDArray(engine.track(losses))
 
     # -- call ----------------------------------------------------------
-    def __call__(self, data, label):
-        """Run one training step; returns the (scalar NDArray) loss."""
+    def __call__(self, data, label, pad=None):
+        """Run one training step; returns the (scalar NDArray) loss.
+
+        ``pad`` marks the trailing rows of the batch as padding (their
+        loss contribution is masked out — see bucketing.py). When None,
+        pad marks left on the arrays by the data pipeline apply, and
+        an active bucketing policy pads odd batches here so they reuse
+        an existing compiled entry."""
         data_leaves, data_spec = _flatten_arrays(_as_tuple(data))
         label_leaves, label_spec = _flatten_arrays(_as_tuple(label))
-        sig = (tuple((l.shape, str(l.dtype)) for l in data_leaves),
-               tuple((l.shape, str(l.dtype)) for l in label_leaves),
-               repr(data_spec), repr(label_spec))
-        entry = self._entries.get(sig)
-        if entry is None:
-            telemetry.counter("parallel.train_step.build")
-            t0 = telemetry.clock()
-            entry = self._build(data_leaves, data_spec,
-                                label_leaves, label_spec)
-            telemetry.duration_since("parallel.train_step.build", t0)
-            self._entries[sig] = entry
+        data_leaves, label_leaves, pad = self._apply_bucketing(
+            data_leaves, label_leaves, pad)
+        _, entry = self._get_entry(data_leaves, data_spec,
+                                   label_leaves, label_spec)
         opt = self.optimizer
         n_diff = len(entry["diff_nds"])
         opt._update_count(list(range(n_diff)))
@@ -445,28 +568,54 @@ class TrainStep:
         data_datas = [l._data for l in data_leaves]
         label_datas = [l._data for l in label_leaves]
         if entry["data_sh"] is not None:
-            data_datas = [jax.device_put(d, sh) for d, sh in
+            # skip leaves a DeviceFeed already placed on the entry's
+            # shardings — the H2D happened off the dispatch path
+            data_datas = [d if _placed_as(d, sh)
+                          else jax.device_put(d, sh) for d, sh in
                           zip(data_datas, entry["data_sh"])]
-            label_datas = [jax.device_put(d, sh) for d, sh in
-                          zip(label_datas, entry["label_sh"])]
+            label_datas = [d if _placed_as(d, sh)
+                           else jax.device_put(d, sh) for d, sh in
+                           zip(label_datas, entry["label_sh"])]
 
+        bsz = next((l.shape[0] for l in data_leaves if l.ndim), 1)
+        n_valid = onp.int32(bsz - pad)
         diff_datas = tuple(nd._data for nd in entry["diff_nds"])
+        args = (next_key(), diff_datas,
+                tuple(nd._data for nd in entry["frozen_nds"]),
+                tuple(self._opt_states), hypers,
+                tuple(data_datas), tuple(label_datas), n_valid)
         # dispatch is async and entry["jit"] is lazily compiled: its
         # FIRST dispatch (even when the entry was built by an earlier
-        # run_chain) pays trace + XLA compile; steady-state 'run'
-        # measures enqueue latency (the host-side cost the reference's
-        # engine-push timing captured)
+        # run_chain) pays trace + XLA compile — unless warmup() AOT-
+        # compiled the entry, in which case dispatch goes through the
+        # precompiled executable; steady-state 'run' measures enqueue
+        # latency (the host-side cost the reference's engine-push
+        # timing captured)
         first_dispatch = not entry.get("jit_dispatched")
         t0 = telemetry.clock()
-        new_ws, new_ss, loss, aux = entry["jit"](
-            next_key(), diff_datas, tuple(nd._data for nd in
-                                          entry["frozen_nds"]),
-            tuple(self._opt_states), hypers,
-            tuple(data_datas), tuple(label_datas))
+        out = None
+        if entry.get("aot") is not None:
+            try:
+                out = entry["aot"](*args)
+            except (TypeError, ValueError):
+                # aval mismatch vs. the warmed signature (e.g. weak
+                # types): fall back to the lazy jit path for good.
+                # That jit has never dispatched (warmup marked the
+                # entry dispatched for the AOT path), so the fallback
+                # pays a real trace+compile — label it as one
+                telemetry.counter("parallel.train_step.aot_fallback")
+                entry["aot"] = None
+                first_dispatch = True
+        if out is None:
+            with compile_cache.measure() if first_dispatch \
+                    else _nullcontext():
+                out = entry["jit"](*args)
+        new_ws, new_ss, loss, aux = out
         entry["jit_dispatched"] = True
         telemetry.duration_since(
             "parallel.train_step.compile" if first_dispatch else
             "parallel.train_step.run", t0)
+        self._check_maskable(entry, pad)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
             nd._data = nw
@@ -477,6 +626,149 @@ class TrainStep:
                 nd._install(new)
         engine.sample_memory()
         return NDArray(engine.track(loss))
+
+    # -- AOT warmup ----------------------------------------------------
+    def warmup(self, shapes, dtype="float32", label_dtype="int32"):
+        """AOT-compile training-step entries ahead of the first step.
+
+        ``shapes`` is a list of ``(data_shapes, label_shapes)``
+        signatures; each side is one shape tuple or a tuple/list of
+        them, and a ``(shape, dtype)`` pair overrides the default
+        dtype per leaf::
+
+            step.warmup([((64, 16), (64,))])               # one entry
+            step.warmup([((b, 16), (b,)) for b in (32, 64)])
+
+        Each signature builds its entry (if missing) and compiles it
+        via ``jit.lower(...).compile()`` — moving trace + XLA compile
+        off the first training step. With ``MXTPU_COMPILE_CACHE_DIR``
+        set the compile replays from the persistent cache, so a
+        restarted process warms up at disk-read speed. Telemetry:
+        ``parallel.train_step.warmup`` (count),
+        ``parallel.train_step.aot_compile`` (ms), plus the
+        ``compile_cache.*`` hit/miss counters."""
+        import jax.numpy as _jnp
+
+        def _leafspecs(side, default_dtype):
+            if isinstance(side, (list, tuple)) and side and \
+                    isinstance(side[0], (list, tuple)):
+                items = list(side)
+                # distinguish the (shape, dtype) pair form from a list
+                # of shapes: a pair has a str dtype second element
+                if len(side) == 2 and isinstance(side[1], str):
+                    items = [side]
+            else:
+                items = [side]
+            out = []
+            for it in items:
+                if (isinstance(it, (list, tuple)) and len(it) == 2
+                        and isinstance(it[1], str)):
+                    out.append((tuple(it[0]), it[1]))
+                else:
+                    out.append((tuple(it), default_dtype))
+            return out
+
+        compiled = []
+        for data_side, label_side in shapes:
+            data_leaves = [NDArray(_jnp.zeros(s, dt)) for s, dt in
+                           _leafspecs(data_side, dtype)]
+            label_leaves = [NDArray(_jnp.zeros(s, dt)) for s, dt in
+                            _leafspecs(label_side, label_dtype)]
+            # bucket the template exactly like dispatch will, so
+            # warming the real odd-tail shape warms the entry dispatch
+            # actually uses (not a never-hit unpadded signature)
+            data_leaves, label_leaves, _ = self._apply_bucketing(
+                data_leaves, label_leaves, None)
+            _, dspec = _flatten_arrays(tuple(data_leaves))
+            _, lspec = _flatten_arrays(tuple(label_leaves))
+            sig, entry = self._get_entry(data_leaves, dspec,
+                                         label_leaves, lspec)
+            telemetry.counter("parallel.train_step.warmup")
+            if entry.get("aot") is not None:
+                compiled.append(sig)
+                continue
+            opt = self.optimizer
+            n_diff = len(entry["diff_nds"])
+            # hypers carry the CURRENT counters; their avals (strong
+            # numpy scalars) are what matters for the compiled
+            # signature, not the values
+            hypers = [opt._hyper(k) for k in range(n_diff)]
+            abstract = [jax.ShapeDtypeStruct(l.shape, l.dtype)
+                        for l in data_leaves]
+            labstract = [jax.ShapeDtypeStruct(l.shape, l.dtype)
+                         for l in label_leaves]
+            bsz = next((l.shape[0] for l in data_leaves if l.ndim), 1)
+            t0 = telemetry.clock()
+            lowered = entry["jit"].lower(
+                next_key(),
+                tuple(nd._data for nd in entry["diff_nds"]),
+                tuple(nd._data for nd in entry["frozen_nds"]),
+                tuple(self._opt_states), hypers,
+                tuple(abstract), tuple(labstract), onp.int32(bsz))
+            with compile_cache.measure():
+                entry["aot"] = lowered.compile()
+            telemetry.duration_since("parallel.train_step.aot_compile",
+                                     t0)
+            # first *training* dispatch is now a plain enqueue
+            entry["jit_dispatched"] = True
+            compiled.append(sig)
+        return compiled
+
+    # -- async feed support --------------------------------------------
+    def prepare_batch(self, data, label, pad=None):
+        """Pad (bucketing) + device-place one batch ahead of dispatch.
+
+        Called by `io.DeviceFeed` from its worker thread: applies the
+        same bucketing/pad resolution as ``__call__``, then
+        ``device_put``s each leaf onto the matching compiled entry's
+        ``data_sh``/``label_sh`` shardings so the dispatch path skips
+        the H2D transfer. Batches whose entry is not built yet come
+        back host-resident (the first step's build handles them).
+        Returns ``(data, label)`` with the input nesting preserved."""
+        data_t, label_t = _as_tuple(data), _as_tuple(label)
+        data_leaves, data_spec = _flatten_arrays(data_t)
+        label_leaves, label_spec = _flatten_arrays(label_t)
+        data_leaves, label_leaves, pad = self._apply_bucketing(
+            data_leaves, label_leaves, pad)
+        sig = self._sig(data_leaves, label_leaves, data_spec,
+                        label_spec)
+        entry = self._entries.get(sig)
+        if entry is not None and entry["data_sh"] is not None:
+            def place(leaves, shs):
+                out = []
+                for l, sh in zip(leaves, shs):
+                    if _placed_as(l._data, sh):
+                        out.append(l)
+                    else:
+                        nd = NDArray(jax.device_put(l._data, sh),
+                                     ctx=l.ctx)
+                        out.append(nd)
+                return out
+
+            data_leaves = place(data_leaves, entry["data_sh"])
+            label_leaves = place(label_leaves, entry["label_sh"])
+        else:
+            # no mesh shardings (single device) — still move any
+            # host-resident leaf onto the default device off the
+            # dispatch path; leaves already backed by a jax.Array were
+            # placed when they were created
+            def to_device(leaves):
+                return [l if isinstance(l._data, jax.Array)
+                        else NDArray(jax.device_put(l._data), ctx=l.ctx)
+                        for l in leaves]
+
+            data_leaves = to_device(data_leaves)
+            label_leaves = to_device(label_leaves)
+        if pad:
+            for l in data_leaves + label_leaves:
+                _bucketing.mark_pad(l, pad)
+        new_data = _rebuild(data_spec, data_leaves)
+        new_label = _rebuild(label_spec, label_leaves)
+        if not isinstance(data, (list, tuple)):
+            new_data = new_data[0]
+        if not isinstance(label, (list, tuple)):
+            new_label = new_label[0]
+        return new_data, new_label
 
 
 def _placed_as(data, sh):

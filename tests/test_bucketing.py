@@ -1,0 +1,285 @@
+"""Shape bucketing: policy math, iterator/loader padding, CachedOp
+pad-and-slice, and padded-batch training correctness (the padded path
+must land on the same loss and parameters as the unpadded path)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon, parallel, bucketing
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.io import NDArrayIter
+
+
+# -- policy ------------------------------------------------------------
+
+def test_policy_pow2():
+    p = bucketing.BucketingPolicy(mode="pow2")
+    assert [p.bucket(n) for n in (1, 2, 3, 5, 8, 9, 17)] == \
+        [1, 2, 4, 8, 8, 16, 32]
+
+
+def test_policy_multiple_and_min():
+    p = bucketing.BucketingPolicy(mode="multiple", multiple=8, min_size=8)
+    assert [p.bucket(n) for n in (1, 8, 9, 16, 17)] == [8, 8, 16, 16, 24]
+
+
+def test_policy_explicit_buckets():
+    p = bucketing.BucketingPolicy(buckets=[4, 16, 64])
+    assert p.bucket(3) == 4 and p.bucket(5) == 16 and p.bucket(17) == 64
+    # above the largest bucket: the size maps to itself
+    assert p.bucket(65) == 65
+
+
+def test_policy_clamped():
+    p = bucketing.BucketingPolicy(mode="pow2").clamped(12)
+    assert p.bucket(3) == 4      # small tails keep their bucket
+    assert p.bucket(10) == 12    # pow2 would say 16; clamp to batch
+    assert p.bucket(12) == 12
+    assert p.bucket(13) == 13    # never pads below n
+
+
+def test_policy_env_parsing():
+    from mxnet_tpu.bucketing import _from_env
+    assert _from_env("") is None and _from_env("0") is None
+    assert _from_env("pow2").bucket(5) == 8
+    assert _from_env("mult:4").bucket(5) == 8
+    assert _from_env("8,32").bucket(9) == 32
+
+
+def test_bucketing_false_opts_out_of_global_policy():
+    """TrainStep(bucketing=False) must ignore an installed global
+    policy (exact unpadded behavior for eval/repro runs)."""
+    from mxnet_tpu import telemetry
+    rng = onp.random.RandomState(11)
+    x10 = np.array(rng.randn(10, 8).astype(onp.float32))
+    y10 = np.array(rng.randint(0, 4, 10).astype(onp.int32))
+    net = _mlp()
+    net(x10)
+    step = _mk_step(net, bucketing=False)
+    with bucketing.policy_scope("pow2"):
+        telemetry.reset()
+        step(x10, y10)
+        snap = telemetry.snapshot()
+    assert "parallel.train_step.bucket_pad" not in snap["counters"]
+    # the entry really is the unpadded (10,...) signature
+    assert any(s[0][0][0] == (10, 8) or s[0][0][0][0] == 10
+               for s in step._entries)
+
+
+def test_scalar_loss_pad_warns():
+    """A padded batch whose loss_fn already reduced to a scalar cannot
+    be masked — dispatch must warn instead of silently diverging."""
+    import warnings
+    rng = onp.random.RandomState(12)
+    x10 = np.array(rng.randn(10, 8).astype(onp.float32))
+    y10 = np.array(rng.randint(0, 4, 10).astype(onp.int32))
+    net = _mlp()
+    net(x10)
+    base = gluon.loss.SoftmaxCrossEntropyLoss()
+    scalar_loss = lambda out, label: base(out, label).mean()
+    step = parallel.TrainStep(
+        net, scalar_loss, "sgd", {"learning_rate": 0.1}, mesh=None,
+        bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        step(x10, y10)  # pads to 16; mask impossible
+        step(x10, y10)  # warning fires once the trace recorded it
+    assert any("cannot be masked" in str(w.message) for w in rec)
+
+
+def test_policy_scope_and_as_policy():
+    assert bucketing.get_policy() is None
+    with bucketing.policy_scope("pow2") as p:
+        assert bucketing.get_policy() is p
+        assert bucketing.as_policy(True) is p
+    assert bucketing.get_policy() is None
+    with pytest.raises(TypeError):
+        bucketing.as_policy(3.14)
+
+
+def test_pad_leaves_replicates_and_marks():
+    x = np.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    (padded,), pad = bucketing.pad_leaves([x], 5, 3)
+    assert pad == 2 and padded.shape == (5, 4)
+    assert bucketing.get_pad(padded) == 2
+    got = padded.asnumpy()
+    onp.testing.assert_array_equal(got[3], got[2])
+    onp.testing.assert_array_equal(got[4], got[2])
+    # scalars / leaves without the batch dim pass through untouched
+    s = np.array(1.0)
+    (same,), pad0 = bucketing.pad_leaves([s], 5, 3)
+    assert pad0 == 2 and same is s
+
+
+# -- iterators / loaders ----------------------------------------------
+
+def test_ndarray_iter_bucketing():
+    X = onp.random.RandomState(0).randn(45, 8).astype(onp.float32)
+    Y = onp.arange(45, dtype=onp.int32)
+    it = NDArrayIter(X, Y, batch_size=16,
+                     bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    batches = list(it)
+    # 45 = 16 + 16 + 13; the tail pads to pow2(13)=16 (clamped @ 16)
+    assert [b.data[0].shape[0] for b in batches] == [16, 16, 16]
+    assert [b.pad for b in batches] == [0, 0, 3]
+    assert bucketing.get_pad(batches[-1].data[0]) == 3
+    assert bucketing.get_pad(batches[-1].label[0]) == 3
+    # a tiny tail lands in a SMALLER bucket, not a full batch
+    it2 = NDArrayIter(X[:34], Y[:34], batch_size=16,
+                      bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    shapes = [(b.data[0].shape[0], b.pad) for b in it2]
+    assert shapes == [(16, 0), (16, 0), (2, 0)]  # 2 is already a bucket
+
+
+def test_ndarray_iter_default_pad_unchanged():
+    X = onp.arange(10, dtype=onp.float32).reshape(10, 1)
+    it = NDArrayIter(X, batch_size=4)
+    batches = list(it)
+    assert [b.data[0].shape[0] for b in batches] == [4, 4, 4]
+    assert [b.pad for b in batches] == [0, 0, 2]
+
+
+def test_dataloader_bucketing_marks():
+    X = mx.np.array(onp.random.RandomState(1).randn(45, 8)
+                    .astype(onp.float32))
+    Y = mx.np.array(onp.arange(45, dtype=onp.int32))
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=16,
+                        bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    out = [(d.shape[0], bucketing.get_pad(d), bucketing.get_pad(l))
+           for d, l in loader]
+    assert out == [(16, 0, 0), (16, 0, 0), (16, 3, 3)]
+
+
+# -- CachedOp pad-and-slice -------------------------------------------
+
+def _mlp(classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_cachedop_bucketing_reuses_entry():
+    from mxnet_tpu import telemetry
+    rng = onp.random.RandomState(3)
+    net = _mlp()
+    net.hybridize()
+    x16 = np.array(rng.randn(16, 8).astype(onp.float32))
+    x10 = np.array(rng.randn(10, 8).astype(onp.float32))
+    with bucketing.policy_scope(bucketing.BucketingPolicy(mode="pow2")):
+        net(x16)  # builds the (16,...) entry
+        telemetry.reset()
+        out = net(x10)  # pads to 16, reuses, slices back
+        snap = telemetry.snapshot()
+    assert out.shape == (10, 4)
+    assert snap["counters"].get("gluon.cachedop.bucket_pad") == 1
+    assert snap["counters"].get("gluon.cachedop.cache_hit") == 1
+    assert "gluon.cachedop.cache_miss" not in snap["counters"]
+    # sliced outputs match the dedicated unpadded entry exactly
+    ref = net(x10)  # policy off: builds a (10,...) entry
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=1e-6, atol=1e-7)
+
+
+def test_cachedop_bucketing_skipped_under_recording():
+    rng = onp.random.RandomState(4)
+    net = _mlp()
+    net.hybridize()
+    x10 = np.array(rng.randn(10, 8).astype(onp.float32))
+    with bucketing.policy_scope(bucketing.BucketingPolicy(mode="pow2")):
+        with mx.autograd.record():
+            out = net(x10)
+        assert out.shape == (10, 4)  # unpadded: grads must match inputs
+        out.backward()
+
+
+# -- padded-batch training correctness (satellite: exact parity) ------
+
+def _clone(net_a, net_b):
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        pb.set_data(pa.data().copy())
+
+
+def _mk_step(net, **kw):
+    return parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.1}, mesh=None,
+                              **kw)
+
+
+def test_padded_batch_matches_unpadded_call():
+    """A bucketing-padded final batch must produce the same loss and
+    the same parameter updates as the unpadded reference step."""
+    rng = onp.random.RandomState(5)
+    x10 = rng.randn(10, 8).astype(onp.float32)
+    y10 = rng.randint(0, 4, 10).astype(onp.int32)
+    net_a, net_b = _mlp(), _mlp()
+    net_a(np.array(x10)), net_b(np.array(x10))
+    _clone(net_a, net_b)
+    step_a = _mk_step(net_a)
+    step_b = _mk_step(net_b,
+                      bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    la = float(step_a(np.array(x10), np.array(y10)))
+    lb = float(step_b(np.array(x10), np.array(y10)))  # pads 10 -> 16
+    assert la == pytest.approx(lb, rel=1e-7, abs=1e-9)
+    for (ka, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                 net_b.collect_params().items()):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(),
+                                    rtol=1e-6, atol=1e-7, err_msg=ka)
+
+
+def test_padded_batch_matches_unpadded_run_chain():
+    """The mask holds under bulk mode too: a chain whose final step is
+    padded matches per-step unpadded training."""
+    rng = onp.random.RandomState(6)
+    xs = rng.randn(3, 16, 8).astype(onp.float32)
+    ys = rng.randint(0, 4, (3, 16)).astype(onp.int32)
+    # reference: 3 sequential unpadded steps, last one 10 rows
+    net_a, net_b = _mlp(), _mlp()
+    net_a(np.array(xs[0])), net_b(np.array(xs[0]))
+    _clone(net_a, net_b)
+    step_a, step_b = _mk_step(net_a), _mk_step(net_b)
+    ref_losses = [float(step_a(np.array(xs[i]), np.array(ys[i])))
+                  for i in range(2)]
+    ref_losses.append(
+        float(step_a(np.array(xs[2][:10]), np.array(ys[2][:10]))))
+    # chained: the last step carries 6 padded rows (replicated), masked
+    xs_p, ys_p = xs.copy(), ys.copy()
+    xs_p[2][10:] = xs_p[2][9]
+    ys_p[2][10:] = ys_p[2][9]
+    losses = step_b.run_chain(np.array(xs_p), np.array(ys_p),
+                              pad=[0, 0, 6])
+    onp.testing.assert_allclose(losses.asnumpy(), ref_losses,
+                                rtol=2e-5, atol=2e-6)
+    for (ka, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                 net_b.collect_params().items()):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(),
+                                    rtol=2e-5, atol=2e-6, err_msg=ka)
+
+
+def test_pad_marks_flow_from_loader_to_loss():
+    """End to end: a DataLoader-bucketed epoch trains to the same
+    parameters as manual unpadded steps over the same rows."""
+    rng = onp.random.RandomState(7)
+    X = rng.randn(40, 8).astype(onp.float32)  # 40 = 16+16+8... use 42
+    X = rng.randn(42, 8).astype(onp.float32)
+    Y = rng.randint(0, 4, 42).astype(onp.int32)
+    net_a, net_b = _mlp(), _mlp()
+    net_a(np.array(X[:16])), net_b(np.array(X[:16]))
+    _clone(net_a, net_b)
+    step_a, step_b = _mk_step(net_a), _mk_step(net_b)
+    for lo, hi in ((0, 16), (16, 32), (32, 42)):
+        step_a(np.array(X[lo:hi]), np.array(Y[lo:hi]))
+    loader = DataLoader(
+        ArrayDataset(mx.np.array(X), mx.np.array(Y)), batch_size=16,
+        bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    for d, l in loader:
+        step_b(d, l)
+    for (ka, pa), (_, pb) in zip(net_a.collect_params().items(),
+                                 net_b.collect_params().items()):
+        onp.testing.assert_allclose(pa.data().asnumpy(),
+                                    pb.data().asnumpy(),
+                                    rtol=2e-5, atol=2e-6, err_msg=ka)

@@ -1,0 +1,320 @@
+"""Async device-feed pipeline: DeviceFeed double buffering, prefetcher
+shutdown determinism (regression: consumer exits mid-epoch), AOT
+warmup entry points, and the persistent compile cache wiring."""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np, gluon, parallel, bucketing, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+from mxnet_tpu.gluon.data.dataloader import _Prefetcher
+from mxnet_tpu.io import DeviceFeed, NDArrayIter, PrefetchingIter
+
+
+def _mlp(classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(classes))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _mk_step(net, **kw):
+    kw.setdefault("mesh", None)
+    return parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              "adam", {"learning_rate": 0.01}, **kw)
+
+
+# -- _Prefetcher shutdown (satellite regression) ----------------------
+
+def test_prefetcher_stop_joins_worker():
+    """stop() must leave the worker thread DEAD, not merely flagged —
+    the old drain-only stop returned while the thread could still be
+    inside queue.put."""
+    pf = _Prefetcher(iter(range(1000)), depth=2)
+    it = iter(pf)
+    assert next(it) == 0
+    pf.stop()
+    assert not pf.is_alive()
+
+
+def test_prefetcher_consumer_exits_mid_epoch():
+    """A consumer that breaks out of the loop early releases the
+    worker promptly (no thread + buffered-batch leak per abandoned
+    epoch)."""
+    X = mx.np.array(onp.arange(400, dtype=onp.float32).reshape(100, 4))
+    loader = DataLoader(ArrayDataset(X), batch_size=4, prefetch=4)
+    gen = iter(loader)
+    next(gen), next(gen)
+    workers = [t for t in threading.enumerate()
+               if t.name == "DataLoaderPrefetcher"]
+    assert workers
+    gen.close()  # the generator's finally runs stop()
+    deadline = time.monotonic() + 5.0
+    while any(t.is_alive() for t in workers):
+        assert time.monotonic() < deadline, "prefetcher leaked"
+        time.sleep(0.01)
+
+
+def test_prefetcher_stop_with_blocked_producer():
+    """Worker blocked on a FULL queue (consumer never drained) still
+    terminates within stop()'s deadline."""
+    pf = _Prefetcher(iter(range(1000)), depth=1)
+    time.sleep(0.2)  # let the worker fill the queue and block in put
+    t0 = time.monotonic()
+    pf.stop()
+    assert not pf.is_alive()
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_prefetcher_exhausted_epoch_still_clean():
+    pf = _Prefetcher(iter(range(5)), depth=2)
+    assert list(iter(pf)) == [0, 1, 2, 3, 4]
+    pf.join(2.0)
+    assert not pf.is_alive()
+
+
+# -- DeviceFeed --------------------------------------------------------
+
+def test_device_feed_yields_all_batches_in_order():
+    rng = onp.random.RandomState(0)
+    X = mx.np.array(rng.randn(48, 8).astype(onp.float32))
+    Y = mx.np.array(onp.arange(48, dtype=onp.int32))
+    loader = DataLoader(ArrayDataset(X, Y), batch_size=16)
+    feed = DeviceFeed(loader, depth=2)
+    labels = []
+    for _ in range(2):  # re-iterable across epochs
+        for d, l in feed:
+            assert d.shape == (16, 8)
+            labels.append(l.asnumpy()[0])
+    assert labels == [0, 16, 32, 0, 16, 32]
+
+
+def test_device_feed_places_on_entry_shardings():
+    """After the first step builds the entry, the feed worker lands
+    batches already placed — the dispatch path skips its device_put."""
+    mesh = parallel.make_mesh((8,), ("dp",))
+    old = parallel.get_mesh()
+    parallel.set_mesh(mesh)
+    try:
+        rng = onp.random.RandomState(1)
+        X = mx.np.array(rng.randn(64, 8).astype(onp.float32))
+        Y = mx.np.array(rng.randint(0, 4, 64).astype(onp.int32))
+        loader = DataLoader(ArrayDataset(X, Y), batch_size=32)
+        net = _mlp()
+        step = _mk_step(net, mesh=mesh)
+        feed = DeviceFeed(loader, step=step, depth=2)
+        for d, l in feed:
+            step(d, l)
+        # second epoch: entries exist, so the worker pre-places leaves
+        placed = 0
+        for d, l in feed:
+            entry = next(iter(step._entries.values()))
+            if d._data.sharding == entry["data_sh"][0]:
+                placed += 1
+            step(d, l)
+        assert placed == 2
+        telemetry.reset()
+        for d, l in feed:
+            step(d, l)
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("io.device_feed.batches") == 2
+        assert "io.device_feed.put" in snap["durations"]
+    finally:
+        parallel.set_mesh(old)
+
+
+def test_device_feed_forwards_databatch_pad():
+    """PrefetchingIter/NDArrayIter integration: DataBatch.pad becomes
+    a pad mark on the leaves, so TrainStep masks the wrapped rows."""
+    rng = onp.random.RandomState(2)
+    X = rng.randn(45, 8).astype(onp.float32)
+    Y = rng.randint(0, 4, 45).astype(onp.int32)
+    it = PrefetchingIter(NDArrayIter(X, Y, batch_size=16))
+    feed = DeviceFeed(it, depth=2)
+    pads = []
+    for batch in feed:
+        pads.append(bucketing.get_pad(batch.data[0]))
+    assert pads == [0, 0, 3]
+
+
+def test_device_feed_propagates_source_error():
+    def bad():
+        yield (mx.np.zeros((4, 2)), mx.np.zeros((4,)))
+        raise RuntimeError("boom")
+
+    feed = DeviceFeed(bad(), depth=2)
+    it = iter(feed)
+    next(it)
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_device_feed_second_iter_does_not_hang_first():
+    """Starting a new epoch (iter(feed)) stops the previous worker;
+    a straggler consumer of the OLD iterator must see StopIteration,
+    not block forever on the dead worker's queue."""
+    X = mx.np.array(onp.zeros((32, 4), onp.float32))
+    loader = DataLoader(ArrayDataset(X), batch_size=4)
+    feed = DeviceFeed(loader, depth=1)
+    it1 = iter(feed)
+    next(it1)
+    it2 = iter(feed)  # stops worker 1
+    done = []
+
+    def drain():
+        done.append(sum(1 for _ in it1))
+
+    t = threading.Thread(target=drain, daemon=True)
+    t.start()
+    t.join(5.0)
+    assert not t.is_alive(), "stale iterator hung on stopped worker"
+    for _ in it2:
+        pass
+
+
+def test_device_feed_stop_releases_worker():
+    X = mx.np.array(onp.zeros((64, 4), onp.float32))
+    loader = DataLoader(ArrayDataset(X), batch_size=4)
+    feed = DeviceFeed(loader, depth=1)
+    it = iter(feed)
+    next(it)
+    feed.stop()
+    assert not any(t.name == "DeviceFeed" and t.is_alive()
+                   for t in threading.enumerate())
+
+
+# -- AOT warmup --------------------------------------------------------
+
+def test_train_step_warmup_compiles_ahead():
+    rng = onp.random.RandomState(3)
+    net = _mlp()
+    net(np.array(rng.randn(4, 8).astype(onp.float32)))
+    step = _mk_step(net)
+    step.warmup([((16, 8), (16,))])
+    telemetry.reset()
+    x = np.array(rng.randn(16, 8).astype(onp.float32))
+    y = np.array(rng.randint(0, 4, 16).astype(onp.int32))
+    losses = [float(step(x, y)) for _ in range(3)]
+    snap = telemetry.snapshot()
+    # no build, no compile-labelled first step, no aot fallback:
+    # dispatch went through the precompiled executable
+    assert "parallel.train_step.build" not in snap["counters"]
+    assert "parallel.train_step.aot_fallback" not in snap["counters"]
+    assert "parallel.train_step.compile" not in snap["durations"]
+    assert snap["durations"]["parallel.train_step.run"]["count"] == 3
+    assert losses[-1] < losses[0]
+
+
+def test_warmup_applies_bucketing_policy():
+    """Warming the real odd-tail shape must warm the BUCKETED entry
+    dispatch actually uses, not a never-hit unpadded signature."""
+    rng = onp.random.RandomState(9)
+    net = _mlp()
+    net(np.array(rng.randn(4, 8).astype(onp.float32)))
+    step = _mk_step(net,
+                    bucketing=bucketing.BucketingPolicy(mode="pow2"))
+    step.warmup([((10, 8), (10,))])  # policy buckets this to 16
+    telemetry.reset()
+    x = np.array(rng.randn(10, 8).astype(onp.float32))
+    y = np.array(rng.randint(0, 4, 10).astype(onp.int32))
+    step(x, y)
+    snap = telemetry.snapshot()
+    assert "parallel.train_step.build" not in snap["counters"], \
+        snap["counters"]
+    assert len(step._entries) == 1  # one (16,...) entry, warmed & used
+
+
+def test_ndarray_iter_without_bucketing_does_not_mark():
+    """Default 'pad' pipelines keep reference semantics: wrapped rows
+    carry no mask mark and DO contribute to training."""
+    X = onp.arange(20, dtype=onp.float32).reshape(10, 2)
+    it = NDArrayIter(X, batch_size=4)  # no bucketing
+    last = list(it)[-1]
+    assert last.pad == 2
+    assert bucketing.get_pad(last.data[0]) == 0
+
+
+def test_train_step_warmup_telemetry():
+    net = _mlp()
+    net(np.array(onp.zeros((4, 8), onp.float32)))
+    step = _mk_step(net)
+    telemetry.reset()
+    sigs = step.warmup([((8, 8), (8,)), ((16, 8), (16,))])
+    snap = telemetry.snapshot()
+    assert len(sigs) == 2 and len(step._entries) == 2
+    assert snap["counters"]["parallel.train_step.warmup"] == 2
+    assert snap["durations"]["parallel.train_step.aot_compile"]["count"] == 2
+
+
+def test_hybrid_block_warmup():
+    net = _mlp()
+    net(np.array(onp.zeros((4, 8), onp.float32)))
+    net.warmup(np.array(onp.zeros((16, 8), onp.float32)))
+    telemetry.reset()
+    out = net(np.array(onp.ones((16, 8), onp.float32)))
+    snap = telemetry.snapshot()
+    assert out.shape == (16, 4)
+    assert snap["counters"].get("gluon.cachedop.cache_hit") == 1
+    # first call after warmup is measured as a plain run, not compile
+    assert "gluon.cachedop.compile" not in snap["durations"]
+    assert "gluon.cachedop.run" in snap["durations"]
+
+
+def test_warmup_matches_lazy_path_numerically():
+    rng = onp.random.RandomState(4)
+    x = rng.randn(16, 8).astype(onp.float32)
+    y = rng.randint(0, 4, 16).astype(onp.int32)
+    net_a, net_b = _mlp(), _mlp()
+    net_a(np.array(x)), net_b(np.array(x))
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        pb.set_data(pa.data().copy())
+    step_a, step_b = _mk_step(net_a), _mk_step(net_b)
+    step_b.warmup([((16, 8), (16,))])
+    for _ in range(3):
+        la = float(step_a(np.array(x), np.array(y)))
+        lb = float(step_b(np.array(x), np.array(y)))
+        assert la == pytest.approx(lb, rel=1e-6)
+
+
+# -- persistent compile cache -----------------------------------------
+
+def test_compile_cache_configure_and_measure(tmp_path, monkeypatch):
+    from mxnet_tpu import compile_cache
+    d = str(tmp_path / "cc")
+    prev = compile_cache._dir
+    try:
+        assert compile_cache.configure(d) == d
+        assert compile_cache.enabled()
+        telemetry.reset()
+        with compile_cache.measure():
+            (tmp_path / "cc" / "entry0").write_text("x")  # simulated write
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("compile_cache.miss") == 1
+        assert snap["gauges"]["compile_cache.entries"]["value"] == 1
+        with compile_cache.measure():
+            pass  # no new entry -> hit
+        snap = telemetry.snapshot()
+        assert snap["counters"].get("compile_cache.hit") == 1
+    finally:
+        compile_cache._dir = prev
+
+
+def test_compile_cache_disabled_is_noop():
+    from mxnet_tpu import compile_cache
+    prev = compile_cache._dir
+    compile_cache._dir = None
+    try:
+        telemetry.reset()
+        with compile_cache.measure():
+            pass
+        snap = telemetry.snapshot()
+        assert "compile_cache.hit" not in snap["counters"]
+        assert "compile_cache.miss" not in snap["counters"]
+        assert compile_cache.entry_count() == 0
+    finally:
+        compile_cache._dir = prev

@@ -9,17 +9,36 @@ reference, by TPU design (SURVEY.md §2.3):
 - `update_on_kvstore` exists for API parity; the 'dist_async' parameter
   -server path sends gradients to the PS backend like the reference's
   KVStoreDist (src/kvstore/kvstore_dist.h:445).
+- The fused gradient pipeline (grad_fusion.py): `allreduce_grads`
+  coalesces same-dtype gradients in reverse declaration order into
+  size-capped buckets — one collective per bucket instead of one per
+  parameter (the reference instead relied on priority-ordered engine
+  pushes, `priority = -key`) — and `_update` applies the optimizer to
+  all parameters of a (dtype, mp) group in one jitted multi-tensor
+  program. ``MXTPU_FUSED_TRAINER=0`` restores the per-parameter loops.
 """
 from __future__ import annotations
 
+from .. import grad_fusion
 from .. import optimizer as opt
+from .. import telemetry
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter
 
 
+def _evict_owner_residuals(kv_ref, prefix):
+    """weakref.finalize target: drop a dead Trainer's compression
+    residuals from a (possibly shared, longer-lived) kvstore."""
+    kv = kv_ref()
+    comp = getattr(kv, "_compression", None) if kv is not None else None
+    if comp is not None:
+        comp.evict_prefix(prefix)
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
-                 compression_params=None, update_on_kvstore=None):
+                 compression_params=None, update_on_kvstore=None,
+                 fusion=None):
         if isinstance(params, dict):
             params = [params[k] for k in sorted(params.keys())]
         if not isinstance(params, (list, tuple)):
@@ -55,6 +74,23 @@ class Trainer:
         self._update_on_kvstore = None
         self._states = [None] * len(self._params)
         self._states_initialized = [False] * len(self._params)
+        # gradient-fusion bucket cap: None/True -> env or 4 MiB default,
+        # False/0 -> this trainer's allreduce stays per-parameter,
+        # int -> explicit byte cap (see grad_fusion.py)
+        if fusion is None or fusion is True:
+            self._fusion_bytes = grad_fusion.default_fusion_bytes()
+        elif not fusion:
+            self._fusion_bytes = 0
+        elif int(fusion) <= 0:  # catches negatives AND 0<float<1
+            raise ValueError(
+                f"fusion must be a positive byte cap, False, or None "
+                f"(got {fusion!r})")
+        else:
+            self._fusion_bytes = int(fusion)
+        self._fused_buckets = None
+        self._fused_buckets_sig = None
+        self._fusion_uid = grad_fusion.next_owner_uid()
+        self._fusion_finalizer = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -155,12 +191,13 @@ class Trainer:
             # gradient before the push; a local kvstore shares this
             # process's optimizer object, which step() just rescaled.
             remote = getattr(self._kvstore, "optimizer_on_remote", False)
+            rescale = self._grad_rescale(batch_size) if remote else None
             for i, param in enumerate(self._params):
                 if param.grad_req == "null" or param._data is None:
                     continue
                 grad = param.grad()
                 if remote:
-                    grad = grad * self._grad_rescale(batch_size)
+                    grad = grad * rescale
                 self._kvstore.push(i, grad, priority=-i)
                 self._kvstore.pull(i, out=param.data(), priority=-i)
                 param.data()._fresh_grad = False
@@ -175,10 +212,49 @@ class Trainer:
         self._check_and_init()
         if self._kvstore is None:
             return
+        if self._fusion_bytes and grad_fusion.fused_enabled() \
+                and self._kvstore.is_capable("fused_pushpull"):
+            # bucketed path: each bucket is issued as soon as it is
+            # assembled (reverse declaration order — the order backward
+            # finished producing grads), so the collective dispatch
+            # overlaps the remaining host-side bucket assembly
+            for bucket in self._grad_buckets():
+                grad_fusion.allreduce_bucket(bucket, self._kvstore)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req != "null" and param._data is not None:
                 self._kvstore.pushpull(i, param.grad(), out=param.grad(),
                                        priority=-i)
+
+    def _grad_buckets(self):
+        """Fusion buckets over the currently-active parameters, cached
+        on their (index, shape, dtype) signature — steady-state steps
+        reuse the layout (and therefore the compiled flatten/unflatten
+        programs and per-bucket compression residuals)."""
+        active = [(i, p) for i, p in enumerate(self._params)
+                  if p.grad_req != "null" and p._data is not None]
+        sig = tuple((i, tuple(p._data._data.shape),
+                     str(p._data._data.dtype)) for i, p in active)
+        if self._fusion_finalizer is None and self._kvstore is not None:
+            # whole-trainer residual cleanup: a shared kvstore may
+            # outlive this trainer, and its compression residuals are
+            # keyed by our owner uid — evict them when we go away
+            import weakref
+            self._fusion_finalizer = weakref.finalize(
+                self, _evict_owner_residuals, weakref.ref(self._kvstore),
+                f"__fused__{self._fusion_uid}:")
+        if self._fused_buckets is None or sig != self._fused_buckets_sig:
+            old = self._fused_buckets or []
+            self._fused_buckets = grad_fusion.build_buckets(
+                active, self._fusion_bytes, owner=self._fusion_uid)
+            self._fused_buckets_sig = sig
+            # a rebuild abandons the old buckets' compression-residual
+            # keys — evict them or they pin bucket-sized arrays forever
+            comp = getattr(self._kvstore, "_compression", None)
+            if old and comp is not None:
+                live = {b.key for b in self._fused_buckets}
+                comp.evict(b.key for b in old if b.key not in live)
+        return self._fused_buckets
 
     def update(self, batch_size, ignore_stale_grad=False):
         self._check_and_init()
@@ -193,26 +269,55 @@ class Trainer:
             self._amp_manual_unscaled = False
 
     def _update(self, ignore_stale_grad=False):
+        import warnings  # hoisted out of the per-parameter loop
+        updates = []
+        stale = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
             if not ignore_stale_grad and not param._data._fresh_grad:
-                import warnings
-                warnings.warn(
-                    f"Gradient of Parameter `{param.name}` on context "
-                    f"{param.list_ctx()[0]} has not been updated by "
-                    "backward since last `step`. This could mean a bug in "
-                    "your model that made it only use a subset of the "
-                    "Parameters for the last iteration, call step with "
-                    "ignore_stale_grad=True to suppress this warning")
+                stale.append(param)
             if not self._states_initialized[i]:
                 self._states[i] = \
                     self._optimizer.create_state_multi_precision(
                         i, param.data())
                 self._states_initialized[i] = True
-            self._optimizer.update_multi_precision(
-                [i], [param.data()], [param.grad()], [self._states[i]])
-            self._states[i] = self._optimizer._last_states[i]
+            updates.append((i, param))
+        if stale:
+            # one warning per step naming every stale parameter (was
+            # re-warned — and warnings re-imported — per parameter)
+            names = ", ".join(f"`{p.name}`" for p in stale)
+            warnings.warn(
+                f"Gradient of Parameter(s) {names} on context "
+                f"{stale[0].list_ctx()[0]} has not been updated by "
+                "backward since last `step`. This could mean a bug in "
+                "your model that made it only use a subset of the "
+                "Parameters for the last iteration, call step with "
+                "ignore_stale_grad=True to suppress this warning")
+        if not updates:
+            return
+        if grad_fusion.fused_enabled():
+            # multi-tensor path: one jitted donation-friendly program
+            # per (dtype, mp) group updates every grouped parameter
+            # and its state at once
+            t0 = telemetry.clock()
+            idxs = [i for i, _ in updates]
+            fused_ran = self._optimizer.fused_update_multi_precision(
+                idxs, [p.data() for _, p in updates],
+                [p.grad() for _, p in updates],
+                [self._states[i] for i in idxs])
+            for i in idxs:
+                self._states[i] = self._optimizer._last_states[i]
+            if fused_ran:  # fallback loops must not masquerade as
+                # multi-tensor dispatch in the telemetry
+                telemetry.duration_since("trainer.fused.update", t0)
+        else:
+            for i, param in updates:
+                self._optimizer.update_multi_precision(
+                    [i], [param.data()], [param.grad()],
+                    [self._states[i]])
+                self._states[i] = self._optimizer._last_states[i]
+        for _, param in updates:
             param.data()._fresh_grad = False
 
     # -- state io ------------------------------------------------------

@@ -7,8 +7,11 @@ class KVStoreBase:
 
     kv_registry = {}
 
-    # capability names (parity)
+    # capability names (parity; FUSED is a jax_graft extension — a
+    # backend that reduces a pre-flattened fusion bucket in one
+    # collective, consumed by the Trainer's bucketed-allreduce path)
     OPTIMIZER = "optimizer"
+    FUSED = "fused_pushpull"
 
     @staticmethod
     def register(klass):
@@ -44,6 +47,15 @@ class KVStoreBase:
 
     def pushpull(self, key, value, out=None, priority=0):
         raise NotImplementedError
+
+    def fused_pushpull(self, key, flat_data):
+        """Allreduce ONE flat (already-fused) gradient buffer — a raw
+        jax array, not an NDArray — and return the reduced buffer.
+        Only meaningful for backends advertising ``is_capable(FUSED)``;
+        gradient compression (when configured) quantizes the bucket
+        with per-key error-feedback residuals before the collective."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support fused pushpull")
 
     def broadcast(self, key, value, out, priority=0):
         raise NotImplementedError

@@ -112,6 +112,14 @@ class KVStoreDistSync(KVStoreLocal):
         # DCN reduce adds its kvstore.dist.allreduce rows via _reduce
         self._pushpull_leaf(key, value, out)
 
+    def _fused_collective(self, flat_data):
+        # fusion-bucket reduce over DCN: compression (applied by the
+        # shared fused_pushpull wrapper) quantized the bucket BEFORE
+        # this transfer, so the wire carries the shrunk payload —
+        # matching the reference's compress-then-push ordering
+        # (gradient_compression.h)
+        return self._global_reduce(flat_data)
+
 
 # registry aliases
 KVStoreBase.kv_registry["dist"] = KVStoreDistSync

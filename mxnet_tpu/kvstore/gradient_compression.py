@@ -58,6 +58,38 @@ class GradientCompression:
             raise ValueError(f"unknown compression params {sorted(params)}")
         self._residuals = {}
 
+    @property
+    def bits(self) -> int:
+        """Wire width per element (the reference bit-packs the
+        quantized tensor into this many bits on the network)."""
+        return 1 if self.ctype == "1bit" else 2
+
+    def wire_nbytes(self, quant_data) -> int:
+        """Logical bytes-on-the-wire for a quantized buffer: the
+        reference's bit-packed format (gradient_compression.h
+        quantize_*bit packs ``bits`` per element), which is what the
+        DCN transfer pays even though the in-memory tensor stays a
+        real dequantized array here."""
+        return (quant_data.size * self.bits + 7) // 8
+
+    def evict(self, keys):
+        """Drop the residuals for ``keys`` (all replicas). Called when
+        a fusion-bucket layout is rebuilt: the abandoned bucket keys
+        would otherwise pin their bucket-sized residual arrays
+        forever."""
+        keys = set(keys)
+        for kr in [kr for kr in self._residuals if kr[0] in keys]:
+            del self._residuals[kr]
+
+    def evict_prefix(self, prefix):
+        """Drop every residual whose key starts with ``prefix`` — the
+        whole-trainer cleanup (a discarded Trainer's bucket keys embed
+        its owner uid, so a shared long-lived kvstore must not keep
+        its residuals)."""
+        for kr in [kr for kr in self._residuals
+                   if isinstance(kr[0], str) and kr[0].startswith(prefix)]:
+            del self._residuals[kr]
+
     def compress(self, key, replica, grad_data):
         """Quantize one gradient buffer; updates the residual."""
         kern = _two_bit_kernel() if self.ctype == "2bit" \

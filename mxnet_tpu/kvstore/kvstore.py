@@ -152,9 +152,37 @@ class KVStoreLocal(KVStoreBase):
         # sparse storage defers to a later round; dense pull is correct
         self.pull(key, out, priority)
 
+    def fused_pushpull(self, key, flat_data):
+        """Reduce one pre-flattened fusion bucket (see grad_fusion.py).
+
+        Single-process backends hold ONE logical replica, so the
+        "collective" is the identity — the only work is the optional
+        compression quantize, which jits into the same program XLA
+        fuses with the Trainer's flatten/unflatten. The dist backend
+        overrides ``_fused_collective`` with the DCN reduce."""
+        if telemetry.enabled():
+            telemetry.counter("kvstore.fused.collectives")
+            telemetry.counter("kvstore.fused.bytes_pre",
+                              getattr(flat_data, "nbytes", 0))
+        t0 = telemetry.clock()
+        if self._compression is not None:
+            flat_data = self._compression.compress(key, 0, flat_data)
+            wire = self._compression.wire_nbytes(flat_data)
+        else:
+            wire = getattr(flat_data, "nbytes", 0)
+        if telemetry.enabled():
+            telemetry.counter("kvstore.fused.bytes_wire", wire)
+        out = self._fused_collective(flat_data)
+        telemetry.duration_since("kvstore.fused.pushpull", t0)
+        return out
+
+    def _fused_collective(self, flat_data):
+        # one logical replica in-process: nothing left to reduce
+        return flat_data
+
     # -- optimizer offload ---------------------------------------------
     def is_capable(self, capability):
-        return capability == KVStoreBase.OPTIMIZER
+        return capability in (KVStoreBase.OPTIMIZER, KVStoreBase.FUSED)
 
     def set_optimizer(self, optimizer):
         assert isinstance(optimizer, Optimizer)

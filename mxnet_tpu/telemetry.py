@@ -4,8 +4,10 @@ Parity target: the reference's profiler aggregate-stats table
 (src/profiler/profiler.h AggregateStats, rendered by
 `profiler.dumps(aggregate_stats=True)`): a process-wide table of named
 counters, gauges, and duration aggregators fed by hooks in every hot
-path (CachedOp compiles, TrainStep timing, kvstore traffic, dataloader
-waits, engine memory watermarks). `profiler.dumps()` renders this
+path (CachedOp compiles, TrainStep timing, kvstore traffic, the fused
+Trainer pipeline — bucket counts, pre/post-compression wire bytes,
+fused allreduce/update dispatch timing —, dataloader waits, engine
+memory watermarks). `profiler.dumps()` renders this
 registry; `monitor.Monitor` writes per-layer stats into it.
 
 Design constraints:
@@ -30,8 +32,9 @@ import threading
 import time
 
 __all__ = [
-    "enabled", "set_enabled", "clock", "counter", "gauge", "value",
-    "duration_since", "snapshot", "reset", "render", "names",
+    "enabled", "set_enabled", "clock", "counter", "counter_value",
+    "gauge", "value", "duration_since", "snapshot", "reset", "render",
+    "names",
 ]
 
 _enabled = os.environ.get("MXTPU_TELEMETRY", "1").lower() \
@@ -73,6 +76,14 @@ def counter(name: str, delta: float = 1):
         return
     with _lock:
         _counters[name] = _counters.get(name, 0) + delta
+
+
+def counter_value(name: str) -> float:
+    """Current value of one counter (0 if never incremented) — the
+    point read used by tests and ``bench.py --trainer-path`` without
+    paying for a full snapshot."""
+    with _lock:
+        return _counters.get(name, 0)
 
 
 def gauge(name: str, val: float, peak: float | None = None):

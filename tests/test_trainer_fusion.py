@@ -1,0 +1,563 @@
+"""Fused gradient pipeline for the imperative Trainer.
+
+The contract under test (ISSUE 3 tentpole): the bucketed-allreduce +
+multi-tensor-update path is BIT-IDENTICAL to the per-parameter loops —
+same params, grads, and optimizer state after 5 steps for sgd/adam/
+adamw, with and without AMP dynamic loss scaling (including an
+overflow-skipped step) — while issuing one collective per fusion
+bucket instead of one per parameter. ``MXTPU_FUSED_TRAINER=0`` is the
+escape hatch back to today's loops and must stay green.
+
+Runs on the conftest's virtual multi-device CPU platform; the parity
+cases also pin a 2-device mesh as the process-global mesh to mirror
+the imperative-on-a-mesh deployment shape.
+"""
+import numpy as onp
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon, grad_fusion, parallel, telemetry
+from mxnet_tpu import np as mnp
+from mxnet_tpu.gluon import nn
+
+
+def _net(dtype="float32"):
+    net = nn.Sequential()
+    net.add(nn.Dense(16, activation="relu"),
+            nn.Dense(8, activation="relu"),
+            nn.Dense(4))
+    net.initialize()
+    if dtype != "float32":
+        net.cast(dtype)
+    return net
+
+
+def _train(opt_name, fused, with_amp, steps=5, fusion=None,
+           dtype="float32", opt_params=None, monkeypatch=None):
+    """One training run; returns (weights, states, losses) snapshots."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1" if fused else "0")
+    mx.np.random.seed(0)
+    onp.random.seed(0)
+    net = _net(dtype)
+    x = mnp.array(onp.random.RandomState(1).randn(6, 10).astype("f4"))
+    if dtype != "float32":
+        x = x.astype(dtype)
+    net(x)  # materialize deferred shapes
+    params = opt_params or {"learning_rate": 0.05}
+    tr = gluon.Trainer(net.collect_params(), opt_name, dict(params),
+                       fusion=fusion)
+    if with_amp:
+        amp.init_trainer(tr)
+    losses = []
+    for s in range(steps):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+            if with_amp:
+                with amp.scale_loss(loss, tr) as scaled:
+                    scaled.backward()
+        if not with_amp:
+            loss.backward()
+        if with_amp and s == 2:
+            # force an overflow-skip step: both paths must skip the
+            # update and shrink the scale identically
+            p = tr._params[0]
+            p.grad()[:] = float("inf")
+        tr.step(6)
+        losses.append(loss.asnumpy().copy())
+    weights = [p.data().asnumpy().copy() for p in tr._params]
+    states = jax.tree_util.tree_map(
+        lambda a: onp.asarray(a) if isinstance(a, jax.Array) else a,
+        tr._states)
+    return weights, states, losses
+
+
+@pytest.mark.parametrize("with_amp", [False, True],
+                         ids=["plain", "amp_overflow_skip"])
+@pytest.mark.parametrize("opt_name", ["sgd", "adam", "adamw"])
+def test_fused_vs_loop_bit_parity(opt_name, with_amp, monkeypatch):
+    opt_params = {"learning_rate": 0.05}
+    if opt_name == "sgd":
+        opt_params["momentum"] = 0.9
+    mesh = parallel.make_mesh((2,), ("dp",),
+                              devices=jax.devices("cpu")[:2])
+    parallel.set_mesh(mesh)
+    try:
+        w_f, s_f, l_f = _train(opt_name, True, with_amp,
+                               opt_params=opt_params,
+                               monkeypatch=monkeypatch)
+        w_p, s_p, l_p = _train(opt_name, False, with_amp,
+                               opt_params=opt_params,
+                               monkeypatch=monkeypatch)
+    finally:
+        parallel.set_mesh(None)
+    for a, b in zip(l_f, l_p):
+        onp.testing.assert_array_equal(a, b)
+    for a, b in zip(w_f, w_p):
+        onp.testing.assert_array_equal(a, b)
+    flat_f = jax.tree_util.tree_leaves(s_f)
+    flat_p = jax.tree_util.tree_leaves(s_p)
+    assert len(flat_f) == len(flat_p)
+    for a, b in zip(flat_f, flat_p):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_fused_vs_loop_bit_parity_multi_precision(monkeypatch):
+    """fp16 weights + multi_precision: the (dtype, mp) grouping path."""
+    opt_params = {"learning_rate": 0.05, "momentum": 0.9,
+                  "multi_precision": True}
+    w_f, s_f, _ = _train("sgd", True, False, dtype="float16",
+                         opt_params=opt_params, monkeypatch=monkeypatch)
+    w_p, s_p, _ = _train("sgd", False, False, dtype="float16",
+                         opt_params=opt_params, monkeypatch=monkeypatch)
+    for a, b in zip(w_f, w_p):
+        onp.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(s_f),
+                    jax.tree_util.tree_leaves(s_p)):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_fused_collective_count_le_bucket_count(monkeypatch):
+    """Tier-1 acceptance: per step, the fused path issues at most one
+    collective per bucket — and strictly fewer collectives than the
+    per-parameter path would (2x+ reduction for multi-param nets)."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        steps = 3
+        for _ in range(steps):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(4)
+        n_buckets = len(tr._grad_buckets())
+        n_params = sum(1 for p in tr._params
+                       if p.grad_req != "null" and p._data is not None)
+        collectives = telemetry.counter_value("kvstore.fused.collectives")
+        assert collectives == telemetry.counter_value(
+            "trainer.fused.buckets")
+        assert collectives / steps <= n_buckets
+        # 6 same-dtype params fit one 4 MiB bucket -> >= 2x fewer
+        # collectives than the per-param loop's one-per-param
+        assert collectives / steps <= n_params / 2
+        assert telemetry.counter_value("kvstore.fused.bytes_pre") > 0
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_escape_hatch_uses_per_param_path(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "0")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(4)
+        assert telemetry.counter_value("kvstore.fused.collectives") == 0
+        assert telemetry.counter_value("trainer.fused.buckets") == 0
+        # the per-param kvstore path ran instead
+        snap = telemetry.snapshot()
+        assert snap["durations"].get("kvstore.pushpull", {}) \
+            .get("count", 0) > 0
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_trainer_fusion_arg_disables_bucketing(monkeypatch):
+    """Trainer(fusion=False): allreduce stays per-parameter even with
+    the env default on."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, fusion=False)
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(4)
+        assert telemetry.counter_value("kvstore.fused.collectives") == 0
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_bucket_building_cap_dtype_and_order():
+    """build_buckets: reverse declaration order, dtype separation, the
+    byte cap, and oversize-gradient isolation."""
+
+    class FakeNDArray:
+        def __init__(self, arr):
+            self._data = arr
+
+    class FakeParam:
+        def __init__(self, arr):
+            self._data = FakeNDArray(arr)
+
+    f4 = [FakeParam(onp.zeros((16,), "f4")) for _ in range(4)]   # 64 B
+    f2 = FakeParam(onp.zeros((16,), "f2"))                       # 32 B
+    big = FakeParam(onp.zeros((1000,), "f4"))                    # 4000 B
+    active = list(enumerate(f4 + [f2, big]))
+    buckets = grad_fusion.build_buckets(active, cap_bytes=128)
+    # oversize grad gets its own bucket; f2 separated from f4; the
+    # four 64 B f4 grads split 2+2 under the 128 B cap
+    by_idx = {b.indices: b for b in buckets}
+    assert (5,) in by_idx and by_idx[(5,)].nbytes == 4000
+    assert (4,) in by_idx and by_idx[(4,)].dtype == "float16"
+    f4_buckets = [b for b in buckets if b.dtype == "float32"
+                  and b.indices != (5,)]
+    assert [b.indices for b in f4_buckets] == [(3, 2), (1, 0)]
+    assert all(b.nbytes <= 128 for b in f4_buckets)
+
+
+def test_fused_compression_per_bucket_error_feedback(monkeypatch):
+    """Compression wraps the bucket collective: quantized values on
+    the wire, residual carried per bucket across steps."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.0},  # freeze weights
+                       compression_params={"type": "2bit",
+                                           "threshold": 0.5})
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        for _ in range(2):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(4)
+        kv = tr._kvstore
+        assert kv._compression is not None
+        # residuals are keyed by the bucket, not per parameter
+        keys = {k for (k, _r) in kv._compression._residuals}
+        assert keys == {b.key for b in tr._grad_buckets()}
+        # post-update grads are quantized levels {-t, 0, +t}
+        for p in tr._params:
+            g = p.grad().asnumpy()
+            assert set(onp.unique(g)) <= {-0.5, 0.0, 0.5}
+        # wire bytes shrink 16x vs the fp32 payload (2 bits/elem)
+        pre = telemetry.counter_value("kvstore.fused.bytes_pre")
+        wire = telemetry.counter_value("kvstore.fused.bytes_wire")
+        assert 0 < wire <= pre / 8
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_bucket_layout_cached_and_rebuilt():
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd")
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    b1 = tr._grad_buckets()
+    assert tr._grad_buckets() is b1  # cached on signature
+    tr._params[0]._grad_req = "null"  # deactivate one param
+    b2 = tr._grad_buckets()
+    assert b2 is not b1
+    assert sum(len(b.indices) for b in b2) == \
+        sum(len(b.indices) for b in b1) - 1
+
+
+def test_fusion_bytes_env_override(monkeypatch):
+    monkeypatch.setenv("MXTPU_FUSION_BYTES", "64")
+    assert grad_fusion.default_fusion_bytes() == 64
+    monkeypatch.setenv("MXTPU_FUSION_BYTES", "bogus")
+    with pytest.warns(UserWarning):
+        assert grad_fusion.default_fusion_bytes() == \
+            grad_fusion.DEFAULT_FUSION_BYTES
+
+
+def test_small_fusion_cap_still_bit_identical(monkeypatch):
+    """A tiny byte cap forces many buckets; numerics must not move."""
+    w_f, _, _ = _train("adam", True, False, fusion=256,
+                       monkeypatch=monkeypatch)
+    w_p, _, _ = _train("adam", False, False, monkeypatch=monkeypatch)
+    for a, b in zip(w_f, w_p):
+        onp.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("opt_name,opt_params", [
+    ("rmsprop", {"learning_rate": 0.01, "centered": True}),
+    ("adadelta", {}),
+    ("ftrl", {"learning_rate": 0.1}),
+    ("ftml", {"learning_rate": 0.01}),
+])
+def test_fused_aliased_state_optimizers(opt_name, opt_params,
+                                        monkeypatch):
+    """Regression: these optimizers create state tuples whose entries
+    may alias one buffer — the donating fused update must not crash
+    ('Attempt to donate the same buffer twice') and must stay
+    bit-identical to the loop."""
+    w_f, s_f, _ = _train(opt_name, True, False, steps=3,
+                         opt_params=opt_params, monkeypatch=monkeypatch)
+    w_p, s_p, _ = _train(opt_name, False, False, steps=3,
+                         opt_params=opt_params, monkeypatch=monkeypatch)
+    for a, b in zip(w_f, w_p):
+        onp.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree_util.tree_leaves(s_f),
+                    jax.tree_util.tree_leaves(s_p)):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_fused_update_dealias_guard(monkeypatch):
+    """A state pytree that aliases one buffer across entries (e.g. a
+    hand-built state) is de-aliased before donation instead of
+    crashing."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    import jax.numpy as jnp
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "adadelta")
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr._check_and_init()
+    # force aliasing the way pre-fix create_state did
+    for i, p in enumerate(tr._params):
+        z = jnp.zeros_like(p.data()._data)
+        tr._states[i] = (z, z)
+        tr._states_initialized[i] = True
+    tr.step(4)  # must not raise
+
+
+def test_compression_residuals_survive_bucket_layout_rebuild(
+        monkeypatch):
+    """Regression: a bucket-layout rebuild (param deactivated between
+    steps) must not feed a stale wrong-length residual into the
+    quantize kernel — content-keyed residuals start fresh instead."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01},
+                       compression_params={"type": "2bit",
+                                           "threshold": 0.5})
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    keys_before = {b.key for b in tr._grad_buckets()}
+    tr._params[0].grad_req = "null"  # layout change
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4, ignore_stale_grad=True)  # must not raise
+    keys_after = {b.key for b in tr._grad_buckets()}
+    assert keys_before != keys_after  # fresh residual key post-rebuild
+    # the abandoned keys' residuals were evicted, not leaked
+    live = {k for (k, _r) in tr._kvstore._compression._residuals}
+    assert live == keys_after
+
+
+def test_bucket_keys_distinct_across_trainers():
+    """Two trainers sharing one kvstore must not share compression
+    residual keys."""
+    net_a, net_b = _net(), _net()
+    x = mnp.ones((4, 10))
+    net_a(x), net_b(x)
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd")
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd")
+    for tr in (tr_a, tr_b):
+        with autograd.record():
+            loss = (tr._params[0].data() ** 2).sum()
+        loss.backward()
+        tr.step(1, ignore_stale_grad=True)
+    keys_a = {b.key for b in tr_a._grad_buckets()}
+    keys_b = {b.key for b in tr_b._grad_buckets()}
+    assert not (keys_a & keys_b)
+
+
+def test_fused_step_keeps_detach_snapshots_alive(monkeypatch):
+    """Regression: weights are not donated — a detach() snapshot taken
+    before step() must stay readable after it."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    snaps = [p.data().detach() for p in tr._params]
+    before = [s.asnumpy().copy() for s in snaps]
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(4)
+    for s, b in zip(snaps, before):  # must not raise 'Array deleted'
+        onp.testing.assert_array_equal(s.asnumpy(), b)
+
+
+def test_fused_step_with_setdata_aliased_weights(monkeypatch):
+    """Regression: two distinct Parameters sharing one weight buffer
+    (set_data aliasing) must not crash the fused update."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    a = gluon.Parameter("a", shape=(4,), init="ones")
+    b = gluon.Parameter("b", shape=(4,), init="ones")
+    a.initialize(); b.initialize()
+    b.set_data(a.data())  # may alias the same jax buffer
+    tr = gluon.Trainer([a, b], "sgd", {"learning_rate": 0.5})
+    with autograd.record():
+        y = (a.data() * 2 + b.data() * 3).sum()
+    y.backward()
+    tr.step(1)  # must not raise donate-twice
+    onp.testing.assert_allclose(a.data().asnumpy(), onp.full((4,), 0.0))
+    onp.testing.assert_allclose(b.data().asnumpy(), onp.full((4,), -0.5))
+
+
+def test_scheduler_bit_parity_with_unequal_update_counts(monkeypatch):
+    """Regression: with an lr_scheduler and UNEQUAL per-index update
+    counts (a late-added param), the fused path must read the same
+    scheduler lr sequence as the per-param loop."""
+    def run(fused):
+        monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1" if fused else "0")
+        mx.np.random.seed(0)
+        net = _net()
+        x = mnp.array(onp.random.RandomState(1).randn(6, 10)
+                      .astype("f4"))
+        net(x)
+        sched = mx.lr_scheduler.FactorScheduler(3, factor=0.5,
+                                                base_lr=0.1)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1,
+                            "lr_scheduler": sched})
+        # simulate a late-added param: index 0 is several updates ahead
+        tr._optimizer._index_update_count = {0: 4}
+        tr._optimizer.num_update = 4
+        for _ in range(4):
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(6)
+        return [p.data().asnumpy().copy() for p in tr._params]
+
+    for a, b in zip(run(True), run(False)):
+        onp.testing.assert_array_equal(a, b)
+
+
+def test_custom_update_multi_precision_override_not_bypassed(
+        monkeypatch):
+    """Regression: an Optimizer subclass overriding
+    update_multi_precision (but not update/_step) must keep its custom
+    math under the fused path — the fused dispatch falls back to the
+    per-parameter calls."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    calls = []
+
+    class MyOpt(mx.optimizer.Optimizer):
+        def update_multi_precision(self, index, weight, grad, state):
+            calls.append(tuple(index))
+            for i, w, s in zip(index, weight, state):
+                w._install(w._data * 0.5)  # custom math, not _step
+                self._set_state(i, s, s)
+
+    x = gluon.Parameter("x", shape=(4,), init="ones")
+    x.initialize()
+    tr = gluon.Trainer([x], MyOpt())
+    with autograd.record():
+        y = (x.data() * 2).sum()
+    y.backward()
+    tr.step(1)
+    assert calls == [(0,)]  # per-param calls, like the non-fused loop
+    onp.testing.assert_allclose(x.data().asnumpy(), onp.full((4,), 0.5))
+
+
+def test_discarded_trainer_evicts_residuals_from_shared_kvstore(
+        monkeypatch):
+    """Regression: a short-lived Trainer on a long-lived shared
+    kvstore must not leak its bucket residuals when discarded."""
+    import gc
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    kv = mx.kvstore.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+
+    def one_trainer():
+        net = _net()
+        x = mnp.ones((4, 10))
+        net(x)
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.01}, kvstore=kv)
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(4)
+        assert kv._compression._residuals  # residuals exist while live
+
+    for _ in range(3):
+        one_trainer()
+        gc.collect()
+    assert not kv._compression._residuals  # all evicted on discard
+
+
+def test_nonpositive_fusion_cap_rejected():
+    x = gluon.Parameter("x", shape=(2,), init="zeros")
+    x.initialize()
+    for bad in (-1, 0.5):  # negatives and sub-byte floats
+        with pytest.raises(ValueError):
+            gluon.Trainer([x], "sgd", fusion=bad)
+
+
+def test_fallback_optimizer_not_labeled_fused_update(monkeypatch):
+    """SGLD (custom update) falls back per-param — the
+    trainer.fused.update telemetry row must not be recorded."""
+    monkeypatch.setenv("MXTPU_FUSED_TRAINER", "1")
+    x = gluon.Parameter("x", shape=(4,), init="ones")
+    x.initialize()
+    tr = gluon.Trainer([x], "sgld", {"learning_rate": 0.01})
+    prev = telemetry.set_enabled(True)
+    telemetry.reset()
+    try:
+        with autograd.record():
+            y = (x.data() ** 2).sum()
+        y.backward()
+        tr.step(1)
+        snap = telemetry.snapshot()
+        assert "trainer.fused.update" not in snap["durations"]
+    finally:
+        telemetry.set_enabled(prev)
+        telemetry.reset()
+
+
+def test_stale_grad_warns_once_per_step():
+    """Satellite: the stale-grad warning fires once per step naming
+    every stale parameter, not once per parameter."""
+    import warnings as pywarnings
+    net = _net()
+    x = mnp.ones((4, 10))
+    net(x)
+    tr = gluon.Trainer(net.collect_params(), "sgd")
+    with pywarnings.catch_warnings(record=True) as rec:
+        pywarnings.simplefilter("always")
+        tr.step(1)  # no backward ran: every grad is stale
+    stale_warns = [w for w in rec
+                   if "has not been updated by backward" in str(w.message)]
+    assert len(stale_warns) == 1
+    msg = str(stale_warns[0].message)
+    for p in tr._params:
+        if p.grad_req != "null":
+            assert f"`{p.name}`" in msg

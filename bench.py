@@ -869,7 +869,296 @@ def _trainer_path_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --serving: inference serving-path benchmark (CPU-runnable, <2 min).
+# Open-loop A/B with Poisson arrivals at a FIXED offered rate (set from
+# a calibration child measuring single-request forward latency), each
+# config in its own subprocess on the virtual 8-device cpu mesh:
+#
+#   perreq: 16 worker threads, one block(x) dispatch per request
+#           (batch-1 AOT-warmed — the pre-engine serving path)
+#   engine: serving.InferenceEngine micro-batching the same arrival
+#           stream (one padded forward per coalesced batch)
+#
+# Reports requests/sec, p50/p99 latency (vs SCHEDULED arrival — open
+# loop), mean batch occupancy, in-window compile counts, and an
+# engine-vs-per-request bit-identity check, to BENCH_r08.json
+# (same A/B + reduction-ratio schema as BENCH_r06/r07).
+# ---------------------------------------------------------------------------
+SERVING_FEAT, SERVING_HIDDEN, SERVING_CLASSES = 64, 256, 32
+SERVING_REQS = int(os.environ.get("BENCH_SERVING_REQS", "2400"))
+SERVING_THREADS = 16          # per-request worker pool = concurrency
+SERVING_MAX_BATCH = 32
+SERVING_RATE_X = 6.0          # offered rate: 6x sequential capacity
+
+
+def _serving_model():
+    import mxnet_tpu as mx
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon import nn
+    import numpy as onp
+    mx.np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(SERVING_HIDDEN, activation="relu"),
+            nn.Dense(SERVING_HIDDEN // 2, activation="relu"),
+            nn.Dense(SERVING_CLASSES))
+    net.initialize(mx.init.Xavier())
+    net(mnp.array(onp.zeros((1, SERVING_FEAT), "f4")))
+    return net
+
+
+def _serving_inputs(n=256):
+    import numpy as onp
+    from mxnet_tpu import np as mnp
+    rng = onp.random.RandomState(7)
+    return [mnp.array(rng.randn(1, SERVING_FEAT).astype("f4"))
+            for _ in range(n)]
+
+
+def _serving_arrivals(rate_rps):
+    """Poisson arrival offsets (seconds from t0), fixed seed: both
+    configs replay the SAME offered load."""
+    import numpy as onp
+    rng = onp.random.RandomState(42)
+    return rng.exponential(1.0 / rate_rps, SERVING_REQS).cumsum()
+
+
+def _serving_calibrate():
+    """Mean batch-1 forward+materialize latency (the sequential
+    capacity the offered rate is scaled from)."""
+    net = _serving_model()
+    xs = _serving_inputs(64)
+    net.warmup(xs[0])
+    for x in xs[:8]:
+        net(x).asnumpy()
+    t0 = time.perf_counter()
+    n = 200
+    for i in range(n):
+        net(xs[i % 64]).asnumpy()
+    single_ms = (time.perf_counter() - t0) / n * 1e3
+    print(json.dumps({"single_ms": round(single_ms, 4)}), flush=True)
+    return 0
+
+
+def _serving_lat_stats(lat_ms):
+    import numpy as onp
+    a = onp.asarray(lat_ms)
+    return {
+        "p50_ms": round(float(onp.percentile(a, 50)), 3),
+        "p95_ms": round(float(onp.percentile(a, 95)), 3),
+        "p99_ms": round(float(onp.percentile(a, 99)), 3),
+        "mean_ms": round(float(a.mean()), 3),
+    }
+
+
+def _serving_feed(arrivals, emit):
+    """Open-loop feeder: emit(i) at (or as soon after as the clock
+    allows) each scheduled arrival; never waits for completions."""
+    t0 = time.perf_counter()
+    for i, at in enumerate(arrivals):
+        while True:
+            lag = t0 + at - time.perf_counter()
+            if lag <= 0:
+                break
+            time.sleep(min(lag, 0.001))
+        emit(i)
+    return t0
+
+
+def _serving_perreq(rate_rps):
+    import queue as pyqueue
+    import threading
+    from mxnet_tpu import telemetry
+
+    net = _serving_model()
+    xs = _serving_inputs()
+    net.warmup(xs[0])
+    for x in xs[:4]:
+        net(x).asnumpy()
+    arrivals = _serving_arrivals(rate_rps)
+    done_t = [0.0] * SERVING_REQS
+    q = pyqueue.Queue()
+
+    def worker():
+        while True:
+            i = q.get()
+            if i is None:
+                return
+            net(xs[i % len(xs)]).asnumpy()
+            done_t[i] = time.perf_counter()
+
+    telemetry.reset()
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(SERVING_THREADS)]
+    for t in threads:
+        t.start()
+    t0 = _serving_feed(arrivals, q.put)
+    for t in threads:
+        q.put(None)
+    for t in threads:
+        t.join(timeout=600)
+    snap = telemetry.snapshot()
+    lat = [(done_t[i] - (t0 + arrivals[i])) * 1e3
+           for i in range(SERVING_REQS)]
+    makespan = max(done_t) - (t0 + arrivals[0])
+    return {
+        "mode": "perreq",
+        "requests": SERVING_REQS,
+        "threads": SERVING_THREADS,
+        "requests_per_sec": round(SERVING_REQS / makespan, 1),
+        "mean_batch_occupancy": 1.0,
+        "compiles_in_window":
+            int(snap["counters"].get("gluon.cachedop.cache_miss", 0)),
+        **_serving_lat_stats(lat),
+    }
+
+
+def _serving_engine(rate_rps):
+    from mxnet_tpu import bucketing, telemetry
+    from mxnet_tpu.serving import InferenceEngine
+
+    net = _serving_model()
+    xs = _serving_inputs()
+    eng = InferenceEngine(net, max_batch_size=SERVING_MAX_BATCH,
+                          max_queue_ms=2.0,
+                          queue_limit=SERVING_REQS + SERVING_THREADS)
+    eng.warmup(xs[0])
+    eng.predict(xs[0])
+    # bit-identity: engine output vs per-request block(x) under the
+    # same policy (same compiled width — docs/SERVING.md)
+    bit_identical = True
+    with bucketing.policy_scope(eng.policy):
+        for x in xs[:8]:
+            if eng.predict(x).asnumpy().tobytes() \
+                    != net(x).asnumpy().tobytes():
+                bit_identical = False
+    arrivals = _serving_arrivals(rate_rps)
+    futs = [None] * SERVING_REQS
+    done_t = [0.0] * SERVING_REQS
+
+    def emit(i):
+        # completion stamped by a done-callback (fires at set_result
+        # on the batcher thread) — symmetric with the perreq workers'
+        # completion stamps; a sequential post-feed harvest would
+        # inflate engine latency by the harvest delay
+        f = eng.submit(xs[i % len(xs)])
+        f.add_done_callback(
+            lambda _f, _i=i: done_t.__setitem__(
+                _i, time.perf_counter()))
+        futs[i] = f
+
+    telemetry.reset()
+    t0 = _serving_feed(arrivals, emit)
+    for i, f in enumerate(futs):
+        f.result(timeout=600).asnumpy()
+        if done_t[i] == 0.0:
+            # result() can return before the done-callback runs
+            # (set_result wakes waiters first); stamp the bound here
+            done_t[i] = time.perf_counter()
+    snap = telemetry.snapshot()
+    eng.close()
+    lat = [(done_t[i] - (t0 + arrivals[i])) * 1e3
+           for i in range(SERVING_REQS)]
+    makespan = max(done_t) - (t0 + arrivals[0])
+    occ = snap["durations"].get("serving.batch.occupancy", {})
+    hist = snap["histograms"].get("serving.request.latency", {})
+    return {
+        "mode": "engine",
+        "requests": SERVING_REQS,
+        "max_batch_size": SERVING_MAX_BATCH,
+        "max_queue_ms": 2.0,
+        "requests_per_sec": round(SERVING_REQS / makespan, 1),
+        "batches": int(snap["counters"].get("serving.batches", 0)),
+        "mean_batch_occupancy": round(occ.get("avg", 0.0), 2),
+        "peak_queue_depth":
+            snap["gauges"].get("serving.queue.depth", {}).get("peak", 0),
+        "compiles_in_window":
+            int(snap["counters"].get("gluon.cachedop.cache_miss", 0)),
+        "bit_identical_to_per_request": bit_identical,
+        "telemetry_hist_p50_ms": round(hist.get("p50", 0.0), 3),
+        "telemetry_hist_p99_ms": round(hist.get("p99", 0.0), 3),
+        **_serving_lat_stats(lat),
+    }
+
+
+def _serving_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_SERVING_CONFIG"]
+    if cfg == "calib":
+        return _serving_calibrate()
+    rate = float(os.environ["BENCH_SERVING_RATE"])
+    result = _serving_perreq(rate) if cfg == "perreq" \
+        else _serving_engine(rate)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def _serving_main():
+    if os.environ.get("BENCH_SERVING_CONFIG"):
+        return _serving_child()
+
+    def run_child(cfg, extra_env=None):
+        env = dict(os.environ, BENCH_SERVING_CONFIG=cfg,
+                   JAX_PLATFORMS="cpu", **(extra_env or {}))
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--serving"],
+            env=env, capture_output=True, text=True, timeout=600)
+        if out.returncode != 0:
+            print(f"[bench] serving {cfg} failed: "
+                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
+                  flush=True)
+            return None
+        return json.loads(_harvest(out.stdout))
+
+    _stage("serving: calibration")
+    calib = run_child("calib")
+    if calib is None:
+        return 1
+    # offered load: SERVING_RATE_X times the sequential per-request
+    # capacity, replayed identically for both configs (open loop)
+    rate = SERVING_RATE_X / (calib["single_ms"] / 1e3)
+    rate_env = {"BENCH_SERVING_RATE": str(rate)}
+    results = {}
+    for cfg in ("perreq", "engine"):
+        _stage(f"serving: {cfg} config")
+        results[cfg] = run_child(cfg, rate_env)
+        if results[cfg] is None:
+            return 1
+    perreq, eng = results["perreq"], results["engine"]
+    doc = {
+        "metric": "serving_requests_per_sec",
+        "value": eng["requests_per_sec"],
+        "unit": "requests/sec",
+        "model": f"mlp {SERVING_FEAT}-{SERVING_HIDDEN}-"
+                 f"{SERVING_HIDDEN // 2}-{SERVING_CLASSES}",
+        "requests": SERVING_REQS,
+        "offered_rate_rps": round(rate, 1),
+        "arrival_process": "poisson (seed 42, identical per config)",
+        "calibration_single_ms": calib["single_ms"],
+        "concurrency": {"perreq_threads": SERVING_THREADS,
+                        "engine_peak_queue_depth":
+                            eng.get("peak_queue_depth", 0)},
+        "engine": eng,
+        "perreq": perreq,
+        "throughput_ratio": round(
+            eng["requests_per_sec"]
+            / max(perreq["requests_per_sec"], 1e-9), 2),
+        "p99_latency_ratio": round(
+            eng["p99_ms"] / max(perreq["p99_ms"], 1e-9), 4),
+    }
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_SERVING_OUT",
+                                           "BENCH_r08.json"))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    return 0
+
+
 def main():
+    if "--serving" in sys.argv:
+        return _serving_main()
     if "--trainer-path" in sys.argv:
         return _trainer_path_main()
     if "--steady-state" in sys.argv:

@@ -48,17 +48,24 @@ class BoundedQueueWorker(threading.Thread):
                 if self._stopped or not self.is_alive():
                     return self._DONE
 
+    def _drained(self, item):
+        """Hook for every item discarded by ``stop()``'s drain.
+        Default: drop it. A stage whose queued items carry completion
+        obligations (the serving engine's request futures) overrides
+        this to reject them instead of leaving waiters hung."""
+
     def stop(self, timeout: float = 5.0):
         """Release the worker deterministically: drain-and-join in a
         loop, with a deadline so a worker wedged inside its source
-        (e.g. a stuck dataset) can't hang the caller."""
+        (e.g. a stuck dataset) can't hang the caller. Drained items
+        pass through ``_drained``."""
         self._stopped = True
         deadline = time.monotonic() + timeout
         while self.is_alive():
             # drain so a blocked put() can observe the flag promptly
             try:
                 while True:
-                    self._queue.get_nowait()
+                    self._drained(self._queue.get_nowait())
             except queue.Empty:
                 pass
             self.join(timeout=0.05)

@@ -94,6 +94,13 @@ class BucketingPolicy:
             target = max(n, self.max_size)
         return target
 
+    def sizes(self, max_size: int):
+        """Every bucket size reachable for a batch in ``1..max_size``,
+        sorted ascending — the warmup template list for a consumer
+        that wants zero steady-state compiles (serving engine AOT
+        warmup, `TrainStep.warmup`)."""
+        return sorted({self.bucket(n) for n in range(1, int(max_size) + 1)})
+
     def clamped(self, batch_size: int) -> "BucketingPolicy":
         """Copy of this policy that never pads past ``batch_size``."""
         return BucketingPolicy(

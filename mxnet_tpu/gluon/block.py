@@ -98,9 +98,58 @@ def _maybe_transpose_conv_kernel(name, p, val):
     return val
 
 
+class _ArgSpec:
+    """Rebuild spec for a flattened arg nest, with its ``repr`` string
+    cached on the object. The string is the hashable half of every
+    dispatch signature (`CachedOp._signature`, `TrainStep._sig`), and
+    re-stringifying the nest used to be a per-dispatch host cost —
+    `gluon.cachedop.signature` telemetry proves the cut. Equality and
+    hash go through the string so specs keep working as dict keys."""
+
+    __slots__ = ("tree", "_str")
+
+    def __init__(self, tree):
+        self.tree = tree
+        self._str = None
+
+    @property
+    def string(self) -> str:
+        s = self._str
+        if s is None:
+            s = self._str = repr(self.tree)
+        return s
+
+    def __repr__(self):
+        return self.string
+
+    def __eq__(self, other):
+        if isinstance(other, _ArgSpec):
+            return self.string == other.string
+        return NotImplemented
+
+    def __hash__(self):
+        return hash(self.string)
+
+
+# interned specs for the dominant call shape — every positional arg an
+# NDArray, no nesting — keyed by arg count: the SAME spec object (repr
+# already computed) comes back on every dispatch, so the signature
+# never walks or stringifies the nest again
+_FLAT_SPECS: dict = {}
+
+
 def _flatten_arrays(args):
     """Flatten nested (list/tuple/dict) args into NDArray leaves +
-    a rebuild spec. Non-array leaves become static."""
+    a rebuild `_ArgSpec`. Non-array leaves become static."""
+    flat = all(type(a) is NDArray or isinstance(a, NDArray)
+               for a in args)
+    if flat:
+        spec = _FLAT_SPECS.get(len(args))
+        if spec is None:
+            spec = _FLAT_SPECS[len(args)] = _ArgSpec(
+                ("list", [("arr", i) for i in range(len(args))]))
+            spec.string  # pre-compute: shared objects must stay frozen
+        return list(args), spec
     leaves = []
 
     def walk(x):
@@ -113,11 +162,12 @@ def _flatten_arrays(args):
             return ("dict", [(k, walk(v)) for k, v in sorted(x.items())])
         return ("static", x)
 
-    spec = walk(list(args))
-    return leaves, spec
+    return leaves, _ArgSpec(walk(list(args)))
 
 
 def _rebuild(spec, leaves):
+    if isinstance(spec, _ArgSpec):
+        spec = spec.tree
     kind, payload = spec
     if kind == "arr":
         return leaves[payload]
@@ -409,8 +459,11 @@ class CachedOp:
         self._entries = {}
 
     def _signature(self, leaves, spec, training):
-        stat = repr(spec)
-        return (tuple((l.shape, str(l.dtype)) for l in leaves), stat, training)
+        # spec.string is cached on the spec object (interned for flat
+        # all-NDArray calls), so steady-state dispatch never re-reprs
+        # the nest — timed as gluon.cachedop.signature by callers
+        return (tuple((l.shape, str(l.dtype)) for l in leaves),
+                spec.string, training)
 
     def _build(self, leaves, spec, training):
         block = self.block
@@ -586,7 +639,9 @@ class CachedOp:
                     telemetry.counter("gluon.cachedop.bucket_pad")
                     leaves, pad_n = _bucketing.pad_leaves(
                         leaves, target, orig_bsz)
+        t_sig = telemetry.clock()
         key_sig = self._signature(leaves, spec, training)
+        telemetry.duration_since("gluon.cachedop.signature", t_sig)
         entry = self._entries.get(key_sig)
         if entry is self._DYNAMIC:
             return self.block.forward(*args)
@@ -741,6 +796,68 @@ class CachedOp:
             return result[0]
         return result
 
+    def infer(self, *args):
+        """Slim inference-only dispatch (the serving fast path).
+
+        Skips everything ``__call__`` does for the training/recording
+        world — recording checks, tape setup, mesh placement — and
+        goes straight from signature to the AOT-compiled forward
+        (``fwd_aot``, see ``warmup``). Any condition the fast path
+        can't honor exactly (cache miss, rebound params, recording
+        active, a live mesh, a global bucketing policy, an AOT aval
+        mismatch) falls back to ``__call__``, which handles it; for
+        any given call the two paths run the SAME compiled program,
+        so results are bit-identical. Callers wanting zero
+        steady-state compiles must ``warmup()`` their signatures
+        first.
+        """
+        if _bucketing.get_policy() is not None:
+            # a global policy pads __call__ to a bucket width; the
+            # fast path must not dispatch a DIFFERENT width for the
+            # same inputs (bit-identity is per compiled width) — take
+            # the full path, which applies the policy exactly. The
+            # serving engine pads batches itself and never installs a
+            # global policy, so its dispatches stay on the fast path.
+            return self(*args)
+        leaves, spec = _flatten_arrays(args)
+        t_sig = telemetry.clock()
+        key_sig = self._signature(leaves, spec, False)
+        telemetry.duration_since("gluon.cachedop.signature", t_sig)
+        entry = self._entries.get(key_sig)
+        if (entry is None or entry is self._DYNAMIC
+                or entry.fwd_aot is None
+                or autograd.is_recording() or autograd.is_training()):
+            return self(*args)
+        if entry.epoch != _PARAM_REBIND_EPOCH or any(
+                p._data is not nd for p, nd in
+                zip(entry.params, entry.param_nds)):
+            return self(*args)  # stale entry: full path re-validates
+        from .. import parallel as _parallel
+        if _parallel.get_mesh() is not None:
+            return self(*args)  # mesh placement lives on the full path
+        telemetry.counter("gluon.cachedop.infer")
+        t0 = telemetry.clock()
+        try:
+            outs_raw, aux = entry.fwd_aot(
+                next_key(), [nd._data for nd in entry.param_nds],
+                [l._data for l in leaves])
+        except (TypeError, ValueError):
+            # aval mismatch vs. the warmed signature — let the full
+            # path run its lazy-jit fallback and telemetry
+            return self(*args)
+        telemetry.duration_since("gluon.cachedop.run", t0)
+        targets = entry.aux_targets.get("targets", [])
+        if targets:
+            with autograd.pause():
+                for nd, new in zip(targets, aux):
+                    nd._install(new)
+        ctx = leaves[0].ctx if leaves else current_context()
+        out_nds = [NDArray(engine.track(o), ctx=ctx) for o in outs_raw]
+        result = _rebuild(entry.out_spec["spec"], out_nds)
+        if entry.out_spec["single"]:
+            return result[0]
+        return result
+
 
 class HybridBlock(Block):
     """A Block that can be hybridized into a compiled graph."""
@@ -783,6 +900,19 @@ class HybridBlock(Block):
             self._cached_op = CachedOp(self)
         self._cached_op.warmup(*args, training=training)
         return self
+
+    def infer(self, *args):
+        """Inference fast path: dispatch the AOT-compiled forward with
+        none of the recording-path setup (see ``CachedOp.infer``).
+        Forward hooks are NOT run — this is the entry the serving
+        engine (`mxnet_tpu.serving`) uses under its batcher thread.
+        Falls back to the full ``__call__`` path whenever the fast
+        path can't honor the call exactly."""
+        if not self._active:
+            self.hybridize(True)
+        if self._cached_op is None:
+            self._cached_op = CachedOp(self)
+        return self._cached_op.infer(*args)
 
     def __call__(self, *args, **kwargs):
         # Only the OUTERMOST active block owns a CachedOp; children

@@ -1,0 +1,16 @@
+"""mxnet_tpu.serving — the inference fast path.
+
+`InferenceEngine` coalesces concurrent single-sample (or small-batch)
+requests onto one AOT-warmed CachedOp forward per dispatch — dynamic
+micro-batching with bounded queueing delay, admission control, and
+graceful shutdown. See docs/SERVING.md for knobs and operational
+guidance, ``bench.py --serving`` / BENCH_r08.json for the measured
+A/B against per-request dispatch.
+"""
+from .engine import (  # noqa: F401
+    InferenceEngine, ServingError, EngineClosedError, QueueFullError,
+    RequestTimeoutError,
+)
+
+__all__ = ["InferenceEngine", "ServingError", "EngineClosedError",
+           "QueueFullError", "RequestTimeoutError"]

@@ -3,8 +3,9 @@
 Parity target: the reference's profiler aggregate-stats table
 (src/profiler/profiler.h AggregateStats, rendered by
 `profiler.dumps(aggregate_stats=True)`): a process-wide table of named
-counters, gauges, and duration aggregators fed by hooks in every hot
-path (CachedOp compiles, TrainStep timing, kvstore traffic, the fused
+counters, gauges, duration aggregators, and log-bucketed duration
+histograms (p50/p95/p99 — the serving engine's latency rows) fed by
+hooks in every hot path (CachedOp compiles, TrainStep timing, kvstore traffic, the fused
 Trainer pipeline — bucket counts, pre/post-compression wire bytes,
 fused allreduce/update dispatch timing —, dataloader waits, engine
 memory watermarks). `profiler.dumps()` renders this
@@ -26,6 +27,7 @@ Design constraints:
 """
 from __future__ import annotations
 
+import bisect
 import json as _json
 import os
 import threading
@@ -33,8 +35,8 @@ import time
 
 __all__ = [
     "enabled", "set_enabled", "clock", "counter", "counter_value",
-    "gauge", "value", "duration_since", "snapshot", "reset", "render",
-    "names",
+    "gauge", "value", "duration_since", "hist", "hist_since",
+    "snapshot", "reset", "render", "names",
 ]
 
 _enabled = os.environ.get("MXTPU_TELEMETRY", "1").lower() \
@@ -47,6 +49,16 @@ _counters: dict = {}
 _gauges: dict = {}
 # name -> [count, total, min, max]
 _aggs: dict = {}
+# name -> [count, total, min, max, bucket_counts]
+_hists: dict = {}
+
+# Log-spaced histogram bucket UPPER bounds (ms): 12 per decade over
+# 1µs..10s, one overflow bucket past the end. Fixed buckets keep
+# recording O(1) with no per-event storage (a serving path records one
+# sample per request — a reservoir would be the hot-path cost the
+# registry exists to avoid); 12/decade bounds quantile interpolation
+# error at ~±10%, plenty for p50/p95/p99 latency reporting.
+_HIST_BOUNDS = tuple(10.0 ** (-3 + i / 12.0) for i in range(85))
 
 
 def enabled() -> bool:
@@ -131,24 +143,84 @@ def duration_since(name: str, t0: float):
     value(name, (time.perf_counter() - t0) * 1e3)
 
 
+def hist(name: str, val: float):
+    """Record one sample into the log-bucketed histogram for ``name``.
+
+    Unlike ``value()`` (count/total/min/max only), a histogram can
+    answer quantile queries — ``snapshot()`` derives p50/p95/p99 by
+    interpolating within the matched bucket, and ``render()`` prints
+    them (the serving engine's latency rows). Negative samples clamp
+    into the first bucket."""
+    if not _enabled:
+        return
+    idx = bisect.bisect_left(_HIST_BOUNDS, val)
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            _hists[name] = [1, val, val, val,
+                            [0] * (len(_HIST_BOUNDS) + 1)]
+            _hists[name][4][idx] = 1
+            return
+        h[0] += 1
+        h[1] += val
+        if val < h[2]:
+            h[2] = val
+        if val > h[3]:
+            h[3] = val
+        h[4][idx] += 1
+
+
+def hist_since(name: str, t0: float):
+    """Record elapsed milliseconds since ``t0 = telemetry.clock()``
+    into the histogram ``name`` (see ``duration_since`` for the 0.0
+    convention)."""
+    if not _enabled or t0 == 0.0:
+        return
+    hist(name, (time.perf_counter() - t0) * 1e3)
+
+
+def _hist_quantile(h, q: float) -> float:
+    """q-quantile estimate from bucket counts: locate the bucket
+    holding the q*count-th sample, interpolate linearly inside it,
+    clamp to the exact observed [min, max]."""
+    count, counts = h[0], h[4]
+    if not count:
+        return 0.0
+    rank = q * count
+    seen = 0
+    for i, n in enumerate(counts):
+        if not n:
+            continue
+        if seen + n >= rank:
+            lo = _HIST_BOUNDS[i - 1] if i > 0 else 0.0
+            hi = _HIST_BOUNDS[i] if i < len(_HIST_BOUNDS) else h[3]
+            est = lo + (hi - lo) * (rank - seen) / n
+            return min(max(est, h[2]), h[3])
+        seen += n
+    return h[3]
+
+
 def reset():
     """Drop every registered entry."""
     with _lock:
         _counters.clear()
         _gauges.clear()
         _aggs.clear()
+        _hists.clear()
 
 
 def names():
     """All registered entry names (tests / quick inspection)."""
     with _lock:
-        return sorted(set(_counters) | set(_gauges) | set(_aggs))
+        return sorted(set(_counters) | set(_gauges) | set(_aggs)
+                      | set(_hists))
 
 
 def snapshot(reset_after: bool = False) -> dict:
     """Consistent copy of the registry:
     ``{"durations": {name: {count,total,min,max,avg}},
-       "counters": {name: value}, "gauges": {name: {value, peak}}}``."""
+       "counters": {name: value}, "gauges": {name: {value, peak}},
+       "histograms": {name: {count,total,min,max,avg,p50,p95,p99}}}``."""
     with _lock:
         counters = dict(_counters)
         gauges = {k: {"value": v[0], "peak": v[1]}
@@ -156,11 +228,20 @@ def snapshot(reset_after: bool = False) -> dict:
         aggs = {k: {"count": v[0], "total": v[1], "min": v[2],
                     "max": v[3], "avg": v[1] / v[0] if v[0] else 0.0}
                 for k, v in _aggs.items()}
+        hists = {k: {"count": v[0], "total": v[1], "min": v[2],
+                     "max": v[3],
+                     "avg": v[1] / v[0] if v[0] else 0.0,
+                     "p50": _hist_quantile(v, 0.50),
+                     "p95": _hist_quantile(v, 0.95),
+                     "p99": _hist_quantile(v, 0.99)}
+                 for k, v in _hists.items()}
         if reset_after:
             _counters.clear()
             _gauges.clear()
             _aggs.clear()
-    return {"durations": aggs, "counters": counters, "gauges": gauges}
+            _hists.clear()
+    return {"durations": aggs, "counters": counters, "gauges": gauges,
+            "histograms": hists}
 
 
 # -- rendering (the reference's aggregate-stats table) -----------------
@@ -206,6 +287,11 @@ def render(format: str = "table", sort_by: str = "total",
     gauge_key = (lambda kv: kv[0]) if sort_by == "name" \
         else (lambda kv: kv[1]["value"])
     gauges = _sorted_items(snap["gauges"], gauge_key, sort_by, ascending)
+    hists = _sorted_items(
+        snap["histograms"],
+        (lambda kv: kv[1][sort_by]) if sort_by != "name"
+        else (lambda kv: kv[0]),
+        sort_by, ascending)
 
     if format == "json":
         doc = {
@@ -215,12 +301,14 @@ def render(format: str = "table", sort_by: str = "total",
             "durations": dict(aggs),
             "counters": dict(counters),
             "gauges": dict(gauges),
+            "histograms": dict(hists),
         }
         if trace_dir:
             doc["trace_dir"] = trace_dir
         return _json.dumps(doc, indent=2)
 
-    w = max([len(n) for n, _ in aggs + counters + gauges] + [24]) + 2
+    w = max([len(n) for n, _ in aggs + counters + gauges + hists]
+            + [24]) + 2
     lines = ["Profile Statistics (aggregate)",
              "\tNote that counter items are counter values and not "
              "time units."]
@@ -237,6 +325,18 @@ def render(format: str = "table", sort_by: str = "total",
             lines.append(
                 f"{name:<{w}}{a['count']:>10}{a['total']:>14.4f}"
                 f"{a['min']:>12.4f}{a['max']:>12.4f}{a['avg']:>12.4f}")
+    if hists:
+        lines += ["", "Duration histograms (ms; p* interpolated from "
+                  "log buckets)", "=" * 56,
+                  f"{'Name':<{w}}{'Count':>10}{'p50':>12}{'p95':>12}"
+                  f"{'p99':>12}{'Max':>12}{'Avg':>12}",
+                  f"{'----':<{w}}{'-----':>10}{'---':>12}{'---':>12}"
+                  f"{'---':>12}{'---':>12}{'---':>12}"]
+        for name, h in hists:
+            lines.append(
+                f"{name:<{w}}{h['count']:>10}{h['p50']:>12.4f}"
+                f"{h['p95']:>12.4f}{h['p99']:>12.4f}{h['max']:>12.4f}"
+                f"{h['avg']:>12.4f}")
     if counters:
         lines += ["", "Counters", "=" * 8,
                   f"{'Name':<{w}}{'Value':>14}",
@@ -249,7 +349,7 @@ def render(format: str = "table", sort_by: str = "total",
                   f"{'----':<{w}}{'-----':>14}{'----':>14}"]
         for name, g in gauges:
             lines.append(f"{name:<{w}}{g['value']:>14g}{g['peak']:>14g}")
-    if not (aggs or counters or gauges):
+    if not (aggs or counters or gauges or hists):
         lines += ["", "(no telemetry recorded"
                   + (" — MXTPU_TELEMETRY=0)" if not _enabled else ")")]
     return "\n".join(lines)

@@ -24,6 +24,13 @@ else:
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'` (ROADMAP.md): long soak/perf tests
+    # opt out of the 870s window with this marker
+    config.addinivalue_line(
+        "markers", "slow: long soak/perf test, excluded from tier-1")
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     """Deterministic per-test seeding (parity: the reference's seed

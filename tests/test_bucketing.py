@@ -194,6 +194,98 @@ def test_cachedop_bucketing_skipped_under_recording():
         out.backward()
 
 
+# -- serving clamp edges: the engine dispatch path leans on CachedOp
+#    pad-and-slice; these pin the edges it can hit ---------------------
+
+def test_cachedop_batch_above_largest_explicit_bucket():
+    """A batch past the largest explicit bucket maps to itself (no
+    pad) — it builds its own entry and matches the policy-off forward
+    bit for bit (same dispatch width)."""
+    rng = onp.random.RandomState(20)
+    net = _mlp()
+    net.hybridize()
+    x16 = np.array(rng.randn(16, 8).astype(onp.float32))
+    policy = bucketing.BucketingPolicy(buckets=[4, 8])
+    assert policy.bucket(16) == 16
+    ref = net(x16).asnumpy()          # no policy: width-16 entry
+    with bucketing.policy_scope(policy):
+        out = net(x16)
+    assert out.shape == (16, 4)
+    onp.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+class _ScaledMLP(nn.HybridSequential):
+    """Forward takes (batched x, 0-d scale) — the scalar leaf must
+    pass through padding untouched."""
+
+    def __init__(self):
+        super().__init__()
+        self.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+
+    def forward(self, x, s):
+        return super().forward(x) * s
+
+
+def test_cachedop_scalar_leaf_pads_and_slices_bit_identically():
+    rng = onp.random.RandomState(21)
+    net = _ScaledMLP()
+    net.initialize(mx.init.Xavier())
+    x10 = rng.randn(10, 8).astype(onp.float32)
+    s = np.array(onp.float32(1.5))
+    net(np.array(x10), s)
+    net.hybridize()
+    # reference: the SAME rows manually padded to the bucket width,
+    # dispatched unpolicied, sliced back — pad-and-slice must equal it
+    # exactly (padding may not perturb valid rows by even one ulp)
+    x16 = onp.concatenate([x10, onp.repeat(x10[-1:], 6, 0)])
+    ref = net(np.array(x16), s).asnumpy()[:10]
+    with bucketing.policy_scope("pow2"):
+        out = net(np.array(x10), s)
+    assert out.shape == (10, 4)
+    onp.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+class _Gated(nn.HybridSequential):
+    """Mixed-dtype inputs: f32 features + i32 gate, both batched."""
+
+    def __init__(self):
+        super().__init__()
+        self.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+
+    def forward(self, x, gate):
+        return super().forward(x) * gate.astype("float32") \
+            .reshape((-1, 1))
+
+
+def test_cachedop_mixed_dtype_pads_and_slices_bit_identically():
+    rng = onp.random.RandomState(22)
+    net = _Gated()
+    net.initialize(mx.init.Xavier())
+    x10 = rng.randn(10, 8).astype(onp.float32)
+    g10 = rng.randint(0, 2, 10).astype(onp.int32)
+    net(np.array(x10), np.array(g10))
+    net.hybridize()
+    x16 = onp.concatenate([x10, onp.repeat(x10[-1:], 6, 0)])
+    g16 = onp.concatenate([g10, onp.repeat(g10[-1:], 6, 0)])
+    ref = net(np.array(x16), np.array(g16)).asnumpy()[:10]
+    with bucketing.policy_scope("pow2"):
+        from mxnet_tpu import telemetry
+        telemetry.reset()
+        out = net(np.array(x10), np.array(g10))
+        snap = telemetry.snapshot()
+    # both leaves really were padded together (one pad event, one entry)
+    assert snap["counters"].get("gluon.cachedop.bucket_pad") == 1
+    assert out.shape == (10, 4)
+    onp.testing.assert_array_equal(out.asnumpy(), ref)
+
+
+def test_policy_sizes_enumerates_warmup_buckets():
+    p = bucketing.BucketingPolicy(mode="pow2")
+    assert p.sizes(8) == [1, 2, 4, 8]
+    assert bucketing.BucketingPolicy(buckets=[4, 16]).sizes(16) == [4, 16]
+    assert bucketing.BucketingPolicy(buckets=[32]).sizes(8) == [32]
+
+
 # -- padded-batch training correctness (satellite: exact parity) ------
 
 def _clone(net_a, net_b):

@@ -23,8 +23,11 @@ def test_disabled_path_records_nothing():
     telemetry.gauge("g", 1.0, peak=5.0)
     telemetry.value("v", 2.0)
     telemetry.duration_since("d", telemetry.clock())
+    telemetry.hist("h", 1.5)
+    telemetry.hist_since("h2", telemetry.clock())
     snap = telemetry.snapshot()
-    assert snap == {"durations": {}, "counters": {}, "gauges": {}}
+    assert snap == {"durations": {}, "counters": {}, "gauges": {},
+                    "histograms": {}}
     assert telemetry.names() == []
     # clock() short-circuits too: no syscall, sentinel 0.0
     assert telemetry.clock() == 0.0

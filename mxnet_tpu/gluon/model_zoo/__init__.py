@@ -8,3 +8,4 @@ from . import model_store  # noqa: F401
 from . import vision  # noqa: F401
 from .vision import get_model  # noqa: F401
 from . import bert  # noqa: F401
+from . import gpt  # noqa: F401

@@ -1,0 +1,347 @@
+"""GPT — causal decoder model family (the autoregressive serving
+workload; the model_zoo so far was encoder-only BERT).
+
+TPU-first notes: training/full-forward runs causal Pallas flash
+attention like every other block here, but GENERATION is a different
+regime — one token per step against a growing KV prefix — so the model
+exposes an explicit-cache API next to the ordinary ``forward``:
+
+- ``init_cache(batch_size)`` — a preallocated, fixed-shape pytree
+  ``{"k": (per-layer (B, H, S_max, Dh)), "v": (...), "len": (B,)}``.
+  Fixed shape is the point: every decode step of every request runs
+  the SAME compiled program (zero steady-state compiles), and per-layer
+  arrays (rather than one stacked (L, ...) buffer) let XLA alias each
+  donated input to its updated output — decode is in-place
+  dynamic-update-slice, not an O(cache) copy per token.
+- ``prefill(tokens, valid_length, cache, slots=...)`` — run the prompt
+  through causal flash attention at a bucketed sequence length, write
+  the K/V rows into the cache at the given slot indices, set ``len``,
+  return last-valid-token logits. Causality makes the padded prompt
+  tail harmless: positions < valid_length never attend it, and decode
+  masks the cache by ``len``.
+- ``decode_step(tokens, cache)`` — one token per slot: insert the new
+  K/V at position ``len``, attend over ``[0, len]`` via
+  ``ops.attention.decode_attention`` (Pallas on TPU), bump ``len``.
+  The cache argument is DONATED to the jitted step — steady-state
+  decode never allocates a second cache.
+
+Both generation entry points are jitted closures over the parameter
+NDArrays (the CachedOp ``raw_fn`` rebinding idiom, gluon/block.py), and
+count ``model.gpt.trace`` each time they actually trace — the
+telemetry hook tests and the serving engine use to assert zero
+steady-state compiles.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import autograd, telemetry
+from ...ndarray.ndarray import NDArray
+from ...ops import attention as _att
+from ...random_state import next_key, trace_rng
+from .. import _deferred
+from ..block import HybridBlock
+from ..parameter import Parameter
+from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+
+__all__ = ["GPTBlock", "GPTModel", "gpt_small"]
+
+
+def _cache_insert(cache, new, pos):
+    """Write ``new`` (B, H, 1, Dh) into ``cache`` (B, H, S, Dh) at
+    per-row sequence position ``pos`` (B,). vmapped dynamic-update so
+    XLA can update a donated cache in place."""
+    return jax.vmap(
+        lambda c, n, p: lax.dynamic_update_slice_in_dim(c, n, p, axis=1)
+    )(cache, new, pos)
+
+
+def _as_i32(x):
+    if isinstance(x, NDArray):
+        x = x._data
+    return jnp.asarray(x, jnp.int32)
+
+
+class GPTBlock(HybridBlock):
+    """Pre-norm causal transformer block with an explicit-KV decode
+    path (``prefill`` / ``decode``) beside the plain ``forward``."""
+
+    def __init__(self, units, num_heads, hidden_size=None, dropout=0.0,
+                 dtype="float32"):
+        super().__init__()
+        assert units % num_heads == 0, \
+            "units must be divisible by num_heads"
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        self.ln1 = LayerNorm()
+        self.q_proj = Dense(units, flatten=False, dtype=dtype)
+        self.k_proj = Dense(units, flatten=False, dtype=dtype)
+        self.v_proj = Dense(units, flatten=False, dtype=dtype)
+        self.out_proj = Dense(units, flatten=False, dtype=dtype)
+        self.ln2 = LayerNorm()
+        self.ffn1 = Dense(hidden_size or 4 * units, activation="gelu",
+                          flatten=False, dtype=dtype)
+        self.ffn2 = Dense(units, flatten=False, dtype=dtype)
+        self.drop = Dropout(dropout) if dropout else None
+
+    def _split(self, x):
+        b, s, _ = x.shape
+        return x.reshape(b, s, self._num_heads,
+                         self._head_dim).transpose(0, 2, 1, 3)
+
+    def _merge(self, out):
+        b, h, s, d = out.shape
+        return out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
+
+    def _qkv(self, x):
+        h = self.ln1(x)
+        return (self._split(self.q_proj(h)), self._split(self.k_proj(h)),
+                self._split(self.v_proj(h)))
+
+    def _finish(self, x, attn):
+        y = self.out_proj(self._merge(attn))
+        if self.drop is not None:
+            y = self.drop(y)
+        x = x + y
+        y = self.ffn2(self.ffn1(self.ln2(x)))
+        if self.drop is not None:
+            y = self.drop(y)
+        return x + y
+
+    def forward(self, x):
+        q, k, v = self._qkv(x)
+        from ... import numpy_extension as npx
+        attn = npx.flash_attention(q, k, v, causal=True)
+        return self._finish(x, attn)
+
+    # -- generation (called inside the model's jitted closures) --------
+    def prefill(self, x):
+        """Causal attention over the (padded) prompt; returns the block
+        output and the raw K/V rows to write into the cache."""
+        q, k, v = self._qkv(x)
+        attn = NDArray(_att.flash_attention(q._data, k._data, v._data,
+                                            True, None), ctx=x.ctx)
+        return self._finish(x, attn), (k._data, v._data)
+
+    def decode(self, x, k_cache, v_cache, pos, att_len):
+        """One decode step: insert this token's K/V at ``pos``, attend
+        over the valid prefix ``[0, att_len)``. ``k_cache``/``v_cache``
+        are raw (B, H, S_max, Dh) buffers; returns updated buffers."""
+        q, k, v = self._qkv(x)
+        kc = _cache_insert(k_cache, k._data, pos)
+        vc = _cache_insert(v_cache, v._data, pos)
+        attn = NDArray(_att.decode_attention(q._data, kc, vc, att_len),
+                       ctx=x.ctx)
+        return self._finish(x, attn), kc, vc
+
+
+class GPTModel(HybridBlock):
+    """Decoder-only transformer LM: token + learned position
+    embeddings -> N pre-norm ``GPTBlock``s -> final LayerNorm -> LM
+    head. ``forward`` gives full-sequence logits (training / parity);
+    ``init_cache``/``prefill``/``decode_step`` are the generation fast
+    path (see module docstring and serving/generate.py)."""
+
+    def __init__(self, vocab_size, units=256, num_layers=4, num_heads=4,
+                 hidden_size=None, max_length=256, dropout=0.0,
+                 dtype="float32"):
+        super().__init__()
+        self._vocab_size = vocab_size
+        self._units = units
+        self._num_layers = num_layers
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        self._max_length = max_length
+        self._dtype = dtype
+        self.word_embed = Embedding(vocab_size, units, dtype=dtype)
+        self.position_weight = Parameter(
+            "position_weight", shape=(max_length, units), dtype=dtype)
+        self.embed_drop = Dropout(dropout) if dropout else None
+        self.layers = HybridSequential()
+        for _ in range(num_layers):
+            self.layers.add(GPTBlock(units, num_heads,
+                                     hidden_size=hidden_size,
+                                     dropout=dropout, dtype=dtype))
+        self.ln_f = LayerNorm()
+        self.lm_head = Dense(vocab_size, use_bias=False, flatten=False,
+                             dtype=dtype)
+        self._gen = None  # (param_nds, prefill_jit, decode_jit)
+
+    @property
+    def max_length(self):
+        return self._max_length
+
+    def _blocks(self):
+        return list(self.layers._children.values())
+
+    def _embed(self, tokens, positions=None):
+        x = self.word_embed(tokens)
+        if positions is None:
+            pos = self.position_weight.data()[:tokens.shape[-1]]
+        else:
+            pos = positions
+        x = x + pos
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        return x
+
+    def forward(self, tokens):
+        x = self._embed(tokens)
+        for blk in self._blocks():
+            x = blk(x)
+        return self.lm_head(self.ln_f(x))
+
+    # -- generation API ------------------------------------------------
+    def _clear_cached_op(self):
+        super()._clear_cached_op()
+        self._gen = None  # params rebound/cast: jitted closures stale
+
+    def init_cache(self, batch_size, max_length=None, dtype=None):
+        """Preallocated fixed-shape KV cache pytree for ``batch_size``
+        slots: ``{"k": tuple of L (B, H, S_max, Dh) arrays, "v": same,
+        "len": (B,) int32 valid lengths}``. Explicit argument/result of
+        ``prefill``/``decode_step`` (which DONATE it) — never mutated
+        in place from Python."""
+        s = int(max_length) if max_length is not None else self._max_length
+        if not 1 <= s <= self._max_length:
+            raise ValueError(
+                f"cache max_length {s} out of range (position table "
+                f"holds {self._max_length})")
+        shape = (int(batch_size), self._num_heads, s, self._head_dim)
+        dt = onp.dtype(dtype or self._dtype)
+        zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
+                              for _ in range(self._num_layers))
+        return {"k": zeros(), "v": zeros(),
+                "len": jnp.zeros((int(batch_size),), jnp.int32)}
+
+    def _ensure_gen(self):
+        if self._gen is not None:
+            return self._gen
+        params = list(self.collect_params().values())
+        if any(p._data is None for p in params):
+            # materialize deferred shapes with one eager probe forward
+            # (the CachedOp._abstract_init idiom)
+            self.infer_shape(NDArray(jnp.zeros((1, 2), jnp.int32)))
+            params = list(self.collect_params().values())
+        param_nds = [p.data() for p in params]
+        blocks = self._blocks()
+
+        def _bind(fn):
+            """Run ``fn`` with the parameter NDArrays rebound to the
+            traced buffers (gluon/block.py raw_fn idiom)."""
+            def wrapper(key, param_datas, *args):
+                telemetry.counter("model.gpt.trace")
+                saved = [nd._data for nd in param_nds]
+                scope = _deferred.trace_scope()
+                rec = autograd._RecordingScope(False, False)
+                with scope, rec, trace_rng(key):
+                    for nd, d in zip(param_nds, param_datas):
+                        nd._data = d
+                    try:
+                        return fn(*args)
+                    finally:
+                        for nd, s in zip(param_nds, saved):
+                            nd._data = s
+            return wrapper
+
+        def prefill_raw(tokens, valid_len, slots, cache):
+            b, sb = tokens.shape
+            x = self._embed(NDArray(tokens))
+            ks, vs = [], []
+            for blk in blocks:
+                x, (k, v) = blk.prefill(x)
+                ks.append(k)
+                vs.append(v)
+            # logits of the LAST VALID prompt token (predicts token 1)
+            idx = jnp.clip(valid_len - 1, 0, sb - 1)
+            last = x._data[jnp.arange(b), idx][:, None, :]   # (b, 1, U)
+            logits = self.lm_head(self.ln_f(NDArray(last)))
+            dt = cache["k"][0].dtype
+            new_cache = {
+                "k": tuple(c.at[slots, :, :sb, :].set(k.astype(dt))
+                           for c, k in zip(cache["k"], ks)),
+                "v": tuple(c.at[slots, :, :sb, :].set(v.astype(dt))
+                           for c, v in zip(cache["v"], vs)),
+                "len": cache["len"].at[slots].set(valid_len),
+            }
+            return logits._data[:, 0, :], new_cache
+
+        def decode_raw(tokens, cache):
+            s_max = cache["k"][0].shape[2]
+            ln = cache["len"]
+            pos = jnp.minimum(ln, s_max - 1)   # clamped write position
+            att_len = pos + 1                  # incl. the new token
+            emb = self.word_embed(NDArray(tokens))          # (B, U)
+            pw = self.position_weight.data()._data
+            x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
+            if self.embed_drop is not None:
+                x = self.embed_drop(x)
+            ks, vs = [], []
+            for li, blk in enumerate(blocks):
+                x, kc, vc = blk.decode(x, cache["k"][li], cache["v"][li],
+                                       pos, att_len)
+                ks.append(kc)
+                vs.append(vc)
+            logits = self.lm_head(self.ln_f(x))             # (B, 1, V)
+            new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln + 1}
+            return logits._data[:, 0, :], new_cache
+
+        self._gen = (
+            param_nds,
+            jax.jit(_bind(prefill_raw), donate_argnums=(5,)),
+            jax.jit(_bind(decode_raw), donate_argnums=(3,)),
+        )
+        return self._gen
+
+    def prefill(self, tokens, valid_length, cache, slots=None):
+        """Run the (padded) prompts ``tokens`` (B_req, S_bucket) int32
+        through the model, write their K/V into ``cache`` at rows
+        ``slots`` (default ``0..B_req-1``), set ``len`` to
+        ``valid_length``. Returns ``(last_logits, cache)`` — raw
+        ``(B_req, vocab)`` logits of each row's last valid token and
+        the updated cache (the passed cache is donated; always use the
+        returned one)."""
+        param_nds, prefill_jit, _ = self._ensure_gen()
+        tokens = _as_i32(tokens)
+        if tokens.ndim != 2:
+            raise ValueError(f"prefill tokens must be (batch, seq), got "
+                             f"shape {tokens.shape}")
+        s_max = cache["k"][0].shape[2]
+        if tokens.shape[1] > s_max:
+            raise ValueError(
+                f"prompt bucket {tokens.shape[1]} exceeds cache "
+                f"max_length {s_max}")
+        valid_length = _as_i32(valid_length)
+        if slots is None:
+            slots = jnp.arange(tokens.shape[0], dtype=jnp.int32)
+        else:
+            slots = _as_i32(slots)
+        return prefill_jit(next_key(), [nd._data for nd in param_nds],
+                           tokens, valid_length, slots, cache)
+
+    def decode_step(self, tokens, cache):
+        """One greedy-decoding step for EVERY cache slot: insert the
+        K/V of ``tokens`` (B,) int32 at each row's ``len``, attend over
+        the valid prefix, bump ``len``. Returns ``(logits, cache)`` —
+        raw ``(B, vocab)`` next-token logits and the updated cache
+        (input cache donated). Rows whose slot is free/unprefilled
+        produce garbage logits that callers simply ignore — the POINT
+        is that the program shape never changes with occupancy."""
+        param_nds, _, decode_jit = self._ensure_gen()
+        return decode_jit(next_key(), [nd._data for nd in param_nds],
+                          _as_i32(tokens), cache)
+
+
+def gpt_small(vocab_size=1000, units=64, num_layers=2, num_heads=4,
+              max_length=128, dropout=0.0, dtype="float32", **kwargs):
+    """Tiny configuration for tests/bench (the bert_small analog)."""
+    return GPTModel(vocab_size=vocab_size, units=units,
+                    num_layers=num_layers, num_heads=num_heads,
+                    max_length=max_length, dropout=dropout, dtype=dtype,
+                    **kwargs)

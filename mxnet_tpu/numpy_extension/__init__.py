@@ -604,18 +604,35 @@ def rnn(data, parameters, *args, use_sequence_length=False, state_size=None,
 # ---------------------------------------------------------------------------
 # attention (long-context first-class; see ops/attention.py)
 # ---------------------------------------------------------------------------
-def flash_attention(query, key, value, causal=False, scale=None):
+def flash_attention(query, key, value, causal=False, scale=None,
+                    kv_len=None):
     """Blockwise (flash) attention over (B, H, S, D) NDArrays.
 
     Pallas TPU kernel forward + rematerializing backward; jnp blockwise
-    reference elsewhere (ops/attention.py)."""
+    reference elsewhere (ops/attention.py). ``kv_len`` (static int)
+    marks the valid key prefix of a longer cache buffer — the padded
+    tail is masked out and the causal diagonal end-aligns against the
+    valid prefix."""
     from ..ops import attention as _att
 
     def fn(q, k, v):
-        return _att.flash_attention(q, k, v, causal, scale)
+        return _att.flash_attention(q, k, v, causal, scale, kv_len)
 
     return apply_op(fn, _c(query), _c(key), _c(value),
                     name="flash_attention")
+
+
+def decode_attention(query, key, value, lengths, scale=None):
+    """Single-query attention against a preallocated (B, H, S_max, D)
+    KV cache with per-slot valid lengths (the autoregressive decode
+    hot path — see ops/attention.py and serving/generate.py)."""
+    from ..ops import attention as _att
+
+    def fn(q, k, v, ln):
+        return _att.decode_attention(q, k, v, ln, scale=scale)
+
+    return apply_op(fn, _c(query), _c(key), _c(value), _c(lengths),
+                    name="decode_attention")
 
 
 def ring_attention(query, key, value, causal=False, scale=None,

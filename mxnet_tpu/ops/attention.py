@@ -15,8 +15,20 @@ here because they shape the core design on TPU:
   online-softmax partial results. Collective-permute overlaps with
   the next block's compute under XLA's latency-hiding scheduler, so
   the ring rides the ICI torus at full bandwidth.
+- `decode_attention` — the autoregressive fast path: one query per
+  sequence against a preallocated KV cache buffer, masked to each
+  row's valid length (serving/generate.py slot batches). jnp path
+  everywhere; Pallas TPU kernel (scalar-prefetched lengths, KV
+  streamed through VMEM) behind the same `_use_pallas()` gate.
 
-All shapes are (batch, heads, seq, head_dim).
+All shapes are (batch, heads, seq, head_dim). `kv_len` arguments mean
+"only the first kv_len entries of the key/value buffer are real" —
+the cache-backed convention: buffers are allocated at S_max, filled
+left-to-right, and the padded tail must never contribute attention
+mass. The causal offset is then end-aligned against the VALID prefix
+(`offset = kv_len - seq_q`), so prefill over a cache buffer and
+decode steps against the same buffer agree with `mha_reference` run
+on the sliced cache.
 """
 from __future__ import annotations
 
@@ -125,13 +137,21 @@ def _pad_seq(x, block):
 
 
 def flash_attention_pallas(q, k, v, causal=False, scale=None,
-                           block_q=128, block_k=128, interpret=False):
+                           block_q=128, block_k=128, interpret=False,
+                           kv_len=None):
     """Pallas forward (see pallas_guide.md patterns); any seq length
-    (inputs are block-padded, padding masked). Returns (out, lse)."""
+    (inputs are block-padded, padding masked). ``kv_len`` marks the
+    valid key prefix of a longer (cache) buffer — keys at or beyond
+    it are masked and the causal diagonal is end-aligned against the
+    valid prefix, not the buffer end. Returns (out, lse)."""
     import jax.experimental.pallas as pl
 
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    kv_len = sk if kv_len is None else int(kv_len)
+    if not 0 < kv_len <= sk:
+        raise ValueError(f"kv_len={kv_len} out of range for key "
+                         f"buffer of length {sk}")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_q = min(block_q, max(sq, 1))
     block_k = min(block_k, max(sk, 1))
@@ -144,7 +164,7 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None,
 
     kernel = functools.partial(
         _flash_fwd_kernel, scale=scale, causal=causal, block_k=block_k,
-        seq_k_padded=skp, kv_len=sk, offset=sk - sq)
+        seq_k_padded=skp, kv_len=kv_len, offset=kv_len - sq)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * h, sqp // block_q),
@@ -170,9 +190,10 @@ def flash_attention_pallas(q, k, v, causal=False, scale=None,
 # ---------------------------------------------------------------------------
 # blockwise jnp forward (non-TPU path) — O(S·block) memory
 # ---------------------------------------------------------------------------
-def _blockwise_fwd(q, k, v, causal, scale, block=512):
+def _blockwise_fwd(q, k, v, causal, scale, block=512, kv_len=None):
     sq, sk = q.shape[-2], k.shape[-2]
-    offset = sk - sq
+    kv_len = sk if kv_len is None else int(kv_len)
+    offset = kv_len - sq
     kp, vp = _pad_seq(k, block), _pad_seq(v, block)
     nb = kp.shape[-2] // block
 
@@ -184,7 +205,7 @@ def _blockwise_fwd(q, k, v, causal, scale, block=512):
             .astype(jnp.float32) * scale
         row = lax.broadcasted_iota(jnp.int32, (sq, block), 0)
         col = lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
-        valid = col < sk
+        valid = col < kv_len
         if causal:
             valid = valid & _causal_valid(row, col, offset)
         s = jnp.where(valid, s, NEG_INF)
@@ -208,9 +229,13 @@ def _blockwise_fwd(q, k, v, causal, scale, block=512):
 # ---------------------------------------------------------------------------
 # public flash_attention with blockwise (O(S·block)) backward
 # ---------------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_attention(q, k, v, causal=False, scale=None):
-    return _flash_fwd(q, k, v, causal, scale)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def flash_attention(q, k, v, causal=False, scale=None, kv_len=None):
+    """``kv_len`` (static int) marks the valid key prefix of a longer
+    cache buffer: keys beyond it are masked out of the softmax and the
+    causal diagonal end-aligns to the valid prefix (the last query row
+    sees keys [0, kv_len))."""
+    return _flash_fwd(q, k, v, causal, scale, kv_len)[0]
 
 
 def _use_pallas():
@@ -220,24 +245,33 @@ def _use_pallas():
         return False
 
 
-def _flash_fwd(q, k, v, causal, scale):
+def _flash_fwd(q, k, v, causal, scale, kv_len=None):
+    # validate here (not only in the Pallas path) so the jnp fallback
+    # rejects a bad kv_len too instead of attending zero-padded keys
+    if kv_len is not None and not 0 < int(kv_len) <= k.shape[2]:
+        raise ValueError(f"kv_len={kv_len} out of range for key "
+                         f"buffer of length {k.shape[2]}")
     scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     if _use_pallas():
         out, lse = flash_attention_pallas(q, k, v, causal=causal,
-                                          scale=scale_v)
+                                          scale=scale_v, kv_len=kv_len)
     else:
-        out, lse = _blockwise_fwd(q, k, v, causal, scale_v)
+        out, lse = _blockwise_fwd(q, k, v, causal, scale_v,
+                                  kv_len=kv_len)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, scale, res, do):
+def _flash_bwd(causal, scale, kv_len, res, do):
     """Blockwise flash backward: rematerializes attention one KV (then
-    one Q) block at a time — no S×S residual ever materializes."""
+    one Q) block at a time — no S×S residual ever materializes.
+    Masked-out cache tail (cols >= kv_len) gets p=0, so its dk/dv are
+    exactly zero and dq ignores it."""
     q, k, v, o, lse = res
     scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     block = 512
     sq, sk = q.shape[-2], k.shape[-2]
-    offset = sk - sq
+    kv_len = sk if kv_len is None else int(kv_len)
+    offset = kv_len - sq
     do32 = do.astype(jnp.float32)
     delta = (do32 * o.astype(jnp.float32)).sum(-1)          # (..., sq)
 
@@ -251,7 +285,7 @@ def _flash_bwd(causal, scale, res, do):
             .astype(jnp.float32) * scale_v
         row = lax.broadcasted_iota(jnp.int32, (sq, block), 0)
         col = lax.broadcasted_iota(jnp.int32, (sq, block), 1) + j * block
-        valid = col < sk
+        valid = col < kv_len
         if causal:
             valid = valid & _causal_valid(row, col, offset)
         p = jnp.where(valid, jnp.exp(s - lse[..., None]), 0.0)
@@ -283,7 +317,7 @@ def _flash_bwd(causal, scale, res, do):
             .astype(jnp.float32) * scale_v
         row = lax.broadcasted_iota(jnp.int32, (block, sk), 0) + i * block
         col = lax.broadcasted_iota(jnp.int32, (block, sk), 1)
-        valid = row < sq
+        valid = (row < sq) & (col < kv_len)
         if causal:
             valid = valid & _causal_valid(row, col, offset)
         p = jnp.where(valid, jnp.exp(s - lsei[..., None]), 0.0)
@@ -302,6 +336,156 @@ def _flash_bwd(causal, scale, res, do):
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# decode attention (single-query KV-cache attention, per-row lengths)
+# ---------------------------------------------------------------------------
+def _decode_fwd_jnp(q, k, v, lengths, scale):
+    """Masked single-pass attention: every query row of batch b attends
+    keys [0, lengths[b]) of its cache row. Small S_max fits one score
+    materialization (B, H, Sq, S_max) — the decode working set is tiny
+    compared to prefill, and XLA fuses the chain."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    col = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+    valid = col < lengths[:, None, None, None]
+    s = jnp.where(valid, s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    # re-mask after exp: with lengths==0 every score is NEG_INF, so
+    # exp(s - m) would be exp(0)=1 across the board instead of 0
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    l_safe = jnp.where(l > 0, l, 1.0)  # lengths==0: an empty slot
+    p = (p / l_safe).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                       l_ref, acc_ref, *, scale, block_k, nkb):
+    """One (batch, head, kv-block) grid step. ``len_ref`` is the
+    scalar-prefetched per-slot length vector (SMEM); blocks at or past
+    the slot's valid prefix skip compute entirely (their BlockSpec
+    index map also re-requests the already-resident block, so no data
+    moves for them). Online-softmax state lives in VMEM scratch, which
+    persists across the innermost (kv-block) grid axis; the output
+    block is written once, on the last grid step."""
+    import jax.experimental.pallas as pl
+    b = pl.program_id(0)
+    kb = pl.program_id(2)
+    length = len_ref[b]
+    nblocks = (length + block_k - 1) // block_k   # this slot's valid blocks
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(kb < nblocks)
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale    # (sq, d)
+        sq = q.shape[0]
+        k = k_ref[0, 0].astype(jnp.float32)            # (block_k, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (sq, bk)
+        col = lax.broadcasted_iota(jnp.int32, (sq, block_k), 1) \
+            + kb * block_k
+        # masks both the final partial block of the valid prefix and
+        # any cache tail past sk (the last grid block may overhang)
+        s = jnp.where(col < length, s, NEG_INF)
+        # v's overhang rows may hold garbage (even NaN): p is 0 there,
+        # but 0 * NaN is NaN, so zero them before the accumulate
+        vrow = lax.broadcasted_iota(jnp.int32, (block_k, 1), 0) \
+            + kb * block_k
+        v = jnp.where(vrow < length, v, 0.0)
+        # m/l scratch is (sq, 128) with all lanes equal (TPU-friendly
+        # layout); [:, :1] slices recover the per-row scalar
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        m_ref[...] = m_new
+        l_ref[...] = alpha * l_prev + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kb == nkb - 1)
+    def _finalize():
+        l = l_ref[...][:, :1]
+        l_safe = jnp.where(l > 0, l, 1.0)  # length==0: an empty slot
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
+                            interpret=False):
+    """Pallas decode kernel: grid over (batch, head, kv-block) with the
+    per-slot lengths scalar-prefetched into the KV BlockSpec index
+    maps. Blocks past a slot's valid prefix are clamped to its last
+    valid block — the TPU pipeline elides the copy when the block
+    index repeats — so a 40-token slot in a 2048-row cache MOVES
+    ceil(40/block_k) KV blocks, not S_max rows; compute for those
+    steps is skipped in the kernel. No host-side padding: a final
+    partial block is masked in-kernel."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_k = min(block_k, max(sk, 1))
+    nkb = (sk + block_k - 1) // block_k
+
+    def _kv_index(i, j, kb, lens):
+        last = jnp.maximum((lens[i] + block_k - 1) // block_k - 1, 0)
+        return (i, j, jnp.minimum(kb, last), 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, nkb),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d),
+                         lambda i, j, kb, lens: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
+            pl.BlockSpec((1, 1, block_k, d), _kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, sq, d),
+                               lambda i, j, kb, lens: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((sq, 128), jnp.float32),   # running max
+            pltpu.VMEM((sq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((sq, d), jnp.float32),     # running numerator
+        ],
+    )
+    kernel = functools.partial(_decode_fwd_kernel, scale=scale,
+                               block_k=block_k, nkb=nkb)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), q, k, v)
+
+
+def decode_attention(q, k, v, lengths, scale=None):
+    """Autoregressive decode attention against a preallocated KV cache.
+
+    ``q`` is (B, H, Sq, D) — Sq is 1 on the decode hot path; ``k``/``v``
+    are the cache buffers (B, H, S_max, D) filled left-to-right;
+    ``lengths`` (B,) int32 marks each slot's valid prefix INCLUDING the
+    just-inserted token. Every query attends keys [0, lengths[b]) — no
+    intra-query causal structure (the single new token sees the whole
+    valid cache), matching ``mha_reference(q, k[:, :, :len],
+    v[:, :, :len])`` per row. A row with lengths==0 (an empty serving
+    slot riding along in the fixed-shape batch) returns zeros.
+    """
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    lengths = jnp.asarray(lengths, jnp.int32)
+    if _use_pallas():
+        return decode_attention_pallas(q, k, v, lengths, scale=scale_v)
+    return _decode_fwd_jnp(q, k, v, lengths, scale_v)
 
 
 # ---------------------------------------------------------------------------

@@ -3,14 +3,20 @@
 `InferenceEngine` coalesces concurrent single-sample (or small-batch)
 requests onto one AOT-warmed CachedOp forward per dispatch — dynamic
 micro-batching with bounded queueing delay, admission control, and
-graceful shutdown. See docs/SERVING.md for knobs and operational
-guidance, ``bench.py --serving`` / BENCH_r08.json for the measured
-A/B against per-request dispatch.
+graceful shutdown. `GenerationEngine` is its autoregressive sibling:
+slot-based continuous batching over one fixed-shape KV-cache decode
+step (generate.py). See docs/SERVING.md for knobs and operational
+guidance, ``bench.py --serving`` / ``--generate`` (BENCH_r08/r09.json)
+for the measured A/Bs.
 """
 from .engine import (  # noqa: F401
     InferenceEngine, ServingError, EngineClosedError, QueueFullError,
     RequestTimeoutError,
 )
+from .generate import (  # noqa: F401
+    GenerationEngine, GenerationStream, GenerationResult,
+)
 
 __all__ = ["InferenceEngine", "ServingError", "EngineClosedError",
-           "QueueFullError", "RequestTimeoutError"]
+           "QueueFullError", "RequestTimeoutError",
+           "GenerationEngine", "GenerationStream", "GenerationResult"]

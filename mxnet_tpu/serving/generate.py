@@ -1,0 +1,588 @@
+"""GenerationEngine — slot-based continuous batching for
+autoregressive decoding.
+
+The InferenceEngine (engine.py) multiplies throughput for FIXED
+forwards by coalescing requests; generation breaks its model: one
+request is not one forward but a prefill plus an unknown number of
+decode steps. Whole-batch ("static") generation — collect B prompts,
+decode until ALL finish — leaves slots idle behind the longest
+sequence and stalls arrivals behind batch formation. Iteration-level
+scheduling (Orca, OSDI'22; vLLM's continuous batching) instead admits
+and evicts requests at DECODE-STEP boundaries. The TPU-native twist
+here is fixed-shape slot batches: the KV cache is a preallocated
+``max_slots``-row pytree (gluon/model_zoo/gpt.py ``init_cache``) and
+every step of every mix of requests runs ONE AOT-warmed decode
+program — occupancy changes rebind slot rows, never shapes, so the
+steady state compiles exactly nothing.
+
+Architecture::
+
+    caller threads ── submit(prompt) ──► bounded request queue
+                                              │ (admission control:
+                                              │  queue_limit, timeout,
+                                              ▼  closed-engine reject)
+                                        generator thread
+                     ┌──────────────────────────────────────────────┐
+                     │ per step: admit queued prompts into FREE     │
+                     │ slots (prefill bucketed on the seq axis via  │
+                     │ BucketingPolicy, K/V scattered into the      │
+                     │ cache at the slot row) ── one fixed-shape    │
+                     │ decode_step over ALL slots ── emit one token │
+                     │ per live slot into its stream ── evict       │
+                     │ EOS / max-tokens / deadline slots (freed     │
+                     │ rows admit the next prompts mid-sequence)    │
+                     └──────────────────────────────────────────────┘
+
+``submit`` returns a :class:`GenerationStream` — a token-stream
+future: iterate it to consume tokens as they are generated, or call
+``result(timeout)`` for the completed :class:`GenerationResult`.
+Admission control and shutdown follow the InferenceEngine contract
+exactly (``QueueFullError`` / ``RequestTimeoutError`` /
+``EngineClosedError``; ``close()`` drains-then-rejects via the shared
+``BoundedQueueWorker``; no stream is ever left hanging), and
+``MXTPU_SERVING=0`` degrades to synchronous inline generation.
+
+Decoding is GREEDY (argmax) — which is what makes engine output
+token-identical to a single-request ``prefill`` + ``decode_step`` loop
+at the same slot width: rows of one XLA program are bit-independent,
+so a request's tokens do not depend on its co-tenants.
+
+Telemetry (docs/OBSERVABILITY.md): counters
+``serving.generate.{requests,tokens,prefills,evictions,rejected_full,
+rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
+(occupancy + peak) / ``serving.generate.queue.depth``, histograms
+``serving.generate.{prefill,decode,ttft}``.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+
+import numpy as onp
+
+from .. import telemetry
+from .._bounded_worker import BoundedQueueWorker
+from ..bucketing import BucketingPolicy, as_policy
+from .engine import (
+    EngineClosedError, QueueFullError, RequestTimeoutError,
+    _live_engines, _serving_enabled,
+)
+
+__all__ = ["GenerationEngine", "GenerationStream", "GenerationResult"]
+
+
+class GenerationResult:
+    """Completed generation: ``tokens`` (generated ids, prompt
+    excluded), ``finish_reason`` in {"eos", "length", "timeout",
+    "closed"}, and the ``prompt_len`` it continued from."""
+
+    __slots__ = ("tokens", "finish_reason", "prompt_len")
+
+    def __init__(self, tokens, finish_reason, prompt_len):
+        self.tokens = tokens
+        self.finish_reason = finish_reason
+        self.prompt_len = prompt_len
+
+    def __len__(self):
+        return len(self.tokens)
+
+    def __repr__(self):
+        return (f"GenerationResult({len(self.tokens)} tokens, "
+                f"finish_reason={self.finish_reason!r})")
+
+
+class GenerationStream:
+    """Per-request token-stream future.
+
+    Iterating yields token ids as the engine produces them (multiple
+    iterators each see the full stream); ``result(timeout)`` blocks for
+    the final :class:`GenerationResult`. A rejected/failed request
+    raises the failure from both paths — never a hung consumer."""
+
+    def __init__(self, prompt_len):
+        self.prompt_len = prompt_len
+        self._cv = threading.Condition()
+        self._tokens: list = []
+        self._reason = None
+        self._exc = None
+        #: ``time.perf_counter()`` stamps of the first token and of
+        #: completion — producer-side, so latency measurement needs no
+        #: consumer thread racing the stream (bench.py --generate).
+        self.first_token_at = None
+        self.done_at = None
+
+    # -- producer side (generator thread) ------------------------------
+    def _emit(self, token: int):
+        with self._cv:
+            if not self._tokens:
+                self.first_token_at = time.perf_counter()
+            self._tokens.append(int(token))
+            self._cv.notify_all()
+
+    def _finish(self, reason=None, exc=None):
+        with self._cv:
+            if self._reason is not None or self._exc is not None:
+                return  # first outcome stands (close racing a finish)
+            self._reason = reason
+            self._exc = exc
+            self.done_at = time.perf_counter()
+            self._cv.notify_all()
+
+    # -- consumer side --------------------------------------------------
+    def done(self) -> bool:
+        with self._cv:
+            return self._reason is not None or self._exc is not None
+
+    @property
+    def tokens(self):
+        """Snapshot of the tokens generated so far."""
+        with self._cv:
+            return list(self._tokens)
+
+    def __iter__(self):
+        i = 0
+        while True:
+            with self._cv:
+                while i >= len(self._tokens) and self._reason is None \
+                        and self._exc is None:
+                    self._cv.wait()  # every producer path notifies
+                if i < len(self._tokens):
+                    tok = self._tokens[i]
+                    i += 1
+                elif self._exc is not None:
+                    raise self._exc
+                else:
+                    return
+            yield tok
+
+    def result(self, timeout=None) -> GenerationResult:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while self._reason is None and self._exc is None:
+                rem = None if deadline is None \
+                    else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise TimeoutError(
+                        "generation still running after result() timeout")
+                self._cv.wait(rem)
+            if self._exc is not None:
+                raise self._exc
+            return GenerationResult(list(self._tokens), self._reason,
+                                    self.prompt_len)
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_submit",
+                 "deadline")
+
+    def __init__(self, prompt, max_new, eos_id, stream, t_submit,
+                 deadline):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.eos_id = eos_id
+        self.stream = stream
+        self.t_submit = t_submit
+        self.deadline = deadline
+
+
+class _Slot:
+    __slots__ = ("stream", "last", "left", "eos_id", "deadline", "n_ctx")
+
+    def __init__(self, stream, last, left, eos_id, deadline, n_ctx):
+        self.stream = stream
+        self.last = last       # last emitted token (next step's input)
+        self.left = left       # generated-token budget remaining
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.n_ctx = n_ctx     # cache rows filled (prompt + decoded)
+
+
+class _GenWorker(BoundedQueueWorker):
+    """Consumer side of the request queue: the admit/step loop.
+
+    Same shutdown contract as the InferenceEngine batcher: a graceful
+    ``_draining`` phase finishes admitted work, ``stop()`` is the hard
+    deadline whose drain rejects queued leftovers through
+    ``_drained``."""
+
+    def __init__(self, engine: "GenerationEngine", queue_limit: int):
+        super().__init__(queue_limit, name="GenerationEngine.worker")
+        self._engine = weakref.ref(engine)
+        self._draining = False
+        self.start()
+
+    def run(self):
+        try:
+            self._run()
+        except Exception as e:  # noqa: BLE001 — a failed step must not
+            # strand waiters: fail every live stream and queued request
+            telemetry.counter("serving.generate.errors")
+            eng = self._engine()
+            if eng is not None:
+                eng._fail_all(e)
+            return
+        # hard-stopped mid-generation: the worker owns the slots, so it
+        # (not close(), racing is_alive) finishes leftover streams —
+        # truncated output with finish_reason="closed", never a hang
+        eng = self._engine()
+        if eng is not None and self._stopped:
+            eng._close_active("closed")
+
+    def _run(self):
+        while not self._stopped:
+            eng = self._engine()
+            if eng is None:
+                return  # abandoned engine: streams die with their refs
+            # every model-touching path holds _gen_lock — warmup() may
+            # be tracing the jitted closures concurrently, and tracing
+            # (parameter rebinding in the _bind wrapper) is not
+            # thread-safe against itself
+            with eng._gen_lock:
+                eng._admit(self._queue)
+                active = eng._n_active
+                if active:
+                    eng._step()
+            if active:
+                continue
+            del eng  # don't pin the engine while blocking on the queue
+            try:
+                r = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                if self._draining:
+                    return
+                continue
+            eng = self._engine()
+            if eng is None:
+                r.stream._finish(exc=EngineClosedError(
+                    "engine was garbage-collected"))
+                return
+            with eng._gen_lock:
+                eng._admit_one(r)
+
+    def _drained(self, item):
+        if isinstance(item, _GenRequest):
+            telemetry.counter("serving.generate.rejected_closed")
+            item.stream._finish(exc=EngineClosedError(
+                "engine closed before the request was scheduled"))
+
+    def close(self, timeout: float):
+        self._draining = True
+        self.join(timeout=max(0.0, timeout))
+        self.stop(timeout=min(timeout, 2.0) if timeout > 0 else 0.1)
+
+
+class GenerationEngine:
+    """Continuously-batched greedy generation over a decoder model.
+
+    Parameters
+    ----------
+    model
+        A decoder exposing the explicit-cache generation API —
+        ``init_cache(batch_size, max_length, dtype)`` /
+        ``prefill(tokens, valid_length, cache, slots)`` /
+        ``decode_step(tokens, cache)`` (gluon/model_zoo/gpt.py
+        ``GPTModel`` is the in-tree implementation).
+    max_slots : int
+        Concurrent sequences per decode step — the fixed batch width
+        of the decode program and the KV-cache row count.
+    max_length : int, optional
+        Cache sequence capacity (default: the model's position table).
+        A prompt must leave room for at least one generated token.
+    max_new_tokens : int
+        Default generated-token budget per request (``submit``
+        overrides per call).
+    eos_id : int, optional
+        Default stop token (``submit`` overrides per call).
+    queue_limit : int
+        Bound on queued requests; beyond it ``submit`` raises
+        :class:`QueueFullError` immediately (load shedding).
+    timeout_ms : float, optional
+        Default deadline: a request still QUEUED past it is rejected
+        with :class:`RequestTimeoutError`; one already generating is
+        finished early with ``finish_reason="timeout"`` (partial
+        output delivered — tokens already streamed can't be unsent).
+    prefill_bucketing : BucketingPolicy | str | None
+        Sequence-axis policy for prefill (default pow2, min 8, clamped
+        to the cache capacity). Each bucket is one compiled prefill
+        width — ``warmup()`` AOT-compiles them all.
+    """
+
+    def __init__(self, model, max_slots: int = 8, max_length=None,
+                 max_new_tokens: int = 64, eos_id=None,
+                 queue_limit: int = 256, timeout_ms=None,
+                 prefill_bucketing=None, cache_dtype=None):
+        for attr in ("init_cache", "prefill", "decode_step"):
+            if not callable(getattr(model, attr, None)):
+                raise TypeError(
+                    f"GenerationEngine needs a decoder with the "
+                    f"explicit-cache generation API (missing "
+                    f"{attr!r}); see gluon.model_zoo.gpt.GPTModel")
+        if int(max_slots) < 1:
+            raise ValueError("max_slots must be >= 1")
+        if int(max_new_tokens) < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.model = model
+        self.max_slots = int(max_slots)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.queue_limit = max(1, int(queue_limit))
+        self.timeout_ms = timeout_ms
+        self._s_max = int(max_length) if max_length is not None \
+            else int(model.max_length)
+        policy = as_policy(prefill_bucketing)
+        if policy is None:
+            policy = BucketingPolicy(mode="pow2", min_size=8)
+        self.policy = policy.clamped(self._s_max)
+        self._cache_dtype = cache_dtype
+        self._cache = model.init_cache(self.max_slots, self._s_max,
+                                       dtype=cache_dtype)
+        self._slots: list = [None] * self.max_slots
+        self._n_active = 0
+        #: serializes every model call (worker admit/step, sync-mode
+        #: generation, warmup) — jit TRACING mutates shared parameter
+        #: bindings, so two threads may never trace concurrently
+        self._gen_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._sync = not _serving_enabled()
+        self._worker = None if self._sync \
+            else _GenWorker(self, self.queue_limit)
+        _live_engines.add(self)
+
+    # -- lifecycle -----------------------------------------------------
+    def warmup(self):
+        """Compile the steady state ahead of traffic: one prefill per
+        sequence bucket the policy can produce, plus the decode step.
+        After this, serving any traffic mix triggers zero new traces
+        (``model.gpt.trace`` telemetry stays flat)."""
+        # compile against a THROWAWAY cache of the live cache's shapes
+        # (the jit cache keys on shapes/dtypes, so the programs carry
+        # over): the worker thread may already be serving self._cache,
+        # and prefill/decode_step DONATE their cache argument — touching
+        # the live one here would race the step loop into a
+        # donated-buffer error. _gen_lock additionally keeps our traces
+        # mutually exclusive with any in-flight worker step.
+        with self._gen_lock:
+            cache = self.model.init_cache(self.max_slots, self._s_max,
+                                          dtype=self._cache_dtype)
+            for sb in self.policy.sizes(self._s_max - 1):
+                toks = onp.zeros((1, sb), "i4")
+                _, cache = self.model.prefill(toks, [sb], cache,
+                                              slots=[0])
+            self.model.decode_step(
+                onp.zeros((self.max_slots,), "i4"), cache)
+        return self
+
+    def close(self, timeout: float = 5.0):
+        """Stop admission, finish ACTIVE generations and drain the
+        queue under ``timeout``; past the deadline queued requests are
+        rejected and still-active streams are finished early with
+        ``finish_reason="closed"`` — nothing ever hangs. Idempotent;
+        also invoked via ``atexit``."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        if self._worker is not None:
+            self._worker.close(timeout)
+            if not self._worker.is_alive():
+                # thread provably dead: it can no longer touch slots
+                self._close_active("closed")
+        else:
+            self._close_active("closed")  # sync mode: nothing active
+        _live_engines.discard(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close(timeout=0.5)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    # -- admission -----------------------------------------------------
+    def _validate(self, prompt, max_new_tokens, eos_id):
+        prompt = onp.asarray(prompt)
+        if prompt.ndim != 1 or prompt.size < 1:
+            raise ValueError(
+                f"prompt must be a non-empty 1-D token sequence, got "
+                f"shape {prompt.shape}")
+        if not onp.issubdtype(prompt.dtype, onp.integer):
+            raise ValueError(f"prompt must hold token ids, got dtype "
+                             f"{prompt.dtype}")
+        if prompt.size > self._s_max - 1:
+            raise ValueError(
+                f"prompt length {prompt.size} leaves no room to "
+                f"generate (cache capacity {self._s_max})")
+        max_new = self.max_new_tokens if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        eos = self.eos_id if eos_id is None else eos_id
+        return prompt.astype("i4"), max_new, eos
+
+    def submit(self, prompt, max_new_tokens=None, eos_id=None,
+               timeout_ms=None) -> GenerationStream:
+        """Queue one prompt; returns a :class:`GenerationStream`.
+        Raises :class:`EngineClosedError` / :class:`QueueFullError` /
+        ``ValueError`` immediately instead of returning a stream that
+        can never complete."""
+        if self._closed:
+            telemetry.counter("serving.generate.rejected_closed")
+            raise EngineClosedError("submit on a closed engine")
+        prompt, max_new, eos = self._validate(prompt, max_new_tokens,
+                                              eos_id)
+        telemetry.counter("serving.generate.requests")
+        stream = GenerationStream(int(prompt.size))
+        tmo = self.timeout_ms if timeout_ms is None else timeout_ms
+        req = _GenRequest(
+            prompt, max_new, eos, stream, telemetry.clock(),
+            time.monotonic() + tmo / 1e3 if tmo is not None else None)
+        if self._sync:  # MXTPU_SERVING=0: inline generation
+            with self._gen_lock:
+                self._admit_one(req)
+                while self._n_active:
+                    self._step()
+            return stream
+        try:
+            self._worker._queue.put_nowait(req)
+        except queue.Full:
+            telemetry.counter("serving.generate.rejected_full")
+            raise QueueFullError(
+                f"request queue at queue_limit={self.queue_limit}") \
+                from None
+        telemetry.gauge("serving.generate.queue.depth",
+                        self._worker._queue.qsize())
+        if self._closed:
+            # close() raced the put: its drain may have missed this
+            # request — reject it ourselves (no-op if already handled)
+            stream._finish(exc=EngineClosedError(
+                "engine closed while the request was being queued"))
+        return stream
+
+    def generate(self, prompt, timeout=None, **kwargs) -> GenerationResult:
+        """Blocking convenience: ``submit(...).result(timeout)``."""
+        return self.submit(prompt, **kwargs).result(timeout)
+
+    # -- scheduling (generator thread / sync mode) ---------------------
+    def _admit(self, q):
+        while self._n_active < self.max_slots:
+            try:
+                r = q.get_nowait()
+            except queue.Empty:
+                break
+            self._admit_one(r)
+        telemetry.gauge("serving.generate.queue.depth", q.qsize())
+
+    def _admit_one(self, r: _GenRequest):
+        """Prefill ``r`` into a free slot (sequence axis bucketed) and
+        emit its first token. Called only at step boundaries."""
+        if r.deadline is not None and time.monotonic() > r.deadline:
+            telemetry.counter("serving.generate.timeouts")
+            r.stream._finish(exc=RequestTimeoutError(
+                "request expired in queue before prefill"))
+            return
+        slot = self._slots.index(None)
+        n = int(r.prompt.size)
+        sb = self.policy.bucket(n)
+        padded = onp.zeros((1, sb), "i4")
+        padded[0, :n] = r.prompt
+        t0 = telemetry.clock()
+        logits, self._cache = self.model.prefill(
+            padded, onp.asarray([n], "i4"), self._cache,
+            slots=onp.asarray([slot], "i4"))
+        telemetry.hist_since("serving.generate.prefill", t0)
+        telemetry.counter("serving.generate.prefills")
+        tok = int(onp.asarray(logits)[0].argmax())
+        s = _Slot(r.stream, tok, r.max_new - 1, r.eos_id, r.deadline,
+                  n_ctx=n)
+        self._slots[slot] = s
+        self._n_active += 1
+        r.stream._emit(tok)
+        telemetry.counter("serving.generate.tokens")
+        telemetry.hist_since("serving.generate.ttft", r.t_submit)
+        if s.eos_id is not None and tok == s.eos_id:
+            self._evict(slot, "eos")
+        elif s.left <= 0 or s.n_ctx >= self._s_max:
+            self._evict(slot, "length")
+        else:
+            telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _step(self):
+        """One fixed-shape decode step over ALL slots; emit one token
+        per live slot, evict finished slots (their rows are free for
+        the next admission — mid-sequence, zero recompiles)."""
+        toks = onp.zeros((self.max_slots,), "i4")
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                toks[i] = s.last
+        t0 = telemetry.clock()
+        logits, self._cache = self.model.decode_step(toks, self._cache)
+        telemetry.hist_since("serving.generate.decode", t0)
+        arr = onp.asarray(logits)
+        now = time.monotonic()
+        n_emitted = 0
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tok = int(arr[i].argmax())
+            s.last = tok
+            s.left -= 1
+            s.n_ctx += 1
+            s.stream._emit(tok)
+            n_emitted += 1
+            if s.eos_id is not None and tok == s.eos_id:
+                self._evict(i, "eos")
+            elif s.left <= 0 or s.n_ctx >= self._s_max:
+                self._evict(i, "length")
+            elif s.deadline is not None and now > s.deadline:
+                telemetry.counter("serving.generate.timeouts")
+                self._evict(i, "timeout")
+        if n_emitted:  # one delta for the step, not one call per token
+            telemetry.counter("serving.generate.tokens", n_emitted)
+        telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _evict(self, slot: int, reason: str):
+        self._slots[slot].stream._finish(reason=reason)
+        self._slots[slot] = None
+        self._n_active -= 1
+        telemetry.counter("serving.generate.evictions")
+        telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _close_active(self, reason: str):
+        """Finish every still-active stream with ``reason`` (idempotent
+        per stream: a first outcome stands) and free the slots."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.stream._finish(reason=reason)
+                self._slots[i] = None
+        self._n_active = 0
+
+    def _fail_all(self, exc):
+        """Worker crashed mid-step (the cache may hold donated/invalid
+        buffers): fail every live stream and queued request, and close
+        the engine — a broken engine must reject, not wedge."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.stream._finish(exc=exc)
+                self._slots[i] = None
+        self._n_active = 0
+        self._closed = True
+        if self._worker is not None:
+            try:
+                while True:
+                    r = self._worker._queue.get_nowait()
+                    r.stream._finish(exc=exc)
+            except queue.Empty:
+                pass
+        _live_engines.discard(self)

@@ -126,6 +126,94 @@ def test_flash_ragged_and_decode_shapes():
                                 rtol=2e-4, atol=2e-5)
 
 
+def test_flash_kv_len_matches_sliced_cache():
+    """kv_len on a long cache buffer == flash over the sliced cache ==
+    mha_reference — the cache-backed prefill convention (padded tail
+    masked, causal diagonal end-aligned to the VALID prefix)."""
+    onp.random.seed(2)
+    mk = lambda s: jnp.asarray(  # noqa: E731
+        onp.random.randn(2, 2, s, 32).astype("float32") * 0.5)
+    kbuf, vbuf = mk(96), mk(96)
+    for sq, kvl in [(16, 70), (70, 70), (16, 16), (1, 33)]:
+        q = mk(sq)
+        ref = at.mha_reference(q, kbuf[:, :, :kvl], vbuf[:, :, :kvl],
+                               causal=True)
+        out = at.flash_attention(q, kbuf, vbuf, True, None, kvl)
+        onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                    rtol=2e-4, atol=2e-5, err_msg=(sq, kvl))
+        pal, _ = at.flash_attention_pallas(q, kbuf, vbuf, causal=True,
+                                           kv_len=kvl, block_q=32,
+                                           block_k=32, interpret=True)
+        onp.testing.assert_allclose(onp.asarray(pal), onp.asarray(ref),
+                                    rtol=2e-4, atol=2e-5, err_msg=(sq, kvl))
+    with pytest.raises(ValueError, match="out of range"):
+        at.flash_attention_pallas(mk(4), kbuf, vbuf, kv_len=97)
+
+
+def test_flash_kv_len_grads_match_and_tail_is_zero():
+    """Backward under kv_len: grads match the sliced-cache reference
+    and the masked cache tail gets EXACTLY zero dk/dv."""
+    onp.random.seed(3)
+    mk = lambda s: jnp.asarray(  # noqa: E731
+        onp.random.randn(2, 2, s, 32).astype("float32") * 0.5)
+    q, kbuf, vbuf = mk(16), mk(96), mk(96)
+    kvl = 40
+    g1 = jax.grad(lambda q, k, v: at.flash_attention(
+        q, k, v, True, None, kvl).sum(), argnums=(0, 1, 2))(q, kbuf, vbuf)
+    g2 = jax.grad(lambda q, k, v: at.mha_reference(
+        q, k, v, causal=True).sum(), argnums=(0, 1, 2))(
+        q, kbuf[:, :, :kvl], vbuf[:, :, :kvl])
+    onp.testing.assert_allclose(onp.asarray(g1[0]), onp.asarray(g2[0]),
+                                rtol=2e-3, atol=2e-4)
+    onp.testing.assert_allclose(onp.asarray(g1[1][:, :, :kvl]),
+                                onp.asarray(g2[1]), rtol=2e-3, atol=2e-4)
+    onp.testing.assert_allclose(onp.asarray(g1[2][:, :, :kvl]),
+                                onp.asarray(g2[2]), rtol=2e-3, atol=2e-4)
+    assert onp.abs(onp.asarray(g1[1][:, :, kvl:])).max() == 0.0
+    assert onp.abs(onp.asarray(g1[2][:, :, kvl:])).max() == 0.0
+
+
+def test_decode_attention_matches_sliced_reference():
+    """Single-query decode attention with per-slot lengths: each row
+    matches mha_reference over that row's valid cache prefix; jnp path
+    and the Pallas kernel (interpret) agree; an empty slot (length 0)
+    returns zeros."""
+    onp.random.seed(4)
+    B, H, S, D = 4, 2, 200, 32
+    mk = lambda *s: jnp.asarray(  # noqa: E731
+        onp.random.randn(*s).astype("float32") * 0.5)
+    q = mk(B, H, 1, D)
+    k, v = mk(B, H, S, D), mk(B, H, S, D)
+    lengths = jnp.asarray([0, 1, 77, 200], jnp.int32)
+    out = at.decode_attention(q, k, v, lengths)
+    assert onp.abs(onp.asarray(out[0])).max() == 0.0  # empty slot
+    for i in range(1, B):
+        ln = int(lengths[i])
+        ref = at.mha_reference(q[i:i + 1], k[i:i + 1, :, :ln],
+                               v[i:i + 1, :, :ln])
+        onp.testing.assert_allclose(onp.asarray(out[i:i + 1]),
+                                    onp.asarray(ref),
+                                    rtol=2e-4, atol=2e-5)
+    pal = at.decode_attention_pallas(q, k, v, lengths, block_k=64,
+                                     interpret=True)
+    onp.testing.assert_allclose(onp.asarray(pal), onp.asarray(out),
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_npx_decode_attention_wrapper():
+    onp.random.seed(5)
+    from mxnet_tpu import numpy_extension as npx
+    q = mx.np.random.uniform(size=(2, 2, 1, 16))
+    k = mx.np.random.uniform(size=(2, 2, 32, 16))
+    v = mx.np.random.uniform(size=(2, 2, 32, 16))
+    lengths = mx.np.array([5, 32], dtype="int32")
+    out = npx.decode_attention(q, k, v, lengths)
+    assert out.shape == (2, 2, 1, 16)
+    ref = at.decode_attention(q._data, k._data, v._data, lengths._data)
+    onp.testing.assert_allclose(out.asnumpy(), onp.asarray(ref),
+                                rtol=1e-6, atol=1e-7)
+
+
 def test_transformer_cell_trains_sequence_parallel():
     if len(jax.devices()) < 8:
         pytest.skip("needs 8 virtual devices")

@@ -1,0 +1,400 @@
+"""Autoregressive generation fast path: GPT decode + GenerationEngine.
+
+Guarantees under test:
+- the explicit-cache API (``init_cache``/``prefill``/``decode_step``)
+  is numerically faithful to the model's full causal ``forward``
+  (teacher-forcing logits parity);
+- greedy generation through the engine is TOKEN-IDENTICAL to the
+  single-request prefill+decode loop at the same slot width (rows of
+  one XLA program are bit-independent — co-tenants can't perturb a
+  request);
+- slots evict and refill mid-sequence under mixed lengths with ZERO
+  steady-state compiles (the ``model.gpt.trace`` counter stays flat);
+- admission control matches the InferenceEngine contract
+  (``QueueFullError`` / ``RequestTimeoutError`` /
+  ``EngineClosedError``, close-drains-then-rejects) and no stream is
+  ever left hanging;
+- ``MXTPU_SERVING=0`` degrades to synchronous inline generation.
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon.model_zoo.gpt import GPTModel, gpt_small
+from mxnet_tpu.serving import (
+    GenerationEngine, EngineClosedError, QueueFullError,
+    RequestTimeoutError,
+)
+
+VOCAB, SLOTS, SMAX = 97, 4, 64
+
+
+@pytest.fixture(scope="module")
+def net():
+    onp.random.seed(1234)
+    mx.np.random.seed(1234)
+    model = gpt_small(vocab_size=VOCAB, units=32, num_layers=2,
+                      num_heads=4, max_length=128)
+    model.initialize(mx.init.Xavier())
+    return model
+
+
+def _prompt(rng, n):
+    return rng.randint(0, VOCAB, size=n).astype("i4")
+
+
+def _ref_generate(net, policy, prompt, max_new, width=SLOTS,
+                  max_length=SMAX, eos_id=None):
+    """Single-request greedy prefill+decode loop at slot width
+    ``width`` — the reference the engine must match token for token."""
+    cache = net.init_cache(width, max_length)
+    n = len(prompt)
+    sb = policy.bucket(n)
+    padded = onp.zeros((1, sb), "i4")
+    padded[0, :n] = prompt
+    logits, cache = net.prefill(padded, [n], cache, slots=[0])
+    toks = [int(onp.asarray(logits)[0].argmax())]
+    n_ctx = n
+    while toks[-1] != eos_id and len(toks) < max_new \
+            and n_ctx < max_length:
+        step = onp.zeros((width,), "i4")
+        step[0] = toks[-1]
+        lg, cache = net.decode_step(step, cache)
+        toks.append(int(onp.asarray(lg)[0].argmax()))
+        n_ctx += 1
+    return toks
+
+
+# -- model-level correctness -------------------------------------------
+
+def test_prefill_and_decode_match_full_forward(net):
+    """Teacher forcing: feeding the true next tokens through
+    prefill+decode_step reproduces the full causal forward's logits at
+    every position (flash prefill vs decode_attention vs full-seq
+    attention — three code paths, one function)."""
+    rng = onp.random.RandomState(0)
+    toks = _prompt(rng, 9)
+    full = net(mx.np.array(toks[None, :])).asnumpy()[0]   # (9, V)
+    cache = net.init_cache(SLOTS, SMAX)
+    logits, cache = net.prefill(toks[None, :4], [4], cache, slots=[1])
+    onp.testing.assert_allclose(onp.asarray(logits)[0], full[3],
+                                rtol=2e-3, atol=2e-4)
+    for t in range(4, 9):
+        step = onp.zeros((SLOTS,), "i4")
+        step[1] = toks[t]
+        lg, cache = net.decode_step(step, cache)
+        onp.testing.assert_allclose(onp.asarray(lg)[1], full[t],
+                                    rtol=2e-3, atol=2e-4)
+
+
+def test_prefill_slot_scatter_and_lengths(net):
+    """Prefill writes only the addressed slot rows and sets their
+    lengths; other slots' state is untouched."""
+    rng = onp.random.RandomState(1)
+    cache = net.init_cache(SLOTS, SMAX)
+    t1, t2 = _prompt(rng, 6), _prompt(rng, 3)
+    padded = onp.zeros((2, 8), "i4")
+    padded[0, :6], padded[1, :3] = t1, t2
+    _, cache = net.prefill(padded, [6, 3], cache, slots=[2, 0])
+    assert onp.asarray(cache["len"]).tolist() == [3, 0, 6, 0]
+    # the un-addressed rows stayed zero
+    k0 = onp.asarray(cache["k"][0])
+    assert onp.abs(k0[[1, 3]]).max() == 0.0
+    assert onp.abs(k0[2, :, :6]).max() > 0.0
+
+
+def test_decode_step_donates_cache(net):
+    """The cache argument is donated: the returned cache is live, the
+    passed one is dead (steady-state decode allocates no second
+    cache)."""
+    cache = net.init_cache(SLOTS, SMAX)
+    _, cache2 = net.prefill(onp.zeros((1, 8), "i4"), [4], cache,
+                            slots=[0])
+    _, cache3 = net.decode_step(onp.zeros((SLOTS,), "i4"), cache2)
+    onp.asarray(cache3["k"][0])  # returned cache is readable
+    with pytest.raises(Exception, match="[Dd]onated|deleted"):
+        onp.asarray(cache2["k"][0]) + 0
+
+
+def test_cache_max_length_validation(net):
+    with pytest.raises(ValueError, match="out of range"):
+        net.init_cache(2, net.max_length + 1)
+    cache = net.init_cache(2, 16)
+    with pytest.raises(ValueError, match="exceeds cache"):
+        net.prefill(onp.zeros((1, 32), "i4"), [32], cache, slots=[0])
+
+
+# -- engine: correctness -----------------------------------------------
+
+def test_engine_token_parity_with_single_request_loop(net):
+    """Continuous batching must not change ANY request's tokens: the
+    engine output equals the single-request prefill+decode loop at the
+    same slot width, token for token, under mixed prompt lengths and
+    budgets."""
+    eng = GenerationEngine(net, max_slots=SLOTS, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=64)
+    eng.warmup()
+    rng = onp.random.RandomState(2)
+    prompts = [_prompt(rng, n) for n in (3, 9, 17, 5, 30, 12, 7, 21)]
+    budgets = [4 + i % 7 for i in range(len(prompts))]
+    streams = [eng.submit(p, max_new_tokens=b)
+               for p, b in zip(prompts, budgets)]
+    results = [s.result(timeout=120) for s in streams]
+    for p, b, r in zip(prompts, budgets, results):
+        assert r.tokens == _ref_generate(net, eng.policy, p, b)
+        assert r.finish_reason == "length"
+        assert r.prompt_len == len(p)
+    eng.close()
+
+
+def test_engine_warmup_concurrent_with_traffic(net):
+    """warmup() racing already-flowing traffic must not crash the
+    worker: tracing is serialized on the engine's _gen_lock and warmup
+    compiles against a throwaway cache, never the live (donated) one.
+    Regression: this combination used to kill the engine with a
+    donated-buffer / corrupted-trace error."""
+    eng = GenerationEngine(net, max_slots=SLOTS, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=64)
+    rng = onp.random.RandomState(7)
+    prompts = [_prompt(rng, n) for n in (3, 9, 17, 5)]
+    early = [eng.submit(p) for p in prompts]   # traffic BEFORE warmup
+    eng.warmup()                               # races the step loop
+    late = [eng.submit(p) for p in prompts]
+    for s in early + late:
+        r = s.result(timeout=120)
+        assert r.finish_reason == "length"
+        assert len(r.tokens) == 8
+    assert not eng.closed
+    for p, s in zip(prompts, late):
+        assert s.result().tokens == _ref_generate(net, eng.policy, p, 8)
+    eng.close()
+
+
+def test_engine_slot_evict_refill_zero_steady_state_compiles(net):
+    """More requests than slots: finished slots refill mid-sequence
+    (evictions observed, peak occupancy == max_slots) and the second
+    wave triggers ZERO new traces/compiles."""
+    eng = GenerationEngine(net, max_slots=SLOTS, max_length=SMAX,
+                           max_new_tokens=6, queue_limit=128)
+    eng.warmup()
+    rng = onp.random.RandomState(3)
+    # first wave primes every bucket the traffic uses
+    first = [eng.submit(_prompt(rng, n), max_new_tokens=3 + n % 5)
+             for n in (3, 9, 17, 5)]
+    for s in first:
+        s.result(timeout=120)
+    telemetry.reset()
+    n_traces = telemetry.counter_value("model.gpt.trace")
+    wave = [eng.submit(_prompt(rng, 3 + (7 * i) % 28),
+                       max_new_tokens=2 + i % 6) for i in range(12)]
+    for s in wave:
+        assert len(s.result(timeout=120).tokens) >= 1
+    snap = telemetry.snapshot()
+    assert telemetry.counter_value("model.gpt.trace") == n_traces, \
+        "steady-state decode retraced"
+    assert "gluon.cachedop.cache_miss" not in snap["counters"]
+    assert snap["counters"]["serving.generate.evictions"] == 12
+    assert snap["counters"]["serving.generate.prefills"] == 12
+    assert snap["gauges"]["serving.generate.slots"]["peak"] == SLOTS
+    assert snap["counters"]["serving.generate.tokens"] == sum(
+        len(s.result().tokens) for s in wave)
+    assert snap["histograms"]["serving.generate.decode"]["count"] > 0
+    assert snap["histograms"]["serving.generate.prefill"]["count"] == 12
+    assert snap["histograms"]["serving.generate.ttft"]["count"] == 12
+    eng.close()
+
+
+def test_engine_eos_eviction(net):
+    """A request whose greedy continuation hits its eos token stops
+    early with finish_reason='eos' (budget not exhausted)."""
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=16)
+    eng.warmup()
+    rng = onp.random.RandomState(4)
+    p = _prompt(rng, 5)
+    free_run = eng.generate(p, timeout=60)
+    assert len(free_run.tokens) == 8
+    # pick an eos that first appears mid-stream (greedy repeats tokens,
+    # so position 2's value may already occur at position 0)
+    j = next(i for i in range(1, 8)
+             if free_run.tokens[i] not in free_run.tokens[:i])
+    eos = free_run.tokens[j]
+    r = eng.generate(p, eos_id=eos, timeout=60)
+    assert r.finish_reason == "eos"
+    assert r.tokens == free_run.tokens[:j + 1]
+    eng.close()
+
+
+def test_engine_cache_capacity_finishes_with_length(net):
+    """A generation that fills the cache stops with
+    finish_reason='length' instead of overrunning the fixed buffer."""
+    eng = GenerationEngine(net, max_slots=2, max_length=16,
+                           max_new_tokens=1000, queue_limit=16)
+    r = eng.generate(_prompt(onp.random.RandomState(5), 10), timeout=60)
+    assert r.finish_reason == "length"
+    assert len(r.tokens) == 16 - 10 + 1  # one per free cache row + 1:
+    # the first token comes from prefill logits and occupies no row
+    # until its decode step writes it
+    eng.close()
+
+
+def test_stream_iteration_and_snapshot(net):
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX,
+                           max_new_tokens=5, queue_limit=16)
+    s = eng.submit(_prompt(onp.random.RandomState(6), 4))
+    got = list(s)  # streaming consumption
+    res = s.result(timeout=60)
+    assert got == res.tokens == s.tokens and len(got) == 5
+    assert s.done()
+    assert list(s) == got  # a second iterator replays the stream
+    eng.close()
+
+
+# -- engine: admission control & shutdown ------------------------------
+
+def test_engine_validation_and_admission(net):
+    eng = GenerationEngine(net, max_slots=2, max_length=32,
+                           max_new_tokens=4, queue_limit=4)
+    rng = onp.random.RandomState(7)
+    with pytest.raises(ValueError, match="1-D"):
+        eng.submit(onp.zeros((2, 3), "i4"))
+    with pytest.raises(ValueError, match="token ids"):
+        eng.submit(onp.zeros(4, "f4"))
+    with pytest.raises(ValueError, match="no room"):
+        eng.submit(_prompt(rng, 32))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit(_prompt(rng, 3), max_new_tokens=0)
+    eng.close()
+    with pytest.raises(EngineClosedError):
+        eng.submit(_prompt(rng, 3))
+
+
+def test_engine_queue_limit_sheds_load(net):
+    eng = GenerationEngine(net, max_slots=1, max_length=SMAX,
+                           max_new_tokens=30, queue_limit=2)
+    rng = onp.random.RandomState(8)
+    rejected, streams = 0, []
+    for _ in range(40):
+        try:
+            streams.append(eng.submit(_prompt(rng, 3), max_new_tokens=2))
+        except QueueFullError:
+            rejected += 1
+    assert rejected > 0, "queue_limit never rejected under flood"
+    for s in streams:  # admitted requests still complete
+        assert len(s.result(timeout=120).tokens) == 2
+    eng.close()
+
+
+def test_engine_request_timeout_in_queue(net):
+    """A request whose deadline expires while QUEUED is rejected with
+    RequestTimeoutError (never silently generated late)."""
+    eng = GenerationEngine(net, max_slots=1, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=16)
+    eng.warmup()
+    rng = onp.random.RandomState(9)
+    busy = eng.submit(_prompt(rng, 3), max_new_tokens=30)
+    doomed = eng.submit(_prompt(rng, 3), timeout_ms=0.0)
+    with pytest.raises(RequestTimeoutError):
+        doomed.result(timeout=120)
+    assert len(busy.result(timeout=120).tokens) == 30
+    assert telemetry.counter_value("serving.generate.timeouts") >= 1
+    eng.close()
+
+
+def test_engine_close_drains_then_new_submits_reject(net):
+    """close() finishes admitted work (streams resolve with real
+    results); a hard zero-grace close still leaves NO stream hanging —
+    everything resolves or raises."""
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX,
+                           max_new_tokens=4, queue_limit=64)
+    eng.warmup()
+    rng = onp.random.RandomState(10)
+    streams = [eng.submit(_prompt(rng, 5)) for _ in range(8)]
+    eng.close(timeout=120.0)
+    for s in streams:
+        assert len(s.result(timeout=5).tokens) == 4
+
+    eng2 = GenerationEngine(net, max_slots=2, max_length=SMAX,
+                            max_new_tokens=40, queue_limit=64)
+    streams = [eng2.submit(_prompt(rng, 5)) for _ in range(8)]
+    eng2.close(timeout=0.0)  # no grace at all
+    done = rejected = truncated = 0
+    for s in streams:
+        try:
+            r = s.result(timeout=10)
+            if r.finish_reason == "closed":
+                truncated += 1
+            else:
+                done += 1
+        except EngineClosedError:
+            rejected += 1
+    assert done + rejected + truncated == 8, "a stream hung"
+
+
+def test_engine_worker_exits_on_gc(net):
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX)
+    worker = eng._worker
+    del eng
+    import gc
+    gc.collect()
+    worker.join(timeout=10.0)
+    assert not worker.is_alive(), "generator thread leaked after GC"
+
+
+def test_escape_hatch_serving_disabled(net, monkeypatch):
+    """MXTPU_SERVING=0: inline synchronous generation — no worker
+    thread, the stream returns already finished, tokens identical to
+    the threaded engine's."""
+    monkeypatch.setenv("MXTPU_SERVING", "0")
+    eng = GenerationEngine(net, max_slots=SLOTS, max_length=SMAX,
+                           max_new_tokens=6, queue_limit=16)
+    assert eng._worker is None
+    rng = onp.random.RandomState(11)
+    p = _prompt(rng, 7)
+    s = eng.submit(p)
+    assert s.done()
+    assert s.result().tokens == _ref_generate(net, eng.policy, p, 6)
+    eng.close()
+    with pytest.raises(EngineClosedError):
+        eng.submit(p)
+
+
+# -- soak (excluded from tier-1 via the slow marker) -------------------
+
+@pytest.mark.slow
+def test_soak_concurrent_generation(net):
+    """Sustained concurrent traffic from multiple client threads:
+    every request token-identical to its single-request reference,
+    clean close, no thread leak."""
+    eng = GenerationEngine(net, max_slots=SLOTS, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=512)
+    eng.warmup()
+    rng = onp.random.RandomState(12)
+    prompts = [_prompt(rng, 3 + i % 24) for i in range(16)]
+    refs = [_ref_generate(net, eng.policy, p, 8) for p in prompts]
+    errors = []
+
+    def client(seed):
+        r = onp.random.RandomState(seed)
+        for _ in range(40):
+            i = int(r.randint(len(prompts)))
+            out = eng.generate(prompts[i], timeout=300)
+            if out.tokens != refs[i]:
+                errors.append(i)
+                return
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(4)]
+    n_before = threading.active_count()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    assert not errors, f"token mismatch for prompts {errors[:5]}"
+    eng.close(timeout=60.0)
+    assert not eng._worker.is_alive()
+    assert threading.active_count() <= n_before

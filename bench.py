@@ -1538,7 +1538,269 @@ def _generate_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --checkpoint: resilience-subsystem benchmark (CPU-runnable, <2 min).
+# Measures the TRAINING-STEP STALL a periodic checkpoint inflicts,
+# sync vs async (ISSUE 6 acceptance: async save stalls <10% of a step
+# where a synchronous save stalls a full step or more), plus restore
+# latency and post-resume bit-identity. Each config runs in its own
+# subprocess on the virtual 8-device cpu mesh (same isolation story as
+# --serving/--generate: one backend init per measurement, no cross-
+# config JIT-cache pollution). Results -> BENCH_r10.json
+# (schema-checked before writing).
+# ---------------------------------------------------------------------------
+CKPT_LAYERS = 12             # ~25 params, feat wide enough that a sync
+CKPT_FEAT = 256              # save moves real bytes (~3 MB + moments)
+CKPT_BATCH = 32
+CKPT_WARM, CKPT_STEPS, CKPT_EVERY = 4, 24, 6
+
+
+def _ckpt_model():
+    import numpy as onp
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu import np as mnp
+    from mxnet_tpu.gluon import nn
+
+    n_dev = jax.local_device_count()
+    parallel.set_mesh(parallel.make_mesh((n_dev,), ("dp",)))
+    mx.np.random.seed(0)
+    net = nn.Sequential()
+    for _ in range(CKPT_LAYERS - 1):
+        net.add(nn.Dense(CKPT_FEAT, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = mnp.array(onp.random.RandomState(0)
+                  .randn(CKPT_BATCH, CKPT_FEAT).astype("f4"))
+    y = mnp.array(onp.random.RandomState(1)
+                  .randint(0, 4, CKPT_BATCH).astype("i4"))
+    net(x)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    return net, tr, loss_fn, x, y
+
+
+def _ckpt_stall_config(asynchronous: bool):
+    """Train CKPT_STEPS steps, checkpointing every CKPT_EVERY; report
+    the stall a save-step pays over a plain step."""
+    import tempfile
+    import numpy as onp
+    from mxnet_tpu import autograd, checkpoint as ckpt, telemetry
+
+    net, tr, loss_fn, x, y = _ckpt_model()
+
+    def one_step():
+        with autograd.record():
+            loss = loss_fn(net(x), y).mean()
+        loss.backward()
+        tr.step(CKPT_BATCH)
+        # per-step sync: stall must be attributed to the step that
+        # paid it, so every step ends at a drained device queue
+        return float(loss.asnumpy())
+
+    root = tempfile.mkdtemp(prefix="bench_ckpt_")
+    mgr = ckpt.CheckpointManager(root, keep_last_n=2,
+                                 async_save=asynchronous)
+    for _ in range(CKPT_WARM):
+        one_step()
+    # prime the snapshot/copy program + one full write outside the
+    # measured window (first save compiles the jitted tree-copy)
+    ckpt.save_training_state(mgr, 0, net=net, trainer=tr)
+    mgr.wait()
+    telemetry.reset()
+
+    plain_ms, save_call_ms = [], []
+    loss = None
+    for s in range(CKPT_STEPS):
+        t0 = time.perf_counter()
+        loss = one_step()
+        t_step = (time.perf_counter() - t0) * 1e3
+        if (s + 1) % CKPT_EVERY == 0:
+            # the STALL is the time the training thread spends inside
+            # the save call (sync: snapshot + full write; async:
+            # snapshot dispatch + queue put). Step wall times are too
+            # load-sensitive on a 1-2 vCPU box — the async writer
+            # legitimately contends with subsequent steps, which is
+            # throughput overlap, not training-thread stall.
+            t1 = time.perf_counter()
+            ckpt.save_training_state(mgr, s + 1, net=net, trainer=tr)
+            save_call_ms.append((time.perf_counter() - t1) * 1e3)
+        else:
+            plain_ms.append(t_step)
+    t_flush = time.perf_counter()
+    mgr.wait()
+    flush_ms = (time.perf_counter() - t_flush) * 1e3
+    snap = telemetry.snapshot()
+    mgr.close()
+    mean_plain = sum(plain_ms) / len(plain_ms)
+    stall = sum(save_call_ms) / len(save_call_ms)
+    return {
+        "async": asynchronous,
+        "steps": CKPT_STEPS,
+        "save_every": CKPT_EVERY,
+        "saves": len(save_call_ms),
+        "mean_plain_step_ms": round(mean_plain, 3),
+        "mean_save_step_ms": round(mean_plain + stall, 3),
+        "stall_ms": round(stall, 3),
+        "stall_frac_of_step": round(stall / mean_plain, 4),
+        "final_flush_ms": round(flush_ms, 3),
+        "checkpoint_bytes": int(snap["counters"].get(
+            "checkpoint.save.bytes", 0)),
+        "write_ms_p50": round(snap["histograms"].get(
+            "checkpoint.save.duration_ms", {}).get("p50", 0.0), 3),
+        "final_loss_hex": float.hex(loss),
+    }
+
+
+def _ckpt_restore_config():
+    """Checkpoint at step 3 of 6, resume in a fresh instance, compare
+    steps 4-6 bitwise; report restore latency."""
+    import tempfile
+    import numpy as onp
+    from mxnet_tpu import autograd, checkpoint as ckpt
+
+    def run(n_steps, net, tr, loss_fn, x, y, start=0):
+        out = []
+        for s in range(start, n_steps):
+            with autograd.record():
+                loss = loss_fn(net(x), y).mean()
+            loss.backward()
+            tr.step(CKPT_BATCH)
+            out.append(float.hex(float(loss.asnumpy())))
+        return out
+
+    net, tr, loss_fn, x, y = _ckpt_model()
+    direct = run(6, net, tr, loss_fn, x, y)
+
+    net, tr, loss_fn, x, y = _ckpt_model()
+    run(3, net, tr, loss_fn, x, y)
+    root = tempfile.mkdtemp(prefix="bench_ckpt_restore_")
+    ckpt.save_training_state(root, 3, net=net, trainer=tr)
+
+    net2, tr2, loss_fn2, x2, y2 = _ckpt_model()
+    t0 = time.perf_counter()
+    step, _meta = ckpt.restore_training_state(root, net=net2,
+                                              trainer=tr2)
+    restore_ms = (time.perf_counter() - t0) * 1e3
+    resumed = run(6, net2, tr2, loss_fn2, x2, y2, start=3)
+    return {
+        "restore_ms": round(restore_ms, 3),
+        "restored_step": step,
+        "losses_direct_tail": direct[3:],
+        "losses_resumed": resumed,
+        "bit_identical": direct[3:] == resumed,
+    }
+
+
+def _ckpt_check_schema(doc):
+    """BENCH_r10.json contract — fail the bench rather than publish a
+    malformed document (the satellite's schema check)."""
+    required = {
+        "metric": str, "value": float, "unit": str, "model": str,
+        "n_devices": int, "async": dict, "sync": dict, "restore": dict,
+        "sync_vs_async_stall_ratio": float,
+        "async_stall_under_10pct": bool, "resume_bit_identical": bool,
+    }
+    for key, typ in required.items():
+        if key not in doc:
+            raise ValueError(f"BENCH_r10 schema: missing key {key!r}")
+        if not isinstance(doc[key], typ):
+            raise ValueError(
+                f"BENCH_r10 schema: {key!r} is "
+                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
+    for cfg in ("async", "sync"):
+        for key in ("stall_ms", "stall_frac_of_step",
+                    "mean_plain_step_ms", "mean_save_step_ms", "saves",
+                    "checkpoint_bytes"):
+            if key not in doc[cfg]:
+                raise ValueError(
+                    f"BENCH_r10 schema: missing {cfg}.{key}")
+    for key in ("restore_ms", "bit_identical"):
+        if key not in doc["restore"]:
+            raise ValueError(f"BENCH_r10 schema: missing restore.{key}")
+    return doc
+
+
+def _ckpt_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    import jax
+    cfg = os.environ["BENCH_CKPT_CONFIG"]
+    if cfg == "restore":
+        result = _ckpt_restore_config()
+    else:
+        result = _ckpt_stall_config(asynchronous=(cfg == "async"))
+        result["n_devices"] = jax.local_device_count()
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def _checkpoint_main():
+    if os.environ.get("BENCH_CKPT_CONFIG"):
+        return _ckpt_child()
+
+    def run_child(cfg):
+        env = dict(os.environ, BENCH_CKPT_CONFIG=cfg,
+                   JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--checkpoint"],
+            env=env, capture_output=True, text=True, timeout=300)
+        if out.returncode != 0:
+            print(f"[bench] checkpoint {cfg} failed: "
+                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
+                  flush=True)
+            return None
+        return json.loads(_harvest(out.stdout))
+
+    # interleaved best-of-N per config (least-contended rep wins — the
+    # --trainer-path lesson: a loaded 1-2 vCPU box swings singles 2x)
+    reps = int(os.environ.get("BENCH_CKPT_REPS", "2"))
+    results = {}
+    for rep in range(reps):
+        for name in ("sync", "async"):
+            _stage(f"checkpoint: {name} config (rep {rep + 1}/{reps})")
+            r = run_child(name)
+            if r is None:
+                return 1
+            best = results.get(name)
+            if best is None or r["stall_ms"] < best["stall_ms"]:
+                results[name] = r
+    _stage("checkpoint: restore/bit-identity config")
+    restore = run_child("restore")
+    if restore is None:
+        return 1
+    a, s = results["async"], results["sync"]
+    doc = _ckpt_check_schema({
+        "metric": "checkpoint_async_stall_frac",
+        "value": float(a["stall_frac_of_step"]),
+        "unit": "save-step stall as a fraction of a plain step",
+        "model": f"mlp {CKPT_LAYERS}L-{CKPT_FEAT}u adam "
+                 f"batch={CKPT_BATCH}",
+        "n_devices": int(a["n_devices"]),
+        "reps_best_of": reps,
+        "async": a,
+        "sync": s,
+        "restore": restore,
+        "sync_vs_async_stall_ratio": round(
+            s["stall_ms"] / max(a["stall_ms"], 1e-9), 2),
+        "async_stall_under_10pct":
+            bool(a["stall_frac_of_step"] < 0.10),
+        "resume_bit_identical": bool(restore["bit_identical"]),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_CKPT_OUT",
+                                           "BENCH_r10.json"))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    return 0
+
+
 def main():
+    if "--checkpoint" in sys.argv:
+        return _checkpoint_main()
     if "--generate" in sys.argv:
         return _generate_main()
     if "--serving" in sys.argv:

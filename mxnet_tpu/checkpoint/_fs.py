@@ -1,0 +1,52 @@
+"""Filesystem seam for the checkpoint subsystem.
+
+Every byte the :class:`~mxnet_tpu.checkpoint.CheckpointManager` reads
+or writes goes through one of these methods, so fault-injection tests
+can wrap a :class:`LocalFS` in a flaky/killing mock (truncated shards,
+transient write failures, a process death between two writes) without
+patching ``os`` globally — and a future remote store (GCS fuse,
+tensorstore) only has to implement this surface.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+
+class LocalFS:
+    """POSIX-backed implementation. ``replace`` is the atomicity
+    primitive: a rename within one directory is atomic on every
+    filesystem we care about, so "write sidecar tmp, then replace"
+    never exposes a torn file."""
+
+    def makedirs(self, path: str):
+        os.makedirs(path, exist_ok=True)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str):
+        return os.listdir(path)
+
+    def getsize(self, path: str) -> int:
+        return os.path.getsize(path)
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes):
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def replace(self, src: str, dst: str):
+        os.replace(src, dst)
+
+    def remove(self, path: str):
+        os.remove(path)
+
+    def rmtree(self, path: str):
+        shutil.rmtree(path, ignore_errors=True)

@@ -1,0 +1,564 @@
+"""CheckpointManager — async sharded checkpointing with atomic commit.
+
+The reference's recovery story is synchronous single-host Save/Load
+(``ndarray.cc:1729,1852``) plus "checkpoint + relaunch"; at sharded-era
+scale that means a full training-loop stall per save and a restart from
+zero after preemption. This manager keeps the training thread out of
+the write path:
+
+1. ``save(step, tree)`` SNAPSHOTS the pytree on the caller thread —
+   jax arrays are immutable, so the snapshot is a device-side copy
+   dispatch (O(dispatch), not O(bytes-to-host)); the copy exists only
+   because donated buffers (the fused optimizer states, TrainStep's
+   donated update program) would otherwise be invalidated by the very
+   next step while the writer still holds a reference.
+2. A ``BoundedQueueWorker`` thread does the device→host reads and the
+   per-shard file writes. The bounded queue (``max_pending``) is the
+   backpressure: a training loop outrunning the disk blocks on the
+   queue instead of buying unbounded host memory.
+3. Commit is a MARKER FILE written last: a checkpoint directory
+   without ``COMMITTED`` does not exist as far as restore is
+   concerned, so a kill mid-save can never surface a torn checkpoint.
+4. Retention GC keeps the last ``keep_last_n`` committed steps (plus
+   any leftover uncommitted debris older than the newest commit).
+5. ``restore()`` verifies every shard (length + crc32) against the
+   manifest and falls back to the previous committed step on
+   corruption — counted as ``checkpoint.restore.corrupt_fallbacks``.
+
+Telemetry (docs/OBSERVABILITY.md): counters
+``checkpoint.save.{bytes,retries,errors,corrupt_fallbacks→restore}``,
+histograms ``checkpoint.{save,restore}.duration_ms``, gauge
+``checkpoint.save.pending``.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+import zlib
+
+import numpy as onp
+
+from .. import telemetry
+from .._bounded_worker import BoundedQueueWorker
+from ._fs import LocalFS
+from .manifest import decode_tree, encode_tree, resolve_dtype
+
+__all__ = [
+    "CheckpointError", "CheckpointCorruptError", "CheckpointWriteError",
+    "CheckpointManager", "write_checkpoint", "read_checkpoint",
+    "read_params", "is_committed", "snapshot_tree",
+    "MARKER_FILE", "MANIFEST_FILE", "STEP_PREFIX",
+]
+
+MARKER_FILE = "COMMITTED"
+MANIFEST_FILE = "manifest.json"
+STEP_PREFIX = "step_"
+_FORMAT = "mxnet_tpu.checkpoint/1"
+
+
+class CheckpointError(RuntimeError):
+    """Base class for checkpoint failures."""
+
+
+class CheckpointWriteError(CheckpointError):
+    """A shard/manifest write failed after exhausting retries."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """A committed-looking checkpoint failed integrity verification
+    (missing/truncated shard, crc mismatch, unreadable manifest)."""
+
+
+# ---------------------------------------------------------------------------
+# snapshot
+# ---------------------------------------------------------------------------
+
+_jit_copy = None
+
+
+def snapshot_tree(tree):
+    """Donation-safe snapshot of a pytree, cheap on the caller thread.
+
+    jax.Array leaves get a device-side copy: holding the ORIGINAL
+    buffer is unsafe because the fused optimizer update and TrainStep
+    donate their state buffers, which invalidates them one step later
+    while the async writer still needs the bytes. All jax leaves are
+    copied by ONE jitted identity program (one async dispatch per
+    snapshot, not one eager op per leaf — per-leaf ``jnp.copy`` of a
+    50-param model costs more host time than the training step it is
+    supposed not to stall). numpy leaves are copied on host (they are
+    tiny: RNG keys, iterator orders); scalars pass through."""
+    global _jit_copy
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, x in enumerate(leaves) if isinstance(x, jax.Array)]
+    if idx:
+        if _jit_copy is None:
+            import jax.numpy as jnp
+            _jit_copy = jax.jit(
+                lambda xs: tuple(jnp.copy(x) for x in xs))
+        copies = _jit_copy(tuple(leaves[i] for i in idx))
+        for i, c in zip(idx, copies):
+            leaves[i] = c
+    leaves = [x.copy() if isinstance(x, onp.ndarray) else x
+              for x in leaves]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# single-directory write / read (the per-step format)
+# ---------------------------------------------------------------------------
+
+def _write_atomic(fs, path, data: bytes, max_retries: int,
+                  backoff_s: float):
+    """tmp-write + rename, with bounded retry-on-OSError (transient
+    NFS/GCS-fuse hiccups). Retries are counted so an unhealthy
+    filesystem is visible in telemetry long before it kills a run."""
+    tmp = path + ".tmp"
+    attempt = 0
+    while True:
+        try:
+            fs.write_bytes(tmp, data)
+            fs.replace(tmp, path)
+            return
+        except OSError as e:
+            attempt += 1
+            if attempt > max_retries:
+                raise CheckpointWriteError(
+                    f"writing {path} failed after {max_retries} "
+                    f"retries: {e!r}") from e
+            telemetry.counter("checkpoint.save.retries")
+            time.sleep(backoff_s * (2 ** (attempt - 1)))
+
+
+def write_checkpoint(directory, tree, metadata=None, fs=None,
+                     max_retries: int = 3, backoff_s: float = 0.05):
+    """Write one checkpoint into ``directory`` (shards + manifest +
+    commit marker, in that order). Synchronous; the manager calls this
+    from its worker thread, the ``parallel.save_sharded`` shim calls
+    it directly. Returns total payload bytes."""
+    fs = fs or LocalFS()
+    import os
+    directory = os.path.abspath(directory)
+    fs.makedirs(directory)
+    t0 = telemetry.clock()
+    counter = [0]
+    total = [0]
+
+    def add_leaf(x):
+        arr = onp.asarray(x)  # D2H happens HERE (writer thread)
+        data = arr.tobytes()
+        name = f"shard_{counter[0]:05d}.bin"
+        counter[0] += 1
+        _write_atomic(fs, os.path.join(directory, name), data,
+                      max_retries, backoff_s)
+        total[0] += len(data)
+        return {"shard": name, "shape": list(arr.shape),
+                "dtype": str(arr.dtype), "nbytes": len(data),
+                "crc32": zlib.crc32(data)}
+
+    skeleton = encode_tree(tree, add_leaf)
+    manifest = {
+        "format": _FORMAT,
+        "tree": skeleton,
+        "metadata": metadata or {},
+        "nbytes": total[0],
+        "n_shards": counter[0],
+    }
+    _write_atomic(fs, os.path.join(directory, MANIFEST_FILE),
+                  json.dumps(manifest, indent=1).encode(),
+                  max_retries, backoff_s)
+    # the commit: restore trusts nothing without this marker
+    _write_atomic(fs, os.path.join(directory, MARKER_FILE), b"ok",
+                  max_retries, backoff_s)
+    telemetry.counter("checkpoint.save.bytes", total[0])
+    telemetry.hist_since("checkpoint.save.duration_ms", t0)
+    return total[0]
+
+
+def is_committed(directory, fs=None) -> bool:
+    import os
+    fs = fs or LocalFS()
+    return fs.exists(os.path.join(directory, MARKER_FILE)) and \
+        fs.exists(os.path.join(directory, MANIFEST_FILE))
+
+
+def read_checkpoint(directory, fs=None, verify: bool = True):
+    """Read one checkpoint directory -> ``(tree, metadata)`` with host
+    numpy leaves. Raises :class:`CheckpointCorruptError` on any
+    integrity failure (missing/truncated shard, crc mismatch,
+    unreadable manifest)."""
+    import os
+    fs = fs or LocalFS()
+    directory = os.path.abspath(directory)
+    t0 = telemetry.clock()
+    try:
+        manifest = json.loads(
+            fs.read_bytes(os.path.join(directory, MANIFEST_FILE)))
+        skeleton, metadata = manifest["tree"], manifest.get("metadata", {})
+    except (OSError, ValueError, KeyError) as e:
+        raise CheckpointCorruptError(
+            f"unreadable manifest in {directory}: {e!r}") from e
+    total = [0]
+
+    def get_leaf(desc):
+        path = os.path.join(directory, desc["shard"])
+        try:
+            data = fs.read_bytes(path)
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"missing shard {desc['shard']} in {directory}: "
+                f"{e!r}") from e
+        if len(data) != desc["nbytes"]:
+            raise CheckpointCorruptError(
+                f"truncated shard {desc['shard']} in {directory}: "
+                f"{len(data)} bytes, manifest says {desc['nbytes']}")
+        if verify and zlib.crc32(data) != desc["crc32"]:
+            raise CheckpointCorruptError(
+                f"crc mismatch in shard {desc['shard']} of {directory}")
+        total[0] += len(data)
+        arr = onp.frombuffer(data, dtype=resolve_dtype(desc["dtype"]))
+        return arr.reshape(desc["shape"]).copy()
+
+    try:
+        tree = decode_tree(skeleton, get_leaf)
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:  # noqa: BLE001 — any decode failure is
+        # corruption from the caller's point of view
+        raise CheckpointCorruptError(
+            f"undecodable checkpoint in {directory}: {e!r}") from e
+    telemetry.counter("checkpoint.restore.bytes", total[0])
+    telemetry.hist_since("checkpoint.restore.duration_ms", t0)
+    return tree, metadata
+
+
+def read_params(path, fs=None):
+    """Parameter mapping (``name -> host array``) plus metadata from
+    ``path`` — either one checkpoint directory or a manager root (the
+    latest committed step is chosen). The serving weight-rollover entry
+    point (`GenerationEngine.load_weights`)."""
+    import os
+    fs = fs or LocalFS()
+    path = os.path.abspath(path)
+    if not fs.exists(os.path.join(path, MANIFEST_FILE)):
+        steps = _committed_steps(path, fs)
+        if not steps:
+            raise CheckpointError(
+                f"{path} holds no committed checkpoint (no "
+                f"{MANIFEST_FILE} and no committed {STEP_PREFIX}* "
+                f"subdirectory)")
+        path = os.path.join(path, _step_dirname(steps[-1]))
+    tree, metadata = read_checkpoint(path, fs)
+    params = tree.get("params", tree) if isinstance(tree, dict) else tree
+    if not isinstance(params, dict):
+        raise CheckpointError(
+            f"checkpoint at {path} does not contain a parameter "
+            f"mapping")
+    return params, metadata
+
+
+# ---------------------------------------------------------------------------
+# step-directory bookkeeping
+# ---------------------------------------------------------------------------
+
+def _step_dirname(step: int) -> str:
+    return f"{STEP_PREFIX}{step:08d}"
+
+
+def _parse_step(name: str):
+    if not name.startswith(STEP_PREFIX):
+        return None
+    try:
+        return int(name[len(STEP_PREFIX):])
+    except ValueError:
+        return None
+
+
+def _committed_steps(root, fs):
+    import os
+    if not fs.isdir(root):
+        return []
+    steps = []
+    for name in fs.listdir(root):
+        s = _parse_step(name)
+        if s is not None and is_committed(os.path.join(root, name), fs):
+            steps.append(s)
+    return sorted(steps)
+
+
+# ---------------------------------------------------------------------------
+# the manager
+# ---------------------------------------------------------------------------
+
+class _SaveWorker(BoundedQueueWorker):
+    """Writer thread: drains queued (step, snapshot) items through
+    ``CheckpointManager._write_step``. Holds only a weakref to the
+    manager so an abandoned manager can be collected; pending events
+    are always set (never a hung ``wait()``)."""
+
+    def __init__(self, manager: "CheckpointManager", depth: int):
+        super().__init__(depth, name="CheckpointManager.saver")
+        self._manager = weakref.ref(manager)
+        self.start()
+
+    def run(self):
+        while True:
+            item = self._get()
+            if item is self._DONE:
+                return
+            step, snap, metadata, evt = item
+            mgr = self._manager()
+            if mgr is None:
+                evt.set()
+                return
+            try:
+                mgr._write_step(step, snap, metadata)
+            except BaseException as e:  # noqa: BLE001 — surface via
+                # wait()/close(); a failed save must not kill the thread
+                mgr._set_error(e)
+            finally:
+                mgr._finish_pending(evt)
+            del mgr
+
+    def _drained(self, item):
+        # hard-stop path: an un-written save is abandoned, but its
+        # waiters are released (close() flushes gracefully first, so
+        # this only fires on a timed-out close)
+        if isinstance(item, tuple) and len(item) == 4:
+            item[3].set()
+
+
+class CheckpointManager:
+    """Periodic training checkpoints under one root directory.
+
+    Parameters
+    ----------
+    directory : str
+        Root; each save lands in ``step_<N>/`` with an atomic
+        ``COMMITTED`` marker.
+    keep_last_n : int, optional
+        Retention: committed steps beyond the newest N are deleted
+        after each commit. ``None`` keeps everything.
+    async_save : bool
+        Write shards on a background worker thread (default). The
+        caller-thread cost is then one device-side copy dispatch per
+        leaf plus a queue put; ``False`` writes synchronously in
+        ``save()``.
+    max_pending : int
+        Bound on queued-but-unwritten saves; a producer outrunning the
+        disk blocks here (backpressure) instead of accumulating
+        snapshots.
+    max_retries / backoff_s
+        Per-file write retry budget and initial exponential backoff.
+    fs : optional
+        Filesystem implementation (see ``_fs.LocalFS``) — the
+        fault-injection seam.
+    """
+
+    def __init__(self, directory, keep_last_n=3, async_save: bool = True,
+                 max_pending: int = 2, max_retries: int = 3,
+                 backoff_s: float = 0.05, fs=None):
+        import os
+        if keep_last_n is not None and int(keep_last_n) < 1:
+            raise ValueError("keep_last_n must be >= 1 or None")
+        self.directory = os.path.abspath(directory)
+        self.keep_last_n = None if keep_last_n is None else int(keep_last_n)
+        self.max_retries = int(max_retries)
+        self.backoff_s = float(backoff_s)
+        self._fs = fs or LocalFS()
+        self._fs.makedirs(self.directory)
+        self._lock = threading.Lock()
+        self._pending: list = []
+        self._error = None
+        self._closed = False
+        self._worker = _SaveWorker(self, max(1, int(max_pending))) \
+            if async_save else None
+
+    # -- error/pending plumbing ----------------------------------------
+    def _set_error(self, e):
+        telemetry.counter("checkpoint.save.errors")
+        with self._lock:
+            self._error = e
+
+    def _finish_pending(self, evt):
+        with self._lock:
+            try:
+                self._pending.remove(evt)
+            except ValueError:
+                pass
+            depth = len(self._pending)
+        evt.set()
+        telemetry.gauge("checkpoint.save.pending", depth)
+
+    def _raise_pending_error(self):
+        with self._lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    @property
+    def pending(self) -> int:
+        """Snapshots queued or being written right now."""
+        with self._lock:
+            return len(self._pending)
+
+    # -- save ----------------------------------------------------------
+    def save(self, step: int, tree, metadata=None, block: bool = False):
+        """Checkpoint ``tree`` as ``step``. Returns once the snapshot
+        is taken (async mode) or the checkpoint is committed
+        (``block=True`` / sync mode). A failure of an earlier async
+        save is raised here, on ``wait()``, or on ``close()`` —
+        whichever comes first."""
+        if self._closed:
+            raise CheckpointError("save on a closed CheckpointManager")
+        step = int(step)
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
+        self._raise_pending_error()
+        snap = snapshot_tree(tree)
+        if self._worker is None:
+            self._write_step(step, snap, metadata)
+            return
+        evt = threading.Event()
+        with self._lock:
+            self._pending.append(evt)
+            depth = len(self._pending)
+        telemetry.gauge("checkpoint.save.pending", depth)
+        # blocking put = backpressure once max_pending saves are queued
+        self._worker._queue.put((step, snap, metadata, evt))
+        if block:
+            evt.wait()
+            self._raise_pending_error()
+
+    def _write_step(self, step, snap, metadata):
+        import os
+        meta = dict(metadata or {})
+        meta.setdefault("step", step)
+        write_checkpoint(
+            os.path.join(self.directory, _step_dirname(step)), snap,
+            metadata=meta, fs=self._fs, max_retries=self.max_retries,
+            backoff_s=self.backoff_s)
+        self.gc()
+
+    def wait(self, timeout=None):
+        """Block until every queued save is committed (or failed);
+        re-raises the first failure."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                evts = list(self._pending)
+            if not evts:
+                break
+            for evt in evts:
+                rem = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                if not evt.wait(rem):
+                    raise TimeoutError(
+                        f"checkpoint saves still pending after "
+                        f"{timeout}s")
+        self._raise_pending_error()
+
+    # -- inspection / restore ------------------------------------------
+    def all_steps(self):
+        """Committed step numbers, ascending."""
+        return _committed_steps(self.directory, self._fs)
+
+    def latest_step(self):
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    def step_dir(self, step: int) -> str:
+        import os
+        return os.path.join(self.directory, _step_dirname(int(step)))
+
+    def restore(self, step=None):
+        """Load a committed checkpoint -> ``(step, tree, metadata)``.
+
+        Default: the NEWEST committed step; if it fails verification
+        (truncated/corrupt shards under the marker — e.g. bit rot or a
+        torn copy), fall back to the previous committed step (counted
+        as ``checkpoint.restore.corrupt_fallbacks``) until one reads
+        clean. An explicit ``step`` is strict: corruption raises."""
+        import warnings
+        if step is not None:
+            step = int(step)
+            if step not in self.all_steps():
+                raise CheckpointError(
+                    f"step {step} has no committed checkpoint under "
+                    f"{self.directory}")
+            tree, metadata = read_checkpoint(self.step_dir(step),
+                                             self._fs)
+            return step, tree, metadata
+        candidates = list(reversed(self.all_steps()))
+        if not candidates:
+            raise CheckpointError(
+                f"no committed checkpoint under {self.directory}")
+        last_exc = None
+        for s in candidates:
+            try:
+                tree, metadata = read_checkpoint(self.step_dir(s),
+                                                 self._fs)
+                return s, tree, metadata
+            except CheckpointCorruptError as e:
+                telemetry.counter("checkpoint.restore.corrupt_fallbacks")
+                warnings.warn(
+                    f"checkpoint step {s} is corrupt "
+                    f"({e}); falling back to the previous "
+                    f"committed step")
+                last_exc = e
+        raise CheckpointError(
+            f"every committed checkpoint under {self.directory} "
+            f"failed verification") from last_exc
+
+    # -- retention ------------------------------------------------------
+    def gc(self):
+        """Apply retention: drop committed steps beyond
+        ``keep_last_n`` and uncommitted debris older than the newest
+        commit (a crashed writer's leftovers)."""
+        import os
+        committed = self.all_steps()
+        doomed = []
+        if self.keep_last_n is not None and \
+                len(committed) > self.keep_last_n:
+            doomed += committed[:-self.keep_last_n]
+        newest = committed[-1] if committed else None
+        if newest is not None and self._fs.isdir(self.directory):
+            for name in self._fs.listdir(self.directory):
+                s = _parse_step(name)
+                if s is not None and s < newest and s not in committed:
+                    doomed.append(s)
+        for s in doomed:
+            self._fs.rmtree(os.path.join(self.directory,
+                                         _step_dirname(s)))
+        return doomed
+
+    # -- lifecycle ------------------------------------------------------
+    def close(self, timeout: float = 60.0):
+        """Flush pending saves (graceful), then stop the worker. The
+        first pending failure is raised after the worker is down."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._worker is not None:
+            try:
+                self.wait(timeout=timeout)
+            finally:
+                self._worker.stop(timeout=5.0)
+        self._raise_pending_error()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            if not self._closed and self._worker is not None:
+                self._worker.stop(timeout=1.0)
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

@@ -1,0 +1,83 @@
+"""Checkpoint manifest — a JSON skeleton of an arbitrary pytree.
+
+The reference serialized whole NDArrays into one file
+(``ndarray.cc:1729`` Save / ``:1852`` Load); a sharded checkpoint
+instead stores one raw-bytes shard file per array leaf plus this
+manifest describing how to reassemble the tree: container structure
+(dict/tuple/list, with key types preserved), inline Python scalars,
+and per-shard integrity data (byte length + crc32) used for
+truncation/corruption detection at restore.
+
+Array leaves are stored as ``tobytes()`` raw buffers rather than
+``.npy`` so non-numpy dtypes (bfloat16, fp8 — ml_dtypes) round-trip
+bit-exactly: the manifest records the logical dtype string and the
+shard file is just the bytes.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["encode_tree", "decode_tree", "resolve_dtype"]
+
+
+def resolve_dtype(name: str):
+    """dtype-string -> numpy dtype, including the ml_dtypes extras
+    (``str(arr.dtype)`` of a bfloat16 array is ``'bfloat16'``, which
+    plain ``onp.dtype`` rejects)."""
+    try:
+        return onp.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return onp.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_key(k):
+    if isinstance(k, bool) or not isinstance(k, (str, int)):
+        raise TypeError(
+            f"checkpoint tree dict keys must be str or int, got "
+            f"{type(k).__name__}: {k!r}")
+    return {"t": "int" if isinstance(k, int) else "str", "v": k}
+
+
+def _decode_key(node):
+    return int(node["v"]) if node["t"] == "int" else str(node["v"])
+
+
+def encode_tree(obj, add_leaf):
+    """Encode ``obj`` into a JSON-able node. ``add_leaf(array)`` is
+    called for every array leaf and must return the shard descriptor
+    dict (``{"shard", "shape", "dtype", "nbytes", "crc32"}``) — the
+    caller owns writing the actual bytes."""
+    if obj is None:
+        return {"t": "none"}
+    if isinstance(obj, (bool, int, float, str)):
+        return {"t": "py", "v": obj}
+    if isinstance(obj, (list, tuple)):
+        return {"t": "tuple" if isinstance(obj, tuple) else "list",
+                "v": [encode_tree(x, add_leaf) for x in obj]}
+    if isinstance(obj, dict):
+        return {"t": "dict",
+                "v": [[_encode_key(k), encode_tree(v, add_leaf)]
+                      for k, v in obj.items()]}
+    # anything else is an array leaf (jax.Array, onp.ndarray, scalars)
+    return {"t": "arr", **add_leaf(obj)}
+
+
+def decode_tree(node, get_leaf):
+    """Inverse of :func:`encode_tree`; ``get_leaf(descriptor)`` loads
+    (and integrity-checks) one shard and returns the array."""
+    t = node["t"]
+    if t == "none":
+        return None
+    if t == "py":
+        return node["v"]
+    if t == "list":
+        return [decode_tree(x, get_leaf) for x in node["v"]]
+    if t == "tuple":
+        return tuple(decode_tree(x, get_leaf) for x in node["v"])
+    if t == "dict":
+        return {_decode_key(k): decode_tree(v, get_leaf)
+                for k, v in node["v"]}
+    if t == "arr":
+        return get_leaf(node)
+    raise ValueError(f"unknown manifest node type {t!r}")

@@ -1,0 +1,318 @@
+"""Full training-state capture — the resume side of the subsystem.
+
+A checkpoint that only holds params + optimizer moments (the old
+``parallel/checkpoint.py`` wrapper) resumes *approximately*: Adam's
+bias correction restarts near t=1, warmup/decay schedulers rewind,
+the data iterator replays the epoch head, dropout masks diverge. This
+module captures everything a killed-and-resumed
+``Trainer``/``Estimator``/``TrainStep`` run needs to continue
+**bit-identically**:
+
+- parameters (by name, sharding-preserving restore),
+- optimizer state tensors (Trainer per-param states or TrainStep's
+  fused ``_opt_states``),
+- optimizer counters: ``num_update``, ``begin_num_update``,
+  ``index_update_count`` (the Adam-t / scheduler clock),
+- lr-scheduler position (scalar scheduler attributes — ``base_lr``
+  mutations included),
+- AMP dynamic-loss-scaler state (scale + unskipped-step window),
+- the data-iterator cursor (any iterator exposing
+  ``state_dict``/``load_state_dict`` — ``io.NDArrayIter`` does),
+- the explicit global RNG key (``random_state.py``) so stochastic
+  layers replay the exact mask stream.
+
+``capture_training_state`` returns ``(tree, metadata)`` — array
+leaves in the tree (sharded to disk), JSON scalars in the metadata
+(folded into the manifest, replacing the old ``opt_counters.json``
+sidecar which silently dropped lr-scheduler state).
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from .. import random_state
+
+__all__ = ["capture_training_state", "apply_training_state",
+           "swap_param_buffers"]
+
+
+# ---------------------------------------------------------------------------
+# capture
+# ---------------------------------------------------------------------------
+
+def _json_scalar(v) -> bool:
+    return isinstance(v, (bool, int, float, str)) or (
+        isinstance(v, (list, tuple))
+        and all(isinstance(x, (bool, int, float, str)) for x in v))
+
+
+def _scheduler_meta(sched):
+    return {
+        "class": type(sched).__name__,
+        "state": {k: (list(v) if isinstance(v, tuple) else v)
+                  for k, v in vars(sched).items() if _json_scalar(v)},
+    }
+
+
+def _optimizer_meta(opt):
+    meta = {
+        "class": type(opt).__name__,
+        "num_update": int(opt.num_update),
+        "begin_num_update": int(opt.begin_num_update),
+        "index_update_count": {str(k): int(v) for k, v
+                               in opt._index_update_count.items()},
+        "lr": float(opt.lr),
+    }
+    if opt.lr_scheduler is not None:
+        meta["lr_scheduler"] = _scheduler_meta(opt.lr_scheduler)
+    return meta
+
+
+def capture_training_state(net=None, trainer=None, train_step=None,
+                           data_iter=None, include_rng: bool = True):
+    """Snapshot-ready ``(tree, metadata)`` for any combination of a
+    Gluon ``net``, an imperative ``Trainer``, a compiled
+    ``parallel.TrainStep``, and a resumable data iterator. Pass the
+    result straight to ``CheckpointManager.save`` (which makes the
+    donation-safe device copies)."""
+    tree: dict = {}
+    meta: dict = {"format": "mxnet_tpu.checkpoint/1"}
+
+    if net is not None:
+        tree["params"] = {name: p.data()._data
+                          for name, p in net.collect_params().items()}
+
+    if trainer is not None:
+        states = {}
+        for i, s in enumerate(trainer._states):
+            if trainer._states_initialized[i]:
+                states[str(i)] = s
+        tree["trainer_states"] = states
+        meta["optimizer"] = _optimizer_meta(trainer._optimizer)
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is not None:
+            meta["amp_scaler"] = {
+                k: v for k, v in vars(scaler).items()
+                if isinstance(v, (bool, int, float))}
+
+    if train_step is not None:
+        if getattr(train_step, "_opt_states", None) is not None:
+            tree["opt_states"] = tuple(train_step._opt_states)
+        meta["optimizer"] = _optimizer_meta(train_step.optimizer)
+
+    if data_iter is not None:
+        state_fn = getattr(data_iter, "state_dict", None)
+        if state_fn is None:
+            raise TypeError(
+                f"data_iter {type(data_iter).__name__} is not "
+                "resumable: it does not expose state_dict()/"
+                "load_state_dict() (io.NDArrayIter does)")
+        tree["data_iter"] = state_fn()
+
+    if include_rng:
+        key, counter = random_state.get_state()
+        if key is not None:
+            tree["rng"] = {"key": key}
+            meta["rng_counter"] = int(counter)
+        # numpy's GLOBAL generator too: NDArrayIter.reset() shuffles
+        # with it, so without this a multi-epoch shuffled resume
+        # diverges at the first epoch boundary after the checkpoint
+        # (the mid-epoch order travels in the iterator cursor, but the
+        # NEXT epoch's shuffle comes from ambient numpy state)
+        name, keys, pos, has_gauss, cached = onp.random.get_state()
+        tree["numpy_rng"] = (name, keys, int(pos), int(has_gauss),
+                             float(cached))
+    return tree, meta
+
+
+# ---------------------------------------------------------------------------
+# apply
+# ---------------------------------------------------------------------------
+
+def _placed_like(arr, like):
+    """Host array -> device array, on the placement (sharding) of the
+    live array it replaces, with the live dtype kept (a checkpoint
+    restored into a recast net follows the net)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    if isinstance(like, jax.Array):
+        out = jnp.asarray(arr, like.dtype)
+        sh = getattr(like, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            out = jax.device_put(out, sh)
+        return out
+    return jnp.asarray(arr)
+
+
+def _to_device(tree, like=None):
+    """Map host leaves onto devices, leaf-aligned with ``like`` when
+    given (sharding/dtype preservation)."""
+    import jax
+
+    if like is not None:
+        try:
+            return jax.tree_util.tree_map(
+                lambda x, l: _placed_like(x, l)
+                if isinstance(x, onp.ndarray) else x, tree, like)
+        except ValueError:
+            pass  # layout changed (optimizer migration): place fresh
+    return jax.tree_util.tree_map(
+        lambda x: _placed_like(x, None)
+        if isinstance(x, onp.ndarray) else x, tree)
+
+
+def _apply_params(net, saved, strict):
+    params = net.collect_params()
+    missing = [n for n in saved if n not in params]
+    if missing and strict:
+        raise KeyError(
+            f"checkpoint holds parameters absent from the net: "
+            f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+    for name, arr in saved.items():
+        p = params.get(name)
+        if p is None:
+            continue
+        if p._data is None:
+            # a FRESH net with deferred shape inference (no in_units,
+            # no forward pass yet — exactly the resume-after-preemption
+            # case): the checkpoint shape finishes the init, the same
+            # way Block.load_parameters does via set_data
+            from ..numpy import array
+            p.set_data(array(onp.asarray(arr)))
+            continue
+        live = p.data()._data
+        if tuple(live.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"shape mismatch restoring {name}: net has "
+                f"{tuple(live.shape)}, checkpoint has "
+                f"{tuple(arr.shape)}")
+        p.data()._install(_placed_like(arr, live))
+
+
+def _apply_optimizer_meta(opt, meta):
+    if not meta:
+        return
+    opt.num_update = int(meta["num_update"])
+    opt.begin_num_update = int(meta["begin_num_update"])
+    opt._index_update_count = {
+        int(k): int(v)
+        for k, v in meta.get("index_update_count", {}).items()}
+    if "lr" in meta:
+        opt.lr = float(meta["lr"])
+    sched_meta = meta.get("lr_scheduler")
+    if sched_meta and opt.lr_scheduler is not None:
+        for k, v in sched_meta.get("state", {}).items():
+            if hasattr(opt.lr_scheduler, k):
+                setattr(opt.lr_scheduler, k, v)
+
+
+def swap_param_buffers(params, new_params, strict: bool = True):
+    """The serving weight-rollover core: install new buffers into live
+    ``Parameter``s without touching shapes, dtypes, placement, or any
+    cached jitted closure.
+
+    Validates EVERYTHING first (name coverage under ``strict``, shape
+    match per parameter) and only then installs — a bad checkpoint can
+    never leave a model half-swapped. Same-shape/dtype buffers mean
+    the compiled programs that take parameters as runtime arguments
+    (CachedOp entries, the GPT generation closures) keep their traces;
+    sharded parameters keep their placement via ``device_put`` onto
+    the old buffer's sharding. Returns the number of parameters
+    swapped."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    missing = [n for n in params if n not in new_params]
+    unexpected = [n for n in new_params if n not in params]
+    if strict and (missing or unexpected):
+        raise ValueError(
+            f"checkpoint does not match the model: "
+            f"missing={missing[:4]} unexpected={unexpected[:4]}")
+    plan = []
+    for name, p in params.items():
+        if name not in new_params:
+            continue
+        live = p.data()._data
+        arr = new_params[name]
+        if tuple(live.shape) != tuple(arr.shape):
+            raise ValueError(
+                f"shape mismatch for {name}: model "
+                f"{tuple(live.shape)}, checkpoint {tuple(arr.shape)}")
+        plan.append((p, live, arr))
+    for p, live, arr in plan:
+        new = jnp.asarray(arr, live.dtype)
+        sh = getattr(live, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            new = jax.device_put(new, sh)
+        p.data()._install(new)
+    return len(plan)
+
+
+def apply_training_state(tree, metadata=None, net=None, trainer=None,
+                         train_step=None, data_iter=None,
+                         strict: bool = True):
+    """Restore a ``capture_training_state`` snapshot (as returned by
+    ``CheckpointManager.restore``: host numpy leaves) into live
+    objects. Only the pieces present in BOTH the checkpoint and the
+    arguments are touched."""
+    metadata = metadata or {}
+
+    if net is not None and "params" in tree:
+        _apply_params(net, tree["params"], strict)
+
+    if trainer is not None:
+        saved = tree.get("trainer_states")
+        if saved is not None:
+            for k, s in saved.items():
+                i = int(k)
+                if i >= len(trainer._states):
+                    if strict:
+                        raise KeyError(
+                            f"checkpoint state index {i} out of range "
+                            f"for a trainer with "
+                            f"{len(trainer._states)} parameters")
+                    continue
+                like = trainer._states[i] \
+                    if trainer._states_initialized[i] else None
+                trainer._states[i] = _to_device(s, like)
+                trainer._states_initialized[i] = True
+        _apply_optimizer_meta(trainer._optimizer,
+                              metadata.get("optimizer"))
+        scaler_meta = metadata.get("amp_scaler")
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler_meta and scaler is not None:
+            for k, v in scaler_meta.items():
+                if hasattr(scaler, k):
+                    setattr(scaler, k, v)
+
+    if train_step is not None:
+        saved = tree.get("opt_states")
+        if saved is not None:
+            live = getattr(train_step, "_opt_states", None)
+            restored = []
+            for i, s in enumerate(saved):
+                l = live[i] if live is not None and i < len(live) \
+                    else None
+                restored.append(_to_device(s, l))
+            train_step._opt_states = restored
+        _apply_optimizer_meta(train_step.optimizer,
+                              metadata.get("optimizer"))
+
+    if data_iter is not None and "data_iter" in tree:
+        load_fn = getattr(data_iter, "load_state_dict", None)
+        if load_fn is None:
+            raise TypeError(
+                f"data_iter {type(data_iter).__name__} does not "
+                "expose load_state_dict()")
+        load_fn(tree["data_iter"])
+
+    if "rng" in tree:
+        random_state.set_state(tree["rng"]["key"],
+                               metadata.get("rng_counter", 0))
+
+    if "numpy_rng" in tree:
+        name, keys, pos, has_gauss, cached = tree["numpy_rng"]
+        onp.random.set_state((name, onp.asarray(keys, onp.uint32),
+                              int(pos), int(has_gauss), float(cached)))

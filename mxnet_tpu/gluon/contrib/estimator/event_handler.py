@@ -204,15 +204,29 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd,
                              self._metrics_str())
 
 
-class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
     """Save model params + trainer states periodically; optionally keep
     only the best by a monitored metric (parity: event_handler.py
-    CheckpointHandler)."""
+    CheckpointHandler).
+
+    ``manager``: pass a ``mxnet_tpu.checkpoint.CheckpointManager`` to
+    route saves through the resilience subsystem instead of the legacy
+    ``.params``/``.states`` file pair — async per-shard save off the
+    fit loop, atomic commit, retention via the manager's
+    ``keep_last_n``, and FULL state capture (optimizer counters,
+    lr-scheduler position, AMP scale, RNG) so
+    ``resume_from_checkpoint=True`` continues from the latest
+    committed step. Resume granularity follows the fit loop: an
+    epoch-boundary checkpoint resumes bit-identically at the next
+    epoch; a ``batch_period`` (mid-epoch) checkpoint resumes at the
+    start of the interrupted epoch, because ``fit`` restarts the data
+    iterable from the top — exact mid-epoch resume is the
+    ``Trainer`` + ``data_iter`` path (docs/CHECKPOINT.md)."""
 
     def __init__(self, model_dir, model_prefix="model", monitor=None,
                  verbose=0, save_best=False, mode="auto", epoch_period=1,
                  batch_period=None, max_checkpoints=5,
-                 resume_from_checkpoint=False):
+                 resume_from_checkpoint=False, manager=None):
         self.model_dir = model_dir
         self.model_prefix = model_prefix
         self.monitor = monitor
@@ -221,6 +235,7 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
         self.batch_period = batch_period
         self.max_checkpoints = max_checkpoints
         self.resume_from_checkpoint = resume_from_checkpoint
+        self.manager = manager
         self.verbose = verbose
         self.saved_checkpoints = []
         self.current_epoch = 0
@@ -247,6 +262,20 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                             f"{self.model_prefix}-{tag}")
 
     def _save(self, estimator, tag):
+        if self.manager is not None:
+            from .... import checkpoint as _ckpt
+            tree, meta = _ckpt.capture_training_state(
+                net=estimator.net, trainer=estimator.trainer)
+            meta.update({"epoch": self.current_epoch,
+                         "batch": self.current_batch, "tag": tag})
+            # async: the fit loop pays one snapshot dispatch, the
+            # manager's worker writes the shards; retention is the
+            # manager's keep_last_n
+            self.manager.save(self.current_batch, tree, metadata=meta)
+            if self.verbose:
+                self.logger.info("queued checkpoint %s (step %d)", tag,
+                                 self.current_batch)
+            return
         prefix = self._state_path(tag)
         estimator.net.save_parameters(prefix + ".params")
         if estimator.trainer is not None:
@@ -266,6 +295,32 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
             self.logger.info("saved checkpoint %s", prefix)
 
     def _resume(self, estimator):
+        if self.manager is not None:
+            from .... import checkpoint as _ckpt
+            if self.manager.latest_step() is None:
+                return
+            step, tree, meta = self.manager.restore()
+            _ckpt.apply_training_state(tree, meta, net=estimator.net,
+                                       trainer=estimator.trainer)
+            epoch = int(meta.get("epoch", -1))
+            tag = str(meta.get("tag", ""))
+            if tag.startswith("epoch"):
+                # epoch-boundary save: that epoch is complete
+                self.trained_epoch = epoch
+            else:
+                # batch-period save mid-epoch: the recorded epoch was
+                # INTERRUPTED, not finished — counting it as trained
+                # would label its untrained tail as done. The fit loop
+                # is epoch-granular (it restarts the data from the
+                # top), so the interrupted epoch keeps its number;
+                # exact mid-epoch resume is the Trainer + data_iter
+                # path (docs/CHECKPOINT.md).
+                self.trained_epoch = epoch - 1
+            self.current_epoch = self.trained_epoch + 1
+            self.current_batch = int(meta.get("batch", step))
+            self.logger.info("resumed from checkpoint step %d (%s)",
+                             step, meta.get("tag", "?"))
+            return
         meta = os.path.join(self.model_dir, f"{self.model_prefix}.meta")
         if not os.path.exists(meta):
             return
@@ -299,6 +354,12 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
                         f"{self.model_prefix}-best.params"))
             self._save(estimator, f"epoch{self.current_epoch}")
         self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.manager is not None:
+            # flush: queued async saves must be committed before the
+            # process (or the fit caller) moves on
+            self.manager.wait()
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

@@ -331,6 +331,8 @@ class Trainer:
         with open(fname, "wb") as f:
             pickle.dump({"states": host,
                          "num_update": self._optimizer.num_update,
+                         "begin_num_update":
+                             self._optimizer.begin_num_update,
                          "index_update_count":
                              self._optimizer._index_update_count}, f)
 
@@ -355,5 +357,13 @@ class Trainer:
             states)
         self._states_initialized = [True] * len(self._states)
         self._optimizer.num_update = blob["num_update"]
-        self._optimizer.begin_num_update = blob["num_update"]
+        # restore the SAVED begin_num_update — setting it to num_update
+        # (the old behavior) skewed everything keyed off
+        # updates-since-begin after a resume: a parameter first updated
+        # post-resume had its index count initialized at num_update
+        # instead of the true begin, inflating its Adam bias-correction
+        # t and shifting warmup/decay schedules that consult
+        # begin_num_update. Blobs from before the key existed fall back
+        # to 0 (the value every fresh run starts from).
+        self._optimizer.begin_num_update = blob.get("begin_num_update", 0)
         self._optimizer._index_update_count = blob["index_update_count"]

@@ -189,6 +189,32 @@ class NDArrayIter(DataIter):
             return self._pad_target(real) - real
         return 0
 
+    # -- resumable iteration (mxnet_tpu.checkpoint) --------------------
+    def state_dict(self):
+        """Mid-epoch position snapshot: the cursor plus the epoch's
+        (possibly shuffled) visit order AND the base index permutation
+        (``reset()`` shuffles ``idx`` in place, so the NEXT epoch's
+        order depends on it, not just on the RNG state), so a
+        checkpoint-resumed run replays the exact remaining batches of
+        this epoch and every following one (docs/CHECKPOINT.md)."""
+        return {"cursor": int(self.cursor),
+                "order": onp.asarray(self._order).copy(),
+                "idx": onp.asarray(self.idx).copy(),
+                "roll_over_idx": onp.asarray(self._roll_over_idx).copy(),
+                "epoch_size": int(self._epoch_size)}
+
+    def load_state_dict(self, state):
+        order = onp.asarray(state["order"])
+        if order.shape[0] > self.num_data + self.batch_size:
+            raise ValueError(
+                f"iterator state holds a {order.shape[0]}-element "
+                f"order for a dataset of {self.num_data}")
+        self._order = order
+        self.idx = onp.asarray(state.get("idx", order))
+        self._roll_over_idx = onp.asarray(state["roll_over_idx"])
+        self._epoch_size = int(state["epoch_size"])
+        self.cursor = int(state["cursor"])
+
 
 def _init_data(data, allow_empty, default_name):
     if data is None:

@@ -1,79 +1,73 @@
-"""Sharded (multi-host) checkpointing for mesh-parallel training.
+"""DEPRECATED shim — sharded checkpointing moved to
+:mod:`mxnet_tpu.checkpoint`.
 
-The reference's checkpoint story is single-host files
-(`save_checkpoint`/`load_checkpoint`, gluon save/load_parameters —
-SURVEY.md §5 "Checkpoint / resume"); its distributed recovery is
-"checkpoint + relaunch". This module keeps that recovery model but
-makes the checkpoint itself mesh-native: every process writes only its
-own parameter shards through Orbax/TensorStore, and restore places
-shards directly onto the target `jax.sharding.Mesh` — no gather to
-host 0, no full-model memory spike, works across pod slices.
+``save_sharded``/``load_sharded`` keep their signatures and on-restore
+placement semantics (mesh + ``(regex, PartitionSpec)`` rules), but now
+delegate to the checkpoint subsystem: shards + a manifest with an
+atomic ``COMMITTED`` marker, optimizer counters folded INTO the
+manifest (the old ``opt_counters.json`` sidecar — which silently
+dropped lr-scheduler state — is gone), and integrity verification on
+read. Directories written by the old Orbax wrapper (no
+``manifest.json``) are still restorable: ``load_sharded`` falls back
+to an Orbax/TensorStore read, including the legacy sidecar.
 
-API shape follows gluon (`save_parameters`/`load_parameters`), scaled
-up:
+Scope note: the new format is single-controller — ``save_sharded``
+host-gathers each array and writes from process 0 only (non-zero
+processes no-op), whereas the old Orbax path coordinated per-process
+shard writes. Multi-host jobs with non-addressable arrays should
+checkpoint through a future multi-host backend of
+``mxnet_tpu.checkpoint`` (the ``fs=`` seam), not this shim.
 
-    from mxnet_tpu import parallel
-    parallel.save_sharded(dir, net, step=trainstep)   # params+opt
-    parallel.load_sharded(dir, net, step=trainstep, mesh=mesh)
+New code should use
+``mxnet_tpu.checkpoint.CheckpointManager`` /
+``save_training_state``/``restore_training_state`` directly — those
+add async save, retention, corrupt fallback, and full training-state
+capture (docs/CHECKPOINT.md).
 """
 from __future__ import annotations
 
 import os
-
-import jax
+import warnings
 
 __all__ = ["save_sharded", "load_sharded"]
 
 
-def _checkpointer():
-    import orbax.checkpoint as ocp
-    return ocp.StandardCheckpointer()
+def _warn_deprecated(name):
+    warnings.warn(
+        f"parallel.{name} is deprecated; use mxnet_tpu.checkpoint "
+        "(CheckpointManager / save_training_state / "
+        "restore_training_state) instead", DeprecationWarning,
+        stacklevel=3)
 
 
-def _tree_for(net, step):
-    """params (+ optimizer states when a TrainStep is given) as a
-    plain pytree of raw jax arrays keyed by parameter name."""
-    params = {name: p.data()._data
-              for name, p in net.collect_params().items()}
-    tree = {"params": params}
-    if step is not None and getattr(step, "_opt_states", None) is not None:
-        tree["opt_states"] = jax.tree.map(
-            lambda x: x, tuple(step._opt_states))
-    return tree
+def save_sharded(directory, net, step=None, force=True):
+    """Write a committed checkpoint of ``net`` (and optionally the
+    optimizer states + counters of a ``TrainStep``) under
+    ``directory``. Deprecated: delegates to
+    ``mxnet_tpu.checkpoint.write_checkpoint``."""
+    import jax
+    from .. import checkpoint as ckpt
+    _warn_deprecated("save_sharded")
+    directory = os.path.abspath(directory)
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        # single-controller write: only process 0 touches the files
+        # (every process writing the same shard names would race); the
+        # old per-process Orbax coordination is out of the shim's scope
+        return directory
+    tree, meta = ckpt.capture_training_state(
+        net=net, train_step=step, include_rng=False)
+    ckpt.write_checkpoint(directory, ckpt.snapshot_tree(tree),
+                          metadata=meta)
+    return directory
 
 
-_COUNTERS_FILE = "opt_counters.json"
-
-
-def _save_opt_counters(directory, step):
-    """Persist the optimizer's step counters next to the shards.
-
-    Adam-family bias correction and lr_scheduler position both key off
-    `num_update`; restoring warm moments with t reset to ~1 inflates
-    the effective lr right after resume. Tiny host-side state, so a
-    JSON sidecar (process 0 only) rather than a sharded array.
-    """
+def _legacy_opt_counters(directory, step):
+    """Read the old wrapper's ``opt_counters.json`` sidecar (kept only
+    for restoring checkpoints written before the manifest subsumed
+    it)."""
     import json
     opt = getattr(step, "optimizer", None)
-    if opt is None or jax.process_index() != 0:
-        return
-    payload = {
-        "num_update": int(opt.num_update),
-        "begin_num_update": int(opt.begin_num_update),
-        "index_update_count": {
-            str(k): int(v) for k, v in opt._index_update_count.items()},
-    }
-    path = os.path.join(directory, _COUNTERS_FILE)
-    tmp = path + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(payload, f)
-    os.replace(tmp, path)  # atomic: never leave a truncated sidecar
-
-
-def _load_opt_counters(directory, step):
-    import json
-    opt = getattr(step, "optimizer", None)
-    path = os.path.join(directory, _COUNTERS_FILE)
+    path = os.path.join(directory, "opt_counters.json")
     if opt is None or not os.path.exists(path):
         return
     try:
@@ -84,82 +78,145 @@ def _load_opt_counters(directory, step):
         index_counts = {
             int(k): v for k, v in payload["index_update_count"].items()}
     except (ValueError, OSError, KeyError, TypeError, AttributeError) as e:
-        # counters are an optional extra — a damaged or foreign-format
-        # sidecar must not fail the restore of intact orbax shards
-        import warnings
-        warnings.warn(f"ignoring unreadable {_COUNTERS_FILE}: {e!r}")
+        warnings.warn(f"ignoring unreadable opt_counters.json: {e!r}")
         return
     opt.num_update = num_update
     opt.begin_num_update = begin
     opt._index_update_count = index_counts
 
 
-def save_sharded(directory, net, step=None, force=True):
-    """Write a sharded checkpoint of `net` (and optionally the
-    optimizer states of a `TrainStep`) under `directory`.
+def _load_legacy_orbax(directory, net, step, target_sharding):
+    """Restore a checkpoint directory written by the pre-subsystem
+    Orbax wrapper (identified by its missing ``manifest.json``):
+    rebuild the abstract tree from the live net/TrainStep the way the
+    old module did, let Orbax/TensorStore read each device's shards,
+    install, and apply the legacy ``opt_counters.json`` sidecar."""
+    import jax
 
-    Each process persists only the shards it owns; safe to call from
-    every process of a multi-host job (Orbax coordinates the commit).
-    """
-    directory = os.path.abspath(directory)
-    ckptr = _checkpointer()
-    ckptr.save(directory, _tree_for(net, step), force=force)
-    ckptr.wait_until_finished()
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as e:
+        from .. import checkpoint as ckpt
+        raise ckpt.CheckpointError(
+            f"{directory} has no manifest.json (a legacy Orbax "
+            f"checkpoint?) and orbax is not importable: {e!r}") from e
+
+    params = net.collect_params()
+    live = {name: p.data()._data for name, p in params.items()}
+
+    def _abstract(name, x):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=target_sharding(name, x))
+
+    abstract = {"params": {n: _abstract(n, x) for n, x in live.items()}}
+    if step is not None and \
+            getattr(step, "_opt_states", None) is not None:
+        abstract["opt_states"] = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            if hasattr(x, "shape") else x,
+            tuple(step._opt_states))
+
+    restored = ocp.StandardCheckpointer().restore(directory, abstract)
+    for name, val in restored["params"].items():
+        params[name].data()._install(val)
     if step is not None:
-        _save_opt_counters(directory, step)
-    return directory
+        if "opt_states" in restored:
+            step._opt_states = list(restored["opt_states"])
+        _legacy_opt_counters(directory, step)
+    return net
 
 
 def load_sharded(directory, net, step=None, mesh=None, rules=None):
-    """Restore a `save_sharded` checkpoint into `net` (and `step`).
-
-    `mesh` + `rules` (list of ``(regex, PartitionSpec)``) choose the
-    target placement; defaults to each array's current sharding, so a
-    train-resume on the same mesh needs no arguments. Restoring onto a
-    *different* mesh shape is supported: TensorStore reads exactly the
-    shards each device needs.
-    """
+    """Restore a ``save_sharded`` checkpoint into ``net`` (and
+    ``step``). ``mesh`` + ``rules`` (list of ``(regex,
+    PartitionSpec)``) choose the target placement; defaults to each
+    array's current sharding, so a train-resume on the same mesh needs
+    no arguments. Deprecated: delegates to
+    ``mxnet_tpu.checkpoint.read_checkpoint``; directories written by
+    the old Orbax wrapper (no ``manifest.json``) fall back to an
+    Orbax/TensorStore read."""
     import re
+    import jax
+    import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
+    from .. import checkpoint as ckpt
 
+    _warn_deprecated("load_sharded")
     directory = os.path.abspath(directory)
     compiled = [(re.compile(pat), spec) for pat, spec in (rules or [])]
 
-    def _target_sharding(name, arr):
+    def _target_sharding(name, live):
         if mesh is not None:
             for pat, spec in compiled:
                 if pat.search(name):
                     return NamedSharding(mesh, spec)
-            if getattr(arr, "sharding", None) is not None and \
-                    isinstance(arr.sharding, NamedSharding) and \
-                    arr.sharding.mesh.shape == mesh.shape:
-                return arr.sharding
+            sh = getattr(live, "sharding", None)
+            if isinstance(sh, NamedSharding) and \
+                    sh.mesh.shape == mesh.shape:
+                return sh
             return NamedSharding(mesh, P())
-        return getattr(arr, "sharding", None)
+        sh = getattr(live, "sharding", None)
+        return sh if isinstance(sh, NamedSharding) else None
 
-    live = _tree_for(net, step)
-
-    def _abstract(path_name, x):
-        sh = _target_sharding(path_name, x)
-        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
-
-    abstract = {"params": {
-        name: _abstract(name, x) for name, x in live["params"].items()}}
-    if "opt_states" in live:
-        abstract["opt_states"] = jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype, sharding=getattr(x, "sharding", None))
-            if hasattr(x, "shape") else x,
-            live["opt_states"])
-
-    ckptr = _checkpointer()
-    restored = ckptr.restore(directory, abstract)
+    if not os.path.exists(os.path.join(directory, ckpt.MANIFEST_FILE)):
+        # a directory written by the pre-subsystem Orbax wrapper has
+        # no manifest — restore it the way the old code did
+        return _load_legacy_orbax(directory, net, step,
+                                  _target_sharding)
+    tree, meta = ckpt.read_checkpoint(directory)
 
     params = net.collect_params()
-    for name, val in restored["params"].items():
-        params[name].data()._install(val)
-    if step is not None and "opt_states" in restored:
-        step._opt_states = list(restored["opt_states"])
+    for name, arr in tree.get("params", {}).items():
+        if name not in params:
+            warnings.warn(f"checkpoint parameter {name!r} not in net; "
+                          "skipped")
+            continue
+        p = params[name]
+        if p._data is None:
+            # deferred shape inference, no forward yet: the checkpoint
+            # shape finishes the init (set_data), then placement below
+            from ..numpy import array as _host_nd
+            p.set_data(_host_nd(arr))
+        live = p.data()._data
+        new = jnp.asarray(arr, live.dtype)
+        target = _target_sharding(name, live)
+        if target is not None:
+            new = jax.device_put(new, target)
+        p.data()._install(new)
+
     if step is not None:
-        _load_opt_counters(directory, step)
+        saved = tree.get("opt_states")
+        if saved is not None:
+            live = getattr(step, "_opt_states", None)
+
+            def _place(x, l):
+                if not isinstance(x, (jnp.ndarray,)) and \
+                        not hasattr(x, "shape"):
+                    return x
+                out = jnp.asarray(x)
+                sh = getattr(l, "sharding", None)
+                if isinstance(sh, NamedSharding):
+                    out = jax.device_put(out, sh)
+                return out
+
+            restored = []
+            for i, s in enumerate(saved):
+                l = live[i] if live is not None and i < len(live) \
+                    else None
+                try:
+                    restored.append(jax.tree_util.tree_map(_place, s, l)
+                                    if l is not None else
+                                    jax.tree_util.tree_map(
+                                        lambda x: _place(x, None), s))
+                except ValueError:
+                    restored.append(jax.tree_util.tree_map(
+                        lambda x: _place(x, None), s))
+            step._opt_states = restored
+        opt_meta = meta.get("optimizer")
+        if opt_meta is not None:
+            from ..checkpoint.state import _apply_optimizer_meta
+            _apply_optimizer_meta(step.optimizer, opt_meta)
+        else:
+            _legacy_opt_counters(directory, step)
     return net

@@ -51,6 +51,26 @@ def seed(seed_value: int):
     _state.trace_counter = 0
 
 
+def get_state():
+    """Host-side snapshot of the global generator: ``(key, counter)``
+    where ``key`` is the raw PRNG key data as host numpy (or ``None``
+    if the generator was never touched) — the piece the checkpoint
+    subsystem persists so a resumed run replays the exact key stream
+    (docs/CHECKPOINT.md)."""
+    import numpy as onp
+    key = _state.key
+    return (None if key is None else onp.asarray(key),
+            _state.trace_counter)
+
+
+def set_state(key, trace_counter: int = 0):
+    """Restore a :func:`get_state` snapshot (checkpoint resume)."""
+    import jax.numpy as jnp
+    _state.key = None if key is None \
+        else jnp.asarray(key, jnp.uint32)
+    _state.trace_counter = int(trace_counter)
+
+
 def next_key():
     """A fresh PRNG key; trace-aware (see module docstring)."""
     if _state.trace_key is not None:

@@ -312,6 +312,11 @@ class InferenceEngine:
         self.policy = policy.clamped(self.max_batch_size)
         self._sync = not _serving_enabled()
         self._lock = threading.Lock()
+        #: serializes load_weights against the batcher's forwards so a
+        #: rollover is batch-boundary atomic (a dispatched forward sees
+        #: all-old or all-new weights, never a mix); uncontended cost
+        #: is one lock op per BATCH, not per request
+        self._swap_lock = threading.Lock()
         self._closed = False
         self._tmpl = None  # (spec_string, ((trailing shape, dtype), ...))
         self._spec = None
@@ -382,6 +387,34 @@ class InferenceEngine:
         self._out_batched = [
             bool(a) and bool(b) and a[0] == w1 and b[0] == w2
             for a, b in zip(s1, s2)]
+
+    def load_weights(self, source, strict: bool = True):
+        """Zero-downtime weight rollover for the micro-batching
+        engine: swap the block's parameter buffers from a committed
+        checkpoint (a ``CheckpointManager`` root or one step
+        directory) or an in-memory ``{name: array}`` mapping, while
+        traffic is live.
+
+        The swap is batch-boundary atomic (``_swap_lock`` serializes
+        it against the batcher's forwards) and recompile-free: CachedOp
+        entries pass parameter buffers as runtime arguments, so
+        installing same-shape/dtype buffers changes no trace. Queued
+        requests are untouched; the first batch dispatched after the
+        swap runs the new weights."""
+        from .. import checkpoint as _ckpt
+        if self._closed:
+            raise EngineClosedError("load_weights on a closed engine")
+        if isinstance(source, dict):
+            new_params = source
+        else:
+            new_params, _meta = _ckpt.read_params(source)
+        t0 = telemetry.clock()
+        with self._swap_lock:
+            _ckpt.swap_param_buffers(self.block.collect_params(),
+                                     new_params, strict=strict)
+        telemetry.hist_since("serving.swap", t0)
+        telemetry.counter("serving.weight_swaps")
+        return self
 
     def close(self, timeout: float = 5.0):
         """Stop admission, drain the queue (dispatching what's
@@ -457,7 +490,11 @@ class InferenceEngine:
         future: Future = Future()
         if self._sync:  # MXTPU_SERVING=0: per-request dispatch
             try:
-                future.set_result(self.block(*args))
+                # same swap-atomicity contract as the batcher path: a
+                # forward racing load_weights sees all-old or all-new
+                with self._swap_lock:
+                    out = self.block(*args)
+                future.set_result(out)
             except Exception as e:  # noqa: BLE001 — deliver to caller
                 future.set_exception(e)
             return future
@@ -487,7 +524,8 @@ class InferenceEngine:
     # -- dispatch (batcher thread) -------------------------------------
     def _dispatch(self, batch):
         try:
-            self._dispatch_inner(batch)
+            with self._swap_lock:
+                self._dispatch_inner(batch)
         except Exception as e:  # noqa: BLE001 — fan the failure out
             telemetry.counter("serving.errors")
             for r in batch:

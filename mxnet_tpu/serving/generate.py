@@ -375,6 +375,44 @@ class GenerationEngine:
                 onp.zeros((self.max_slots,), "i4"), cache)
         return self
 
+    def load_weights(self, source, strict: bool = True):
+        """Zero-downtime weight rollover: swap the model's parameter
+        buffers from a committed checkpoint while traffic is live.
+
+        ``source`` is a checkpoint path (a ``CheckpointManager`` root —
+        latest committed step wins — or one step directory) or an
+        in-memory ``{name: array}`` mapping. The swap happens at a
+        decode-STEP boundary under ``_gen_lock``: in-flight slots keep
+        their KV cache and continue decoding (their next token simply
+        comes from the new weights), queued requests are untouched, and
+        nothing recompiles — the jitted prefill/decode closures take
+        parameter buffers as runtime arguments, so installing
+        same-shape/dtype buffers into the live parameter NDArrays
+        changes no trace (``model.gpt.trace`` stays flat; asserted in
+        tests). Sharded parameters keep their placement via
+        ``device_put`` onto the old buffer's sharding.
+
+        ``strict=True`` (default) requires the checkpoint names to
+        cover the model's parameters exactly; ``strict=False`` swaps
+        the intersection. Shape mismatches always raise — before any
+        buffer is touched, so a bad checkpoint can never leave the
+        model half-swapped."""
+        from .. import checkpoint as _ckpt
+        if self._closed:
+            raise EngineClosedError("load_weights on a closed engine")
+        if isinstance(source, dict):
+            new_params = source
+        else:
+            new_params, _meta = _ckpt.read_params(source)
+        t0 = telemetry.clock()
+        with self._gen_lock:  # step boundary: the worker is between
+            # decode steps, warmup is not tracing
+            _ckpt.swap_param_buffers(self.model.collect_params(),
+                                     new_params, strict=strict)
+        telemetry.hist_since("serving.generate.swap", t0)
+        telemetry.counter("serving.generate.weight_swaps")
+        return self
+
     def close(self, timeout: float = 5.0):
         """Stop admission, finish ACTIVE generations and drain the
         queue under ``timeout``; past the deadline queued requests are

@@ -47,6 +47,7 @@ import shutil
 import subprocess
 import sys
 import tempfile
+import threading
 import time
 
 _START = time.monotonic()  # process start — the parent's watchdog t0
@@ -825,18 +826,11 @@ def _trainer_path_main():
     for rep in range(reps):
         for name in ("perparam", "fused"):
             _stage(f"trainer-path: {name} config (rep {rep + 1}/{reps})")
-            env = dict(os.environ, BENCH_TRAINER_CONFIG=name,
-                       JAX_PLATFORMS="cpu")
-            out = subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--trainer-path"],
-                env=env, capture_output=True, text=True, timeout=300)
-            if out.returncode != 0:
-                print(f"[bench] trainer-path {name} failed: "
-                      f"{out.stderr.strip()[-400:]}", file=sys.stderr,
-                      flush=True)
+            r = _ab_child("--trainer-path",
+                          dict(BENCH_TRAINER_CONFIG=name), timeout=300,
+                          label=f"trainer-path {name}")
+            if r is None:
                 return 1
-            r = json.loads(_harvest(out.stdout))
             best = results.get(name)
             if best is None or r["steps_per_sec"] > best["steps_per_sec"]:
                 results[name] = r
@@ -967,6 +961,67 @@ def _serving_feed(arrivals, emit, t0=None):
             time.sleep(min(lag, 0.001))
         emit(i)
     return t0
+
+
+def _ab_child(flag, env_overrides, timeout=600, label=None):
+    """Run ONE config of a subprocess-isolated A/B bench: fresh
+    process (one backend init per measurement — JIT dispatch caches,
+    engine tracking, and XLA thread pools from config A must not
+    contaminate config B; they swing a 1-2 vCPU box by 2-3x), pinned
+    to CPU, JSON line harvested from stdout. Returns the parsed dict,
+    or None after printing the child's stderr tail. Shared by
+    --serving / --generate / --checkpoint / --trainer-path / --router
+    (it used to exist as near-copies in each)."""
+    label = label or f"{flag} [{' '.join(map(str, env_overrides.values()))}]"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               **{k: str(v) for k, v in env_overrides.items()})
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        err = e.stderr
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        print(f"[bench] {label} timed out after {timeout}s: "
+              f"{(err or '').strip()[-400:]}", file=sys.stderr, flush=True)
+        return None
+    if out.returncode != 0:
+        print(f"[bench] {label} failed: {out.stderr.strip()[-400:]}",
+              file=sys.stderr, flush=True)
+        return None
+    line = _harvest(out.stdout)
+    if line is None:
+        print(f"[bench] {label} produced no JSON line", file=sys.stderr,
+              flush=True)
+        return None
+    return json.loads(line)
+
+
+class _BoxedThread(threading.Thread):
+    """Bench worker thread with an exception box: a dead or stuck
+    worker fails the bench loudly instead of letting it publish a
+    partial/bogus number (the --generate static-config lesson, now
+    shared by every harness that needs a side thread)."""
+
+    def __init__(self, target, name="bench-worker"):
+        super().__init__(daemon=True, name=name)
+        self._fn = target
+        self.error = None
+
+    def run(self):
+        try:
+            self._fn()
+        except BaseException as e:  # noqa: BLE001 — boxed for the join
+            self.error = e
+
+    def join_or_raise(self, timeout):
+        self.join(timeout=timeout)
+        if self.error is not None:
+            raise RuntimeError(f"{self.name} died") from self.error
+        if self.is_alive():
+            raise RuntimeError(
+                f"{self.name} stuck past the {timeout}s deadline")
 
 
 def _serving_perreq(rate_rps):
@@ -1103,17 +1158,9 @@ def _serving_main():
         return _serving_child()
 
     def run_child(cfg, extra_env=None):
-        env = dict(os.environ, BENCH_SERVING_CONFIG=cfg,
-                   JAX_PLATFORMS="cpu", **(extra_env or {}))
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--serving"],
-            env=env, capture_output=True, text=True, timeout=600)
-        if out.returncode != 0:
-            print(f"[bench] serving {cfg} failed: "
-                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
-                  flush=True)
-            return None
-        return json.loads(_harvest(out.stdout))
+        return _ab_child("--serving",
+                         dict(BENCH_SERVING_CONFIG=cfg, **(extra_env or {})),
+                         label=f"serving {cfg}")
 
     _stage("serving: calibration")
     calib = run_child("calib")
@@ -1316,7 +1363,6 @@ def _gen_static_batch(net, policy, cache, batch, ttft, t0):
 def _gen_static(rate_rps):
     """Whole-batch baseline under the open-loop arrival stream."""
     import queue as pyqueue
-    import threading
     import numpy as onp
     from mxnet_tpu import telemetry
 
@@ -1340,46 +1386,34 @@ def _gen_static(rate_rps):
     telemetry.reset()
     t0_box = [0.0]
 
-    worker_err = [None]
-
     def worker():
         nonlocal cache
-        try:
-            served = 0
-            while served < GEN_REQS:
-                batch_ids = [q.get()]
-                while len(batch_ids) < GEN_SLOTS:
-                    try:
-                        batch_ids.append(q.get_nowait())
-                    except pyqueue.Empty:
-                        break
-                batch = [reqs[i] for i in batch_ids]
-                bt = [0.0] * len(batch)
-                cache, tok, stp = _gen_static_batch(
-                    net, policy, cache, batch, bt, t0_box[0])
-                now = time.perf_counter()
-                for j, i in enumerate(batch_ids):
-                    ttft[i] = (bt[j] - arrivals[i]) * 1e3
-                    done_t[i] = now
-                n_tokens[0] += tok
-                n_steps[0] += stp
-                served += len(batch)
-        except BaseException as e:  # noqa: BLE001 — a dead worker must
-            # fail the bench loudly, not publish a bogus A/B number
-            worker_err[0] = e
+        served = 0
+        while served < GEN_REQS:
+            batch_ids = [q.get()]
+            while len(batch_ids) < GEN_SLOTS:
+                try:
+                    batch_ids.append(q.get_nowait())
+                except pyqueue.Empty:
+                    break
+            batch = [reqs[i] for i in batch_ids]
+            bt = [0.0] * len(batch)
+            cache, tok, stp = _gen_static_batch(
+                net, policy, cache, batch, bt, t0_box[0])
+            now = time.perf_counter()
+            for j, i in enumerate(batch_ids):
+                ttft[i] = (bt[j] - arrivals[i]) * 1e3
+                done_t[i] = now
+            n_tokens[0] += tok
+            n_steps[0] += stp
+            served += len(batch)
 
-    th = threading.Thread(target=worker, daemon=True)
+    th = _BoxedThread(worker, name="static generation worker")
     th.start()
     t0_box[0] = time.perf_counter()
     # feeder shares t0 with the worker's reference clock
     _serving_feed(arrivals, q.put, t0=t0_box[0])
-    th.join(timeout=600)
-    if worker_err[0] is not None:
-        raise RuntimeError("static generation worker died") \
-            from worker_err[0]
-    if th.is_alive():
-        raise RuntimeError("static generation worker stuck past the "
-                           "600s deadline")
+    th.join_or_raise(timeout=600)
     snap = telemetry.snapshot()
     makespan = max(done_t) - (t0_box[0] + arrivals[0])
     return {
@@ -1480,17 +1514,9 @@ def _generate_main():
         return _gen_child()
 
     def run_child(cfg, extra_env=None):
-        env = dict(os.environ, BENCH_GEN_CONFIG=cfg,
-                   JAX_PLATFORMS="cpu", **(extra_env or {}))
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--generate"],
-            env=env, capture_output=True, text=True, timeout=600)
-        if out.returncode != 0:
-            print(f"[bench] generate {cfg} failed: "
-                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
-                  flush=True)
-            return None
-        return json.loads(_harvest(out.stdout))
+        return _ab_child("--generate",
+                         dict(BENCH_GEN_CONFIG=cfg, **(extra_env or {})),
+                         label=f"generate {cfg}")
 
     _stage("generate: calibration")
     calib = run_child("calib")
@@ -1742,17 +1768,8 @@ def _checkpoint_main():
         return _ckpt_child()
 
     def run_child(cfg):
-        env = dict(os.environ, BENCH_CKPT_CONFIG=cfg,
-                   JAX_PLATFORMS="cpu")
-        out = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--checkpoint"],
-            env=env, capture_output=True, text=True, timeout=300)
-        if out.returncode != 0:
-            print(f"[bench] checkpoint {cfg} failed: "
-                  f"{out.stderr.strip()[-400:]}", file=sys.stderr,
-                  flush=True)
-            return None
-        return json.loads(_harvest(out.stdout))
+        return _ab_child("--checkpoint", dict(BENCH_CKPT_CONFIG=cfg),
+                         timeout=300, label=f"checkpoint {cfg}")
 
     # interleaved best-of-N per config (least-contended rep wins — the
     # --trainer-path lesson: a loaded 1-2 vCPU box swings singles 2x)
@@ -1798,7 +1815,458 @@ def _checkpoint_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --router: fault-tolerant serving-fleet benchmark (CPU-runnable,
+# <3 min). Open-loop Poisson prompt traffic over a Router of
+# ROUTER_REPLICAS GenerationEngine replicas, two chaos configs, each
+# subprocess-isolated:
+#
+#   chaos:    a deterministic FaultInjector kill of replica 0 at the
+#             ROUTER_KILL_AT_FRAC point of the arrival schedule —
+#             measures request success rate (cross-replica retries
+#             must absorb the failure), goodput before/after the
+#             kill, completion-latency p99, recovery time, and
+#             token-identity of every retried request vs the
+#             single-request reference loop
+#   rollover: fleet-wide rolling load_weights (drain-swap-restore,
+#             one replica at a time) under live traffic — measures
+#             dropped requests (must be 0), swaps applied, and
+#             post-rollover token correctness against the new weights
+#
+# The offered rate is ROUTER_LOAD_FRAC of the measured fleet token
+# capacity (calibration child): the bench proves fault ABSORPTION,
+# not saturation — a saturated fleet must shed by design, and
+# shedding would mask what retries absorb. Results (schema-checked)
+# -> BENCH_r11.json.
+# ---------------------------------------------------------------------------
+ROUTER_REPLICAS = 3
+ROUTER_SLOTS = 4
+ROUTER_VOCAB, ROUTER_UNITS, ROUTER_LAYERS, ROUTER_HEADS = 128, 32, 2, 4
+ROUTER_SMAX = 64
+ROUTER_REQS = int(os.environ.get("BENCH_ROUTER_REQS", "320"))
+ROUTER_KILL_AT_FRAC = 0.4
+ROUTER_LOAD_FRAC = 0.5
+
+
+def _router_net(seed=0):
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(seed)
+    net = GPTModel(vocab_size=ROUTER_VOCAB, units=ROUTER_UNITS,
+                   num_layers=ROUTER_LAYERS, num_heads=ROUTER_HEADS,
+                   max_length=ROUTER_SMAX)
+    net.initialize(mx.init.Xavier())
+    net(mx.np.array(onp.zeros((1, 4), "i4")))  # materialize params
+    return net
+
+
+def _router_params(net):
+    import numpy as onp
+    return {k: onp.asarray(p.data()._data)
+            for k, p in net.collect_params().items()}
+
+
+def _router_fleet(params, n=ROUTER_REPLICAS):
+    from mxnet_tpu.serving import GenerationEngine
+    engines = []
+    for _ in range(n):
+        eng = GenerationEngine(
+            _router_net(), max_slots=ROUTER_SLOTS,
+            max_length=ROUTER_SMAX, max_new_tokens=8,
+            queue_limit=ROUTER_REQS + 16)
+        eng.load_weights(params)  # identical weights fleet-wide:
+        engines.append(eng)       # retry token-identity depends on it
+    return engines
+
+
+def _router_workload():
+    """(prompt, max_new) mix, fixed seed — heavy-tailed budgets (the
+    production LLM shape), identical for every config."""
+    import numpy as onp
+    rng = onp.random.RandomState(46)
+    reqs = []
+    for _ in range(ROUTER_REQS):
+        n = int(rng.randint(4, 13))
+        max_new = int(rng.randint(24, 41)) if rng.rand() < 0.15 \
+            else int(rng.randint(4, 11))
+        reqs.append((rng.randint(0, ROUTER_VOCAB, size=n).astype("i4"),
+                     max_new))
+    return reqs
+
+
+def _router_arrivals(rate_rps):
+    import numpy as onp
+    rng = onp.random.RandomState(47)
+    return rng.exponential(1.0 / rate_rps, ROUTER_REQS).cumsum()
+
+
+def _router_ref_generate(net, policy, prompt, max_new):
+    """Single-request greedy loop at the fleet's slot width — what a
+    retried request must match token for token."""
+    import numpy as onp
+    cache = net.init_cache(ROUTER_SLOTS, ROUTER_SMAX)
+    n = len(prompt)
+    sb = policy.bucket(n)
+    padded = onp.zeros((1, sb), "i4")
+    padded[0, :n] = prompt
+    logits, cache = net.prefill(padded, [n], cache, slots=[0])
+    toks = [int(onp.asarray(logits)[0].argmax())]
+    n_ctx = n
+    while len(toks) < max_new and n_ctx < ROUTER_SMAX:
+        step = onp.zeros((ROUTER_SLOTS,), "i4")
+        step[0] = toks[-1]
+        lg, cache = net.decode_step(step, cache)
+        toks.append(int(onp.asarray(lg)[0].argmax()))
+        n_ctx += 1
+    return toks
+
+
+def _router_prime(router, n=8):
+    import numpy as onp
+    rng = onp.random.RandomState(5)
+    waves = [router.submit(rng.randint(0, ROUTER_VOCAB, 6).astype("i4"),
+                           max_new_tokens=4) for _ in range(n)]
+    for s in waves:
+        s.result(timeout=600)
+
+
+def _router_calibrate():
+    """FLEET generated tokens/sec through the actual Router (replica
+    worker threads, prober, dispatch path — the GIL contention a
+    single-engine number misses by ~5x on this box), closed-loop burst.
+    The chaos/rollover offered rate is ROUTER_LOAD_FRAC of this."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import Router
+    params = _router_params(_router_net())
+    router = Router(_router_fleet(params), probe_interval_s=0.1,
+                    queue_limit=ROUTER_REQS * 2)
+    router.warmup()
+    _router_prime(router)
+    reqs = _router_workload()
+    telemetry.reset()
+    t0 = time.perf_counter()
+    for s in [router.submit(p, max_new_tokens=m) for p, m in reqs[:48]]:
+        s.result(timeout=600)
+    dt = time.perf_counter() - t0
+    tokens = telemetry.counter_value("serving.generate.tokens")
+    router.close()
+    mean_tokens = sum(m for _, m in reqs) / len(reqs)
+    print(json.dumps({
+        "fleet_tokens_per_sec": round(tokens / dt, 1),
+        "mean_tokens_per_req": round(mean_tokens, 2)}), flush=True)
+    return 0
+
+
+def _router_goodput_series(done, t0, bin_s=0.5):
+    """Completed-token counts per ``bin_s`` window: [(t_rel, tokens)]."""
+    series = {}
+    for done_at, n_tok in done:
+        b = int((done_at - t0) / bin_s)
+        series[b] = series.get(b, 0) + n_tok
+    return {b * bin_s: n for b, n in sorted(series.items())}
+
+
+def _router_chaos(rate_rps):
+    import numpy as onp
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import FaultInjector, FaultRule, Router
+
+    net = _router_net()
+    params = _router_params(net)
+    engines = _router_fleet(params)
+    # deterministic mid-window kill: fire on replica 0's Nth DISPATCH
+    # (≈ the ROUTER_KILL_AT_FRAC point under JSQ's even spread) — the
+    # replica dies while work is being routed to it, so the kill
+    # provably lands on live traffic (a wall-clock kill can hit an
+    # idle instant at moderate load and absorb nothing)
+    kill_at = int(ROUTER_REQS * ROUTER_KILL_AT_FRAC)
+    kill_disp = max(8, kill_at // ROUTER_REPLICAS)
+    injector = FaultInjector(
+        rules=[FaultRule("crash", replica=0, after_n=kill_disp)])
+    router = Router(engines, max_retries=3, breaker_threshold=3,
+                    breaker_cooldown_s=1.0, probe_interval_s=0.1,
+                    queue_limit=ROUTER_REQS * 2,
+                    fault_injector=injector)
+    router.warmup()
+    _router_prime(router)
+    reqs = _router_workload()
+    arrivals = _router_arrivals(rate_rps)
+    streams = [None] * ROUTER_REQS
+    submit_errs = []
+    t_crash = [0.0]
+    telemetry.reset()
+
+    def emit(i):
+        try:
+            streams[i] = router.submit(reqs[i][0],
+                                       max_new_tokens=reqs[i][1])
+        except Exception as e:  # noqa: BLE001 — a shed/failed submit is
+            submit_errs.append((i, type(e).__name__))  # an outcome, not
+            # a bench crash: it counts against the success rate
+        if not t_crash[0] and engines[0]._failure is not None:
+            t_crash[0] = time.perf_counter()  # ≤1 arrival of lag
+
+    t0 = _serving_feed(arrivals, emit)
+    if not t_crash[0]:
+        raise RuntimeError(
+            f"injected crash never fired (replica 0 saw "
+            f"{injector.dispatches(0)} < {kill_disp} dispatches)")
+    ok = fail = 0
+    retried = []
+    lat_ms = []
+    done = []  # (done_at, token_count) for the goodput series
+    for i, s in enumerate(streams):
+        if s is None:
+            fail += 1
+            continue
+        try:
+            r = s.result(timeout=600)
+        except Exception:  # noqa: BLE001 — failed request
+            fail += 1
+            continue
+        if r.finish_reason in ("length", "eos"):
+            ok += 1
+            lat_ms.append((s.done_at - (t0 + arrivals[i])) * 1e3)
+            done.append((s.done_at, len(r.tokens)))
+            if s.retries:
+                retried.append(i)
+        else:
+            fail += 1
+    # retried requests must be token-identical to the unfailed path
+    policy = engines[1].policy
+    retry_identical = all(
+        streams[i].result().tokens == _router_ref_generate(
+            net, policy, reqs[i][0], reqs[i][1])
+        for i in retried)
+    series = _router_goodput_series(done, t0)
+    t_kill_rel = t_crash[0] - t0
+    t_last = float(arrivals[-1])
+    # goodput windows live inside the arrival window: the post-feed
+    # drain tail would otherwise drag the post-kill average down
+    pre = [v for t, v in series.items() if t + 0.5 <= t_kill_rel]
+    post = [v for t, v in series.items()
+            if t_kill_rel + 1.0 <= t and t + 0.5 <= t_last]
+    # recovery: first 0.5s window at/after the kill back above HALF
+    # the pre-kill median goodput (the survivors carry ~50%-of-capacity
+    # load; a full-median threshold is too noisy at 0.5s bins to be a
+    # stable recovery signal)
+    pre_median = sorted(pre)[len(pre) // 2] if pre else 0
+    recovery_s = None
+    for t, v in series.items():
+        if t + 0.5 > t_kill_rel and v >= 0.5 * pre_median:
+            recovery_s = round(max(0.0, t + 0.5 - t_kill_rel), 2)
+            break
+    gaps = sorted(d for d, _ in done)
+    post_kill_gaps = [b - a for a, b in zip(gaps, gaps[1:])
+                      if b > t_crash[0]]
+    snap = telemetry.snapshot()
+    health = router.health()
+    router.close()
+    a = onp.asarray(lat_ms)
+    return {
+        "mode": "chaos",
+        "requests": ROUTER_REQS,
+        "replicas": ROUTER_REPLICAS,
+        "slots_per_replica": ROUTER_SLOTS,
+        "killed_replica": 0,
+        "kill_at_replica_dispatch": kill_disp,
+        "kill_at_s_into_window": round(t_kill_rel, 2),
+        "succeeded": ok,
+        "failed": fail + len(submit_errs),
+        "submit_errors": len(submit_errs),
+        "success_rate": round(ok / ROUTER_REQS, 4),
+        "retried_requests": len(retried),
+        "retries": int(snap["counters"].get("serving.router.retries", 0)),
+        "retry_token_identical": bool(retry_identical),
+        "latency_p50_ms": round(float(onp.percentile(a, 50)), 1),
+        "latency_p99_ms": round(float(onp.percentile(a, 99)), 1),
+        "goodput_tokens_per_sec_pre_kill": round(
+            sum(pre) / (len(pre) * 0.5), 1) if pre else None,
+        "goodput_tokens_per_sec_post_kill": round(
+            sum(post) / (len(post) * 0.5), 1) if post else None,
+        "recovery_s": recovery_s,
+        "max_completion_gap_after_kill_s": round(
+            max(post_kill_gaps), 3) if post_kill_gaps else None,
+        "killed_replica_state": health[0]["state"],
+        "survivor_states": [health[i]["state"]
+                            for i in range(1, ROUTER_REPLICAS)],
+        "fail_open_dispatches": int(
+            snap["counters"].get("serving.router.fail_open", 0)),
+    }
+
+
+def _router_rollover(rate_rps):
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import Router
+
+    net = _router_net()
+    params = _router_params(net)
+    net_b = _router_net(seed=1)          # the "new build" weights
+    params_b = _router_params(net_b)
+    engines = _router_fleet(params)
+    router = Router(engines, max_retries=3, probe_interval_s=0.1,
+                    queue_limit=ROUTER_REQS * 2)
+    router.warmup()
+    _router_prime(router)
+    reqs = _router_workload()
+    arrivals = _router_arrivals(rate_rps)
+    start_at = int(ROUTER_REQS * ROUTER_KILL_AT_FRAC)
+    streams = [None] * ROUTER_REQS
+    swap_info = {}
+
+    def roll():
+        swap_info["swapped"] = router.load_weights(params_b,
+                                                   drain_timeout_s=60.0)
+        # stamped HERE: the drain of the traffic window below is not
+        # part of the rollover's duration
+        swap_info["t_end"] = time.perf_counter()
+
+    roller = _BoxedThread(roll, name="rolling rollover")
+    telemetry.reset()
+
+    def emit(i):
+        if i == start_at:
+            swap_info["t_start"] = time.perf_counter()
+            roller.start()
+        streams[i] = router.submit(reqs[i][0], max_new_tokens=reqs[i][1])
+
+    _serving_feed(arrivals, emit)
+    dropped = 0
+    for s in streams:
+        try:
+            if s.result(timeout=600).finish_reason not in ("length",
+                                                           "eos"):
+                dropped += 1
+        except Exception:  # noqa: BLE001 — a dropped request
+            dropped += 1
+    roller.join_or_raise(timeout=600)
+    rollover_s = swap_info["t_end"] - swap_info["t_start"]
+    # post-rollover traffic must run the NEW weights on every replica
+    policy = engines[0].policy
+    import numpy as onp
+    rng = onp.random.RandomState(6)
+    post_ok = True
+    for _ in range(2 * ROUTER_REPLICAS):  # JSQ covers the fleet
+        p = rng.randint(0, ROUTER_VOCAB, 6).astype("i4")
+        r = router.generate(p, max_new_tokens=5, timeout=600)
+        if r.tokens != _router_ref_generate(net_b, policy, p, 5):
+            post_ok = False
+    snap = telemetry.snapshot()
+    router.close()
+    return {
+        "mode": "rollover",
+        "requests": ROUTER_REQS,
+        "replicas": ROUTER_REPLICAS,
+        "dropped": dropped,
+        "success_rate": round(
+            (ROUTER_REQS - dropped) / ROUTER_REQS, 4),
+        "weight_swaps": int(snap["counters"].get(
+            "serving.generate.weight_swaps", 0)),
+        "replicas_swapped": int(swap_info.get("swapped", 0)),
+        "rollover_duration_s": round(rollover_s, 2),
+        "post_rollover_tokens_match_new_weights": bool(post_ok),
+    }
+
+
+def _router_check_schema(doc):
+    """BENCH_r11.json contract — fail the bench rather than publish a
+    malformed document."""
+    required = {
+        "metric": str, "value": float, "unit": str, "model": str,
+        "replicas": int, "chaos": dict, "rollover": dict,
+        "chaos_success_ge_99pct": bool, "retry_token_identical": bool,
+        "zero_dropped_during_rollover": bool,
+    }
+    for key, typ in required.items():
+        if key not in doc:
+            raise ValueError(f"BENCH_r11 schema: missing key {key!r}")
+        if not isinstance(doc[key], typ):
+            raise ValueError(
+                f"BENCH_r11 schema: {key!r} is "
+                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
+    for key in ("success_rate", "retries", "latency_p99_ms",
+                "goodput_tokens_per_sec_pre_kill",
+                "goodput_tokens_per_sec_post_kill", "recovery_s",
+                "killed_replica_state"):
+        if key not in doc["chaos"]:
+            raise ValueError(f"BENCH_r11 schema: missing chaos.{key}")
+    for key in ("dropped", "weight_swaps", "replicas_swapped",
+                "post_rollover_tokens_match_new_weights"):
+        if key not in doc["rollover"]:
+            raise ValueError(f"BENCH_r11 schema: missing rollover.{key}")
+    return doc
+
+
+def _router_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_ROUTER_CONFIG"]
+    if cfg == "calib":
+        return _router_calibrate()
+    rate = float(os.environ["BENCH_ROUTER_RATE"])
+    result = _router_chaos(rate) if cfg == "chaos" \
+        else _router_rollover(rate)
+    print(json.dumps(result), flush=True)
+    return 0
+
+
+def _router_main():
+    if os.environ.get("BENCH_ROUTER_CONFIG"):
+        return _router_child()
+
+    _stage("router: calibration")
+    calib = _ab_child("--router", dict(BENCH_ROUTER_CONFIG="calib"),
+                      label="router calib")
+    if calib is None:
+        return 1
+    rate = (ROUTER_LOAD_FRAC * calib["fleet_tokens_per_sec"]
+            / calib["mean_tokens_per_req"])
+    results = {}
+    for cfg in ("chaos", "rollover"):
+        _stage(f"router: {cfg} config")
+        results[cfg] = _ab_child(
+            "--router", dict(BENCH_ROUTER_CONFIG=cfg,
+                             BENCH_ROUTER_RATE=rate),
+            label=f"router {cfg}")
+        if results[cfg] is None:
+            return 1
+    chaos, rollover = results["chaos"], results["rollover"]
+    doc = _router_check_schema({
+        "metric": "router_chaos_success_rate",
+        "value": float(chaos["success_rate"]),
+        "unit": "fraction of requests served with one replica killed "
+                "mid-window",
+        "model": f"gpt {ROUTER_LAYERS}L-{ROUTER_UNITS}u-"
+                 f"{ROUTER_HEADS}h vocab={ROUTER_VOCAB} "
+                 f"s_max={ROUTER_SMAX}",
+        "replicas": ROUTER_REPLICAS,
+        "slots_per_replica": ROUTER_SLOTS,
+        "requests": ROUTER_REQS,
+        "offered_rate_rps": round(rate, 2),
+        "offered_load_frac_of_capacity": ROUTER_LOAD_FRAC,
+        "arrival_process": "poisson (seed 47, identical per config); "
+                           "prompt 4-12, heavy-tailed budget (85% "
+                           "4-10, 15% 24-40; seed 46)",
+        "calibration": calib,
+        "chaos": chaos,
+        "rollover": rollover,
+        "chaos_success_ge_99pct": bool(chaos["success_rate"] >= 0.99),
+        "retry_token_identical": bool(chaos["retry_token_identical"]),
+        "zero_dropped_during_rollover": bool(rollover["dropped"] == 0),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_ROUTER_OUT",
+                                           "BENCH_r11.json"))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    return 0
+
+
 def main():
+    if "--router" in sys.argv:
+        return _router_main()
     if "--checkpoint" in sys.argv:
         return _checkpoint_main()
     if "--generate" in sys.argv:

@@ -5,18 +5,31 @@ requests onto one AOT-warmed CachedOp forward per dispatch — dynamic
 micro-batching with bounded queueing delay, admission control, and
 graceful shutdown. `GenerationEngine` is its autoregressive sibling:
 slot-based continuous batching over one fixed-shape KV-cache decode
-step (generate.py). See docs/SERVING.md for knobs and operational
-guidance, ``bench.py --serving`` / ``--generate`` (BENCH_r08/r09.json)
-for the measured A/Bs.
+step (generate.py). `Router` fronts N engine replicas as ONE
+fault-tolerant fleet: join-shortest-queue balancing, per-replica
+health/circuit-breaker state, cross-replica retry, per-tenant quotas,
+priority load shedding, and rolling zero-downtime weight rollover
+(router.py); `FaultInjector` (faults.py) is the deterministic
+chaos-injection seam that proves all of it. See docs/SERVING.md for
+knobs and operational guidance, ``bench.py --serving`` / ``--generate``
+/ ``--router`` (BENCH_r08/r09/r11.json) for the measured A/Bs.
 """
 from .engine import (  # noqa: F401
     InferenceEngine, ServingError, EngineClosedError, QueueFullError,
-    RequestTimeoutError,
+    RequestTimeoutError, ReplicaFailedError,
 )
 from .generate import (  # noqa: F401
     GenerationEngine, GenerationStream, GenerationResult,
 )
+from .faults import FaultInjector, FaultRule, InjectedFault  # noqa: F401
+from .router import (  # noqa: F401
+    Router, RouterStream, LoadShedError, TenantQuotaError,
+    HEALTHY, DEGRADED, DOWN,
+)
 
 __all__ = ["InferenceEngine", "ServingError", "EngineClosedError",
-           "QueueFullError", "RequestTimeoutError",
-           "GenerationEngine", "GenerationStream", "GenerationResult"]
+           "QueueFullError", "RequestTimeoutError", "ReplicaFailedError",
+           "GenerationEngine", "GenerationStream", "GenerationResult",
+           "Router", "RouterStream", "LoadShedError", "TenantQuotaError",
+           "FaultInjector", "FaultRule", "InjectedFault",
+           "HEALTHY", "DEGRADED", "DOWN"]

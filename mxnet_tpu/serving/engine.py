@@ -64,7 +64,7 @@ from ..bucketing import BucketingPolicy, as_policy, pad_leaves
 from ..ndarray.ndarray import NDArray
 
 __all__ = ["InferenceEngine", "ServingError", "EngineClosedError",
-           "QueueFullError", "RequestTimeoutError"]
+           "QueueFullError", "RequestTimeoutError", "ReplicaFailedError"]
 
 
 class ServingError(RuntimeError):
@@ -73,6 +73,18 @@ class ServingError(RuntimeError):
 
 class EngineClosedError(ServingError):
     """The engine was closed before (or while) the request was queued."""
+
+
+class ReplicaFailedError(EngineClosedError):
+    """The engine's worker/batcher thread DIED from an unexpected error
+    — the replica is broken, which is categorically different from a
+    deliberate ``close()``: a router (or caller) may safely retry the
+    request on another replica, whereas a closed engine means shutdown.
+    ``cause`` carries the original exception."""
+
+    def __init__(self, msg, cause=None):
+        super().__init__(msg)
+        self.cause = cause
 
 
 class QueueFullError(ServingError):
@@ -116,9 +128,31 @@ class _Batcher(BoundedQueueWorker):
         self._window_s = engine.max_queue_ms / 1e3
         self._draining = False
         self._carry = None
+        self._inhand = None
         self.start()
 
     def run(self):
+        try:
+            self._run()
+        except Exception as e:  # noqa: BLE001 — a dead batcher must not
+            # strand queued futures: mark the engine FAILED (so later
+            # submits see ReplicaFailedError, not a plain closed), and
+            # reject everything queued, in hand, or carried
+            telemetry.counter("serving.errors")
+            engine = self._engine()
+            if engine is not None:
+                engine._fail_all(e)
+                failure = engine._failure
+            else:
+                failure = ReplicaFailedError(
+                    f"inference batcher died: {type(e).__name__}: {e}",
+                    cause=e)
+            inhand, self._inhand = self._inhand, None
+            carry, self._carry = self._carry, None
+            for r in (inhand or []) + ([carry] if carry else []):
+                _reject(r.future, failure)
+
+    def _run(self):
         while True:
             batch = self._collect()
             if batch is None:
@@ -129,7 +163,12 @@ class _Batcher(BoundedQueueWorker):
                     _reject(r.future, EngineClosedError(
                         "engine was garbage-collected"))
                 return
+            # _inhand makes the batch reachable from the crash handler:
+            # a popped-but-undispatched batch must be rejected, never
+            # silently dropped with hung waiters
+            self._inhand = batch
             engine._dispatch(batch)
+            self._inhand = None
 
     # -- coalescing ----------------------------------------------------
     def _expired(self, req) -> bool:
@@ -318,6 +357,10 @@ class InferenceEngine:
         #: is one lock op per BATCH, not per request
         self._swap_lock = threading.Lock()
         self._closed = False
+        #: set (to a ReplicaFailedError) when the batcher thread died
+        #: from an unexpected error — distinguishes a broken replica
+        #: (retryable elsewhere) from a deliberate close()
+        self._failure: ReplicaFailedError | None = None
         self._tmpl = None  # (spec_string, ((trailing shape, dtype), ...))
         self._spec = None
         # per-output-leaf "tracks the batch dim" mask, resolved
@@ -476,6 +519,10 @@ class InferenceEngine:
         of the coalesced forward). Raises :class:`EngineClosedError` /
         :class:`QueueFullError` / ``ValueError`` immediately instead
         of returning a future that can never complete."""
+        if self._failure is not None:
+            telemetry.counter("serving.rejected_closed")
+            raise ReplicaFailedError(str(self._failure),
+                                     cause=self._failure.cause)
         if self._closed:
             telemetry.counter("serving.rejected_closed")
             raise EngineClosedError("submit on a closed engine")
@@ -510,7 +557,12 @@ class InferenceEngine:
                 f"request queue at queue_limit={self.queue_limit}") \
                 from None
         telemetry.gauge("serving.queue.depth", self._batcher._queue.qsize())
-        if self._closed:
+        if self._failure is not None:
+            # the batcher died while the request was being queued: its
+            # drain may have missed this request — reject it ourselves
+            _reject(future, ReplicaFailedError(str(self._failure),
+                                               cause=self._failure.cause))
+        elif self._closed:
             # close() raced the put: its drain may already have missed
             # this request, so reject it ourselves (no-op if dispatched)
             _reject(future, EngineClosedError(
@@ -520,6 +572,31 @@ class InferenceEngine:
     def predict(self, *args, timeout: float | None = None):
         """Blocking convenience: ``submit(*args).result(timeout)``."""
         return self.submit(*args).result(timeout)
+
+    def _fail_all(self, exc):
+        """The batcher died (or a fault was injected): mark the engine
+        FAILED — later submits raise :class:`ReplicaFailedError`, not a
+        plain closed — and reject every queued future so no waiter ever
+        hangs on a dead replica."""
+        failure = exc if isinstance(exc, ReplicaFailedError) \
+            else ReplicaFailedError(
+                f"inference batcher died: {type(exc).__name__}: {exc}",
+                cause=exc)
+        if not isinstance(exc, ReplicaFailedError):
+            failure.__cause__ = exc
+        self._failure = failure
+        self._closed = True
+        if self._batcher is not None:
+            self._batcher._stopped = True  # a live-but-looping batcher
+            # exits at its next queue poll; a dead one is already gone
+            try:
+                while True:
+                    r = self._batcher._queue.get_nowait()
+                    if isinstance(r, _Request):
+                        _reject(r.future, failure)
+            except queue.Empty:
+                pass
+        _live_engines.discard(self)
 
     # -- dispatch (batcher thread) -------------------------------------
     def _dispatch(self, batch):

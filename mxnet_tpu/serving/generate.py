@@ -55,6 +55,7 @@ rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
 """
 from __future__ import annotations
 
+import contextlib
 import queue
 import threading
 import time
@@ -66,8 +67,8 @@ from .. import telemetry
 from .._bounded_worker import BoundedQueueWorker
 from ..bucketing import BucketingPolicy, as_policy
 from .engine import (
-    EngineClosedError, QueueFullError, RequestTimeoutError,
-    _live_engines, _serving_enabled,
+    EngineClosedError, QueueFullError, ReplicaFailedError,
+    RequestTimeoutError, _live_engines, _serving_enabled,
 )
 
 __all__ = ["GenerationEngine", "GenerationStream", "GenerationResult"]
@@ -107,6 +108,7 @@ class GenerationStream:
         self._tokens: list = []
         self._reason = None
         self._exc = None
+        self._watchers: list = []
         #: ``time.perf_counter()`` stamps of the first token and of
         #: completion — producer-side, so latency measurement needs no
         #: consumer thread racing the stream (bench.py --generate).
@@ -116,10 +118,16 @@ class GenerationStream:
     # -- producer side (generator thread) ------------------------------
     def _emit(self, token: int):
         with self._cv:
+            if self._reason is not None or self._exc is not None:
+                return  # a finished stream takes no more tokens (a
+                # stale step racing an injected crash must not append)
             if not self._tokens:
                 self.first_token_at = time.perf_counter()
-            self._tokens.append(int(token))
+            tok = int(token)
+            self._tokens.append(tok)
             self._cv.notify_all()
+            for on_token, _fin in self._watchers:
+                on_token(tok)
 
     def _finish(self, reason=None, exc=None):
         with self._cv:
@@ -129,6 +137,24 @@ class GenerationStream:
             self._exc = exc
             self.done_at = time.perf_counter()
             self._cv.notify_all()
+            watchers, self._watchers = self._watchers, []
+            for _tok, on_finish in watchers:
+                on_finish(reason, exc)
+
+    def _watch(self, on_token, on_finish):
+        """Producer-side event subscription (the Router's retry hook):
+        ``on_token(tok)`` fires for every token — including, first, a
+        replay of tokens already emitted — and ``on_finish(reason,
+        exc)`` exactly once at completion. Callbacks run under the
+        stream lock on the producer thread; they must be quick and must
+        not raise (a raise propagates into the producing engine)."""
+        with self._cv:
+            for tok in self._tokens:
+                on_token(tok)
+            if self._reason is not None or self._exc is not None:
+                on_finish(self._reason, self._exc)
+            else:
+                self._watchers.append((on_token, on_finish))
 
     # -- consumer side --------------------------------------------------
     def done(self) -> bool:
@@ -175,15 +201,16 @@ class GenerationStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_submit",
-                 "deadline")
+                 "t_enq", "deadline")
 
     def __init__(self, prompt, max_new, eos_id, stream, t_submit,
-                 deadline):
+                 t_enq, deadline):
         self.prompt = prompt
         self.max_new = max_new
         self.eos_id = eos_id
         self.stream = stream
         self.t_submit = t_submit
+        self.t_enq = t_enq     # monotonic enqueue stamp (queue wait)
         self.deadline = deadline
 
 
@@ -244,6 +271,13 @@ class _GenWorker(BoundedQueueWorker):
                 active = eng._n_active
                 if active:
                     eng._step()
+            if eng._gen_waiters:
+                # fairness: this loop re-acquires _gen_lock back to
+                # back, and lock handoff is unfair under the GIL — a
+                # rollover/warmup/fault-injection caller could starve
+                # for an entire generation. Cede one scheduler slice
+                # between steps when someone is waiting (rare).
+                time.sleep(0.0005)
             if active:
                 continue
             del eng  # don't pin the engine while blocking on the queue
@@ -344,14 +378,37 @@ class GenerationEngine:
         #: generation, warmup) — jit TRACING mutates shared parameter
         #: bindings, so two threads may never trace concurrently
         self._gen_lock = threading.Lock()
+        #: count of threads waiting on _gen_lock via _gen_exclusive —
+        #: the worker's step loop yields between steps when non-zero
+        #: (unfair lock handoff would otherwise starve them)
+        self._gen_waiters = 0
         self._lock = threading.Lock()
         self._closed = False
+        #: set (to a ReplicaFailedError) when the generator thread died
+        #: from an unexpected error — a broken replica, not a close()
+        self._failure: ReplicaFailedError | None = None
         self._sync = not _serving_enabled()
         self._worker = None if self._sync \
             else _GenWorker(self, self.queue_limit)
         _live_engines.add(self)
 
     # -- lifecycle -----------------------------------------------------
+    @contextlib.contextmanager
+    def _gen_exclusive(self):
+        """Acquire ``_gen_lock`` as a registered waiter. The worker's
+        step loop re-acquires the lock back to back and Python lock
+        handoff is unfair — without the waiter signal a rollover,
+        warmup, or fault-injection caller can starve for as long as a
+        whole generation under continuous decode traffic."""
+        with self._lock:
+            self._gen_waiters += 1
+        try:
+            with self._gen_lock:
+                yield
+        finally:
+            with self._lock:
+                self._gen_waiters -= 1
+
     def warmup(self):
         """Compile the steady state ahead of traffic: one prefill per
         sequence bucket the policy can produce, plus the decode step.
@@ -364,7 +421,12 @@ class GenerationEngine:
         # the live one here would race the step loop into a
         # donated-buffer error. _gen_lock additionally keeps our traces
         # mutually exclusive with any in-flight worker step.
-        with self._gen_lock:
+        with self._gen_exclusive():
+            if self._closed:
+                # close() won the lock first: compiling against a
+                # closing engine is wasted work at best and a
+                # donated-buffer race at worst — bail cleanly
+                return self
             cache = self.model.init_cache(self.max_slots, self._s_max,
                                           dtype=self._cache_dtype)
             for sb in self.policy.sizes(self._s_max - 1):
@@ -405,8 +467,9 @@ class GenerationEngine:
         else:
             new_params, _meta = _ckpt.read_params(source)
         t0 = telemetry.clock()
-        with self._gen_lock:  # step boundary: the worker is between
-            # decode steps, warmup is not tracing
+        with self._gen_exclusive():  # step boundary: the worker is
+            # between decode steps (and yields to us promptly — the
+            # waiter signal), warmup is not tracing
             _ckpt.swap_param_buffers(self.model.collect_params(),
                                      new_params, strict=strict)
         telemetry.hist_since("serving.generate.swap", t0)
@@ -475,6 +538,10 @@ class GenerationEngine:
         Raises :class:`EngineClosedError` / :class:`QueueFullError` /
         ``ValueError`` immediately instead of returning a stream that
         can never complete."""
+        if self._failure is not None:
+            telemetry.counter("serving.generate.rejected_closed")
+            raise ReplicaFailedError(str(self._failure),
+                                     cause=self._failure.cause)
         if self._closed:
             telemetry.counter("serving.generate.rejected_closed")
             raise EngineClosedError("submit on a closed engine")
@@ -483,9 +550,10 @@ class GenerationEngine:
         telemetry.counter("serving.generate.requests")
         stream = GenerationStream(int(prompt.size))
         tmo = self.timeout_ms if timeout_ms is None else timeout_ms
+        now = time.monotonic()
         req = _GenRequest(
-            prompt, max_new, eos, stream, telemetry.clock(),
-            time.monotonic() + tmo / 1e3 if tmo is not None else None)
+            prompt, max_new, eos, stream, telemetry.clock(), now,
+            now + tmo / 1e3 if tmo is not None else None)
         if self._sync:  # MXTPU_SERVING=0: inline generation
             with self._gen_lock:
                 self._admit_one(req)
@@ -501,7 +569,12 @@ class GenerationEngine:
                 from None
         telemetry.gauge("serving.generate.queue.depth",
                         self._worker._queue.qsize())
-        if self._closed:
+        if self._failure is not None:
+            # the worker died while the request was being queued: its
+            # drain may have missed this request — fail it ourselves
+            stream._finish(exc=ReplicaFailedError(
+                str(self._failure), cause=self._failure.cause))
+        elif self._closed:
             # close() raced the put: its drain may have missed this
             # request — reject it ourselves (no-op if already handled)
             stream._finish(exc=EngineClosedError(
@@ -525,10 +598,13 @@ class GenerationEngine:
     def _admit_one(self, r: _GenRequest):
         """Prefill ``r`` into a free slot (sequence axis bucketed) and
         emit its first token. Called only at step boundaries."""
+        waited_ms = (time.monotonic() - r.t_enq) * 1e3
+        telemetry.hist("serving.generate.queue_wait", waited_ms)
         if r.deadline is not None and time.monotonic() > r.deadline:
             telemetry.counter("serving.generate.timeouts")
             r.stream._finish(exc=RequestTimeoutError(
-                "request expired in queue before prefill"))
+                f"request expired in queue before prefill (waited "
+                f"{waited_ms:.1f} ms)"))
             return
         slot = self._slots.index(None)
         n = int(r.prompt.size)
@@ -608,19 +684,30 @@ class GenerationEngine:
 
     def _fail_all(self, exc):
         """Worker crashed mid-step (the cache may hold donated/invalid
-        buffers): fail every live stream and queued request, and close
-        the engine — a broken engine must reject, not wedge."""
+        buffers): fail every live stream and queued request with a
+        :class:`ReplicaFailedError` — retryable replica death, NOT a
+        deliberate close — and close the engine; a broken engine must
+        reject, not wedge."""
+        failure = exc if isinstance(exc, ReplicaFailedError) \
+            else ReplicaFailedError(
+                f"generation worker died: {type(exc).__name__}: {exc}",
+                cause=exc)
+        if not isinstance(exc, ReplicaFailedError):
+            failure.__cause__ = exc
+        self._failure = failure
+        self._closed = True
         for i, s in enumerate(self._slots):
             if s is not None:
-                s.stream._finish(exc=exc)
+                s.stream._finish(exc=failure)
                 self._slots[i] = None
         self._n_active = 0
-        self._closed = True
         if self._worker is not None:
+            self._worker._stopped = True  # a still-looping worker (an
+            # injected failure, not a real crash) exits at its next poll
             try:
                 while True:
                     r = self._worker._queue.get_nowait()
-                    r.stream._finish(exc=exc)
+                    r.stream._finish(exc=failure)
             except queue.Empty:
                 pass
         _live_engines.discard(self)

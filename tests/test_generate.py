@@ -27,7 +27,7 @@ from mxnet_tpu import telemetry
 from mxnet_tpu.gluon.model_zoo.gpt import GPTModel, gpt_small
 from mxnet_tpu.serving import (
     GenerationEngine, EngineClosedError, QueueFullError,
-    RequestTimeoutError,
+    ReplicaFailedError, RequestTimeoutError,
 )
 
 VOCAB, SLOTS, SMAX = 97, 4, 64
@@ -361,6 +361,80 @@ def test_escape_hatch_serving_disabled(net, monkeypatch):
     eng.close()
     with pytest.raises(EngineClosedError):
         eng.submit(p)
+
+
+class _PoisonedModel:
+    """Model wrapper whose decode_step dies — simulates an organic
+    worker crash mid-generation."""
+
+    def __init__(self, model, exc):
+        self._model = model
+        self._exc = exc
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    def decode_step(self, tokens, cache):
+        raise self._exc
+
+
+def test_worker_crash_surfaces_replica_failed(net):
+    """A dead worker is a FAILED replica, not a deliberate shutdown:
+    the in-flight stream and later submits raise ReplicaFailedError
+    (an EngineClosedError subclass) carrying the original exception —
+    a Router can tell retryable death from close()."""
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX,
+                           max_new_tokens=6, queue_limit=16)
+    boom = RuntimeError("decode exploded")
+    eng.model = _PoisonedModel(net, boom)
+    rng = onp.random.RandomState(20)
+    s = eng.submit(_prompt(rng, 4))
+    with pytest.raises(ReplicaFailedError) as ei:
+        s.result(timeout=60)
+    assert ei.value.cause is boom
+    with pytest.raises(ReplicaFailedError) as ei:
+        eng.submit(_prompt(rng, 4))
+    assert ei.value.cause is boom
+    assert isinstance(ei.value, EngineClosedError)  # old handlers work
+
+    # a DELIBERATE close is still a plain EngineClosedError
+    eng2 = GenerationEngine(net, max_slots=2, max_length=SMAX)
+    eng2.close()
+    with pytest.raises(EngineClosedError) as ei:
+        eng2.submit(_prompt(rng, 4))
+    assert not isinstance(ei.value, ReplicaFailedError)
+
+
+def test_queue_wait_histogram_and_timeout_message(net):
+    """Queue wait is recorded for every admission AND for queued-past-
+    deadline rejections, whose error message now carries the waited
+    duration (it used to be dropped on the floor)."""
+    eng = GenerationEngine(net, max_slots=1, max_length=SMAX,
+                           max_new_tokens=8, queue_limit=16)
+    eng.warmup()
+    telemetry.reset()
+    rng = onp.random.RandomState(21)
+    busy = eng.submit(_prompt(rng, 3), max_new_tokens=25)
+    doomed = eng.submit(_prompt(rng, 3), timeout_ms=0.0)
+    with pytest.raises(RequestTimeoutError, match=r"waited [0-9.]+ ms"):
+        doomed.result(timeout=120)
+    assert len(busy.result(timeout=120).tokens) == 25
+    snap = telemetry.snapshot()
+    h = snap["histograms"]["serving.generate.queue_wait"]
+    assert h["count"] == 2  # the admitted request and the rejected one
+    eng.close()
+
+
+def test_warmup_bails_cleanly_on_closed_engine(net):
+    """close() racing warmup(): a warmup that acquires _gen_lock after
+    the engine closed must bail instead of compiling against a closing
+    engine (regression: it used to trace against dead state)."""
+    eng = GenerationEngine(net, max_slots=2, max_length=SMAX)
+    eng.close()
+    telemetry.reset()
+    assert eng.warmup() is eng  # no exception, fluent return
+    assert telemetry.counter_value("model.gpt.trace") == 0, \
+        "warmup compiled against a closed engine"
 
 
 # -- soak (excluded from tier-1 via the slow marker) -------------------

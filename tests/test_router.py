@@ -1,0 +1,517 @@
+"""Fault-tolerant serving fleet: Router + FaultInjector chaos tests.
+
+Guarantees under test (all faults seeded/deterministic):
+- join-shortest-queue balancing spreads traffic and never changes any
+  request's tokens (greedy engine output is replica-independent when
+  replicas share weights);
+- a replica crash mid-decode is absorbed: in-flight requests retry on
+  a DIFFERENT replica and the caller's stream is token-identical to
+  the unfailed path (greedy decode is deterministic, so the retry
+  regenerates the same prefix and the router skips what it already
+  delivered);
+- the circuit breaker opens after K consecutive failures, half-opens
+  after the cooldown, and closes on a successful trial;
+- per-tenant quotas and priority brownout shedding reject at the edge
+  (``TenantQuotaError`` / ``LoadShedError``), with optional
+  ``max_new_tokens`` capping under brownout;
+- a rolling fleet-wide ``load_weights`` under live traffic drops zero
+  requests and swaps every live replica;
+- deadlines propagate end to end (queued-past-deadline requests are
+  rejected, not served late);
+- the same machinery fronts ``InferenceEngine`` fleets (Future-based).
+"""
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon.model_zoo.gpt import gpt_small
+from mxnet_tpu.serving import (
+    DOWN, HEALTHY, EngineClosedError, FaultInjector, FaultRule,
+    GenerationEngine, InferenceEngine, InjectedFault, LoadShedError,
+    ReplicaFailedError, RequestTimeoutError, Router, TenantQuotaError,
+)
+
+VOCAB, SLOTS, SMAX = 64, 2, 32
+
+
+def _build_net(seed=7):
+    mx.np.random.seed(seed)
+    onp.random.seed(seed)
+    net = gpt_small(vocab_size=VOCAB, units=16, num_layers=1,
+                    num_heads=2, max_length=SMAX)
+    net.initialize(mx.init.Xavier())
+    net(mx.np.array(onp.zeros((1, 4), "i4")))  # materialize params
+    return net
+
+
+@pytest.fixture(scope="module")
+def base():
+    """Reference net + its parameter mapping (the fleet's weights)."""
+    net = _build_net(seed=99)
+    params = {k: onp.asarray(p.data()._data)
+              for k, p in net.collect_params().items()}
+    return net, params
+
+
+def _mk_engine(params, slots=SLOTS, max_new=4, queue_limit=32):
+    eng = GenerationEngine(_build_net(), max_slots=slots,
+                           max_length=SMAX, max_new_tokens=max_new,
+                           queue_limit=queue_limit)
+    eng.load_weights(params)
+    return eng
+
+
+def _fleet(params, n=2, **eng_kw):
+    return [_mk_engine(params, **eng_kw) for _ in range(n)]
+
+
+def _prompt(rng, n=5):
+    return rng.randint(0, VOCAB, size=n).astype("i4")
+
+
+def _ref_generate(net, policy, prompt, max_new, width=SLOTS,
+                  max_length=SMAX):
+    """Single-request greedy loop at slot width ``width`` — what every
+    fleet-served request must match token for token."""
+    cache = net.init_cache(width, max_length)
+    n = len(prompt)
+    sb = policy.bucket(n)
+    padded = onp.zeros((1, sb), "i4")
+    padded[0, :n] = prompt
+    logits, cache = net.prefill(padded, [n], cache, slots=[0])
+    toks = [int(onp.asarray(logits)[0].argmax())]
+    n_ctx = n
+    while len(toks) < max_new and n_ctx < max_length:
+        step = onp.zeros((width,), "i4")
+        step[0] = toks[-1]
+        lg, cache = net.decode_step(step, cache)
+        toks.append(int(onp.asarray(lg)[0].argmax()))
+        n_ctx += 1
+    return toks
+
+
+# -- balancing & parity ------------------------------------------------
+
+def test_jsq_balancing_and_token_parity(base):
+    net, params = base
+    router = Router(_fleet(params, n=2), probe_interval_s=0.1)
+    rng = onp.random.RandomState(0)
+    prompts = [_prompt(rng, 3 + i % 9) for i in range(10)]
+    streams = [router.submit(p, max_new_tokens=5) for p in prompts]
+    results = [s.result(timeout=120) for s in streams]
+    policy = router.replicas[0].policy
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length"
+        assert r.tokens == _ref_generate(net, policy, p, 5)
+    h = router.health()
+    assert all(v["state"] == HEALTHY for v in h.values())
+    # JSQ spread the load: no replica served everything
+    assert all(v["dispatches"] > 0 for v in h.values())
+    assert sum(v["dispatches"] for v in h.values()) == len(prompts)
+    router.close()
+    with pytest.raises(EngineClosedError):
+        router.submit(prompts[0])
+
+
+# -- crash / retry -----------------------------------------------------
+
+def test_replica_crash_mid_decode_retry_token_identical(base):
+    """The tentpole guarantee: kill a replica while a request is
+    mid-decode on it; the request is retried on the OTHER replica with
+    the already-delivered token prefix skipped, and the caller's
+    stream is token-identical to the unfailed path.
+
+    Fully deterministic: the crash is a FaultRule keyed on replica 0's
+    DISPATCH COUNT (its 2nd dispatch), not wall-clock — by then the
+    1st request is provably mid-decode (its first token was observed
+    before anything else was submitted)."""
+    net, params = base
+    engines = _fleet(params, n=2)
+    injector = FaultInjector(
+        rules=[FaultRule("crash", replica=0, after_n=2)], seed=0)
+    router = Router(engines, max_retries=2, probe_interval_s=0.05,
+                    fault_injector=injector)
+    rng = onp.random.RandomState(1)
+    prompts = [_prompt(rng) for _ in range(3)]
+    # 1st request lands on replica 0 (idle JSQ tie-break); wait until
+    # it is mid-decode (first token out, 19 to go)
+    s1 = router.submit(prompts[0], max_new_tokens=20)
+    deadline = time.monotonic() + 60
+    while not s1.tokens and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert s1.tokens, "first request never started decoding"
+    # 2nd goes to the idle replica 1; the 3rd ties back to replica 0 —
+    # its dispatch is replica 0's 2nd, which fires the injected crash:
+    # s1 dies mid-decode (retried, prefix skipped), s3's submit fails
+    # over to replica 1
+    s2 = router.submit(prompts[1], max_new_tokens=20)
+    s3 = router.submit(prompts[2], max_new_tokens=20)
+    streams = [s1, s2, s3]
+    results = [s.result(timeout=120) for s in streams]
+    policy = engines[1].policy
+    for p, s, r in zip(prompts, streams, results):
+        assert r.finish_reason == "length"
+        assert r.tokens == _ref_generate(net, policy, p, 20), \
+            f"retried stream diverged (retries={s.retries})"
+    assert s1.retries == 1 and s1.replicas == [0, 1]
+    assert s3.retries == 1, "the crashed dispatch must fail over"
+    assert s2.retries == 0
+    assert router.health()[0]["state"] == DOWN
+    assert telemetry.counter_value("serving.router.retries") >= 2
+    assert telemetry.counter_value("serving.faults.crashes") >= 1
+    # post-crash traffic keeps flowing on the survivor
+    r = router.generate(prompts[0], max_new_tokens=6, timeout=120)
+    assert r.tokens == _ref_generate(net, policy, prompts[0], 6)
+    router.close()
+
+
+def test_retry_budget_exhausted_surfaces_fault(base):
+    _net, params = base
+    injector = FaultInjector(rules=[FaultRule("error", rate=1.0)],
+                             seed=3)
+    router = Router(_fleet(params, n=2), max_retries=1,
+                    fault_injector=injector)
+    with pytest.raises(InjectedFault):
+        router.submit(_prompt(onp.random.RandomState(2)))
+    assert telemetry.counter_value("serving.router.retries") >= 1
+    router.close()
+
+
+def test_no_replica_available(base):
+    _net, params = base
+    engines = _fleet(params, n=1)
+    injector = FaultInjector()
+    router = Router(engines, fault_injector=injector)
+    injector.crash(engines[0])
+    with pytest.raises(ReplicaFailedError):
+        router.submit(_prompt(onp.random.RandomState(3)))
+    assert router.health()[0]["state"] == DOWN
+    router.close()
+
+
+# -- circuit breaker ---------------------------------------------------
+
+def test_circuit_breaker_opens_half_opens_closes(base):
+    net, params = base
+    injector = FaultInjector(
+        rules=[FaultRule("error", replica=0, rate=1.0)], seed=0)
+    router = Router(_fleet(params, n=2), max_retries=2,
+                    breaker_threshold=3, breaker_cooldown_s=2.0,
+                    probe_interval_s=0.05, fault_injector=injector)
+    rng = onp.random.RandomState(4)
+    base_opens = telemetry.counter_value("serving.router.breaker_opens")
+    # idle JSQ prefers replica 0 (index tie-break) → each request
+    # first hits the poisoned replica until its breaker opens
+    for _ in range(6):
+        r = router.generate(_prompt(rng), max_new_tokens=3, timeout=120)
+        assert r.finish_reason == "length"
+    assert router.health()[0]["breaker"] == "open"
+    assert router.health()[0]["state"] == DOWN
+    assert injector.dispatches(0) == 3, \
+        "breaker kept routing to the open replica"
+    assert telemetry.counter_value("serving.router.breaker_opens") \
+        == base_opens + 1
+    # cooldown: the probe flips the breaker to half-open; the next
+    # request is the single trial — with the fault cleared it succeeds
+    # and closes the breaker
+    injector.clear()
+    time.sleep(2.3)
+    r = router.generate(_prompt(rng), max_new_tokens=3, timeout=120)
+    assert r.finish_reason == "length"
+    assert injector.dispatches(0) == 4  # the trial went to replica 0
+    assert router.health()[0]["breaker"] == "closed"
+    assert telemetry.counter_value(
+        "serving.router.breaker_half_opens") >= 1
+    assert telemetry.counter_value(
+        "serving.router.breaker_closes") >= 1
+    router.close()
+
+
+def test_half_open_failure_reopens(base):
+    _net, params = base
+    injector = FaultInjector(
+        rules=[FaultRule("error", replica=0, rate=1.0)], seed=0)
+    router = Router(_fleet(params, n=2), max_retries=2,
+                    breaker_threshold=2, breaker_cooldown_s=1.0,
+                    probe_interval_s=0.05, fault_injector=injector)
+    rng = onp.random.RandomState(5)
+    for _ in range(3):
+        router.generate(_prompt(rng), max_new_tokens=3, timeout=120)
+    assert router.health()[0]["breaker"] == "open"
+    time.sleep(1.3)  # half-opens; the fault is still active
+    router.generate(_prompt(rng), max_new_tokens=3, timeout=120)
+    assert router.health()[0]["breaker"] == "open", \
+        "a failed half-open trial must re-open the circuit"
+    router.close()
+
+
+# -- admission: quotas, shedding, deadlines ----------------------------
+
+def test_tenant_quota(base):
+    _net, params = base
+    router = Router(_fleet(params, n=1, slots=1), tenant_quota=2)
+    rng = onp.random.RandomState(6)
+    held = [router.submit(_prompt(rng), max_new_tokens=20, tenant="a")
+            for _ in range(2)]
+    with pytest.raises(TenantQuotaError):
+        router.submit(_prompt(rng), tenant="a")
+    # another tenant is unaffected
+    other = router.submit(_prompt(rng), max_new_tokens=2, tenant="b")
+    for s in held + [other]:
+        assert s.result(timeout=120).finish_reason == "length"
+    # quota released on completion
+    s = router.submit(_prompt(rng), max_new_tokens=2, tenant="a")
+    assert s.result(timeout=120).finish_reason == "length"
+    assert telemetry.counter_value("serving.router.rejected_quota") >= 1
+    router.close()
+
+
+def test_brownout_sheds_low_priority_and_caps_budget(base):
+    _net, params = base
+    router = Router(_fleet(params, n=1, slots=1), queue_limit=10,
+                    brownout_frac=0.5, brownout_max_new_tokens=2)
+    rng = onp.random.RandomState(7)
+    held = [router.submit(_prompt(rng), max_new_tokens=15)
+            for _ in range(5)]           # outstanding = 5 = brownout_at
+    with pytest.raises(LoadShedError):
+        router.submit(_prompt(rng), priority=1)  # lowest priority first
+    capped = router.submit(_prompt(rng), max_new_tokens=15, priority=0)
+    held += [router.submit(_prompt(rng), max_new_tokens=15)
+             for _ in range(4)]          # outstanding = 10 = queue_limit
+    with pytest.raises(LoadShedError):
+        router.submit(_prompt(rng), priority=0)  # hard limit: all shed
+    assert capped.result(timeout=300).tokens \
+        and len(capped.result().tokens) == 2, \
+        "brownout must cap the admitted generation budget"
+    for s in held:
+        assert s.result(timeout=300).finish_reason == "length"
+    assert telemetry.counter_value("serving.router.rejected_shed") >= 2
+    assert telemetry.counter_value(
+        "serving.router.brownout_capped") >= 1
+    router.close()
+
+
+def test_deadline_propagates_to_queued_rejection(base):
+    _net, params = base
+    router = Router(_fleet(params, n=1, slots=1))
+    rng = onp.random.RandomState(8)
+    busy = router.submit(_prompt(rng), max_new_tokens=25)
+    doomed = router.submit(_prompt(rng), timeout_ms=5.0)
+    with pytest.raises(RequestTimeoutError):
+        doomed.result(timeout=120)
+    assert busy.result(timeout=120).finish_reason == "length"
+    assert telemetry.counter_value("serving.router.timeouts") >= 1
+    router.close()
+
+
+# -- rolling rollover --------------------------------------------------
+
+def test_rolling_rollover_under_traffic_drops_nothing(base):
+    net, params = base
+    net_b = _build_net(seed=123)   # different weights, same shapes
+    params_b = {k: onp.asarray(p.data()._data)
+                for k, p in net_b.collect_params().items()}
+    router = Router(_fleet(params, n=2), probe_interval_s=0.1)
+    rng = onp.random.RandomState(9)
+    swaps0 = telemetry.counter_value("serving.generate.weight_swaps")
+    streams = [router.submit(_prompt(rng), max_new_tokens=8)
+               for _ in range(10)]
+    swapped = router.load_weights(params_b, drain_timeout_s=30.0)
+    assert swapped == 2
+    # zero dropped requests fleet-wide: everything completes normally
+    for s in streams:
+        assert s.result(timeout=120).finish_reason == "length"
+    assert telemetry.counter_value("serving.generate.weight_swaps") \
+        == swaps0 + 2
+    assert telemetry.counter_value("serving.router.rollovers") >= 1
+    # post-rollover traffic runs the NEW weights on every replica
+    policy = router.replicas[0].policy
+    p = _prompt(rng)
+    for _ in range(4):   # JSQ alternates, covering both replicas
+        r = router.generate(p, max_new_tokens=6, timeout=120)
+        assert r.tokens == _ref_generate(net_b, policy, p, 6)
+    router.close()
+
+
+def test_rollover_skips_replica_that_dies_mid_sweep(base):
+    """A replica that dies between the liveness check and its swap
+    must be SKIPPED, not abort the sweep — aborting would strand the
+    rest of the fleet on the old weights (mixed versions break retry
+    token-identity fleet-wide)."""
+    _net, params = base
+    net_b = _build_net(seed=321)
+    params_b = {k: onp.asarray(p.data()._data)
+                for k, p in net_b.collect_params().items()}
+    engines = _fleet(params, n=2)
+    router = Router(engines, probe_interval_s=0.1)
+
+    def dying_load_weights(source, strict=True):
+        raise EngineClosedError("replica died mid-rollover")
+
+    engines[0].load_weights, real = dying_load_weights, \
+        engines[0].load_weights
+    try:
+        assert router.load_weights(params_b) == 1
+    finally:
+        engines[0].load_weights = real
+    assert not router.health()[1]["cordoned"]
+    router.close()
+
+
+def test_probe_detects_silently_dead_worker(base):
+    """The probe's 'DOWN on a silent death' contract: a worker thread
+    that exits without recording a failure (no exception reached its
+    handler) is detected by liveness, the replica is declared FAILED,
+    and traffic keeps flowing on the survivor."""
+    net, params = base
+    engines = _fleet(params, n=2)
+    router = Router(engines, probe_interval_s=0.05)
+    rng = onp.random.RandomState(14)
+    router.generate(_prompt(rng), max_new_tokens=2, timeout=120)
+    # silent death: stop the worker loop without any failure record
+    engines[0]._worker._stopped = True
+    engines[0]._worker.join(timeout=30)
+    assert not engines[0]._worker.is_alive()
+    assert engines[0]._failure is None and not engines[0].closed
+    deadline = time.monotonic() + 30
+    while router.health()[0]["state"] != DOWN \
+            and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert router.health()[0]["state"] == DOWN
+    assert isinstance(engines[0]._failure, ReplicaFailedError)
+    policy = engines[1].policy
+    p = _prompt(rng)
+    r = router.generate(p, max_new_tokens=4, timeout=120)
+    assert r.tokens == _ref_generate(net, policy, p, 4)
+    router.close()
+
+
+# -- inference-engine fleets -------------------------------------------
+
+def _mk_infer_engine(**kw):
+    from mxnet_tpu.gluon import nn
+    mx.np.random.seed(11)
+    onp.random.seed(11)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8))
+    net.initialize(mx.init.Xavier())
+    net(mx.np.array(onp.zeros((1, 4), "f4")))
+    return InferenceEngine(net, max_batch_size=4, **kw)
+
+
+def test_infer_mode_routing_and_crash_retry(base):
+    engines = [_mk_infer_engine(max_queue_ms=0.0),
+               _mk_infer_engine(max_queue_ms=0.0)]
+    injector = FaultInjector()
+    router = Router(engines, max_retries=2, probe_interval_s=0.05,
+                    fault_injector=injector)
+    rng = onp.random.RandomState(12)
+    xs = [mx.np.array(rng.randn(1, 4).astype("f4")) for _ in range(6)]
+    futs = [router.submit(x) for x in xs]
+    expected = [engines[1].block(x).asnumpy() for x in xs]
+    for f, want in zip(futs, expected):
+        onp.testing.assert_allclose(f.result(timeout=120).asnumpy(),
+                                    want, rtol=1e-5, atol=1e-6)
+    # crash one replica; the fleet keeps answering
+    injector.crash(engines[0])
+    futs = [router.submit(x) for x in xs]
+    for f, want in zip(futs, expected):
+        onp.testing.assert_allclose(f.result(timeout=120).asnumpy(),
+                                    want, rtol=1e-5, atol=1e-6)
+    assert router.health()[0]["state"] == DOWN
+    with pytest.raises(TypeError):
+        router.submit(xs[0], max_new_tokens=3)  # generation-only knob
+    router.close()
+
+
+def test_infer_mode_queued_requests_survive_crash():
+    # a generous coalescing window holds submissions in the doomed
+    # replica's queue; the injected crash rejects them with
+    # ReplicaFailedError and the router retries them elsewhere
+    engines = [_mk_infer_engine(max_queue_ms=500.0, queue_limit=64),
+               _mk_infer_engine(max_queue_ms=0.0, queue_limit=64)]
+    injector = FaultInjector()
+    router = Router(engines, max_retries=2, probe_interval_s=0.05,
+                    fault_injector=injector)
+    rng = onp.random.RandomState(13)
+    xs = [mx.np.array(rng.randn(1, 4).astype("f4")) for _ in range(8)]
+    futs = [router.submit(x) for x in xs]
+    injector.crash(engines[0])
+    expected = [engines[1].block(x).asnumpy() for x in xs]
+    for f, want in zip(futs, expected):
+        onp.testing.assert_allclose(f.result(timeout=120).asnumpy(),
+                                    want, rtol=1e-5, atol=1e-6)
+    assert sum(f.retries for f in futs) >= 1
+    router.close()
+
+
+def test_mixed_fleet_rejected(base):
+    _net, params = base
+    gen = _mk_engine(params)
+    inf = _mk_infer_engine()
+    with pytest.raises(TypeError):
+        Router([gen, inf])
+    gen.close()
+    inf.close()
+
+
+# -- randomized soak (excluded from tier-1 via the slow marker) --------
+
+@pytest.mark.slow
+def test_soak_randomized_fault_schedule(base):
+    """Fixed-seed randomized chaos: transient dispatch errors, a slow
+    replica, and a scheduled mid-window crash. Every request must
+    resolve (success or an explicit error — never a hang) and
+    successful streams stay token-identical to the reference."""
+    net, params = base
+    engines = _fleet(params, n=3, queue_limit=64)
+    injector = FaultInjector(
+        rules=[FaultRule("error", rate=0.05),
+               FaultRule("slow", replica=2, rate=0.3, duration_ms=5.0),
+               FaultRule("crash", replica=1, after_n=25)],
+        seed=1234)
+    router = Router(engines, max_retries=3, breaker_threshold=3,
+                    breaker_cooldown_s=0.5, probe_interval_s=0.05,
+                    fault_injector=injector)
+    rng = onp.random.RandomState(42)
+    prompts = [_prompt(rng, 3 + i % 10) for i in range(80)]
+    budgets = [2 + i % 7 for i in range(80)]
+    streams = [None] * 80
+    errs = []
+
+    def client(lo, hi):
+        for i in range(lo, hi):
+            try:
+                streams[i] = router.submit(prompts[i],
+                                           max_new_tokens=budgets[i])
+            except Exception as e:  # noqa: BLE001 — shed/faulted is ok
+                errs.append((i, e))
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(0, 40)),
+               threading.Thread(target=client, args=(40, 80))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    policy = engines[0].policy
+    n_ok = 0
+    for i, s in enumerate(streams):
+        if s is None:
+            continue
+        try:
+            r = s.result(timeout=300)
+        except Exception:  # noqa: BLE001 — explicit failure, not a hang
+            continue
+        if r.finish_reason == "length":
+            n_ok += 1
+            assert r.tokens == _ref_generate(net, policy, prompts[i],
+                                             budgets[i])
+    assert n_ok >= 60, f"too few successes under chaos ({n_ok}/80)"
+    assert telemetry.counter_value("serving.router.retries") >= 1
+    router.close(timeout=60.0)
+    assert not router._prober.is_alive()

@@ -26,7 +26,7 @@ from mxnet_tpu.gluon import nn
 from mxnet_tpu.gluon.block import HybridBlock
 from mxnet_tpu.serving import (
     InferenceEngine, EngineClosedError, QueueFullError,
-    RequestTimeoutError,
+    ReplicaFailedError, RequestTimeoutError,
 )
 
 
@@ -342,6 +342,40 @@ def test_escape_hatch_serving_disabled(monkeypatch):
     eng.close()
     with pytest.raises(EngineClosedError):
         eng.submit(x)
+
+
+def test_batcher_death_surfaces_replica_failed():
+    """A batcher thread that DIES (not a per-batch dispatch error,
+    which only fails its own batch) marks the engine FAILED: queued
+    futures and later submits raise ReplicaFailedError carrying the
+    original exception — distinguishable from a deliberate close()."""
+    rng = onp.random.RandomState(17)
+    eng = InferenceEngine(_mlp(), max_batch_size=4, max_queue_ms=50.0)
+    x = _x(rng)
+    eng.warmup(x)
+    eng.predict(x)
+    boom = RuntimeError("batcher exploded")
+
+    def dying_dispatch(batch):
+        raise boom
+
+    eng._dispatch = dying_dispatch
+    fut = eng.submit(x)
+    with pytest.raises(ReplicaFailedError) as ei:
+        fut.result(timeout=30)
+    assert ei.value.cause is boom
+    with pytest.raises(ReplicaFailedError) as ei:
+        eng.submit(x)
+    assert ei.value.cause is boom
+    assert isinstance(ei.value, EngineClosedError)  # old handlers work
+    assert not eng._batcher.is_alive()
+
+    # a DELIBERATE close stays a plain EngineClosedError
+    eng2 = InferenceEngine(_mlp(), max_batch_size=4)
+    eng2.close()
+    with pytest.raises(EngineClosedError) as ei:
+        eng2.submit(x)
+    assert not isinstance(ei.value, ReplicaFailedError)
 
 
 # -- observability -----------------------------------------------------

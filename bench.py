@@ -1816,6 +1816,283 @@ def _checkpoint_main():
 
 
 # ---------------------------------------------------------------------------
+# --resilience: self-healing training benchmark (CPU-runnable, <5 min).
+# An uninterrupted CONTROL child establishes the ground-truth final
+# parameters (sha256 digest) and step rate; then a CHAOS respawn loop
+# runs the same seeded training under a TrainSupervisor and kills it
+# on a deterministic per-attempt fault plan:
+#
+#   attempt 1: SIGKILL at step 27 (hard preemption, no cleanup);
+#   attempt 2: SIGKILL mid-checkpoint of step 45 (torn save — the
+#              COMMITTED marker never lands, restore must fall back);
+#   attempt 3: transient NaN-batch at batch 45 (watchdog rewind +
+#              clean replay) then SIGTERM at step 75 (the supervisor's
+#              flush-on-signal path commits step 75 exactly);
+#   attempt 4: no faults — run to completion.
+#
+# Acceptance (ISSUE 8): the chaos run's final params must be BITWISE
+# identical to the control run (PR 6's full-state capture is what
+# makes replay exact), at >= 90% goodput (useful steps / total steps
+# executed across every attempt, tracked in a stats file that
+# survives SIGKILL). Results (schema-checked) -> BENCH_r12.json.
+# ---------------------------------------------------------------------------
+RESIL_STEPS = 200  # waste per fault is fixed (~a save window), so
+RESIL_SAVE_EVERY = 5  # more steps = goodput margin over the 0.90 gate
+RESIL_FEAT, RESIL_HIDDEN, RESIL_BATCH, RESIL_ROWS = 32, 64, 16, 400
+RESIL_PLAN = ("kill@27", "kill_mid_save@45",
+              "nan_batch@45;preempt@75", "")
+
+
+def _resil_model():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, io
+    from mxnet_tpu.gluon import nn
+
+    mx.np.random.seed(11)
+    onp.random.seed(11)
+    net = nn.Sequential()
+    net.add(nn.Dense(RESIL_HIDDEN, activation="relu",
+                     in_units=RESIL_FEAT),
+            nn.Dense(RESIL_HIDDEN, activation="relu",
+                     in_units=RESIL_HIDDEN),
+            nn.Dense(4, in_units=RESIL_HIDDEN))
+    # in_units everywhere: the supervisor's anchor checkpoint captures
+    # params BEFORE the first forward pass
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    data = onp.random.RandomState(5).randn(
+        RESIL_ROWS, RESIL_FEAT).astype("f4")
+    label = onp.random.RandomState(6).randint(
+        0, 4, RESIL_ROWS).astype("i4")
+    it = io.NDArrayIter(data, label, batch_size=RESIL_BATCH,
+                        shuffle=True)
+    return net, tr, loss_fn, it
+
+
+def _resil_digest(net):
+    import hashlib
+    h = hashlib.sha256()
+    for name in sorted(net.collect_params()):
+        h.update(net.collect_params()[name].data().asnumpy().tobytes())
+    return h.hexdigest()
+
+
+def _resil_control_config():
+    from mxnet_tpu import autograd
+
+    net, tr, loss_fn, it = _resil_model()
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(RESIL_STEPS):
+        try:
+            b = it.next()
+        except StopIteration:
+            it.reset()
+            b = it.next()
+        with autograd.record():
+            loss = loss_fn(net(b.data[0]), b.label[0]).mean()
+        loss.backward()
+        tr.step(RESIL_BATCH)
+        losses.append(float(loss.asnumpy()))
+    wall = time.perf_counter() - t0
+    return {
+        "mode": "control",
+        "steps": RESIL_STEPS,
+        "final_digest": _resil_digest(net),
+        "losses_tail": [float.hex(l) for l in losses[-3:]],
+        "wall_s": round(wall, 3),
+        "steps_per_sec": round(RESIL_STEPS / wall, 2),
+    }
+
+
+def _resil_chaos_attempt():
+    from mxnet_tpu import checkpoint as ckpt, resilience, telemetry
+
+    spec = os.environ.get("BENCH_RESIL_FAULTS", "")
+    inj = resilience.TrainFaultInjector.from_spec(spec)
+    net, tr, loss_fn, it = _resil_model()
+    mgr = ckpt.CheckpointManager(os.environ["BENCH_RESIL_DIR"],
+                                 keep_last_n=3,
+                                 fs=inj.checkpoint_fs())
+    sup = resilience.TrainSupervisor(
+        mgr, net=net, trainer=tr, loss_fn=loss_fn, data_iter=it,
+        save_every=RESIL_SAVE_EVERY, injector=inj,
+        stats_file=os.environ["BENCH_RESIL_STATS"])
+    rep = sup.supervise(RESIL_STEPS)
+    mgr.close()
+    snap = telemetry.snapshot()
+    return {
+        "mode": "chaos",
+        "faults": spec,
+        "status": rep["status"],
+        "step": rep["step"],
+        "steps_executed": rep["steps_executed"],
+        "total_steps_executed": rep["total_steps_executed"],
+        "goodput": round(rep["goodput"], 4),
+        "rewinds": rep["rewinds"],
+        "resumes": rep["resumes"],
+        "preemptions": rep["preemptions"],
+        "restarts": rep["restarts"],
+        "final_digest": _resil_digest(net),
+        "telemetry": {k: v for k, v in snap["counters"].items()
+                      if k.startswith(("resilience.", "checkpoint."))},
+    }
+
+
+def _resil_check_schema(doc):
+    """BENCH_r12.json contract — fail the bench rather than publish a
+    malformed document."""
+    required = {
+        "metric": str, "value": float, "unit": str, "model": str,
+        "steps": int, "control": dict, "chaos": dict, "attempts": list,
+        "kills": int, "preemptions": int, "nan_injections": int,
+        "bitwise_identical": bool, "goodput": float,
+        "goodput_over_090": bool,
+    }
+    for key, typ in required.items():
+        if key not in doc:
+            raise ValueError(f"BENCH_r12 schema: missing key {key!r}")
+        if not isinstance(doc[key], typ):
+            raise ValueError(
+                f"BENCH_r12 schema: {key!r} is "
+                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
+    for key in ("final_digest", "steps_per_sec", "steps"):
+        if key not in doc["control"]:
+            raise ValueError(f"BENCH_r12 schema: missing control.{key}")
+    for key in ("final_digest", "status", "total_steps_executed",
+                "telemetry"):
+        if key not in doc["chaos"]:
+            raise ValueError(f"BENCH_r12 schema: missing chaos.{key}")
+    if doc["kills"] < 2:
+        raise ValueError(
+            f"BENCH_r12 schema: chaos run must include >= 2 hard "
+            f"kills, saw {doc['kills']}")
+    return doc
+
+
+def _resil_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_RESIL_CONFIG"]
+    if cfg == "control":
+        print(json.dumps(_resil_control_config()), flush=True)
+        return 0
+    result = _resil_chaos_attempt()
+    print(json.dumps(result), flush=True)
+    return 3 if result["status"] == "preempted" else 0
+
+
+def _resilience_main():
+    if os.environ.get("BENCH_RESIL_CONFIG"):
+        return _resil_child()
+
+    _stage("resilience: control config")
+    control = _ab_child("--resilience",
+                        dict(BENCH_RESIL_CONFIG="control"),
+                        timeout=300, label="resilience control")
+    if control is None:
+        return 1
+
+    workdir = tempfile.mkdtemp(prefix="bench_resil_")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    stats_file = os.path.join(workdir, "steps.txt")
+    env_base = dict(os.environ, JAX_PLATFORMS="cpu",
+                    BENCH_RESIL_CONFIG="chaos",
+                    BENCH_RESIL_DIR=ckpt_dir,
+                    BENCH_RESIL_STATS=stats_file)
+    attempts, kills, preemptions = [], 0, 0
+    final = None
+    for i, faults in enumerate(RESIL_PLAN):
+        _stage(f"resilience: chaos attempt {i + 1}/{len(RESIL_PLAN)} "
+               f"(faults: {faults or 'none'})")
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--resilience"],
+                env=dict(env_base, BENCH_RESIL_FAULTS=faults),
+                capture_output=True, text=True, timeout=300)
+        except subprocess.TimeoutExpired:
+            print(f"[bench] resilience attempt {i + 1} timed out",
+                  file=sys.stderr, flush=True)
+            return 1
+        if out.returncode < 0:
+            # SIGKILLed by the fault plan — exactly the point
+            kills += 1
+            attempts.append({"faults": faults, "rc": out.returncode,
+                             "outcome": "killed"})
+            continue
+        line = _harvest(out.stdout)
+        if line is None:
+            print(f"[bench] resilience attempt {i + 1} produced no "
+                  f"JSON: {out.stderr.strip()[-400:]}",
+                  file=sys.stderr, flush=True)
+            return 1
+        r = json.loads(line)
+        r["rc"] = out.returncode
+        attempts.append(r)
+        if out.returncode == 3:
+            preemptions += 1
+            continue
+        if out.returncode == 0:
+            final = r
+            break
+        print(f"[bench] resilience attempt {i + 1} failed (rc="
+              f"{out.returncode}): {out.stderr.strip()[-400:]}",
+              file=sys.stderr, flush=True)
+        return 1
+    if final is None or final.get("status") != "done":
+        print("[bench] resilience chaos run never completed",
+              file=sys.stderr, flush=True)
+        return 1
+    try:
+        with open(stats_file) as f:
+            total_executed = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        total_executed = final["total_steps_executed"]
+    goodput = RESIL_STEPS / max(total_executed, RESIL_STEPS)
+    bitwise = final["final_digest"] == control["final_digest"]
+    nan_injections = sum(1 for a in attempts
+                         if "nan_batch" in str(a.get("faults", "")))
+    doc = _resil_check_schema({
+        "metric": "resilience_goodput",
+        "value": round(goodput, 4),
+        "unit": "useful steps / total steps executed across kills",
+        "model": f"mlp {RESIL_HIDDEN}u adam batch={RESIL_BATCH} "
+                 f"save_every={RESIL_SAVE_EVERY}",
+        "steps": RESIL_STEPS,
+        "control": control,
+        "chaos": final,
+        "attempts": attempts,
+        "kills": kills,
+        "preemptions": preemptions,
+        "nan_injections": nan_injections,
+        "bitwise_identical": bool(bitwise),
+        "goodput": round(goodput, 4),
+        "goodput_over_090": bool(goodput >= 0.90),
+        "total_steps_executed": total_executed,
+    })
+    shutil.rmtree(workdir, ignore_errors=True)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_RESIL_OUT",
+                                           "BENCH_r12.json"))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    # the headline acceptance gates are ENFORCED, not just recorded —
+    # the document is still written above for diagnosis, but a harness
+    # keyed on the exit code must see the failure
+    if not doc["bitwise_identical"] or not doc["goodput_over_090"]:
+        print(f"[bench] resilience gates failed: bitwise_identical="
+              f"{doc['bitwise_identical']} goodput={doc['goodput']}",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # --router: fault-tolerant serving-fleet benchmark (CPU-runnable,
 # <3 min). Open-loop Poisson prompt traffic over a Router of
 # ROUTER_REPLICAS GenerationEngine replicas, two chaos configs, each
@@ -2265,6 +2542,8 @@ def _router_main():
 
 
 def main():
+    if "--resilience" in sys.argv:
+        return _resilience_main()
     if "--router" in sys.argv:
         return _router_main()
     if "--checkpoint" in sys.argv:

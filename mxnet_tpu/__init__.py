@@ -82,7 +82,7 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "model", "callback", "name", "attribute", "registry",
              "error", "log", "misc", "dlpack", "executor", "telemetry",
              "monitor", "bucketing", "compile_cache", "serving",
-             "checkpoint"):
+             "checkpoint", "resilience"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -1,11 +1,49 @@
 """Dynamic loss scaler (parity: python/mxnet/amp/loss_scaler.py).
 
 Only needed for float16; bfloat16 training runs unscaled on TPU.
+
+The overflow check is ONE jitted all-finite reduction over every
+gradient (``all_finite`` below, also used by the resilience
+subsystem's divergence watchdog): the old implementation dispatched a
+per-parameter ``isfinite().all()`` plus a chain of eager
+``logical_and`` ops — O(params) dispatches per step — where one fused
+program costs a single dispatch and a single scalar fetch.
 """
 from __future__ import annotations
 
-import numpy as onp
+import jax
 import jax.numpy as jnp
+
+from .. import telemetry
+
+
+_finite_jit = None
+
+
+def _finite_fn(xs):
+    acc = jnp.bool_(True)
+    for x in xs:
+        if jnp.issubdtype(x.dtype, jnp.inexact):
+            acc = jnp.logical_and(acc, jnp.isfinite(x).all())
+    return acc
+
+
+def all_finite(arrays) -> bool:
+    """True iff every element of every (floating) array is finite.
+
+    One jitted reduction over the whole tuple — a single dispatch and
+    ONE host sync regardless of parameter count (jit retraces per
+    distinct shape signature, which is stable across a training run).
+    Integer arrays pass trivially. Shared by
+    :meth:`LossScaler.has_overflow` and the resilience watchdog's
+    parameter check (``mxnet_tpu/resilience/watchdog.py``)."""
+    global _finite_jit
+    arrays = tuple(a for a in arrays if isinstance(a, jax.Array))
+    if not arrays:
+        return True
+    if _finite_jit is None:
+        _finite_jit = jax.jit(_finite_fn)
+    return bool(_finite_jit(arrays))
 
 
 class LossScaler:
@@ -15,28 +53,35 @@ class LossScaler:
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        # monotone trip count — the TrainSupervisor compares it across
+        # a step to classify an overflow-skip as NOT divergence even
+        # with telemetry disabled (it also travels in the checkpoint's
+        # amp_scaler metadata, harmlessly)
+        self.overflow_count = 0
 
     def has_overflow(self, params):
         """Check grads for inf/nan (parity: multi_all_finite kernel).
 
-        All per-grad reductions stay on device and combine into one
-        scalar — a single host sync per step, not one per parameter."""
-        finites = []
+        One fused jitted reduction over every gradient (see
+        :func:`all_finite`) — a single dispatch + host sync per step.
+        Trips are counted as ``amp.overflow`` so a run burning steps
+        on overflow skips is visible in telemetry."""
+        grads = []
         for p in params:
             if p.grad_req == "null" or p._data is None or \
                     p._data._grad is None:
                 continue
-            g = p._data._grad._data
-            finites.append(jnp.isfinite(jnp.asarray(g, jnp.float32)).all())
-        if not finites:
+            grads.append(p._data._grad._data)
+        if not grads:
             return False
-        all_finite = finites[0]
-        for f in finites[1:]:
-            all_finite = jnp.logical_and(all_finite, f)
-        return not bool(all_finite)
+        overflow = not all_finite(grads)
+        if overflow:
+            telemetry.counter("amp.overflow")
+        return overflow
 
     def update_scale(self, overflow: bool):
         if overflow:
+            self.overflow_count += 1
             self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
             self._unskipped = 0
         else:

@@ -32,6 +32,7 @@ histograms ``checkpoint.{save,restore}.duration_ms``, gauge
 """
 from __future__ import annotations
 
+import atexit
 import json
 import threading
 import time
@@ -294,6 +295,23 @@ def _committed_steps(root, fs):
 # the manager
 # ---------------------------------------------------------------------------
 
+# Interpreter-exit flush: an async save queued moments before the
+# process falls off the end of a script would be silently lost (the
+# writer is a daemon thread — the interpreter does not join it). Every
+# live manager registers here; one atexit hook flushes them all. A
+# manager that was close()d or collected has already left the set.
+_live_managers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+@atexit.register
+def _flush_live_managers():
+    for mgr in list(_live_managers):
+        try:
+            mgr.close(timeout=60.0)
+        except Exception:  # noqa: BLE001 — exit path: never raise
+            pass
+
+
 class _SaveWorker(BoundedQueueWorker):
     """Writer thread: drains queued (step, snapshot) items through
     ``CheckpointManager._write_step``. Holds only a weakref to the
@@ -372,11 +390,17 @@ class CheckpointManager:
         self._fs = fs or LocalFS()
         self._fs.makedirs(self.directory)
         self._lock = threading.Lock()
+        # serializes actual checkpoint writes + retention GC between
+        # the async worker and the synchronous flush path (save_sync):
+        # two writers target distinct step dirs, but GC's listdir/
+        # rmtree sweep must not race a half-written sibling
+        self._io_lock = threading.Lock()
         self._pending: list = []
         self._error = None
         self._closed = False
         self._worker = _SaveWorker(self, max(1, int(max_pending))) \
             if async_save else None
+        _live_managers.add(self)
 
     # -- error/pending plumbing ----------------------------------------
     def _set_error(self, e):
@@ -434,15 +458,33 @@ class CheckpointManager:
             evt.wait()
             self._raise_pending_error()
 
+    def save_sync(self, step: int, tree, metadata=None):
+        """Synchronous commit on the CALLER thread, bypassing the
+        async queue — the flush-on-signal path. A SIGTERM handler that
+        must persist the current step before the process dies cannot
+        queue behind ``max_pending`` earlier saves; this writes (and
+        commits) directly, serialized with the worker only around the
+        actual file I/O. Returns once the ``COMMITTED`` marker is on
+        disk."""
+        if self._closed:
+            raise CheckpointError("save_sync on a closed "
+                                  "CheckpointManager")
+        step = int(step)
+        if step < 0:
+            raise ValueError(f"step must be >= 0, got {step}")
+        snap = snapshot_tree(tree)
+        self._write_step(step, snap, metadata)
+
     def _write_step(self, step, snap, metadata):
         import os
         meta = dict(metadata or {})
         meta.setdefault("step", step)
-        write_checkpoint(
-            os.path.join(self.directory, _step_dirname(step)), snap,
-            metadata=meta, fs=self._fs, max_retries=self.max_retries,
-            backoff_s=self.backoff_s)
-        self.gc()
+        with self._io_lock:
+            write_checkpoint(
+                os.path.join(self.directory, _step_dirname(step)), snap,
+                metadata=meta, fs=self._fs, max_retries=self.max_retries,
+                backoff_s=self.backoff_s)
+            self.gc()
 
     def wait(self, timeout=None):
         """Block until every queued save is committed (or failed);
@@ -474,6 +516,22 @@ class CheckpointManager:
     def step_dir(self, step: int) -> str:
         import os
         return os.path.join(self.directory, _step_dirname(int(step)))
+
+    def read_metadata(self, step: int) -> dict:
+        """Metadata of a committed step WITHOUT reading its shards —
+        one small manifest read. The cheap way to inspect tags/epochs
+        across many candidates (the estimator resume path) before
+        paying a full verified restore for the chosen one."""
+        import os
+        step = int(step)
+        try:
+            manifest = json.loads(self._fs.read_bytes(
+                os.path.join(self.step_dir(step), MANIFEST_FILE)))
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                f"unreadable manifest for step {step} under "
+                f"{self.directory}: {e!r}") from e
+        return manifest.get("metadata", {})
 
     def restore(self, step=None):
         """Load a committed checkpoint -> ``(step, tree, metadata)``.
@@ -543,6 +601,7 @@ class CheckpointManager:
         if self._closed:
             return
         self._closed = True
+        _live_managers.discard(self)
         if self._worker is not None:
             try:
                 self.wait(timeout=timeout)

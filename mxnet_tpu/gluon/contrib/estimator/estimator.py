@@ -165,24 +165,52 @@ class Estimator:
         train_begin, epoch_begin, batch_begin, batch_end, epoch_end, \
             train_end = self._categorize(handlers)
 
-        for h in train_begin:
-            h.train_begin(self)
-        while not self.stop_training:
-            for h in epoch_begin:
-                h.epoch_begin(self)
-            for batch in train_data:
-                for h in batch_begin:
-                    h.batch_begin(self, batch=batch)
-                _, label, pred, loss = self.fit_batch(batch, batch_axis)
-                for h in batch_end:
-                    h.batch_end(self, batch=batch, pred=pred,
-                                label=label, loss=loss)
-                if self.stop_training:
-                    break
-            for h in epoch_end:
-                h.epoch_end(self)
-        for h in train_end:
-            h.train_end(self)
+        try:
+            # train_begin inside the guard: a later handler's
+            # train_begin raising must still trigger the run_on_error
+            # cleanup of handlers that already began (e.g. installed
+            # process signal handlers)
+            for h in train_begin:
+                h.train_begin(self)
+            while not self.stop_training:
+                for h in epoch_begin:
+                    h.epoch_begin(self)
+                for batch in train_data:
+                    for h in batch_begin:
+                        h.batch_begin(self, batch=batch)
+                    _, label, pred, loss = self.fit_batch(batch,
+                                                          batch_axis)
+                    for h in batch_end:
+                        h.batch_end(self, batch=batch, pred=pred,
+                                    label=label, loss=loss)
+                    if self.stop_training:
+                        break
+                for h in epoch_end:
+                    h.epoch_end(self)
+        except BaseException:
+            # a crashed fit still runs train_end for handlers that
+            # opted in (run_on_error) — e.g. ResilienceHandler must
+            # restore the process signal handlers it installed, or a
+            # failed fit permanently disables Ctrl+C
+            self._run_train_end_on_error(train_end)
+            raise
+        for i, h in enumerate(train_end):
+            try:
+                h.train_end(self)
+            except BaseException:
+                # an earlier train_end raising (e.g. a manager.wait()
+                # surfacing a failed async save) must not skip later
+                # run_on_error handlers' cleanup
+                self._run_train_end_on_error(train_end[i + 1:])
+                raise
+
+    def _run_train_end_on_error(self, handlers):
+        for h in handlers:
+            if getattr(h, "run_on_error", False):
+                try:
+                    h.train_end(self)
+                except Exception:  # noqa: BLE001 — cleanup path
+                    pass
 
     # -- handler plumbing ----------------------------------------------
     def _prepare_handlers(self, val_data, event_handlers):

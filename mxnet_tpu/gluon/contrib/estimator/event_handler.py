@@ -18,7 +18,7 @@ __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
            "EarlyStoppingHandler", "GradientUpdateHandler", "NaNStoppingHandler",
-           "GradientClippingHandler"]
+           "GradientClippingHandler", "ResilienceHandler"]
 
 
 class EventHandler:
@@ -360,6 +360,153 @@ class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd, TrainEnd):
             # flush: queued async saves must be committed before the
             # process (or the fit caller) moves on
             self.manager.wait()
+
+
+class ResilienceHandler(CheckpointHandler):
+    """Preemption-safe checkpointing for ``Estimator.fit`` — the
+    estimator face of ``mxnet_tpu.resilience`` (docs/RESILIENCE.md).
+
+    Extends :class:`CheckpointHandler` (manager-backed) with:
+
+    - **flush-on-signal**: SIGTERM/SIGINT set a flag; at the next
+      batch boundary the handler commits a SYNCHRONOUS checkpoint
+      (``manager.save_sync`` — it cannot queue behind earlier async
+      saves) tagged ``batch<N>``, counts ``resilience.preemptions``,
+      and stops the fit loop cleanly;
+    - **heartbeat**: ``resilience.heartbeat`` / ``heartbeat_step``
+      gauges per batch, so an external supervisor can tell a slow
+      step from a dead one;
+    - **determinism-preserving resume**: ``fit`` is epoch-granular
+      (each epoch re-iterates the data from the top), so resuming
+      from a MID-epoch (batch-tag) save would train the interrupted
+      epoch on partially-advanced params — approximately right,
+      bitwise wrong. This handler resumes from the latest
+      *epoch-boundary* commit instead and re-runs the interrupted
+      epoch exactly, so the resumed fit's final metrics match an
+      uninterrupted run (exact mid-epoch resume is the
+      ``TrainSupervisor`` + resumable-iterator path).
+    """
+
+    def __init__(self, model_dir, manager=None, epoch_period=1,
+                 batch_period=None, verbose=0, **kwargs):
+        if manager is None:
+            from .... import checkpoint as _ckpt
+            manager = _ckpt.CheckpointManager(model_dir)
+        super().__init__(model_dir, manager=manager,
+                         epoch_period=epoch_period,
+                         batch_period=batch_period, verbose=verbose,
+                         resume_from_checkpoint=True, **kwargs)
+        self._preempt_flag = False
+        self._preempt_signum = None
+        self._preempted_stop = False
+        self._prev_handlers = None
+
+    # -- signals -------------------------------------------------------
+    def _on_signal(self, signum, frame):  # noqa: ARG002 — signal API
+        self._preempt_flag = True
+        self._preempt_signum = signum
+
+    # opt-in: Estimator.fit runs our train_end even when the fit loop
+    # raises, so the installed signal handlers can never leak
+    run_on_error = True
+
+    def train_begin(self, estimator, *args, **kwargs):
+        import signal
+        import threading
+        self._preempt_flag = False
+        # a prior preempted fit on this SAME handler instance must not
+        # leave epoch_end saves suppressed for the resumed fit
+        self._preempted_stop = False
+        super().train_begin(estimator, *args, **kwargs)
+        if threading.current_thread() is threading.main_thread():
+            self._prev_handlers = {
+                sig: signal.signal(sig, self._on_signal)
+                for sig in (signal.SIGTERM, signal.SIGINT)}
+
+    def _resume(self, estimator):
+        """Resume from the latest EPOCH-boundary commit (see class
+        docstring); batch-tag (preemption-flush) commits are kept on
+        disk for inspection but skipped as resume points. Candidate
+        tags are read from the manifests alone (no shard I/O); only
+        the chosen step pays a full verified restore. If retention
+        evicted every epoch-boundary commit (a preemption-heavy
+        window of batch-tag flushes), fall back to the plain
+        CheckpointHandler resume — the latest commit with tag-aware
+        accounting: approximate (the interrupted epoch re-runs on
+        mid-epoch params) but never a silent restart from scratch."""
+        from .... import checkpoint as _ckpt
+        steps = self.manager.all_steps()
+        for step in reversed(steps):
+            try:
+                tag = str(self.manager.read_metadata(step).get(
+                    "tag", ""))
+                if not tag.startswith("epoch"):
+                    continue
+                _, tree, meta = self.manager.restore(step=step)
+            except _ckpt.CheckpointCorruptError:
+                continue
+            _ckpt.apply_training_state(tree, meta, net=estimator.net,
+                                       trainer=estimator.trainer)
+            self.trained_epoch = int(meta.get("epoch", -1))
+            self.current_epoch = self.trained_epoch + 1
+            self.current_batch = int(meta.get("batch", step))
+            self.logger.info(
+                "resumed from epoch-boundary checkpoint step %d (%s)",
+                step, tag)
+            return
+        if steps:
+            self.logger.warning(
+                "no epoch-boundary checkpoint survives retention "
+                "(only mid-epoch preemption flushes); falling back to "
+                "the latest commit — the interrupted epoch re-runs on "
+                "mid-epoch params (approximate, not bit-deterministic)")
+            super()._resume(estimator)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        from .... import telemetry
+        super().batch_end(estimator, *args, **kwargs)
+        telemetry.gauge("resilience.heartbeat_step", self.current_batch)
+        telemetry.gauge("resilience.heartbeat", time.time())
+        if self._preempt_flag:
+            self._preempt_flag = False
+            telemetry.counter("resilience.preemptions")
+            from .... import checkpoint as _ckpt
+            tree, meta = _ckpt.capture_training_state(
+                net=estimator.net, trainer=estimator.trainer)
+            meta.update({"epoch": self.current_epoch,
+                         "batch": self.current_batch,
+                         "tag": f"batch{self.current_batch}",
+                         "preempted": True})
+            self.manager.save_sync(self.current_batch, tree,
+                                   metadata=meta)
+            self.logger.warning(
+                "preemption signal %s: flushed checkpoint at batch %d;"
+                " stopping fit", self._preempt_signum,
+                self.current_batch)
+            self._preempted_stop = True
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        # the fit loop still runs epoch_end handlers after a mid-epoch
+        # stop_training break; saving an "epoch<N>" tag there would
+        # label the INTERRUPTED epoch as trained and resume past its
+        # untrained tail
+        if self._preempted_stop:
+            return
+        super().epoch_end(estimator, *args, **kwargs)
+
+    def train_end(self, estimator, *args, **kwargs):
+        import signal
+        try:
+            super().train_end(estimator, *args, **kwargs)
+        finally:
+            # even if the manager's final wait() raises (failed async
+            # save), the process signal handlers MUST come back — a
+            # leak leaves Ctrl+C dead for the rest of the process
+            if self._prev_handlers:
+                for sig, h in self._prev_handlers.items():
+                    signal.signal(sig, h)
+                self._prev_handlers = None
 
 
 class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):

@@ -248,6 +248,7 @@ class DataLoader:
                 "batch_size, shuffle, sampler and last_batch must not be "
                 "specified if batch_sampler is specified.")
         self._batch_sampler = batch_sampler
+        self._skip_next = 0
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._thread_pool = thread_pool
         self._timeout = timeout
@@ -307,6 +308,12 @@ class DataLoader:
         return self._proc_pool
 
     def _proc_iter(self):
+        # claim any armed skip at ITERATOR CREATION time (this is a
+        # plain function; the generator below would defer the claim to
+        # its first next(), diverging from the single-process path)
+        return self._proc_iter_inner(self._indices_iter())
+
+    def _proc_iter_inner(self, batches):
         """Process-worker epoch: a bounded window of in-flight batches
         (the prefetch depth) keeps workers busy without unbounded
         memory; results rebuild in order."""
@@ -314,7 +321,6 @@ class DataLoader:
         pool = self._ensure_proc_pool()
         depth = max(self._prefetch, self._num_workers)
         pending = deque()
-        batches = iter(self._batch_sampler)
 
         def submit():
             try:
@@ -358,10 +364,51 @@ class DataLoader:
                 except Exception:  # noqa: BLE001 — best-effort reap
                     pass
 
+    def skip_batches(self, n: int):
+        """Arm a fast-forward: the next ``__iter__`` (epoch) skips its
+        first ``n`` sampler batches WITHOUT loading or collating them
+        — the samples are never touched, only the sampler's index
+        stream is consumed (so a shuffled epoch burns the same RNG
+        draws a real consumption would). A skip larger than one epoch
+        carries its remainder into the following ``__iter__`` — the
+        epoch-boundary case. Used by the resilience watchdog's
+        poisoned-batch skip and by mid-epoch resume loops."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"skip_batches needs n >= 0, got {n}")
+        self._skip_next += n
+        return n
+
+    def _indices_iter(self):
+        """The sampler stream with any armed skip_batches() applied:
+        skipped index-batches are consumed from the sampler but never
+        reach the dataset/batchify stage. The armed count is claimed
+        HERE (iterator creation), so an epoch already in flight — or
+        one running ahead behind a prefetcher — is untouched by a
+        mid-epoch skip_batches() call, exactly as the docstring
+        promises; an unconsumed remainder is handed back for the
+        following epoch."""
+        skip, self._skip_next = self._skip_next, 0
+
+        def gen(skip):
+            for idxs in self._batch_sampler:
+                if skip > 0:
+                    skip -= 1
+                    continue
+                yield idxs
+            # carry fires ONLY on sampler exhaustion (the epoch was
+            # shorter than the skip) — never on abandonment
+            # (GeneratorExit), where a finally would re-arm the
+            # remainder at GC time against an arbitrary later epoch
+            if skip > 0:
+                self._skip_next += skip
+
+        return gen(skip)
+
     def __iter__(self):
         if self._num_workers > 0 and not self._thread_pool:
             return self._proc_iter()
-        it = (self._make_batch(batch) for batch in self._batch_sampler)
+        it = (self._make_batch(batch) for batch in self._indices_iter())
         if self._prefetch > 0:
             return iter(_Prefetcher(it, self._prefetch))
         return it

@@ -189,6 +189,39 @@ class NDArrayIter(DataIter):
             return self._pad_target(real) - real
         return 0
 
+    def skip_batches(self, n: int) -> int:
+        """Fast-forward ``n`` batches without materializing data.
+
+        Performs exactly the cursor math of calling ``next()`` ``n``
+        times with reset-on-exhaustion (the training-loop idiom:
+        ``StopIteration -> reset() -> next()``) minus the data slicing
+        — including the epoch-boundary ``reset()`` itself, so a
+        shuffled iterator consumes the same ambient-numpy RNG draws a
+        real consumption would, and ``roll_over`` remainders carry
+        identically. This is the divergence watchdog's poisoned-batch
+        skip (``mxnet_tpu/resilience/``): after a rewind, the batch
+        window that poisoned the params is jumped, not replayed.
+        Returns ``n``."""
+        n = int(n)
+        if n < 0:
+            raise ValueError(f"skip_batches needs n >= 0, got {n}")
+        skipped = 0
+        while skipped < n:
+            progressed = False
+            while skipped < n and self.iter_next():
+                skipped += 1
+                progressed = True
+            if skipped < n:
+                if not progressed and self.cursor <= 0:
+                    # an epoch that yields zero batches (dataset
+                    # smaller than batch_size under 'discard') would
+                    # spin forever
+                    raise ValueError(
+                        "skip_batches on an iterator whose epoch "
+                        "yields no batches")
+                self.reset()
+        return skipped
+
     # -- resumable iteration (mxnet_tpu.checkpoint) --------------------
     def state_dict(self):
         """Mid-epoch position snapshot: the cursor plus the epoch's

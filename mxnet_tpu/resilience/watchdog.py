@@ -1,0 +1,205 @@
+"""Divergence and hang detection for the TrainSupervisor.
+
+Two independent killers of long runs, two watchdogs:
+
+- :class:`DivergenceWatchdog` — a cheap per-step-boundary health
+  check on the loss stream: non-finite loss, optionally a fused
+  all-finite sweep of the parameters (ONE jitted reduction, shared
+  with ``amp.loss_scaler.all_finite``), and a loss-spike test against
+  an exponential moving average with an EMA of absolute deviation as
+  the scale. AMP overflow-skips are explicitly NOT divergence — the
+  loss scaler already skipped the update and shrank the scale; the
+  supervisor passes ``amp_overflow=True`` and the watchdog stands
+  down for that step (and keeps the spiked sample out of its EMA).
+
+- :class:`HangWatchdog` — a per-step deadline on a companion thread.
+  ``arm()`` at step start, ``disarm()`` at step end; on expiry it
+  raises :class:`StepHangError` *asynchronously in the training
+  thread* (CPython ``PyThreadState_SetAsyncExc``), which aborts the
+  stuck step at its next bytecode boundary — a Python-level stall
+  (lock, sleep, retry loop, slow host preprocessing) is reclaimed
+  in-process; a hang inside a C extension only aborts once control
+  returns to Python, and a truly wedged device step is the
+  process-level supervisor's job (kill + restart, which the
+  checkpoint subsystem already makes safe).
+"""
+from __future__ import annotations
+
+import ctypes
+import math
+import threading
+import time
+
+from .. import telemetry
+from ..amp.loss_scaler import all_finite
+
+__all__ = ["DivergenceWatchdog", "HangWatchdog", "StepHangError",
+           "DivergenceError"]
+
+
+class DivergenceError(RuntimeError):
+    """The watchdog rewound ``max_consecutive_rewinds`` times without
+    making progress — the run is actually diverging (bad LR, corrupted
+    optimizer state), not hitting a poisoned batch. Escalated to the
+    caller instead of burning the schedule on futile rewinds."""
+
+
+class StepHangError(RuntimeError):
+    """A training step exceeded its deadline and was asynchronously
+    aborted by the :class:`HangWatchdog`."""
+
+
+class DivergenceWatchdog:
+    """Step-boundary divergence detection (see module docstring).
+
+    Parameters
+    ----------
+    ema_beta : float
+        Smoothing of the loss EMA and its absolute-deviation EMA.
+    spike_factor : float
+        Trip when ``loss - ema > spike_factor * max(dev, rel_floor *
+        |ema| + 1e-8)``. Only upward spikes trip — a fast drop is
+        progress, not divergence.
+    rel_floor : float
+        Deviation floor relative to ``|ema|`` so a converged, flat
+        loss stream does not trip on noise.
+    warmup_steps : int
+        Spike detection starts after this many observed steps (the
+        first steps of a run legitimately move fast). Finiteness is
+        checked from step one.
+    check_params : bool
+        Also sweep the parameters with the fused all-finite reduction
+        every step — catches NaN *gradients* the step they poison the
+        weights (the loss of that step was computed before the bad
+        update) at the cost of one extra device program + scalar
+        fetch per step. Off by default; a NaN weight surfaces in the
+        next step's loss anyway.
+    """
+
+    def __init__(self, ema_beta: float = 0.9, spike_factor: float = 10.0,
+                 rel_floor: float = 0.1, warmup_steps: int = 8,
+                 check_params: bool = False):
+        if not 0.0 < ema_beta < 1.0:
+            raise ValueError(f"ema_beta in (0,1), got {ema_beta}")
+        if spike_factor <= 0:
+            raise ValueError(f"spike_factor > 0, got {spike_factor}")
+        self.ema_beta = float(ema_beta)
+        self.spike_factor = float(spike_factor)
+        self.rel_floor = float(rel_floor)
+        self.warmup_steps = int(warmup_steps)
+        self.check_params = bool(check_params)
+        self.reset()
+
+    def reset(self):
+        self._ema = None
+        self._dev = 0.0
+        self._n = 0
+
+    def check(self, loss: float, params=None,
+              amp_overflow: bool = False) -> bool:
+        """Observe one step's (host) loss; return True on a trip.
+
+        A tripped sample is kept OUT of the EMA — the statistics keep
+        describing the healthy stream the rewound run returns to."""
+        if amp_overflow:
+            # the loss scaler already skipped this update; expected
+            # fp16 behavior, not divergence
+            return False
+        loss = float(loss)
+        if not math.isfinite(loss):
+            return True
+        if params is not None and self.check_params and \
+                not all_finite(params):
+            return True
+        if self._ema is not None and self._n >= self.warmup_steps:
+            floor = self.rel_floor * abs(self._ema) + 1e-8
+            if loss - self._ema > self.spike_factor * \
+                    max(self._dev, floor):
+                return True
+        if self._ema is None:
+            self._ema = loss
+        else:
+            b = self.ema_beta
+            self._ema = b * self._ema + (1 - b) * loss
+            self._dev = b * self._dev + (1 - b) * abs(loss - self._ema)
+        self._n += 1
+        return False
+
+
+def _async_raise(tid: int, exc_type) -> bool:
+    """Raise ``exc_type`` asynchronously in thread ``tid`` (CPython)."""
+    res = ctypes.pythonapi.PyThreadState_SetAsyncExc(
+        ctypes.c_ulong(tid), ctypes.py_object(exc_type))
+    if res > 1:  # pragma: no cover — undo on over-delivery per C API docs
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(ctypes.c_ulong(tid),
+                                                   None)
+    return res == 1
+
+
+class HangWatchdog:
+    """Per-step deadline watchdog (see module docstring). One-shot per
+    ``arm()``; reusable across steps; ``close()`` stops the thread."""
+
+    def __init__(self, timeout_s: float):
+        if timeout_s <= 0:
+            raise ValueError(f"timeout_s > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self._cv = threading.Condition()
+        self._deadline = None
+        self._target_tid = None
+        self._epoch = 0  # bumped by every arm/disarm: fire re-checks
+        self._closed = False
+        self.fired = 0
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="HangWatchdog")
+        self._thread.start()
+
+    def arm(self):
+        """Start the deadline for the CALLING thread's current step."""
+        with self._cv:
+            self._target_tid = threading.get_ident()
+            self._deadline = time.monotonic() + self.timeout_s
+            self._epoch += 1
+            self._cv.notify()
+
+    def disarm(self):
+        with self._cv:
+            self._deadline = None
+            self._epoch += 1
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            self._deadline = None
+            self._cv.notify()
+        self._thread.join(timeout=2.0)
+
+    def _run(self):
+        while True:
+            with self._cv:
+                if self._closed:
+                    return
+                if self._deadline is None:
+                    self._cv.wait(timeout=0.5)
+                    continue
+                remaining = self._deadline - time.monotonic()
+                if remaining > 0:
+                    self._cv.wait(timeout=remaining)
+                    continue
+                # expired and still armed: abort the step
+                tid = self._target_tid
+                epoch = self._epoch
+                self._deadline = None
+            # re-check right before the raise: a disarm() that slipped
+            # in while we held no lock means the step actually
+            # finished — do not poison the boundary code. (The raise
+            # itself is asynchronous; a disarm in the remaining
+            # microseconds leaves a stale StepHangError that the
+            # supervisor's restart path absorbs as bounded waste, and
+            # its final-flush guard ignores — never corruption.)
+            with self._cv:
+                if self._epoch != epoch:
+                    continue
+            self.fired += 1
+            telemetry.counter("resilience.hangs")
+            _async_raise(tid, StepHangError)

@@ -998,6 +998,37 @@ def _ab_child(flag, env_overrides, timeout=600, label=None):
     return json.loads(line)
 
 
+def _check_schema(name, doc, required, nested=None, gates=None):
+    """Shared bench-document contract check: fail the bench rather
+    than publish a malformed document (it used to exist as near-copies
+    per bench — ``_ckpt_check_schema`` and friends).
+
+    ``required`` maps top-level key -> expected type; ``nested`` maps
+    a dict-valued key -> its required subkeys; ``gates`` is an
+    iterable of ``(description, predicate)`` — structural invariants a
+    publishable document must satisfy (e.g. the chaos run really
+    included its kills). Returns ``doc`` so call sites stay one
+    expression."""
+    for key, typ in required.items():
+        if key not in doc:
+            raise ValueError(f"{name} schema: missing key {key!r}")
+        if not isinstance(doc[key], typ):
+            raise ValueError(
+                f"{name} schema: {key!r} is "
+                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
+    for parent, subkeys in (nested or {}).items():
+        sub = doc.get(parent)
+        if not isinstance(sub, dict):
+            raise ValueError(f"{name} schema: {parent!r} must be a dict")
+        for key in subkeys:
+            if key not in sub:
+                raise ValueError(f"{name} schema: missing {parent}.{key}")
+    for desc, pred in (gates or ()):
+        if not pred(doc):
+            raise ValueError(f"{name} schema: {desc}")
+    return doc
+
+
 class _BoxedThread(threading.Thread):
     """Bench worker thread with an exception box: a dead or stuck
     worker fails the bench loudly instead of letting it publish a
@@ -1177,7 +1208,7 @@ def _serving_main():
         if results[cfg] is None:
             return 1
     perreq, eng = results["perreq"], results["engine"]
-    doc = {
+    doc = _check_schema("BENCH_r08", {
         "metric": "serving_requests_per_sec",
         "value": eng["requests_per_sec"],
         "unit": "requests/sec",
@@ -1197,7 +1228,11 @@ def _serving_main():
             / max(perreq["requests_per_sec"], 1e-9), 2),
         "p99_latency_ratio": round(
             eng["p99_ms"] / max(perreq["p99_ms"], 1e-9), 4),
-    }
+    }, required={"metric": str, "value": float, "unit": str,
+                 "model": str, "engine": dict, "perreq": dict,
+                 "throughput_ratio": float, "p99_latency_ratio": float},
+       nested={"engine": ("requests_per_sec", "p99_ms"),
+               "perreq": ("requests_per_sec", "p99_ms")})
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             os.environ.get("BENCH_SERVING_OUT",
                                            "BENCH_r08.json"))
@@ -1534,7 +1569,7 @@ def _generate_main():
         if results[cfg] is None:
             return 1
     static, eng = results["static"], results["engine"]
-    doc = {
+    doc = _check_schema("BENCH_r09", {
         "metric": "generate_tokens_per_sec",
         "value": eng["tokens_per_sec"],
         "unit": "generated tokens/sec",
@@ -1554,7 +1589,13 @@ def _generate_main():
             / max(static["tokens_per_sec"], 1e-9), 2),
         "ttft_p99_ratio": round(
             eng["ttft_p99_ms"] / max(static["ttft_p99_ms"], 1e-9), 4),
-    }
+    }, required={"metric": str, "value": float, "unit": str,
+                 "model": str, "engine": dict, "static": dict,
+                 "throughput_ratio": float, "ttft_p99_ratio": float},
+       nested={"engine": ("tokens_per_sec", "ttft_p99_ms",
+                          "compiles_in_window"),
+               "static": ("tokens_per_sec", "ttft_p99_ms",
+                          "compiles_in_window")})
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             os.environ.get("BENCH_GEN_OUT",
                                            "BENCH_r09.json"))
@@ -1720,33 +1761,24 @@ def _ckpt_restore_config():
     }
 
 
-def _ckpt_check_schema(doc):
-    """BENCH_r10.json contract — fail the bench rather than publish a
-    malformed document (the satellite's schema check)."""
-    required = {
-        "metric": str, "value": float, "unit": str, "model": str,
-        "n_devices": int, "async": dict, "sync": dict, "restore": dict,
-        "sync_vs_async_stall_ratio": float,
-        "async_stall_under_10pct": bool, "resume_bit_identical": bool,
-    }
-    for key, typ in required.items():
-        if key not in doc:
-            raise ValueError(f"BENCH_r10 schema: missing key {key!r}")
-        if not isinstance(doc[key], typ):
-            raise ValueError(
-                f"BENCH_r10 schema: {key!r} is "
-                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
-    for cfg in ("async", "sync"):
-        for key in ("stall_ms", "stall_frac_of_step",
+_CKPT_STALL_KEYS = ("stall_ms", "stall_frac_of_step",
                     "mean_plain_step_ms", "mean_save_step_ms", "saves",
-                    "checkpoint_bytes"):
-            if key not in doc[cfg]:
-                raise ValueError(
-                    f"BENCH_r10 schema: missing {cfg}.{key}")
-    for key in ("restore_ms", "bit_identical"):
-        if key not in doc["restore"]:
-            raise ValueError(f"BENCH_r10 schema: missing restore.{key}")
-    return doc
+                    "checkpoint_bytes")
+
+
+def _ckpt_check_schema(doc):
+    """BENCH_r10.json contract (spec for the shared _check_schema)."""
+    return _check_schema(
+        "BENCH_r10", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "n_devices": int, "async": dict, "sync": dict,
+            "restore": dict, "sync_vs_async_stall_ratio": float,
+            "async_stall_under_10pct": bool,
+            "resume_bit_identical": bool,
+        },
+        nested={"async": _CKPT_STALL_KEYS, "sync": _CKPT_STALL_KEYS,
+                "restore": ("restore_ms", "bit_identical")})
 
 
 def _ckpt_child():
@@ -1943,34 +1975,21 @@ def _resil_chaos_attempt():
 
 
 def _resil_check_schema(doc):
-    """BENCH_r12.json contract — fail the bench rather than publish a
-    malformed document."""
-    required = {
-        "metric": str, "value": float, "unit": str, "model": str,
-        "steps": int, "control": dict, "chaos": dict, "attempts": list,
-        "kills": int, "preemptions": int, "nan_injections": int,
-        "bitwise_identical": bool, "goodput": float,
-        "goodput_over_090": bool,
-    }
-    for key, typ in required.items():
-        if key not in doc:
-            raise ValueError(f"BENCH_r12 schema: missing key {key!r}")
-        if not isinstance(doc[key], typ):
-            raise ValueError(
-                f"BENCH_r12 schema: {key!r} is "
-                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
-    for key in ("final_digest", "steps_per_sec", "steps"):
-        if key not in doc["control"]:
-            raise ValueError(f"BENCH_r12 schema: missing control.{key}")
-    for key in ("final_digest", "status", "total_steps_executed",
-                "telemetry"):
-        if key not in doc["chaos"]:
-            raise ValueError(f"BENCH_r12 schema: missing chaos.{key}")
-    if doc["kills"] < 2:
-        raise ValueError(
-            f"BENCH_r12 schema: chaos run must include >= 2 hard "
-            f"kills, saw {doc['kills']}")
-    return doc
+    """BENCH_r12.json contract (spec for the shared _check_schema)."""
+    return _check_schema(
+        "BENCH_r12", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "steps": int, "control": dict, "chaos": dict,
+            "attempts": list, "kills": int, "preemptions": int,
+            "nan_injections": int, "bitwise_identical": bool,
+            "goodput": float, "goodput_over_090": bool,
+        },
+        nested={"control": ("final_digest", "steps_per_sec", "steps"),
+                "chaos": ("final_digest", "status",
+                          "total_steps_executed", "telemetry")},
+        gates=[(f"chaos run must include >= 2 hard kills, saw "
+                f"{doc.get('kills')}", lambda d: d["kills"] >= 2)])
 
 
 def _resil_child():
@@ -2447,32 +2466,23 @@ def _router_rollover(rate_rps):
 
 
 def _router_check_schema(doc):
-    """BENCH_r11.json contract — fail the bench rather than publish a
-    malformed document."""
-    required = {
-        "metric": str, "value": float, "unit": str, "model": str,
-        "replicas": int, "chaos": dict, "rollover": dict,
-        "chaos_success_ge_99pct": bool, "retry_token_identical": bool,
-        "zero_dropped_during_rollover": bool,
-    }
-    for key, typ in required.items():
-        if key not in doc:
-            raise ValueError(f"BENCH_r11 schema: missing key {key!r}")
-        if not isinstance(doc[key], typ):
-            raise ValueError(
-                f"BENCH_r11 schema: {key!r} is "
-                f"{type(doc[key]).__name__}, wanted {typ.__name__}")
-    for key in ("success_rate", "retries", "latency_p99_ms",
-                "goodput_tokens_per_sec_pre_kill",
-                "goodput_tokens_per_sec_post_kill", "recovery_s",
-                "killed_replica_state"):
-        if key not in doc["chaos"]:
-            raise ValueError(f"BENCH_r11 schema: missing chaos.{key}")
-    for key in ("dropped", "weight_swaps", "replicas_swapped",
-                "post_rollover_tokens_match_new_weights"):
-        if key not in doc["rollover"]:
-            raise ValueError(f"BENCH_r11 schema: missing rollover.{key}")
-    return doc
+    """BENCH_r11.json contract (spec for the shared _check_schema)."""
+    return _check_schema(
+        "BENCH_r11", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "replicas": int, "chaos": dict, "rollover": dict,
+            "chaos_success_ge_99pct": bool,
+            "retry_token_identical": bool,
+            "zero_dropped_during_rollover": bool,
+        },
+        nested={
+            "chaos": ("success_rate", "retries", "latency_p99_ms",
+                      "goodput_tokens_per_sec_pre_kill",
+                      "goodput_tokens_per_sec_post_kill", "recovery_s",
+                      "killed_replica_state"),
+            "rollover": ("dropped", "weight_swaps", "replicas_swapped",
+                         "post_rollover_tokens_match_new_weights")})
 
 
 def _router_child():
@@ -2541,7 +2551,351 @@ def _router_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --prefix: paged-KV-cache serving benchmark (CPU-runnable, <5 min).
+# Open-loop A/B under a HIGH-PREFIX-SHARING workload (the production
+# shape this PR targets: 80% of requests carry the same long system
+# prompt), identical Poisson arrival schedule and request mix per
+# config, each config subprocess-isolated, SAME HBM budget:
+#
+#   dense: the PR-5 GenerationEngine — every slot owns a full
+#          (S_max)-row cache slice, every admission re-prefills the
+#          whole prompt (system prefix included) in one monolithic
+#          bucketed prefill that stalls in-flight decode
+#   paged: paged KV cache (page pool + page tables) with prefix reuse
+#          (shared system-prompt pages prefilled ONCE, refcounted,
+#          copy-on-write at the divergence page) and chunked prefill
+#          (at most one fixed-size chunk per engine iteration,
+#          interleaved with decode)
+#
+# The offered rate sits above the DENSE engine's measured capacity:
+# the A/B question is whether prefix reuse + chunking turn the same
+# HBM and the same arithmetic into more tokens/sec and bounded
+# TTFT/TPOT tails. Greedy output must be TOKEN-IDENTICAL across the
+# configs (per-request token lists are digested in each child and the
+# digests compared). Acceptance gates (ISSUE 9) are ENFORCED via exit
+# code: >= 1.5x tokens/sec, >= 2x lower TTFT p99, token-identical,
+# zero in-window compiles in both configs. Results (schema-checked)
+# -> BENCH_r13.json.
+# ---------------------------------------------------------------------------
+PFX_VOCAB, PFX_UNITS, PFX_LAYERS, PFX_HEADS = 256, 96, 4, 4
+PFX_SMAX = 256
+PFX_SLOTS = 8
+PFX_PS = 16                  # KV page size (tokens per page)
+PFX_CHUNK = 32               # prefill chunk width
+PFX_SYS_LEN = 192            # shared system-prompt length
+PFX_SHARE = 0.8              # fraction of requests carrying it
+PFX_REQS = int(os.environ.get("BENCH_PFX_REQS", "96"))
+PFX_RATE_X = 2.0             # offered load over measured DENSE capacity
+# pool bytes == dense cache bytes exactly: page 0 is the scrap page,
+# so 127 allocatable pages serve what dense spends 128 rows' worth on
+PFX_PAGES = PFX_SLOTS * PFX_SMAX // PFX_PS
+
+
+def _pfx_model():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(0)
+    net = GPTModel(vocab_size=PFX_VOCAB, units=PFX_UNITS,
+                   num_layers=PFX_LAYERS, num_heads=PFX_HEADS,
+                   max_length=PFX_SMAX)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _pfx_engine(paged):
+    from mxnet_tpu.serving import GenerationEngine
+    net = _pfx_model()
+    kw = dict(max_slots=PFX_SLOTS, max_length=PFX_SMAX,
+              queue_limit=PFX_REQS + 16)
+    if paged:
+        kw.update(paged=True, page_size=PFX_PS,
+                  prefill_chunk=PFX_CHUNK, n_pages=PFX_PAGES,
+                  prefix_cache=True)
+    return GenerationEngine(net, **kw).warmup()
+
+
+def _pfx_workload():
+    """(prompt, max_new) mix, fixed seed: PFX_SHARE of the requests
+    open with the SAME PFX_SYS_LEN-token system prompt plus a short
+    unique tail (the RAG/chat production shape), the rest are unique
+    medium prompts. Identical for both configs."""
+    import numpy as onp
+    rng = onp.random.RandomState(52)
+    sys_prompt = rng.randint(0, PFX_VOCAB, PFX_SYS_LEN).astype("i4")
+    reqs = []
+    for _ in range(PFX_REQS):
+        tail = rng.randint(0, PFX_VOCAB,
+                           int(rng.randint(4, 17))).astype("i4")
+        if rng.rand() < PFX_SHARE:
+            prompt = onp.concatenate([sys_prompt, tail])
+        else:
+            prompt = rng.randint(0, PFX_VOCAB,
+                                 16 + tail.size).astype("i4")
+        reqs.append((prompt, int(rng.randint(6, 13))))
+    return reqs
+
+
+def _pfx_arrivals(rate_rps):
+    import numpy as onp
+    rng = onp.random.RandomState(53)
+    return rng.exponential(1.0 / rate_rps, PFX_REQS).cumsum()
+
+
+def _pfx_prime(eng):
+    """Fixed short NEUTRAL prompts (not the system prompt — the prefix
+    cache must earn its hits inside the measured window), served
+    before telemetry.reset() in both configs."""
+    import numpy as onp
+    rng = onp.random.RandomState(7)
+    for s in [eng.submit(rng.randint(0, PFX_VOCAB, 8).astype("i4"),
+                         max_new_tokens=4) for _ in range(PFX_SLOTS)]:
+        s.result(timeout=600)
+
+
+def _pfx_calibrate():
+    """Closed-loop DENSE-engine tokens/sec on this exact workload mix
+    (prefill cost of the shared prompt included — that IS dense
+    capacity here); the offered rate is PFX_RATE_X of it."""
+    from mxnet_tpu import telemetry
+    eng = _pfx_engine(paged=False)
+    reqs = _pfx_workload()
+    _pfx_prime(eng)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    for s in [eng.submit(p, max_new_tokens=m) for p, m in reqs[:24]]:
+        s.result(timeout=600)
+    dt = time.perf_counter() - t0
+    tokens = telemetry.counter_value("serving.generate.tokens")
+    eng.close()
+    mean_tokens = sum(m for _, m in reqs) / len(reqs)
+    print(json.dumps({
+        "dense_tokens_per_sec": round(tokens / dt, 1),
+        "mean_tokens_per_req": round(mean_tokens, 2)}), flush=True)
+    return 0
+
+
+def _pfx_run(paged, rate_rps):
+    import hashlib
+    import numpy as onp
+    from mxnet_tpu import telemetry
+
+    eng = _pfx_engine(paged)
+    reqs = _pfx_workload()
+    _pfx_prime(eng)
+    arrivals = _pfx_arrivals(rate_rps)
+    streams = [None] * PFX_REQS
+    telemetry.reset()
+
+    def emit(i):
+        streams[i] = eng.submit(reqs[i][0], max_new_tokens=reqs[i][1])
+
+    t0 = _serving_feed(arrivals, emit)
+    results = [s.result(timeout=600) for s in streams]
+    snap = telemetry.snapshot()
+    eng.close()
+    n_tokens = int(snap["counters"].get("serving.generate.tokens", 0))
+    makespan = max(s.done_at for s in streams) - (t0 + arrivals[0])
+    ttft = onp.asarray([(s.first_token_at - (t0 + at)) * 1e3
+                        for s, at in zip(streams, arrivals)])
+    tpot = onp.asarray([(s.done_at - s.first_token_at)
+                        / (len(r.tokens) - 1) * 1e3
+                        for s, r in zip(streams, results)
+                        if len(r.tokens) > 1])
+    digest = hashlib.sha256(json.dumps(
+        [r.tokens for r in results]).encode()).hexdigest()
+    out = {
+        "mode": "paged" if paged else "dense",
+        "requests": PFX_REQS,
+        "slots": PFX_SLOTS,
+        "generated_tokens": n_tokens,
+        "tokens_per_sec": round(n_tokens / makespan, 1),
+        "decode_steps":
+            int(snap["histograms"]["serving.generate.decode"]["count"]),
+        "ttft_p50_ms": round(float(onp.percentile(ttft, 50)), 1),
+        "ttft_p99_ms": round(float(onp.percentile(ttft, 99)), 1),
+        "tpot_p50_ms": round(float(onp.percentile(tpot, 50)), 1),
+        "tpot_p99_ms": round(float(onp.percentile(tpot, 99)), 1),
+        "compiles_in_window":
+            int(snap["counters"].get("model.gpt.trace", 0))
+            + int(snap["counters"].get("gluon.cachedop.cache_miss", 0)),
+        "tokens_digest": digest,
+        "finish_reasons": sorted({r.finish_reason for r in results}),
+    }
+    if paged:
+        c = snap["counters"]
+        allocated = int(c.get("serving.generate.pages.allocated", 0))
+        out.update({
+            "prefill_chunks":
+                int(c.get("serving.generate.prefill_chunks", 0)),
+            "max_chunks_per_iteration": int(
+                snap["gauges"].get(
+                    "serving.generate.prefill_chunks_per_iter", {})
+                .get("peak", 0)),
+            "prefix_hits":
+                int(c.get("serving.generate.prefix_hits", 0)),
+            "pages_allocated": allocated,
+            "pages_shared":
+                int(c.get("serving.generate.pages.shared", 0)),
+            "pages_cow_copies":
+                int(c.get("serving.generate.pages.cow_copies", 0)),
+            "pages_freed":
+                int(c.get("serving.generate.pages.freed", 0)),
+            # private pages a request actually consumed, on average —
+            # the slots-per-HBM-byte story: the same pool bytes hold
+            # pool_pages/avg_private concurrent sequences vs the dense
+            # cache's fixed PFX_SLOTS
+            "avg_private_pages_per_req":
+                round(allocated / PFX_REQS, 2),
+            "effective_slots_same_hbm": round(
+                (PFX_PAGES - 1) / max(allocated / PFX_REQS, 1e-9), 1),
+        })
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _pfx_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_PFX_CONFIG"]
+    if cfg == "calib":
+        return _pfx_calibrate()
+    rate = float(os.environ["BENCH_PFX_RATE"])
+    return _pfx_run(cfg == "paged", rate)
+
+
+def _pfx_check_schema(doc):
+    """BENCH_r13.json contract (spec for the shared _check_schema)."""
+    per_cfg = ("tokens_per_sec", "ttft_p99_ms", "tpot_p99_ms",
+               "compiles_in_window", "tokens_digest")
+    return _check_schema(
+        "BENCH_r13", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "requests": int, "slots": int, "offered_rate_rps": float,
+            "calibration": dict, "dense": dict, "paged": dict,
+            "hbm_bytes_per_layer": int, "throughput_ratio": float,
+            "ttft_p99_ratio": float, "tpot_p99_ratio": float,
+            "token_identical": bool, "zero_compiles_in_window": bool,
+            "throughput_ge_1_5x": bool, "ttft_p99_ge_2x_lower": bool,
+        },
+        nested={"dense": per_cfg,
+                "paged": per_cfg + (
+                    "prefix_hits", "pages_shared", "pages_cow_copies",
+                    "prefill_chunks", "max_chunks_per_iteration",
+                    "effective_slots_same_hbm")},
+        gates=[("paged config must observe prefix sharing",
+                lambda d: d["paged"]["pages_shared"] > 0),
+               ("chunked prefill must stay <= 1 chunk/iteration",
+                lambda d:
+                d["paged"]["max_chunks_per_iteration"] <= 1)])
+
+
+def _prefix_main():
+    if os.environ.get("BENCH_PFX_CONFIG"):
+        return _pfx_child()
+
+    _stage("prefix: dense-capacity calibration")
+    calib = _ab_child("--prefix", dict(BENCH_PFX_CONFIG="calib"),
+                      label="prefix calib")
+    if calib is None:
+        return 1
+    rate = (PFX_RATE_X * calib["dense_tokens_per_sec"]
+            / calib["mean_tokens_per_req"])
+    # interleaved best-of-N per config (the --checkpoint/--trainer-path
+    # lesson: this box's cpu-shares swing 2-3x between windows, and a
+    # degraded window landing on ONE config inverts the A/B; the
+    # least-contended rep per config is the honest capacity number).
+    # Token digests must agree across EVERY rep of EVERY config —
+    # identity is a correctness claim, not a per-rep accident.
+    reps = int(os.environ.get("BENCH_PFX_REPS", "2"))
+    results = {}
+    digests = set()
+    for rep in range(reps):
+        for cfg in ("dense", "paged"):
+            _stage(f"prefix: {cfg} config (rep {rep + 1}/{reps})")
+            r = _ab_child(
+                "--prefix", dict(BENCH_PFX_CONFIG=cfg,
+                                 BENCH_PFX_RATE=rate),
+                label=f"prefix {cfg} rep{rep}")
+            if r is None:
+                return 1
+            digests.add(r["tokens_digest"])
+            best = results.get(cfg)
+            if best is None \
+                    or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                results[cfg] = r
+    if len(digests) != 1:
+        print(f"[bench] prefix token digests diverged across "
+              f"reps/configs: {sorted(digests)}", file=sys.stderr,
+              flush=True)
+        return 1
+    dense, paged = results["dense"], results["paged"]
+    hbm = (PFX_SLOTS * PFX_SMAX * PFX_HEADS
+           * (PFX_UNITS // PFX_HEADS) * 4 * 2)  # K+V fp32, per layer
+    thr_ratio = round(paged["tokens_per_sec"]
+                      / max(dense["tokens_per_sec"], 1e-9), 2)
+    ttft_ratio = round(dense["ttft_p99_ms"]
+                       / max(paged["ttft_p99_ms"], 1e-9), 2)
+    doc = _pfx_check_schema({
+        "metric": "prefix_paged_tokens_per_sec",
+        "value": float(paged["tokens_per_sec"]),
+        "unit": "generated tokens/sec at the same HBM budget",
+        "model": f"gpt {PFX_LAYERS}L-{PFX_UNITS}u-{PFX_HEADS}h "
+                 f"vocab={PFX_VOCAB} s_max={PFX_SMAX}",
+        "requests": PFX_REQS,
+        "slots": PFX_SLOTS,
+        "page_size": PFX_PS,
+        "prefill_chunk": PFX_CHUNK,
+        "offered_rate_rps": round(rate, 2),
+        "offered_load_x_dense_capacity": PFX_RATE_X,
+        "reps_best_of": reps,
+        "arrival_process": "poisson (seed 53, identical per config); "
+                           f"{int(PFX_SHARE * 100)}% share a "
+                           f"{PFX_SYS_LEN}-token system prompt + 4-16 "
+                           "unique tail, budgets 6-12 (seed 52)",
+        "calibration": calib,
+        "dense": dense,
+        "paged": paged,
+        "hbm_bytes_per_layer": hbm,
+        "throughput_ratio": thr_ratio,
+        "ttft_p99_ratio": ttft_ratio,
+        "tpot_p99_ratio": round(
+            dense["tpot_p99_ms"] / max(paged["tpot_p99_ms"], 1e-9), 2),
+        "token_identical":
+            bool(dense["tokens_digest"] == paged["tokens_digest"]),
+        "zero_compiles_in_window":
+            bool(dense["compiles_in_window"] == 0
+                 and paged["compiles_in_window"] == 0),
+        "throughput_ge_1_5x": bool(thr_ratio >= 1.5),
+        "ttft_p99_ge_2x_lower": bool(ttft_ratio >= 2.0),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_PFX_OUT",
+                                           "BENCH_r13.json"))
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    # acceptance gates ENFORCED, not just recorded (the resilience-
+    # bench discipline): a harness keyed on the exit code must see it
+    failed = [g for g, ok in [
+        ("throughput_ge_1_5x", doc["throughput_ge_1_5x"]),
+        ("ttft_p99_ge_2x_lower", doc["ttft_p99_ge_2x_lower"]),
+        ("token_identical", doc["token_identical"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] prefix gates failed: {', '.join(failed)} "
+              f"(throughput_ratio={doc['throughput_ratio']} "
+              f"ttft_p99_ratio={doc['ttft_p99_ratio']})",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--prefix" in sys.argv:
+        return _prefix_main()
     if "--resilience" in sys.argv:
         return _resilience_main()
     if "--router" in sys.argv:

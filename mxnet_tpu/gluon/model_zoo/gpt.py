@@ -25,7 +25,23 @@ exposes an explicit-cache API next to the ordinary ``forward``:
   The cache argument is DONATED to the jitted step — steady-state
   decode never allocates a second cache.
 
-Both generation entry points are jitted closures over the parameter
+Beside the dense cache there is a PAGED cache API (the serving
+engine's ``paged=True`` mode — docs/SERVING.md "Paged KV cache"):
+``init_paged_cache`` allocates a global pool of fixed-size KV pages
+per layer plus a static-shape ``(B, P_max)`` int32 page table, and the
+paged closures grow the same contract — ``prefill_paged`` (whole short
+prompt bitwise-equal to dense prefill, or fixed-width chunks appended
+at a traced global offset), ``decode_step_paged`` (per-row paged
+write + ``ops.attention.paged_decode_attention``; inactive rows'
+writes are REDIRECTED to the reserved scrap page 0, because a freed
+slot's stale table row may alias pages owned by another slot),
+``peek_logits_paged`` (first token of a fully-cached prompt, zero
+prefill, no donation), and the ``bind_slot_paged``/``copy_page_paged``
+table/COW helpers. Page ownership (refcounts, prefix index, COW
+arming) is the engine's job — serving/paging.py; the model layer only
+guarantees fixed shapes and donated in-place pool updates.
+
+All generation entry points are jitted closures over the parameter
 NDArrays (the CachedOp ``raw_fn`` rebinding idiom, gluon/block.py), and
 count ``model.gpt.trace`` each time they actually trace — the
 telemetry hook tests and the serving engine use to assert zero
@@ -65,6 +81,15 @@ def _as_i32(x):
     if isinstance(x, NDArray):
         x = x._data
     return jnp.asarray(x, jnp.int32)
+
+
+def _to_pages(a, page_size, dtype):
+    """Reshape a (1, H, C, Dh) chunk of K or V into page-pool layout
+    (C/page_size, H, page_size, Dh) for a scatter into the pool —
+    the ONE place the pool's page layout is encoded."""
+    h, c, d = a.shape[1:]
+    return a[0].reshape(h, c // page_size, page_size, d) \
+        .transpose(1, 0, 2, 3).astype(dtype)
 
 
 class GPTBlock(HybridBlock):
@@ -140,6 +165,59 @@ class GPTBlock(HybridBlock):
                        ctx=x.ctx)
         return self._finish(x, attn), kc, vc
 
+    # -- paged-cache generation (serving/generate.py paged mode) --------
+    def decode_paged(self, x, k_pool, v_pool, table, page, offset,
+                     att_len):
+        """One decode step against a PAGED cache: write this token's
+        K/V into pool page ``page[b]`` at slot ``offset[b]`` per row,
+        attend over each row's valid pages via the table. Inactive
+        rows must arrive with ``page == 0`` (the reserved scrap page):
+        a free slot's table row may alias pages now owned by another
+        slot, so its write is redirected, never masked after the
+        fact."""
+        q, k, v = self._qkv(x)
+        dt = k_pool.dtype
+        kp = k_pool.at[page, :, offset, :].set(
+            k._data[:, :, 0, :].astype(dt))
+        vp = v_pool.at[page, :, offset, :].set(
+            v._data[:, :, 0, :].astype(dt))
+        attn = NDArray(_att.paged_decode_attention(q._data, kp, vp,
+                                                   table, att_len),
+                       ctx=x.ctx)
+        return self._finish(x, attn), kp, vp
+
+    def prefill_chunk(self, x, k_pool, v_pool, pages, page_ids, start):
+        """One prefill CHUNK against a paged cache: scatter the chunk's
+        K/V into its pool pages (``page_ids``), then attend the chunk's
+        queries over the slot's full gathered view (earlier chunks +
+        shared prefix pages + this chunk) with the causal mask in
+        global coordinates (``start`` is traced — every chunk of every
+        prompt runs one compiled program per chunk width)."""
+        q, k, v = self._qkv(x)
+        ps = k_pool.shape[2]
+        dt = k_pool.dtype
+        kp = k_pool.at[page_ids].set(_to_pages(k._data, ps, dt))
+        vp = v_pool.at[page_ids].set(_to_pages(v._data, ps, dt))
+        kg = _att.gather_pages(kp, pages[None])
+        vg = _att.gather_pages(vp, pages[None])
+        attn = NDArray(_att.chunked_prefill_attention(
+            q._data, kg.astype(q._data.dtype),
+            vg.astype(q._data.dtype), start), ctx=x.ctx)
+        return self._finish(x, attn), kp, vp
+
+    def peek_paged(self, x, k_pool, v_pool, table, att_len):
+        """Logits-only attention for the LAST already-cached token of
+        one slot (its K/V — including its own — is in the pool): no
+        write, cache untouched. The prefix-reuse fast path: a request
+        whose entire prompt is cached needs one of these per layer, and
+        zero prefill compute."""
+        q, _k, _v = self._qkv(x)
+        attn = NDArray(_att.paged_decode_attention(q._data, k_pool,
+                                                   v_pool, table,
+                                                   att_len),
+                       ctx=x.ctx)
+        return self._finish(x, attn)
+
 
 class GPTModel(HybridBlock):
     """Decoder-only transformer LM: token + learned position
@@ -172,6 +250,7 @@ class GPTModel(HybridBlock):
         self.lm_head = Dense(vocab_size, use_bias=False, flatten=False,
                              dtype=dtype)
         self._gen = None  # (param_nds, prefill_jit, decode_jit)
+        self._paged = None  # paged-cache closures (_ensure_paged)
 
     @property
     def max_length(self):
@@ -201,6 +280,7 @@ class GPTModel(HybridBlock):
     def _clear_cached_op(self):
         super()._clear_cached_op()
         self._gen = None  # params rebound/cast: jitted closures stale
+        self._paged = None
 
     def init_cache(self, batch_size, max_length=None, dtype=None):
         """Preallocated fixed-shape KV cache pytree for ``batch_size``
@@ -220,21 +300,21 @@ class GPTModel(HybridBlock):
         return {"k": zeros(), "v": zeros(),
                 "len": jnp.zeros((int(batch_size),), jnp.int32)}
 
-    def _ensure_gen(self):
-        if self._gen is not None:
-            return self._gen
+    def _gen_params(self):
         params = list(self.collect_params().values())
         if any(p._data is None for p in params):
             # materialize deferred shapes with one eager probe forward
             # (the CachedOp._abstract_init idiom)
             self.infer_shape(NDArray(jnp.zeros((1, 2), jnp.int32)))
             params = list(self.collect_params().values())
-        param_nds = [p.data() for p in params]
-        blocks = self._blocks()
+        return [p.data() for p in params]
 
+    @staticmethod
+    def _make_bind(param_nds):
+        """Closure factory: run ``fn`` with the parameter NDArrays
+        rebound to the traced buffers (gluon/block.py raw_fn idiom).
+        Shared by the dense and paged generation closures."""
         def _bind(fn):
-            """Run ``fn`` with the parameter NDArrays rebound to the
-            traced buffers (gluon/block.py raw_fn idiom)."""
             def wrapper(key, param_datas, *args):
                 telemetry.counter("model.gpt.trace")
                 saved = [nd._data for nd in param_nds]
@@ -249,6 +329,14 @@ class GPTModel(HybridBlock):
                         for nd, s in zip(param_nds, saved):
                             nd._data = s
             return wrapper
+        return _bind
+
+    def _ensure_gen(self):
+        if self._gen is not None:
+            return self._gen
+        param_nds = self._gen_params()
+        blocks = self._blocks()
+        _bind = self._make_bind(param_nds)
 
         def prefill_raw(tokens, valid_len, slots, cache):
             b, sb = tokens.shape
@@ -336,6 +424,260 @@ class GPTModel(HybridBlock):
         param_nds, _, decode_jit = self._ensure_gen()
         return decode_jit(next_key(), [nd._data for nd in param_nds],
                           _as_i32(tokens), cache)
+
+    # -- paged-cache generation API -------------------------------------
+    def init_paged_cache(self, batch_size, n_pages, page_size,
+                         max_length=None, dtype=None):
+        """Preallocated PAGED KV cache: a global pool of ``n_pages``
+        fixed-size pages per layer plus a static-shape page table —
+        ``{"k": tuple of L (n_pages, H, page_size, Dh) arrays, "v":
+        same, "table": (B, P_max) int32, "len": (B,) int32}`` with
+        ``P_max = max_length // page_size``. Logical position ``t`` of
+        slot ``b`` lives at ``pool[table[b, t // ps], :, t % ps]``.
+        Page 0 is the reserved SCRAP page: free table entries point at
+        it and redirected writes land in it — callers must never
+        allocate it to a slot. Explicit argument/result of the paged
+        generation calls (which DONATE it, except ``peek``)."""
+        s = int(max_length) if max_length is not None else self._max_length
+        if not 1 <= s <= self._max_length:
+            raise ValueError(
+                f"cache max_length {s} out of range (position table "
+                f"holds {self._max_length})")
+        ps = int(page_size)
+        if ps < 1 or s % ps != 0:
+            raise ValueError(
+                f"page_size {ps} must divide cache max_length {s}")
+        if int(n_pages) < 2:
+            raise ValueError("n_pages must be >= 2 (page 0 is the "
+                             "reserved scrap page)")
+        shape = (int(n_pages), self._num_heads, ps, self._head_dim)
+        dt = onp.dtype(dtype or self._dtype)
+        zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
+                              for _ in range(self._num_layers))
+        return {"k": zeros(), "v": zeros(),
+                "table": jnp.zeros((int(batch_size), s // ps),
+                                   jnp.int32),
+                "len": jnp.zeros((int(batch_size),), jnp.int32)}
+
+    def _ensure_paged(self):
+        if self._paged is not None:
+            return self._paged
+        param_nds = self._gen_params()
+        blocks = self._blocks()
+        _bind = self._make_bind(param_nds)
+
+        def fresh_raw(tokens, n_valid, slot, pages, cache):
+            """Whole-prompt prefill of one slot at bucket width W: the
+            computation is EXACTLY the dense prefill's (same causal
+            flash over the prompt block — bitwise-equal K/V and
+            logits); only the cache write is page-shaped."""
+            _b, w = tokens.shape
+            ps = cache["k"][0].shape[2]
+            x = self._embed(NDArray(tokens))
+            ks, vs = [], []
+            for blk in blocks:
+                x, (k, v) = blk.prefill(x)
+                ks.append(k)
+                vs.append(v)
+            idx = jnp.clip(n_valid - 1, 0, w - 1)
+            last = x._data[0, idx][None, None, :]
+            logits = self.lm_head(self.ln_f(NDArray(last)))
+            dt = cache["k"][0].dtype
+            page_ids = pages[:w // ps]          # start == 0: static
+            new_cache = {
+                "k": tuple(p.at[page_ids].set(_to_pages(k, ps, dt))
+                           for p, k in zip(cache["k"], ks)),
+                "v": tuple(p.at[page_ids].set(_to_pages(v, ps, dt))
+                           for p, v in zip(cache["v"], vs)),
+                "table": cache["table"].at[slot].set(pages),
+                "len": cache["len"].at[slot].set(n_valid),
+            }
+            return logits._data[:, 0, :], new_cache
+
+        def chunk_raw(tokens, start, n_valid, slot, pages, cache):
+            """One fixed-width prefill chunk of one slot, appended at
+            global position ``start`` (a multiple of page_size;
+            traced, so every chunk runs this one program)."""
+            _b, c = tokens.shape
+            ps = cache["k"][0].shape[2]
+            positions = start + jnp.arange(c, dtype=jnp.int32)
+            pw = self.position_weight.data()._data
+            x = NDArray(self.word_embed(NDArray(tokens))._data
+                        + jnp.take(pw, positions, axis=0))
+            if self.embed_drop is not None:
+                x = self.embed_drop(x)
+            page_ids = lax.dynamic_slice(pages, (start // ps,),
+                                         (c // ps,))
+            ks, vs = [], []
+            for li, blk in enumerate(blocks):
+                x, kp, vp = blk.prefill_chunk(
+                    x, cache["k"][li], cache["v"][li], pages, page_ids,
+                    start)
+                ks.append(kp)
+                vs.append(vp)
+            idx = jnp.clip(n_valid - 1, 0, c - 1)
+            last = x._data[0, idx][None, None, :]
+            logits = self.lm_head(self.ln_f(NDArray(last)))
+            new_cache = {
+                "k": tuple(ks), "v": tuple(vs),
+                "table": cache["table"].at[slot].set(pages),
+                "len": cache["len"].at[slot].set(start + n_valid),
+            }
+            return logits._data[:, 0, :], new_cache
+
+        def decode_raw(tokens, active, cache):
+            ps = cache["k"][0].shape[2]
+            s_max = cache["table"].shape[1] * ps
+            ln = cache["len"]
+            b = ln.shape[0]
+            pos = jnp.minimum(ln, s_max - 1)
+            att_len = pos + 1
+            live = active > 0
+            # inactive rows write into scrap page 0 (their table rows
+            # may alias pages now owned by OTHER slots — a masked-out
+            # result is not enough, the write itself must be redirected)
+            page = jnp.where(
+                live, cache["table"][jnp.arange(b), pos // ps], 0)
+            offset = jnp.where(live, pos % ps, 0)
+            emb = self.word_embed(NDArray(tokens))
+            pw = self.position_weight.data()._data
+            x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
+            if self.embed_drop is not None:
+                x = self.embed_drop(x)
+            ks, vs = [], []
+            for li, blk in enumerate(blocks):
+                x, kp, vp = blk.decode_paged(
+                    x, cache["k"][li], cache["v"][li], cache["table"],
+                    page, offset, att_len)
+                ks.append(kp)
+                vs.append(vp)
+            logits = self.lm_head(self.ln_f(x))
+            new_cache = {"k": tuple(ks), "v": tuple(vs),
+                         "table": cache["table"],
+                         "len": ln + live.astype(jnp.int32)}
+            return logits._data[:, 0, :], new_cache
+
+        def peek_raw(token, slot, cache):
+            """Logits of the last CACHED token of ``slot`` (position
+            len-1, K/V already in the pool) — zero prefill compute, no
+            cache write. The 100%-prefix-hit admission path."""
+            ln = cache["len"][slot]
+            pos = ln - 1
+            pw = self.position_weight.data()._data
+            x = NDArray((self.word_embed(NDArray(token[None]))._data
+                         + jnp.take(pw, pos[None], axis=0))[:, None, :])
+            if self.embed_drop is not None:
+                x = self.embed_drop(x)
+            table1 = cache["table"][slot][None]
+            for li, blk in enumerate(blocks):
+                x = blk.peek_paged(x, cache["k"][li], cache["v"][li],
+                                   table1, ln[None])
+            logits = self.lm_head(self.ln_f(x))
+            return logits._data[0, 0, :]
+
+        def bind_raw(slot, pages, length, cache):
+            return {"k": cache["k"], "v": cache["v"],
+                    "table": cache["table"].at[slot].set(pages),
+                    "len": cache["len"].at[slot].set(length)}
+
+        def copy_raw(src, dst, cache):
+            return {
+                "k": tuple(p.at[dst].set(p[src]) for p in cache["k"]),
+                "v": tuple(p.at[dst].set(p[src]) for p in cache["v"]),
+                "table": cache["table"], "len": cache["len"]}
+
+        self._paged = {
+            "params": param_nds,
+            "fresh": jax.jit(_bind(fresh_raw), donate_argnums=(6,)),
+            "chunk": jax.jit(_bind(chunk_raw), donate_argnums=(7,)),
+            "decode": jax.jit(_bind(decode_raw), donate_argnums=(4,)),
+            "peek": jax.jit(_bind(peek_raw)),
+            "bind": jax.jit(_bind(bind_raw), donate_argnums=(5,)),
+            "copy": jax.jit(_bind(copy_raw), donate_argnums=(4,)),
+        }
+        return self._paged
+
+    def _paged_call(self, name, *args):
+        p = self._ensure_paged()
+        return p[name](next_key(),
+                       [nd._data for nd in p["params"]], *args)
+
+    def prefill_paged(self, tokens, n_valid, slot, pages, cache, *,
+                      start=0, fresh=False):
+        """Prefill one chunk (or, with ``fresh=True``, one whole short
+        prompt) of ``slot`` into pool pages. ``tokens`` is (1, W) int32
+        with W a multiple of the page size; ``pages`` is the slot's
+        FULL (P_max,) physical-page row (entries past the slot's
+        reservation must point at scrap page 0); ``start`` is the
+        chunk's global offset (multiple of the page size; 0 when
+        ``fresh``); ``n_valid`` counts real tokens in this chunk.
+        Returns ``(last_valid_logits (1, V), cache)`` — cache donated.
+
+        ``fresh=True`` runs the dense prefill computation (causal flash
+        over the prompt block only) and is bitwise-identical to dense
+        ``prefill`` — use it for unshared prompts that fit one chunk;
+        the general path attends the gathered page view (shared prefix
+        + earlier chunks) under the global causal mask."""
+        tokens = _as_i32(tokens)
+        if tokens.ndim != 2 or tokens.shape[0] != 1:
+            raise ValueError(f"paged prefill tokens must be (1, W), "
+                             f"got shape {tokens.shape}")
+        ps = cache["k"][0].shape[2]
+        s_max = cache["table"].shape[1] * ps
+        w = tokens.shape[1]
+        if w % ps or w > s_max:
+            raise ValueError(
+                f"chunk width {w} must be a multiple of page_size "
+                f"{ps} and fit cache capacity {s_max}")
+        if int(start) % ps:
+            raise ValueError(f"chunk start {start} must be a multiple "
+                             f"of page_size {ps}")
+        if fresh and int(start) != 0:
+            raise ValueError("fresh prefill starts at 0 by definition")
+        pages = _as_i32(pages)
+        if fresh:
+            return self._paged_call(
+                "fresh", tokens, jnp.int32(n_valid), jnp.int32(slot),
+                pages, cache)
+        return self._paged_call(
+            "chunk", tokens, jnp.int32(start), jnp.int32(n_valid),
+            jnp.int32(slot), pages, cache)
+
+    def decode_step_paged(self, tokens, active, cache):
+        """One decode step for every slot of a PAGED cache: write each
+        active row's K/V into its current page at ``len % page_size``,
+        attend its valid pages, bump its ``len``. ``active`` (B,) masks
+        rows: inactive rows run the same fixed-shape program but their
+        writes are redirected to the scrap page and their ``len`` is
+        not bumped (a freed slot's table row may alias pages owned by
+        someone else — garbage logits are ignorable, stray writes are
+        not). Returns ``(logits, cache)`` — cache donated."""
+        return self._paged_call("decode", _as_i32(tokens),
+                                _as_i32(active), cache)
+
+    def peek_logits_paged(self, token, slot, cache):
+        """Next-token logits for a slot whose ENTIRE prompt is already
+        cached (prefix reuse): recompute the last prompt token's query
+        at position ``len - 1`` and attend the cached pages — no
+        prefill, no write. Cache is NOT donated (unchanged). Returns
+        raw (vocab,) logits."""
+        return self._paged_call("peek", jnp.asarray(token, jnp.int32),
+                                jnp.int32(slot), cache)
+
+    def bind_slot_paged(self, slot, pages, length, cache):
+        """Install a slot's page-table row and valid length (the
+        exact-prefix-hit admission: point the table at shared pages;
+        no compute). Cache donated."""
+        return self._paged_call("bind", jnp.int32(slot),
+                                _as_i32(pages), jnp.int32(length),
+                                cache)
+
+    def copy_page_paged(self, src, dst, cache):
+        """Copy physical page ``src`` to ``dst`` across every layer's
+        K and V pools — the copy half of copy-on-write at a shared
+        divergence page. Cache donated."""
+        return self._paged_call("copy", jnp.int32(src),
+                                jnp.int32(dst), cache)
 
 
 def gpt_small(vocab_size=1000, units=64, num_layers=2, num_heads=4,

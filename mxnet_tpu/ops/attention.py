@@ -341,23 +341,33 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 # ---------------------------------------------------------------------------
 # decode attention (single-query KV-cache attention, per-row lengths)
 # ---------------------------------------------------------------------------
+def _masked_attend(q, k, v, valid, scale):
+    """Single-pass masked-softmax attention: score, mask, softmax with
+    the two non-obvious guards the cache paths need — RE-MASK after
+    the exp (a fully-masked row's scores are all NEG_INF, so
+    exp(s - m) would be exp(0)=1 across the board instead of 0) and an
+    l_safe denominator (a fully-masked row — an empty serving slot —
+    returns zeros, not NaN). Shared by decode attention and chunked
+    prefill, which differ only in the validity predicate."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    s = jnp.where(valid, s, NEG_INF)
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.where(valid, jnp.exp(s - m), 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    l_safe = jnp.where(l > 0, l, 1.0)
+    p = (p / l_safe).astype(v.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
 def _decode_fwd_jnp(q, k, v, lengths, scale):
     """Masked single-pass attention: every query row of batch b attends
     keys [0, lengths[b]) of its cache row. Small S_max fits one score
     materialization (B, H, Sq, S_max) — the decode working set is tiny
     compared to prefill, and XLA fuses the chain."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
-    col = lax.broadcasted_iota(jnp.int32, s.shape, 3)
-    valid = col < lengths[:, None, None, None]
-    s = jnp.where(valid, s, NEG_INF)
-    m = s.max(axis=-1, keepdims=True)
-    # re-mask after exp: with lengths==0 every score is NEG_INF, so
-    # exp(s - m) would be exp(0)=1 across the board instead of 0
-    p = jnp.where(valid, jnp.exp(s - m), 0.0)
-    l = p.sum(axis=-1, keepdims=True)
-    l_safe = jnp.where(l > 0, l, 1.0)  # lengths==0: an empty slot
-    p = (p / l_safe).astype(v.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    shape = (*q.shape[:3], k.shape[2])
+    col = lax.broadcasted_iota(jnp.int32, shape, 3)
+    return _masked_attend(q, k, v,
+                          col < lengths[:, None, None, None], scale)
 
 
 def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
@@ -467,6 +477,132 @@ def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=interpret,
     )(lengths.astype(jnp.int32), q, k, v)
+
+
+def gather_pages(pool, table):
+    """Materialize each slot's logical KV view from a paged pool:
+    ``pool`` (n_pages, H, page_size, D) + ``table`` (B, P_max) int32
+    -> (B, H, P_max * page_size, D). Logical position ``t`` of slot
+    ``b`` lives at ``pool[table[b, t // ps], :, t % ps]``. Free table
+    entries point at the reserved scrap page (id 0) — their rows are
+    garbage that per-row length masking must exclude."""
+    g = pool[table]                       # (B, P_max, H, ps, D)
+    b, pm, h, ps, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, h, pm * ps, d)
+
+
+def _paged_decode_fwd_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref,
+                             o_ref, m_ref, l_ref, acc_ref, **kw):
+    """Paged decode grid step: the page table participates only in the
+    BlockSpec index maps (it chooses WHICH pool page each grid step
+    DMAs); once the right (1, 1, page_size, d) pool block is resident
+    the arithmetic is exactly the dense decode kernel's."""
+    del tbl_ref
+    _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
+                       l_ref, acc_ref, **kw)
+
+
+def paged_decode_attention_pallas(q, k_pool, v_pool, table, lengths,
+                                  scale=None, interpret=False):
+    """Pallas paged-decode kernel: grid (batch, head, page-slot) with
+    BOTH the per-slot lengths and the page table scalar-prefetched into
+    the KV BlockSpec index maps. Grid step ``kb`` of slot ``i`` DMAs
+    pool page ``table[i, kb]`` — so the data that moves is each slot's
+    OWN pages, wherever they sit in the pool, and (as in the dense
+    decode kernel) steps at or past the slot's valid prefix clamp to
+    its last valid page: a repeated block index lets the TPU pipeline
+    elide the copy, bounding DMA to ceil(len/page_size) pages per
+    slot. Compute for those steps is skipped in the kernel."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, sq, d = q.shape
+    n_pages, hp, ps, dp = k_pool.shape
+    if (hp, dp) != (h, d):
+        raise ValueError(
+            f"pool layout {k_pool.shape} does not match q {q.shape}")
+    p_max = table.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def _kv_index(i, j, kb, lens, tbl):
+        last = jnp.maximum((lens[i] + ps - 1) // ps - 1, 0)
+        return (tbl[i, jnp.minimum(kb, last)], j, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, p_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d),
+                         lambda i, j, kb, lens, tbl: (i, j, 0, 0)),
+            pl.BlockSpec((1, 1, ps, d), _kv_index),
+            pl.BlockSpec((1, 1, ps, d), _kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, 1, sq, d),
+                               lambda i, j, kb, lens, tbl: (i, j, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((sq, 128), jnp.float32),   # running max
+            pltpu.VMEM((sq, 128), jnp.float32),   # running denominator
+            pltpu.VMEM((sq, d), jnp.float32),     # running numerator
+        ],
+    )
+    kernel = functools.partial(_paged_decode_fwd_kernel, scale=scale,
+                               block_k=ps, nkb=p_max)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), table.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_decode_attention(q, k_pool, v_pool, table, lengths,
+                           scale=None):
+    """Decode attention against a PAGED KV cache.
+
+    ``q`` is (B, H, Sq, D); ``k_pool``/``v_pool`` are the global page
+    pools (n_pages, H, page_size, D); ``table`` (B, P_max) int32 maps
+    each slot's logical page index to a physical pool page; ``lengths``
+    (B,) int32 marks each slot's valid token prefix. Semantics equal
+    ``decode_attention`` over the gathered per-slot view — the jnp
+    path literally IS that (gather + the same masked softmax, so a
+    paged cache holding the same values produces bit-identical logits
+    to the dense cache); the Pallas TPU path streams only each slot's
+    valid pages through VMEM via scalar-prefetched (lengths, table)
+    index maps."""
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    lengths = jnp.asarray(lengths, jnp.int32)
+    table = jnp.asarray(table, jnp.int32)
+    if _use_pallas():
+        return paged_decode_attention_pallas(q, k_pool, v_pool, table,
+                                             lengths, scale=scale_v)
+    return _decode_fwd_jnp(q, gather_pages(k_pool, table),
+                           gather_pages(v_pool, table), lengths, scale_v)
+
+
+def chunked_prefill_attention(q, k, v, start, scale=None):
+    """Attention for one PREFILL CHUNK against a cache buffer.
+
+    ``q`` (B, H, C, D) holds the chunk's queries at global positions
+    ``start + i`` (``start`` is a (B,) int32 or scalar — traced, so
+    every chunk of every prompt runs ONE compiled program); ``k``/``v``
+    (B, H, S, D) are each row's gathered cache holding valid keys
+    ``[0, start + C)`` (earlier chunks plus this one, already written).
+    Row ``i`` attends keys ``[0, start + i]`` — the causal mask in
+    global coordinates, which also masks every unwritten/garbage cache
+    position since nothing beyond ``start + i`` is ever valid for that
+    query. Single-pass masked softmax (the decode-attention
+    formulation): the chunk working set is (C, S), tiny next to a
+    monolithic prefill's (S, S)."""
+    scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    start = jnp.asarray(start, jnp.int32)
+    if start.ndim == 0:
+        start = start[None]
+    shape = (*q.shape[:3], k.shape[2])
+    row = lax.broadcasted_iota(jnp.int32, shape, 2)
+    col = lax.broadcasted_iota(jnp.int32, shape, 3)
+    valid = col <= start[:, None, None, None] + row
+    return _masked_attend(q, k, v, valid, scale_v)
 
 
 def decode_attention(q, k, v, lengths, scale=None):

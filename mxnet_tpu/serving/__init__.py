@@ -5,14 +5,18 @@ requests onto one AOT-warmed CachedOp forward per dispatch — dynamic
 micro-batching with bounded queueing delay, admission control, and
 graceful shutdown. `GenerationEngine` is its autoregressive sibling:
 slot-based continuous batching over one fixed-shape KV-cache decode
-step (generate.py). `Router` fronts N engine replicas as ONE
+step (generate.py); with ``paged=True`` the cache is a PAGED pool
+with prefix reuse (shared prompts prefilled once, refcounted,
+copy-on-write) and chunked prefill (paging.py owns the host-side
+page/prefix bookkeeping). `Router` fronts N engine replicas as ONE
 fault-tolerant fleet: join-shortest-queue balancing, per-replica
 health/circuit-breaker state, cross-replica retry, per-tenant quotas,
 priority load shedding, and rolling zero-downtime weight rollover
 (router.py); `FaultInjector` (faults.py) is the deterministic
 chaos-injection seam that proves all of it. See docs/SERVING.md for
 knobs and operational guidance, ``bench.py --serving`` / ``--generate``
-/ ``--router`` (BENCH_r08/r09/r11.json) for the measured A/Bs.
+/ ``--router`` / ``--prefix`` (BENCH_r08/r09/r11/r13.json) for the
+measured A/Bs.
 """
 from .engine import (  # noqa: F401
     InferenceEngine, ServingError, EngineClosedError, QueueFullError,

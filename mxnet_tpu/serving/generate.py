@@ -47,14 +47,31 @@ token-identical to a single-request ``prefill`` + ``decode_step`` loop
 at the same slot width: rows of one XLA program are bit-independent,
 so a request's tokens do not depend on its co-tenants.
 
+``paged=True`` swaps the dense per-slot cache for the PAGED KV cache
+(vLLM-style block tables; docs/SERVING.md "Paged KV cache"): a global
+page pool + static-shape page tables, host-side refcounted page
+allocation (serving/paging.py), prefix reuse (a shared system prompt
+is prefilled ONCE and its immutable pages are shared across slots,
+copy-on-write at the divergence page), and Sarathi/Orca-style chunked
+prefill (at most ONE fixed-width chunk per engine iteration,
+interleaved with the decode step, so a long prompt bounds TPOT instead
+of stalling every in-flight request for a whole monolithic prefill).
+Same fixed-shape/zero-steady-state-compile discipline; greedy output
+stays token-identical to the dense engine.
+
 Telemetry (docs/OBSERVABILITY.md): counters
 ``serving.generate.{requests,tokens,prefills,evictions,rejected_full,
 rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
 (occupancy + peak) / ``serving.generate.queue.depth``, histograms
-``serving.generate.{prefill,decode,ttft}``.
+``serving.generate.{prefill,decode,ttft}``; paged mode adds
+``serving.generate.pages.{allocated,shared,cow_copies,freed}`` /
+``pages.free`` / ``prefix_hits`` / ``prefill_chunks`` and the
+``prefill_chunks_per_iter`` gauge whose peak proves the one-chunk
+decode-stall bound.
 """
 from __future__ import annotations
 
+import collections
 import contextlib
 import queue
 import threading
@@ -66,6 +83,7 @@ import numpy as onp
 from .. import telemetry
 from .._bounded_worker import BoundedQueueWorker
 from ..bucketing import BucketingPolicy, as_policy
+from . import paging
 from .engine import (
     EngineClosedError, QueueFullError, ReplicaFailedError,
     RequestTimeoutError, _live_engines, _serving_enabled,
@@ -226,6 +244,38 @@ class _Slot:
         self.n_ctx = n_ctx     # cache rows filled (prompt + decoded)
 
 
+class _PagedSlot:
+    """Slot state in paged mode. ``state`` is "prefill" (chunks still
+    pending — the slot sits out decode steps) or "decode". ``row`` is
+    the host mirror of the slot's page-table row (physical page per
+    logical page index; scrap 0 past its reservation); ``page_refs``
+    are the pool references the slot holds (released at eviction);
+    ``cow_pending`` is ``(src, dst, logical_idx)`` when the slot's next
+    decode write would land in a SHARED page — the divergence page is
+    copied to ``dst`` right before that first write (copy-on-write)."""
+
+    __slots__ = ("stream", "last", "left", "eos_id", "deadline", "n_ctx",
+                 "state", "chunks", "row", "page_refs", "cow_pending",
+                 "prompt", "seq", "t_submit")
+
+    def __init__(self, stream, left, eos_id, deadline, n_ctx, row,
+                 page_refs, prompt, seq, t_submit):
+        self.stream = stream
+        self.last = None
+        self.left = left
+        self.eos_id = eos_id
+        self.deadline = deadline
+        self.n_ctx = n_ctx
+        self.state = "prefill"
+        self.chunks = collections.deque()
+        self.row = row
+        self.page_refs = page_refs
+        self.cow_pending = None
+        self.prompt = prompt   # kept until registered in the index
+        self.seq = seq         # admission order (oldest prefills first)
+        self.t_submit = t_submit
+
+
 class _GenWorker(BoundedQueueWorker):
     """Consumer side of the request queue: the admit/step loop.
 
@@ -339,15 +389,52 @@ class GenerationEngine:
         output delivered — tokens already streamed can't be unsent).
     prefill_bucketing : BucketingPolicy | str | None
         Sequence-axis policy for prefill (default pow2, min 8, clamped
-        to the cache capacity). Each bucket is one compiled prefill
-        width — ``warmup()`` AOT-compiles them all.
+        to the cache capacity; paged mode raises the floor to the page
+        size). Each bucket is one compiled prefill width — ``warmup()``
+        AOT-compiles them all.
+    paged : bool
+        Replace the dense per-slot cache with the PAGED KV cache: a
+        global pool of fixed-size pages plus a static-shape page table
+        per slot (docs/SERVING.md "Paged KV cache"). Enables prefix
+        reuse (shared prompts prefilled once, refcounted, copy-on-write
+        at the divergence page) and chunked prefill (at most one chunk
+        per engine iteration, so long prompts can't stall in-flight
+        decode). Greedy output stays token-identical to dense mode.
+    page_size : int
+        Tokens per KV page (power of two dividing ``max_length``).
+        Also the prefix-sharing granularity: only whole pages are
+        shared.
+    n_pages : int, optional
+        Physical pages in the pool (default: the dense cache's exact
+        HBM budget, ``max_slots * max_length / page_size``, plus the
+        reserved scrap page). Fewer pages overcommit HBM against
+        short/shared traffic: admission defers (FIFO) while the pool
+        is exhausted, after evicting cold cached prefixes.
+    prefill_chunk : int
+        Chunked-prefill width (multiple of ``page_size``; default
+        ``max(32, 2 * page_size)`` capped at the cache capacity). A
+        prompt longer than one bucketed chunk is admitted as
+        fixed-width chunks, one per engine iteration.
+    prefix_cache : bool
+        Keep finished prompts' pages in a refcounted LRU index so
+        later requests sharing their prefix skip that prefill (an
+        exact repeat skips prefill entirely — its first token is
+        computed straight off the cached K/V).
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
                  max_new_tokens: int = 64, eos_id=None,
                  queue_limit: int = 256, timeout_ms=None,
-                 prefill_bucketing=None, cache_dtype=None):
-        for attr in ("init_cache", "prefill", "decode_step"):
+                 prefill_bucketing=None, cache_dtype=None,
+                 paged: bool = False, page_size: int = 16,
+                 n_pages=None, prefill_chunk=None,
+                 prefix_cache: bool = True):
+        self.paged = bool(paged)
+        api = ("init_paged_cache", "prefill_paged", "decode_step_paged",
+               "peek_logits_paged", "bind_slot_paged",
+               "copy_page_paged") if self.paged \
+            else ("init_cache", "prefill", "decode_step")
+        for attr in api:
             if not callable(getattr(model, attr, None)):
                 raise TypeError(
                     f"GenerationEngine needs a decoder with the "
@@ -366,12 +453,55 @@ class GenerationEngine:
         self._s_max = int(max_length) if max_length is not None \
             else int(model.max_length)
         policy = as_policy(prefill_bucketing)
-        if policy is None:
-            policy = BucketingPolicy(mode="pow2", min_size=8)
-        self.policy = policy.clamped(self._s_max)
         self._cache_dtype = cache_dtype
-        self._cache = model.init_cache(self.max_slots, self._s_max,
-                                       dtype=cache_dtype)
+        if self.paged:
+            ps = int(page_size)
+            if ps < 1 or (ps & (ps - 1)):
+                raise ValueError("page_size must be a power of two")
+            if self._s_max % ps:
+                raise ValueError(
+                    f"page_size {ps} must divide max_length "
+                    f"{self._s_max}")
+            self._ps = ps
+            self._p_max = self._s_max // ps
+            chunk = int(prefill_chunk) if prefill_chunk is not None \
+                else min(self._s_max, max(32, 2 * ps))
+            if chunk % ps or not 0 < chunk <= self._s_max:
+                raise ValueError(
+                    f"prefill_chunk {chunk} must be a positive "
+                    f"multiple of page_size {ps} within the cache "
+                    f"capacity {self._s_max}")
+            self._chunk = chunk
+            if policy is None:
+                policy = BucketingPolicy(mode="pow2",
+                                         min_size=max(8, ps))
+            self.policy = policy.clamped(self._s_max)
+            for w in self.policy.sizes(self._chunk):
+                if w <= self._chunk and w % ps:
+                    raise ValueError(
+                        f"prefill bucket {w} is not a multiple of "
+                        f"page_size {ps} (page-granular scatter needs "
+                        f"aligned widths)")
+            #: default pool = the dense cache's HBM budget exactly
+            #: (max_slots full-length rows) + the scrap page; prefix
+            #: sharing turns the saving into extra effective slots
+            np_total = int(n_pages) if n_pages is not None \
+                else self.max_slots * self._p_max + 1
+            self._pool = paging.PagePool(np_total)
+            self._prefix = paging.PrefixIndex(self._pool, ps) \
+                if prefix_cache else None
+            self._blocked: collections.deque = collections.deque()
+            self._seq = 0
+            self._chunks_this_iter = 0
+            self._cache = model.init_paged_cache(
+                self.max_slots, np_total, ps, self._s_max,
+                dtype=cache_dtype)
+        else:
+            if policy is None:
+                policy = BucketingPolicy(mode="pow2", min_size=8)
+            self.policy = policy.clamped(self._s_max)
+            self._cache = model.init_cache(self.max_slots, self._s_max,
+                                           dtype=cache_dtype)
         self._slots: list = [None] * self.max_slots
         self._n_active = 0
         #: serializes every model call (worker admit/step, sync-mode
@@ -427,6 +557,9 @@ class GenerationEngine:
                 # closing engine is wasted work at best and a
                 # donated-buffer race at worst — bail cleanly
                 return self
+            if self.paged:
+                self._warmup_paged()
+                return self
             cache = self.model.init_cache(self.max_slots, self._s_max,
                                           dtype=self._cache_dtype)
             for sb in self.policy.sizes(self._s_max - 1):
@@ -436,6 +569,34 @@ class GenerationEngine:
             self.model.decode_step(
                 onp.zeros((self.max_slots,), "i4"), cache)
         return self
+
+    def _warmup_paged(self):
+        """Compile the paged steady state against a throwaway cache:
+        one fresh-prefill program per bucket <= the chunk width, one
+        chunk program per page-multiple width <= the chunk width (tail
+        chunks shrink near the cache end), the decode step, the peek
+        (prefix-hit) path, and the table-bind / page-copy (COW)
+        helpers. Physical page ids are DATA, not shape — id choice
+        here is arbitrary."""
+        cache = self.model.init_paged_cache(
+            self.max_slots, self._pool.n_pages, self._ps, self._s_max,
+            dtype=self._cache_dtype)
+        row = onp.ones((self._p_max,), "i4")
+        for sb in self.policy.sizes(self._chunk):
+            if sb > self._chunk:
+                continue
+            _, cache = self.model.prefill_paged(
+                onp.zeros((1, sb), "i4"), sb, 0, row, cache,
+                fresh=True)
+        for w in range(self._ps, self._chunk + 1, self._ps):
+            _, cache = self.model.prefill_paged(
+                onp.zeros((1, w), "i4"), w, 0, row, cache, start=0)
+        _, cache = self.model.decode_step_paged(
+            onp.zeros((self.max_slots,), "i4"),
+            onp.ones((self.max_slots,), "i4"), cache)
+        self.model.peek_logits_paged(0, 0, cache)
+        cache = self.model.bind_slot_paged(0, row, 1, cache)
+        self.model.copy_page_paged(1, 1, cache)
 
     def load_weights(self, source, strict: bool = True):
         """Zero-downtime weight rollover: swap the model's parameter
@@ -472,6 +633,20 @@ class GenerationEngine:
             # waiter signal), warmup is not tracing
             _ckpt.swap_param_buffers(self.model.collect_params(),
                                      new_params, strict=strict)
+            if self.paged and self._prefix is not None:
+                # the prefix cache holds K/V computed with the OLD
+                # weights: a post-swap prefix hit would silently serve
+                # stale attention context forever. Flush it (pages
+                # pinned by in-flight slots stay alive via their own
+                # refs — those slots finish on mixed weights, the same
+                # documented in-flight tradeoff as the dense rollover)
+                # and suppress registration of any prompt prefilled
+                # before/across the swap — publishing mixed-weight K/V
+                # would poison future requests.
+                self._prefix.release_all()
+                for s in self._slots:
+                    if s is not None:
+                        s.prompt = None
         telemetry.hist_since("serving.generate.swap", t0)
         telemetry.counter("serving.generate.weight_swaps")
         return self
@@ -529,6 +704,13 @@ class GenerationEngine:
             else int(max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.paged:
+            cap = min(int(prompt.size) + max_new, self._s_max)
+            need = -(-cap // self._ps)
+            if need > self._pool.n_pages - 1:
+                raise ValueError(
+                    f"request needs up to {need} KV pages but the pool "
+                    f"holds {self._pool.n_pages - 1} allocatable pages")
         eos = self.eos_id if eos_id is None else eos_id
         return prompt.astype("i4"), max_new, eos
 
@@ -559,6 +741,15 @@ class GenerationEngine:
                 self._admit_one(req)
                 while self._n_active:
                     self._step()
+                if self.paged and self._blocked:
+                    # an idle sync engine can never unblock a stashed
+                    # request (validated capacity makes this a pool-
+                    # accounting bug, not a load condition) — reject
+                    # rather than hang
+                    self._blocked.popleft().stream._finish(
+                        exc=QueueFullError(
+                            "page pool exhausted for a synchronous "
+                            "request"))
             return stream
         try:
             self._worker._queue.put_nowait(req)
@@ -587,25 +778,64 @@ class GenerationEngine:
 
     # -- scheduling (generator thread / sync mode) ---------------------
     def _admit(self, q):
-        while self._n_active < self.max_slots:
+        if self.paged:
+            # page-starved requests wait in _blocked (FIFO — younger
+            # queue entries must not starve an older blocked one).
+            # queue_wait is recorded at the ACTUAL admission (or the
+            # rejection), so time spent blocked on KV pages shows up
+            # in the histogram an operator reads next to pages.free
+            while self._blocked and self._n_active < self.max_slots:
+                r = self._blocked[0]
+                waited_ms = (time.monotonic() - r.t_enq) * 1e3
+                if r.deadline is not None \
+                        and time.monotonic() > r.deadline:
+                    telemetry.hist("serving.generate.queue_wait",
+                                   waited_ms)
+                    telemetry.counter("serving.generate.timeouts")
+                    r.stream._finish(exc=RequestTimeoutError(
+                        f"request deadline expired while awaiting KV "
+                        f"pages (waited {waited_ms:.1f} ms)"))
+                    self._blocked.popleft()
+                    continue
+                if not self._try_admit_paged(r):
+                    break
+                telemetry.hist("serving.generate.queue_wait", waited_ms)
+                self._blocked.popleft()
+        while self._n_active < self.max_slots \
+                and not (self.paged and self._blocked):
             try:
                 r = q.get_nowait()
             except queue.Empty:
                 break
             self._admit_one(r)
-        telemetry.gauge("serving.generate.queue.depth", q.qsize())
+        telemetry.gauge(
+            "serving.generate.queue.depth",
+            q.qsize() + (len(self._blocked) if self.paged else 0))
 
     def _admit_one(self, r: _GenRequest):
-        """Prefill ``r`` into a free slot (sequence axis bucketed) and
-        emit its first token. Called only at step boundaries."""
+        """Admit ``r`` into a free slot and (dense mode) prefill it and
+        emit its first token; paged mode allocates its pages and either
+        peeks the first token off a fully-cached prefix or queues its
+        prefill chunks. Called only at step boundaries."""
         waited_ms = (time.monotonic() - r.t_enq) * 1e3
-        telemetry.hist("serving.generate.queue_wait", waited_ms)
         if r.deadline is not None and time.monotonic() > r.deadline:
+            telemetry.hist("serving.generate.queue_wait", waited_ms)
             telemetry.counter("serving.generate.timeouts")
             r.stream._finish(exc=RequestTimeoutError(
                 f"request expired in queue before prefill (waited "
                 f"{waited_ms:.1f} ms)"))
             return
+        if self.paged:
+            # a page-starved request goes to _blocked: its queue_wait
+            # is recorded when it actually admits (or rejects), not
+            # here — the blocked time is the interesting part
+            if self._try_admit_paged(r):
+                telemetry.hist("serving.generate.queue_wait",
+                               waited_ms)
+            else:
+                self._blocked.append(r)
+            return
+        telemetry.hist("serving.generate.queue_wait", waited_ms)
         slot = self._slots.index(None)
         n = int(r.prompt.size)
         sb = self.policy.bucket(n)
@@ -632,10 +862,286 @@ class GenerationEngine:
         else:
             telemetry.gauge("serving.generate.slots", self._n_active)
 
+    # -- paged scheduling ----------------------------------------------
+    def _alloc_pages(self, n):
+        """Allocate ``n`` pool pages, evicting LRU cached prefixes to
+        make room; None when even an empty prefix cache can't cover
+        them (the pages are pinned by active slots)."""
+        out = self._pool.alloc(n)
+        while out is None and self._prefix is not None \
+                and self._prefix.evict_lru():
+            out = self._pool.alloc(n)
+        return out
+
+    def _release_pages(self, pids):
+        for pid in pids:
+            self._pool.release(pid)
+
+    def _try_admit_paged(self, r: _GenRequest) -> bool:
+        """Place ``r`` into a free slot: match the longest cached
+        prefix, reserve its worst-case private pages (so decode can
+        never run out mid-sequence), and either peek its first token
+        straight off a fully-cached prompt or queue its prefill
+        chunks. False when the pool (after prefix-cache eviction)
+        cannot cover the reservation — the request stays blocked."""
+        length = int(r.prompt.size)
+        ps = self._ps
+        cap_pages = -(-min(length + r.max_new, self._s_max) // ps)
+        shared_pages, shared_tokens = [], 0
+        if self._prefix is not None:
+            shared_pages, shared_tokens = self._prefix.match(r.prompt)
+        peek = shared_tokens == length
+        first_write = (length if peek else shared_tokens) // ps
+        # retain the matched pages BEFORE allocating: _alloc_pages may
+        # LRU-evict the very record backing them, and unretained pages
+        # would return to the free list and come straight back as this
+        # request's PRIVATE pages (LIFO) — the row would alias shared
+        # and private, and chunk prefill would overwrite the shared
+        # prefix K/V (found by review with a live tight-pool repro)
+        refs = []
+        n_shared = len(shared_pages) if peek else first_write
+        for i in range(n_shared):
+            self._pool.retain(shared_pages[i])
+            refs.append(shared_pages[i])
+        private = self._alloc_pages(cap_pages - first_write)
+        if private is None and refs:
+            # our retained prefix refs pinned exactly the pages the
+            # allocator's eviction sweep tried to reclaim: drop the
+            # match and retry UNSHARED — a transiently page-heavy
+            # prefix hit must degrade to a plain prefill, not fail an
+            # admission a retry would satisfy
+            self._release_pages(refs)
+            refs = []
+            shared_pages, shared_tokens = [], 0
+            peek = False
+            first_write = n_shared = 0
+            private = self._alloc_pages(cap_pages)
+        if private is None:
+            self._release_pages(refs)
+            return False
+        slot = self._slots.index(None)
+        row = onp.zeros((self._p_max,), "i4")   # scrap past the cap
+        for i in range(n_shared):
+            row[i] = shared_pages[i]
+        refs.extend(private)
+        s = _PagedSlot(r.stream, r.max_new, r.eos_id, r.deadline,
+                       n_ctx=length, row=row, page_refs=refs,
+                       prompt=r.prompt, seq=self._seq,
+                       t_submit=r.t_submit)
+        self._seq += 1
+        if peek:
+            if length % ps:
+                # the shared partial tail is this slot's divergence
+                # page: COW it right before the first decode write
+                s.cow_pending = (int(row[first_write]), private[0],
+                                 first_write)
+                row[first_write + 1:cap_pages] = private[1:]
+            else:
+                row[first_write:cap_pages] = private
+            telemetry.counter("serving.generate.prefix_hits")
+            self._slots[slot] = s
+            self._n_active += 1
+            t0 = telemetry.clock()
+            self._cache = self.model.bind_slot_paged(
+                slot, row, length, self._cache)
+            logits = self.model.peek_logits_paged(
+                int(r.prompt[-1]), slot, self._cache)
+            telemetry.hist_since("serving.generate.prefill", t0)
+            telemetry.counter("serving.generate.prefills")
+            self._register_prefix(s)
+            self._first_token(slot, s, onp.asarray(logits))
+            return True
+        row[first_write:cap_pages] = private
+        start0 = first_write * ps
+        fresh = (start0 == 0
+                 and self.policy.bucket(length) <= self._chunk)
+        if fresh:
+            w = self.policy.bucket(length)
+            toks = onp.zeros((1, w), "i4")
+            toks[0, :length] = r.prompt
+            s.chunks.append((toks, 0, length, True))
+        else:
+            pos = start0
+            while pos < length:
+                w = min(self._chunk, self._s_max - pos)
+                nv = min(w, length - pos)
+                toks = onp.zeros((1, w), "i4")
+                toks[0, :nv] = r.prompt[pos:pos + nv]
+                s.chunks.append((toks, pos, nv, False))
+                pos += nv
+        self._slots[slot] = s
+        self._n_active += 1
+        return True
+
+    def _register_prefix(self, s: _PagedSlot):
+        """Publish a completed prompt's pages to the prefix index so
+        later identical/shared-prefix requests reuse them. When the
+        prompt ends mid-page and this slot will keep decoding, the now
+        index-retained tail page becomes shared — arm a COW so the
+        slot's first decode write copies it instead of corrupting the
+        cached prefix."""
+        if self._prefix is None or s.prompt is None:
+            return
+        length = int(s.prompt.size)
+        needs_cow = (length % self._ps != 0 and s.cow_pending is None
+                     and s.left > 1 and s.n_ctx < self._s_max)
+        dst = None
+        if needs_cow:
+            dst = self._alloc_pages(1)
+            if dst is None:
+                return  # can't afford to freeze the tail: skip caching
+        if not self._prefix.register(s.prompt, s.row):
+            if dst:
+                self._release_pages(dst)
+        elif dst:
+            s.cow_pending = (int(s.row[length // self._ps]), dst[0],
+                             length // self._ps)
+            s.page_refs.append(dst[0])
+        s.prompt = None
+
+    def _first_token(self, slot: int, s: _PagedSlot, logits_row):
+        """Emit a freshly-admitted request's first token (from its last
+        prefill chunk's logits or the prefix-hit peek) — the paged
+        analog of dense ``_admit_one``'s tail."""
+        tok = int(logits_row.reshape(-1, logits_row.shape[-1])[0]
+                  .argmax())
+        s.last = tok
+        s.left -= 1
+        s.state = "decode"
+        s.stream._emit(tok)
+        telemetry.counter("serving.generate.tokens")
+        telemetry.hist_since("serving.generate.ttft", s.t_submit)
+        if s.eos_id is not None and tok == s.eos_id:
+            self._evict(slot, "eos")
+        elif s.left <= 0 or s.n_ctx >= self._s_max:
+            self._evict(slot, "length")
+        else:
+            telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _prefill_tick(self) -> int:
+        """Run AT MOST ONE prefill chunk (oldest admitted slot first):
+        the decode-stall bound — a 192-token prompt spends several
+        iterations prefilling, each interleaved with a decode step over
+        the in-flight slots, so TPOT p99 is bounded by one chunk, not
+        one monolithic prefill."""
+        best = None
+        for i, s in enumerate(self._slots):
+            if s is not None and s.state == "prefill" \
+                    and (best is None or s.seq < self._slots[best].seq):
+                best = i
+        if best is None:
+            return 0
+        s = self._slots[best]
+        if s.deadline is not None and time.monotonic() > s.deadline:
+            telemetry.counter("serving.generate.timeouts")
+            self._evict_exc(best, RequestTimeoutError(
+                "request deadline expired during chunked prefill"))
+            return 0
+        toks, start, n_valid, fresh = s.chunks.popleft()
+        t0 = telemetry.clock()
+        logits, self._cache = self.model.prefill_paged(
+            toks, n_valid, best, s.row, self._cache, start=start,
+            fresh=fresh)
+        telemetry.hist_since("serving.generate.prefill", t0)
+        telemetry.counter("serving.generate.prefill_chunks")
+        self._chunks_this_iter += 1
+        if not s.chunks:
+            telemetry.counter("serving.generate.prefills")
+            self._register_prefix(s)
+            self._first_token(best, s, onp.asarray(logits))
+        return 1
+
+    def _decode_tick(self):
+        """One fixed-shape paged decode step over all DECODING slots
+        (prefilling slots ride along masked out — their writes are
+        redirected to the scrap page and their ``len`` stands still)."""
+        for i, s in enumerate(self._slots):
+            if s is not None and s.state == "decode" \
+                    and s.cow_pending is not None:
+                src, dst, logical = s.cow_pending
+                self._cache = self.model.copy_page_paged(
+                    src, dst, self._cache)
+                s.row[logical] = dst
+                self._cache = self.model.bind_slot_paged(
+                    i, s.row, s.n_ctx, self._cache)
+                self._pool.release(src)
+                s.page_refs.remove(src)
+                s.cow_pending = None
+                telemetry.counter("serving.generate.pages.cow_copies")
+        toks = onp.zeros((self.max_slots,), "i4")
+        active = onp.zeros((self.max_slots,), "i4")
+        for i, s in enumerate(self._slots):
+            if s is not None and s.state == "decode":
+                toks[i] = s.last
+                active[i] = 1
+        t0 = telemetry.clock()
+        logits, self._cache = self.model.decode_step_paged(
+            toks, active, self._cache)
+        telemetry.hist_since("serving.generate.decode", t0)
+        arr = onp.asarray(logits)
+        now = time.monotonic()
+        n_emitted = 0
+        for i, s in enumerate(self._slots):
+            if s is None or s.state != "decode" or not active[i]:
+                continue
+            tok = int(arr[i].argmax())
+            s.last = tok
+            s.left -= 1
+            s.n_ctx += 1
+            s.stream._emit(tok)
+            n_emitted += 1
+            if s.eos_id is not None and tok == s.eos_id:
+                self._evict(i, "eos")
+            elif s.left <= 0 or s.n_ctx >= self._s_max:
+                self._evict(i, "length")
+            elif s.deadline is not None and now > s.deadline:
+                telemetry.counter("serving.generate.timeouts")
+                self._evict(i, "timeout")
+        if n_emitted:
+            telemetry.counter("serving.generate.tokens", n_emitted)
+        telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _evict_exc(self, slot: int, exc):
+        """Reject a slot whose stream has delivered nothing yet (a
+        prefill-phase deadline): an exception, not a truncated
+        result."""
+        self._slots[slot].stream._finish(exc=exc)
+        self._free_slot(slot)
+
+    def _release_slot_refs(self, s):
+        if self.paged and s.page_refs:
+            self._release_pages(s.page_refs)
+            s.page_refs = []
+
+    def _free_slot(self, slot: int):
+        s = self._slots[slot]
+        self._release_slot_refs(s)
+        self._slots[slot] = None
+        self._n_active -= 1
+        telemetry.counter("serving.generate.evictions")
+        telemetry.gauge("serving.generate.slots", self._n_active)
+
     def _step(self):
-        """One fixed-shape decode step over ALL slots; emit one token
-        per live slot, evict finished slots (their rows are free for
-        the next admission — mid-sequence, zero recompiles)."""
+        """One engine iteration. Paged mode: at most one prefill chunk
+        (``_prefill_tick``) then one fixed-shape decode step over the
+        decoding slots. Dense mode: one decode step over ALL slots;
+        emit one token per live slot, evict finished slots (their rows
+        are free for the next admission — mid-sequence, zero
+        recompiles)."""
+        if self.paged:
+            # the gauge counts EVERY chunk run inside this iteration
+            # (accumulated by _prefill_tick itself, not inferred from
+            # its call count) so the one-chunk decode-stall bound is
+            # falsifiable: a future second tick call would push the
+            # peak past 1 and fail the tests/bench gate
+            self._chunks_this_iter = 0
+            self._prefill_tick()
+            telemetry.gauge("serving.generate.prefill_chunks_per_iter",
+                            self._chunks_this_iter)
+            if any(s is not None and s.state == "decode"
+                   for s in self._slots):
+                self._decode_tick()
+            return
         toks = onp.zeros((self.max_slots,), "i4")
         for i, s in enumerate(self._slots):
             if s is not None:
@@ -668,19 +1174,41 @@ class GenerationEngine:
 
     def _evict(self, slot: int, reason: str):
         self._slots[slot].stream._finish(reason=reason)
-        self._slots[slot] = None
-        self._n_active -= 1
-        telemetry.counter("serving.generate.evictions")
-        telemetry.gauge("serving.generate.slots", self._n_active)
+        self._free_slot(slot)
 
     def _close_active(self, reason: str):
         """Finish every still-active stream with ``reason`` (idempotent
-        per stream: a first outcome stands) and free the slots."""
+        per stream: a first outcome stands) and free the slots. A paged
+        slot still in its PREFILL phase has delivered nothing — it is
+        rejected with :class:`EngineClosedError` like a queued request,
+        never handed an empty 'successful' result. Paged mode also
+        rejects page-starved blocked requests."""
         for i, s in enumerate(self._slots):
             if s is not None:
-                s.stream._finish(reason=reason)
+                if self.paged and s.state == "prefill":
+                    s.stream._finish(exc=EngineClosedError(
+                        "engine closed during chunked prefill (no "
+                        "tokens were generated)"))
+                else:
+                    s.stream._finish(reason=reason)
+                self._release_slot_refs(s)
                 self._slots[i] = None
         self._n_active = 0
+        self._teardown_paged(EngineClosedError(
+            "engine closed while the request awaited KV pages"))
+
+    def _teardown_paged(self, exc):
+        """Terminal paged cleanup shared by close and worker-crash:
+        reject every page-starved blocked request with ``exc`` and
+        drain the prefix index — a dead engine serves nothing, and the
+        pool/gauge must read fully free afterwards (post-close
+        accounting, dashboards, leak checks)."""
+        if not self.paged:
+            return
+        while self._blocked:
+            self._blocked.popleft().stream._finish(exc=exc)
+        if self._prefix is not None:
+            self._prefix.release_all()
 
     def _fail_all(self, exc):
         """Worker crashed mid-step (the cache may hold donated/invalid
@@ -699,8 +1227,10 @@ class GenerationEngine:
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.stream._finish(exc=failure)
+                self._release_slot_refs(s)
                 self._slots[i] = None
         self._n_active = 0
+        self._teardown_paged(failure)
         if self._worker is not None:
             self._worker._stopped = True  # a still-looping worker (an
             # injected failure, not a real crash) exits at its next poll

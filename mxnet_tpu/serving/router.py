@@ -54,13 +54,14 @@ Telemetry (docs/OBSERVABILITY.md): counters
 ``serving.router.{requests,completed,retries,replica_failures,
 replica_crashes,replica_full,rejected_shed,rejected_quota,
 rejected_full,rejected_closed,brownout_capped,breaker_opens,
-breaker_half_opens,breaker_closes,fail_open,timeouts,errors,
-rollovers,probes}``, gauges
+breaker_half_opens,breaker_closes,fail_open,prefix_affinity_hits,
+timeouts,errors,rollovers,probes}``, gauges
 ``serving.router.{outstanding,healthy_replicas}`` (with peaks), and
 the ``serving.router.latency`` histogram (submit → final outcome).
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 import weakref
@@ -133,10 +134,11 @@ class _Replica:
 
 class _Req:
     __slots__ = ("payload", "max_new", "eos_id", "deadline", "tenant",
-                 "priority", "retries_left", "sink", "t0", "finished")
+                 "priority", "retries_left", "sink", "t0", "finished",
+                 "prefix_key")
 
     def __init__(self, payload, max_new, eos_id, deadline, tenant,
-                 priority, retries_left, sink, t0):
+                 priority, retries_left, sink, t0, prefix_key=None):
         self.payload = payload
         self.max_new = max_new
         self.eos_id = eos_id
@@ -147,6 +149,7 @@ class _Req:
         self.sink = sink           # RouterStream (generate) / Future
         self.t0 = t0
         self.finished = False
+        self.prefix_key = prefix_key
 
 
 class _Prober(threading.Thread):
@@ -217,6 +220,11 @@ class Router:
         budget propagates to every dispatch attempt, including retries.
     fault_injector : FaultInjector, optional
         Chaos seam: consulted before every replica dispatch.
+    prefix_affinity_slack : int
+        How many queued requests of extra load a prefix-warm replica
+        may carry and still win a ``submit(prefix_key=...)`` dispatch
+        over the shortest queue (soft preference: health, breaker
+        state, and larger imbalances always win).
     """
 
     def __init__(self, replicas, *, max_retries: int = 2,
@@ -226,7 +234,8 @@ class Router:
                  probe_interval_s: float = 0.5,
                  queue_limit=None, brownout_frac: float = 0.8,
                  brownout_max_new_tokens=None, tenant_quota=None,
-                 timeout_ms=None, fault_injector=None):
+                 timeout_ms=None, fault_injector=None,
+                 prefix_affinity_slack: int = 4):
         replicas = list(replicas)
         if not replicas:
             raise ValueError("Router needs at least one replica")
@@ -253,6 +262,12 @@ class Router:
         self._tenant_quota = tenant_quota
         self.timeout_ms = timeout_ms
         self._faults = fault_injector
+        self.prefix_affinity_slack = int(prefix_affinity_slack)
+        #: prefix_key -> replica idx that last held that prefix's
+        #: pages (bounded FIFO; a soft routing hint, never load-bearing)
+        self._affinity: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._affinity_cap = 4096
         self._lock = threading.Lock()
         self._outstanding = 0
         self._tenant_out: dict = {}
@@ -324,7 +339,7 @@ class Router:
         (an in-process engine cannot resurrect — no trial traffic)."""
         return rep.engine._failure is not None or rep.engine.closed
 
-    def _pick(self, exclude):
+    def _pick(self, exclude, affinity=None):
         """Select the dispatch target: the half-open trial slot first
         (the breaker can only close by observing a success), else the
         least-loaded closed-breaker replica; cordoned replicas (mid-
@@ -333,11 +348,18 @@ class Router:
         open, route to the least-loaded one anyway — shedding every
         request because the whole fleet tripped (e.g. a retry burst
         meeting a transient error spike) would turn a partial outage
-        into a total one; a success then closes the breaker."""
+        into a total one; a success then closes the breaker.
+
+        ``affinity`` is a SOFT prefix-affinity hint: among the healthy
+        closed-breaker candidates, the replica already holding that
+        prefix's KV pages wins as long as its queued load is within
+        ``prefix_affinity_slack`` of the shortest queue — a warm
+        prefix beats a marginally shorter queue, but health, breaker
+        state, cordons, and real imbalance always win."""
         now = time.monotonic()
         with self._lock:
-            half = best = best_cord = best_open = None
-            best_load = best_cord_load = best_open_load = None
+            half = best = best_cord = best_open = aff = None
+            best_load = best_cord_load = best_open_load = aff_load = None
             for rep in self._replicas:
                 if rep.idx in exclude or self._dead(rep):
                     continue
@@ -358,11 +380,23 @@ class Router:
                 elif rep.cordoned:
                     if best_cord is None or load < best_cord_load:
                         best_cord, best_cord_load = rep, load
-                elif best is None or load < best_load:
-                    best, best_load = rep, load
+                else:
+                    if rep.idx == affinity:
+                        aff, aff_load = rep, load
+                    if best is None or load < best_load:
+                        best, best_load = rep, load
             if half is not None:
                 half.half_open_trial = 1
                 return half
+            if aff is not None and \
+                    aff_load[0] <= best_load[0] + self.prefix_affinity_slack:
+                if aff is not best:
+                    # count only dispatches the hint actually CHANGED —
+                    # an idle fleet where JSQ already picks the warm
+                    # replica must not read as 100% affinity routing
+                    telemetry.counter(
+                        "serving.router.prefix_affinity_hits")
+                return aff
             if best is not None:
                 return best
             if best_cord is not None:
@@ -563,13 +597,20 @@ class Router:
     # -- submit --------------------------------------------------------
     def submit(self, *args, max_new_tokens=None, eos_id=None,
                timeout_ms=None, tenant: str = "default",
-               priority: int = 0):
+               priority: int = 0, prefix_key=None):
         """Queue one request on the fleet.
 
         Generation fleets take exactly one positional ``prompt`` and
         return a :class:`RouterStream`; inference fleets take the
         request args and return a ``Future``. ``tenant`` scopes the
         quota, ``priority`` (0 = highest) orders load shedding.
+        ``prefix_key`` is an opaque caller-chosen label for the
+        request's shared prompt prefix (e.g. a system-prompt id):
+        requests with the same key are soft-biased toward the replica
+        that served that key last, so its paged-KV prefix cache stays
+        warm — health, breaker state, and join-shortest-queue still
+        win (``serving.router.prefix_affinity_hits`` counts the
+        dispatches the hint changed).
         Raises :class:`EngineClosedError` / :class:`LoadShedError` /
         :class:`TenantQuotaError` / :class:`QueueFullError` /
         ``ValueError`` immediately, never via a hung stream."""
@@ -590,7 +631,8 @@ class Router:
             max_new = self._admit(tenant, priority, max_new)
             sink = RouterStream(int(prompt.size), tenant, priority)
             req = _Req(prompt, max_new, eos, deadline, tenant, priority,
-                       self.max_retries, sink, telemetry.clock())
+                       self.max_retries, sink, telemetry.clock(),
+                       prefix_key=prefix_key)
         else:
             if max_new_tokens is not None or eos_id is not None:
                 raise TypeError(
@@ -601,7 +643,8 @@ class Router:
             sink.tenant, sink.priority = tenant, priority
             sink.retries, sink.replicas = 0, []
             req = _Req(args, None, None, deadline, tenant, priority,
-                       self.max_retries, sink, telemetry.clock())
+                       self.max_retries, sink, telemetry.clock(),
+                       prefix_key=prefix_key)
         telemetry.counter("serving.router.requests")
         try:
             self._dispatch(req, frozenset(), inline=True)
@@ -658,7 +701,11 @@ class Router:
                 return self._fail(req, RequestTimeoutError(
                     "request deadline expired before a replica could "
                     "serve it"), inline)
-            rep = self._pick(exclude)
+            affinity = None
+            if req.prefix_key is not None:
+                with self._lock:
+                    affinity = self._affinity.get(req.prefix_key)
+            rep = self._pick(exclude, affinity=affinity)
             if rep is None:
                 return self._fail(req, ReplicaFailedError(
                     f"no available replica in the fleet "
@@ -702,6 +749,13 @@ class Router:
             with self._lock:
                 rep.inflight += 1
                 rep.dispatches += 1
+                if req.prefix_key is not None:
+                    # this replica now holds the prefix's pages — bias
+                    # the key's future requests toward it
+                    self._affinity.pop(req.prefix_key, None)
+                    self._affinity[req.prefix_key] = rep.idx
+                    while len(self._affinity) > self._affinity_cap:
+                        self._affinity.popitem(last=False)
             req.sink.replicas.append(rep.idx)
             if self._mode == "generate":
                 self._attach_gen(req, rep, attempt)

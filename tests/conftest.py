@@ -29,6 +29,47 @@ def pytest_configure(config):
     # opt out of the 870s window with this marker
     config.addinivalue_line(
         "markers", "slow: long soak/perf test, excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "requires_pallas: exercises a Pallas kernel in "
+        "interpret mode; auto-skipped on boxes whose jax build cannot "
+        "run pallas_call (keeps tier-1 green on minimal CI boxes)")
+
+
+_PALLAS_OK = None
+
+
+def _pallas_supported():
+    """Probe interpret-mode pallas_call once per session: some CPU-only
+    jax builds ship without a working Pallas lowering, and a marked
+    kernel test must skip there instead of failing tier-1."""
+    global _PALLAS_OK
+    if _PALLAS_OK is None:
+        try:
+            import jax
+            import jax.numpy as jnp
+            import jax.experimental.pallas as pl
+
+            def _probe(x_ref, o_ref):
+                o_ref[...] = x_ref[...] + 1.0
+
+            out = pl.pallas_call(
+                _probe,
+                out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+                interpret=True)(jnp.zeros((8, 128), jnp.float32))
+            _PALLAS_OK = bool((out == 1.0).all())
+        except Exception:  # noqa: BLE001 — any failure means "skip"
+            _PALLAS_OK = False
+    return _PALLAS_OK
+
+
+def pytest_collection_modifyitems(config, items):
+    marked = [it for it in items if "requires_pallas" in it.keywords]
+    if not marked or _pallas_supported():
+        return
+    skip = pytest.mark.skip(
+        reason="Pallas interpret mode unavailable on this box")
+    for item in marked:
+        item.add_marker(skip)
 
 
 @pytest.fixture(autouse=True)

@@ -17,6 +17,7 @@ def _qkv(b=2, h=4, s=128, d=32, seed=0):
     return mk(), mk(), mk()
 
 
+@pytest.mark.requires_pallas
 def test_pallas_kernel_matches_reference():
     q, k, v = _qkv()
     ref = at.mha_reference(q, k, v, causal=False)
@@ -27,6 +28,7 @@ def test_pallas_kernel_matches_reference():
                                 rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.requires_pallas
 def test_pallas_kernel_causal():
     q, k, v = _qkv(s=64)
     ref = at.mha_reference(q, k, v, causal=True)
@@ -105,6 +107,7 @@ def test_hybridize_sequence_parallel_matches_eager():
     onp.testing.assert_allclose(eager, hyb, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.requires_pallas
 def test_flash_ragged_and_decode_shapes():
     # non-multiple-of-block lengths pad cleanly; sq != sk uses the
     # end-aligned causal offset (decode with KV cache)
@@ -126,6 +129,7 @@ def test_flash_ragged_and_decode_shapes():
                                 rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.requires_pallas
 def test_flash_kv_len_matches_sliced_cache():
     """kv_len on a long cache buffer == flash over the sliced cache ==
     mha_reference — the cache-backed prefill convention (padded tail
@@ -173,6 +177,7 @@ def test_flash_kv_len_grads_match_and_tail_is_zero():
     assert onp.abs(onp.asarray(g1[2][:, :, kvl:])).max() == 0.0
 
 
+@pytest.mark.requires_pallas
 def test_decode_attention_matches_sliced_reference():
     """Single-query decode attention with per-slot lengths: each row
     matches mha_reference over that row's valid cache prefix; jnp path
@@ -239,3 +244,70 @@ def test_transformer_cell_trains_sequence_parallel():
         y = mx.np.array(onp.random.randint(0, 8, size=(4,)), dtype="int32")
         losses = [float(step(x, y).asnumpy()) for _ in range(30)]
     assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+def test_paged_decode_attention_matches_gathered_reference():
+    """Paged decode over a (pool, table) cache == dense decode over the
+    gathered per-slot view, bit for bit on the jnp path (the paged
+    engine's token-identity to the dense engine rests on this), with
+    empty slots returning zeros."""
+    onp.random.seed(6)
+    B, H, D, PS, NP = 4, 2, 32, 16, 40
+    P_MAX = 8                                      # capacity 128
+    mk = lambda *s: jnp.asarray(  # noqa: E731
+        onp.random.randn(*s).astype("float32") * 0.5)
+    kpool, vpool = mk(NP, H, PS, D), mk(NP, H, PS, D)
+    rng = onp.random.RandomState(7)
+    table = jnp.asarray(rng.permutation(onp.arange(1, NP))
+                        [:B * P_MAX].reshape(B, P_MAX).astype("i4"))
+    lengths = jnp.asarray([0, 1, 77, 128], jnp.int32)
+    q = mk(B, H, 1, D)
+    kg = at.gather_pages(kpool, table)
+    vg = at.gather_pages(vpool, table)
+    ref = at.decode_attention(q, kg, vg, lengths)
+    out = at.paged_decode_attention(q, kpool, vpool, table, lengths)
+    assert (onp.asarray(out) == onp.asarray(ref)).all()
+    assert onp.abs(onp.asarray(out[0])).max() == 0.0   # empty slot
+
+
+@pytest.mark.requires_pallas
+def test_paged_decode_attention_pallas_parity():
+    """The Pallas paged kernel (scalar-prefetched lengths + page table
+    bounding DMA to each slot's valid pages) matches the jnp path."""
+    onp.random.seed(8)
+    B, H, D, PS, NP, P_MAX = 3, 2, 32, 16, 30, 6
+    mk = lambda *s: jnp.asarray(  # noqa: E731
+        onp.random.randn(*s).astype("float32") * 0.5)
+    kpool, vpool = mk(NP, H, PS, D), mk(NP, H, PS, D)
+    rng = onp.random.RandomState(9)
+    table = jnp.asarray(rng.permutation(onp.arange(1, NP))
+                        [:B * P_MAX].reshape(B, P_MAX).astype("i4"))
+    lengths = jnp.asarray([5, 0, 96], jnp.int32)
+    q = mk(B, H, 1, D)
+    ref = at.paged_decode_attention(q, kpool, vpool, table, lengths)
+    pal = at.paged_decode_attention_pallas(q, kpool, vpool, table,
+                                           lengths, interpret=True)
+    onp.testing.assert_allclose(onp.asarray(pal), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-5)
+
+
+def test_chunked_prefill_attention_matches_reference():
+    """A chunk's queries at global positions [start, start+C) against a
+    cache buffer == the matching rows of full causal mha_reference over
+    [0, start+C) — per-row global causal masking, any start."""
+    onp.random.seed(10)
+    H, D, S = 2, 32, 96
+    mk = lambda *s: jnp.asarray(  # noqa: E731
+        onp.random.randn(*s).astype("float32") * 0.5)
+    kbuf, vbuf = mk(1, H, S, D), mk(1, H, S, D)
+    for start, c in [(0, 8), (24, 8), (88, 8), (0, 32)]:
+        q = mk(1, H, c, D)
+        out = at.chunked_prefill_attention(q, kbuf, vbuf, start)
+        fq = onp.zeros((1, H, start + c, D), "f4")
+        fq[:, :, start:] = onp.asarray(q)
+        ref = at.mha_reference(jnp.asarray(fq),
+                               kbuf[:, :, :start + c],
+                               vbuf[:, :, :start + c], causal=True)
+        onp.testing.assert_allclose(
+            onp.asarray(out), onp.asarray(ref)[:, :, start:],
+            rtol=2e-4, atol=2e-5, err_msg=(start, c))

@@ -515,3 +515,43 @@ def test_soak_randomized_fault_schedule(base):
     assert telemetry.counter_value("serving.router.retries") >= 1
     router.close(timeout=60.0)
     assert not router._prober.is_alive()
+
+
+def test_prefix_affinity_hint(base):
+    """submit(prefix_key=...) softly biases dispatch toward the replica
+    that last served that key: the biased replica wins over an idle one
+    while its load is within the slack, the hit counter counts it, and
+    a DOWN affinity replica is routed around (health always wins)."""
+    net, params = base
+    router = Router(_fleet(params, n=3, queue_limit=64),
+                    probe_interval_s=10.0)
+    rng = onp.random.RandomState(31)
+    p = _prompt(rng, 5)
+    try:
+        s0 = router.submit(p, max_new_tokens=2, prefix_key="sys")
+        s0.result(timeout=120)
+        home = s0.replicas[0]
+        telemetry.reset()
+        # a long-running request keeps the home replica busier than
+        # the idle others — JSQ alone would route away, the affinity
+        # hint (within slack) keeps the prefix-warm replica
+        busy = router.submit(p, max_new_tokens=24, prefix_key="sys")
+        assert busy.replicas[0] == home
+        warm = router.submit(p, max_new_tokens=2, prefix_key="sys")
+        assert warm.replicas[0] == home
+        # only dispatches the hint CHANGED are counted ("warm" beat a
+        # shorter queue; "busy" may have been the JSQ pick anyway)
+        assert telemetry.counter_value(
+            "serving.router.prefix_affinity_hits") >= 1
+        # no key -> pure JSQ, unaffected by the affinity map
+        plain = router.submit(_prompt(rng, 4), max_new_tokens=2)
+        assert plain.replicas[0] != home
+        for s in (busy, warm, plain):
+            s.result(timeout=120)
+        # health wins: a dead home replica never gets hint traffic
+        router.replicas[home].close()
+        moved = router.submit(p, max_new_tokens=2, prefix_key="sys")
+        assert moved.replicas[0] != home
+        moved.result(timeout=120)
+    finally:
+        router.close()

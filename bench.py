@@ -2893,7 +2893,483 @@ def _prefix_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --quant: low-precision serving benchmark (CPU-runnable; --smoke is
+# the tier-1-sized variant). Subprocess-isolated configs, gates
+# ENFORCED via exit code -> BENCH_r14.json:
+#
+#   parity : the correctness phase. Free-running fp32 decode over the
+#            bench corpus records tokens + logits; the int8-weights
+#            model then replays the SAME token stream TEACHER-FORCED
+#            (identical inputs each step, so the comparison measures
+#            quantization error, not path divergence lock-in) ->
+#            greedy agreement >= 98% + per-step logit max-abs-err
+#            bound; the int8-KV run replays it again -> the
+#            quantized-KV per-step bound (vs the int8-weights logits:
+#            same weights, only the cache storage differs).
+#   fp32 / w8 : the weight-bandwidth A/B at ONE HBM budget. Decode at
+#            small batch re-streams the whole parameter set per step,
+#            so the budget that holds fp32 params + 2 KV slots holds
+#            int8 params + 8 (param bytes / 4 -> the savings buy KV
+#            slots). Both engines decode at batch <= 8 under the same
+#            closed-loop workload; gate: int8-weights tokens/sec >=
+#            1.3x fp32. (Per-STEP latency is reported, not gated: on
+#            CPU the in-cache dequant roughly ties fp32 — the win is
+#            slots-per-byte, which is exactly the production story.)
+#   kv_fp32 / kv_int8 : the paged-pool density A/B at the SAME POOL
+#            BYTES, on BENCH_r13's exact model/workload shape (80%
+#            share a 192-token system prompt). int8 pages cost ~1/4
+#            the bytes of fp32 (+ per-head scales), so the same bytes
+#            hold ~4x the pages; gate: effective sequences >= 1.8x
+#            the fp32-KV pool's (and the multiplier over BENCH_r13's
+#            committed ~40 is reported).
+#   every config: 0 in-window compiles (quantized closures keep the
+#            fixed-shape zero-steady-state-compile discipline).
+# ---------------------------------------------------------------------------
+QUANT_SMOKE = os.environ.get("BENCH_QUANT_SMOKE", "") not in ("", "0")
+if QUANT_SMOKE:
+    # tiny enough for tier-1 CI: 8 requests, seconds per config
+    QNT_VOCAB, QNT_UNITS, QNT_LAYERS, QNT_HEADS = 256, 128, 2, 4
+    QNT_SMAX, QNT_REQS, QNT_STEPS, QNT_REPS = 64, 8, 12, 1
+    QNT_KV_UNITS, QNT_KV_LAYERS, QNT_KV_SMAX = 64, 2, 128
+    QNT_KV_SYS_LEN, QNT_KV_REQS, QNT_KV_SLOTS = 64, 8, 4
+else:
+    QNT_VOCAB, QNT_UNITS, QNT_LAYERS, QNT_HEADS = 256, 384, 4, 8
+    QNT_SMAX, QNT_REQS, QNT_STEPS, QNT_REPS = 128, 32, 24, 2
+    # the KV phase replicates BENCH_r13's model/workload shape so the
+    # effective-sequences multiplier composes with its committed ~40
+    QNT_KV_UNITS, QNT_KV_LAYERS, QNT_KV_SMAX = PFX_UNITS, PFX_LAYERS, \
+        PFX_SMAX
+    QNT_KV_SYS_LEN, QNT_KV_REQS, QNT_KV_SLOTS = PFX_SYS_LEN, PFX_REQS, \
+        PFX_SLOTS
+QNT_SLOTS_FP32 = 2          # KV slots the fp32 budget has room for
+QNT_MAX_SLOTS = 8           # "batch <= 8": the decode-batch cap
+QNT_KV_HEADS, QNT_KV_PS, QNT_KV_CHUNK = 4, 16, 32
+QNT_KV_PAGES_F32 = QNT_KV_SLOTS * QNT_KV_SMAX // QNT_KV_PS
+QNT_AGREE_MIN = 0.98        # greedy corpus agreement gate
+QNT_W8_TOL = 0.25           # per-step logit max-abs-err, int8 weights
+QNT_KV_TOL = 0.60           # per-step logit max-abs-err, int8 KV
+QNT_THR_MIN = 1.3           # int8-weights tokens/sec over fp32
+QNT_KV_EFF_MIN = 1.8        # int8-KV effective sequences over fp32-KV
+QNT_R13_EFFECTIVE = 40.0    # BENCH_r13's committed paged figure
+
+
+def _qnt_model(seed=0):
+    """Tied-embedding GPT: lm_head.weight == word_embed.weight, so the
+    residual stream's copy of the last token dominates the logits —
+    greedy argmax has a real gap for rounding error to clear, instead
+    of the near-ties an untied random-init head produces. (A trained
+    LM is peaky for the same reason; a random untied head is the one
+    configuration with no signal at all.)"""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(seed)
+    net = GPTModel(vocab_size=QNT_VOCAB, units=QNT_UNITS,
+                   num_layers=QNT_LAYERS, num_heads=QNT_HEADS,
+                   max_length=QNT_SMAX)
+    net.initialize(mx.init.Xavier())
+    net._gen_params()
+    params = net.collect_params()
+    params["lm_head.weight"].set_data(
+        mx.np.array(params["word_embed.weight"].data().asnumpy()))
+    net._clear_cached_op()
+    return net
+
+
+def _qnt_workload():
+    """(prompt, max_new) corpus, fixed seed, identical per config."""
+    import numpy as onp
+    rng = onp.random.RandomState(61)
+    return [(rng.randint(0, QNT_VOCAB,
+                         int(rng.randint(8, 25))).astype("i4"),
+             int(rng.randint(16, 33))) for _ in range(QNT_REQS)]
+
+
+def _qnt_budget():
+    """(param_bytes_fp32, kv_bytes_per_slot, int8_slots): the shared
+    HBM budget arithmetic. budget = fp32 params + QNT_SLOTS_FP32 KV
+    slots; quantizing the params to int8 frees 3/4 of their bytes,
+    which buy (3/4 * params / kv_slot) more slots, capped at the
+    QNT_MAX_SLOTS decode batch."""
+    import numpy as onp
+    emb = QNT_VOCAB * QNT_UNITS
+    per_block = 4 * QNT_UNITS * QNT_UNITS \
+        + 2 * QNT_UNITS * (4 * QNT_UNITS) \
+        + (9 * QNT_UNITS + 4 * QNT_UNITS)            # biases + LN
+    n_params = 2 * emb + QNT_SMAX * QNT_UNITS \
+        + QNT_LAYERS * per_block + 2 * QNT_UNITS
+    p_bytes = int(n_params) * 4
+    kv_slot = QNT_LAYERS * 2 * QNT_SMAX * QNT_UNITS * 4
+    budget = p_bytes + QNT_SLOTS_FP32 * kv_slot
+    int8_slots = int(min(QNT_MAX_SLOTS,
+                         (budget - p_bytes // 4) // kv_slot))
+    return p_bytes, kv_slot, max(QNT_SLOTS_FP32, int8_slots)
+
+
+def _qnt_parity():
+    """Teacher-forced bounded-divergence measurement over the bench
+    corpus (see the section comment for why teacher-forced)."""
+    import hashlib
+    import numpy as onp
+    net = _qnt_model()
+    prompts = [p for p, _m in _qnt_workload()]
+    groups = [prompts[g:g + QNT_MAX_SLOTS]
+              for g in range(0, len(prompts), QNT_MAX_SLOTS)]
+
+    def run(kv_dtype=None, forced=None):
+        toks_all, logs_all = [], []
+        for gi, group in enumerate(groups):
+            b = len(group)
+            cache = net.init_cache(b, QNT_SMAX, dtype=kv_dtype)
+            firsts = []
+            for i, p in enumerate(group):
+                pad = onp.zeros((1, 32), "i4")
+                pad[0, :p.size] = p
+                lg, cache = net.prefill(pad, [p.size], cache,
+                                        slots=[i])
+                firsts.append(int(onp.asarray(lg)[0].argmax()))
+            lasts = onp.asarray(firsts, "i4")
+            toks, logs = [lasts.copy()], []
+            for t in range(QNT_STEPS):
+                inp = lasts if forced is None else forced[gi][t]
+                lg, cache = net.decode_step(inp, cache)
+                arr = onp.asarray(lg)
+                logs.append(arr.copy())
+                lasts = arr.argmax(axis=1).astype("i4")
+                toks.append(lasts.copy())
+            toks_all.append(onp.stack(toks))
+            logs_all.append(onp.stack(logs))
+        return toks_all, logs_all
+
+    t_fp, l_fp = run()
+    forced = [t[:-1] for t in t_fp]
+    net.quantize_params()
+    t_w8, l_w8 = run(forced=forced)
+    t_kv, l_kv = run(kv_dtype="int8", forced=forced)
+    n = sum(int(t.size) for t in t_fp)
+    agree = sum(int((a == b).sum())
+                for a, b in zip(t_fp, t_w8)) / n
+    w8_err = max(float(onp.abs(a - b).max())
+                 for a, b in zip(l_fp, l_w8))
+    kv_err = max(float(onp.abs(a - b).max())
+                 for a, b in zip(l_w8, l_kv))
+    print(json.dumps({
+        "tokens_compared": n,
+        "greedy_agreement": round(agree, 4),
+        "w8_logit_maxerr": round(w8_err, 4),
+        "kv_logit_maxerr": round(kv_err, 4),
+        "logit_absmax": round(max(float(onp.abs(a).max())
+                                  for a in l_fp), 3),
+        "fp32_digest": hashlib.sha256(json.dumps(
+            [t.tolist() for t in t_fp]).encode()).hexdigest(),
+    }), flush=True)
+    return 0
+
+
+def _qnt_engine_run(quantized):
+    """One dense-engine config of the weight-bandwidth A/B: closed
+    loop (every request queued at once — the decode-batch economics
+    are the question, not arrival pacing), slot count from the shared
+    HBM budget."""
+    import numpy as onp
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+    p_bytes, kv_slot, int8_slots = _qnt_budget()
+    slots = int8_slots if quantized else QNT_SLOTS_FP32
+    eng = GenerationEngine(
+        _qnt_model(), max_slots=slots, max_length=QNT_SMAX,
+        queue_limit=QNT_REQS + 8,
+        quantize="int8_weights" if quantized else None).warmup()
+    reqs = _qnt_workload()
+    for s in [eng.submit(p, max_new_tokens=2) for p, _m in reqs[:2]]:
+        s.result(timeout=600)          # cold-start priming
+    telemetry.reset()
+    t0 = time.perf_counter()
+    streams = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    for s in streams:
+        s.result(timeout=600)
+    makespan = max(s.done_at for s in streams) - t0
+    snap = telemetry.snapshot()
+    eng.close()
+    tokens = int(snap["counters"].get("serving.generate.tokens", 0))
+    dec = snap["histograms"].get("serving.generate.decode", {})
+    weight_bytes = p_bytes // 4 if quantized else p_bytes
+    print(json.dumps({
+        "mode": "int8_weights" if quantized else "fp32",
+        "slots": slots,
+        "requests": QNT_REQS,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / makespan, 1),
+        "decode_steps": int(dec.get("count", 0)),
+        "decode_p50_ms": round(float(dec.get("p50", 0.0)), 2),
+        "weight_bytes": weight_bytes,
+        "kv_bytes": slots * kv_slot,
+        "hbm_budget_bytes": weight_bytes + slots * kv_slot,
+        "compiles_in_window":
+            int(snap["counters"].get("model.gpt.trace", 0))
+            + int(snap["counters"].get("gluon.cachedop.cache_miss", 0)),
+    }), flush=True)
+    return 0
+
+
+def _qnt_kv_model():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(0)
+    net = GPTModel(vocab_size=QNT_VOCAB, units=QNT_KV_UNITS,
+                   num_layers=QNT_KV_LAYERS, num_heads=QNT_KV_HEADS,
+                   max_length=QNT_KV_SMAX)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def _qnt_kv_workload():
+    """The BENCH_r13 workload shape (same seeds): most requests share
+    one long system prompt + a short unique tail."""
+    import numpy as onp
+    rng = onp.random.RandomState(52)
+    sys_prompt = rng.randint(0, QNT_VOCAB,
+                             QNT_KV_SYS_LEN).astype("i4")
+    reqs = []
+    for _ in range(QNT_KV_REQS):
+        tail = rng.randint(0, QNT_VOCAB,
+                           int(rng.randint(4, 17))).astype("i4")
+        if rng.rand() < PFX_SHARE:
+            prompt = onp.concatenate([sys_prompt, tail])
+        else:
+            prompt = rng.randint(0, QNT_VOCAB,
+                                 16 + tail.size).astype("i4")
+        reqs.append((prompt, int(rng.randint(6, 13))))
+    return reqs
+
+
+def _qnt_kv_page_bytes(int8):
+    """Per-page HBM bytes across one layer's K+V pools (+ the int8
+    per-head scales — counted against the saving)."""
+    dh = QNT_KV_UNITS // QNT_KV_HEADS
+    if int8:
+        return 2 * (QNT_KV_HEADS * QNT_KV_PS * dh + QNT_KV_HEADS * 4)
+    return 2 * QNT_KV_HEADS * QNT_KV_PS * dh * 4
+
+
+def _qnt_kv_run(int8):
+    """One paged-pool density config: same pool BYTES, fp32 vs int8
+    pages, shared-prefix workload; the headline is effective
+    sequences per pool (usable pages / avg private pages per
+    request — the BENCH_r13 metric)."""
+    import hashlib
+    import numpy as onp
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+    n_pages = QNT_KV_PAGES_F32 if not int8 else max(
+        2, QNT_KV_PAGES_F32 * _qnt_kv_page_bytes(False)
+        // _qnt_kv_page_bytes(True))
+    eng = GenerationEngine(
+        _qnt_kv_model(), max_slots=QNT_KV_SLOTS,
+        max_length=QNT_KV_SMAX, paged=True, page_size=QNT_KV_PS,
+        prefill_chunk=QNT_KV_CHUNK, n_pages=n_pages,
+        queue_limit=QNT_KV_REQS + 16, quantize="int8_weights",
+        kv_dtype="int8" if int8 else None).warmup()
+    reqs = _qnt_kv_workload()
+    rng = onp.random.RandomState(7)
+    for s in [eng.submit(rng.randint(0, QNT_VOCAB, 8).astype("i4"),
+                         max_new_tokens=2)
+              for _ in range(QNT_KV_SLOTS)]:
+        s.result(timeout=600)          # neutral priming (no prefix)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    streams = [eng.submit(p, max_new_tokens=m) for p, m in reqs]
+    results = [s.result(timeout=600) for s in streams]
+    makespan = max(s.done_at for s in streams) - t0
+    snap = telemetry.snapshot()
+    eng.close()
+    c = snap["counters"]
+    allocated = int(c.get("serving.generate.pages.allocated", 0))
+    avg_private = allocated / QNT_KV_REQS
+    print(json.dumps({
+        "mode": "int8_kv" if int8 else "fp32_kv",
+        "requests": QNT_KV_REQS,
+        "n_pages": n_pages,
+        "pool_bytes": n_pages * _qnt_kv_page_bytes(int8)
+        * QNT_KV_LAYERS,
+        "pages_allocated": allocated,
+        "pages_shared": int(c.get("serving.generate.pages.shared", 0)),
+        "prefix_hits":
+            int(c.get("serving.generate.prefix_hits", 0)),
+        "avg_private_pages_per_req": round(avg_private, 2),
+        "effective_slots_same_hbm":
+            round((n_pages - 1) / max(avg_private, 1e-9), 1),
+        "generated_tokens":
+            int(c.get("serving.generate.tokens", 0)),
+        "tokens_per_sec": round(
+            int(c.get("serving.generate.tokens", 0)) / makespan, 1),
+        "compiles_in_window":
+            int(c.get("model.gpt.trace", 0))
+            + int(c.get("gluon.cachedop.cache_miss", 0)),
+        "tokens_digest": hashlib.sha256(json.dumps(
+            [r.tokens for r in results]).encode()).hexdigest(),
+    }), flush=True)
+    return 0
+
+
+def _qnt_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_QUANT_CONFIG"]
+    if cfg == "parity":
+        return _qnt_parity()
+    if cfg in ("fp32", "w8"):
+        return _qnt_engine_run(cfg == "w8")
+    if cfg in ("kv_fp32", "kv_int8"):
+        return _qnt_kv_run(cfg == "kv_int8")
+    raise SystemExit(f"unknown BENCH_QUANT_CONFIG {cfg!r}")
+
+
+def _qnt_check_schema(doc):
+    """BENCH_r14.json contract (spec for the shared _check_schema)."""
+    eng_keys = ("tokens_per_sec", "slots", "hbm_budget_bytes",
+                "compiles_in_window", "decode_p50_ms")
+    kv_keys = ("effective_slots_same_hbm", "pool_bytes", "n_pages",
+               "pages_shared", "compiles_in_window")
+    return _check_schema(
+        "BENCH_r14", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "parity": dict, "fp32": dict, "w8": dict,
+            "kv_fp32": dict, "kv_int8": dict,
+            "throughput_ratio": float, "kv_effective_ratio": float,
+            "kv_multiplier_vs_r13": float,
+            "greedy_agreement": float,
+            "zero_compiles_in_window": bool,
+            "throughput_ge_1_3x": bool, "kv_effective_ge_1_8x": bool,
+            "agreement_ge_98pct": bool, "logit_bounds_hold": bool,
+        },
+        nested={"parity": ("greedy_agreement", "w8_logit_maxerr",
+                           "kv_logit_maxerr", "tokens_compared"),
+                "fp32": eng_keys, "w8": eng_keys,
+                "kv_fp32": kv_keys, "kv_int8": kv_keys},
+        gates=[("int8 pool bytes must not exceed the fp32 pool's",
+                lambda d: d["kv_int8"]["pool_bytes"]
+                <= d["kv_fp32"]["pool_bytes"]),
+               ("both engine configs must decode at batch <= 8",
+                lambda d: d["fp32"]["slots"] <= QNT_MAX_SLOTS
+                and d["w8"]["slots"] <= QNT_MAX_SLOTS),
+               ("the KV configs must observe prefix sharing",
+                lambda d: d["kv_fp32"]["pages_shared"] > 0
+                and d["kv_int8"]["pages_shared"] > 0)])
+
+
+def _quant_main():
+    if os.environ.get("BENCH_QUANT_CONFIG"):
+        return _qnt_child()
+    smoke = QUANT_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_QUANT_SMOKE": "1"} if smoke else {}
+
+    _stage("quant: parity (teacher-forced bounded divergence)")
+    parity = _ab_child("--quant", dict(env, BENCH_QUANT_CONFIG="parity"),
+                       label="quant parity")
+    if parity is None:
+        return 1
+
+    # interleaved best-of-N reps on the timed configs (the established
+    # A/B discipline: this box's cpu-shares swing between windows)
+    results = {}
+    for rep in range(QNT_REPS if not smoke else 1):
+        for cfg in ("fp32", "w8"):
+            _stage(f"quant: {cfg} (rep {rep + 1})")
+            r = _ab_child("--quant",
+                          dict(env, BENCH_QUANT_CONFIG=cfg),
+                          label=f"quant {cfg} rep{rep}")
+            if r is None:
+                return 1
+            best = results.get(cfg)
+            if best is None \
+                    or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                results[cfg] = r
+    for cfg in ("kv_fp32", "kv_int8"):
+        _stage(f"quant: {cfg}")
+        r = _ab_child("--quant", dict(env, BENCH_QUANT_CONFIG=cfg),
+                      label=f"quant {cfg}")
+        if r is None:
+            return 1
+        results[cfg] = r
+
+    fp32, w8 = results["fp32"], results["w8"]
+    kvf, kv8 = results["kv_fp32"], results["kv_int8"]
+    thr_ratio = round(w8["tokens_per_sec"]
+                      / max(fp32["tokens_per_sec"], 1e-9), 2)
+    eff_ratio = round(kv8["effective_slots_same_hbm"]
+                      / max(kvf["effective_slots_same_hbm"], 1e-9), 2)
+    agree = float(parity["greedy_agreement"])
+    bounds = bool(parity["w8_logit_maxerr"] <= QNT_W8_TOL
+                  and parity["kv_logit_maxerr"] <= QNT_KV_TOL)
+    zero_compiles = all(
+        results[c]["compiles_in_window"] == 0
+        for c in ("fp32", "w8", "kv_fp32", "kv_int8"))
+    doc = _qnt_check_schema({
+        "metric": "quant_int8_weights_decode_tokens_per_sec",
+        "value": float(w8["tokens_per_sec"]),
+        "unit": "generated tokens/sec at the same HBM budget",
+        "model": f"gpt {QNT_LAYERS}L-{QNT_UNITS}u-{QNT_HEADS}h "
+                 f"vocab={QNT_VOCAB} s_max={QNT_SMAX} tied-head; "
+                 f"kv phase gpt {QNT_KV_LAYERS}L-{QNT_KV_UNITS}u-"
+                 f"{QNT_KV_HEADS}h s_max={QNT_KV_SMAX}",
+        "smoke": bool(smoke),
+        "reps_best_of": QNT_REPS if not smoke else 1,
+        "quantization": "per-output-channel symmetric int8 weights "
+                        "(attention/MLP projections); int8 KV with "
+                        "per-head-per-slot (dense) / per-head-per-page "
+                        "(paged) scales",
+        "logit_tolerances": {"w8": QNT_W8_TOL, "kv": QNT_KV_TOL},
+        "parity": parity,
+        "fp32": fp32,
+        "w8": w8,
+        "kv_fp32": kvf,
+        "kv_int8": kv8,
+        "throughput_ratio": thr_ratio,
+        "kv_effective_ratio": eff_ratio,
+        "kv_multiplier_vs_r13": round(
+            kv8["effective_slots_same_hbm"] / QNT_R13_EFFECTIVE, 2)
+        if not smoke else 0.0,
+        "greedy_agreement": agree,
+        "zero_compiles_in_window": zero_compiles,
+        "throughput_ge_1_3x": bool(thr_ratio >= QNT_THR_MIN),
+        "kv_effective_ge_1_8x": bool(eff_ratio >= QNT_KV_EFF_MIN),
+        "agreement_ge_98pct": bool(agree >= QNT_AGREE_MIN),
+        "logit_bounds_hold": bounds,
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_QUANT_OUT",
+                                           "BENCH_r14.json"))
+    if not smoke or "BENCH_QUANT_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("throughput_ge_1_3x", doc["throughput_ge_1_3x"]),
+        ("kv_effective_ge_1_8x", doc["kv_effective_ge_1_8x"]),
+        # the ISSUE's multiplier over BENCH_r13's committed ~40 (full
+        # runs replicate r13's model/workload shape; smoke can't)
+        ("kv_multiplier_vs_r13_ge_1_8x",
+         smoke or doc["kv_multiplier_vs_r13"] >= QNT_KV_EFF_MIN),
+        ("agreement_ge_98pct", doc["agreement_ge_98pct"]),
+        ("logit_bounds_hold", doc["logit_bounds_hold"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] quant gates failed: {', '.join(failed)} "
+              f"(throughput_ratio={thr_ratio} "
+              f"kv_effective_ratio={eff_ratio} agreement={agree} "
+              f"w8_err={parity['w8_logit_maxerr']} "
+              f"kv_err={parity['kv_logit_maxerr']})",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--quant" in sys.argv:
+        return _quant_main()
     if "--prefix" in sys.argv:
         return _prefix_main()
     if "--resilience" in sys.argv:

@@ -27,15 +27,18 @@ import fnmatch
 import logging
 
 import numpy as onp
+import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .. import telemetry
 from ..ndarray.ndarray import NDArray
 from ..ops import apply_op
 from ..gluon.block import HybridBlock
+from ..gluon.parameter import Constant
 from ..gluon import nn as _nn
 
-__all__ = ["CalibrationCollector", "quantize_net",
+__all__ = ["CalibrationCollector", "quantize_net", "iter_quantized",
            "QuantizedDense", "QuantizedConv"]
 
 _INT8_MAX = 127.0
@@ -202,34 +205,104 @@ def _quantize_act(x, scale):
 
 
 def _dynamic_scale(x):
-    return jnp.maximum(jnp.abs(x).max(), 1e-12) / _INT8_MAX
+    """In-graph activation scale for ``calib_mode='none'``. The
+    epsilon floor guards the all-zero activation batch: an unguarded
+    ``absmax / 127`` scale of exactly 0 would turn ``_quantize_act``'s
+    ``x / scale`` into 0/0 NaNs that ``clip`` happily keeps —
+    quantizing zeros must yield zeros. Eager (non-hybridized) calls
+    record a ``quantization.dynamic_scale`` duration; inside a trace
+    the computation is staged, so there is nothing meaningful to
+    time."""
+    if x.size == 0:
+        raise ValueError("cannot derive an int8 scale from an empty "
+                         "activation")
+    tracing = isinstance(x, jax.core.Tracer)
+    t0 = None if tracing else telemetry.clock()
+    scale = jnp.maximum(jnp.abs(x).max(), 1e-12) / _INT8_MAX
+    if t0 is not None:
+        telemetry.hist_since("quantization.dynamic_scale", t0)
+    return scale
 
 
 class QuantizedDense(HybridBlock):
     """int8 twin of nn.Dense (parity: quantized_fully_connected,
-    src/operator/quantization/quantized_fully_connected.cc)."""
+    src/operator/quantization/quantized_fully_connected.cc).
+
+    The int8 weights, per-channel scales and bias are registered
+    ``Constant`` parameters — NOT trace-baked closures — so a
+    hybridized twin's CachedOp passes them as runtime arguments and a
+    serving weight rollover (``requantize``) installs fresh buffers
+    with ZERO retraces, exactly like the fp32 engines' swap."""
 
     def __init__(self, dense, in_range=None,
                  granularity="channel-wise"):
         super().__init__()
         self._units = dense._units
         self._flatten = dense._flatten
+        self._granularity = granularity
+        #: dotted source-layer name (set by quantize_net) — the key
+        #: prefix a rollover checkpoint's fp32 weights carry, so
+        #: InferenceEngine.load_weights can re-quantize in place
+        self._src_name = None
         self.act = dense.act
         w = dense.weight.data().asnumpy()          # (units, in)
         wq, w_scale = _quantize_weight(w, 0, granularity)
-        # device-resident once; eager forwards must not re-upload
-        self._wq = jnp.asarray(wq)
-        self._w_scale = jnp.asarray(w_scale.reshape(-1))
-        self._bias = (jnp.asarray(dense.bias.data().asnumpy())
+        self.wq = Constant(wq, name="wq")
+        self.w_scale = Constant(w_scale.reshape(-1).astype(onp.float32),
+                                name="w_scale")
+        self.qbias = (Constant(dense.bias.data().asnumpy(),
+                               name="qbias")
                       if dense.bias is not None else None)
+        for p in (self.wq, self.w_scale, self.qbias):
+            if p is not None:
+                p.initialize()
         # static input scale from calibration, or None -> in-graph
         self._in_scale = (max(abs(in_range[0]), abs(in_range[1]))
                           / _INT8_MAX if in_range is not None else None)
 
+    def _install(self, const, host):
+        """Swap a Constant's device buffer in place (placement
+        preserved) — the trace sees the same runtime argument slot,
+        so nothing recompiles."""
+        nd = const.data()
+        nd._data = jax.device_put(jnp.asarray(host), nd._data.sharding)
+        const.value = host
+
+    def requantize(self, weight, bias=None):
+        """Recompute the int8 weights/scales from fresh fp32 arrays
+        (the serving weight-rollover path). Shapes must match the
+        original layer's; validation precedes any mutation so a bad
+        checkpoint can never leave the twin half-swapped. The
+        calibrated input scale is kept — re-calibration is the
+        caller's decision, not a side effect of a rollover."""
+        w = onp.asarray(weight, dtype=onp.float32)
+        if w.shape != tuple(self.wq.shape):
+            raise ValueError(
+                f"requantize weight shape {w.shape} does not match "
+                f"the quantized layer's {tuple(self.wq.shape)}")
+        if (bias is None) != (self.qbias is None):
+            raise ValueError(
+                "requantize bias presence must match the quantized "
+                "layer's")
+        if bias is not None:
+            b = onp.asarray(bias, dtype=onp.float32)
+            if b.shape != tuple(self.qbias.shape):
+                raise ValueError(
+                    f"requantize bias shape {b.shape} does not match "
+                    f"{tuple(self.qbias.shape)}")
+        wq, w_scale = _quantize_weight(w, 0, self._granularity)
+        self._install(self.wq, wq)
+        self._install(self.w_scale,
+                      w_scale.reshape(-1).astype(onp.float32))
+        if bias is not None:
+            self._install(self.qbias, b)
+        return self
+
     def forward(self, x):
-        wq = self._wq
-        w_scale = self._w_scale
-        bias = self._bias
+        wq = self.wq.data()._data
+        w_scale = self.w_scale.data()._data
+        bias = self.qbias.data()._data if self.qbias is not None \
+            else None
         s_in = self._in_scale
 
         def fn(xr):
@@ -262,6 +335,8 @@ class QuantizedConv(HybridBlock):
         super().__init__()
         assert conv._op_name == "convolution", \
             "only forward convolutions can be quantized"
+        self._granularity = granularity
+        self._src_name = None   # see QuantizedDense._src_name
         self._kernel = conv._kernel
         self._stride = conv._stride
         self._pad = conv._pad
@@ -273,18 +348,23 @@ class QuantizedConv(HybridBlock):
         w = conv.weight.data().asnumpy()
         ch_axis = 0  # weight layout puts out-channels first in both
         wq, w_scale = _quantize_weight(w, ch_axis, granularity)
-        self._wq = jnp.asarray(wq)
-        self._w_scale = jnp.asarray(w_scale.reshape(-1))
-        self._bias = (jnp.asarray(conv.bias.data().asnumpy())
+        self.wq = Constant(wq, name="wq")
+        self.w_scale = Constant(w_scale.reshape(-1).astype(onp.float32),
+                                name="w_scale")
+        self.qbias = (Constant(conv.bias.data().asnumpy(), name="qbias")
                       if conv.bias is not None else None)
+        for p in (self.wq, self.w_scale, self.qbias):
+            if p is not None:
+                p.initialize()
         self._in_scale = (max(abs(in_range[0]), abs(in_range[1]))
                           / _INT8_MAX if in_range is not None else None)
 
     def forward(self, x):
         from ..ops import nn as _opsnn
-        wq = self._wq
-        w_scale = self._w_scale
-        bias = self._bias
+        wq = self.wq.data()._data
+        w_scale = self.w_scale.data()._data
+        bias = self.qbias.data()._data if self.qbias is not None \
+            else None
         s_in = self._in_scale
         nsp = len(self._kernel)
         stride = self._stride if isinstance(self._stride, tuple) \
@@ -324,6 +404,11 @@ class QuantizedConv(HybridBlock):
             out = self.act(out)
         return out
 
+    # identical contracts (weight layout puts out-channels first in
+    # both Dense and Conv, so axis-0 requantization carries over)
+    _install = QuantizedDense._install
+    requantize = QuantizedDense.requantize
+
     def __repr__(self):
         return (f"QuantizedConv(int8, channels={self._channels}, "
                 f"kernel={self._kernel})")
@@ -350,6 +435,19 @@ def _attr_name_for_child(parent, child):
         if val is child:
             return attr
     return None
+
+
+def iter_quantized(block, prefix=""):
+    """Yield ``(dotted_name, twin)`` for every QuantizedDense /
+    QuantizedConv in ``block`` (depth-first, collect_params-style
+    names) — how the serving engines detect an int8 net and find the
+    twins a rollover must re-quantize."""
+    for key, child in block._children.items():
+        name = f"{prefix}{key}"
+        if isinstance(child, (QuantizedDense, QuantizedConv)):
+            yield name, child
+        else:
+            yield from iter_quantized(child, name + ".")
 
 
 def quantize_net(network, quantized_dtype="auto", quantize_mode="full",
@@ -487,6 +585,7 @@ def quantize_net(network, quantized_dtype="auto", quantize_mode="full",
         else:
             q = QuantizedConv(child, in_range=rng,
                               granularity=quantize_granularity)
+        q._src_name = name
         parent._children[key] = q
         attr = _attr_name_for_child(parent, child)
         if attr is not None:

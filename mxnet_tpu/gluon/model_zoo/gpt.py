@@ -59,6 +59,7 @@ from jax import lax
 from ... import autograd, telemetry
 from ...ndarray.ndarray import NDArray
 from ...ops import attention as _att
+from ...ops import quantized as _qz
 from ...random_state import next_key, trace_rng
 from .. import _deferred
 from ..block import HybridBlock
@@ -66,6 +67,19 @@ from ..parameter import Parameter
 from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
 
 __all__ = ["GPTBlock", "GPTModel", "gpt_small"]
+
+#: the weight-only int8 target set: every per-block projection on the
+#: decode hot path. Embeddings, LayerNorms and the lm_head stay fp32 —
+#: they are small next to the projections and the head feeds the
+#: greedy argmax directly.
+_QUANTIZED_PROJECTIONS = ("q_proj", "k_proj", "v_proj", "out_proj",
+                          "ffn1", "ffn2")
+
+# the ONE int8 convention (amax/127, eps floor, round-then-clip)
+# lives in ops/quantized.py — KV quantization must never drift from
+# the weight quantization the parity bounds are built on
+_kv_scale = _qz.kv_scale
+_kv_quantize = _qz.kv_quantize
 
 
 def _cache_insert(cache, new, pos):
@@ -114,6 +128,12 @@ class GPTBlock(HybridBlock):
                           flatten=False, dtype=dtype)
         self.ffn2 = Dense(units, flatten=False, dtype=dtype)
         self.drop = Dropout(dropout) if dropout else None
+        #: per-call quant binding installed by ``GPTModel._make_bind``
+        #: while a quantized generation closure runs: ``{proj_name:
+        #: (int8 weight, fp32 per-channel scales)}`` of TRACED buffers.
+        #: None (the steady state outside generation and for fp32
+        #: engines) keeps every projection on the fp32 Dense path.
+        self._qbind = None
 
     def _split(self, x):
         b, s, _ = x.shape
@@ -124,17 +144,38 @@ class GPTBlock(HybridBlock):
         b, h, s, d = out.shape
         return out.transpose(0, 2, 1, 3).reshape(b, s, h * d)
 
+    def _proj(self, name, x):
+        """One projection: the fp32 Dense, or — when the bound quant
+        table carries ``name`` — the fused dequant-matmul over its
+        int8 weights (ops/quantized.py: the fp32 weight never
+        materializes outside VMEM/cache). Bias and activation follow
+        the Dense's own, so the two paths differ ONLY in the weight
+        rounding."""
+        layer = getattr(self, name)
+        q = self._qbind.get(name) if self._qbind else None
+        if q is None:
+            return layer(x)
+        wq, w_scale = q
+        y = _qz.dequant_matmul(x._data, wq, w_scale)
+        if layer.bias is not None:
+            y = y + layer.bias.data()._data
+        out = NDArray(y, ctx=x.ctx)
+        if layer.act is not None:
+            out = layer.act(out)
+        return out
+
     def _qkv(self, x):
         h = self.ln1(x)
-        return (self._split(self.q_proj(h)), self._split(self.k_proj(h)),
-                self._split(self.v_proj(h)))
+        return (self._split(self._proj("q_proj", h)),
+                self._split(self._proj("k_proj", h)),
+                self._split(self._proj("v_proj", h)))
 
     def _finish(self, x, attn):
-        y = self.out_proj(self._merge(attn))
+        y = self._proj("out_proj", self._merge(attn))
         if self.drop is not None:
             y = self.drop(y)
         x = x + y
-        y = self.ffn2(self.ffn1(self.ln2(x)))
+        y = self._proj("ffn2", self._proj("ffn1", self.ln2(x)))
         if self.drop is not None:
             y = self.drop(y)
         return x + y
@@ -154,11 +195,29 @@ class GPTBlock(HybridBlock):
                                             True, None), ctx=x.ctx)
         return self._finish(x, attn), (k._data, v._data)
 
-    def decode(self, x, k_cache, v_cache, pos, att_len):
+    def decode(self, x, k_cache, v_cache, pos, att_len, k_scale=None,
+               v_scale=None):
         """One decode step: insert this token's K/V at ``pos``, attend
         over the valid prefix ``[0, att_len)``. ``k_cache``/``v_cache``
-        are raw (B, H, S_max, Dh) buffers; returns updated buffers."""
+        are raw (B, H, S_max, Dh) buffers; returns updated buffers.
+        ``k_scale``/``v_scale`` (B, H) mark an INT8 cache: the new
+        token quantizes against its slot's per-head scale (fixed at
+        prefill — K/V statistics are stationary across positions, and
+        one slot row must share one scale) and attention dequantizes
+        in the kernel."""
         q, k, v = self._qkv(x)
+        if k_scale is not None:
+            kc = _cache_insert(
+                k_cache, _kv_quantize(k._data, k_scale[:, :, None, None]),
+                pos)
+            vc = _cache_insert(
+                v_cache, _kv_quantize(v._data, v_scale[:, :, None, None]),
+                pos)
+            attn = NDArray(
+                _att.decode_attention(q._data, kc, vc, att_len,
+                                      k_scale=k_scale, v_scale=v_scale),
+                ctx=x.ctx)
+            return self._finish(x, attn), kc, vc
         kc = _cache_insert(k_cache, k._data, pos)
         vc = _cache_insert(v_cache, v._data, pos)
         attn = NDArray(_att.decode_attention(q._data, kc, vc, att_len),
@@ -167,15 +226,40 @@ class GPTBlock(HybridBlock):
 
     # -- paged-cache generation (serving/generate.py paged mode) --------
     def decode_paged(self, x, k_pool, v_pool, table, page, offset,
-                     att_len):
+                     att_len, k_scale=None, v_scale=None,
+                     prev_page=None):
         """One decode step against a PAGED cache: write this token's
         K/V into pool page ``page[b]`` at slot ``offset[b]`` per row,
         attend over each row's valid pages via the table. Inactive
         rows must arrive with ``page == 0`` (the reserved scrap page):
         a free slot's table row may alias pages now owned by another
         slot, so its write is redirected, never masked after the
-        fact."""
+        fact.
+
+        ``k_scale``/``v_scale`` (n_pages, H) mark an INT8 pool. The
+        write page's per-head scale quantizes the new token; a FRESH
+        page (``offset == 0``) inherits ``prev_page``'s scale — the
+        page's eventual tokens must share one scale, K/V statistics
+        are stationary across positions, and the recycled pool page's
+        stale scale must never leak in. Scale writes ride the same
+        scrap-page redirection as the data. Returns the updated scale
+        pools alongside the K/V pools."""
         q, k, v = self._qkv(x)
+        if k_scale is not None:
+            fresh = (offset == 0)[:, None]
+            ks_eff = jnp.where(fresh, k_scale[prev_page], k_scale[page])
+            vs_eff = jnp.where(fresh, v_scale[prev_page], v_scale[page])
+            ksp = k_scale.at[page].set(ks_eff)
+            vsp = v_scale.at[page].set(vs_eff)
+            kp = k_pool.at[page, :, offset, :].set(
+                _kv_quantize(k._data[:, :, 0, :], ks_eff[:, :, None]))
+            vp = v_pool.at[page, :, offset, :].set(
+                _kv_quantize(v._data[:, :, 0, :], vs_eff[:, :, None]))
+            attn = NDArray(
+                _att.paged_decode_attention(q._data, kp, vp, table,
+                                            att_len, k_scale=ksp,
+                                            v_scale=vsp), ctx=x.ctx)
+            return self._finish(x, attn), kp, vp, ksp, vsp
         dt = k_pool.dtype
         kp = k_pool.at[page, :, offset, :].set(
             k._data[:, :, 0, :].astype(dt))
@@ -184,17 +268,40 @@ class GPTBlock(HybridBlock):
         attn = NDArray(_att.paged_decode_attention(q._data, kp, vp,
                                                    table, att_len),
                        ctx=x.ctx)
-        return self._finish(x, attn), kp, vp
+        return self._finish(x, attn), kp, vp, None, None
 
-    def prefill_chunk(self, x, k_pool, v_pool, pages, page_ids, start):
+    def prefill_chunk(self, x, k_pool, v_pool, pages, page_ids, start,
+                      k_scale=None, v_scale=None):
         """One prefill CHUNK against a paged cache: scatter the chunk's
         K/V into its pool pages (``page_ids``), then attend the chunk's
         queries over the slot's full gathered view (earlier chunks +
         shared prefix pages + this chunk) with the causal mask in
         global coordinates (``start`` is traced — every chunk of every
-        prompt runs one compiled program per chunk width)."""
+        prompt runs one compiled program per chunk width).
+        ``k_scale``/``v_scale`` (n_pages, H) mark an INT8 pool: each
+        written page gets its own per-head amax scale, and the
+        gathered view dequantizes every page — shared-prefix pages
+        included — with the scale that page was written under."""
         q, k, v = self._qkv(x)
         ps = k_pool.shape[2]
+        if k_scale is not None:
+            kpg = _to_pages(k._data, ps, jnp.float32)
+            vpg = _to_pages(v._data, ps, jnp.float32)
+            ks_new = _kv_scale(kpg, (2, 3))          # (C/ps, H)
+            vs_new = _kv_scale(vpg, (2, 3))
+            kp = k_pool.at[page_ids].set(
+                _kv_quantize(kpg, ks_new[:, :, None, None]))
+            vp = v_pool.at[page_ids].set(
+                _kv_quantize(vpg, vs_new[:, :, None, None]))
+            ksp = k_scale.at[page_ids].set(ks_new)
+            vsp = v_scale.at[page_ids].set(vs_new)
+            kg = _att.gather_pages(kp, pages[None]).astype(jnp.float32) \
+                * _att.expand_page_scales(ksp, pages[None], ps)[..., None]
+            vg = _att.gather_pages(vp, pages[None]).astype(jnp.float32) \
+                * _att.expand_page_scales(vsp, pages[None], ps)[..., None]
+            attn = NDArray(_att.chunked_prefill_attention(
+                q._data, kg, vg, start), ctx=x.ctx)
+            return self._finish(x, attn), kp, vp, ksp, vsp
         dt = k_pool.dtype
         kp = k_pool.at[page_ids].set(_to_pages(k._data, ps, dt))
         vp = v_pool.at[page_ids].set(_to_pages(v._data, ps, dt))
@@ -203,9 +310,10 @@ class GPTBlock(HybridBlock):
         attn = NDArray(_att.chunked_prefill_attention(
             q._data, kg.astype(q._data.dtype),
             vg.astype(q._data.dtype), start), ctx=x.ctx)
-        return self._finish(x, attn), kp, vp
+        return self._finish(x, attn), kp, vp, None, None
 
-    def peek_paged(self, x, k_pool, v_pool, table, att_len):
+    def peek_paged(self, x, k_pool, v_pool, table, att_len,
+                   k_scale=None, v_scale=None):
         """Logits-only attention for the LAST already-cached token of
         one slot (its K/V — including its own — is in the pool): no
         write, cache untouched. The prefix-reuse fast path: a request
@@ -214,7 +322,9 @@ class GPTBlock(HybridBlock):
         q, _k, _v = self._qkv(x)
         attn = NDArray(_att.paged_decode_attention(q._data, k_pool,
                                                    v_pool, table,
-                                                   att_len),
+                                                   att_len,
+                                                   k_scale=k_scale,
+                                                   v_scale=v_scale),
                        ctx=x.ctx)
         return self._finish(x, attn)
 
@@ -251,10 +361,22 @@ class GPTModel(HybridBlock):
                              dtype=dtype)
         self._gen = None  # (param_nds, prefill_jit, decode_jit)
         self._paged = None  # paged-cache closures (_ensure_paged)
+        #: weight-only int8 tables (``quantize_params``): one dict per
+        #: block, ``{proj_name: (int8 weight, fp32 scales)}`` of
+        #: device arrays, passed to the jitted closures as RUNTIME
+        #: arguments (so a rollover re-quantize installs new values
+        #: without retracing — the dense-engine swap discipline).
+        self._quant = None
 
     @property
     def max_length(self):
         return self._max_length
+
+    @property
+    def quantized(self) -> bool:
+        """True once ``quantize_params`` armed the weight-only int8
+        decode path."""
+        return self._quant is not None
 
     def _blocks(self):
         return list(self.layers._children.values())
@@ -281,13 +403,71 @@ class GPTModel(HybridBlock):
         super()._clear_cached_op()
         self._gen = None  # params rebound/cast: jitted closures stale
         self._paged = None
+        # NOTE: self._quant survives — it is derived state an explicit
+        # quantize_params() refresh owns (the serving engine re-calls
+        # it under the swap lock on every weight rollover)
+
+    def quantize_params(self, include=_QUANTIZED_PROJECTIONS):
+        """Arm (or refresh) weight-only int8 decode: quantize every
+        ``include`` projection of every block per-output-channel
+        symmetric int8 (ops/quantized.py) and route the generation
+        closures' projections through the fused dequant-matmul.
+
+        The quantized tables are RUNTIME arguments of the jitted
+        closures, so calling this again after a weight swap
+        (``GenerationEngine.load_weights``) installs freshly-quantized
+        values with ZERO retraces; the first call (or a change of
+        ``include``) invalidates the closures — quantize before
+        ``warmup()``. Embeddings, LayerNorms and the lm_head stay
+        fp32. Training/plain ``forward`` is untouched — the fp32
+        parameters remain the source of truth."""
+        self._gen_params()   # materialize deferred parameters first
+        tabs = []
+        for blk in self._blocks():
+            tab = {}
+            for name in include:
+                layer = getattr(blk, name, None)
+                if not isinstance(layer, Dense):
+                    raise ValueError(
+                        f"unknown quantizable projection {name!r} "
+                        f"(choose from {_QUANTIZED_PROJECTIONS})")
+                wq, scale = _qz.quantize_channelwise(
+                    layer.weight.data()._data)
+                tab[name] = (wq, scale)
+            tabs.append(tab)
+        fresh = (self._quant is None
+                 or [sorted(t) for t in self._quant]
+                 != [sorted(t) for t in tabs])
+        self._quant = tabs
+        if fresh:   # pytree structure changed: closures must retrace
+            self._gen = None
+            self._paged = None
+        return self
+
+    def quantized_param_stats(self):
+        """``(n_elements, bytes_saved)`` of the current quant tables
+        (fp32 -> int8 is 3 bytes per element; the per-channel scales
+        are counted against the saving)."""
+        if self._quant is None:
+            return 0, 0
+        n = sum(int(wq.size) for tab in self._quant
+                for wq, _s in tab.values())
+        scale_bytes = sum(int(s.size) * 4 for tab in self._quant
+                          for _wq, s in tab.values())
+        return n, n * 3 - scale_bytes
 
     def init_cache(self, batch_size, max_length=None, dtype=None):
         """Preallocated fixed-shape KV cache pytree for ``batch_size``
         slots: ``{"k": tuple of L (B, H, S_max, Dh) arrays, "v": same,
         "len": (B,) int32 valid lengths}``. Explicit argument/result of
         ``prefill``/``decode_step`` (which DONATE it) — never mutated
-        in place from Python."""
+        in place from Python.
+
+        ``dtype="int8"`` allocates a QUANTIZED cache (a quarter the
+        K/V bytes of fp32): the pytree grows ``k_scale``/``v_scale``
+        tuples of (B, H) fp32 per-head-per-slot scales, set at prefill
+        from each prompt's amax and reused by every decode write into
+        that slot."""
         s = int(max_length) if max_length is not None else self._max_length
         if not 1 <= s <= self._max_length:
             raise ValueError(
@@ -297,8 +477,15 @@ class GPTModel(HybridBlock):
         dt = onp.dtype(dtype or self._dtype)
         zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
                               for _ in range(self._num_layers))
-        return {"k": zeros(), "v": zeros(),
-                "len": jnp.zeros((int(batch_size),), jnp.int32)}
+        cache = {"k": zeros(), "v": zeros(),
+                 "len": jnp.zeros((int(batch_size),), jnp.int32)}
+        if dt == onp.int8:
+            sc = lambda: tuple(  # noqa: E731
+                jnp.zeros((int(batch_size), self._num_heads),
+                          jnp.float32) for _ in range(self._num_layers))
+            cache["k_scale"] = sc()
+            cache["v_scale"] = sc()
+        return cache
 
     def _gen_params(self):
         params = list(self.collect_params().values())
@@ -310,33 +497,49 @@ class GPTModel(HybridBlock):
         return [p.data() for p in params]
 
     @staticmethod
-    def _make_bind(param_nds):
+    def _make_bind(param_nds, blocks):
         """Closure factory: run ``fn`` with the parameter NDArrays
-        rebound to the traced buffers (gluon/block.py raw_fn idiom).
+        rebound to the traced buffers (gluon/block.py raw_fn idiom)
+        and — for a quantized model — each block's ``_qbind`` table
+        rebound to the traced int8 weights/scales, so ``_proj``
+        dispatches to the fused dequant-matmul inside the trace.
         Shared by the dense and paged generation closures."""
         def _bind(fn):
-            def wrapper(key, param_datas, *args):
+            def wrapper(key, param_datas, quant_tabs, *args):
                 telemetry.counter("model.gpt.trace")
                 saved = [nd._data for nd in param_nds]
+                saved_q = [blk._qbind for blk in blocks]
                 scope = _deferred.trace_scope()
                 rec = autograd._RecordingScope(False, False)
                 with scope, rec, trace_rng(key):
                     for nd, d in zip(param_nds, param_datas):
                         nd._data = d
+                    for blk, tab in zip(
+                            blocks, quant_tabs or [None] * len(blocks)):
+                        blk._qbind = tab
                     try:
                         return fn(*args)
                     finally:
                         for nd, s in zip(param_nds, saved):
                             nd._data = s
+                        for blk, s in zip(blocks, saved_q):
+                            blk._qbind = s
             return wrapper
         return _bind
+
+    def _quant_arg(self):
+        """The quant-table runtime argument every closure call carries:
+        the live tables, or an empty pytree for fp32 models (a STABLE
+        structure either way — flipping it retraces, which is why
+        ``quantize_params`` invalidates the closures on first arm)."""
+        return self._quant if self._quant is not None else []
 
     def _ensure_gen(self):
         if self._gen is not None:
             return self._gen
         param_nds = self._gen_params()
         blocks = self._blocks()
-        _bind = self._make_bind(param_nds)
+        _bind = self._make_bind(param_nds, blocks)
 
         def prefill_raw(tokens, valid_len, slots, cache):
             b, sb = tokens.shape
@@ -351,17 +554,42 @@ class GPTModel(HybridBlock):
             last = x._data[jnp.arange(b), idx][:, None, :]   # (b, 1, U)
             logits = self.lm_head(self.ln_f(NDArray(last)))
             dt = cache["k"][0].dtype
-            new_cache = {
-                "k": tuple(c.at[slots, :, :sb, :].set(k.astype(dt))
-                           for c, k in zip(cache["k"], ks)),
-                "v": tuple(c.at[slots, :, :sb, :].set(v.astype(dt))
-                           for c, v in zip(cache["v"], vs)),
-                "len": cache["len"].at[slots].set(valid_len),
-            }
+            if dt == jnp.int8:
+                # int8 cache: per-head-per-slot scales from the
+                # prompt's amax (the bucket's pad rows contribute —
+                # harmless overestimate); decode reuses them
+                ksc = [_kv_scale(k, (2, 3)) for k in ks]     # (b, H)
+                vsc = [_kv_scale(v, (2, 3)) for v in vs]
+                new_cache = {
+                    "k": tuple(
+                        c.at[slots, :, :sb, :].set(
+                            _kv_quantize(k, s[:, :, None, None]))
+                        for c, k, s in zip(cache["k"], ks, ksc)),
+                    "v": tuple(
+                        c.at[slots, :, :sb, :].set(
+                            _kv_quantize(v, s[:, :, None, None]))
+                        for c, v, s in zip(cache["v"], vs, vsc)),
+                    "k_scale": tuple(
+                        c.at[slots].set(s)
+                        for c, s in zip(cache["k_scale"], ksc)),
+                    "v_scale": tuple(
+                        c.at[slots].set(s)
+                        for c, s in zip(cache["v_scale"], vsc)),
+                    "len": cache["len"].at[slots].set(valid_len),
+                }
+            else:
+                new_cache = {
+                    "k": tuple(c.at[slots, :, :sb, :].set(k.astype(dt))
+                               for c, k in zip(cache["k"], ks)),
+                    "v": tuple(c.at[slots, :, :sb, :].set(v.astype(dt))
+                               for c, v in zip(cache["v"], vs)),
+                    "len": cache["len"].at[slots].set(valid_len),
+                }
             return logits._data[:, 0, :], new_cache
 
         def decode_raw(tokens, cache):
             s_max = cache["k"][0].shape[2]
+            quant_kv = cache["k"][0].dtype == jnp.int8
             ln = cache["len"]
             pos = jnp.minimum(ln, s_max - 1)   # clamped write position
             att_len = pos + 1                  # incl. the new token
@@ -372,18 +600,23 @@ class GPTModel(HybridBlock):
                 x = self.embed_drop(x)
             ks, vs = [], []
             for li, blk in enumerate(blocks):
-                x, kc, vc = blk.decode(x, cache["k"][li], cache["v"][li],
-                                       pos, att_len)
+                x, kc, vc = blk.decode(
+                    x, cache["k"][li], cache["v"][li], pos, att_len,
+                    k_scale=cache["k_scale"][li] if quant_kv else None,
+                    v_scale=cache["v_scale"][li] if quant_kv else None)
                 ks.append(kc)
                 vs.append(vc)
             logits = self.lm_head(self.ln_f(x))             # (B, 1, V)
             new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln + 1}
+            if quant_kv:   # per-slot scales are fixed at prefill
+                new_cache["k_scale"] = cache["k_scale"]
+                new_cache["v_scale"] = cache["v_scale"]
             return logits._data[:, 0, :], new_cache
 
         self._gen = (
             param_nds,
-            jax.jit(_bind(prefill_raw), donate_argnums=(5,)),
-            jax.jit(_bind(decode_raw), donate_argnums=(3,)),
+            jax.jit(_bind(prefill_raw), donate_argnums=(6,)),
+            jax.jit(_bind(decode_raw), donate_argnums=(4,)),
         )
         return self._gen
 
@@ -411,7 +644,8 @@ class GPTModel(HybridBlock):
         else:
             slots = _as_i32(slots)
         return prefill_jit(next_key(), [nd._data for nd in param_nds],
-                           tokens, valid_length, slots, cache)
+                           self._quant_arg(), tokens, valid_length,
+                           slots, cache)
 
     def decode_step(self, tokens, cache):
         """One greedy-decoding step for EVERY cache slot: insert the
@@ -423,7 +657,7 @@ class GPTModel(HybridBlock):
         is that the program shape never changes with occupancy."""
         param_nds, _, decode_jit = self._ensure_gen()
         return decode_jit(next_key(), [nd._data for nd in param_nds],
-                          _as_i32(tokens), cache)
+                          self._quant_arg(), _as_i32(tokens), cache)
 
     # -- paged-cache generation API -------------------------------------
     def init_paged_cache(self, batch_size, n_pages, page_size,
@@ -454,23 +688,35 @@ class GPTModel(HybridBlock):
         dt = onp.dtype(dtype or self._dtype)
         zeros = lambda: tuple(jnp.zeros(shape, dt)  # noqa: E731
                               for _ in range(self._num_layers))
-        return {"k": zeros(), "v": zeros(),
-                "table": jnp.zeros((int(batch_size), s // ps),
-                                   jnp.int32),
-                "len": jnp.zeros((int(batch_size),), jnp.int32)}
+        cache = {"k": zeros(), "v": zeros(),
+                 "table": jnp.zeros((int(batch_size), s // ps),
+                                    jnp.int32),
+                 "len": jnp.zeros((int(batch_size),), jnp.int32)}
+        if dt == onp.int8:
+            # per-head-per-PAGE scales: a shared prefix page carries
+            # its own scale wherever its refcount travels, and COW
+            # copies it with the data
+            sc = lambda: tuple(  # noqa: E731
+                jnp.zeros((int(n_pages), self._num_heads), jnp.float32)
+                for _ in range(self._num_layers))
+            cache["k_scale"] = sc()
+            cache["v_scale"] = sc()
+        return cache
 
     def _ensure_paged(self):
         if self._paged is not None:
             return self._paged
         param_nds = self._gen_params()
         blocks = self._blocks()
-        _bind = self._make_bind(param_nds)
+        _bind = self._make_bind(param_nds, blocks)
 
         def fresh_raw(tokens, n_valid, slot, pages, cache):
             """Whole-prompt prefill of one slot at bucket width W: the
             computation is EXACTLY the dense prefill's (same causal
             flash over the prompt block — bitwise-equal K/V and
-            logits); only the cache write is page-shaped."""
+            logits); only the cache write is page-shaped (and, for an
+            int8 pool, quantized per page with per-head amax
+            scales)."""
             _b, w = tokens.shape
             ps = cache["k"][0].shape[2]
             x = self._embed(NDArray(tokens))
@@ -484,14 +730,38 @@ class GPTModel(HybridBlock):
             logits = self.lm_head(self.ln_f(NDArray(last)))
             dt = cache["k"][0].dtype
             page_ids = pages[:w // ps]          # start == 0: static
-            new_cache = {
-                "k": tuple(p.at[page_ids].set(_to_pages(k, ps, dt))
-                           for p, k in zip(cache["k"], ks)),
-                "v": tuple(p.at[page_ids].set(_to_pages(v, ps, dt))
-                           for p, v in zip(cache["v"], vs)),
-                "table": cache["table"].at[slot].set(pages),
-                "len": cache["len"].at[slot].set(n_valid),
-            }
+            if dt == jnp.int8:
+                kpgs = [_to_pages(k, ps, jnp.float32) for k in ks]
+                vpgs = [_to_pages(v, ps, jnp.float32) for v in vs]
+                kscs = [_kv_scale(p, (2, 3)) for p in kpgs]
+                vscs = [_kv_scale(p, (2, 3)) for p in vpgs]
+                new_cache = {
+                    "k": tuple(
+                        p.at[page_ids].set(
+                            _kv_quantize(pg, s[:, :, None, None]))
+                        for p, pg, s in zip(cache["k"], kpgs, kscs)),
+                    "v": tuple(
+                        p.at[page_ids].set(
+                            _kv_quantize(pg, s[:, :, None, None]))
+                        for p, pg, s in zip(cache["v"], vpgs, vscs)),
+                    "k_scale": tuple(
+                        p.at[page_ids].set(s)
+                        for p, s in zip(cache["k_scale"], kscs)),
+                    "v_scale": tuple(
+                        p.at[page_ids].set(s)
+                        for p, s in zip(cache["v_scale"], vscs)),
+                    "table": cache["table"].at[slot].set(pages),
+                    "len": cache["len"].at[slot].set(n_valid),
+                }
+            else:
+                new_cache = {
+                    "k": tuple(p.at[page_ids].set(_to_pages(k, ps, dt))
+                               for p, k in zip(cache["k"], ks)),
+                    "v": tuple(p.at[page_ids].set(_to_pages(v, ps, dt))
+                               for p, v in zip(cache["v"], vs)),
+                    "table": cache["table"].at[slot].set(pages),
+                    "len": cache["len"].at[slot].set(n_valid),
+                }
             return logits._data[:, 0, :], new_cache
 
         def chunk_raw(tokens, start, n_valid, slot, pages, cache):
@@ -508,13 +778,18 @@ class GPTModel(HybridBlock):
                 x = self.embed_drop(x)
             page_ids = lax.dynamic_slice(pages, (start // ps,),
                                          (c // ps,))
-            ks, vs = [], []
+            quant_kv = cache["k"][0].dtype == jnp.int8
+            ks, vs, kscs, vscs = [], [], [], []
             for li, blk in enumerate(blocks):
-                x, kp, vp = blk.prefill_chunk(
+                x, kp, vp, ksp, vsp = blk.prefill_chunk(
                     x, cache["k"][li], cache["v"][li], pages, page_ids,
-                    start)
+                    start,
+                    k_scale=cache["k_scale"][li] if quant_kv else None,
+                    v_scale=cache["v_scale"][li] if quant_kv else None)
                 ks.append(kp)
                 vs.append(vp)
+                kscs.append(ksp)
+                vscs.append(vsp)
             idx = jnp.clip(n_valid - 1, 0, c - 1)
             last = x._data[0, idx][None, None, :]
             logits = self.lm_head(self.ln_f(NDArray(last)))
@@ -523,11 +798,15 @@ class GPTModel(HybridBlock):
                 "table": cache["table"].at[slot].set(pages),
                 "len": cache["len"].at[slot].set(start + n_valid),
             }
+            if quant_kv:
+                new_cache["k_scale"] = tuple(kscs)
+                new_cache["v_scale"] = tuple(vscs)
             return logits._data[:, 0, :], new_cache
 
         def decode_raw(tokens, active, cache):
             ps = cache["k"][0].shape[2]
             s_max = cache["table"].shape[1] * ps
+            quant_kv = cache["k"][0].dtype == jnp.int8
             ln = cache["len"]
             b = ln.shape[0]
             pos = jnp.minimum(ln, s_max - 1)
@@ -539,28 +818,43 @@ class GPTModel(HybridBlock):
             page = jnp.where(
                 live, cache["table"][jnp.arange(b), pos // ps], 0)
             offset = jnp.where(live, pos % ps, 0)
+            # the previous page (scale inheritance for a page whose
+            # first token this step writes); same scrap redirection
+            prev_page = jnp.where(
+                live,
+                cache["table"][jnp.arange(b),
+                               jnp.maximum(pos // ps - 1, 0)], 0)
             emb = self.word_embed(NDArray(tokens))
             pw = self.position_weight.data()._data
             x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
             if self.embed_drop is not None:
                 x = self.embed_drop(x)
-            ks, vs = [], []
+            ks, vs, kscs, vscs = [], [], [], []
             for li, blk in enumerate(blocks):
-                x, kp, vp = blk.decode_paged(
+                x, kp, vp, ksp, vsp = blk.decode_paged(
                     x, cache["k"][li], cache["v"][li], cache["table"],
-                    page, offset, att_len)
+                    page, offset, att_len,
+                    k_scale=cache["k_scale"][li] if quant_kv else None,
+                    v_scale=cache["v_scale"][li] if quant_kv else None,
+                    prev_page=prev_page if quant_kv else None)
                 ks.append(kp)
                 vs.append(vp)
+                kscs.append(ksp)
+                vscs.append(vsp)
             logits = self.lm_head(self.ln_f(x))
             new_cache = {"k": tuple(ks), "v": tuple(vs),
                          "table": cache["table"],
                          "len": ln + live.astype(jnp.int32)}
+            if quant_kv:
+                new_cache["k_scale"] = tuple(kscs)
+                new_cache["v_scale"] = tuple(vscs)
             return logits._data[:, 0, :], new_cache
 
         def peek_raw(token, slot, cache):
             """Logits of the last CACHED token of ``slot`` (position
             len-1, K/V already in the pool) — zero prefill compute, no
             cache write. The 100%-prefix-hit admission path."""
+            quant_kv = cache["k"][0].dtype == jnp.int8
             ln = cache["len"][slot]
             pos = ln - 1
             pw = self.position_weight.data()._data
@@ -570,37 +864,45 @@ class GPTModel(HybridBlock):
                 x = self.embed_drop(x)
             table1 = cache["table"][slot][None]
             for li, blk in enumerate(blocks):
-                x = blk.peek_paged(x, cache["k"][li], cache["v"][li],
-                                   table1, ln[None])
+                x = blk.peek_paged(
+                    x, cache["k"][li], cache["v"][li], table1, ln[None],
+                    k_scale=cache["k_scale"][li] if quant_kv else None,
+                    v_scale=cache["v_scale"][li] if quant_kv else None)
             logits = self.lm_head(self.ln_f(x))
             return logits._data[0, 0, :]
 
         def bind_raw(slot, pages, length, cache):
-            return {"k": cache["k"], "v": cache["v"],
-                    "table": cache["table"].at[slot].set(pages),
-                    "len": cache["len"].at[slot].set(length)}
+            new = dict(cache)   # int8 scale pools ride along untouched
+            new["table"] = cache["table"].at[slot].set(pages)
+            new["len"] = cache["len"].at[slot].set(length)
+            return new
 
         def copy_raw(src, dst, cache):
-            return {
-                "k": tuple(p.at[dst].set(p[src]) for p in cache["k"]),
-                "v": tuple(p.at[dst].set(p[src]) for p in cache["v"]),
-                "table": cache["table"], "len": cache["len"]}
+            new = dict(cache)
+            new["k"] = tuple(p.at[dst].set(p[src]) for p in cache["k"])
+            new["v"] = tuple(p.at[dst].set(p[src]) for p in cache["v"])
+            if "k_scale" in cache:   # a COW'd page keeps its scale
+                new["k_scale"] = tuple(p.at[dst].set(p[src])
+                                       for p in cache["k_scale"])
+                new["v_scale"] = tuple(p.at[dst].set(p[src])
+                                       for p in cache["v_scale"])
+            return new
 
         self._paged = {
             "params": param_nds,
-            "fresh": jax.jit(_bind(fresh_raw), donate_argnums=(6,)),
-            "chunk": jax.jit(_bind(chunk_raw), donate_argnums=(7,)),
-            "decode": jax.jit(_bind(decode_raw), donate_argnums=(4,)),
+            "fresh": jax.jit(_bind(fresh_raw), donate_argnums=(7,)),
+            "chunk": jax.jit(_bind(chunk_raw), donate_argnums=(8,)),
+            "decode": jax.jit(_bind(decode_raw), donate_argnums=(5,)),
             "peek": jax.jit(_bind(peek_raw)),
-            "bind": jax.jit(_bind(bind_raw), donate_argnums=(5,)),
-            "copy": jax.jit(_bind(copy_raw), donate_argnums=(4,)),
+            "bind": jax.jit(_bind(bind_raw), donate_argnums=(6,)),
+            "copy": jax.jit(_bind(copy_raw), donate_argnums=(5,)),
         }
         return self._paged
 
     def _paged_call(self, name, *args):
         p = self._ensure_paged()
-        return p[name](next_key(),
-                       [nd._data for nd in p["params"]], *args)
+        return p[name](next_key(), [nd._data for nd in p["params"]],
+                       self._quant_arg(), *args)
 
     def prefill_paged(self, tokens, n_valid, slot, pages, cache, *,
                       start=0, fresh=False):

@@ -370,16 +370,26 @@ def _decode_fwd_jnp(q, k, v, lengths, scale):
                           col < lengths[:, None, None, None], scale)
 
 
-def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                       l_ref, acc_ref, *, scale, block_k, nkb):
+def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, *rest, scale,
+                       block_k, nkb, quant=False):
     """One (batch, head, kv-block) grid step. ``len_ref`` is the
     scalar-prefetched per-slot length vector (SMEM); blocks at or past
     the slot's valid prefix skip compute entirely (their BlockSpec
     index map also re-requests the already-resident block, so no data
     moves for them). Online-softmax state lives in VMEM scratch, which
     persists across the innermost (kv-block) grid axis; the output
-    block is written once, on the last grid step."""
+    block is written once, on the last grid step.
+
+    ``quant=True`` (int8 KV cache) adds two ``(1, 1)`` scale inputs
+    right after ``v_ref``: the resident int8 block is dequantized
+    IN-REGISTER with its slot's (dense) or page's (paged) per-head
+    scale — the fp32 K/V never exist outside VMEM, so the cache's HBM
+    footprint (and the DMA per step) is the int8 bytes."""
     import jax.experimental.pallas as pl
+    if quant:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     kb = pl.program_id(2)
     length = len_ref[b]
@@ -397,6 +407,9 @@ def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
         sq = q.shape[0]
         k = k_ref[0, 0].astype(jnp.float32)            # (block_k, d)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quant:
+            k = k * ks_ref[0, 0]
+            v = v * vs_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)        # (sq, bk)
@@ -430,7 +443,7 @@ def _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
 
 
 def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
-                            interpret=False):
+                            interpret=False, k_scale=None, v_scale=None):
     """Pallas decode kernel: grid over (batch, head, kv-block) with the
     per-slot lengths scalar-prefetched into the KV BlockSpec index
     maps. Blocks past a slot's valid prefix are clamped to its last
@@ -438,7 +451,9 @@ def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
     index repeats — so a 40-token slot in a 2048-row cache MOVES
     ceil(40/block_k) KV blocks, not S_max rows; compute for those
     steps is skipped in the kernel. No host-side padding: a final
-    partial block is masked in-kernel."""
+    partial block is masked in-kernel. ``k_scale``/``v_scale``
+    ``(B, H)`` mark an int8 KV cache: the streamed int8 blocks are
+    dequantized in VMEM with each slot's per-head scale."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -447,20 +462,28 @@ def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     block_k = min(block_k, max(sk, 1))
     nkb = (sk + block_k - 1) // block_k
+    quant = k_scale is not None
 
     def _kv_index(i, j, kb, lens):
         last = jnp.maximum((lens[i] + block_k - 1) // block_k - 1, 0)
         return (i, j, jnp.minimum(kb, last), 0)
 
+    in_specs = [
+        pl.BlockSpec((1, 1, sq, d),
+                     lambda i, j, kb, lens: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, block_k, d), _kv_index),
+        pl.BlockSpec((1, 1, block_k, d), _kv_index),
+    ]
+    operands = [q, k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1),
+                                  lambda i, j, kb, lens: (i, j))] * 2
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(b, h, nkb),
-        in_specs=[
-            pl.BlockSpec((1, 1, sq, d),
-                         lambda i, j, kb, lens: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, block_k, d), _kv_index),
-            pl.BlockSpec((1, 1, block_k, d), _kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, sq, d),
                                lambda i, j, kb, lens: (i, j, 0, 0)),
         scratch_shapes=[
@@ -470,13 +493,13 @@ def decode_attention_pallas(q, k, v, lengths, scale=None, block_k=128,
         ],
     )
     kernel = functools.partial(_decode_fwd_kernel, scale=scale,
-                               block_k=block_k, nkb=nkb)
+                               block_k=block_k, nkb=nkb, quant=quant)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), q, k, v)
+    )(lengths.astype(jnp.int32), *operands)
 
 
 def gather_pages(pool, table):
@@ -492,18 +515,28 @@ def gather_pages(pool, table):
 
 
 def _paged_decode_fwd_kernel(len_ref, tbl_ref, q_ref, k_ref, v_ref,
-                             o_ref, m_ref, l_ref, acc_ref, **kw):
+                             *rest, **kw):
     """Paged decode grid step: the page table participates only in the
     BlockSpec index maps (it chooses WHICH pool page each grid step
     DMAs); once the right (1, 1, page_size, d) pool block is resident
     the arithmetic is exactly the dense decode kernel's."""
     del tbl_ref
-    _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref,
-                       l_ref, acc_ref, **kw)
+    _decode_fwd_kernel(len_ref, q_ref, k_ref, v_ref, *rest, **kw)
+
+
+def expand_page_scales(pool_scale, table, page_size):
+    """Broadcast per-head-per-PAGE scales onto token positions:
+    ``pool_scale`` (n_pages, H) + ``table`` (B, P_max) ->
+    (B, H, P_max * page_size) — position ``t`` of slot ``b`` carries
+    the scale of its page ``table[b, t // page_size]``. The dequant
+    companion of ``gather_pages`` for an int8 pool."""
+    g = pool_scale[table]                       # (B, P_max, H)
+    return jnp.repeat(g.transpose(0, 2, 1), page_size, axis=2)
 
 
 def paged_decode_attention_pallas(q, k_pool, v_pool, table, lengths,
-                                  scale=None, interpret=False):
+                                  scale=None, interpret=False,
+                                  k_scale=None, v_scale=None):
     """Pallas paged-decode kernel: grid (batch, head, page-slot) with
     BOTH the per-slot lengths and the page table scalar-prefetched into
     the KV BlockSpec index maps. Grid step ``kb`` of slot ``i`` DMAs
@@ -512,7 +545,10 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, table, lengths,
     decode kernel) steps at or past the slot's valid prefix clamp to
     its last valid page: a repeated block index lets the TPU pipeline
     elide the copy, bounding DMA to ceil(len/page_size) pages per
-    slot. Compute for those steps is skipped in the kernel."""
+    slot. Compute for those steps is skipped in the kernel.
+    ``k_scale``/``v_scale`` (n_pages, H) mark an int8 pool: each
+    resident page is dequantized in VMEM with ITS OWN per-head scale
+    (the scale rides the same table-indexed BlockSpec as the page)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -523,20 +559,31 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, table, lengths,
             f"pool layout {k_pool.shape} does not match q {q.shape}")
     p_max = table.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    quant = k_scale is not None
 
     def _kv_index(i, j, kb, lens, tbl):
         last = jnp.maximum((lens[i] + ps - 1) // ps - 1, 0)
         return (tbl[i, jnp.minimum(kb, last)], j, 0, 0)
 
+    def _sc_index(i, j, kb, lens, tbl):
+        last = jnp.maximum((lens[i] + ps - 1) // ps - 1, 0)
+        return (tbl[i, jnp.minimum(kb, last)], j)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, sq, d),
+                     lambda i, j, kb, lens, tbl: (i, j, 0, 0)),
+        pl.BlockSpec((1, 1, ps, d), _kv_index),
+        pl.BlockSpec((1, 1, ps, d), _kv_index),
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1), _sc_index)] * 2
+        operands += [k_scale.astype(jnp.float32),
+                     v_scale.astype(jnp.float32)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(b, h, p_max),
-        in_specs=[
-            pl.BlockSpec((1, 1, sq, d),
-                         lambda i, j, kb, lens, tbl: (i, j, 0, 0)),
-            pl.BlockSpec((1, 1, ps, d), _kv_index),
-            pl.BlockSpec((1, 1, ps, d), _kv_index),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, sq, d),
                                lambda i, j, kb, lens, tbl: (i, j, 0, 0)),
         scratch_shapes=[
@@ -546,18 +593,17 @@ def paged_decode_attention_pallas(q, k_pool, v_pool, table, lengths,
         ],
     )
     kernel = functools.partial(_paged_decode_fwd_kernel, scale=scale,
-                               block_k=ps, nkb=p_max)
+                               block_k=ps, nkb=p_max, quant=quant)
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=interpret,
-    )(lengths.astype(jnp.int32), table.astype(jnp.int32),
-      q, k_pool, v_pool)
+    )(lengths.astype(jnp.int32), table.astype(jnp.int32), *operands)
 
 
 def paged_decode_attention(q, k_pool, v_pool, table, lengths,
-                           scale=None):
+                           scale=None, k_scale=None, v_scale=None):
     """Decode attention against a PAGED KV cache.
 
     ``q`` is (B, H, Sq, D); ``k_pool``/``v_pool`` are the global page
@@ -569,15 +615,30 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths,
     paged cache holding the same values produces bit-identical logits
     to the dense cache); the Pallas TPU path streams only each slot's
     valid pages through VMEM via scalar-prefetched (lengths, table)
-    index maps."""
+    index maps.
+
+    ``k_scale``/``v_scale`` (n_pages, H) fp32 mark an INT8 pool
+    (half the HBM per cached token vs bf16, a quarter vs fp32): the
+    jnp path dequantizes the gathered view with each page's per-head
+    scale; the Pallas path dequantizes each page in VMEM after the
+    DMA — int8 is what moves."""
     scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     lengths = jnp.asarray(lengths, jnp.int32)
     table = jnp.asarray(table, jnp.int32)
     if _use_pallas():
         return paged_decode_attention_pallas(q, k_pool, v_pool, table,
-                                             lengths, scale=scale_v)
-    return _decode_fwd_jnp(q, gather_pages(k_pool, table),
-                           gather_pages(v_pool, table), lengths, scale_v)
+                                             lengths, scale=scale_v,
+                                             k_scale=k_scale,
+                                             v_scale=v_scale)
+    k = gather_pages(k_pool, table)
+    v = gather_pages(v_pool, table)
+    if k_scale is not None:
+        ps = k_pool.shape[2]
+        k = k.astype(jnp.float32) \
+            * expand_page_scales(k_scale, table, ps)[..., None]
+        v = v.astype(jnp.float32) \
+            * expand_page_scales(v_scale, table, ps)[..., None]
+    return _decode_fwd_jnp(q, k, v, lengths, scale_v)
 
 
 def chunked_prefill_attention(q, k, v, start, scale=None):
@@ -605,7 +666,8 @@ def chunked_prefill_attention(q, k, v, start, scale=None):
     return _masked_attend(q, k, v, valid, scale_v)
 
 
-def decode_attention(q, k, v, lengths, scale=None):
+def decode_attention(q, k, v, lengths, scale=None, k_scale=None,
+                     v_scale=None):
     """Autoregressive decode attention against a preallocated KV cache.
 
     ``q`` is (B, H, Sq, D) — Sq is 1 on the decode hot path; ``k``/``v``
@@ -616,11 +678,20 @@ def decode_attention(q, k, v, lengths, scale=None):
     valid cache), matching ``mha_reference(q, k[:, :, :len],
     v[:, :, :len])`` per row. A row with lengths==0 (an empty serving
     slot riding along in the fixed-shape batch) returns zeros.
+
+    ``k_scale``/``v_scale`` (B, H) fp32 mark an INT8 cache: the
+    stored int8 K/V dequantize with each slot's per-head scale — in
+    VMEM on the Pallas path (int8 is what streams from HBM), before
+    the masked softmax on the jnp path.
     """
     scale_v = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
     lengths = jnp.asarray(lengths, jnp.int32)
     if _use_pallas():
-        return decode_attention_pallas(q, k, v, lengths, scale=scale_v)
+        return decode_attention_pallas(q, k, v, lengths, scale=scale_v,
+                                       k_scale=k_scale, v_scale=v_scale)
+    if k_scale is not None:
+        k = k.astype(jnp.float32) * k_scale[:, :, None, None]
+        v = v.astype(jnp.float32) * v_scale[:, :, None, None]
     return _decode_fwd_jnp(q, k, v, lengths, scale_v)
 
 

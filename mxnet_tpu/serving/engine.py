@@ -431,6 +431,16 @@ class InferenceEngine:
             bool(a) and bool(b) and a[0] == w1 and b[0] == w2
             for a, b in zip(s1, s2)]
 
+    @property
+    def precision(self) -> str:
+        """``"int8"`` when the block carries quantize_net-produced
+        int8 twins, else ``"fp32"``. Router fleets must be
+        precision-homogeneous (a retried request must see one numeric
+        configuration)."""
+        from ..contrib.quantization import iter_quantized
+        return "int8" if any(True for _ in iter_quantized(self.block)) \
+            else "fp32"
+
     def load_weights(self, source, strict: bool = True):
         """Zero-downtime weight rollover for the micro-batching
         engine: swap the block's parameter buffers from a committed
@@ -443,8 +453,16 @@ class InferenceEngine:
         entries pass parameter buffers as runtime arguments, so
         installing same-shape/dtype buffers changes no trace. Queued
         requests are untouched; the first batch dispatched after the
-        swap runs the new weights."""
+        swap runs the new weights.
+
+        On a quantize_net-produced int8 block, the checkpoint's fp32
+        weights for the quantized twins are RE-QUANTIZED in place
+        (per twin, under the same swap lock; the twins keep their
+        calibrated activation scales) and the remaining parameters
+        swap as usual — all validated before anything is installed,
+        so the block is never left half fp32-new / half int8-old."""
         from .. import checkpoint as _ckpt
+        from ..contrib.quantization import iter_quantized
         if self._closed:
             raise EngineClosedError("load_weights on a closed engine")
         if isinstance(source, dict):
@@ -453,8 +471,60 @@ class InferenceEngine:
             new_params, _meta = _ckpt.read_params(source)
         t0 = telemetry.clock()
         with self._swap_lock:
-            _ckpt.swap_param_buffers(self.block.collect_params(),
-                                     new_params, strict=strict)
+            twins = list(iter_quantized(self.block))
+            if not twins:
+                _ckpt.swap_param_buffers(self.block.collect_params(),
+                                         new_params, strict=strict)
+            else:
+                # validate the WHOLE plan before touching anything:
+                # swap_param_buffers is already all-or-nothing for the
+                # fp32 remainder, and the requantize loop below can no
+                # longer fail once shapes/presence checked out here
+                import numpy as onp
+                plan, consumed = [], set()
+                for name, q in twins:
+                    src = q._src_name or name
+                    wkey, bkey = f"{src}.weight", f"{src}.bias"
+                    if wkey not in new_params:
+                        if strict:
+                            raise ValueError(
+                                f"checkpoint is missing {wkey!r} for "
+                                f"the quantized layer {name!r}")
+                        continue
+                    w = onp.asarray(new_params[wkey])
+                    if w.shape != tuple(q.wq.shape):
+                        raise ValueError(
+                            f"checkpoint weight {wkey!r} shape "
+                            f"{w.shape} does not match the quantized "
+                            f"layer's {tuple(q.wq.shape)}")
+                    b = new_params.get(bkey)
+                    if (b is None) != (q.qbias is None):
+                        raise ValueError(
+                            f"checkpoint bias presence for {name!r} "
+                            f"does not match the quantized layer")
+                    if b is not None \
+                            and onp.asarray(b).shape \
+                            != tuple(q.qbias.shape):
+                        raise ValueError(
+                            f"checkpoint bias {bkey!r} shape does not "
+                            f"match the quantized layer")
+                    consumed.update((wkey, bkey))
+                    plan.append((q, w, b))
+                rest = {k: v for k, v in new_params.items()
+                        if k not in consumed}
+                # the twins' own Constant params (wq/w_scale/qbias)
+                # are requantize's job, not the fp32 swap's — a
+                # checkpoint from the UNQUANTIZED twin net cannot
+                # cover them
+                twin_prefixes = tuple(f"{name}." for name, _ in twins)
+                target = {k: p for k, p
+                          in self.block.collect_params().items()
+                          if not k.startswith(twin_prefixes)}
+                _ckpt.swap_param_buffers(target, rest, strict=strict)
+                tq = telemetry.clock()
+                for q, w, b in plan:
+                    q.requantize(w, b)
+                telemetry.hist_since("serving.quant.requantize", tq)
         telemetry.hist_since("serving.swap", t0)
         telemetry.counter("serving.weight_swaps")
         return self

@@ -420,6 +420,21 @@ class GenerationEngine:
         later requests sharing their prefix skip that prefill (an
         exact repeat skips prefill entirely — its first token is
         computed straight off the cached K/V).
+    quantize : str, optional
+        ``"int8_weights"`` arms weight-only int8 decode: the model's
+        attention/MLP projection weights are quantized per-output-
+        channel symmetric int8 at engine load (re-quantized under the
+        swap lock on every ``load_weights`` rollover) and the decode
+        path runs the fused dequant-matmul kernel — the fp32 weights
+        never re-stream from HBM. Greedy output is held to the
+        bounded-divergence gate documented in docs/SERVING.md
+        ("Low-precision decode"), not token-identity.
+    kv_dtype : str, optional
+        ``"int8"`` stores the KV cache quantized (a quarter the K/V
+        bytes of fp32; per-head-per-slot scales dense, per-head-per-
+        page scales paged — so a paged pool holds ~4x the pages in
+        the same HBM). Alias for ``cache_dtype`` with the quantized
+        layout; attention dequantizes inside the decode kernels.
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
@@ -428,8 +443,39 @@ class GenerationEngine:
                  prefill_bucketing=None, cache_dtype=None,
                  paged: bool = False, page_size: int = 16,
                  n_pages=None, prefill_chunk=None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, quantize=None,
+                 kv_dtype=None):
         self.paged = bool(paged)
+        if quantize not in (None, "int8_weights"):
+            raise ValueError(
+                f"unsupported quantize={quantize!r} (only "
+                f"'int8_weights')")
+        if kv_dtype is not None:
+            if cache_dtype is not None \
+                    and str(cache_dtype) != str(kv_dtype):
+                raise ValueError(
+                    f"kv_dtype={kv_dtype!r} conflicts with "
+                    f"cache_dtype={cache_dtype!r}")
+            if str(kv_dtype) != "int8":
+                raise ValueError(
+                    f"unsupported kv_dtype={kv_dtype!r} (only 'int8'; "
+                    f"use cache_dtype for plain float layouts)")
+            cache_dtype = kv_dtype
+        self.quantize = quantize
+        if quantize is not None:
+            if not callable(getattr(model, "quantize_params", None)):
+                raise TypeError(
+                    "quantize='int8_weights' needs a model exposing "
+                    "quantize_params() (gluon.model_zoo.gpt.GPTModel)")
+            t0 = telemetry.clock()
+            model.quantize_params()
+            telemetry.hist_since("serving.generate.quant.quantize", t0)
+            n, saved = model.quantized_param_stats() \
+                if callable(getattr(model, "quantized_param_stats",
+                                    None)) else (0, 0)
+            telemetry.counter("serving.generate.quant.params", n)
+            telemetry.counter("serving.generate.quant.bytes_saved",
+                              saved)
         api = ("init_paged_cache", "prefill_paged", "decode_step_paged",
                "peek_logits_paged", "bind_slot_paged",
                "copy_page_paged") if self.paged \
@@ -502,6 +548,15 @@ class GenerationEngine:
             self.policy = policy.clamped(self._s_max)
             self._cache = model.init_cache(self.max_slots, self._s_max,
                                            dtype=cache_dtype)
+        self._kv_int8 = "k_scale" in self._cache
+        if self._kv_int8:   # quant.* telemetry only for quantized
+            # engines — an fp32 fleet must not populate the namespace
+            kv_bytes = sum(
+                int(a.size) * a.dtype.itemsize
+                for key in ("k", "v", "k_scale", "v_scale")
+                for a in self._cache.get(key, ()))
+            telemetry.gauge("serving.generate.quant.kv_bytes_per_slot",
+                            kv_bytes // self.max_slots)
         self._slots: list = [None] * self.max_slots
         self._n_active = 0
         #: serializes every model call (worker admit/step, sync-mode
@@ -521,6 +576,20 @@ class GenerationEngine:
         self._worker = None if self._sync \
             else _GenWorker(self, self.queue_limit)
         _live_engines.add(self)
+
+    @property
+    def precision(self) -> str:
+        """The replica's numeric configuration — ``"fp32"``,
+        ``"int8_weights"``, ``"int8_kv"`` or ``"int8_weights+int8_kv"``.
+        Router fleets must be precision-homogeneous: retries re-run a
+        request on another replica and the bounded-divergence contract
+        only holds within ONE quantization configuration."""
+        parts = []
+        if self.quantize is not None:
+            parts.append(self.quantize)
+        if self._kv_int8:
+            parts.append("int8_kv")
+        return "+".join(parts) if parts else "fp32"
 
     # -- lifecycle -----------------------------------------------------
     @contextlib.contextmanager
@@ -633,6 +702,16 @@ class GenerationEngine:
             # waiter signal), warmup is not tracing
             _ckpt.swap_param_buffers(self.model.collect_params(),
                                      new_params, strict=strict)
+            if self.quantize is not None:
+                # re-quantize from the fresh fp32 buffers INSIDE the
+                # swap window: the quant tables are runtime args of
+                # the jitted closures, so this installs new int8
+                # weights with zero retraces — and a decode step may
+                # never see new fp32 params with stale int8 tables
+                tq = telemetry.clock()
+                self.model.quantize_params()
+                telemetry.hist_since(
+                    "serving.generate.quant.requantize", tq)
             if self.paged and self._prefix is not None:
                 # the prefix cache holds K/V computed with the OLD
                 # weights: a post-swap prefix hit would silently serve

@@ -247,6 +247,15 @@ class Router:
             raise TypeError(
                 "replicas must be a homogeneous fleet of "
                 "GenerationEngine or InferenceEngine instances")
+        precisions = {getattr(e, "precision", "fp32") for e in replicas}
+        if len(precisions) > 1:
+            # a retried request re-runs on ANOTHER replica; mixing
+            # fp32 and int8 replicas would make the retry's output
+            # depend on which replica caught it — token-identity and
+            # the bounded-divergence contract both break
+            raise TypeError(
+                f"replicas must be precision-homogeneous, got "
+                f"{sorted(precisions)}")
         self._replicas = [_Replica(e, i) for i, e in enumerate(replicas)]
         self.max_retries = int(max_retries)
         self.breaker_threshold = max(1, int(breaker_threshold))

@@ -27,6 +27,13 @@ from mxnet_tpu.contrib.quantization import quantize_net  # noqa: E402
 
 BATCH = int(os.environ.get("INT8_BATCH", "256"))
 HW = int(os.environ.get("INT8_HW", "224"))
+# calibration mode: naive min-max (fast) or entropy (KL-optimal
+# thresholds via _LayerHistogramCollector — the path unit tests alone
+# used to exercise)
+CALIB = os.environ.get("INT8_CALIB", "naive")
+if CALIB not in ("naive", "entropy"):
+    raise SystemExit(f"INT8_CALIB must be 'naive' or 'entropy', "
+                     f"got {CALIB!r}")
 LO, HI = 2, 10
 
 rng = onp.random.RandomState(0)
@@ -38,7 +45,7 @@ def build(mode):
     net.initialize()
     if mode == "int8":
         net = quantize_net(net, quantized_dtype="int8",
-                           calib_mode="naive", calib_data=[data[:32]])
+                           calib_mode=CALIB, calib_data=[data[:32]])
     elif mode == "bf16":
         net.cast("bfloat16")
     net.hybridize()
@@ -74,6 +81,7 @@ print(json.dumps({
     "ips_fp32": round(ips_fp32, 1),
     "ips_bf16": round(ips_bf16, 1),
     "int8_speedup_vs_fp32": round(ips_int8 / max(ips_fp32, 1e-9), 3),
+    "calib_mode": CALIB,
     "batch": BATCH, "hw": HW,
     "total_s": round(total_s, 1),
     "init_s": round(init_s, 2),

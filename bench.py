@@ -3367,7 +3367,386 @@ def _quant_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --spec: speculative-decoding serving benchmark (CPU-runnable; --smoke
+# is the tier-1-sized variant). Subprocess-isolated configs, gates
+# ENFORCED via exit code -> BENCH_r15.json:
+#
+#   base / spec : closed-loop INTERACTIVE A/B at the same HBM budget.
+#            SPC_CLIENTS client threads each submit-wait-resubmit a
+#            fixed greedy request list — the low-concurrency regime
+#            where production decode is latency-bound and slots sit
+#            idle (BENCH_r09 measured 6.57/8 tokens-per-step of
+#            slot-level headroom; speculation is the per-SLOT
+#            multiplier, continuous batching the cross-slot one). The
+#            budget charges the spec engine for the draft: base =
+#            target params + SPC_BASE_SLOTS target-KV slots; spec =
+#            target + draft params + S' (target+draft)-KV slots with
+#            S' the largest count that fits the SAME bytes. Gates:
+#            spec decode tokens/sec >= 1.4x base, greedy output
+#            TOKEN-IDENTICAL (cross-subprocess sha256 digest),
+#            acceptance rate reported, 0 in-window compiles. (At
+#            SATURATED batch the verify's k+1 positions cost ~k+1
+#            compute units on CPU and speculation loses — reported
+#            honestly in docs/PERFORMANCE.md; the production win is
+#            the memory-bound/overhead-bound regime this workload
+#            pins.)
+#   sampled : the same spec engine under per-request SAMPLING
+#            (temperature/top-k/top-p + explicit seeds), the whole
+#            request list submitted UP FRONT from one thread (a
+#            DETERMINISTIC admission schedule), run TWICE in one
+#            process against two FRESH engines and once more in a
+#            second subprocess. Gates: bitwise-identical digests
+#            across the in-process engine restart AND across the
+#            processes. A seeded stream is a function of (seed,
+#            engine config, admission schedule); the closed-loop
+#            client THREADS of the throughput configs would make the
+#            schedule itself race-dependent — reproducibility is
+#            only ever promised for a replayed schedule, so that is
+#            what this config replays (docs/SERVING.md states the
+#            same contract).
+#
+#   Draft/target construction: tied-embedding GPTs (the BENCH_r14
+#            peaky-logits discipline) with block weights damped by
+#            SPC_DAMP, and the 1-layer draft COPIES the target's
+#            embeddings + first block — a poor man's distillation
+#            that yields the ~0.7-0.8 acceptance a trained
+#            draft/target pair exhibits. Acceptance is REPORTED in
+#            the JSON, never assumed.
+# ---------------------------------------------------------------------------
+SPEC_SMOKE = os.environ.get("BENCH_SPEC_SMOKE", "") not in ("", "0")
+#: model shape is IDENTICAL in smoke (the ratio depends on the
+#: model-size/overhead balance — a smaller smoke model would test a
+#: different operating point); smoke only cuts requests and reps
+SPC_VOCAB, SPC_TL, SPC_TU, SPC_HEADS = 256, 4, 48, 4
+SPC_DL, SPC_K, SPC_SMAX = 1, 8, 128
+if SPEC_SMOKE:
+    SPC_CLIENTS, SPC_PER_CLIENT, SPC_REPS = 2, 8, 2
+else:
+    SPC_CLIENTS, SPC_PER_CLIENT, SPC_REPS = 2, 12, 2
+SPC_BASE_SLOTS = 8
+SPC_DAMP = 0.3
+SPC_THR_MIN = 1.4            # spec tokens/sec over base (the gate)
+
+
+def _spc_models():
+    """(target, draft): tied-embedding GPTs whose block weights are
+    damped by SPC_DAMP (peaky logits -> a real greedy gap, the
+    _qnt_model lesson) and whose draft shares the target's
+    embeddings/head and FIRST block (weight-copy distillation — the
+    source of the measured acceptance rate)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+
+    def build(layers, seed):
+        mx.np.random.seed(seed)
+        net = GPTModel(vocab_size=SPC_VOCAB, units=SPC_TU,
+                       num_layers=layers, num_heads=SPC_HEADS,
+                       max_length=SPC_SMAX)
+        net.initialize(mx.init.Xavier())
+        net._gen_params()
+        params = net.collect_params()
+        params["lm_head.weight"].set_data(
+            mx.np.array(params["word_embed.weight"].data().asnumpy()))
+        for k, p in params.items():
+            if "layers." in k and (k.endswith(".weight")
+                                   or k.endswith(".bias")):
+                p.set_data(mx.np.array(p.data().asnumpy() * SPC_DAMP))
+        net._clear_cached_op()
+        return net
+
+    target = build(SPC_TL, seed=0)
+    draft = build(SPC_DL, seed=1)
+    tgt_params = {k: v.data().asnumpy()
+                  for k, v in target.collect_params().items()}
+    for k, p in draft.collect_params().items():
+        if k in tgt_params and p.data().shape == tgt_params[k].shape:
+            p.set_data(__import__("mxnet_tpu").np.array(tgt_params[k]))
+    draft._clear_cached_op()
+    return target, draft
+
+
+def _spc_param_bytes(net):
+    return sum(int(p.data()._data.size) * 4
+               for p in net.collect_params().values())
+
+
+def _spc_budget(target, draft):
+    """(base_budget_bytes, spec_slots): charge the spec engine for
+    draft params + a draft-KV slot per target-KV slot inside the
+    budget that holds the base engine's SPC_BASE_SLOTS."""
+    kv_t = SPC_TL * 2 * SPC_SMAX * SPC_TU * 4
+    kv_d = SPC_DL * 2 * SPC_SMAX * SPC_TU * 4
+    p_t = _spc_param_bytes(target)
+    p_d = _spc_param_bytes(draft)
+    budget = p_t + SPC_BASE_SLOTS * kv_t
+    spec_slots = int((SPC_BASE_SLOTS * kv_t - p_d) // (kv_t + kv_d))
+    return budget, max(1, spec_slots)
+
+
+def _spc_workload():
+    """Per-client greedy request lists (fixed seed, identical per
+    config): short prompts + 24-40 token budgets — decode-dominated
+    interactive traffic."""
+    import numpy as onp
+    rng = onp.random.RandomState(61)
+    return [[(rng.randint(0, SPC_VOCAB,
+                          int(rng.randint(4, 13))).astype("i4"),
+              int(rng.randint(24, 41))) for _ in range(SPC_PER_CLIENT)]
+            for _ in range(SPC_CLIENTS)]
+
+
+def _spc_one_engine(target, draft, config, slots):
+    """Build one engine, serve the workload, return the run dict
+    (engine closed). ``base``/``spec`` run the closed-loop client
+    pool; ``sampled`` floods the whole seeded request list from one
+    thread — a deterministic admission schedule, which is the
+    precondition of the bitwise-reproducibility gate."""
+    import hashlib
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+
+    spec = config != "base"
+    kw = dict(draft_model=draft, spec_k=SPC_K) if spec else {}
+    eng = GenerationEngine(target, max_slots=slots,
+                           max_length=SPC_SMAX, queue_limit=64,
+                           **kw).warmup()
+    work = _spc_workload()
+    sampling = config == "sampled"
+    # priming: absorb any cold-start cost outside the window (both
+    # admission paths + one sampled request when sampling is measured)
+    eng.generate(work[0][0][0], max_new_tokens=2, timeout=600)
+    eng.generate(work[0][1][0], max_new_tokens=2, timeout=600,
+                 **({"temperature": 0.8, "seed": 1} if sampling else {}))
+    telemetry.reset()
+    all_tokens = [None] * SPC_CLIENTS
+
+    if sampling:
+        t0 = time.perf_counter()
+        flat = [(ci, p, m, 1000 + ci * 100 + ri)
+                for ci, lst in enumerate(work)
+                for ri, (p, m) in enumerate(lst)]
+        streams = [(ci, eng.submit(p, max_new_tokens=m,
+                                   temperature=0.8, top_k=40,
+                                   top_p=0.95, seed=sd))
+                   for ci, p, m, sd in flat]
+        for ci in range(SPC_CLIENTS):
+            all_tokens[ci] = [s.result(timeout=600).tokens
+                              for c, s in streams if c == ci]
+        wall = time.perf_counter() - t0
+    else:
+        def client(ci):
+            toks = []
+            for ri, (p, m) in enumerate(work[ci]):
+                r = eng.generate(p, max_new_tokens=m, timeout=600)
+                toks.append(r.tokens)
+            all_tokens[ci] = toks
+
+        threads = [_BoxedThread(lambda ci=ci: client(ci),
+                                name=f"spec-client-{ci}")
+                   for ci in range(SPC_CLIENTS)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join_or_raise(600)
+        wall = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    eng.close()
+    c = snap["counters"]
+    tokens = int(c.get("serving.generate.tokens", 0))
+    steps = int(snap["histograms"]["serving.generate.decode"]["count"])
+    out = {
+        "config": config,
+        "clients": SPC_CLIENTS,
+        "requests": SPC_CLIENTS * SPC_PER_CLIENT,
+        "slots": slots,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "decode_iterations": steps,
+        "tokens_per_step": round(tokens / max(steps, 1), 2),
+        "compiles_in_window":
+            int(c.get("model.gpt.trace", 0))
+            + int(c.get("gluon.cachedop.cache_miss", 0))
+            + int(c.get("ops.sampling.trace", 0)),
+        "tokens_digest": hashlib.sha256(json.dumps(
+            all_tokens).encode()).hexdigest(),
+    }
+    if spec:
+        prop = int(c.get("serving.generate.spec.proposed", 0))
+        acc = int(c.get("serving.generate.spec.accepted", 0))
+        out.update({
+            "spec_k": SPC_K,
+            "draft_param_bytes": _spc_param_bytes(draft),
+            "proposed": prop,
+            "accepted": acc,
+            "accept_rate": round(acc / max(prop, 1), 4),
+        })
+    return out
+
+
+def _spc_run(config):
+    """One subprocess config: base | spec | sampled. ``sampled`` runs
+    the seeded workload TWICE against fresh engines (an in-process
+    engine restart) and reports both digests — the bitwise
+    restart-reproducibility evidence."""
+    target, draft = _spc_models()
+    budget, spec_slots = _spc_budget(target, draft)
+    slots = SPC_BASE_SLOTS if config == "base" else spec_slots
+    out = _spc_one_engine(target, draft, config, slots)
+    out["hbm_budget_bytes"] = budget
+    if config == "sampled":
+        rerun = _spc_one_engine(target, draft, config, slots)
+        out["restart_digest"] = rerun["tokens_digest"]
+        out["restart_identical"] = bool(
+            rerun["tokens_digest"] == out["tokens_digest"])
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+def _spc_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    return _spc_run(os.environ["BENCH_SPEC_CONFIG"])
+
+
+def _spc_check_schema(doc):
+    """BENCH_r15.json contract (spec for the shared _check_schema)."""
+    cfg_keys = ("tokens_per_sec", "tokens_per_step", "slots",
+                "hbm_budget_bytes", "compiles_in_window",
+                "tokens_digest")
+    return _check_schema(
+        "BENCH_r15", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "base": dict, "spec": dict,
+            "sampled": dict, "sampled_rerun": dict,
+            "throughput_ratio": float, "accept_rate": float,
+            "tokens_per_step": float, "token_identical": bool,
+            "sampling_reproducible": bool,
+            "sampling_cross_process_identical": bool,
+            "zero_compiles_in_window": bool,
+            "throughput_ge_1_4x": bool,
+        },
+        nested={"base": cfg_keys,
+                "spec": cfg_keys + ("accept_rate", "proposed",
+                                    "accepted", "spec_k"),
+                "sampled": cfg_keys + ("restart_identical",
+                                       "restart_digest"),
+                "sampled_rerun": cfg_keys + ("restart_identical",)},
+        gates=[("both engines must fit ONE HBM budget",
+                lambda d: d["spec"]["hbm_budget_bytes"]
+                == d["base"]["hbm_budget_bytes"]),
+               ("the draft must have proposed tokens",
+                lambda d: d["spec"]["proposed"] > 0),
+               ("speculation must multiply tokens per step",
+                lambda d: d["spec"]["tokens_per_step"]
+                > d["base"]["tokens_per_step"])])
+
+
+def _spec_main():
+    if os.environ.get("BENCH_SPEC_CONFIG"):
+        return _spc_child()
+    smoke = SPEC_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_SPEC_SMOKE": "1"} if smoke else {}
+    # interleaved best-of-N reps (the established A/B discipline:
+    # this box's cpu-shares swing 2-3x between windows, and a
+    # degraded window landing on ONE config inverts the A/B); greedy
+    # digests must agree across EVERY rep of EVERY config
+    reps = 3 if smoke else SPC_REPS
+    per_client = 8 if smoke else SPC_PER_CLIENT  # mirror the child's
+    # smoke constants (the parent may run without BENCH_SPEC_SMOKE
+    # in its own environment — only the doc strings need these)
+    results = {}
+    greedy_digests = set()
+    for rep in range(reps):
+        for cfg in ("base", "spec"):
+            _stage(f"spec: {cfg} (rep {rep + 1}/{reps})")
+            r = _ab_child("--spec", dict(env, BENCH_SPEC_CONFIG=cfg),
+                          label=f"spec {cfg} rep{rep}")
+            if r is None:
+                return 1
+            greedy_digests.add(r["tokens_digest"])
+            best = results.get(cfg)
+            if best is None \
+                    or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                results[cfg] = r
+    for cfg in ("sampled", "sampled_rerun"):
+        _stage(f"spec: {cfg}")
+        r = _ab_child("--spec", dict(env, BENCH_SPEC_CONFIG="sampled"),
+                      label=f"spec {cfg}")
+        if r is None:
+            return 1
+        results[cfg] = r
+    base, spec = results["base"], results["spec"]
+    thr_ratio = round(spec["tokens_per_sec"]
+                      / max(base["tokens_per_sec"], 1e-9), 2)
+    doc = _spc_check_schema({
+        "metric": "spec_decode_tokens_per_sec",
+        "value": float(spec["tokens_per_sec"]),
+        "unit": "generated tokens/sec at the same HBM budget "
+                "(interactive closed loop)",
+        "model": f"target gpt {SPC_TL}L-{SPC_TU}u-{SPC_HEADS}h "
+                 f"vocab={SPC_VOCAB} s_max={SPC_SMAX} tied-head "
+                 f"damp={SPC_DAMP}; draft {SPC_DL}L-{SPC_TU}u "
+                 f"(embeddings+first block copied), spec_k={SPC_K}",
+        "smoke": bool(smoke),
+        "reps_best_of": reps,
+        "workload": f"closed loop, {SPC_CLIENTS} client threads x "
+                    f"{per_client} greedy requests (prompts 4-12, "
+                    f"budgets 24-40, seed 61) — the low-concurrency "
+                    f"interactive regime; saturated-batch behavior "
+                    f"documented in docs/PERFORMANCE.md",
+        "base": base,
+        "spec": spec,
+        "sampled": results["sampled"],
+        "sampled_rerun": results["sampled_rerun"],
+        "throughput_ratio": thr_ratio,
+        "accept_rate": float(spec["accept_rate"]),
+        "tokens_per_step": float(spec["tokens_per_step"]),
+        "token_identical": bool(len(greedy_digests) == 1),
+        # THE reproducibility claim (gated): same seeds + the same
+        # (deterministic, flood-submitted) admission schedule ->
+        # bitwise-identical streams, across an in-process engine
+        # restart AND across processes
+        "sampling_reproducible": bool(
+            results["sampled"]["restart_identical"]
+            and results["sampled_rerun"]["restart_identical"]),
+        "sampling_cross_process_identical": bool(
+            results["sampled"]["tokens_digest"]
+            == results["sampled_rerun"]["tokens_digest"]),
+        "zero_compiles_in_window": bool(all(
+            results[c]["compiles_in_window"] == 0
+            for c in ("base", "spec", "sampled", "sampled_rerun"))),
+        "throughput_ge_1_4x": bool(thr_ratio >= SPC_THR_MIN),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_SPEC_OUT",
+                                           "BENCH_r15.json"))
+    if not smoke or "BENCH_SPEC_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("throughput_ge_1_4x", doc["throughput_ge_1_4x"]),
+        ("token_identical", doc["token_identical"]),
+        ("sampling_reproducible", doc["sampling_reproducible"]),
+        ("sampling_cross_process_identical",
+         doc["sampling_cross_process_identical"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] spec gates failed: {', '.join(failed)} "
+              f"(throughput_ratio={thr_ratio} "
+              f"accept_rate={doc['accept_rate']})",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--spec" in sys.argv:
+        return _spec_main()
     if "--quant" in sys.argv:
         return _quant_main()
     if "--prefix" in sys.argv:

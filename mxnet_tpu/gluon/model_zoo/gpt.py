@@ -41,6 +41,21 @@ table/COW helpers. Page ownership (refcounts, prefix index, COW
 arming) is the engine's job — serving/paging.py; the model layer only
 guarantees fixed shapes and donated in-place pool updates.
 
+For SPECULATIVE DECODING (serving/generate.py ``draft_model=``;
+docs/SERVING.md) the family grows k-token verify closures beside the
+one-token decode: ``verify_step``/``verify_step_paged`` write R
+tokens per row at ``[len, len + R)`` and return logits at every
+position (``ops.attention.chunked_prefill_attention`` under the
+global causal mask — the chunk-prefill kernel reused), ``advance_len``
+/``advance_len_paged`` move the ``len`` waterline (commit AND
+rollback — a rejected tail simply dies above it), and the FUSED
+fast-path closures ``propose_tokens`` (k chained draft steps + the
+sampling head in one program) and ``verify_commit[_paged]``
+(verify + accept rule + len advance in one program) cut a
+speculative iteration to three dispatches. The sampling heads
+(ops/sampling.py) ride inside these traces with explicit per-slot
+PRNG keys.
+
 All generation entry points are jitted closures over the parameter
 NDArrays (the CachedOp ``raw_fn`` rebinding idiom, gluon/block.py), and
 count ``model.gpt.trace`` each time they actually trace — the
@@ -60,6 +75,7 @@ from ... import autograd, telemetry
 from ...ndarray.ndarray import NDArray
 from ...ops import attention as _att
 from ...ops import quantized as _qz
+from ...ops import sampling as _smp
 from ...random_state import next_key, trace_rng
 from .. import _deferred
 from ..block import HybridBlock
@@ -224,6 +240,38 @@ class GPTBlock(HybridBlock):
                        ctx=x.ctx)
         return self._finish(x, attn), kc, vc
 
+    def verify(self, x, k_cache, v_cache, pos, start, k_scale=None,
+               v_scale=None):
+        """One speculative VERIFY step: insert R tokens' K/V at the
+        contiguous positions ``[pos, pos + R)`` per row and attend all
+        R queries over the global causal mask in one pass —
+        ``ops.attention.chunked_prefill_attention`` with per-row
+        ``start`` (= each row's committed length), the same kernel the
+        paged chunk-prefill path runs. The caller guarantees
+        ``pos + R <= S_max`` (the engine reserves a ``spec_k`` scratch
+        margin), so the write never clamps. ``k_scale``/``v_scale``
+        (B, H) mark an INT8 cache: writes quantize against the slot's
+        prefill-time scale and the attention view dequantizes with it
+        (the decode-path convention — one slot row, one scale)."""
+        q, k, v = self._qkv(x)
+        if k_scale is not None:
+            kc = _cache_insert(
+                k_cache, _kv_quantize(k._data, k_scale[:, :, None, None]),
+                pos)
+            vc = _cache_insert(
+                v_cache, _kv_quantize(v._data, v_scale[:, :, None, None]),
+                pos)
+            kf = kc.astype(jnp.float32) * k_scale[:, :, None, None]
+            vf = vc.astype(jnp.float32) * v_scale[:, :, None, None]
+        else:
+            kc = _cache_insert(k_cache, k._data, pos)
+            vc = _cache_insert(v_cache, v._data, pos)
+            kf, vf = kc, vc
+        attn = NDArray(_att.chunked_prefill_attention(
+            q._data, kf.astype(q._data.dtype), vf.astype(q._data.dtype),
+            start), ctx=x.ctx)
+        return self._finish(x, attn), kc, vc
+
     # -- paged-cache generation (serving/generate.py paged mode) --------
     def decode_paged(self, x, k_pool, v_pool, table, page, offset,
                      att_len, k_scale=None, v_scale=None,
@@ -312,6 +360,58 @@ class GPTBlock(HybridBlock):
             vg.astype(q._data.dtype), start), ctx=x.ctx)
         return self._finish(x, attn), kp, vp, None, None
 
+    def verify_paged(self, x, k_pool, v_pool, table, page, offset,
+                     start, k_scale=None, v_scale=None, fresh=None,
+                     anchor_page=None):
+        """Speculative VERIFY against a PAGED cache: scatter R tokens'
+        K/V per row into pool pages ``page``/``offset`` (B, R) —
+        inactive rows and positions past a slot's reservation arrive
+        redirected to scrap page 0, exactly the decode-write
+        discipline — then attend the R queries over each row's full
+        gathered table view under the global causal mask
+        (``chunked_prefill_attention`` with per-row ``start``).
+
+        ``k_scale``/``v_scale`` (n_pages, H) mark an INT8 pool:
+        ``fresh`` (B, R) flags positions whose page holds no committed
+        token yet — they quantize (and stamp the page) with
+        ``anchor_page``'s scale (the page holding the row's last
+        committed token), the multi-position generalization of
+        ``decode_paged``'s predecessor-scale inheritance; positions in
+        partially-committed pages reuse that page's scale."""
+        q, k, v = self._qkv(x)
+        kt = k._data.transpose(0, 2, 1, 3)            # (B, R, H, Dh)
+        vt = v._data.transpose(0, 2, 1, 3)
+        ps = k_pool.shape[2]
+        if k_scale is not None:
+            ks_eff = jnp.where(fresh[..., None],
+                               k_scale[anchor_page][:, None, :],
+                               k_scale[page])         # (B, R, H)
+            vs_eff = jnp.where(fresh[..., None],
+                               v_scale[anchor_page][:, None, :],
+                               v_scale[page])
+            ksp = k_scale.at[page].set(ks_eff)
+            vsp = v_scale.at[page].set(vs_eff)
+            kp = k_pool.at[page, :, offset, :].set(
+                _kv_quantize(kt, ks_eff[..., None]))
+            vp = v_pool.at[page, :, offset, :].set(
+                _kv_quantize(vt, vs_eff[..., None]))
+            kg = _att.gather_pages(kp, table).astype(jnp.float32) \
+                * _att.expand_page_scales(ksp, table, ps)[..., None]
+            vg = _att.gather_pages(vp, table).astype(jnp.float32) \
+                * _att.expand_page_scales(vsp, table, ps)[..., None]
+            attn = NDArray(_att.chunked_prefill_attention(
+                q._data, kg, vg, start), ctx=x.ctx)
+            return self._finish(x, attn), kp, vp, ksp, vsp
+        dt = k_pool.dtype
+        kp = k_pool.at[page, :, offset, :].set(kt.astype(dt))
+        vp = v_pool.at[page, :, offset, :].set(vt.astype(dt))
+        kg = _att.gather_pages(kp, table)
+        vg = _att.gather_pages(vp, table)
+        attn = NDArray(_att.chunked_prefill_attention(
+            q._data, kg.astype(q._data.dtype),
+            vg.astype(q._data.dtype), start), ctx=x.ctx)
+        return self._finish(x, attn), kp, vp, None, None
+
     def peek_paged(self, x, k_pool, v_pool, table, att_len,
                    k_scale=None, v_scale=None):
         """Logits-only attention for the LAST already-cached token of
@@ -359,8 +459,11 @@ class GPTModel(HybridBlock):
         self.ln_f = LayerNorm()
         self.lm_head = Dense(vocab_size, use_bias=False, flatten=False,
                              dtype=dtype)
-        self._gen = None  # (param_nds, prefill_jit, decode_jit)
+        self._gen = None  # (param_nds, prefill_jit, decode_jit, ...)
         self._paged = None  # paged-cache closures (_ensure_paged)
+        #: fused speculative closures, keyed (kind, k, sampled) —
+        #: _ensure_spec; cleared with the other generation closures
+        self._spec_jits = None
         #: weight-only int8 tables (``quantize_params``): one dict per
         #: block, ``{proj_name: (int8 weight, fp32 scales)}`` of
         #: device arrays, passed to the jitted closures as RUNTIME
@@ -403,6 +506,7 @@ class GPTModel(HybridBlock):
         super()._clear_cached_op()
         self._gen = None  # params rebound/cast: jitted closures stale
         self._paged = None
+        self._spec_jits = None
         # NOTE: self._quant survives — it is derived state an explicit
         # quantize_params() refresh owns (the serving engine re-calls
         # it under the swap lock on every weight rollover)
@@ -442,6 +546,7 @@ class GPTModel(HybridBlock):
         if fresh:   # pytree structure changed: closures must retrace
             self._gen = None
             self._paged = None
+            self._spec_jits = None
         return self
 
     def quantized_param_stats(self):
@@ -534,6 +639,120 @@ class GPTModel(HybridBlock):
         ``quantize_params`` invalidates the closures on first arm)."""
         return self._quant if self._quant is not None else []
 
+    def _verify_body(self, blocks, tokens, cache):
+        """The dense k-token verify computation (shared by the
+        ``verify_step`` closure and the fused ``verify_commit``):
+        write R tokens per row at ``[len, len + R)``, attend all R
+        queries under the global causal mask, return (B, R, V) logits
+        with ``len`` UNCHANGED."""
+        _b, r = tokens.shape
+        quant_kv = cache["k"][0].dtype == jnp.int8
+        ln = cache["len"]
+        positions = ln[:, None] + jnp.arange(r, dtype=jnp.int32)
+        pw = self.position_weight.data()._data
+        x = NDArray(self.word_embed(NDArray(tokens))._data
+                    + jnp.take(pw, positions, axis=0))
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        ks, vs = [], []
+        for li, blk in enumerate(blocks):
+            x, kc, vc = blk.verify(
+                x, cache["k"][li], cache["v"][li], ln, ln,
+                k_scale=cache["k_scale"][li] if quant_kv else None,
+                v_scale=cache["v_scale"][li] if quant_kv else None)
+            ks.append(kc)
+            vs.append(vc)
+        logits = self.lm_head(self.ln_f(x))          # (B, R, V)
+        new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln}
+        if quant_kv:
+            new_cache["k_scale"] = cache["k_scale"]
+            new_cache["v_scale"] = cache["v_scale"]
+        return logits._data, new_cache
+
+    def _verify_body_paged(self, blocks, tokens, active, cache):
+        """The paged k-token verify computation (shared by the
+        ``verify_step_paged`` closure and the fused
+        ``verify_commit_paged``): scatter each ACTIVE row's R tokens
+        through its page table (inactive rows redirect to scrap page
+        0), attend the gathered view, return (B, R, V) logits with
+        ``len`` unchanged."""
+        b, r = tokens.shape
+        ps = cache["k"][0].shape[2]
+        s_max = cache["table"].shape[1] * ps
+        quant_kv = cache["k"][0].dtype == jnp.int8
+        ln = cache["len"]
+        live = active > 0
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+        pos = jnp.minimum(
+            ln[:, None] + jnp.arange(r, dtype=jnp.int32), s_max - 1)
+        lpage = pos // ps
+        page = jnp.where(live[:, None], cache["table"][rows, lpage], 0)
+        offset = jnp.where(live[:, None], pos % ps, 0)
+        pw = self.position_weight.data()._data
+        x = NDArray(self.word_embed(NDArray(tokens))._data
+                    + jnp.take(pw, pos, axis=0))
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        if quant_kv:
+            # scale anchoring: a page with no committed token yet
+            # inherits the scale of the page holding the row's last
+            # committed token (decode_paged's predecessor rule,
+            # generalized to a multi-position write)
+            anchor = jnp.where(
+                live,
+                cache["table"][jnp.arange(b),
+                               jnp.maximum(ln - 1, 0) // ps], 0)
+            fresh = (lpage * ps) >= ln[:, None]
+        else:
+            anchor = fresh = None
+        ks, vs, kscs, vscs = [], [], [], []
+        for li, blk in enumerate(blocks):
+            x, kp, vp, ksp, vsp = blk.verify_paged(
+                x, cache["k"][li], cache["v"][li], cache["table"],
+                page, offset, ln,
+                k_scale=cache["k_scale"][li] if quant_kv else None,
+                v_scale=cache["v_scale"][li] if quant_kv else None,
+                fresh=fresh, anchor_page=anchor)
+            ks.append(kp)
+            vs.append(vp)
+            kscs.append(ksp)
+            vscs.append(vsp)
+        logits = self.lm_head(self.ln_f(x))          # (B, R, V)
+        new_cache = {"k": tuple(ks), "v": tuple(vs),
+                     "table": cache["table"], "len": ln}
+        if quant_kv:
+            new_cache["k_scale"] = tuple(kscs)
+            new_cache["v_scale"] = tuple(vscs)
+        return logits._data, new_cache
+
+    def _decode_body(self, blocks, tokens, cache):
+        """One decode step's computation (shared by the ``decode_step``
+        closure and the fused k-step ``propose_tokens`` loop)."""
+        s_max = cache["k"][0].shape[2]
+        quant_kv = cache["k"][0].dtype == jnp.int8
+        ln = cache["len"]
+        pos = jnp.minimum(ln, s_max - 1)   # clamped write position
+        att_len = pos + 1                  # incl. the new token
+        emb = self.word_embed(NDArray(tokens))          # (B, U)
+        pw = self.position_weight.data()._data
+        x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        ks, vs = [], []
+        for li, blk in enumerate(blocks):
+            x, kc, vc = blk.decode(
+                x, cache["k"][li], cache["v"][li], pos, att_len,
+                k_scale=cache["k_scale"][li] if quant_kv else None,
+                v_scale=cache["v_scale"][li] if quant_kv else None)
+            ks.append(kc)
+            vs.append(vc)
+        logits = self.lm_head(self.ln_f(x))             # (B, 1, V)
+        new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln + 1}
+        if quant_kv:   # per-slot scales are fixed at prefill
+            new_cache["k_scale"] = cache["k_scale"]
+            new_cache["v_scale"] = cache["v_scale"]
+        return logits._data[:, 0, :], new_cache
+
     def _ensure_gen(self):
         if self._gen is not None:
             return self._gen
@@ -588,35 +807,35 @@ class GPTModel(HybridBlock):
             return logits._data[:, 0, :], new_cache
 
         def decode_raw(tokens, cache):
-            s_max = cache["k"][0].shape[2]
-            quant_kv = cache["k"][0].dtype == jnp.int8
-            ln = cache["len"]
-            pos = jnp.minimum(ln, s_max - 1)   # clamped write position
-            att_len = pos + 1                  # incl. the new token
-            emb = self.word_embed(NDArray(tokens))          # (B, U)
-            pw = self.position_weight.data()._data
-            x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
-            if self.embed_drop is not None:
-                x = self.embed_drop(x)
-            ks, vs = [], []
-            for li, blk in enumerate(blocks):
-                x, kc, vc = blk.decode(
-                    x, cache["k"][li], cache["v"][li], pos, att_len,
-                    k_scale=cache["k_scale"][li] if quant_kv else None,
-                    v_scale=cache["v_scale"][li] if quant_kv else None)
-                ks.append(kc)
-                vs.append(vc)
-            logits = self.lm_head(self.ln_f(x))             # (B, 1, V)
-            new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln + 1}
-            if quant_kv:   # per-slot scales are fixed at prefill
-                new_cache["k_scale"] = cache["k_scale"]
-                new_cache["v_scale"] = cache["v_scale"]
-            return logits._data[:, 0, :], new_cache
+            return self._decode_body(blocks, tokens, cache)
+
+        def verify_raw(tokens, cache):
+            """Speculative verify: write the R tokens of every row at
+            its contiguous positions ``[len, len + R)`` and return the
+            logits at ALL R positions (B, R, V) in one fixed-shape
+            program. ``len`` is NOT advanced — the engine commits the
+            accepted prefix afterwards via ``advance_raw``, which is
+            what clips the rejected tail out of the cache (positions
+            past ``len`` are never attended and the next verify
+            overwrites them). The caller keeps ``len + R <= S_max``
+            (the engine's spec_k capacity margin)."""
+            return self._verify_body(blocks, tokens, cache)
+
+        def advance_raw(delta, cache):
+            """Commit point: bump each row's valid length by ``delta``
+            (the engine's accepted-token count; 0 leaves a row put).
+            Everything in the cache past the new ``len`` is dead —
+            the speculative rollback IS this counter."""
+            new = dict(cache)
+            new["len"] = cache["len"] + delta
+            return new
 
         self._gen = (
             param_nds,
             jax.jit(_bind(prefill_raw), donate_argnums=(6,)),
             jax.jit(_bind(decode_raw), donate_argnums=(4,)),
+            jax.jit(_bind(verify_raw), donate_argnums=(4,)),
+            jax.jit(_bind(advance_raw), donate_argnums=(4,)),
         )
         return self._gen
 
@@ -628,7 +847,7 @@ class GPTModel(HybridBlock):
         ``(B_req, vocab)`` logits of each row's last valid token and
         the updated cache (the passed cache is donated; always use the
         returned one)."""
-        param_nds, prefill_jit, _ = self._ensure_gen()
+        param_nds, prefill_jit = self._ensure_gen()[:2]
         tokens = _as_i32(tokens)
         if tokens.ndim != 2:
             raise ValueError(f"prefill tokens must be (batch, seq), got "
@@ -655,9 +874,203 @@ class GPTModel(HybridBlock):
         (input cache donated). Rows whose slot is free/unprefilled
         produce garbage logits that callers simply ignore — the POINT
         is that the program shape never changes with occupancy."""
-        param_nds, _, decode_jit = self._ensure_gen()
+        param_nds, _, decode_jit = self._ensure_gen()[:3]
         return decode_jit(next_key(), [nd._data for nd in param_nds],
                           self._quant_arg(), _as_i32(tokens), cache)
+
+    def verify_step(self, tokens, cache):
+        """Speculative VERIFY over every cache slot: insert the K/V of
+        ``tokens`` (B, R) int32 — per row ``[last, d_1 .. d_{R-1}]``,
+        the committed tail token plus the draft's R-1 proposals — at
+        positions ``[len, len + R)`` and return the raw logits at all
+        R positions ``(B, R, V)`` plus the updated cache (donated).
+        ``len`` is unchanged: commit the accepted prefix with
+        :meth:`advance_len`, which also rolls the rejected tail back
+        (a rejected token lives above the ``len`` waterline, is never
+        attended, and the next verify overwrites it). Rows must
+        satisfy ``len + R <=`` cache capacity — the serving engine
+        reserves a ``spec_k`` scratch margin for exactly this."""
+        gen = self._ensure_gen()
+        param_nds, verify_jit = gen[0], gen[3]
+        tokens = _as_i32(tokens)
+        if tokens.ndim != 2:
+            raise ValueError(f"verify tokens must be (batch, R), got "
+                             f"shape {tokens.shape}")
+        return verify_jit(next_key(), [nd._data for nd in param_nds],
+                          self._quant_arg(), tokens, cache)
+
+    def advance_len(self, delta, cache):
+        """Advance each row's valid length by ``delta`` (B,) int32 —
+        the speculative COMMIT/ROLLBACK primitive (0 leaves a row
+        put; the draft model's cache is rolled back to the accept
+        point with a negative delta). Cache donated."""
+        gen = self._ensure_gen()
+        param_nds, advance_jit = gen[0], gen[4]
+        return advance_jit(next_key(), [nd._data for nd in param_nds],
+                           self._quant_arg(), _as_i32(delta), cache)
+
+    # -- fused speculative fast path ------------------------------------
+    def _ensure_spec(self, kind, k, sampled):
+        """Jitted SPECULATIVE fast-path closures, cached per ``(kind,
+        k, sampled)``: the whole draft/verify half-iteration runs as
+        ONE program each, because at serving model sizes the per-call
+        dispatch overhead of k separate draft steps plus separate
+        sample/accept/advance calls costs more than the math itself.
+
+        - ``propose``: k chained decode steps of THIS (draft) model,
+          each feeding its sampled/greedy token to the next, inside
+          one trace.
+        - ``verify_commit`` / ``verify_commit_paged``: build the
+          ``[last, d_1 .. d_k]`` rows, run the k-token verify, apply
+          the accept rule (greedy or the residual-distribution rule —
+          ops/sampling.py), and advance ``len`` by each active row's
+          commit count, all in one program. Rows the engine will
+          evict (budget/eos/capacity clip) keep the full-commit
+          ``len`` — they are dead rows whose counter nobody reads.
+        """
+        if self._spec_jits is None:
+            self._spec_jits = {}
+        key_ = (kind, int(k), bool(sampled))
+        hit = self._spec_jits.get(key_)
+        if hit is not None:
+            return hit
+        param_nds = self._gen_params()
+        blocks = self._blocks()
+        _bind = self._make_bind(param_nds, blocks)
+        k = int(k)
+
+        if kind == "propose":
+            if sampled:
+                def raw(tokens, keys, temps, tks, tps, cache):
+                    cur = tokens
+                    dts, qs = [], []
+                    for _ in range(k):
+                        logits, cache = self._decode_body(
+                            blocks, cur, cache)
+                        cur, q, keys = _smp.sample_with_probs(
+                            keys, logits, temps, tks, tps)
+                        dts.append(cur)
+                        qs.append(q)
+                    return (jnp.stack(dts, axis=1),
+                            jnp.stack(qs, axis=1), keys, cache)
+                jitted = jax.jit(_bind(raw), donate_argnums=(8,))
+            else:
+                def raw(tokens, cache):
+                    cur = tokens
+                    dts = []
+                    for _ in range(k):
+                        logits, cache = self._decode_body(
+                            blocks, cur, cache)
+                        cur = jnp.argmax(logits, axis=-1) \
+                            .astype(jnp.int32)
+                        dts.append(cur)
+                    return jnp.stack(dts, axis=1), cache
+                jitted = jax.jit(_bind(raw), donate_argnums=(4,))
+        elif kind in ("verify_commit", "verify_commit_paged"):
+            paged = kind == "verify_commit_paged"
+
+            def _verify(vt, active, cache):
+                if paged:
+                    return self._verify_body_paged(blocks, vt, active,
+                                                   cache)
+                return self._verify_body(blocks, vt, cache)
+
+            if sampled:
+                def raw(last, d_toks, q, keys, temps, tks, tps,
+                        active, cache):
+                    vt = jnp.concatenate([last[:, None], d_toks],
+                                         axis=1)
+                    logits, cache = _verify(vt, active, cache)
+                    commit, n_commit, keys = _smp.speculative_accept(
+                        keys, logits, d_toks, q, temps, tks, tps)
+                    new = dict(cache)
+                    new["len"] = cache["len"] \
+                        + n_commit * (active > 0)
+                    return commit, n_commit, keys, new
+                jitted = jax.jit(_bind(raw), donate_argnums=(11,))
+            else:
+                def raw(last, d_toks, active, cache):
+                    vt = jnp.concatenate([last[:, None], d_toks],
+                                         axis=1)
+                    logits, cache = _verify(vt, active, cache)
+                    commit, n_commit = _smp.greedy_accept(logits,
+                                                          d_toks)
+                    new = dict(cache)
+                    new["len"] = cache["len"] \
+                        + n_commit * (active > 0)
+                    return commit, n_commit, new
+                jitted = jax.jit(_bind(raw), donate_argnums=(6,))
+        else:
+            raise ValueError(f"unknown speculative closure {kind!r}")
+        entry = (param_nds, jitted)
+        self._spec_jits[key_] = entry
+        return entry
+
+    def _spec_call(self, kind, k, sampled, *args):
+        param_nds, jitted = self._ensure_spec(kind, k, sampled)
+        return jitted(next_key(), [nd._data for nd in param_nds],
+                      self._quant_arg(), *args)
+
+    def propose_tokens(self, tokens, cache, k, keys=None, temps=None,
+                       top_ks=None, top_ps=None):
+        """DRAFT side of one speculative iteration: k chained decode
+        steps in ONE jitted program, each feeding its token to the
+        next. Greedy (no ``keys``): returns ``(draft_tokens (B, k)
+        int32, cache)``. Sampled (explicit per-row ``keys`` + knob
+        vectors): returns ``(draft_tokens, warped_probs (B, k, V),
+        advanced keys, cache)`` — exactly what the accept rule needs.
+        ``len`` advances by k on every row; the engine rolls back to
+        the accept point with :meth:`advance_len`. Cache donated."""
+        tokens = _as_i32(tokens)
+        if keys is None:
+            return self._spec_call("propose", k, False, tokens, cache)
+        return self._spec_call(
+            "propose", k, True, tokens, jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32), cache)
+
+    def verify_commit(self, last, d_toks, active, cache, q=None,
+                      keys=None, temps=None, top_ks=None,
+                      top_ps=None):
+        """TARGET side of one speculative iteration, fused: verify all
+        ``k + 1`` positions (``verify_step``'s program), apply the
+        accept rule, and advance every active row's ``len`` by its
+        commit count — one dispatch. Greedy (no ``q``/``keys``):
+        returns ``(commit (B, k+1), n_commit (B,), cache)``; sampled:
+        ``(commit, n_commit, advanced keys, cache)``. Cache donated;
+        rows the engine evicts mid-commit keep the full-commit
+        ``len`` (dead rows)."""
+        last = _as_i32(last)
+        k = int(d_toks.shape[1])
+        if q is None:
+            return self._spec_call("verify_commit", k, False, last,
+                                   _as_i32(d_toks), _as_i32(active),
+                                   cache)
+        return self._spec_call(
+            "verify_commit", k, True, last, _as_i32(d_toks), q,
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32), _as_i32(active), cache)
+
+    def verify_commit_paged(self, last, d_toks, active, cache, q=None,
+                            keys=None, temps=None, top_ks=None,
+                            top_ps=None):
+        """Paged-cache :meth:`verify_commit` (the verify runs
+        ``verify_step_paged``'s program; accept/advance identical)."""
+        last = _as_i32(last)
+        k = int(d_toks.shape[1])
+        if q is None:
+            return self._spec_call("verify_commit_paged", k, False,
+                                   last, _as_i32(d_toks),
+                                   _as_i32(active), cache)
+        return self._spec_call(
+            "verify_commit_paged", k, True, last, _as_i32(d_toks), q,
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32), _as_i32(active), cache)
 
     # -- paged-cache generation API -------------------------------------
     def init_paged_cache(self, batch_size, n_pages, page_size,
@@ -850,6 +1263,22 @@ class GPTModel(HybridBlock):
                 new_cache["v_scale"] = tuple(vscs)
             return logits._data[:, 0, :], new_cache
 
+        def spec_verify_raw(tokens, active, cache):
+            """Speculative verify against the paged pool: write each
+            ACTIVE row's R tokens at positions ``[len, len + R)``
+            through its page table (inactive rows' — and any position
+            past a slot's reservation, whose table entry already
+            points at scrap — writes land in scrap page 0) and return
+            logits at all R positions. ``len`` unchanged; the engine
+            commits via ``advance_raw``."""
+            return self._verify_body_paged(blocks, tokens, active,
+                                           cache)
+
+        def advance_raw(delta, cache):
+            new = dict(cache)
+            new["len"] = cache["len"] + delta
+            return new
+
         def peek_raw(token, slot, cache):
             """Logits of the last CACHED token of ``slot`` (position
             len-1, K/V already in the pool) — zero prefill compute, no
@@ -896,6 +1325,9 @@ class GPTModel(HybridBlock):
             "peek": jax.jit(_bind(peek_raw)),
             "bind": jax.jit(_bind(bind_raw), donate_argnums=(6,)),
             "copy": jax.jit(_bind(copy_raw), donate_argnums=(5,)),
+            "verify": jax.jit(_bind(spec_verify_raw),
+                              donate_argnums=(5,)),
+            "advance": jax.jit(_bind(advance_raw), donate_argnums=(4,)),
         }
         return self._paged
 
@@ -980,6 +1412,28 @@ class GPTModel(HybridBlock):
         divergence page. Cache donated."""
         return self._paged_call("copy", jnp.int32(src),
                                 jnp.int32(dst), cache)
+
+    def verify_step_paged(self, tokens, active, cache):
+        """Speculative VERIFY for every slot of a PAGED cache: write
+        each active row's ``tokens`` (B, R) int32 — ``[last, d_1 ..
+        d_{R-1}]`` — at positions ``[len, len + R)`` through its page
+        table and return the raw logits at all R positions
+        ``(B, R, V)`` plus the updated cache (donated). Inactive rows
+        (``active == 0``) and positions past a slot's page reservation
+        write into the reserved scrap page; ``len`` is unchanged —
+        commit the accepted prefix with :meth:`advance_len_paged`."""
+        tokens = _as_i32(tokens)
+        if tokens.ndim != 2:
+            raise ValueError(f"verify tokens must be (batch, R), got "
+                             f"shape {tokens.shape}")
+        return self._paged_call("verify", tokens, _as_i32(active),
+                                cache)
+
+    def advance_len_paged(self, delta, cache):
+        """Advance each paged row's valid length by ``delta`` (B,)
+        int32 — the paged commit/rollback counterpart of
+        :meth:`advance_len`. Cache donated."""
+        return self._paged_call("advance", _as_i32(delta), cache)
 
 
 def gpt_small(vocab_size=1000, units=64, num_layers=2, num_heads=4,

@@ -71,6 +71,18 @@ def set_state(key, trace_counter: int = 0):
     _state.trace_counter = int(trace_counter)
 
 
+def request_key(seed_value: int):
+    """Raw PRNG key data for an explicit PER-REQUEST seed: host numpy
+    ``(2,)`` uint32, the per-slot sampling-key format the serving
+    engine threads through its jitted sampling/verify programs
+    (serving/generate.py ``submit(seed=...)``). Independent of the
+    global generator — two requests with the same seed draw the same
+    stream no matter what else the process sampled."""
+    import numpy as onp
+    return onp.asarray(jax.random.PRNGKey(int(seed_value)),
+                       dtype=onp.uint32)
+
+
 def next_key():
     """A fresh PRNG key; trace-aware (see module docstring)."""
     if _state.trace_key is not None:

@@ -8,7 +8,12 @@ slot-based continuous batching over one fixed-shape KV-cache decode
 step (generate.py); with ``paged=True`` the cache is a PAGED pool
 with prefix reuse (shared prompts prefilled once, refcounted,
 copy-on-write) and chunked prefill (paging.py owns the host-side
-page/prefix bookkeeping). `Router` fronts N engine replicas as ONE
+page/prefix bookkeeping); with ``draft_model=`` it decodes
+SPECULATIVELY (a small draft proposes k tokens, the target verifies
+k+1 positions in one program — greedy output token-identical,
+stochastic distribution-preserving), and ``submit(temperature=,
+top_k=, top_p=, seed=)`` gives every request its own sampling knobs
+and explicit PRNG key. `Router` fronts N engine replicas as ONE
 fault-tolerant fleet: join-shortest-queue balancing, per-replica
 health/circuit-breaker state, cross-replica retry, per-tenant quotas,
 priority load shedding, and rolling zero-downtime weight rollover

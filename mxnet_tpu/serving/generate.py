@@ -59,6 +59,22 @@ of stalling every in-flight request for a whole monolithic prefill).
 Same fixed-shape/zero-steady-state-compile discipline; greedy output
 stays token-identical to the dense engine.
 
+``draft_model=`` turns on SPECULATIVE DECODING (docs/SERVING.md
+"Speculative decoding & sampling"): a second, smaller decoder
+proposes ``spec_k`` tokens per slot per iteration and the target
+verifies all ``spec_k + 1`` positions in one fixed-shape program,
+committing 1..``spec_k + 1`` tokens — the per-SLOT throughput
+multiplier that composes with continuous batching's cross-slot one.
+Greedy output stays TOKEN-IDENTICAL to the non-speculative engine;
+stochastic requests use the residual-distribution accept rule, which
+preserves the target distribution exactly. ``submit(temperature=,
+top_k=, top_p=, seed=)`` is a first-class per-request feature on
+every engine: knobs ride per-slot runtime vectors through one
+fixed-shape sampling program (ops/sampling.py), keys are explicit
+and split per slot per step inside the trace, and a seeded stream
+is bitwise-reproducible whenever the admission schedule is replayed
+— across engine restarts included.
+
 Telemetry (docs/OBSERVABILITY.md): counters
 ``serving.generate.{requests,tokens,prefills,evictions,rejected_full,
 rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
@@ -67,12 +83,16 @@ rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
 ``serving.generate.pages.{allocated,shared,cow_copies,freed}`` /
 ``pages.free`` / ``prefix_hits`` / ``prefill_chunks`` and the
 ``prefill_chunks_per_iter`` gauge whose peak proves the one-chunk
-decode-stall bound.
+decode-stall bound; speculation adds
+``serving.generate.spec.{proposed,accepted,rejected}`` counters and
+the ``spec.accept_rate`` / ``spec.tokens_per_step`` gauges; sampling
+adds ``serving.generate.sampling.requests``.
 """
 from __future__ import annotations
 
 import collections
 import contextlib
+import os
 import queue
 import threading
 import time
@@ -81,6 +101,7 @@ import weakref
 import numpy as onp
 
 from .. import telemetry
+from ..random_state import request_key
 from .._bounded_worker import BoundedQueueWorker
 from ..bucketing import BucketingPolicy, as_policy
 from . import paging
@@ -135,17 +156,31 @@ class GenerationStream:
 
     # -- producer side (generator thread) ------------------------------
     def _emit(self, token: int):
+        # one protocol, one implementation: the finished-stream guard
+        # (a stale step racing an injected crash must not append),
+        # first-token stamp, wakeup and watcher fan-out all live in
+        # _emit_many
+        self._emit_many((token,))
+
+    def _emit_many(self, tokens):
+        """Append a SEQUENCE of tokens under one lock acquisition and
+        one wakeup — the speculative-commit fast path: a verify step
+        commits up to k+1 tokens at once, and per-token notify_all
+        with a live ``result()`` waiter costs a GIL bounce each (the
+        dominant per-iteration cost at interactive concurrency)."""
+        if not tokens:
+            return
         with self._cv:
             if self._reason is not None or self._exc is not None:
-                return  # a finished stream takes no more tokens (a
-                # stale step racing an injected crash must not append)
+                return  # finished streams take no more tokens
             if not self._tokens:
                 self.first_token_at = time.perf_counter()
-            tok = int(token)
-            self._tokens.append(tok)
+            toks = [int(t) for t in tokens]
+            self._tokens.extend(toks)
             self._cv.notify_all()
             for on_token, _fin in self._watchers:
-                on_token(tok)
+                for tok in toks:
+                    on_token(tok)
 
     def _finish(self, reason=None, exc=None):
         with self._cv:
@@ -219,10 +254,12 @@ class GenerationStream:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_submit",
-                 "t_enq", "deadline")
+                 "t_enq", "deadline", "temperature", "top_k", "top_p",
+                 "key")
 
     def __init__(self, prompt, max_new, eos_id, stream, t_submit,
-                 t_enq, deadline):
+                 t_enq, deadline, temperature=0.0, top_k=0, top_p=1.0,
+                 key=None):
         self.prompt = prompt
         self.max_new = max_new
         self.eos_id = eos_id
@@ -230,6 +267,10 @@ class _GenRequest:
         self.t_submit = t_submit
         self.t_enq = t_enq     # monotonic enqueue stamp (queue wait)
         self.deadline = deadline
+        self.temperature = temperature   # 0.0 = greedy
+        self.top_k = top_k               # 0 = off
+        self.top_p = top_p               # 1.0 = off
+        self.key = key                   # (2,) uint32 PRNG key data
 
 
 class _Slot:
@@ -256,11 +297,15 @@ class _PagedSlot:
 
     __slots__ = ("stream", "last", "left", "eos_id", "deadline", "n_ctx",
                  "state", "chunks", "row", "page_refs", "cow_pending",
-                 "prompt", "seq", "t_submit")
+                 "prompt", "seq", "t_submit", "draft_prompt", "key")
 
     def __init__(self, stream, left, eos_id, deadline, n_ctx, row,
                  page_refs, prompt, seq, t_submit):
         self.stream = stream
+        self.draft_prompt = None   # kept in speculative mode for the
+        # draft's dense prefill when the slot enters decode
+        self.key = None   # stochastic requests: the PRNG key, parked
+        # here until decode entry (see _arm_sampling)
         self.last = None
         self.left = left
         self.eos_id = eos_id
@@ -435,6 +480,30 @@ class GenerationEngine:
         page scales paged — so a paged pool holds ~4x the pages in
         the same HBM). Alias for ``cache_dtype`` with the quantized
         layout; attention dequantizes inside the decode kernels.
+    draft_model : optional
+        A second, SMALLER decoder from the same model family (same
+        vocabulary) that turns on draft-model SPECULATIVE DECODING:
+        each engine iteration the draft proposes ``spec_k`` tokens per
+        decoding slot and the target model verifies all ``spec_k + 1``
+        positions in one fixed-shape program, committing the accepted
+        prefix plus one target token — between 1 and ``spec_k + 1``
+        tokens per slot per iteration instead of exactly one. Greedy
+        output stays TOKEN-IDENTICAL to the non-speculative engine
+        (the accept rule only ever commits the target's own greedy
+        tokens); stochastic requests use the speculative-sampling
+        residual rule, which preserves the target distribution
+        exactly. The draft keeps its own dense fp32 cache and is
+        rolled back to the accept point every iteration.
+    spec_k : int
+        Draft tokens proposed per slot per iteration (default 4).
+        Each cache row reserves a ``spec_k`` scratch margin at the
+        top (usable capacity is ``max_length - spec_k``) so a verify
+        write never clamps; rejected entries die above the ``len``
+        waterline.
+    speculative : bool, optional
+        Defaults to ``draft_model is not None``. Passing
+        ``speculative=True`` without a draft raises — self-speculation
+        is not implemented.
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
@@ -444,8 +513,22 @@ class GenerationEngine:
                  paged: bool = False, page_size: int = 16,
                  n_pages=None, prefill_chunk=None,
                  prefix_cache: bool = True, quantize=None,
-                 kv_dtype=None):
+                 kv_dtype=None, draft_model=None, spec_k: int = 4,
+                 speculative=None):
         self.paged = bool(paged)
+        if speculative is None:
+            speculative = draft_model is not None
+        self.speculative = bool(speculative)
+        if self.speculative and draft_model is None:
+            raise ValueError(
+                "speculative=True needs a draft_model (a second, "
+                "smaller decoder from the same model_zoo family)")
+        if draft_model is not None and not self.speculative:
+            raise ValueError(
+                "draft_model without speculative decoding is inert; "
+                "drop it or pass speculative=True")
+        self.draft = draft_model
+        self.spec_k = int(spec_k)
         if quantize not in (None, "int8_weights"):
             raise ValueError(
                 f"unsupported quantize={quantize!r} (only "
@@ -480,12 +563,32 @@ class GenerationEngine:
                "peek_logits_paged", "bind_slot_paged",
                "copy_page_paged") if self.paged \
             else ("init_cache", "prefill", "decode_step")
+        if self.speculative:
+            api += (("verify_commit_paged",)
+                    if self.paged else ("verify_commit",))
         for attr in api:
             if not callable(getattr(model, attr, None)):
                 raise TypeError(
                     f"GenerationEngine needs a decoder with the "
                     f"explicit-cache generation API (missing "
                     f"{attr!r}); see gluon.model_zoo.gpt.GPTModel")
+        if self.speculative:
+            if self.spec_k < 1:
+                raise ValueError("spec_k must be >= 1")
+            for attr in ("init_cache", "prefill", "propose_tokens",
+                         "advance_len"):
+                if not callable(getattr(draft_model, attr, None)):
+                    raise TypeError(
+                        f"draft_model needs the dense explicit-cache "
+                        f"generation API (missing {attr!r}); see "
+                        f"gluon.model_zoo.gpt.GPTModel")
+            tv = getattr(model, "_vocab_size", None)
+            dv = getattr(draft_model, "_vocab_size", None)
+            if tv is not None and dv is not None and tv != dv:
+                raise TypeError(
+                    f"draft vocab {dv} != target vocab {tv}: "
+                    f"speculative decoding needs one tokenizer — the "
+                    f"draft proposes TARGET token ids")
         if int(max_slots) < 1:
             raise ValueError("max_slots must be >= 1")
         if int(max_new_tokens) < 1:
@@ -498,6 +601,18 @@ class GenerationEngine:
         self.timeout_ms = timeout_ms
         self._s_max = int(max_length) if max_length is not None \
             else int(model.max_length)
+        #: usable sequence capacity. A speculative engine reserves a
+        #: ``spec_k`` scratch margin at the top of every cache row: a
+        #: verify step writes up to ``len + spec_k`` K/V entries before
+        #: knowing how many will commit, and that write must never
+        #: clamp/wrap — rejected entries sit above the ``len``
+        #: waterline (never attended, overwritten next step) instead
+        self._s_cap = self._s_max - self.spec_k if self.speculative \
+            else self._s_max
+        if self._s_cap < 2:
+            raise ValueError(
+                f"max_length {self._s_max} leaves no usable capacity "
+                f"after the spec_k={self.spec_k} verify margin")
         policy = as_policy(prefill_bucketing)
         self._cache_dtype = cache_dtype
         if self.paged:
@@ -548,6 +663,31 @@ class GenerationEngine:
             self.policy = policy.clamped(self._s_max)
             self._cache = model.init_cache(self.max_slots, self._s_max,
                                            dtype=cache_dtype)
+        # COMMIT the cache to its device up front: a fresh
+        # ``init_cache`` holds uncommitted arrays, a jitted step's
+        # outputs are committed — and the pjit C++ fast path caches
+        # executables PER INPUT-SHARDING SIGNATURE, so the first
+        # admission after the first step would silently recompile
+        # every prefill bucket a second time (~1s stalls that no
+        # trace counter sees; found by driving the speculative engine
+        # under JAX_LOG_COMPILES)
+        self._cache = self._commit(self._cache)
+        #: the draft model's OWN cache: dense even under a paged
+        #: target (the draft is small — its whole cache costs a
+        #: fraction of one target layer's pool) and fp32 (its logits
+        #: only steer proposals; the target's verify is what commits)
+        self._draft_cache = None if not self.speculative \
+            else self._commit(
+                draft_model.init_cache(self.max_slots, self._s_max))
+        #: per-slot sampling state, threaded as runtime (B,) vectors
+        #: through the fixed-shape sampling/verify programs — a mixed
+        #: greedy/stochastic batch runs ONE compiled program
+        self._temps = onp.zeros((self.max_slots,), "f4")
+        self._topks = onp.zeros((self.max_slots,), "i4")
+        self._topps = onp.ones((self.max_slots,), "f4")
+        self._keys = onp.zeros((self.max_slots, 2), "u4")
+        self._n_sampling = 0   # active slots with temperature > 0
+        self._samplers = None  # jitted ops/sampling.py programs (lazy)
         self._kv_int8 = "k_scale" in self._cache
         if self._kv_int8:   # quant.* telemetry only for quantized
             # engines — an fp32 fleet must not populate the namespace
@@ -591,6 +731,71 @@ class GenerationEngine:
             parts.append("int8_kv")
         return "+".join(parts) if parts else "fp32"
 
+    @property
+    def speculation(self) -> str:
+        """The replica's speculative-decoding configuration — ``"off"``
+        or ``"k=<spec_k>:draft=<type>:<layers>L-<units>u"``. Router
+        fleets must be speculation-homogeneous (the precision-
+        homogeneity rule's sibling): a retried STOCHASTIC request
+        replays its seed, and its committed stream depends on the
+        draft/spec_k key-consumption schedule — mixing configurations
+        would make the retry's tokens depend on which replica caught
+        it."""
+        if not self.speculative:
+            return "off"
+        d = self.draft
+        return (f"k={self.spec_k}:draft={type(d).__name__}:"
+                f"{getattr(d, '_num_layers', '?')}L-"
+                f"{getattr(d, '_units', '?')}u")
+
+    def _ensure_samplers(self):
+        """The jitted ops/sampling.py programs (lazy — importing jax
+        at engine construction is fine, but tracing belongs under
+        ``_gen_lock`` at warmup/first use). Each actual trace counts
+        ``ops.sampling.trace`` — the sampling analog of
+        ``model.gpt.trace`` for the zero-steady-state-compile gates."""
+        if self._samplers is None:
+            import jax
+
+            from ..ops import sampling as _smp
+
+            def counted(fn):
+                def wrapper(*args):
+                    telemetry.counter("ops.sampling.trace")
+                    return fn(*args)
+                return wrapper
+
+            self._samplers = {
+                "sample": jax.jit(counted(_smp.sample_tokens)),
+            }
+        return self._samplers
+
+    def _warm_samplers(self, vocab: int):
+        """Compile every engine-level sampler shape the steady state
+        can hit: the (1, V) first-token pick and the (B, V)
+        decode-step pick (the speculative draft/accept math lives
+        inside the model's fused closures — ``_warmup_spec``)."""
+        smp = self._ensure_samplers()
+        b = self.max_slots
+        smp["sample"](onp.zeros((1, 2), "u4"),
+                      onp.zeros((1, vocab), "f4"),
+                      onp.zeros((1,), "f4"),
+                      onp.zeros((1,), "i4"), onp.ones((1,), "f4"))
+        smp["sample"](onp.zeros((b, 2), "u4"),
+                      onp.zeros((b, vocab), "f4"),
+                      onp.zeros((b,), "f4"),
+                      onp.zeros((b,), "i4"), onp.ones((b,), "f4"))
+
+    @staticmethod
+    def _commit(cache):
+        """Pin a cache pytree to its device (see the constructor
+        note: committed and uncommitted inputs compile SEPARATE pjit
+        executables, and caches cross that line after their first
+        donated step). The target device must be EXPLICIT — a bare
+        ``device_put`` preserves the uncommitted state."""
+        import jax
+        return jax.device_put(cache, jax.devices()[0])
+
     # -- lifecycle -----------------------------------------------------
     @contextlib.contextmanager
     def _gen_exclusive(self):
@@ -629,15 +834,45 @@ class GenerationEngine:
             if self.paged:
                 self._warmup_paged()
                 return self
-            cache = self.model.init_cache(self.max_slots, self._s_max,
-                                          dtype=self._cache_dtype)
-            for sb in self.policy.sizes(self._s_max - 1):
+            cache = self._commit(self.model.init_cache(
+                self.max_slots, self._s_max, dtype=self._cache_dtype))
+            for sb in self.policy.sizes(self._s_cap - 1):
                 toks = onp.zeros((1, sb), "i4")
                 _, cache = self.model.prefill(toks, [sb], cache,
                                               slots=[0])
-            self.model.decode_step(
+            lg, cache = self.model.decode_step(
                 onp.zeros((self.max_slots,), "i4"), cache)
+            self._warm_samplers(int(lg.shape[-1]))
+            if self.speculative:
+                self._warmup_spec(cache)
         return self
+
+    def _warmup_spec(self, cache):
+        """Compile the speculative steady state against throwaway
+        caches: the draft's prefill buckets, the fused k-step propose
+        (greedy AND sampled variants — traffic can flip between them
+        as stochastic requests come and go), the fused
+        verify+accept+advance (both variants), and the draft-rollback
+        advance_len."""
+        b, k = self.max_slots, self.spec_k
+        zb = onp.zeros((b,), "i4")
+        ones = onp.ones((b,), "i4")
+        keys = onp.zeros((b, 2), "u4")
+        tf = onp.zeros((b,), "f4")
+        pf = onp.ones((b,), "f4")
+        dcache = self._commit(self.draft.init_cache(b, self._s_max))
+        for sb in self.policy.sizes(self._s_cap - 1):
+            _, dcache = self.draft.prefill(
+                onp.zeros((1, sb), "i4"), [sb], dcache, slots=[0])
+        dt, dcache = self.draft.propose_tokens(zb, dcache, k)
+        dt, q, _, dcache = self.draft.propose_tokens(
+            zb, dcache, k, keys=keys, temps=tf, top_ks=zb, top_ps=pf)
+        dcache = self.draft.advance_len(zb, dcache)
+        vc = self.model.verify_commit_paged if self.paged \
+            else self.model.verify_commit
+        _, _, cache = vc(zb, dt, ones, cache)
+        _, _, _, cache = vc(zb, dt, ones, cache, q=q, keys=keys,
+                            temps=tf, top_ks=zb, top_ps=pf)
 
     def _warmup_paged(self):
         """Compile the paged steady state against a throwaway cache:
@@ -647,9 +882,9 @@ class GenerationEngine:
         (prefix-hit) path, and the table-bind / page-copy (COW)
         helpers. Physical page ids are DATA, not shape — id choice
         here is arbitrary."""
-        cache = self.model.init_paged_cache(
+        cache = self._commit(self.model.init_paged_cache(
             self.max_slots, self._pool.n_pages, self._ps, self._s_max,
-            dtype=self._cache_dtype)
+            dtype=self._cache_dtype))
         row = onp.ones((self._p_max,), "i4")
         for sb in self.policy.sizes(self._chunk):
             if sb > self._chunk:
@@ -660,12 +895,15 @@ class GenerationEngine:
         for w in range(self._ps, self._chunk + 1, self._ps):
             _, cache = self.model.prefill_paged(
                 onp.zeros((1, w), "i4"), w, 0, row, cache, start=0)
-        _, cache = self.model.decode_step_paged(
+        lg, cache = self.model.decode_step_paged(
             onp.zeros((self.max_slots,), "i4"),
             onp.ones((self.max_slots,), "i4"), cache)
         self.model.peek_logits_paged(0, 0, cache)
         cache = self.model.bind_slot_paged(0, row, 1, cache)
-        self.model.copy_page_paged(1, 1, cache)
+        cache = self.model.copy_page_paged(1, 1, cache)
+        self._warm_samplers(int(lg.shape[-1]))
+        if self.speculative:
+            self._warmup_spec(cache)
 
     def load_weights(self, source, strict: bool = True):
         """Zero-downtime weight rollover: swap the model's parameter
@@ -775,16 +1013,18 @@ class GenerationEngine:
         if not onp.issubdtype(prompt.dtype, onp.integer):
             raise ValueError(f"prompt must hold token ids, got dtype "
                              f"{prompt.dtype}")
-        if prompt.size > self._s_max - 1:
+        if prompt.size > self._s_cap - 1:
+            margin = "" if not self.speculative else \
+                f" minus the spec_k={self.spec_k} verify margin"
             raise ValueError(
                 f"prompt length {prompt.size} leaves no room to "
-                f"generate (cache capacity {self._s_max})")
+                f"generate (cache capacity {self._s_max}{margin})")
         max_new = self.max_new_tokens if max_new_tokens is None \
             else int(max_new_tokens)
         if max_new < 1:
             raise ValueError("max_new_tokens must be >= 1")
         if self.paged:
-            cap = min(int(prompt.size) + max_new, self._s_max)
+            cap = min(int(prompt.size) + max_new, self._s_cap)
             need = -(-cap // self._ps)
             if need > self._pool.n_pages - 1:
                 raise ValueError(
@@ -793,12 +1033,45 @@ class GenerationEngine:
         eos = self.eos_id if eos_id is None else eos_id
         return prompt.astype("i4"), max_new, eos
 
+    @staticmethod
+    def _validate_sampling(temperature, top_k, top_p, seed):
+        """Normalize/validate the per-request sampling knobs. Returns
+        ``(temperature, top_k, top_p, seed)`` with the greedy/off
+        defaults filled in (``0.0``, ``0``, ``1.0``, ``None``). Shared
+        with the Router's pre-admission validation."""
+        t = 0.0 if temperature is None else float(temperature)
+        if not t >= 0.0:   # also rejects NaN
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{temperature!r}")
+        k = 0 if top_k is None else int(top_k)
+        if k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got "
+                             f"{top_k!r}")
+        p = 1.0 if top_p is None else float(top_p)
+        if not 0.0 < p <= 1.0:
+            raise ValueError(
+                f"top_p must be in (0, 1] (1 = off), got {top_p!r}")
+        if seed is not None:
+            seed = int(seed)
+        return t, k, p, seed
+
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
-               timeout_ms=None) -> GenerationStream:
+               timeout_ms=None, temperature=None, top_k=None,
+               top_p=None, seed=None) -> GenerationStream:
         """Queue one prompt; returns a :class:`GenerationStream`.
         Raises :class:`EngineClosedError` / :class:`QueueFullError` /
         ``ValueError`` immediately instead of returning a stream that
-        can never complete."""
+        can never complete.
+
+        ``temperature``/``top_k``/``top_p`` select per-request
+        stochastic sampling (default greedy: ``temperature`` absent or
+        0 — ``top_k``/``top_p`` are then ignored). ``seed`` pins the
+        request's explicit PRNG key: the same seed yields a bitwise-
+        identical token stream on every rerun of the same engine
+        configuration, across engine restarts (docs/SERVING.md
+        "Speculative decoding & sampling"). Without a seed, a fresh
+        one is drawn per request."""
         if self._failure is not None:
             telemetry.counter("serving.generate.rejected_closed")
             raise ReplicaFailedError(str(self._failure),
@@ -808,13 +1081,22 @@ class GenerationEngine:
             raise EngineClosedError("submit on a closed engine")
         prompt, max_new, eos = self._validate(prompt, max_new_tokens,
                                               eos_id)
+        temp, tk, tp, seed = self._validate_sampling(
+            temperature, top_k, top_p, seed)
+        key = None
+        if temp > 0:
+            telemetry.counter("serving.generate.sampling.requests")
+            if seed is None:
+                seed = int.from_bytes(os.urandom(4), "little")
+            key = request_key(seed)
         telemetry.counter("serving.generate.requests")
         stream = GenerationStream(int(prompt.size))
         tmo = self.timeout_ms if timeout_ms is None else timeout_ms
         now = time.monotonic()
         req = _GenRequest(
             prompt, max_new, eos, stream, telemetry.clock(), now,
-            now + tmo / 1e3 if tmo is not None else None)
+            now + tmo / 1e3 if tmo is not None else None,
+            temperature=temp, top_k=tk, top_p=tp, key=key)
         if self._sync:  # MXTPU_SERVING=0: inline generation
             with self._gen_lock:
                 self._admit_one(req)
@@ -904,6 +1186,17 @@ class GenerationEngine:
                 f"request expired in queue before prefill (waited "
                 f"{waited_ms:.1f} ms)"))
             return
+        try:
+            self._admit_one_inner(r, waited_ms)
+        except Exception as e:  # noqa: BLE001 — the worker is about to
+            # die (_fail_all); without this the IN-HAND request —
+            # already popped from the queue, not yet in a slot — would
+            # be invisible to the cleanup and hang its caller forever
+            r.stream._finish(exc=ReplicaFailedError(
+                f"admission failed: {type(e).__name__}: {e}", cause=e))
+            raise
+
+    def _admit_one_inner(self, r: _GenRequest, waited_ms):
         if self.paged:
             # a page-starved request goes to _blocked: its queue_wait
             # is recorded when it actually admits (or rejects), not
@@ -920,13 +1213,21 @@ class GenerationEngine:
         sb = self.policy.bucket(n)
         padded = onp.zeros((1, sb), "i4")
         padded[0, :n] = r.prompt
+        self._arm_sampling(slot, r)
         t0 = telemetry.clock()
         logits, self._cache = self.model.prefill(
             padded, onp.asarray([n], "i4"), self._cache,
             slots=onp.asarray([slot], "i4"))
+        if self.speculative:
+            # the draft mirrors the target's committed prefix from the
+            # moment the slot exists — its own (dense) prefill of the
+            # same padded prompt into the same slot row
+            _, self._draft_cache = self.draft.prefill(
+                padded, onp.asarray([n], "i4"), self._draft_cache,
+                slots=onp.asarray([slot], "i4"))
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefills")
-        tok = int(onp.asarray(logits)[0].argmax())
+        tok = self._pick_first(slot, onp.asarray(logits)[0])
         s = _Slot(r.stream, tok, r.max_new - 1, r.eos_id, r.deadline,
                   n_ctx=n)
         self._slots[slot] = s
@@ -936,10 +1237,47 @@ class GenerationEngine:
         telemetry.hist_since("serving.generate.ttft", r.t_submit)
         if s.eos_id is not None and tok == s.eos_id:
             self._evict(slot, "eos")
-        elif s.left <= 0 or s.n_ctx >= self._s_max:
+        elif s.left <= 0 or s.n_ctx >= self._s_cap:
             self._evict(slot, "length")
         else:
             telemetry.gauge("serving.generate.slots", self._n_active)
+
+    def _arm_sampling(self, slot: int, r: _GenRequest):
+        """Install a request's sampling knobs into the per-slot
+        vectors the fixed-shape programs read (greedy requests write
+        the defaults — the vectors must never carry a previous
+        tenant's state). The PRNG key is installed here only in DENSE
+        mode, where admission prefills synchronously and the first
+        pick follows immediately; a PAGED slot can sit in its prefill
+        phase for several iterations whose decode ticks split EVERY
+        row's key — installing at admission would make the
+        pre-first-token split count depend on co-tenant activity and
+        break seeded reproducibility, so the key waits on the slot
+        (``_PagedSlot.key``) until ``_first_token`` installs it."""
+        self._temps[slot] = r.temperature
+        self._topks[slot] = r.top_k
+        self._topps[slot] = r.top_p
+        if r.temperature > 0:
+            self._n_sampling += 1
+            if not self.paged:
+                self._keys[slot] = r.key
+
+    def _pick_first(self, slot: int, logits_row):
+        """First token of a fresh admission, from its prefill/peek
+        logits row: host argmax for greedy slots (bit-identical to the
+        pre-sampling engine), the jitted (1, V) sampler for stochastic
+        ones — the same key chain the decode steps continue."""
+        logits_row = logits_row.reshape(-1)
+        if self._temps[slot] <= 0:
+            return int(logits_row.argmax())
+        smp = self._ensure_samplers()
+        tok, nk = smp["sample"](
+            self._keys[slot:slot + 1],
+            onp.asarray(logits_row, "f4")[None],
+            self._temps[slot:slot + 1], self._topks[slot:slot + 1],
+            self._topps[slot:slot + 1])
+        self._keys[slot] = onp.asarray(nk)[0]
+        return int(onp.asarray(tok)[0])
 
     # -- paged scheduling ----------------------------------------------
     def _alloc_pages(self, n):
@@ -965,7 +1303,7 @@ class GenerationEngine:
         cannot cover the reservation — the request stays blocked."""
         length = int(r.prompt.size)
         ps = self._ps
-        cap_pages = -(-min(length + r.max_new, self._s_max) // ps)
+        cap_pages = -(-min(length + r.max_new, self._s_cap) // ps)
         shared_pages, shared_tokens = [], 0
         if self._prefix is not None:
             shared_pages, shared_tokens = self._prefix.match(r.prompt)
@@ -1007,6 +1345,13 @@ class GenerationEngine:
                        n_ctx=length, row=row, page_refs=refs,
                        prompt=r.prompt, seq=self._seq,
                        t_submit=r.t_submit)
+        if self.speculative:
+            # survives prefix registration (which clears s.prompt):
+            # the draft's dense prefill runs when the slot enters
+            # decode, prefix hit or not — the draft has no prefix cache
+            s.draft_prompt = r.prompt
+        s.key = r.key   # installed at decode entry (_first_token)
+        self._arm_sampling(slot, r)
         self._seq += 1
         if peek:
             if length % ps:
@@ -1063,7 +1408,7 @@ class GenerationEngine:
             return
         length = int(s.prompt.size)
         needs_cow = (length % self._ps != 0 and s.cow_pending is None
-                     and s.left > 1 and s.n_ctx < self._s_max)
+                     and s.left > 1 and s.n_ctx < self._s_cap)
         dst = None
         if needs_cow:
             dst = self._alloc_pages(1)
@@ -1081,9 +1426,30 @@ class GenerationEngine:
     def _first_token(self, slot: int, s: _PagedSlot, logits_row):
         """Emit a freshly-admitted request's first token (from its last
         prefill chunk's logits or the prefix-hit peek) — the paged
-        analog of dense ``_admit_one``'s tail."""
-        tok = int(logits_row.reshape(-1, logits_row.shape[-1])[0]
-                  .argmax())
+        analog of dense ``_admit_one``'s tail. In speculative mode the
+        slot's entry into decode is also where the DRAFT catches up:
+        one dense draft prefill of the full prompt (the draft has no
+        paged pool and no prefix cache — it is small enough that a
+        monolithic prefill is cheaper than teaching it chunking)."""
+        if self.speculative and s.draft_prompt is not None:
+            n = int(s.draft_prompt.size)
+            sb = self.policy.bucket(n)
+            padded = onp.zeros((1, sb), "i4")
+            padded[0, :n] = s.draft_prompt
+            _, self._draft_cache = self.draft.prefill(
+                padded, onp.asarray([n], "i4"), self._draft_cache,
+                slots=onp.asarray([slot], "i4"))
+            s.draft_prompt = None
+        if s.key is not None:
+            # decode entry is where the request's PRNG key goes live:
+            # installing it at admission would let every co-tenant
+            # tick during the chunked prefill split it (the
+            # fixed-shape programs advance ALL rows), making the
+            # stream depend on co-tenant activity
+            self._keys[slot] = s.key
+            s.key = None
+        tok = self._pick_first(
+            slot, logits_row.reshape(-1, logits_row.shape[-1])[0])
         s.last = tok
         s.left -= 1
         s.state = "decode"
@@ -1092,7 +1458,7 @@ class GenerationEngine:
         telemetry.hist_since("serving.generate.ttft", s.t_submit)
         if s.eos_id is not None and tok == s.eos_id:
             self._evict(slot, "eos")
-        elif s.left <= 0 or s.n_ctx >= self._s_max:
+        elif s.left <= 0 or s.n_ctx >= self._s_cap:
             self._evict(slot, "length")
         else:
             telemetry.gauge("serving.generate.slots", self._n_active)
@@ -1130,10 +1496,11 @@ class GenerationEngine:
             self._first_token(best, s, onp.asarray(logits))
         return 1
 
-    def _decode_tick(self):
-        """One fixed-shape paged decode step over all DECODING slots
-        (prefilling slots ride along masked out — their writes are
-        redirected to the scrap page and their ``len`` stands still)."""
+    def _cow_sweep(self):
+        """Copy-on-write: a decoding slot whose next cache write would
+        land in a SHARED page copies the divergence page first and
+        rebinds its table row. Runs before every paged decode/verify
+        step (a speculative verify writes through the same table)."""
         for i, s in enumerate(self._slots):
             if s is not None and s.state == "decode" \
                     and s.cow_pending is not None:
@@ -1147,6 +1514,29 @@ class GenerationEngine:
                 s.page_refs.remove(src)
                 s.cow_pending = None
                 telemetry.counter("serving.generate.pages.cow_copies")
+
+    def _pick_step_tokens(self, logits):
+        """Per-slot next tokens from a decode step's raw (B, V)
+        logits: the host argmax when every active slot is greedy (the
+        pre-sampling engine's exact path), otherwise one fixed-shape
+        sampler call whose greedy rows are in-program argmax (the same
+        ints) and whose stochastic rows consume their slot's key."""
+        if self._n_sampling:
+            tok, nk = self._ensure_samplers()["sample"](
+                self._keys, logits, self._temps, self._topks,
+                self._topps)
+            # onp.array, not asarray: a jax array converts to a
+            # READ-ONLY numpy view, and _arm_sampling assigns into
+            # this buffer per admission
+            self._keys = onp.array(nk, dtype="u4")
+            return onp.asarray(tok)
+        return onp.asarray(logits).argmax(axis=-1)
+
+    def _decode_tick(self):
+        """One fixed-shape paged decode step over all DECODING slots
+        (prefilling slots ride along masked out — their writes are
+        redirected to the scrap page and their ``len`` stands still)."""
+        self._cow_sweep()
         toks = onp.zeros((self.max_slots,), "i4")
         active = onp.zeros((self.max_slots,), "i4")
         for i, s in enumerate(self._slots):
@@ -1157,13 +1547,13 @@ class GenerationEngine:
         logits, self._cache = self.model.decode_step_paged(
             toks, active, self._cache)
         telemetry.hist_since("serving.generate.decode", t0)
-        arr = onp.asarray(logits)
+        step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()
         n_emitted = 0
         for i, s in enumerate(self._slots):
             if s is None or s.state != "decode" or not active[i]:
                 continue
-            tok = int(arr[i].argmax())
+            tok = int(step_toks[i])
             s.last = tok
             s.left -= 1
             s.n_ctx += 1
@@ -1171,7 +1561,7 @@ class GenerationEngine:
             n_emitted += 1
             if s.eos_id is not None and tok == s.eos_id:
                 self._evict(i, "eos")
-            elif s.left <= 0 or s.n_ctx >= self._s_max:
+            elif s.left <= 0 or s.n_ctx >= self._s_cap:
                 self._evict(i, "length")
             elif s.deadline is not None and now > s.deadline:
                 telemetry.counter("serving.generate.timeouts")
@@ -1197,6 +1587,11 @@ class GenerationEngine:
         self._release_slot_refs(s)
         self._slots[slot] = None
         self._n_active -= 1
+        if self._temps[slot] > 0:
+            self._n_sampling -= 1
+        self._temps[slot] = 0.0    # the next tenant must never read a
+        self._topks[slot] = 0      # previous request's knobs
+        self._topps[slot] = 1.0
         telemetry.counter("serving.generate.evictions")
         telemetry.gauge("serving.generate.slots", self._n_active)
 
@@ -1219,7 +1614,13 @@ class GenerationEngine:
                             self._chunks_this_iter)
             if any(s is not None and s.state == "decode"
                    for s in self._slots):
-                self._decode_tick()
+                if self.speculative:
+                    self._spec_tick()
+                else:
+                    self._decode_tick()
+            return
+        if self.speculative:
+            self._spec_tick()
             return
         toks = onp.zeros((self.max_slots,), "i4")
         for i, s in enumerate(self._slots):
@@ -1228,13 +1629,13 @@ class GenerationEngine:
         t0 = telemetry.clock()
         logits, self._cache = self.model.decode_step(toks, self._cache)
         telemetry.hist_since("serving.generate.decode", t0)
-        arr = onp.asarray(logits)
+        step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()
         n_emitted = 0
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
-            tok = int(arr[i].argmax())
+            tok = int(step_toks[i])
             s.last = tok
             s.left -= 1
             s.n_ctx += 1
@@ -1242,13 +1643,127 @@ class GenerationEngine:
             n_emitted += 1
             if s.eos_id is not None and tok == s.eos_id:
                 self._evict(i, "eos")
-            elif s.left <= 0 or s.n_ctx >= self._s_max:
+            elif s.left <= 0 or s.n_ctx >= self._s_cap:
                 self._evict(i, "length")
             elif s.deadline is not None and now > s.deadline:
                 telemetry.counter("serving.generate.timeouts")
                 self._evict(i, "timeout")
         if n_emitted:  # one delta for the step, not one call per token
             telemetry.counter("serving.generate.tokens", n_emitted)
+        telemetry.gauge("serving.generate.slots", self._n_active)
+
+    # -- speculative decoding (docs/SERVING.md) -------------------------
+    def _spec_tick(self):
+        """One speculative iteration over every decoding slot: the
+        draft proposes ``spec_k`` tokens per slot (k dense draft
+        steps, tokens and keys chained on-device — no host sync), the
+        target verifies all ``k + 1`` positions in ONE fixed-shape
+        program, the accept rule (ops/sampling.py) commits the
+        accepted prefix plus one target-derived token, and both caches
+        advance to the accept point (``advance_len`` — the rejected
+        tail sits above the ``len`` waterline and the next verify
+        overwrites it; the draft, which ran k steps, ROLLS BACK by the
+        same counter). Greedy slots commit exactly the tokens
+        non-speculative decode would; stochastic slots commit a
+        sample from exactly the warped target distribution."""
+        if self.paged:
+            self._cow_sweep()
+        idxs = [i for i, s in enumerate(self._slots)
+                if s is not None
+                and (not self.paged or s.state == "decode")]
+        if not idxs:
+            return
+        k = self.spec_k
+        b = self.max_slots
+        toks = onp.zeros((b,), "i4")
+        active = onp.zeros((b,), "i4")
+        for i in idxs:
+            toks[i] = self._slots[i].last
+            active[i] = 1
+        sampled = bool(self._n_sampling)
+        t0 = telemetry.clock()
+        # three dispatches + one host sync per iteration: the fused
+        # k-step draft propose, the fused verify+accept+advance, and
+        # the draft rollback — at serving model sizes the per-call
+        # dispatch overhead dominates, so the k draft steps, the k+1
+        # verify, the accept rule and the len bump each run INSIDE
+        # one program instead of as ~3k separate calls
+        if sampled:
+            dt, q, keys, self._draft_cache = self.draft.propose_tokens(
+                toks, self._draft_cache, k, keys=self._keys,
+                temps=self._temps, top_ks=self._topks,
+                top_ps=self._topps)
+            commit, n_commit, keys, self._cache = (
+                self.model.verify_commit_paged if self.paged
+                else self.model.verify_commit)(
+                toks, dt, active, self._cache, q=q, keys=keys,
+                temps=self._temps, top_ks=self._topks,
+                top_ps=self._topps)
+        else:
+            dt, self._draft_cache = self.draft.propose_tokens(
+                toks, self._draft_cache, k)
+            commit, n_commit, self._cache = (
+                self.model.verify_commit_paged if self.paged
+                else self.model.verify_commit)(
+                toks, dt, active, self._cache)
+        commit_h = onp.asarray(commit)    # the tick's one host sync
+        n_h = onp.asarray(n_commit)
+        if sampled:
+            self._keys = onp.array(keys, dtype="u4")  # writable copy
+        telemetry.hist_since("serving.generate.decode", t0)
+        # commit bookkeeping: eos cuts the emission at the stop token,
+        # budget/capacity clip it. A clipped slot is EVICTED, so the
+        # cache's full-commit len (advanced in-program) is a dead
+        # row's counter; the draft rolls back by the same arithmetic
+        # (it ran k steps on every row — fixed shape).
+        ddelta = onp.full((b,), -k, "i4")
+        emits = {}
+        proposed = len(idxs) * k
+        accepted = 0
+        for i in idxs:
+            s = self._slots[i]
+            m = int(n_h[i])
+            accepted += m - 1
+            out = [int(t) for t in commit_h[i, :m]]
+            if s.eos_id is not None and s.eos_id in out:
+                out = out[:out.index(s.eos_id) + 1]
+            out = out[:min(len(out), s.left, self._s_cap - s.n_ctx)]
+            emits[i] = (out, m)
+            ddelta[i] += m
+        self._draft_cache = self.draft.advance_len(
+            ddelta, self._draft_cache)
+        telemetry.counter("serving.generate.spec.proposed", proposed)
+        telemetry.counter("serving.generate.spec.accepted", accepted)
+        telemetry.counter("serving.generate.spec.rejected",
+                          proposed - accepted)
+        if proposed:
+            telemetry.gauge("serving.generate.spec.accept_rate",
+                            accepted / proposed)
+        now = time.monotonic()
+        n_emitted = 0
+        for i in idxs:
+            s = self._slots[i]
+            out, m = emits[i]
+            s.stream._emit_many(out)
+            n_emitted += len(out)
+            if not out:   # can only mean an exhausted slot the evict
+                self._evict(i, "length")     # checks below would have
+                continue                     # caught last tick
+            s.last = out[-1]
+            s.left -= len(out)
+            s.n_ctx += len(out)
+            if s.eos_id is not None and out[-1] == s.eos_id:
+                self._evict(i, "eos")
+            elif s.left <= 0 or s.n_ctx >= self._s_cap \
+                    or len(out) < m:
+                self._evict(i, "length")
+            elif s.deadline is not None and now > s.deadline:
+                telemetry.counter("serving.generate.timeouts")
+                self._evict(i, "timeout")
+        if n_emitted:
+            telemetry.counter("serving.generate.tokens", n_emitted)
+        telemetry.gauge("serving.generate.spec.tokens_per_step",
+                        n_emitted)
         telemetry.gauge("serving.generate.slots", self._n_active)
 
     def _evict(self, slot: int, reason: str):
@@ -1273,6 +1788,7 @@ class GenerationEngine:
                 self._release_slot_refs(s)
                 self._slots[i] = None
         self._n_active = 0
+        self._n_sampling = 0
         self._teardown_paged(EngineClosedError(
             "engine closed while the request awaited KV pages"))
 
@@ -1309,6 +1825,7 @@ class GenerationEngine:
                 self._release_slot_refs(s)
                 self._slots[i] = None
         self._n_active = 0
+        self._n_sampling = 0
         self._teardown_paged(failure)
         if self._worker is not None:
             self._worker._stopped = True  # a still-looping worker (an

@@ -62,6 +62,7 @@ the ``serving.router.latency`` histogram (submit → final outcome).
 from __future__ import annotations
 
 import collections
+import os
 import threading
 import time
 import weakref
@@ -135,10 +136,11 @@ class _Replica:
 class _Req:
     __slots__ = ("payload", "max_new", "eos_id", "deadline", "tenant",
                  "priority", "retries_left", "sink", "t0", "finished",
-                 "prefix_key")
+                 "prefix_key", "sampling")
 
     def __init__(self, payload, max_new, eos_id, deadline, tenant,
-                 priority, retries_left, sink, t0, prefix_key=None):
+                 priority, retries_left, sink, t0, prefix_key=None,
+                 sampling=None):
         self.payload = payload
         self.max_new = max_new
         self.eos_id = eos_id
@@ -150,6 +152,15 @@ class _Req:
         self.t0 = t0
         self.finished = False
         self.prefix_key = prefix_key
+        #: per-request sampling kwargs forwarded verbatim to EVERY
+        #: dispatch attempt (the seed is pinned at admission, so a
+        #: cross-replica retry replays the same stochastic stream and
+        #: the prefix-skip stays token-identical — up to the seeded-
+        #: stream schedule caveat of docs/SERVING.md: the new
+        #: replica's co-tenant schedule differs, which can shift an
+        #: ulp-knife-edge accept draw in rare cases; greedy retries
+        #: are exact)
+        self.sampling = sampling
 
 
 class _Prober(threading.Thread):
@@ -256,6 +267,17 @@ class Router:
             raise TypeError(
                 f"replicas must be precision-homogeneous, got "
                 f"{sorted(precisions)}")
+        specs = {getattr(e, "speculation", "off") for e in replicas}
+        if len(specs) > 1:
+            # same rule for the speculation config (the draft model
+            # and spec_k): a retried STOCHASTIC request replays its
+            # seed, and its committed stream depends on the
+            # draft/spec_k key-consumption schedule — a draft-model-
+            # heterogeneous fleet would make the retry's tokens depend
+            # on which replica caught it
+            raise TypeError(
+                f"replicas must be speculation-homogeneous, got "
+                f"{sorted(specs)}")
         self._replicas = [_Replica(e, i) for i, e in enumerate(replicas)]
         self.max_retries = int(max_retries)
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -606,7 +628,8 @@ class Router:
     # -- submit --------------------------------------------------------
     def submit(self, *args, max_new_tokens=None, eos_id=None,
                timeout_ms=None, tenant: str = "default",
-               priority: int = 0, prefix_key=None):
+               priority: int = 0, prefix_key=None, temperature=None,
+               top_k=None, top_p=None, seed=None):
         """Queue one request on the fleet.
 
         Generation fleets take exactly one positional ``prompt`` and
@@ -620,6 +643,11 @@ class Router:
         warm — health, breaker state, and join-shortest-queue still
         win (``serving.router.prefix_affinity_hits`` counts the
         dispatches the hint changed).
+        ``temperature``/``top_k``/``top_p``/``seed`` are the engines'
+        per-request sampling knobs, forwarded to every dispatch; a
+        stochastic request without an explicit seed gets one pinned at
+        admission, so a cross-replica retry replays the identical
+        stream and the prefix-skip stays token-identical.
         Raises :class:`EngineClosedError` / :class:`LoadShedError` /
         :class:`TenantQuotaError` / :class:`QueueFullError` /
         ``ValueError`` immediately, never via a hung stream."""
@@ -637,16 +665,28 @@ class Router:
             lead = self._replicas[0].engine
             prompt, max_new, eos = lead._validate(
                 args[0], max_new_tokens, eos_id)
+            temp, tk, tp, seed = lead._validate_sampling(
+                temperature, top_k, top_p, seed)
+            sampling = None
+            if temp > 0:
+                if seed is None:
+                    # pin the seed NOW: a retry must replay the exact
+                    # stochastic stream on the next replica
+                    seed = int.from_bytes(os.urandom(4), "little")
+                sampling = {"temperature": temp, "top_k": tk,
+                            "top_p": tp, "seed": seed}
             max_new = self._admit(tenant, priority, max_new)
             sink = RouterStream(int(prompt.size), tenant, priority)
             req = _Req(prompt, max_new, eos, deadline, tenant, priority,
                        self.max_retries, sink, telemetry.clock(),
-                       prefix_key=prefix_key)
+                       prefix_key=prefix_key, sampling=sampling)
         else:
-            if max_new_tokens is not None or eos_id is not None:
+            if max_new_tokens is not None or eos_id is not None \
+                    or temperature is not None or top_k is not None \
+                    or top_p is not None or seed is not None:
                 raise TypeError(
-                    "max_new_tokens/eos_id apply to generation fleets "
-                    "only")
+                    "max_new_tokens/eos_id and the sampling knobs "
+                    "apply to generation fleets only")
             self._admit(tenant, priority, None)
             sink = Future()
             sink.tenant, sink.priority = tenant, priority
@@ -726,7 +766,8 @@ class Router:
                 if self._mode == "generate":
                     attempt = rep.engine.submit(
                         req.payload, max_new_tokens=req.max_new,
-                        eos_id=req.eos_id, timeout_ms=rem_ms)
+                        eos_id=req.eos_id, timeout_ms=rem_ms,
+                        **(req.sampling or {}))
                 else:
                     attempt = rep.engine.submit(*req.payload,
                                                 timeout_ms=rem_ms)

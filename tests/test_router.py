@@ -459,6 +459,80 @@ def test_mixed_fleet_rejected(base):
     inf.close()
 
 
+def _mk_draft():
+    mx.np.random.seed(5)
+    net = gpt_small(vocab_size=VOCAB, units=8, num_layers=1,
+                    num_heads=2, max_length=SMAX)
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_speculation_heterogeneous_fleet_rejected(base):
+    """The PR-10 precision-homogeneity rule's sibling: a fleet mixing
+    speculative and plain replicas (or two different draft/spec_k
+    configs) is rejected at construction — a retried stochastic
+    request's stream depends on the speculation config's key
+    schedule, so it must not depend on which replica catches it."""
+    _net, params = base
+    plain = _mk_engine(params)
+    spec = GenerationEngine(_build_net(), draft_model=_mk_draft(),
+                            spec_k=2, max_slots=SLOTS,
+                            max_length=SMAX, max_new_tokens=4,
+                            queue_limit=32)
+    spec.load_weights(params)
+    with pytest.raises(TypeError, match="speculation-homogeneous"):
+        Router([plain, spec])
+    spec2 = GenerationEngine(_build_net(), draft_model=_mk_draft(),
+                             spec_k=3, max_slots=SLOTS,
+                             max_length=SMAX, max_new_tokens=4,
+                             queue_limit=32)
+    spec2.load_weights(params)
+    with pytest.raises(TypeError, match="speculation-homogeneous"):
+        Router([spec, spec2])
+    # a homogeneous speculative fleet is fine (and still serves)
+    router = Router([spec, spec2_ok := GenerationEngine(
+        _build_net(), draft_model=_mk_draft(), spec_k=2,
+        max_slots=SLOTS, max_length=SMAX, max_new_tokens=4,
+        queue_limit=32)])
+    spec2_ok.load_weights(params)
+    router.close()
+    plain.close()
+    spec2.close()
+
+
+def test_sampling_kwargs_propagate_and_pin_seed(base):
+    """submit(temperature=, top_k=, top_p=, seed=) reaches the engine:
+    a 1-replica fleet's stream equals the direct engine submit with
+    the same seed, and an unseeded stochastic request gets a seed
+    pinned at admission (req.sampling carries it) so retries replay
+    the identical stream."""
+    net, params = base
+    rng = onp.random.RandomState(17)
+    p = _prompt(rng)
+    direct_eng = _mk_engine(params, max_new=6)
+    direct = direct_eng.submit(
+        p, temperature=0.9, top_k=12, seed=77).result(timeout=120).tokens
+    direct_eng.close()
+    eng = _mk_engine(params, max_new=6)
+    router = Router([eng])
+    via = router.submit(p, temperature=0.9, top_k=12,
+                        seed=77).result(timeout=120).tokens
+    assert via == direct
+    # greedy requests stay greedy (and bit-identical) through the fleet
+    g1 = router.submit(p).result(timeout=120).tokens
+    g2 = router.submit(p, temperature=0.0).result(timeout=120).tokens
+    assert g1 == g2
+    router.close()
+
+
+def test_infer_fleet_rejects_sampling_kwargs():
+    inf = _mk_infer_engine()
+    router = Router([inf])
+    with pytest.raises(TypeError, match="generation fleets only"):
+        router.submit(onp.zeros((1, 4), "f4"), temperature=0.5)
+    router.close()
+
+
 # -- randomized soak (excluded from tier-1 via the slow marker) --------
 
 @pytest.mark.slow

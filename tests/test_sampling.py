@@ -1,0 +1,270 @@
+"""Sampling heads (ops/sampling.py): logit warping, explicit-key
+sampling, and the speculative-decoding accept rule.
+
+Guarantees under test:
+- top-k / top-p warping truncates exactly the mass a jnp/numpy
+  reference says it should (minimal nucleus, largest-k survivors,
+  temperature scaling of the survivors);
+- sampling with explicit per-row keys is deterministic (same key ->
+  same token, bitwise), row-independent, and greedy rows
+  (``temperature <= 0``) reduce to ``argmax`` of the raw logits;
+- the speculative accept rule is exact: greedy rows commit exactly
+  the target's greedy tokens (accept-while-argmax-matches, then the
+  target token), stochastic rows commit tokens whose MARGINAL
+  distribution is the warped target distribution (the
+  residual-distribution rule), verified empirically against the
+  closed form on a fixed teacher-forced corpus.
+"""
+import numpy as onp
+
+import tpu_platform  # noqa: F401 — platform pinned in conftest
+
+from mxnet_tpu.ops import sampling as smp
+
+NEG = -1e29   # "masked" threshold for assertions (NEG_INF is -1e30)
+
+
+def _keys(n, base=0):
+    k = onp.zeros((n, 2), "u4")
+    k[:, 1] = base + onp.arange(n)
+    return k
+
+
+def _softmax(x):
+    e = onp.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# -- warping -----------------------------------------------------------
+
+def test_top_k_keeps_exactly_k_largest():
+    rng = onp.random.RandomState(0)
+    lg = rng.randn(5, 23).astype("f4")
+    for k in (1, 3, 10, 22):
+        w = onp.asarray(smp.warp_logits(
+            lg, onp.ones(5, "f4"), onp.full(5, k, "i4"),
+            onp.ones(5, "f4")))
+        for row in range(5):
+            kept = w[row] > NEG
+            assert kept.sum() == k
+            # the survivors are the k largest of the row
+            thresh = onp.sort(lg[row])[-k]
+            assert (lg[row][kept] >= thresh).all()
+    # k == 0 and k >= V disable the filter
+    for k in (0, 23, 99):
+        w = onp.asarray(smp.warp_logits(
+            lg, onp.ones(5, "f4"), onp.full(5, k, "i4"),
+            onp.ones(5, "f4")))
+        assert (w > NEG).all()
+
+
+def test_top_p_minimal_nucleus_vs_reference():
+    rng = onp.random.RandomState(1)
+    lg = rng.randn(6, 17).astype("f4") * 2.0
+    for p in (0.1, 0.5, 0.9):
+        w = onp.asarray(smp.warp_logits(
+            lg, onp.ones(6, "f4"), onp.zeros(6, "i4"),
+            onp.full(6, p, "f4")))
+        probs = _softmax(lg.astype("f8"))
+        for row in range(6):
+            order = onp.argsort(-probs[row], kind="stable")
+            cum = probs[row][order].cumsum()
+            # reference nucleus: tokens whose preceding mass < p
+            n_keep = int((onp.concatenate([[0.0], cum[:-1]]) < p).sum())
+            kept = w[row] > NEG
+            assert kept.sum() == n_keep
+            assert set(onp.where(kept)[0]) == set(order[:n_keep])
+    # p == 1 disables
+    w = onp.asarray(smp.warp_logits(
+        lg, onp.ones(6, "f4"), onp.zeros(6, "i4"), onp.ones(6, "f4")))
+    assert (w > NEG).all()
+
+
+def test_temperature_scales_surviving_logits():
+    rng = onp.random.RandomState(2)
+    lg = rng.randn(3, 9).astype("f4")
+    w = onp.asarray(smp.warp_logits(
+        lg, onp.full(3, 2.0, "f4"), onp.zeros(3, "i4"),
+        onp.ones(3, "f4")))
+    onp.testing.assert_allclose(w, lg / 2.0, rtol=1e-6)
+
+
+def test_warp_always_keeps_at_least_one_token():
+    # an extreme nucleus + top_k=1 still leaves the head token
+    lg = onp.asarray([[5.0, 0.0, -1.0]], "f4")
+    w = onp.asarray(smp.warp_logits(
+        lg, onp.asarray([0.01], "f4"), onp.asarray([1], "i4"),
+        onp.asarray([1e-6], "f4")))
+    assert (w[0] > NEG).sum() == 1
+    assert w[0].argmax() == 0
+
+
+# -- sampling ----------------------------------------------------------
+
+def test_sample_tokens_greedy_rows_are_argmax():
+    rng = onp.random.RandomState(3)
+    lg = rng.randn(4, 13).astype("f4")
+    t = onp.asarray([0.0, 1.0, 0.0, 0.7], "f4")
+    tok, nk = smp.sample_tokens(_keys(4), lg, t, onp.zeros(4, "i4"),
+                                onp.ones(4, "f4"))
+    tok = onp.asarray(tok)
+    assert tok[0] == lg[0].argmax()
+    assert tok[2] == lg[2].argmax()
+    assert onp.asarray(nk).shape == (4, 2)
+
+
+def test_sample_tokens_deterministic_and_row_independent():
+    rng = onp.random.RandomState(4)
+    lg = rng.randn(4, 29).astype("f4")
+    t = onp.full(4, 1.0, "f4")
+    a = onp.asarray(smp.sample_tokens(_keys(4), lg, t,
+                                      onp.zeros(4, "i4"),
+                                      onp.ones(4, "f4"))[0])
+    b = onp.asarray(smp.sample_tokens(_keys(4), lg, t,
+                                      onp.zeros(4, "i4"),
+                                      onp.ones(4, "f4"))[0])
+    assert (a == b).all(), "same keys must sample the same tokens"
+    # a row's draw depends only on ITS key: permuting other rows'
+    # keys leaves row 0 untouched
+    k2 = _keys(4)
+    k2[1:] = _keys(3, base=1000)
+    c = onp.asarray(smp.sample_tokens(k2, lg, t, onp.zeros(4, "i4"),
+                                      onp.ones(4, "f4"))[0])
+    assert c[0] == a[0]
+    # different keys: at least one of the stochastic rows moves
+    assert (c[1:] != a[1:]).any()
+
+
+def test_sample_respects_top_k_support():
+    rng = onp.random.RandomState(5)
+    lg = rng.randn(64, 31).astype("f4")
+    tok = onp.asarray(smp.sample_tokens(
+        _keys(64), lg, onp.full(64, 1.5, "f4"), onp.full(64, 4, "i4"),
+        onp.ones(64, "f4"))[0])
+    for row in range(64):
+        top4 = set(onp.argsort(-lg[row])[:4].tolist())
+        assert int(tok[row]) in top4
+
+
+def test_sample_with_probs_matches_sample_tokens():
+    """The draft-step variant draws the SAME token as sample_tokens
+    under the same key (one shared split schedule) and returns the
+    warped distribution it drew from."""
+    rng = onp.random.RandomState(6)
+    lg = rng.randn(5, 19).astype("f4")
+    t = onp.full(5, 0.9, "f4")
+    tk = onp.full(5, 8, "i4")
+    tp = onp.full(5, 0.95, "f4")
+    a, nk_a = smp.sample_tokens(_keys(5), lg, t, tk, tp)
+    b, probs, nk_b = smp.sample_with_probs(_keys(5), lg, t, tk, tp)
+    assert (onp.asarray(a) == onp.asarray(b)).all()
+    assert (onp.asarray(nk_a) == onp.asarray(nk_b)).all()
+    probs = onp.asarray(probs)
+    onp.testing.assert_allclose(probs.sum(axis=-1), 1.0, rtol=1e-5)
+    w = onp.asarray(smp.warp_logits(lg, t, tk, tp))
+    assert ((probs > 1e-9) == (w > NEG)).all(), \
+        "returned distribution must live on the warped support"
+
+
+# -- speculative accept rule -------------------------------------------
+
+def test_accept_greedy_commits_target_tokens_exactly():
+    rng = onp.random.RandomState(7)
+    B, K, V = 6, 3, 13
+    tl = rng.randn(B, K + 1, V).astype("f4")
+    tgt = tl.argmax(-1)
+    dt = onp.zeros((B, K), "i4")
+    dt[0] = tgt[0, :K]              # full accept
+    dt[1] = tgt[1, :K]; dt[1, 0] = (dt[1, 0] + 1) % V   # reject at 0
+    dt[2] = tgt[2, :K]; dt[2, 2] = (dt[2, 2] + 1) % V   # reject at 2
+    dt[3:] = (tgt[3:, :K] + 1) % V  # reject immediately
+    qp = onp.full((B, K, V), 1.0 / V, "f4")
+    commit, n, _ = smp.speculative_accept(
+        _keys(B), tl, dt, qp, onp.zeros(B, "f4"), onp.zeros(B, "i4"),
+        onp.ones(B, "f4"))
+    commit, n = onp.asarray(commit), onp.asarray(n)
+    assert n.tolist() == [K + 1, 1, K, 1, 1, 1]
+    # every committed token is the non-speculative greedy stream:
+    # accepted drafts (== target argmax) then the target's own token
+    assert commit[0, :K].tolist() == tgt[0, :K].tolist()
+    assert commit[0, K] == tgt[0, K]          # bonus token
+    assert commit[1, 0] == tgt[1, 0]
+    assert commit[2, :2].tolist() == tgt[2, :2].tolist()
+    assert commit[2, 2] == tgt[2, 2]
+    assert (commit[3:, 0] == tgt[3:, 0]).all()
+
+
+def test_accept_stochastic_preserves_target_distribution():
+    """Teacher-forced accept-rule test on a fixed corpus: for each of
+    a handful of (p, q) pairs, run the full draft-then-accept pipeline
+    over thousands of independent keys and compare the empirical
+    distribution of the FIRST committed token against the closed form
+    — speculative sampling's defining property is that this marginal
+    is exactly the (warped) target distribution p."""
+    trials = 4000
+    cases = [
+        # (target logits, draft logits) — draft close, draft far,
+        # draft peaked on the wrong token
+        ([1.2, 0.1, -0.4, 2.0, -1.0], [0.5, 0.5, 0.0, 0.2, 0.8]),
+        ([0.0, 0.0, 0.0, 0.0, 3.0], [3.0, 0.0, 0.0, 0.0, 0.0]),
+        ([2.0, 1.0, 0.0, -1.0, -2.0], [2.0, 1.0, 0.0, -1.0, -2.0]),
+    ]
+    for ci, (p_log, q_log) in enumerate(cases):
+        V = len(p_log)
+        t = onp.ones(trials, "f4")
+        tk = onp.zeros(trials, "i4")
+        tp = onp.ones(trials, "f4")
+        tl = onp.broadcast_to(
+            onp.asarray(p_log, "f4"), (trials, 2, V)).copy()
+        ql = onp.broadcast_to(
+            onp.asarray(q_log, "f4"), (trials, V)).copy()
+        dtok, dprob, _ = smp.sample_with_probs(
+            _keys(trials, base=10_000 * ci), ql, t, tk, tp)
+        commit, _n, _ = smp.speculative_accept(
+            _keys(trials, base=77_000 + 10_000 * ci), tl,
+            onp.asarray(dtok)[:, None], onp.asarray(dprob)[:, None],
+            t, tk, tp)
+        first = onp.asarray(commit)[:, 0]
+        emp = onp.bincount(first, minlength=V) / trials
+        expect = _softmax(onp.asarray(p_log, "f8"))
+        tv = 0.5 * onp.abs(emp - expect).sum()
+        assert tv < 0.05, (ci, emp, expect, tv)
+
+
+def test_accept_stochastic_respects_warping():
+    """The preserved distribution is the WARPED target: with top_k=2
+    every committed token lies in the target's top-2 support."""
+    trials = 800
+    p_log = onp.asarray([1.5, 1.0, -3.0, -3.0, -3.0], "f4")
+    q_log = onp.zeros(5, "f4")    # uniform draft, often outside top-2
+    t = onp.ones(trials, "f4")
+    tk = onp.full(trials, 2, "i4")
+    tp = onp.ones(trials, "f4")
+    tl = onp.broadcast_to(p_log, (trials, 2, 5)).copy()
+    ql = onp.broadcast_to(q_log, (trials, 5)).copy()
+    dtok, dprob, _ = smp.sample_with_probs(_keys(trials, 5), ql, t,
+                                           tk, tp)
+    commit, _n, _ = smp.speculative_accept(
+        _keys(trials, 99_000), tl, onp.asarray(dtok)[:, None],
+        onp.asarray(dprob)[:, None], t, tk, tp)
+    assert set(onp.asarray(commit)[:, 0].tolist()) <= {0, 1}
+
+
+def test_accept_mixed_greedy_and_stochastic_rows():
+    rng = onp.random.RandomState(8)
+    B, K, V = 4, 2, 7
+    tl = rng.randn(B, K + 1, V).astype("f4")
+    tgt = tl.argmax(-1)
+    dt = onp.zeros((B, K), "i4")
+    dt[0] = tgt[0, :K]                  # greedy row, full accept
+    qp = onp.full((B, K, V), 1.0 / V, "f4")
+    temps = onp.asarray([0.0, 1.0, 0.0, 1.0], "f4")
+    commit, n, _ = smp.speculative_accept(
+        _keys(B), tl, dt, qp, temps, onp.zeros(B, "i4"),
+        onp.ones(B, "f4"))
+    commit, n = onp.asarray(commit), onp.asarray(n)
+    assert n[0] == K + 1 and commit[0, K] == tgt[0, K]
+    assert (1 <= n).all() and (n <= K + 1).all()
+    # greedy rows always commit the target's own greedy token at the
+    # cut position, whatever the stochastic co-tenants drew
+    assert commit[2, 0] == tgt[2, 0]

@@ -3744,7 +3744,337 @@ def _spec_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --shard: SPMD sharding-layer benchmark (CPU-runnable; --smoke is the
+# tier-1-sized variant). Subprocess-isolated configs, gates ENFORCED
+# via exit code -> BENCH_r16.json:
+#
+#   train_dp / train_fsdp / train_tp : the SAME seeded GPT trained
+#            SHD_STEPS steps under each layout (parallel/partition.py)
+#            on the 8-device mesh. Reported per config: the loss
+#            sequence (parity gate: fsdp/tp within tolerance of dp —
+#            the only numeric difference is collective reduction
+#            order), MEASURED per-device param+optimizer bytes
+#            (partition.per_device_bytes walks real jax.Array shards),
+#            the analytic grad-sync comm bytes/step (the
+#            kvstore.collective_wire_bytes model: allreduce = full
+#            payload per direction, reduce-scatter/all-gather =
+#            (N-1)/N per direction), and the compiled program's
+#            collective ops (partition.hlo_collectives — structural
+#            evidence that the fsdp program contains the per-layer
+#            all-gathers and the dp program none; the CPU backend
+#            lowers the grad reduce-scatter as all-reduce +
+#            dynamic-slice, TPU/GPU emit reduce-scatter proper).
+#   serve_dense / serve_tp : the serving A/B. One tied-embedding GPT
+#            (peaky logits — the BENCH_r14 discipline) serves the
+#            same greedy workload unsharded and as ONE
+#            mesh_layout="tp" engine sharded over the mesh (params by
+#            logical axes, KV cache by heads). Gate: sha256 token
+#            digests IDENTICAL, and the TP engine's measured
+#            per-device param+cache bytes under the budget.
+#
+#   THE HEADLINE GATE: the per-device HBM budget is set to HALF the
+#            model's full param+optimizer footprint — a model that
+#            CANNOT fit a device under pure DP (full > budget by
+#            construction). train_fsdp and serve_tp must both fit
+#            their shares under it; comm bytes/step must shrink vs
+#            the dp allreduce; 0 in-window compiles everywhere.
+# ---------------------------------------------------------------------------
+SHARD_SMOKE = os.environ.get("BENCH_SHARD_SMOKE", "") not in ("", "0")
+if SHARD_SMOKE:
+    SHD_VOCAB, SHD_UNITS, SHD_LAYERS, SHD_HEADS = 128, 64, 2, 4
+    SHD_SMAX, SHD_BATCH, SHD_SEQ = 64, 16, 32
+    SHD_WARM, SHD_STEPS, SHD_REQS, SHD_MAXNEW = 2, 5, 8, 8
+else:
+    SHD_VOCAB, SHD_UNITS, SHD_LAYERS, SHD_HEADS = 512, 256, 4, 8
+    SHD_SMAX, SHD_BATCH, SHD_SEQ = 128, 32, 64
+    SHD_WARM, SHD_STEPS, SHD_REQS, SHD_MAXNEW = 3, 12, 24, 16
+SHD_LOSS_RTOL = 2e-3        # layout loss-parity tolerance (reduction
+#                             order is the only numeric difference)
+SHD_BUDGET_DEN = 2          # budget = full footprint / 2: DP cannot
+#                             fit, the sharded layouts must
+
+
+def _shd_model(tied=False):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(0)
+    net = GPTModel(vocab_size=SHD_VOCAB, units=SHD_UNITS,
+                   num_layers=SHD_LAYERS, num_heads=SHD_HEADS,
+                   max_length=SHD_SMAX)
+    net.initialize(mx.init.Xavier())
+    if tied:
+        net._gen_params()
+        params = net.collect_params()
+        params["lm_head.weight"].set_data(
+            mx.np.array(params["word_embed.weight"].data().asnumpy()))
+        net._clear_cached_op()
+    return net
+
+
+def _shd_batch():
+    import numpy as onp
+    from mxnet_tpu import np as mnp
+    rng = onp.random.RandomState(11)
+    x = rng.randint(0, SHD_VOCAB, (SHD_BATCH, SHD_SEQ + 1)).astype("i4")
+    return mnp.array(x[:, :-1]), mnp.array(x[:, 1:])
+
+
+def _shd_train_run(layout):
+    """One training config: the seeded GPT under one layout."""
+    from mxnet_tpu import gluon, parallel, telemetry
+    from mxnet_tpu.parallel import partition
+
+    class LmLoss:
+        def __call__(self, out, label):
+            return gluon.loss.SoftmaxCrossEntropyLoss()(
+                out.reshape(-1, out.shape[-1]), label.reshape(-1))
+
+    mesh = parallel.make_mesh((2, 4), ("dp", "tp")) if layout == "tp" \
+        else parallel.make_mesh((8,), ("dp",))
+    x, y = _shd_batch()
+    with parallel.mesh_scope(mesh):
+        net = _shd_model()
+        step = parallel.TrainStep(net, LmLoss(), "adam",
+                                  {"learning_rate": 1e-3}, mesh=mesh,
+                                  layout=layout)
+        losses = [float(step(x, y)) for _ in range(SHD_WARM)]
+        colls = partition.hlo_collectives(step.compiled_hlo(x, y))
+        telemetry.reset()
+        t0 = time.perf_counter()
+        losses += [float(step(x, y)) for _ in range(SHD_STEPS)]
+        dt = time.perf_counter() - t0
+        snap = telemetry.snapshot()["counters"]
+        leaves = [p.data()._data
+                  for p in net.collect_params().values()]
+        opt_leaves = [s for st in step._opt_states
+                      for s in __import__("jax").tree.leaves(st)
+                      if hasattr(s, "nbytes")]
+        full = sum(int(a.nbytes) for a in leaves + opt_leaves)
+        perdev = partition.per_device_bytes(leaves + opt_leaves)
+    print(json.dumps({
+        "mode": f"train_{layout or 'dp'}",
+        "model": f"gpt {SHD_LAYERS}L-{SHD_UNITS}u-{SHD_HEADS}h "
+                 f"vocab={SHD_VOCAB} s_max={SHD_SMAX} "
+                 f"batch={SHD_BATCH}x{SHD_SEQ}",
+        "mesh": "x".join(map(str, mesh.devices.shape)),
+        "losses": [round(l, 6) for l in losses],
+        "steps_per_sec": round(SHD_STEPS / dt, 2),
+        "comm_bytes_per_step": int(step.comm_bytes_per_step),
+        "full_footprint_bytes": full,
+        "per_device_bytes": perdev,
+        "hlo_collectives": {k: v["count"] for k, v in colls.items()},
+        "compiles_in_window":
+            int(snap.get("parallel.train_step.build", 0))
+            + int(snap.get("parallel.train_step.aot_fallback", 0)),
+    }), flush=True)
+    return 0
+
+
+def _shd_workload():
+    import numpy as onp
+    rng = onp.random.RandomState(23)
+    return [rng.randint(0, SHD_VOCAB,
+                        int(rng.randint(6, SHD_SEQ // 2))).astype("i4")
+            for _ in range(SHD_REQS)]
+
+
+def _shd_serve_run(tp):
+    """One serving config: the tied-peaky GPT, unsharded or as one
+    tensor-parallel engine over the mesh."""
+    import hashlib
+    import numpy as onp
+    from mxnet_tpu import parallel, telemetry
+    from mxnet_tpu.parallel import partition
+    from mxnet_tpu.serving import GenerationEngine
+    mesh = parallel.make_mesh((2, 4), ("dp", "tp"))
+    with parallel.mesh_scope(mesh):
+        net = _shd_model(tied=True)
+        eng = GenerationEngine(
+            net, max_slots=8, max_length=SHD_SMAX,
+            max_new_tokens=SHD_MAXNEW, queue_limit=SHD_REQS + 8,
+            mesh_layout="tp" if tp else None,
+            mesh=mesh if tp else None).warmup()
+        prompts = _shd_workload()
+        for s in [eng.submit(p, max_new_tokens=2)
+                  for p in prompts[:2]]:
+            s.result(timeout=600)          # cold-start priming
+        telemetry.reset()
+        t0 = time.perf_counter()
+        streams = [eng.submit(p) for p in prompts]
+        results = [s.result(timeout=600) for s in streams]
+        makespan = max(s.done_at for s in streams) - t0
+        snap = telemetry.snapshot()["counters"]
+        leaves = [p.data()._data
+                  for p in net.collect_params().values()]
+        full = sum(int(a.nbytes) for a in leaves) \
+            + sum(int(a.nbytes)
+                  for a in __import__("jax").tree.leaves(eng._cache))
+        perdev = partition.per_device_bytes(leaves + [eng._cache])
+        eng.close()
+    tokens = int(snap.get("serving.generate.tokens", 0))
+    print(json.dumps({
+        "mode": "serve_tp" if tp else "serve_dense",
+        "requests": SHD_REQS,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / makespan, 1),
+        "full_footprint_bytes": full,
+        "per_device_bytes": perdev,
+        "compiles_in_window":
+            int(snap.get("model.gpt.trace", 0))
+            + int(snap.get("gluon.cachedop.cache_miss", 0)),
+        "tokens_digest": hashlib.sha256(json.dumps(
+            [r.tokens for r in results]).encode()).hexdigest(),
+    }), flush=True)
+    return 0
+
+
+def _shd_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_SHARD_CONFIG"]
+    if cfg in ("train_dp", "train_fsdp", "train_tp"):
+        layout = cfg.split("_", 1)[1]
+        return _shd_train_run(None if layout == "dp" else layout)
+    if cfg in ("serve_dense", "serve_tp"):
+        return _shd_serve_run(cfg == "serve_tp")
+    raise SystemExit(f"unknown BENCH_SHARD_CONFIG {cfg!r}")
+
+
+def _shd_check_schema(doc):
+    """BENCH_r16.json contract (spec for the shared _check_schema)."""
+    train_keys = ("losses", "comm_bytes_per_step", "per_device_bytes",
+                  "full_footprint_bytes", "hlo_collectives",
+                  "compiles_in_window", "steps_per_sec")
+    serve_keys = ("tokens_digest", "per_device_bytes",
+                  "full_footprint_bytes", "tokens_per_sec",
+                  "compiles_in_window")
+    return _check_schema(
+        "BENCH_r16", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "hbm_budget_bytes": int,
+            "train_dp": dict, "train_fsdp": dict, "train_tp": dict,
+            "serve_dense": dict, "serve_tp": dict,
+            "comm_bytes_ratio_fsdp_vs_dp": float,
+            "loss_parity_ok": bool, "fits_device_budget": bool,
+            "comm_bytes_reduced": bool,
+            "tp_serving_token_identical": bool,
+            "fsdp_hlo_has_all_gather": bool,
+            "zero_compiles_in_window": bool,
+        },
+        nested={"train_dp": train_keys, "train_fsdp": train_keys,
+                "train_tp": train_keys,
+                "serve_dense": serve_keys, "serve_tp": serve_keys},
+        gates=[("the budget must exclude a full (dp) replica",
+                lambda d: d["train_dp"]["per_device_bytes"]
+                > d["hbm_budget_bytes"]),
+               ("every train config must run one equal-length, "
+                "non-empty loss sequence",
+                lambda d: len({len(d[c]["losses"]) for c in
+                               ("train_dp", "train_fsdp", "train_tp")})
+                == 1 and len(d["train_dp"]["losses"]) > 0),
+               ("the serving configs must generate tokens",
+                lambda d: d["serve_dense"]["generated_tokens"] > 0
+                and d["serve_tp"]["generated_tokens"] > 0)])
+
+
+def _shard_main():
+    import numpy as onp
+    if os.environ.get("BENCH_SHARD_CONFIG"):
+        return _shd_child()
+    smoke = SHARD_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_SHARD_SMOKE": "1"} if smoke else {}
+
+    results = {}
+    for cfg in ("train_dp", "train_fsdp", "train_tp",
+                "serve_dense", "serve_tp"):
+        _stage(f"shard: {cfg}")
+        r = _ab_child("--shard", dict(env, BENCH_SHARD_CONFIG=cfg),
+                      label=f"shard {cfg}")
+        if r is None:
+            return 1
+        results[cfg] = r
+
+    dp, fsdp, tp = (results["train_dp"], results["train_fsdp"],
+                    results["train_tp"])
+    sdense, stp = results["serve_dense"], results["serve_tp"]
+    budget = dp["full_footprint_bytes"] // SHD_BUDGET_DEN
+
+    def parity(a, b):
+        la, lb = onp.asarray(a["losses"]), onp.asarray(b["losses"])
+        return float(onp.max(onp.abs(la - lb)
+                             / onp.maximum(onp.abs(la), 1e-6)))
+    fsdp_dev = parity(dp, fsdp)
+    tp_dev = parity(dp, tp)
+    comm_ratio = round(fsdp["comm_bytes_per_step"]
+                       / max(dp["comm_bytes_per_step"], 1), 4)
+    fits = bool(fsdp["per_device_bytes"] <= budget
+                and stp["per_device_bytes"]
+                <= stp["full_footprint_bytes"] // SHD_BUDGET_DEN)
+    zero_compiles = all(results[c]["compiles_in_window"] == 0
+                        for c in results)
+    doc = _shd_check_schema({
+        "metric": "shard_fsdp_per_device_bytes_fraction",
+        "value": round(fsdp["per_device_bytes"]
+                       / max(dp["per_device_bytes"], 1), 4),
+        "unit": "per-device param+opt bytes, fsdp / dp (8 devices)",
+        "model": dp.get("model", "gpt"),   # the CHILD's actual dims
+        #                                    (smoke and full differ)
+        "smoke": bool(smoke),
+        "layouts": "dp (replicated) | fsdp (params+opt over dp) | "
+                   "tp (heads/mlp/vocab over tp, 2x4 mesh)",
+        "byte_model": "allreduce = full payload per direction; "
+                      "reduce-scatter/all-gather = (N-1)/N per "
+                      "direction (kvstore.collective_wire_bytes)",
+        "hbm_budget_bytes": int(budget),
+        "train_dp": dp, "train_fsdp": fsdp, "train_tp": tp,
+        "serve_dense": sdense, "serve_tp": stp,
+        "loss_max_rel_dev": {"fsdp": round(fsdp_dev, 6),
+                             "tp": round(tp_dev, 6)},
+        "comm_bytes_ratio_fsdp_vs_dp": comm_ratio,
+        "loss_parity_ok": bool(fsdp_dev <= SHD_LOSS_RTOL
+                               and tp_dev <= SHD_LOSS_RTOL),
+        "fits_device_budget": fits,
+        "comm_bytes_reduced": bool(
+            0 < fsdp["comm_bytes_per_step"]
+            < dp["comm_bytes_per_step"]),
+        "tp_serving_token_identical": bool(
+            sdense["tokens_digest"] == stp["tokens_digest"]),
+        "fsdp_hlo_has_all_gather": bool(
+            fsdp["hlo_collectives"].get("all-gather", 0) > 0
+            and dp["hlo_collectives"].get("all-gather", 0) == 0),
+        "zero_compiles_in_window": zero_compiles,
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_SHARD_OUT",
+                                           "BENCH_r16.json"))
+    if not smoke or "BENCH_SHARD_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("loss_parity_ok", doc["loss_parity_ok"]),
+        ("fits_device_budget", doc["fits_device_budget"]),
+        ("comm_bytes_reduced", doc["comm_bytes_reduced"]),
+        ("tp_serving_token_identical",
+         doc["tp_serving_token_identical"]),
+        ("fsdp_hlo_has_all_gather", doc["fsdp_hlo_has_all_gather"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] shard gates failed: {', '.join(failed)} "
+              f"(loss_dev fsdp={fsdp_dev:.2g} tp={tp_dev:.2g} "
+              f"comm_ratio={comm_ratio} "
+              f"fsdp_dev_bytes={fsdp['per_device_bytes']} "
+              f"budget={budget})", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--shard" in sys.argv:
+        return _shard_main()
     if "--spec" in sys.argv:
         return _spec_main()
     if "--quant" in sys.argv:

@@ -25,6 +25,18 @@ module captures everything a killed-and-resumed
 leaves in the tree (sharded to disk), JSON scalars in the metadata
 (folded into the manifest, replacing the old ``opt_counters.json``
 sidecar which silently dropped lr-scheduler state).
+
+RESHARD-ON-RESTORE (docs/SHARDING.md): checkpoints hold FULL
+(unsharded) arrays — save gathers each global ``jax.Array`` host-side
+and the manifest records the full-array shapes — and restore places
+every leaf onto the LIVE buffer's ``NamedSharding``
+(``_placed_like``). So a TP-/FSDP-sharded ``TrainStep`` resumes
+bit-identically onto the same layout, and a checkpoint written under
+one layout/mesh shape restores cleanly onto another (the fsdp-on-(8,)
+→ tp-on-(2,4) round trip is pinned by tests/test_partition.py):
+params land on the new layout at apply time, and optimizer-state
+leaves restored before the step is built are re-placed onto the
+resolved state shardings by the next ``TrainStep._build``.
 """
 from __future__ import annotations
 

@@ -61,6 +61,18 @@ NDArrays (the CachedOp ``raw_fn`` rebinding idiom, gluon/block.py), and
 count ``model.gpt.trace`` each time they actually trace — the
 telemetry hook tests and the serving engine use to assert zero
 steady-state compiles.
+
+TENSOR-PARALLEL serving (``GenerationEngine(mesh_layout="tp")``;
+docs/SHARDING.md): every parameter carries NAMED LOGICAL AXES
+(``Parameter.logical_axes`` — q/k/v/out by heads, ffn1/ffn2 by the
+mlp dim, embeddings/lm_head by vocab) that
+``parallel.partition.Partitioner`` resolves to mesh placements. The
+generation closures are TP-aware by construction: parameters and the
+KV cache (sharded over the HEADS axis) enter as COMMITTED sharded
+arrays, so the same jitted closures compile SPMD over the mesh —
+no second code path, and greedy output stays token-identical to the
+unsharded engine (the ``tp`` partial-sum reduction order is the only
+numeric difference).
 """
 from __future__ import annotations
 
@@ -459,6 +471,7 @@ class GPTModel(HybridBlock):
         self.ln_f = LayerNorm()
         self.lm_head = Dense(vocab_size, use_bias=False, flatten=False,
                              dtype=dtype)
+        self._annotate_logical_axes()
         self._gen = None  # (param_nds, prefill_jit, decode_jit, ...)
         self._paged = None  # paged-cache closures (_ensure_paged)
         #: fused speculative closures, keyed (kind, k, sampled) —
@@ -470,6 +483,40 @@ class GPTModel(HybridBlock):
         #: arguments (so a rollover re-quantize installs new values
         #: without retracing — the dense-engine swap discipline).
         self._quant = None
+
+    def _annotate_logical_axes(self):
+        """Stamp every parameter with its NAMED LOGICAL AXES
+        (``parallel/partition.py``): the partitioner's ordered rule
+        list maps these to mesh axes, so one metadata set serves every
+        layout — ``"tp"`` shards q/k/v/out by heads and ffn1/ffn2 by
+        the mlp dim over ``tp`` and the embeddings/lm_head over the
+        vocab dim; ``"fsdp"`` shards everything over ``dp`` along its
+        first shardable dim. Dense weights are ``(out, in)``;
+        Embedding weights ``(vocab, embed)``."""
+        self.word_embed.weight.logical_axes = ("vocab", "embed")
+        self.position_weight.logical_axes = (None, "embed")
+        self.lm_head.weight.logical_axes = ("vocab", "embed")
+        for ln in [self.ln_f]:
+            ln.gamma.logical_axes = ("embed",)
+            ln.beta.logical_axes = ("embed",)
+        for blk in self._blocks():
+            for name in ("q_proj", "k_proj", "v_proj"):
+                layer = getattr(blk, name)
+                layer.weight.logical_axes = ("heads", "embed")
+                if layer.bias is not None:
+                    layer.bias.logical_axes = ("heads",)
+            blk.out_proj.weight.logical_axes = ("embed", "heads")
+            if blk.out_proj.bias is not None:
+                blk.out_proj.bias.logical_axes = ("embed",)
+            blk.ffn1.weight.logical_axes = ("mlp", "embed")
+            if blk.ffn1.bias is not None:
+                blk.ffn1.bias.logical_axes = ("mlp",)
+            blk.ffn2.weight.logical_axes = ("embed", "mlp")
+            if blk.ffn2.bias is not None:
+                blk.ffn2.bias.logical_axes = ("embed",)
+            for ln in (blk.ln1, blk.ln2):
+                ln.gamma.logical_axes = ("embed",)
+                ln.beta.logical_axes = ("embed",)
 
     @property
     def max_length(self):

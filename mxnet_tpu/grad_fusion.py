@@ -38,7 +38,8 @@ import jax.numpy as jnp
 from . import telemetry
 
 __all__ = ["fused_enabled", "default_fusion_bytes", "build_buckets",
-           "allreduce_bucket", "GradBucket", "DEFAULT_FUSION_BYTES"]
+           "allreduce_bucket", "reduce_scatter_bucket", "GradBucket",
+           "DEFAULT_FUSION_BYTES"]
 
 DEFAULT_FUSION_BYTES = 4 << 20  # 4 MiB, Horovod's fusion-buffer default
 
@@ -164,9 +165,40 @@ def _unflatten_fn(shapes):
     return jax.jit(split)
 
 
+def _sharded_layout(kvstore):
+    """The active partition layout when it licenses the reduce-scatter
+    bucket path: optimizer state sharded over the batch axis (fsdp), a
+    real multi-device mesh on that axis, and a kvstore advertising the
+    capability. None → the classic allreduce path."""
+    from .parallel import partition as _partition
+    layout = _partition.current_layout()
+    if layout is None or layout.grad_collective != "reduce_scatter":
+        return None
+    if not kvstore.is_capable("reduce_scatter"):
+        return None
+    try:
+        mesh = layout.mesh
+    except RuntimeError:
+        return None
+    if int(mesh.shape.get(layout.batch_axis, 1)) <= 1:
+        return None
+    return layout
+
+
 def allreduce_bucket(bucket, kvstore):
     """Flatten → fused collective → unflatten one bucket, installing
-    the reduced gradients back into the parameters' grad buffers."""
+    the reduced gradients back into the parameters' grad buffers.
+
+    Under an active ``"fsdp"`` partition layout
+    (``parallel.partition.layout_scope``) and a capable kvstore the
+    collective is reduce-scatter + all-gather instead of the full
+    allreduce — bitwise-equal output (unit-proven), ``(N-1)/N`` of
+    the bytes per direction (``kvstore.collective_wire_bytes``), and
+    each device only ever materializes its own reduced shard between
+    the two halves."""
+    layout = _sharded_layout(kvstore)
+    if layout is not None:
+        return reduce_scatter_bucket(bucket, kvstore, layout)
     t0 = telemetry.clock()
     grads = [p.grad() for p in bucket.params]  # raises like the
     # per-param path when a grad buffer was never attached
@@ -178,4 +210,35 @@ def allreduce_bucket(bucket, kvstore):
     telemetry.duration_since("trainer.fused.allreduce", t0)
     if telemetry.enabled():
         telemetry.counter("trainer.fused.buckets")
+        telemetry.counter("trainer.fused.params", len(grads))
+
+
+def reduce_scatter_bucket(bucket, kvstore, layout):
+    """The fsdp-layout bucket sync: flatten → reduce-scatter (each
+    device keeps the 1/n shard whose optimizer state it owns) →
+    all-gather → unflatten. Output bitwise equal to
+    ``allreduce_bucket``'s; the wire-byte counters
+    (``kvstore.{reduce_scatter,all_gather}.bytes``) record the
+    ``(n-1)/n``-per-direction saving."""
+    t0 = telemetry.clock()
+    grads = [p.grad() for p in bucket.params]
+    flat = _flatten_fn(len(grads))(*[g._data for g in grads])
+    mesh, axis = layout.mesh, layout.batch_axis
+    n = int(mesh.shape.get(axis, 1))
+    if flat.shape[0] % n:
+        # the scatter needs n even shards: pad the fusion buffer tail
+        # (Horovod's fusion-buffer discipline); _unflatten_fn slices
+        # by exact offsets, so the pad never reaches a gradient
+        flat = jnp.pad(flat, (0, n - flat.shape[0] % n))
+    shard = kvstore.fused_reduce_scatter(bucket.key, flat, mesh=mesh,
+                                         axis_name=axis)
+    full = kvstore.fused_all_gather(bucket.key, shard, mesh=mesh,
+                                    axis_name=axis)
+    parts = _unflatten_fn(bucket.shapes)(full)
+    for g, part in zip(grads, parts):
+        g._install(part)
+    telemetry.duration_since("trainer.fused.reduce_scatter", t0)
+    if telemetry.enabled():
+        telemetry.counter("trainer.fused.buckets")
+        telemetry.counter("trainer.fused.rs_buckets")
         telemetry.counter("trainer.fused.params", len(grads))

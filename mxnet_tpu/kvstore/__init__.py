@@ -19,6 +19,11 @@ Parity with the reference's KVStore stack (SURVEY.md §2.3):
 """
 from __future__ import annotations
 
+import jax
+from jax.sharding import PartitionSpec as _P
+
+from .. import telemetry as _telemetry
+
 from .base import KVStoreBase  # noqa: F401
 from . import horovod  # noqa: F401  (registers 'horovod')
 from . import byteps  # noqa: F401  (registers 'byteps')
@@ -33,6 +38,135 @@ def create(name="local"):
     if not isinstance(name, str):
         raise TypeError("name must be a string")
     return KVStoreBase.create(name)
+
+
+# ---------------------------------------------------------------------------
+# sharded collectives — the reduce-scatter/all-gather pair beside the
+# allreduce (parallel.allreduce). When the optimizer state is already
+# sharded over the reduction axis (the "fsdp" layout), the gradient
+# can be reduced STRAIGHT INTO the owning shard (reduce-scatter) and
+# the updated shard broadcast back (all-gather): (N-1)/N of the bytes
+# per direction instead of the full gradient each way, and no device
+# ever holds a second full copy. reduce_scatter + all_gather is
+# BITWISE equal to allreduce on the local mesh (unit-proven,
+# tests/test_partition.py) — the layouts choose purely on bytes.
+# ---------------------------------------------------------------------------
+
+def collective_wire_bytes(kind: str, nbytes: int, n: int) -> int:
+    """Per-device wire bytes of one collective over ``n`` participants
+    under the byte model the telemetry counters record: a full
+    allreduce moves the payload once per direction (the push+pull
+    accounting ``kvstore.push_bytes``/``pull_bytes`` already use);
+    reduce-scatter and all-gather each move ``(n-1)/n`` of it in ONE
+    direction (every participant sends/receives all shards but its
+    own)."""
+    if n <= 1:
+        return 0
+    if kind == "allreduce":
+        return 2 * int(nbytes)
+    if kind in ("reduce_scatter", "all_gather"):
+        return int(nbytes) * (n - 1) // n
+    raise ValueError(f"unknown collective {kind!r}")
+
+
+def _collective_mesh(mesh):
+    if mesh is None:
+        from .. import parallel
+        mesh = parallel.get_mesh()
+    if mesh is None:
+        raise RuntimeError(
+            "no mesh set; pass mesh= or call parallel.set_mesh first")
+    return mesh
+
+
+def _par():
+    # the spec helpers shared with parallel.allreduce live there so
+    # the collective semantics cannot drift (lazy: import-cycle-safe)
+    from .. import parallel
+    return parallel
+
+
+def reduce_scatter(value, mesh=None, axis_name="dp", axis=0):
+    """Sum-reduce ``value`` over ``axis_name`` and leave each
+    participant holding its ``1/n`` shard along dim ``axis`` — the
+    cheap half of a sharded gradient sync (the owning shard's
+    optimizer update needs nothing else). Same contribution semantics
+    as ``parallel.allreduce``: an ``axis_name``-sharded array's blocks
+    are summed; a replicated array's copies each count once. Returns
+    the NDArray with its data sharded over ``axis_name`` along
+    ``axis``; follow with :func:`all_gather` to rebuild the full
+    reduction (bitwise equal to ``parallel.allreduce``)."""
+    mesh = _collective_mesh(mesh)
+    n = int(mesh.shape.get(axis_name, 1))
+    if n == 1:
+        return value
+    from .._shard_compat import shard_map
+    data, spec = _par().on_mesh(value._data, mesh)
+    entries = list(spec) + [None] * (data.ndim - len(spec))
+    if entries[axis] not in (None, axis_name):
+        raise ValueError(
+            f"reduce_scatter: dim {axis} is sharded over "
+            f"{entries[axis]!r}; only {axis_name!r}-sharded or "
+            f"unsharded scatter dims are supported")
+    # each participant's LOCAL block must split into n shards
+    local = data.shape[axis] // (n if entries[axis] == axis_name else 1)
+    if local % n:
+        raise ValueError(
+            f"reduce_scatter: local dim {axis} (size {local}) must be "
+            f"divisible by mesh axis {axis_name!r} (size {n})")
+    out_entries = [_par().strip_axis(e, axis_name)
+                   for e in entries]
+    out_entries[axis] = axis_name
+    out_spec = _P(*out_entries)
+    fn = shard_map(
+        lambda x: jax.lax.psum_scatter(x, axis_name,
+                                       scatter_dimension=axis,
+                                       tiled=True),
+        mesh=mesh, in_specs=spec, out_specs=out_spec, check_rep=False)
+    out = fn(data)
+    if _telemetry.enabled():
+        _telemetry.counter(
+            "kvstore.reduce_scatter.bytes",
+            collective_wire_bytes("reduce_scatter",
+                                  _result_nbytes(out), n))
+    value._install(out)
+    return value
+
+
+def all_gather(value, mesh=None, axis_name="dp", axis=0):
+    """Gather an ``axis_name``-sharded array's blocks along ``axis``
+    onto every participant (the broadcast half of the sharded sync:
+    each device rebuilds the full updated parameter from the owning
+    shards). Returns the NDArray replicated over ``axis_name``."""
+    mesh = _collective_mesh(mesh)
+    n = int(mesh.shape.get(axis_name, 1))
+    if n == 1:
+        return value
+    from .._shard_compat import shard_map
+    data, spec = _par().on_mesh(value._data, mesh)
+    entries = list(spec) + [None] * (data.ndim - len(spec))
+    if entries[axis] != axis_name:
+        raise ValueError(
+            f"all_gather: dim {axis} is not sharded over "
+            f"{axis_name!r} (spec {spec})")
+    out_entries = list(entries)
+    out_entries[axis] = None
+    fn = shard_map(
+        lambda x: jax.lax.all_gather(x, axis_name, axis=axis,
+                                     tiled=True),
+        mesh=mesh, in_specs=spec, out_specs=_P(*out_entries),
+        check_rep=False)
+    out = fn(data)
+    if _telemetry.enabled():
+        _telemetry.counter(
+            "kvstore.all_gather.bytes",
+            collective_wire_bytes("all_gather", _result_nbytes(out), n))
+    value._install(out)
+    return value
+
+
+def _result_nbytes(data):
+    return int(getattr(data, "nbytes", 0))
 
 
 class KVStoreServer:

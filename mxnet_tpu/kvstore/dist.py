@@ -120,6 +120,17 @@ class KVStoreDistSync(KVStoreLocal):
         # (gradient_compression.h)
         return self._global_reduce(flat_data)
 
+    def is_capable(self, capability):
+        # do NOT advertise "reduce_scatter": the inherited
+        # fused_reduce_scatter's reduce half is _fused_collective,
+        # which here is the FULL DCN allreduce — routing fsdp buckets
+        # through it would pay the full wire bytes plus two extra
+        # reshards while the telemetry claimed (n-1)/n savings. A
+        # real cross-host psum_scatter override can re-enable it.
+        if capability == "reduce_scatter":
+            return False
+        return super().is_capable(capability)
+
 
 # registry aliases
 KVStoreBase.kv_registry["dist"] = KVStoreDistSync

@@ -180,9 +180,66 @@ class KVStoreLocal(KVStoreBase):
         # one logical replica in-process: nothing left to reduce
         return flat_data
 
+    def fused_reduce_scatter(self, key, flat_data, mesh=None,
+                             axis_name="dp"):
+        """The sharded-layout sibling of ``fused_pushpull``: reduce one
+        fusion bucket and leave each device holding its ``1/n`` shard
+        (the shard whose optimizer state it owns under the ``"fsdp"``
+        layout — see parallel/partition.py).
+
+        On the single-process backends the REDUCE half is the identity
+        (one logical replica, exactly like ``fused_pushpull``) and the
+        scatter is a real mesh layout transfer; a distributed backend
+        must override BOTH this and ``is_capable("reduce_scatter")``
+        with a real cross-host ``psum_scatter`` — ``KVStoreDistSync``
+        advertises False until it has one, so fsdp buckets there keep
+        the plain fused allreduce. Wire bytes are counted under the
+        shared ``collective_wire_bytes`` ring model either way —
+        ``(n-1)/n`` of the bucket per direction instead of the full
+        bucket ``fused_pushpull`` moves. Returns the sharded flat
+        buffer; rebuild with :meth:`fused_all_gather`."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import collective_wire_bytes, _collective_mesh
+        mesh = _collective_mesh(mesh)
+        n = int(mesh.shape.get(axis_name, 1))
+        t0 = telemetry.clock()
+        if self._compression is not None:
+            flat_data = self._compression.compress(key, 0, flat_data)
+        flat_data = self._fused_collective(flat_data)
+        out = jax.device_put(flat_data,
+                             NamedSharding(mesh, P(axis_name)))
+        telemetry.duration_since("kvstore.fused.reduce_scatter", t0)
+        if telemetry.enabled():
+            telemetry.counter("kvstore.fused.collectives")
+            telemetry.counter(
+                "kvstore.reduce_scatter.bytes",
+                collective_wire_bytes("reduce_scatter",
+                                      getattr(out, "nbytes", 0), n))
+        return out
+
+    def fused_all_gather(self, key, shard_data, mesh=None,
+                         axis_name="dp"):
+        """Rebuild a ``fused_reduce_scatter`` bucket on every device
+        (the broadcast half of the sharded sync — runs AFTER the
+        sharded optimizer update under the fsdp layout)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from . import collective_wire_bytes, _collective_mesh
+        mesh = _collective_mesh(mesh)
+        n = int(mesh.shape.get(axis_name, 1))
+        t0 = telemetry.clock()
+        out = jax.device_put(shard_data, NamedSharding(mesh, P()))
+        telemetry.duration_since("kvstore.fused.all_gather", t0)
+        if telemetry.enabled():
+            telemetry.counter(
+                "kvstore.all_gather.bytes",
+                collective_wire_bytes("all_gather",
+                                      getattr(out, "nbytes", 0), n))
+        return out
+
     # -- optimizer offload ---------------------------------------------
     def is_capable(self, capability):
-        return capability in (KVStoreBase.OPTIMIZER, KVStoreBase.FUSED)
+        return capability in (KVStoreBase.OPTIMIZER, KVStoreBase.FUSED,
+                              "reduce_scatter")
 
     def set_optimizer(self, optimizer):
         assert isinstance(optimizer, Optimizer)

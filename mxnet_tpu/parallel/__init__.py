@@ -115,6 +115,31 @@ def shard_params(params, rules=None, mesh: Mesh = None):
                                             NamedSharding(mesh, spec)))
 
 
+def strip_axis(entry, axis_name):
+    """One PartitionSpec entry with ``axis_name`` removed (None when
+    nothing remains) — the reduced-away axis of a collective's output
+    spec. Shared by ``allreduce`` and the kvstore
+    ``reduce_scatter``/``all_gather`` pair so the spec semantics
+    cannot drift between them."""
+    if entry is None:
+        return None
+    if isinstance(entry, (tuple, list)):
+        rem = tuple(a for a in entry if a != axis_name)
+        return rem if rem else None
+    return None if entry == axis_name else entry
+
+
+def on_mesh(data, mesh: Mesh):
+    """``(data, spec)`` with ``data`` guaranteed to live on ``mesh``
+    (a value from elsewhere is replicated onto it first) — the
+    imperative collectives' shared input convention."""
+    sh = getattr(data, "sharding", None)
+    if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
+        data = jax.device_put(data, NamedSharding(mesh, P()))
+        sh = data.sharding
+    return data, sh.spec
+
+
 def allreduce(value: NDArray, op="sum", mesh: Mesh = None,
               axis_name=AXIS_DP) -> NDArray:
     """Imperative cross-device reduction: a REAL psum/pmax/pmin over
@@ -136,25 +161,10 @@ def allreduce(value: NDArray, op="sum", mesh: Mesh = None,
 
     reducer = {"sum": jax.lax.psum, "max": jax.lax.pmax,
                "min": jax.lax.pmin}[op]
-    data = value._data
-    sh = getattr(data, "sharding", None)
-    if not (isinstance(sh, NamedSharding) and sh.mesh == mesh):
-        # not on this mesh yet: replicate onto it first
-        data = jax.device_put(data, NamedSharding(mesh, P()))
-        sh = data.sharding
-    spec = sh.spec
-
-    def _strip(entry):
-        # output stays sharded over the OTHER axes; only `axis_name`
-        # is reduced away
-        if entry is None:
-            return None
-        if isinstance(entry, (tuple, list)):
-            rem = tuple(a for a in entry if a != axis_name)
-            return rem if rem else None
-        return None if entry == axis_name else entry
-
-    out_spec = P(*[_strip(e) for e in spec])
+    data, spec = on_mesh(value._data, mesh)
+    # output stays sharded over the OTHER axes; only `axis_name` is
+    # reduced away
+    out_spec = P(*[strip_axis(e, axis_name) for e in spec])
     fn = shard_map(lambda x: reducer(x, axis_name), mesh=mesh,
                    in_specs=spec, out_specs=out_spec)
     out = fn(data)
@@ -216,3 +226,5 @@ from .train_step import TrainStep  # noqa: E402,F401
 from .moe import moe_ffn  # noqa: E402,F401  (expert parallel, 'ep')
 from .pipeline import pipeline_apply  # noqa: E402,F401  ('pp')
 from .checkpoint import save_sharded, load_sharded  # noqa: E402,F401
+from . import partition  # noqa: E402,F401  (SPMD logical-axis layer)
+from .partition import Partitioner  # noqa: E402,F401

@@ -58,6 +58,21 @@ class TrainStep:
     batch_axis : mesh axis name the leading batch dim is sharded over
     param_rules : list of (regex, PartitionSpec) giving tensor-parallel
         placements by parameter name; unmatched params are replicated.
+        With ``layout=`` set this is the ESCAPE HATCH: a matching rule
+        overrides the layout's logical-axis resolution for that
+        parameter.
+    layout : str or parallel.partition.Partitioner, optional
+        Named SPMD layout over the mesh — ``"dp"`` (pure data
+        parallel, the default behavior), ``"tp"`` (tensor parallel by
+        logical axes), ``"fsdp"`` (params + optimizer state sharded
+        over the batch axis; XLA all-gathers each layer's weights
+        inside the step — overlapped with compute by the
+        latency-hiding scheduler — and reduces gradients straight
+        into the owning shard: reduce-scatter semantics, ``(N-1)/N``
+        of the allreduce bytes per direction). Parameters resolve
+        through their ``logical_axes`` metadata (gpt.py annotates the
+        GPT family; un-annotated params stay replicated — use
+        ``param_rules`` for those). Requires a mesh.
     bucketing : BucketingPolicy, optional
         Pad odd batches (the last partial batch of every epoch) up to
         a bucket so they reuse an existing compiled entry instead of
@@ -69,7 +84,7 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, batch_axis=AXIS_DP, param_rules=None,
-                 donate=True, bucketing=None):
+                 layout=None, donate=True, bucketing=None):
         from .. import optimizer as opt_mod
         self.net = net
         self.loss_fn = loss_fn
@@ -80,6 +95,11 @@ class TrainStep:
         self.batch_axis = batch_axis
         self.param_rules = [(re.compile(pat), spec)
                             for pat, spec in (param_rules or [])]
+        self._layout = layout
+        self._partitioner = None
+        #: analytic gradient-sync wire bytes per step for the resolved
+        #: layout (kvstore.collective_wire_bytes model); set at build
+        self.comm_bytes_per_step = 0
         self.donate = donate
         # False is a distinct value: "no bucketing, not even the
         # global policy" (as_policy would collapse it to None = inherit)
@@ -95,6 +115,27 @@ class TrainStep:
     def mesh(self):
         return self._explicit_mesh or get_mesh()
 
+    @property
+    def partitioner(self):
+        """The resolved layout Partitioner (built lazily: the mesh may
+        be the process-global one set after construction). None when
+        no ``layout=`` was requested."""
+        if self._layout is None:
+            return None
+        if self._partitioner is None:
+            from . import partition as _partition
+            if isinstance(self._layout, _partition.Partitioner):
+                self._partitioner = self._layout
+            else:
+                if self.mesh is None:
+                    raise RuntimeError(
+                        f"TrainStep(layout={self._layout!r}) needs a "
+                        f"mesh: pass mesh= or parallel.set_mesh first")
+                self._partitioner = _partition.Partitioner(
+                    self._layout, mesh=self.mesh,
+                    batch_axis=self.batch_axis)
+        return self._partitioner
+
     def _spec_for(self, name):
         for pat, spec in self.param_rules:
             if pat.search(name):
@@ -109,6 +150,13 @@ class TrainStep:
             CachedOp(net)._abstract_init(list(data_leaves),
                                          data_spec)
             params_dict = net.collect_params()
+
+        part = self.partitioner
+        if part is not None:
+            # resolve every parameter's logical axes to a spec over
+            # the mesh (p.sharding), param_rules overriding per name —
+            # the pjit wiring below consumes p.sharding as before
+            part.annotate(params_dict, override_rules=self.param_rules)
 
         names = list(params_dict.keys())
         params = [params_dict[n] for n in names]
@@ -283,6 +331,23 @@ class TrainStep:
                 d = frozen_nds[j]._data
                 if not _placed_as(d, frozen_sh[j]):
                     frozen_nds[j]._data = jax.device_put(d, frozen_sh[j])
+            # layout accounting: the analytic grad-sync wire bytes of
+            # the resolved layout (the bench A/B's comm metric) and the
+            # MEASURED per-device param+optimizer footprint (the "fits
+            # one device's share of HBM" gate walks real shards)
+            from . import partition as _partition
+            spec_map = {names[i]: diff_sh[k].spec
+                        for k, i in enumerate(diff_idx)}
+            self.comm_bytes_per_step = _partition.grad_sync_bytes(
+                spec_map, {names[i]: params[i] for i in diff_idx},
+                mesh, self.batch_axis)
+            telemetry.gauge("parallel.train_step.comm_bytes_per_step",
+                            self.comm_bytes_per_step)
+            telemetry.gauge(
+                "parallel.partition.bytes_per_device",
+                _partition.per_device_bytes(
+                    [nd._data for nd in diff_nds]
+                    + [nd._data for nd in frozen_nds] + list(states)))
         else:
             data_sh = label_sh = None
 
@@ -529,6 +594,9 @@ class TrainStep:
             "parallel.train_step.chain_compile" if first_dispatch else
             "parallel.train_step.run_chain", t0)
         telemetry.counter("parallel.train_step.chained_steps", n_steps)
+        if self.comm_bytes_per_step and telemetry.enabled():
+            telemetry.counter("parallel.train_step.comm_bytes",
+                              self.comm_bytes_per_step * n_steps)
         self._check_maskable(entry, int(pads.max()) if len(pads) else 0)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
@@ -615,6 +683,9 @@ class TrainStep:
         telemetry.duration_since(
             "parallel.train_step.compile" if first_dispatch else
             "parallel.train_step.run", t0)
+        if self.comm_bytes_per_step and telemetry.enabled():
+            telemetry.counter("parallel.train_step.comm_bytes",
+                              self.comm_bytes_per_step)
         self._check_maskable(entry, pad)
 
         for nd, nw in zip(entry["diff_nds"], new_ws):
@@ -626,6 +697,37 @@ class TrainStep:
                 nd._install(new)
         engine.sample_memory()
         return NDArray(engine.track(loss))
+
+    # -- introspection -------------------------------------------------
+    def compiled_hlo(self, data, label):
+        """Compiled HLO text of the entry serving this batch signature
+        — the bench's structural-evidence hook: ``bench.py --shard``
+        feeds it to ``partition.hlo_collectives`` to show the fsdp
+        program really contains the per-layer all-gathers (and the dp
+        program contains none). Build the entry (run one step) first;
+        this lowers/compiles a fresh executable for inspection, so
+        call it OUTSIDE any timed window."""
+        data_leaves, data_spec = _flatten_arrays(_as_tuple(data))
+        label_leaves, label_spec = _flatten_arrays(_as_tuple(label))
+        data_leaves, label_leaves, _pad = self._apply_bucketing(
+            data_leaves, label_leaves, None)
+        _, entry = self._get_entry(data_leaves, data_spec,
+                                   label_leaves, label_spec)
+        opt = self.optimizer
+        n_diff = len(entry["diff_nds"])
+        hypers = [opt._hyper(k) for k in range(n_diff)]
+        abstract = [jax.ShapeDtypeStruct(l.shape, l.dtype)
+                    for l in data_leaves]
+        labstract = [jax.ShapeDtypeStruct(l.shape, l.dtype)
+                     for l in label_leaves]
+        bsz = next((l.shape[0] for l in data_leaves if l.ndim), 1)
+        lowered = entry["jit"].lower(
+            next_key(),
+            tuple(nd._data for nd in entry["diff_nds"]),
+            tuple(nd._data for nd in entry["frozen_nds"]),
+            tuple(self._opt_states), hypers,
+            tuple(abstract), tuple(labstract), onp.int32(bsz))
+        return lowered.compile().as_text()
 
     # -- AOT warmup ----------------------------------------------------
     def warmup(self, shapes, dtype="float32", label_dtype="int32"):

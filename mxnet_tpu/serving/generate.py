@@ -504,6 +504,21 @@ class GenerationEngine:
         Defaults to ``draft_model is not None``. Passing
         ``speculative=True`` without a draft raises — self-speculation
         is not implemented.
+    mesh_layout : str, optional
+        ``"tp"`` runs ONE model sharded across the device mesh
+        (tensor parallel — parallel/partition.py's ``"tp"`` layout):
+        the attention/MLP weights are placed over the mesh's ``tp``
+        axis by their logical axes, the KV cache is sharded over the
+        HEADS axis, and every generation program compiles SPMD — so a
+        model (plus cache) larger than one device's HBM serves from
+        the whole mesh. Greedy output is token-identical to the
+        unsharded engine (the only numeric difference is the
+        reduction order of the ``tp`` partial sums). Currently the
+        dense fp32 engine only; ``num_heads`` must be divisible by
+        the ``tp`` axis size.
+    mesh : jax.sharding.Mesh, optional
+        The mesh for ``mesh_layout`` (default: the process-global
+        ``parallel.get_mesh()``). Must carry a ``tp`` axis.
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
@@ -514,7 +529,7 @@ class GenerationEngine:
                  n_pages=None, prefill_chunk=None,
                  prefix_cache: bool = True, quantize=None,
                  kv_dtype=None, draft_model=None, spec_k: int = 4,
-                 speculative=None):
+                 speculative=None, mesh_layout=None, mesh=None):
         self.paged = bool(paged)
         if speculative is None:
             speculative = draft_model is not None
@@ -593,6 +608,52 @@ class GenerationEngine:
             raise ValueError("max_slots must be >= 1")
         if int(max_new_tokens) < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        self.mesh_layout = mesh_layout
+        self._part = None
+        self._tp_heads = 0
+        self._cache_sh = None  # canonical TP cache shardings (lazy)
+        if mesh_layout is not None:
+            if mesh_layout != "tp":
+                raise ValueError(
+                    f"unsupported mesh_layout={mesh_layout!r} (only "
+                    f"'tp')")
+            if self.paged or self.speculative or quantize is not None \
+                    or cache_dtype is not None:
+                raise ValueError(
+                    "mesh_layout='tp' currently composes with the "
+                    "dense fp32 engine only (paged / speculative / "
+                    "int8 engines stay single-device)")
+            from .. import parallel as _parallel
+            from ..parallel import partition as _partition
+            m = mesh if mesh is not None else _parallel.get_mesh()
+            if m is None:
+                raise RuntimeError(
+                    "mesh_layout='tp' needs a mesh: pass mesh= or "
+                    "call parallel.set_mesh first")
+            tp = int(m.shape.get("tp", 1))
+            if tp <= 1:
+                raise ValueError(
+                    "mesh_layout='tp' needs a mesh with a 'tp' axis "
+                    "of size > 1 (parallel.make_mesh((1, n), "
+                    "('dp', 'tp')))")
+            n_heads = int(getattr(model, "_num_heads", 0) or 0)
+            if n_heads <= 0:
+                raise TypeError(
+                    "mesh_layout='tp' needs a model exposing "
+                    "_num_heads (the KV cache shards by heads; "
+                    "gluon.model_zoo.gpt.GPTModel does)")
+            if n_heads % tp:
+                raise ValueError(
+                    f"num_heads {n_heads} is not divisible by the tp "
+                    f"axis size {tp}: the KV cache shards by heads")
+            self._tp_heads = n_heads
+            self._part = _partition.Partitioner("tp", mesh=m)
+            # place the parameters over the mesh BEFORE any closure
+            # traces: the jitted generation programs read the params'
+            # committed shardings and compile SPMD
+            if callable(getattr(model, "_gen_params", None)):
+                model._gen_params()   # materialize deferred shapes
+            self._part.place(model.collect_params())
         self.model = model
         self.max_slots = int(max_slots)
         self.max_new_tokens = int(max_new_tokens)
@@ -786,15 +847,37 @@ class GenerationEngine:
                       onp.zeros((b,), "f4"),
                       onp.zeros((b,), "i4"), onp.ones((b,), "f4"))
 
-    @staticmethod
-    def _commit(cache):
-        """Pin a cache pytree to its device (see the constructor
+    def _commit(self, cache):
+        """Pin a cache pytree to its device(s) (see the constructor
         note: committed and uncommitted inputs compile SEPARATE pjit
         executables, and caches cross that line after their first
-        donated step). The target device must be EXPLICIT — a bare
-        ``device_put`` preserves the uncommitted state."""
+        donated step). The target must be EXPLICIT — a bare
+        ``device_put`` preserves the uncommitted state. Under
+        ``mesh_layout="tp"`` the target is the partitioner's cache
+        sharding (K/V over the heads axis) instead of one device."""
         import jax
+        if self._part is not None:
+            return self._part.place_cache(cache, self._tp_heads)
         return jax.device_put(cache, jax.devices()[0])
+
+    def _recommit(self, cache):
+        """TP mode: pin a jitted step's returned cache back onto the
+        canonical heads-sharded placement, so every program always
+        sees ONE input-sharding signature (GSPMD is free to pick a
+        different output sharding, and the pjit executable cache keys
+        on input shardings — a drifting cache would silently compile
+        a second executable per program). The shardings pytree is
+        computed ONCE (the cache's shapes are fixed for the engine's
+        lifetime) so the per-step cost is one device_put that is a
+        no-op copy-wise when the shardings already match. Entirely
+        outside TP mode."""
+        if self._part is None:
+            return cache
+        import jax
+        if self._cache_sh is None:
+            self._cache_sh = self._part.cache_shardings(cache,
+                                                        self._tp_heads)
+        return jax.device_put(cache, self._cache_sh)
 
     # -- lifecycle -----------------------------------------------------
     @contextlib.contextmanager
@@ -840,6 +923,11 @@ class GenerationEngine:
                 toks = onp.zeros((1, sb), "i4")
                 _, cache = self.model.prefill(toks, [sb], cache,
                                               slots=[0])
+                if self._part is not None:
+                    # pin back to the canonical heads-sharded layout
+                    # so every program warms against the ONE input
+                    # sharding signature the live path will feed it
+                    cache = self._recommit(cache)
             lg, cache = self.model.decode_step(
                 onp.zeros((self.max_slots,), "i4"), cache)
             self._warm_samplers(int(lg.shape[-1]))
@@ -1218,6 +1306,8 @@ class GenerationEngine:
         logits, self._cache = self.model.prefill(
             padded, onp.asarray([n], "i4"), self._cache,
             slots=onp.asarray([slot], "i4"))
+        if self._part is not None:
+            self._cache = self._recommit(self._cache)
         if self.speculative:
             # the draft mirrors the target's committed prefix from the
             # moment the slot exists — its own (dense) prefill of the
@@ -1628,6 +1718,8 @@ class GenerationEngine:
                 toks[i] = s.last
         t0 = telemetry.clock()
         logits, self._cache = self.model.decode_step(toks, self._cache)
+        if self._part is not None:
+            self._cache = self._recommit(self._cache)
         telemetry.hist_since("serving.generate.decode", t0)
         step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()

@@ -4072,7 +4072,450 @@ def _shard_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --lora: batched multi-tenant LoRA serving benchmark (CPU-runnable;
+# --smoke is the tier-1-sized variant). Subprocess-isolated configs,
+# gates ENFORCED via exit code -> BENCH_r17.json:
+#
+#   multi : ONE engine serving LRA_TENANTS fine-tunes through one
+#            fixed-shape decode program — a stacked adapter bank
+#            (ops/lora.py) gathered per slot inside the trace. Two
+#            phases under ONE compile-counting window: the throughput
+#            phase floods every tenant's requests interleaved (the
+#            A/B number — no host-side management traffic in it),
+#            then the CHURN phase churns the tenant mix mid-traffic
+#            (adapter loads, a refresh, an immediate unload and a
+#            pinned/deferred unload while a fresh request round
+#            decodes) — 0 compiles across both. Per-tenant sha256
+#            digests recorded in submit order (throughput phase).
+#   dedicated : the per-tenant baseline at the SAME HBM accounting —
+#            an identically-configured single-adapter engine (same
+#            slot count, same base params, same programs) serving the
+#            same number of requests. Its measured bytes set how many
+#            dedicated engines fit the multi engine's budget:
+#            dedicated_fit = budget // dedicated_bytes, and the
+#            consolidation multiplier is TENANTS / dedicated_fit
+#            (tenants served per HBM byte at one budget).
+#   refs : per-tenant correctness references — one dedicated
+#            single-adapter engine per tenant (the same unmerged LoRA
+#            path), serving that tenant's exact request list. Gate:
+#            per-tenant digests IDENTICAL to the multi engine's.
+#
+#   Gates: tenants-per-HBM-byte multiplier >= 3x, aggregate decode
+#   tokens/sec >= 0.9x dedicated, per-tenant digests identical, and
+#   0 in-window compiles (model.gpt.trace + ops.lora.trace +
+#   cachedop misses + sampler traces) through the churn wave — the
+#   compile and churn gates cover EVERY rep of every config, not
+#   just the best-throughput rep the A/B keeps.
+# ---------------------------------------------------------------------------
+LORA_SMOKE = os.environ.get("BENCH_LORA_SMOKE", "") not in ("", "0")
+LRA_RANK, LRA_SLOTS, LRA_CHURN = 4, 8, 2
+LRA_DAMP = 0.3
+if LORA_SMOKE:
+    LRA_VOCAB, LRA_UNITS, LRA_LAYERS, LRA_HEADS = 128, 32, 2, 4
+    LRA_SMAX, LRA_TENANTS, LRA_REQS, LRA_MAXNEW, LRA_REPS = 64, 4, 3, 16, 1
+else:
+    LRA_VOCAB, LRA_UNITS, LRA_LAYERS, LRA_HEADS = 256, 48, 4, 4
+    LRA_SMAX, LRA_TENANTS, LRA_REQS, LRA_MAXNEW, LRA_REPS = 128, 6, 5, 24, 2
+LRA_MULT_MIN = 3.0           # tenants per HBM byte vs dedicated
+LRA_THR_MIN = 0.9            # aggregate decode tokens/sec vs dedicated
+
+
+def _lra_model():
+    """Tied-embedding damped GPT (the BENCH_r14/r15 peaky-logits
+    discipline: greedy streams with a real argmax gap)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    mx.np.random.seed(0)
+    net = GPTModel(vocab_size=LRA_VOCAB, units=LRA_UNITS,
+                   num_layers=LRA_LAYERS, num_heads=LRA_HEADS,
+                   max_length=LRA_SMAX)
+    net.initialize(mx.init.Xavier())
+    net._gen_params()
+    params = net.collect_params()
+    params["lm_head.weight"].set_data(
+        mx.np.array(params["word_embed.weight"].data().asnumpy()))
+    for k, p in params.items():
+        if "layers." in k and (k.endswith(".weight")
+                               or k.endswith(".bias")):
+            p.set_data(mx.np.array(p.data().asnumpy() * LRA_DAMP))
+    net._clear_cached_op()
+    return net
+
+
+def _lra_adapter(seed, scale=0.2):
+    """Seeded LoRA factors for one tenant (every armed projection of
+    every block) — strong enough to flip greedy argmaxes, so tenants
+    produce genuinely distinct streams."""
+    import numpy as onp
+    r = onp.random.RandomState(1000 + seed)
+    return {f"layers.{li}.{p}.{h}":
+            (r.randn(LRA_UNITS, LRA_RANK) if h == "A"
+             else r.randn(LRA_RANK, LRA_UNITS)).astype("f4") * scale
+            for li in range(LRA_LAYERS)
+            for p in ("q_proj", "k_proj", "v_proj", "out_proj")
+            for h in ("A", "B")}
+
+
+def _lra_workload():
+    """Per-tenant request lists (fixed seed, identical across
+    configs): short prompts + LRA_MAXNEW budgets — decode-dominated
+    multi-tenant traffic."""
+    import numpy as onp
+    rng = onp.random.RandomState(71)
+    return [[(rng.randint(0, LRA_VOCAB,
+                          int(rng.randint(4, 13))).astype("i4"),
+              LRA_MAXNEW) for _ in range(LRA_REQS)]
+            for _ in range(LRA_TENANTS)]
+
+
+def _lra_hbm_bytes(net, eng):
+    """params + adapter banks + KV cache — the engine's HBM
+    accounting (fp32 leaves measured, not estimated)."""
+    import jax
+    p = sum(int(x.data()._data.nbytes)
+            for x in net.collect_params().values())
+    cache = sum(int(a.nbytes) for a in jax.tree.leaves(eng._cache))
+    return p + int(net.lora_bank_bytes()) + cache
+
+
+def _lra_digests(tokens_by_tenant):
+    import hashlib
+    return {str(t): hashlib.sha256(
+        json.dumps(toks).encode()).hexdigest()
+        for t, toks in tokens_by_tenant.items()}
+
+
+def _lra_run_multi():
+    """The multi-tenant engine: all tenants interleaved through one
+    program, adapter churn mid-traffic, zero in-window compiles."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+    net = _lra_model()
+    eng = GenerationEngine(
+        net, max_slots=LRA_SLOTS, max_length=LRA_SMAX,
+        max_new_tokens=LRA_MAXNEW, queue_limit=256,
+        lora_rank=LRA_RANK,
+        max_adapters=LRA_TENANTS + LRA_CHURN).warmup()
+    for t in range(LRA_TENANTS):
+        eng.load_adapter(f"tenant-{t}", _lra_adapter(t),
+                         alpha=LRA_RANK)
+    work = _lra_workload()
+    # priming: absorb cold-start costs (both adapter and churn code
+    # paths) outside the measured window
+    eng.generate(work[0][0][0], max_new_tokens=2, timeout=600)
+    eng.generate(work[0][0][0], max_new_tokens=2, adapter="tenant-0",
+                 timeout=600)
+    eng.load_adapter("prime", _lra_adapter(98), alpha=LRA_RANK)
+    eng.unload_adapter("prime")
+    telemetry.reset()
+    # PHASE 1 — the throughput A/B window: the whole tenant mix
+    # flooded through the one program (queue depth >> slots), no
+    # host-side management traffic. Tokens counted off the streams so
+    # phase 2's tokens can't inflate the rate.
+    t0 = time.perf_counter()
+    flat = [(t, ri) for ri in range(LRA_REQS)
+            for t in range(LRA_TENANTS)]
+    streams = [(t, eng.submit(work[t][ri][0],
+                              max_new_tokens=work[t][ri][1],
+                              adapter=f"tenant-{t}"))
+               for t, ri in flat]
+    by_tenant = {t: [] for t in range(LRA_TENANTS)}
+    for t, s in streams:
+        by_tenant[t].append(s.result(timeout=600).tokens)
+    wall = time.perf_counter() - t0
+    tokens = sum(len(toks) for tl in by_tenant.values()
+                 for toks in tl)
+    # PHASE 2 — THE CHURN WAVE, mid-traffic (telemetry NOT reset: the
+    # zero-compile gate spans both phases): another request round
+    # keeps every tenant decoding while new tenants load, one
+    # refreshes, one unloads immediately, and one unloads while its
+    # request is in flight (deferred behind the pin).
+    wave = [(t, eng.submit(work[t][0][0], max_new_tokens=LRA_MAXNEW,
+                           adapter=f"tenant-{t}"))
+            for t in range(LRA_TENANTS)]
+    eng.load_adapter("churn-0", _lra_adapter(100), alpha=LRA_RANK)
+    churn_stream = eng.submit(work[0][0][0], max_new_tokens=4,
+                              adapter="churn-0")
+    eng.load_adapter("churn-1", _lra_adapter(101), alpha=LRA_RANK)
+    eng.load_adapter("churn-1", _lra_adapter(102),
+                     alpha=LRA_RANK)              # refresh
+    eng.unload_adapter("churn-0")                 # deferred (pinned)
+    eng.unload_adapter("churn-1")                 # immediate
+    for _t, s in wave:
+        s.result(timeout=600)
+    churn_stream.result(timeout=600)
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    hbm = _lra_hbm_bytes(net, eng)
+    eng.close()
+    print(json.dumps({
+        "config": "multi",
+        "model": f"gpt {LRA_LAYERS}L-{LRA_UNITS}u-{LRA_HEADS}h "
+                 f"vocab={LRA_VOCAB} s_max={LRA_SMAX} tied-head "
+                 f"damp={LRA_DAMP}; lora rank={LRA_RANK} "
+                 f"adapters={LRA_TENANTS}+{LRA_CHURN} churn",
+        "workload": f"{LRA_TENANTS} tenants x {LRA_REQS} greedy "
+                    f"requests (prompts 4-12, budget {LRA_MAXNEW}, "
+                    f"seed 71) flooded through one engine, adapter "
+                    f"churn mid-window",
+        "tenants": LRA_TENANTS,
+        "requests": len(flat) + LRA_TENANTS + 1,
+        "slots": LRA_SLOTS,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "hbm_bytes": hbm,
+        "bank_bytes": int(net.lora_bank_bytes()),
+        "adapters_loaded": int(
+            c.get("serving.generate.lora.adapters_loaded", 0)),
+        "adapters_evicted": int(
+            c.get("serving.generate.lora.adapters_evicted", 0)),
+        "lora_requests": int(
+            c.get("serving.generate.lora.requests", 0)),
+        "compiles_in_window":
+            int(c.get("model.gpt.trace", 0))
+            + int(c.get("ops.lora.trace", 0))
+            + int(c.get("gluon.cachedop.cache_miss", 0))
+            + int(c.get("ops.sampling.trace", 0)),
+        "tenant_digests": _lra_digests(by_tenant),
+    }), flush=True)
+    return 0
+
+
+def _lra_run_dedicated():
+    """The baseline: an identically-configured SINGLE-adapter engine
+    (one tenant per engine is the world without the batched bank)
+    serving the same request volume; its bytes set dedicated_fit."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+    net = _lra_model()
+    eng = GenerationEngine(
+        net, max_slots=LRA_SLOTS, max_length=LRA_SMAX,
+        max_new_tokens=LRA_MAXNEW, queue_limit=256,
+        lora_rank=LRA_RANK, max_adapters=1).warmup()
+    eng.load_adapter("only", _lra_adapter(0), alpha=LRA_RANK)
+    work = _lra_workload()
+    eng.generate(work[0][0][0], max_new_tokens=2, timeout=600)
+    eng.generate(work[0][0][0], max_new_tokens=2, adapter="only",
+                 timeout=600)
+    telemetry.reset()
+    t0 = time.perf_counter()
+    streams = [eng.submit(p, max_new_tokens=m, adapter="only")
+               for tl in work for p, m in tl]
+    outs = [s.result(timeout=600).tokens for s in streams]
+    wall = time.perf_counter() - t0
+    tokens = sum(len(o) for o in outs)
+    snap = telemetry.snapshot()
+    c = snap["counters"]
+    hbm = _lra_hbm_bytes(net, eng)
+    eng.close()
+    print(json.dumps({
+        "config": "dedicated",
+        "tenants": 1,
+        "requests": LRA_TENANTS * LRA_REQS,
+        "slots": LRA_SLOTS,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "hbm_bytes": hbm,
+        "bank_bytes": int(net.lora_bank_bytes()),
+        "compiles_in_window":
+            int(c.get("model.gpt.trace", 0))
+            + int(c.get("ops.lora.trace", 0))
+            + int(c.get("gluon.cachedop.cache_miss", 0))
+            + int(c.get("ops.sampling.trace", 0)),
+    }), flush=True)
+    return 0
+
+
+def _lra_run_refs():
+    """Per-tenant dedicated references: one single-adapter engine per
+    tenant (the zero-retrace refresh swaps tenants between batches —
+    no request is ever in flight across a swap), same unmerged LoRA
+    path, same prompts. No timing; digests only."""
+    from mxnet_tpu.serving import GenerationEngine
+    net = _lra_model()
+    eng = GenerationEngine(
+        net, max_slots=LRA_SLOTS, max_length=LRA_SMAX,
+        max_new_tokens=LRA_MAXNEW, queue_limit=256,
+        lora_rank=LRA_RANK, max_adapters=1)
+    work = _lra_workload()
+    by_tenant = {}
+    for t in range(LRA_TENANTS):
+        eng.load_adapter("only", _lra_adapter(t), alpha=LRA_RANK)
+        by_tenant[t] = [
+            eng.generate(p, max_new_tokens=m, adapter="only",
+                         timeout=600).tokens for p, m in work[t]]
+    eng.close()
+    print(json.dumps({
+        "config": "refs",
+        "tenants": LRA_TENANTS,
+        "tenant_digests": _lra_digests(by_tenant),
+    }), flush=True)
+    return 0
+
+
+def _lra_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_LORA_CONFIG"]
+    if cfg == "multi":
+        return _lra_run_multi()
+    if cfg == "dedicated":
+        return _lra_run_dedicated()
+    if cfg == "refs":
+        return _lra_run_refs()
+    raise SystemExit(f"unknown BENCH_LORA_CONFIG {cfg!r}")
+
+
+def _lra_check_schema(doc):
+    """BENCH_r17.json contract (spec for the shared _check_schema)."""
+    run_keys = ("tokens_per_sec", "generated_tokens", "hbm_bytes",
+                "compiles_in_window", "slots", "requests")
+    return _check_schema(
+        "BENCH_r17", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "hbm_budget_bytes": int,
+            "multi": dict, "dedicated": dict, "refs": dict,
+            "tenants": int, "dedicated_fit": int,
+            "tenants_per_byte_multiplier": float,
+            "throughput_ratio": float,
+            "tenant_digests_identical": bool,
+            "compiles_all_reps": int,
+            "churn_loaded_min": int, "churn_evicted_min": int,
+            "zero_compiles_in_window": bool,
+            "multiplier_ge_3x": bool, "throughput_ge_0_9x": bool,
+        },
+        nested={"multi": run_keys + ("tenant_digests",
+                                     "adapters_loaded",
+                                     "adapters_evicted", "bank_bytes"),
+                "dedicated": run_keys,
+                "refs": ("tenant_digests",)},
+        gates=[("ONE HBM budget: a dedicated engine must fit the "
+                "multi engine's bytes",
+                lambda d: 0 < d["dedicated"]["hbm_bytes"]
+                <= d["hbm_budget_bytes"]),
+               ("the multi engine must have served every tenant",
+                lambda d: len(d["multi"]["tenant_digests"])
+                == d["tenants"]
+                and len(set(d["multi"]["tenant_digests"].values()))
+                == d["tenants"]),
+               ("the churn wave must have loaded AND evicted "
+                "adapters inside the measured window of EVERY rep "
+                "(not just the best-throughput one the A/B keeps)",
+                lambda d: d["churn_loaded_min"] >= 3
+                and d["churn_evicted_min"] >= 2),
+               ("zero_compiles_in_window must cover every rep of "
+                "every config",
+                lambda d: d["zero_compiles_in_window"]
+                == (d["compiles_all_reps"] == 0))])
+
+
+def _lora_main():
+    if os.environ.get("BENCH_LORA_CONFIG"):
+        return _lra_child()
+    smoke = LORA_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_LORA_SMOKE": "1"} if smoke else {}
+    reps = LRA_REPS if not smoke else 1   # the smoke tier's sizing
+    # interleaved best-of-N reps (the established A/B discipline: this
+    # box's cpu-shares swing between windows); digests must agree
+    # across every rep of every config
+    results = {}
+    digests = {"multi": set()}
+    # gates that must hold in EVERY rep, not just the best-throughput
+    # one the A/B keeps: a retrace or a missed churn in a discarded
+    # rep must still fail the bench
+    compiles_all = 0
+    churn_loaded_min = churn_evicted_min = None
+    for rep in range(reps):
+        for cfg in ("multi", "dedicated"):
+            _stage(f"lora: {cfg} (rep {rep + 1}/{reps})")
+            r = _ab_child("--lora", dict(env, BENCH_LORA_CONFIG=cfg),
+                          label=f"lora {cfg} rep{rep}")
+            if r is None:
+                return 1
+            compiles_all += int(r["compiles_in_window"])
+            if cfg == "multi":
+                digests["multi"].add(
+                    json.dumps(r["tenant_digests"], sort_keys=True))
+                churn_loaded_min = (
+                    int(r["adapters_loaded"]) if churn_loaded_min
+                    is None else min(churn_loaded_min,
+                                     int(r["adapters_loaded"])))
+                churn_evicted_min = (
+                    int(r["adapters_evicted"]) if churn_evicted_min
+                    is None else min(churn_evicted_min,
+                                     int(r["adapters_evicted"])))
+            best = results.get(cfg)
+            if best is None \
+                    or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                results[cfg] = r
+    _stage("lora: refs")
+    refs = _ab_child("--lora", dict(env, BENCH_LORA_CONFIG="refs"),
+                     label="lora refs")
+    if refs is None:
+        return 1
+    results["refs"] = refs
+    multi, ded = results["multi"], results["dedicated"]
+    budget = int(multi["hbm_bytes"])
+    ded_fit = max(1, budget // int(ded["hbm_bytes"]))
+    multiplier = round(multi["tenants"] / ded_fit, 2)
+    thr_ratio = round(multi["tokens_per_sec"]
+                      / max(ded["tokens_per_sec"], 1e-9), 2)
+    digests_ok = bool(
+        len(digests["multi"]) == 1
+        and multi["tenant_digests"] == refs["tenant_digests"])
+    zero_compiles = bool(compiles_all == 0)  # EVERY rep, every config
+    doc = _lra_check_schema({
+        "metric": "lora_tenants_per_hbm_byte_multiplier",
+        "value": float(multiplier),
+        "unit": "tenants served per HBM byte, multi-tenant bank vs "
+                "dedicated engines at one budget",
+        "model": multi.get("model", "gpt"),  # the CHILD's actual dims
+        #                                      (smoke and full differ)
+        "smoke": bool(smoke),
+        "reps_best_of": reps,
+        "workload": multi.get("workload", ""),
+        "hbm_budget_bytes": budget,
+        "tenants": int(multi["tenants"]),
+        "dedicated_fit": int(ded_fit),
+        "multi": multi,
+        "dedicated": ded,
+        "refs": refs,
+        "tenants_per_byte_multiplier": float(multiplier),
+        "throughput_ratio": float(thr_ratio),
+        "tenant_digests_identical": digests_ok,
+        "compiles_all_reps": int(compiles_all),
+        "churn_loaded_min": int(churn_loaded_min),
+        "churn_evicted_min": int(churn_evicted_min),
+        "zero_compiles_in_window": zero_compiles,
+        "multiplier_ge_3x": bool(multiplier >= LRA_MULT_MIN),
+        "throughput_ge_0_9x": bool(thr_ratio >= LRA_THR_MIN),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_LORA_OUT",
+                                           "BENCH_r17.json"))
+    if not smoke or "BENCH_LORA_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("multiplier_ge_3x", doc["multiplier_ge_3x"]),
+        ("throughput_ge_0_9x", doc["throughput_ge_0_9x"]),
+        ("tenant_digests_identical", doc["tenant_digests_identical"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] lora gates failed: {', '.join(failed)} "
+              f"(multiplier={multiplier} thr_ratio={thr_ratio})",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--lora" in sys.argv:
+        return _lora_main()
     if "--shard" in sys.argv:
         return _shard_main()
     if "--spec" in sys.argv:

@@ -86,6 +86,7 @@ from jax import lax
 from ... import autograd, telemetry
 from ...ndarray.ndarray import NDArray
 from ...ops import attention as _att
+from ...ops import lora as _lora
 from ...ops import quantized as _qz
 from ...ops import sampling as _smp
 from ...random_state import next_key, trace_rng
@@ -102,6 +103,13 @@ __all__ = ["GPTBlock", "GPTModel", "gpt_small"]
 #: greedy argmax directly.
 _QUANTIZED_PROJECTIONS = ("q_proj", "k_proj", "v_proj", "out_proj",
                           "ffn1", "ffn2")
+
+#: the batched-LoRA target set (``arm_lora``): the attention
+#: projections of every block. Adapters must attach to projections
+#: with NO fused activation (the low-rank delta adds to the
+#: pre-activation output; q/k/v/out and ffn2 qualify, ffn1's gelu
+#: does not) — validated at arm time.
+_LORA_PROJECTIONS = ("q_proj", "k_proj", "v_proj", "out_proj")
 
 # the ONE int8 convention (amax/127, eps floor, round-then-clip)
 # lives in ops/quantized.py — KV quantization must never drift from
@@ -162,6 +170,11 @@ class GPTBlock(HybridBlock):
         #: None (the steady state outside generation and for fp32
         #: engines) keeps every projection on the fp32 Dense path.
         self._qbind = None
+        #: per-call LoRA binding installed by ``GPTModel._make_bind``
+        #: while a generation closure of a LoRA-armed model runs:
+        #: ``({proj_name: bank}, (B,) adapter-index vector)`` of
+        #: TRACED buffers. None keeps every projection base-only.
+        self._lbind = None
 
     def _split(self, x):
         b, s, _ = x.shape
@@ -182,14 +195,24 @@ class GPTBlock(HybridBlock):
         layer = getattr(self, name)
         q = self._qbind.get(name) if self._qbind else None
         if q is None:
-            return layer(x)
-        wq, w_scale = q
-        y = _qz.dequant_matmul(x._data, wq, w_scale)
-        if layer.bias is not None:
-            y = y + layer.bias.data()._data
-        out = NDArray(y, ctx=x.ctx)
-        if layer.act is not None:
-            out = layer.act(out)
+            out = layer(x)
+        else:
+            wq, w_scale = q
+            y = _qz.dequant_matmul(x._data, wq, w_scale)
+            if layer.bias is not None:
+                y = y + layer.bias.data()._data
+            out = NDArray(y, ctx=x.ctx)
+            if layer.act is not None:
+                out = layer.act(out)
+        if self._lbind is not None:
+            tab, idx = self._lbind
+            bank = tab.get(name)
+            if bank is not None:
+                # the per-slot low-rank delta, fp32 over either base
+                # path (targeted projections carry no activation —
+                # enforced by arm_lora — so post-layer == pre-act)
+                out = NDArray(_lora.apply(out._data, x._data, bank,
+                                          idx), ctx=x.ctx)
         return out
 
     def _qkv(self, x):
@@ -483,6 +506,20 @@ class GPTModel(HybridBlock):
         #: arguments (so a rollover re-quantize installs new values
         #: without retracing — the dense-engine swap discipline).
         self._quant = None
+        #: batched-LoRA adapter banks (``arm_lora``): one dict per
+        #: block, ``{proj_name: {"A", "B", "scale"} stacked bank}``
+        #: (ops/lora.py), passed to the jitted closures as RUNTIME
+        #: arguments together with a per-row adapter-index vector —
+        #: loading/refreshing/clearing an adapter slot installs new
+        #: bank arrays with zero retraces; the first arm (or a
+        #: rank/include/capacity change) invalidates the closures.
+        self._lora = None
+        self._lora_meta = None  # (n_adapters, rank, include tuple)
+        #: per-batch-size cached all-zeros (B,) index vectors for the
+        #: adapters=None case — the vector is a constant, and minting
+        #: a fresh device array per decode tick would tax every
+        #: engine's hot path (LoRA-free ones included)
+        self._lora_zero_idx: dict = {}
 
     def _annotate_logical_axes(self):
         """Stamp every parameter with its NAMED LOGICAL AXES
@@ -557,6 +594,9 @@ class GPTModel(HybridBlock):
         # NOTE: self._quant survives — it is derived state an explicit
         # quantize_params() refresh owns (the serving engine re-calls
         # it under the swap lock on every weight rollover)
+        # NOTE: self._lora survives too — adapter banks are tenant
+        # state, not derived from the base parameters; a weight
+        # rollover keeps the loaded adapters armed
 
     def quantize_params(self, include=_QUANTIZED_PROJECTIONS):
         """Arm (or refresh) weight-only int8 decode: quantize every
@@ -608,6 +648,149 @@ class GPTModel(HybridBlock):
                           for _wq, s in tab.values())
         return n, n * 3 - scale_bytes
 
+    # -- batched multi-tenant LoRA (ops/lora.py; serving/generate.py) ---
+    @property
+    def lora_armed(self) -> bool:
+        """True once ``arm_lora`` installed the stacked adapter banks."""
+        return self._lora is not None
+
+    def arm_lora(self, n_adapters, rank, include=_LORA_PROJECTIONS):
+        """Arm batched multi-tenant LoRA: allocate an all-zeros stacked
+        adapter bank (``n_adapters`` slots, slot 0 reserved as the
+        base-model zero adapter) for every ``include`` projection of
+        every block, and route the generation closures through the
+        per-slot batched apply ``y += (x @ A[idx]) @ B[idx] *
+        scale[idx]`` (ops/lora.py).
+
+        The banks are RUNTIME arguments of the jitted closures (the
+        quant-table discipline): :meth:`set_adapter` /
+        :meth:`clear_adapter` install new bank arrays with ZERO
+        retraces. The first arm — or a change of ``n_adapters``,
+        ``rank`` or ``include`` — changes the closures' pytree
+        structure and invalidates them; arm before ``warmup()``.
+        Training/plain ``forward`` is untouched (adapters live only on
+        the generation path)."""
+        self._gen_params()   # materialize deferred parameter shapes
+        include = tuple(include)
+        if not include:
+            raise ValueError("arm_lora needs at least one projection")
+        for name in include:
+            probe = getattr(self._blocks()[0], name, None)
+            if not isinstance(probe, Dense):
+                raise ValueError(
+                    f"unknown LoRA projection {name!r} (choose from "
+                    f"{_LORA_PROJECTIONS + ('ffn2',)}; ffn1 carries "
+                    f"a fused activation and cannot take the delta)")
+            if probe.act is not None:
+                raise ValueError(
+                    f"LoRA projection {name!r} carries a fused "
+                    f"activation: the low-rank delta must add to the "
+                    f"pre-activation output (choose projections "
+                    f"without one, e.g. {_LORA_PROJECTIONS})")
+        meta = (int(n_adapters), int(rank), tuple(sorted(include)))
+        fresh = self._lora_meta != meta
+        if not fresh:
+            return self
+        tabs = []
+        for blk in self._blocks():
+            tab = {}
+            for name in include:
+                d_out, d_in = getattr(blk, name).weight.data().shape
+                tab[name] = _lora.init_bank(n_adapters, d_in, d_out,
+                                            rank)
+            tabs.append(tab)
+        self._lora = tabs
+        self._lora_meta = meta
+        # pytree structure changed: the closures must retrace once
+        self._gen = None
+        self._paged = None
+        self._spec_jits = None
+        return self
+
+    def set_adapter(self, idx, params, alpha=1.0):
+        """Install one tenant's LoRA factors into bank slot ``idx``
+        (1-based; slot 0 is the reserved base adapter). ``params`` is
+        a flat mapping ``{"layers.<li>.<proj>.A": (d_in, rank),
+        "layers.<li>.<proj>.B": (rank, d_out)}`` covering EXACTLY the
+        armed include set of every block; ``alpha`` is the adapter's
+        scaling numerator (applied as ``alpha / rank``). Shape or
+        coverage mismatches raise before any slot is touched, so a bad
+        adapter can never leave the bank half-written. Zero retraces —
+        the banks are runtime arguments of the jitted closures."""
+        if self._lora is None:
+            raise RuntimeError("set_adapter before arm_lora")
+        include = self._lora_meta[2]
+        expect = {f"layers.{li}.{name}.{half}"
+                  for li in range(self._num_layers)
+                  for name in include for half in ("A", "B")}
+        got = set(params)
+        if got != expect:
+            missing = sorted(expect - got)[:3]
+            extra = sorted(got - expect)[:3]
+            raise ValueError(
+                f"adapter params must cover the armed include set "
+                f"exactly (missing {missing}, unexpected {extra})")
+        for key in sorted(got):
+            # host-side check: the factors arrive as host arrays, and
+            # this runs inside the engine's exclusive swap window — a
+            # per-key device round-trip would stall decode for
+            # 2*layers*projections syncs per load
+            if not bool(onp.isfinite(onp.asarray(params[key])).all()):
+                raise ValueError(
+                    f"adapter param {key!r} contains non-finite "
+                    f"values — a NaN/inf factor would poison every "
+                    f"request bound to this slot; rejected before "
+                    f"any install")
+        new_tabs = []
+        for li, tab in enumerate(self._lora):
+            new_tab = dict(tab)
+            for name in include:
+                new_tab[name] = _lora.set_slot(
+                    tab[name], idx, params[f"layers.{li}.{name}.A"],
+                    params[f"layers.{li}.{name}.B"], alpha)
+            new_tabs.append(new_tab)
+        self._lora = new_tabs
+        return self
+
+    def clear_adapter(self, idx):
+        """Zero bank slot ``idx`` back to the base (no-op) adapter —
+        zero retraces, like :meth:`set_adapter`."""
+        if self._lora is None:
+            raise RuntimeError("clear_adapter before arm_lora")
+        self._lora = [
+            {name: _lora.clear_slot(bank, idx)
+             for name, bank in tab.items()} for tab in self._lora]
+        return self
+
+    def lora_bank_bytes(self) -> int:
+        """HBM bytes of the armed adapter banks (0 when unarmed)."""
+        return _lora.bank_bytes(self._lora) if self._lora else 0
+
+    def _lora_arg(self):
+        """The LoRA-bank runtime argument every closure call carries:
+        the live banks, or an empty pytree for unarmed models (a
+        stable structure either way — flipping it retraces, which is
+        why ``arm_lora`` invalidates the closures)."""
+        return self._lora if self._lora is not None else []
+
+    def _lora_idx(self, adapters, batch):
+        """Normalize a per-row adapter-index vector: ``None`` means
+        all-base (index 0 — the reserved zero adapter; the constant
+        vector is cached per batch size, not re-minted per step)."""
+        if adapters is None:
+            b = int(batch)
+            z = self._lora_zero_idx.get(b)
+            if z is None:
+                z = self._lora_zero_idx.setdefault(
+                    b, jnp.zeros((b,), jnp.int32))
+            return z
+        idx = _as_i32(adapters).reshape(-1)
+        if idx.shape[0] != int(batch):
+            raise ValueError(
+                f"adapters must be one index per row ({int(batch)}), "
+                f"got shape {idx.shape}")
+        return idx
+
     def init_cache(self, batch_size, max_length=None, dtype=None):
         """Preallocated fixed-shape KV cache pytree for ``batch_size``
         slots: ``{"k": tuple of L (B, H, S_max, Dh) arrays, "v": same,
@@ -654,13 +837,18 @@ class GPTModel(HybridBlock):
         rebound to the traced buffers (gluon/block.py raw_fn idiom)
         and — for a quantized model — each block's ``_qbind`` table
         rebound to the traced int8 weights/scales, so ``_proj``
-        dispatches to the fused dequant-matmul inside the trace.
-        Shared by the dense and paged generation closures."""
+        dispatches to the fused dequant-matmul inside the trace; a
+        LoRA-armed model additionally rebinds each block's ``_lbind``
+        to its traced adapter banks plus the call's per-row adapter
+        index vector. Shared by the dense and paged generation
+        closures."""
         def _bind(fn):
-            def wrapper(key, param_datas, quant_tabs, *args):
+            def wrapper(key, param_datas, quant_tabs, lora_tabs,
+                        lora_idx, *args):
                 telemetry.counter("model.gpt.trace")
                 saved = [nd._data for nd in param_nds]
                 saved_q = [blk._qbind for blk in blocks]
+                saved_l = [blk._lbind for blk in blocks]
                 scope = _deferred.trace_scope()
                 rec = autograd._RecordingScope(False, False)
                 with scope, rec, trace_rng(key):
@@ -669,6 +857,10 @@ class GPTModel(HybridBlock):
                     for blk, tab in zip(
                             blocks, quant_tabs or [None] * len(blocks)):
                         blk._qbind = tab
+                    for blk, tab in zip(
+                            blocks, lora_tabs or [None] * len(blocks)):
+                        blk._lbind = None if tab is None \
+                            else (tab, lora_idx)
                     try:
                         return fn(*args)
                     finally:
@@ -676,6 +868,8 @@ class GPTModel(HybridBlock):
                             nd._data = s
                         for blk, s in zip(blocks, saved_q):
                             blk._qbind = s
+                        for blk, s in zip(blocks, saved_l):
+                            blk._lbind = s
             return wrapper
         return _bind
 
@@ -877,23 +1071,28 @@ class GPTModel(HybridBlock):
             new["len"] = cache["len"] + delta
             return new
 
+        # wrapper args: (key, params, quant, lora_tabs, lora_idx,
+        # *fn_args) — fn args start at 5, hence the donated cache
+        # positions below
         self._gen = (
             param_nds,
-            jax.jit(_bind(prefill_raw), donate_argnums=(6,)),
-            jax.jit(_bind(decode_raw), donate_argnums=(4,)),
-            jax.jit(_bind(verify_raw), donate_argnums=(4,)),
-            jax.jit(_bind(advance_raw), donate_argnums=(4,)),
+            jax.jit(_bind(prefill_raw), donate_argnums=(8,)),
+            jax.jit(_bind(decode_raw), donate_argnums=(6,)),
+            jax.jit(_bind(verify_raw), donate_argnums=(6,)),
+            jax.jit(_bind(advance_raw), donate_argnums=(6,)),
         )
         return self._gen
 
-    def prefill(self, tokens, valid_length, cache, slots=None):
+    def prefill(self, tokens, valid_length, cache, slots=None,
+                adapters=None):
         """Run the (padded) prompts ``tokens`` (B_req, S_bucket) int32
         through the model, write their K/V into ``cache`` at rows
         ``slots`` (default ``0..B_req-1``), set ``len`` to
         ``valid_length``. Returns ``(last_logits, cache)`` — raw
         ``(B_req, vocab)`` logits of each row's last valid token and
         the updated cache (the passed cache is donated; always use the
-        returned one)."""
+        returned one). ``adapters`` (B_req,) int32 selects each row's
+        LoRA bank slot on an armed model (None/0 = base)."""
         param_nds, prefill_jit = self._ensure_gen()[:2]
         tokens = _as_i32(tokens)
         if tokens.ndim != 2:
@@ -910,22 +1109,28 @@ class GPTModel(HybridBlock):
         else:
             slots = _as_i32(slots)
         return prefill_jit(next_key(), [nd._data for nd in param_nds],
-                           self._quant_arg(), tokens, valid_length,
-                           slots, cache)
+                           self._quant_arg(), self._lora_arg(),
+                           self._lora_idx(adapters, tokens.shape[0]),
+                           tokens, valid_length, slots, cache)
 
-    def decode_step(self, tokens, cache):
+    def decode_step(self, tokens, cache, adapters=None):
         """One greedy-decoding step for EVERY cache slot: insert the
         K/V of ``tokens`` (B,) int32 at each row's ``len``, attend over
         the valid prefix, bump ``len``. Returns ``(logits, cache)`` —
         raw ``(B, vocab)`` next-token logits and the updated cache
         (input cache donated). Rows whose slot is free/unprefilled
         produce garbage logits that callers simply ignore — the POINT
-        is that the program shape never changes with occupancy."""
+        is that the program shape never changes with occupancy.
+        ``adapters`` (B,) selects each row's LoRA bank slot — per-slot
+        runtime data gathered inside the one fixed-shape program."""
         param_nds, _, decode_jit = self._ensure_gen()[:3]
+        tokens = _as_i32(tokens)
         return decode_jit(next_key(), [nd._data for nd in param_nds],
-                          self._quant_arg(), _as_i32(tokens), cache)
+                          self._quant_arg(), self._lora_arg(),
+                          self._lora_idx(adapters, tokens.shape[0]),
+                          tokens, cache)
 
-    def verify_step(self, tokens, cache):
+    def verify_step(self, tokens, cache, adapters=None):
         """Speculative VERIFY over every cache slot: insert the K/V of
         ``tokens`` (B, R) int32 — per row ``[last, d_1 .. d_{R-1}]``,
         the committed tail token plus the draft's R-1 proposals — at
@@ -944,7 +1149,9 @@ class GPTModel(HybridBlock):
             raise ValueError(f"verify tokens must be (batch, R), got "
                              f"shape {tokens.shape}")
         return verify_jit(next_key(), [nd._data for nd in param_nds],
-                          self._quant_arg(), tokens, cache)
+                          self._quant_arg(), self._lora_arg(),
+                          self._lora_idx(adapters, tokens.shape[0]),
+                          tokens, cache)
 
     def advance_len(self, delta, cache):
         """Advance each row's valid length by ``delta`` (B,) int32 —
@@ -954,7 +1161,9 @@ class GPTModel(HybridBlock):
         gen = self._ensure_gen()
         param_nds, advance_jit = gen[0], gen[4]
         return advance_jit(next_key(), [nd._data for nd in param_nds],
-                           self._quant_arg(), _as_i32(delta), cache)
+                           self._quant_arg(), self._lora_arg(),
+                           self._lora_idx(None, 1),  # no compute
+                           _as_i32(delta), cache)
 
     # -- fused speculative fast path ------------------------------------
     def _ensure_spec(self, kind, k, sampled):
@@ -1000,7 +1209,7 @@ class GPTModel(HybridBlock):
                         qs.append(q)
                     return (jnp.stack(dts, axis=1),
                             jnp.stack(qs, axis=1), keys, cache)
-                jitted = jax.jit(_bind(raw), donate_argnums=(8,))
+                jitted = jax.jit(_bind(raw), donate_argnums=(10,))
             else:
                 def raw(tokens, cache):
                     cur = tokens
@@ -1012,7 +1221,7 @@ class GPTModel(HybridBlock):
                             .astype(jnp.int32)
                         dts.append(cur)
                     return jnp.stack(dts, axis=1), cache
-                jitted = jax.jit(_bind(raw), donate_argnums=(4,))
+                jitted = jax.jit(_bind(raw), donate_argnums=(6,))
         elif kind in ("verify_commit", "verify_commit_paged"):
             paged = kind == "verify_commit_paged"
 
@@ -1034,7 +1243,7 @@ class GPTModel(HybridBlock):
                     new["len"] = cache["len"] \
                         + n_commit * (active > 0)
                     return commit, n_commit, keys, new
-                jitted = jax.jit(_bind(raw), donate_argnums=(11,))
+                jitted = jax.jit(_bind(raw), donate_argnums=(13,))
             else:
                 def raw(last, d_toks, active, cache):
                     vt = jnp.concatenate([last[:, None], d_toks],
@@ -1046,17 +1255,18 @@ class GPTModel(HybridBlock):
                     new["len"] = cache["len"] \
                         + n_commit * (active > 0)
                     return commit, n_commit, new
-                jitted = jax.jit(_bind(raw), donate_argnums=(6,))
+                jitted = jax.jit(_bind(raw), donate_argnums=(8,))
         else:
             raise ValueError(f"unknown speculative closure {kind!r}")
         entry = (param_nds, jitted)
         self._spec_jits[key_] = entry
         return entry
 
-    def _spec_call(self, kind, k, sampled, *args):
+    def _spec_call(self, kind, k, sampled, adapters, batch, *args):
         param_nds, jitted = self._ensure_spec(kind, k, sampled)
         return jitted(next_key(), [nd._data for nd in param_nds],
-                      self._quant_arg(), *args)
+                      self._quant_arg(), self._lora_arg(),
+                      self._lora_idx(adapters, batch), *args)
 
     def propose_tokens(self, tokens, cache, k, keys=None, temps=None,
                        top_ks=None, top_ps=None):
@@ -1069,17 +1279,20 @@ class GPTModel(HybridBlock):
         ``len`` advances by k on every row; the engine rolls back to
         the accept point with :meth:`advance_len`. Cache donated."""
         tokens = _as_i32(tokens)
+        b = tokens.shape[0]
         if keys is None:
-            return self._spec_call("propose", k, False, tokens, cache)
+            return self._spec_call("propose", k, False, None, b,
+                                   tokens, cache)
         return self._spec_call(
-            "propose", k, True, tokens, jnp.asarray(keys, jnp.uint32),
+            "propose", k, True, None, b, tokens,
+            jnp.asarray(keys, jnp.uint32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(top_ks, jnp.int32),
             jnp.asarray(top_ps, jnp.float32), cache)
 
     def verify_commit(self, last, d_toks, active, cache, q=None,
                       keys=None, temps=None, top_ks=None,
-                      top_ps=None):
+                      top_ps=None, adapters=None):
         """TARGET side of one speculative iteration, fused: verify all
         ``k + 1`` positions (``verify_step``'s program), apply the
         accept rule, and advance every active row's ``len`` by its
@@ -1087,34 +1300,39 @@ class GPTModel(HybridBlock):
         returns ``(commit (B, k+1), n_commit (B,), cache)``; sampled:
         ``(commit, n_commit, advanced keys, cache)``. Cache donated;
         rows the engine evicts mid-commit keep the full-commit
-        ``len`` (dead rows)."""
+        ``len`` (dead rows). ``adapters`` (B,) selects each row's
+        LoRA bank slot — the verify runs ADAPTED (the draft proposed
+        with the base model; the accept rule makes the committed
+        stream the adapted model's own)."""
         last = _as_i32(last)
         k = int(d_toks.shape[1])
+        b = last.shape[0]
         if q is None:
-            return self._spec_call("verify_commit", k, False, last,
-                                   _as_i32(d_toks), _as_i32(active),
-                                   cache)
+            return self._spec_call("verify_commit", k, False, adapters,
+                                   b, last, _as_i32(d_toks),
+                                   _as_i32(active), cache)
         return self._spec_call(
-            "verify_commit", k, True, last, _as_i32(d_toks), q,
-            jnp.asarray(keys, jnp.uint32),
+            "verify_commit", k, True, adapters, b, last,
+            _as_i32(d_toks), q, jnp.asarray(keys, jnp.uint32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(top_ks, jnp.int32),
             jnp.asarray(top_ps, jnp.float32), _as_i32(active), cache)
 
     def verify_commit_paged(self, last, d_toks, active, cache, q=None,
                             keys=None, temps=None, top_ks=None,
-                            top_ps=None):
+                            top_ps=None, adapters=None):
         """Paged-cache :meth:`verify_commit` (the verify runs
         ``verify_step_paged``'s program; accept/advance identical)."""
         last = _as_i32(last)
         k = int(d_toks.shape[1])
+        b = last.shape[0]
         if q is None:
             return self._spec_call("verify_commit_paged", k, False,
-                                   last, _as_i32(d_toks),
+                                   adapters, b, last, _as_i32(d_toks),
                                    _as_i32(active), cache)
         return self._spec_call(
-            "verify_commit_paged", k, True, last, _as_i32(d_toks), q,
-            jnp.asarray(keys, jnp.uint32),
+            "verify_commit_paged", k, True, adapters, b, last,
+            _as_i32(d_toks), q, jnp.asarray(keys, jnp.uint32),
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(top_ks, jnp.int32),
             jnp.asarray(top_ps, jnp.float32), _as_i32(active), cache)
@@ -1364,27 +1582,31 @@ class GPTModel(HybridBlock):
                                        for p in cache["v_scale"])
             return new
 
+        # wrapper args: (key, params, quant, lora_tabs, lora_idx,
+        # *fn_args) — fn args start at 5, hence the donated cache
+        # positions below
         self._paged = {
             "params": param_nds,
-            "fresh": jax.jit(_bind(fresh_raw), donate_argnums=(7,)),
-            "chunk": jax.jit(_bind(chunk_raw), donate_argnums=(8,)),
-            "decode": jax.jit(_bind(decode_raw), donate_argnums=(5,)),
+            "fresh": jax.jit(_bind(fresh_raw), donate_argnums=(9,)),
+            "chunk": jax.jit(_bind(chunk_raw), donate_argnums=(10,)),
+            "decode": jax.jit(_bind(decode_raw), donate_argnums=(7,)),
             "peek": jax.jit(_bind(peek_raw)),
-            "bind": jax.jit(_bind(bind_raw), donate_argnums=(6,)),
-            "copy": jax.jit(_bind(copy_raw), donate_argnums=(5,)),
+            "bind": jax.jit(_bind(bind_raw), donate_argnums=(8,)),
+            "copy": jax.jit(_bind(copy_raw), donate_argnums=(7,)),
             "verify": jax.jit(_bind(spec_verify_raw),
-                              donate_argnums=(5,)),
-            "advance": jax.jit(_bind(advance_raw), donate_argnums=(4,)),
+                              donate_argnums=(7,)),
+            "advance": jax.jit(_bind(advance_raw), donate_argnums=(6,)),
         }
         return self._paged
 
-    def _paged_call(self, name, *args):
+    def _paged_call(self, name, adapters, batch, *args):
         p = self._ensure_paged()
         return p[name](next_key(), [nd._data for nd in p["params"]],
-                       self._quant_arg(), *args)
+                       self._quant_arg(), self._lora_arg(),
+                       self._lora_idx(adapters, batch), *args)
 
     def prefill_paged(self, tokens, n_valid, slot, pages, cache, *,
-                      start=0, fresh=False):
+                      start=0, fresh=False, adapters=None):
         """Prefill one chunk (or, with ``fresh=True``, one whole short
         prompt) of ``slot`` into pool pages. ``tokens`` is (1, W) int32
         with W a multiple of the page size; ``pages`` is the slot's
@@ -1418,13 +1640,13 @@ class GPTModel(HybridBlock):
         pages = _as_i32(pages)
         if fresh:
             return self._paged_call(
-                "fresh", tokens, jnp.int32(n_valid), jnp.int32(slot),
-                pages, cache)
+                "fresh", adapters, 1, tokens, jnp.int32(n_valid),
+                jnp.int32(slot), pages, cache)
         return self._paged_call(
-            "chunk", tokens, jnp.int32(start), jnp.int32(n_valid),
-            jnp.int32(slot), pages, cache)
+            "chunk", adapters, 1, tokens, jnp.int32(start),
+            jnp.int32(n_valid), jnp.int32(slot), pages, cache)
 
-    def decode_step_paged(self, tokens, active, cache):
+    def decode_step_paged(self, tokens, active, cache, adapters=None):
         """One decode step for every slot of a PAGED cache: write each
         active row's K/V into its current page at ``len % page_size``,
         attend its valid pages, bump its ``len``. ``active`` (B,) masks
@@ -1432,24 +1654,27 @@ class GPTModel(HybridBlock):
         writes are redirected to the scrap page and their ``len`` is
         not bumped (a freed slot's table row may alias pages owned by
         someone else — garbage logits are ignorable, stray writes are
-        not). Returns ``(logits, cache)`` — cache donated."""
-        return self._paged_call("decode", _as_i32(tokens),
-                                _as_i32(active), cache)
+        not). Returns ``(logits, cache)`` — cache donated.
+        ``adapters`` (B,) selects each row's LoRA bank slot."""
+        tokens = _as_i32(tokens)
+        return self._paged_call("decode", adapters, tokens.shape[0],
+                                tokens, _as_i32(active), cache)
 
-    def peek_logits_paged(self, token, slot, cache):
+    def peek_logits_paged(self, token, slot, cache, adapters=None):
         """Next-token logits for a slot whose ENTIRE prompt is already
         cached (prefix reuse): recompute the last prompt token's query
         at position ``len - 1`` and attend the cached pages — no
         prefill, no write. Cache is NOT donated (unchanged). Returns
         raw (vocab,) logits."""
-        return self._paged_call("peek", jnp.asarray(token, jnp.int32),
+        return self._paged_call("peek", adapters, 1,
+                                jnp.asarray(token, jnp.int32),
                                 jnp.int32(slot), cache)
 
     def bind_slot_paged(self, slot, pages, length, cache):
         """Install a slot's page-table row and valid length (the
         exact-prefix-hit admission: point the table at shared pages;
         no compute). Cache donated."""
-        return self._paged_call("bind", jnp.int32(slot),
+        return self._paged_call("bind", None, 1, jnp.int32(slot),
                                 _as_i32(pages), jnp.int32(length),
                                 cache)
 
@@ -1457,10 +1682,10 @@ class GPTModel(HybridBlock):
         """Copy physical page ``src`` to ``dst`` across every layer's
         K and V pools — the copy half of copy-on-write at a shared
         divergence page. Cache donated."""
-        return self._paged_call("copy", jnp.int32(src),
+        return self._paged_call("copy", None, 1, jnp.int32(src),
                                 jnp.int32(dst), cache)
 
-    def verify_step_paged(self, tokens, active, cache):
+    def verify_step_paged(self, tokens, active, cache, adapters=None):
         """Speculative VERIFY for every slot of a PAGED cache: write
         each active row's ``tokens`` (B, R) int32 — ``[last, d_1 ..
         d_{R-1}]`` — at positions ``[len, len + R)`` through its page
@@ -1473,14 +1698,15 @@ class GPTModel(HybridBlock):
         if tokens.ndim != 2:
             raise ValueError(f"verify tokens must be (batch, R), got "
                              f"shape {tokens.shape}")
-        return self._paged_call("verify", tokens, _as_i32(active),
-                                cache)
+        return self._paged_call("verify", adapters, tokens.shape[0],
+                                tokens, _as_i32(active), cache)
 
     def advance_len_paged(self, delta, cache):
         """Advance each paged row's valid length by ``delta`` (B,)
         int32 — the paged commit/rollback counterpart of
         :meth:`advance_len`. Cache donated."""
-        return self._paged_call("advance", _as_i32(delta), cache)
+        return self._paged_call("advance", None, 1, _as_i32(delta),
+                                cache)
 
 
 def gpt_small(vocab_size=1000, units=64, num_layers=2, num_heads=4,

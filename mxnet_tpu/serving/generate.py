@@ -86,7 +86,11 @@ rejected_closed,timeouts,errors}``, gauges ``serving.generate.slots``
 decode-stall bound; speculation adds
 ``serving.generate.spec.{proposed,accepted,rejected}`` counters and
 the ``spec.accept_rate`` / ``spec.tokens_per_step`` gauges; sampling
-adds ``serving.generate.sampling.requests``.
+adds ``serving.generate.sampling.requests``; multi-tenant LoRA adds
+the ``serving.generate.lora.{adapters_loaded,adapters_evicted,
+requests}`` counters, the ``lora.active_adapters`` gauge, the
+``lora.load`` histogram, and the ``ops.lora.trace`` compile counter
+(the bank analog of ``model.gpt.trace`` for the zero-retrace gates).
 """
 from __future__ import annotations
 
@@ -255,11 +259,11 @@ class GenerationStream:
 class _GenRequest:
     __slots__ = ("prompt", "max_new", "eos_id", "stream", "t_submit",
                  "t_enq", "deadline", "temperature", "top_k", "top_p",
-                 "key")
+                 "key", "adapter_idx")
 
     def __init__(self, prompt, max_new, eos_id, stream, t_submit,
                  t_enq, deadline, temperature=0.0, top_k=0, top_p=1.0,
-                 key=None):
+                 key=None, adapter_idx=0):
         self.prompt = prompt
         self.max_new = max_new
         self.eos_id = eos_id
@@ -271,6 +275,21 @@ class _GenRequest:
         self.top_k = top_k               # 0 = off
         self.top_p = top_p               # 1.0 = off
         self.key = key                   # (2,) uint32 PRNG key data
+        self.adapter_idx = adapter_idx   # LoRA bank slot (0 = base)
+
+
+class _Adapter:
+    """Host-side registry record of one loaded LoRA adapter: its bank
+    slot, the number of requests pinning it (submitted and not yet
+    finished), and whether an unload is deferred behind those pins."""
+
+    __slots__ = ("name", "idx", "refs", "unloading")
+
+    def __init__(self, name, idx):
+        self.name = name
+        self.idx = idx
+        self.refs = 0
+        self.unloading = False
 
 
 class _Slot:
@@ -297,11 +316,13 @@ class _PagedSlot:
 
     __slots__ = ("stream", "last", "left", "eos_id", "deadline", "n_ctx",
                  "state", "chunks", "row", "page_refs", "cow_pending",
-                 "prompt", "seq", "t_submit", "draft_prompt", "key")
+                 "prompt", "seq", "t_submit", "draft_prompt", "key",
+                 "adapter_idx")
 
     def __init__(self, stream, left, eos_id, deadline, n_ctx, row,
                  page_refs, prompt, seq, t_submit):
         self.stream = stream
+        self.adapter_idx = 0   # LoRA bank slot (0 = base model)
         self.draft_prompt = None   # kept in speculative mode for the
         # draft's dense prefill when the slot enters decode
         self.key = None   # stochastic requests: the PRNG key, parked
@@ -519,6 +540,27 @@ class GenerationEngine:
     mesh : jax.sharding.Mesh, optional
         The mesh for ``mesh_layout`` (default: the process-global
         ``parallel.get_mesh()``). Must carry a ``tp`` axis.
+    lora_rank : int, optional
+        Arm batched multi-tenant LoRA (docs/SERVING.md "Multi-tenant
+        LoRA"): the model grows a stacked adapter bank (ops/lora.py)
+        over its attention projections and every generation program
+        gathers each slot's adapter by a per-slot index vector —
+        thousands of fine-tunes share ONE engine, one compiled
+        program, one KV pool. ``load_adapter(name, params)`` /
+        ``unload_adapter(name)`` manage tenants at runtime with zero
+        retraces (the banks are runtime arguments, the quant-table
+        discipline); ``submit(adapter=name)`` binds a request.
+        Per-tenant greedy output is token-identical to a dedicated
+        single-adapter engine. Composes with ``paged=True`` (prefix
+        reuse stays base-model-only), int8 (the LoRA delta stays fp32
+        over the dequant base path) and speculative decoding (the
+        draft proposes with the BASE model; verify/commit runs
+        adapted — greedy commits stay the adapted model's own,
+        acceptance degrades gracefully and is reported).
+    max_adapters : int, optional
+        Loadable adapter slots in the bank (default 8; bank slot 0 is
+        the reserved all-zeros base adapter on top of these). Only
+        meaningful with ``lora_rank``.
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
@@ -529,7 +571,8 @@ class GenerationEngine:
                  n_pages=None, prefill_chunk=None,
                  prefix_cache: bool = True, quantize=None,
                  kv_dtype=None, draft_model=None, spec_k: int = 4,
-                 speculative=None, mesh_layout=None, mesh=None):
+                 speculative=None, mesh_layout=None, mesh=None,
+                 lora_rank=None, max_adapters=None):
         self.paged = bool(paged)
         if speculative is None:
             speculative = draft_model is not None
@@ -574,6 +617,34 @@ class GenerationEngine:
             telemetry.counter("serving.generate.quant.params", n)
             telemetry.counter("serving.generate.quant.bytes_saved",
                               saved)
+        self.lora_enabled = lora_rank is not None
+        if max_adapters is not None and not self.lora_enabled:
+            raise ValueError(
+                "max_adapters without lora_rank is inert; pass "
+                "lora_rank= to arm the batched adapter bank")
+        if self.lora_enabled:
+            self.lora_rank = int(lora_rank)
+            if self.lora_rank < 1:
+                raise ValueError(f"lora_rank must be >= 1, got "
+                                 f"{lora_rank}")
+            self.max_adapters = 8 if max_adapters is None \
+                else int(max_adapters)
+            if self.max_adapters < 1:
+                raise ValueError(f"max_adapters must be >= 1, got "
+                                 f"{max_adapters}")
+            for attr in ("arm_lora", "set_adapter", "clear_adapter"):
+                if not callable(getattr(model, attr, None)):
+                    raise TypeError(
+                        f"lora_rank= needs a model exposing the "
+                        f"batched-LoRA API (missing {attr!r}); see "
+                        f"gluon.model_zoo.gpt.GPTModel")
+            # bank slot 0 is the reserved all-zeros base adapter, so
+            # the bank holds max_adapters + 1 slots; arming BEFORE
+            # warmup() means the one structural retrace happens there
+            model.arm_lora(self.max_adapters + 1, self.lora_rank)
+        else:
+            self.lora_rank = None
+            self.max_adapters = 0
         api = ("init_paged_cache", "prefill_paged", "decode_step_paged",
                "peek_logits_paged", "bind_slot_paged",
                "copy_page_paged") if self.paged \
@@ -618,11 +689,11 @@ class GenerationEngine:
                     f"unsupported mesh_layout={mesh_layout!r} (only "
                     f"'tp')")
             if self.paged or self.speculative or quantize is not None \
-                    or cache_dtype is not None:
+                    or cache_dtype is not None or self.lora_enabled:
                 raise ValueError(
                     "mesh_layout='tp' currently composes with the "
                     "dense fp32 engine only (paged / speculative / "
-                    "int8 engines stay single-device)")
+                    "int8 / LoRA engines stay single-device)")
             from .. import parallel as _parallel
             from ..parallel import partition as _partition
             m = mesh if mesh is not None else _parallel.get_mesh()
@@ -749,6 +820,28 @@ class GenerationEngine:
         self._keys = onp.zeros((self.max_slots, 2), "u4")
         self._n_sampling = 0   # active slots with temperature > 0
         self._samplers = None  # jitted ops/sampling.py programs (lazy)
+        #: per-slot LoRA bank indices, threaded as a runtime (B,)
+        #: vector through every fixed-shape generation program — a
+        #: batch mixing any tenants (base rows included) runs ONE
+        #: compiled program; the vector is data, never shape
+        self._adapter_idx = onp.zeros((self.max_slots,), "i4")
+        #: host-side adapter registry: name -> _Adapter (bank slot,
+        #: pin count, deferred-unload flag). _lora_lock is a LEAF
+        #: lock — taken from submit, stream-finish callbacks and the
+        #: swap-locked load/unload paths, never around a model call
+        self._lora_lock = threading.Lock()
+        self._lora_reg: dict = {}
+        self._lora_free = list(range(1, self.max_adapters + 1))
+        #: freed-but-not-yet-zeroed bank slots: eviction paths run in
+        #: stream-finish callbacks that may hold the worker's
+        #: ``_gen_lock``, where ``model.clear_adapter`` (a
+        #: read-modify-write of the banks) cannot be serialized
+        #: against a concurrent ``set_adapter`` — so the factors are
+        #: zeroed lazily inside the NEXT ``load_adapter``'s
+        #: ``_gen_exclusive`` window (a freed slot is unreachable —
+        #: no registry name maps to it — so this is hygiene, never
+        #: correctness, and bank bytes are preallocated either way)
+        self._lora_stale: set = set()
         self._kv_int8 = "k_scale" in self._cache
         if self._kv_int8:   # quant.* telemetry only for quantized
             # engines — an fp32 fleet must not populate the namespace
@@ -808,6 +901,204 @@ class GenerationEngine:
         return (f"k={self.spec_k}:draft={type(d).__name__}:"
                 f"{getattr(d, '_num_layers', '?')}L-"
                 f"{getattr(d, '_units', '?')}u")
+
+    @property
+    def lora(self) -> str:
+        """The replica's batched-LoRA configuration — ``"off"`` or
+        ``"rank=<r>:max=<n>"``. Router fleets must be LoRA-config-
+        homogeneous (the precision/speculation rule's sibling): a
+        retried request re-runs ``adapter=`` on another replica, and
+        the binding only means the same thing when every replica's
+        bank has the same shape."""
+        if not self.lora_enabled:
+            return "off"
+        return f"rank={self.lora_rank}:max={self.max_adapters}"
+
+    def capabilities(self) -> str:
+        """One-line summary of the engine's configured capabilities —
+        quoted by every ``submit`` kwarg-validation error so a caller
+        holding the wrong engine sees what this one actually does."""
+        return (f"precision={self.precision}, "
+                f"speculation={self.speculation}, lora={self.lora}, "
+                f"paged={self.paged}")
+
+    def _submit_error(self, arg, value, why):
+        """The shared ``submit`` kwarg-validation error: names the
+        offending argument AND the engine's configured capabilities
+        (a bare TypeError told the caller neither)."""
+        return TypeError(
+            f"submit() {arg}={value!r} not supported: {why} "
+            f"(engine capabilities: {self.capabilities()})")
+
+    # -- multi-tenant LoRA (docs/SERVING.md "Multi-tenant LoRA") --------
+    @property
+    def adapters(self):
+        """Sorted names of the loaded adapters (unload-pending ones —
+        pinned by in-flight requests — excluded: they reject new
+        submits already)."""
+        with self._lora_lock:
+            return sorted(name for name, ad in self._lora_reg.items()
+                          if not ad.unloading)
+
+    def has_adapter(self, name) -> bool:
+        """Membership check for ONE adapter name (loaded and not
+        unload-pending) — a single dict lookup under the leaf lock.
+        The Router's per-submit validation hot path: it must not
+        materialize and sort the whole registry per replica per
+        request just to answer a membership question."""
+        with self._lora_lock:
+            ad = self._lora_reg.get(name)
+            return ad is not None and not ad.unloading
+
+    def _lora_active_locked(self):
+        """Loaded-adapter count for the ``lora.active_adapters``
+        gauge — unload-pending names excluded, matching the
+        :attr:`adapters` property and the OBSERVABILITY.md row (they
+        already reject new submits). Call under ``_lora_lock``."""
+        return sum(1 for ad in self._lora_reg.values()
+                   if not ad.unloading)
+
+    def load_adapter(self, name, params, alpha=1.0):
+        """Load (or refresh) one tenant's LoRA adapter under the swap
+        lock, with ZERO retraces: the stacked banks are runtime
+        arguments of the jitted closures, so installing the factors is
+        a step-boundary array swap — the ``load_weights`` discipline
+        applied to the tenant axis. ``params`` is the flat
+        ``{"layers.<li>.<proj>.A"/".B": array}`` mapping of
+        ``GPTModel.set_adapter``. Refreshing an existing name keeps
+        its bank slot; in-flight requests bound to it simply continue
+        on the new factors (the documented rollover semantics)."""
+        if not self.lora_enabled:
+            raise TypeError(
+                f"load_adapter({name!r}): this engine has no LoRA "
+                f"bank (constructed without lora_rank=) (engine "
+                f"capabilities: {self.capabilities()})")
+        if self._closed:
+            raise EngineClosedError("load_adapter on a closed engine")
+        t0 = telemetry.clock()
+        with self._gen_exclusive():
+            with self._lora_lock:
+                ad = self._lora_reg.get(name)
+                if ad is not None and ad.unloading:
+                    raise ValueError(
+                        f"adapter {name!r} is unloading (pinned by "
+                        f"in-flight requests); retry once they finish")
+                if ad is None and not self._lora_free:
+                    raise ValueError(
+                        f"adapter capacity exhausted: {self.max_adapters} "
+                        f"slots all hold live adapters "
+                        f"({sorted(self._lora_reg)!r}, unload-pending "
+                        f"included)")
+                idx = ad.idx if ad is not None \
+                    else self._lora_free[0]
+                stale = self._lora_stale
+                self._lora_stale = set()
+            # the model calls happen under _gen_exclusive only (never
+            # the leaf lock): a worker step is between iterations
+            # here. First zero any slots freed since the last swap
+            # window (evicted tenants' factors must not linger in the
+            # bank), then install the new factors.
+            for s in stale:
+                # idx included even though set_adapter overwrites it:
+                # if the install's validation raises, the slot must
+                # not keep the evicted tenant's factors
+                self.model.clear_adapter(s)
+            self.model.set_adapter(idx, params, alpha=alpha)
+            with self._lora_lock:
+                if self._lora_reg.get(name) is None:
+                    # fresh load — or a refresh whose name vanished
+                    # between the two lock sections (a concurrent
+                    # unload completing via a pin drop takes only the
+                    # leaf lock): the factors ARE installed in `idx`,
+                    # so re-register instead of returning success for
+                    # an adapter that is no longer loaded
+                    self._lora_free.remove(idx)
+                    self._lora_reg[name] = _Adapter(name, idx)
+                # the slot holds a live install now: a concurrent
+                # eviction in the window above must not leave it
+                # marked for the next swap's lazy zeroing
+                self._lora_stale.discard(idx)
+                n_active = self._lora_active_locked()
+        telemetry.hist_since("serving.generate.lora.load", t0)
+        telemetry.counter("serving.generate.lora.adapters_loaded")
+        telemetry.gauge("serving.generate.lora.active_adapters",
+                        n_active)
+        return self
+
+    def unload_adapter(self, name) -> bool:
+        """Unload an adapter. Returns True when the bank slot was
+        freed immediately; False when in-flight requests still pin it
+        — the unload is DEFERRED: the name stops accepting new
+        submits now, and the slot is freed when the last pinned
+        request finishes (``lora.adapters_evicted`` counts the actual
+        eviction either way)."""
+        if not self.lora_enabled:
+            raise TypeError(
+                f"unload_adapter({name!r}): this engine has no LoRA "
+                f"bank (constructed without lora_rank=) (engine "
+                f"capabilities: {self.capabilities()})")
+        with self._lora_lock:
+            ad = self._lora_reg.get(name)
+            if ad is None:
+                raise ValueError(
+                    f"unknown adapter {name!r} (loaded: "
+                    f"{sorted(self._lora_reg)!r})")
+            if ad.refs > 0:
+                ad.unloading = True
+                n_active = self._lora_active_locked()
+                deferred = True
+            else:
+                del self._lora_reg[name]
+                self._lora_free.append(ad.idx)
+                self._lora_free.sort()
+                self._lora_stale.add(ad.idx)
+                n_active = self._lora_active_locked()
+                deferred = False
+        telemetry.gauge("serving.generate.lora.active_adapters",
+                        n_active)
+        if deferred:
+            return False
+        telemetry.counter("serving.generate.lora.adapters_evicted")
+        return True
+
+    def _pin_adapter(self, name):
+        """Resolve an ``adapter=`` submit binding to its bank slot and
+        pin it (in-flight requests keep their adapter loaded: an
+        unload while they run is deferred, never a mid-stream tenant
+        swap to base)."""
+        with self._lora_lock:
+            ad = self._lora_reg.get(name)
+            if ad is None or ad.unloading:
+                loaded = sorted(n for n, a in self._lora_reg.items()
+                                if not a.unloading)
+                raise ValueError(
+                    f"submit() adapter={name!r} is not loaded on this "
+                    f"engine (loaded adapters: {loaded!r}; engine "
+                    f"capabilities: {self.capabilities()})")
+            ad.refs += 1
+            return ad.idx
+
+    def _unpin_adapter(self, name):
+        """Drop one request's pin; completes a deferred unload when
+        the last pin goes (stream-finish callback — leaf lock only,
+        safe under the worker's ``_gen_lock``)."""
+        evicted = False
+        with self._lora_lock:
+            ad = self._lora_reg.get(name)
+            if ad is None:
+                return
+            ad.refs -= 1
+            if ad.refs <= 0 and ad.unloading:
+                del self._lora_reg[name]
+                self._lora_free.append(ad.idx)
+                self._lora_free.sort()
+                self._lora_stale.add(ad.idx)
+                evicted = True
+                n_active = self._lora_active_locked()
+        if evicted:
+            telemetry.counter("serving.generate.lora.adapters_evicted")
+            telemetry.gauge("serving.generate.lora.active_adapters",
+                            n_active)
 
     def _ensure_samplers(self):
         """The jitted ops/sampling.py programs (lazy — importing jax
@@ -1146,7 +1437,7 @@ class GenerationEngine:
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
                timeout_ms=None, temperature=None, top_k=None,
-               top_p=None, seed=None) -> GenerationStream:
+               top_p=None, seed=None, adapter=None) -> GenerationStream:
         """Queue one prompt; returns a :class:`GenerationStream`.
         Raises :class:`EngineClosedError` / :class:`QueueFullError` /
         ``ValueError`` immediately instead of returning a stream that
@@ -1159,7 +1450,13 @@ class GenerationEngine:
         identical token stream on every rerun of the same engine
         configuration, across engine restarts (docs/SERVING.md
         "Speculative decoding & sampling"). Without a seed, a fresh
-        one is drawn per request."""
+        one is drawn per request.
+
+        ``adapter`` names a loaded LoRA adapter (``load_adapter``) the
+        request decodes under — per-slot runtime data, so any tenant
+        mix shares the one compiled program; the adapter stays PINNED
+        (unload defers) until the request finishes. Default: the base
+        model."""
         if self._failure is not None:
             telemetry.counter("serving.generate.rejected_closed")
             raise ReplicaFailedError(str(self._failure),
@@ -1171,20 +1468,35 @@ class GenerationEngine:
                                               eos_id)
         temp, tk, tp, seed = self._validate_sampling(
             temperature, top_k, top_p, seed)
+        if adapter is not None and not self.lora_enabled:
+            raise self._submit_error(
+                "adapter", adapter, "this engine has no LoRA bank "
+                "(constructed without lora_rank=)")
         key = None
         if temp > 0:
             telemetry.counter("serving.generate.sampling.requests")
             if seed is None:
                 seed = int.from_bytes(os.urandom(4), "little")
             key = request_key(seed)
+        aidx = 0
+        if adapter is not None:
+            aidx = self._pin_adapter(adapter)  # raises on unknown name
+            telemetry.counter("serving.generate.lora.requests")
         telemetry.counter("serving.generate.requests")
         stream = GenerationStream(int(prompt.size))
+        if adapter is not None:
+            # every stream finishes exactly once on every engine path
+            # (the no-hung-stream contract) — the finish callback is
+            # therefore the one place the pin reliably drops
+            stream._watch(lambda _tok: None,
+                          lambda _r, _e: self._unpin_adapter(adapter))
         tmo = self.timeout_ms if timeout_ms is None else timeout_ms
         now = time.monotonic()
         req = _GenRequest(
             prompt, max_new, eos, stream, telemetry.clock(), now,
             now + tmo / 1e3 if tmo is not None else None,
-            temperature=temp, top_k=tk, top_p=tp, key=key)
+            temperature=temp, top_k=tk, top_p=tp, key=key,
+            adapter_idx=aidx)
         if self._sync:  # MXTPU_SERVING=0: inline generation
             with self._gen_lock:
                 self._admit_one(req)
@@ -1204,6 +1516,10 @@ class GenerationEngine:
             self._worker._queue.put_nowait(req)
         except queue.Full:
             telemetry.counter("serving.generate.rejected_full")
+            if adapter is not None:
+                # the stream never reaches the engine, so its finish
+                # callback never fires — drop the pin here
+                self._unpin_adapter(adapter)
             raise QueueFullError(
                 f"request queue at queue_limit={self.queue_limit}") \
                 from None
@@ -1305,7 +1621,8 @@ class GenerationEngine:
         t0 = telemetry.clock()
         logits, self._cache = self.model.prefill(
             padded, onp.asarray([n], "i4"), self._cache,
-            slots=onp.asarray([slot], "i4"))
+            slots=onp.asarray([slot], "i4"),
+            **self._akw(self._adapter_idx[slot:slot + 1]))
         if self._part is not None:
             self._cache = self._recommit(self._cache)
         if self.speculative:
@@ -1347,10 +1664,17 @@ class GenerationEngine:
         self._temps[slot] = r.temperature
         self._topks[slot] = r.top_k
         self._topps[slot] = r.top_p
+        self._adapter_idx[slot] = r.adapter_idx
         if r.temperature > 0:
             self._n_sampling += 1
             if not self.paged:
                 self._keys[slot] = r.key
+
+    def _akw(self, idx):
+        """``adapters=`` kwarg for a model call — present only on a
+        LoRA-enabled engine, so other decoder families never need to
+        grow the keyword."""
+        return {"adapters": idx} if self.lora_enabled else {}
 
     def _pick_first(self, slot: int, logits_row):
         """First token of a fresh admission, from its prefill/peek
@@ -1395,7 +1719,13 @@ class GenerationEngine:
         ps = self._ps
         cap_pages = -(-min(length + r.max_new, self._s_cap) // ps)
         shared_pages, shared_tokens = [], 0
-        if self._prefix is not None:
+        if self._prefix is not None and r.adapter_idx == 0:
+            # prefix reuse is BASE-MODEL-only: cached pages hold K/V
+            # computed under the projections that prefilled them, and
+            # an adapter changes q/k/v — serving one tenant's pages to
+            # another (or adapted pages to base traffic) would
+            # silently swap attention context. Adapter requests always
+            # prefill fresh and never publish to the index.
             shared_pages, shared_tokens = self._prefix.match(r.prompt)
         peek = shared_tokens == length
         first_write = (length if peek else shared_tokens) // ps
@@ -1435,6 +1765,7 @@ class GenerationEngine:
                        n_ctx=length, row=row, page_refs=refs,
                        prompt=r.prompt, seq=self._seq,
                        t_submit=r.t_submit)
+        s.adapter_idx = r.adapter_idx
         if self.speculative:
             # survives prefix registration (which clears s.prompt):
             # the draft's dense prefill runs when the slot enters
@@ -1459,7 +1790,8 @@ class GenerationEngine:
             self._cache = self.model.bind_slot_paged(
                 slot, row, length, self._cache)
             logits = self.model.peek_logits_paged(
-                int(r.prompt[-1]), slot, self._cache)
+                int(r.prompt[-1]), slot, self._cache,
+                **self._akw(self._adapter_idx[slot:slot + 1]))
             telemetry.hist_since("serving.generate.prefill", t0)
             telemetry.counter("serving.generate.prefills")
             self._register_prefix(s)
@@ -1494,7 +1826,8 @@ class GenerationEngine:
         index-retained tail page becomes shared — arm a COW so the
         slot's first decode write copies it instead of corrupting the
         cached prefix."""
-        if self._prefix is None or s.prompt is None:
+        if self._prefix is None or s.prompt is None \
+                or s.adapter_idx != 0:  # adapted K/V never publishes
             return
         length = int(s.prompt.size)
         needs_cow = (length % self._ps != 0 and s.cow_pending is None
@@ -1576,7 +1909,8 @@ class GenerationEngine:
         t0 = telemetry.clock()
         logits, self._cache = self.model.prefill_paged(
             toks, n_valid, best, s.row, self._cache, start=start,
-            fresh=fresh)
+            fresh=fresh,
+            **self._akw(self._adapter_idx[best:best + 1]))
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefill_chunks")
         self._chunks_this_iter += 1
@@ -1635,7 +1969,8 @@ class GenerationEngine:
                 active[i] = 1
         t0 = telemetry.clock()
         logits, self._cache = self.model.decode_step_paged(
-            toks, active, self._cache)
+            toks, active, self._cache,
+            **self._akw(self._adapter_idx))
         telemetry.hist_since("serving.generate.decode", t0)
         step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()
@@ -1682,6 +2017,7 @@ class GenerationEngine:
         self._temps[slot] = 0.0    # the next tenant must never read a
         self._topks[slot] = 0      # previous request's knobs
         self._topps[slot] = 1.0
+        self._adapter_idx[slot] = 0  # freed rows decode as base
         telemetry.counter("serving.generate.evictions")
         telemetry.gauge("serving.generate.slots", self._n_active)
 
@@ -1717,7 +2053,8 @@ class GenerationEngine:
             if s is not None:
                 toks[i] = s.last
         t0 = telemetry.clock()
-        logits, self._cache = self.model.decode_step(toks, self._cache)
+        logits, self._cache = self.model.decode_step(
+            toks, self._cache, **self._akw(self._adapter_idx))
         if self._part is not None:
             self._cache = self._recommit(self._cache)
         telemetry.hist_since("serving.generate.decode", t0)
@@ -1790,14 +2127,16 @@ class GenerationEngine:
                 else self.model.verify_commit)(
                 toks, dt, active, self._cache, q=q, keys=keys,
                 temps=self._temps, top_ks=self._topks,
-                top_ps=self._topps)
+                top_ps=self._topps,
+                **self._akw(self._adapter_idx))
         else:
             dt, self._draft_cache = self.draft.propose_tokens(
                 toks, self._draft_cache, k)
             commit, n_commit, self._cache = (
                 self.model.verify_commit_paged if self.paged
                 else self.model.verify_commit)(
-                toks, dt, active, self._cache)
+                toks, dt, active, self._cache,
+                **self._akw(self._adapter_idx))
         commit_h = onp.asarray(commit)    # the tick's one host sync
         n_h = onp.asarray(n_commit)
         if sampled:

@@ -43,6 +43,13 @@ it:
   rollover: cordoned replicas stop taking new traffic while their
   queue drains, in-flight slots finish on the new weights, and no
   request is dropped fleet-wide.
+- **Multi-tenant LoRA propagation** — ``submit(adapter=name)`` rides
+  every dispatch and retry; :meth:`Router.load_adapter` /
+  :meth:`unload_adapter` roll an adapter across the fleet (the
+  ``load_weights`` pattern, zero retraces per engine), and a fleet
+  whose adapter registries diverge is rejected AT DISPATCH — a
+  cross-replica retry must be able to re-bind the same adapter on
+  whichever replica catches it.
 
 Every replica dispatch passes through the
 :class:`~mxnet_tpu.serving.FaultInjector` seam (``fault_injector=``),
@@ -136,11 +143,11 @@ class _Replica:
 class _Req:
     __slots__ = ("payload", "max_new", "eos_id", "deadline", "tenant",
                  "priority", "retries_left", "sink", "t0", "finished",
-                 "prefix_key", "sampling")
+                 "prefix_key", "sampling", "adapter")
 
     def __init__(self, payload, max_new, eos_id, deadline, tenant,
                  priority, retries_left, sink, t0, prefix_key=None,
-                 sampling=None):
+                 sampling=None, adapter=None):
         self.payload = payload
         self.max_new = max_new
         self.eos_id = eos_id
@@ -161,6 +168,11 @@ class _Req:
         #: ulp-knife-edge accept draw in rare cases; greedy retries
         #: are exact)
         self.sampling = sampling
+        #: LoRA adapter name, forwarded verbatim to every dispatch
+        #: attempt (registry homogeneity is checked at admission, so
+        #: a cross-replica retry re-binds the same adapter and stays
+        #: token-identical)
+        self.adapter = adapter
 
 
 class _Prober(threading.Thread):
@@ -266,7 +278,8 @@ class Router:
             # the bounded-divergence contract both break
             raise TypeError(
                 f"replicas must be precision-homogeneous, got "
-                f"{sorted(precisions)}")
+                f"{sorted(precisions)} (replica capabilities: "
+                f"{self._fleet_capabilities(replicas)})")
         specs = {getattr(e, "speculation", "off") for e in replicas}
         if len(specs) > 1:
             # same rule for the speculation config (the draft model
@@ -277,7 +290,19 @@ class Router:
             # on which replica caught it
             raise TypeError(
                 f"replicas must be speculation-homogeneous, got "
-                f"{sorted(specs)}")
+                f"{sorted(specs)} (replica capabilities: "
+                f"{self._fleet_capabilities(replicas)})")
+        loras = {getattr(e, "lora", "off") for e in replicas}
+        if len(loras) > 1:
+            # and for the LoRA bank config: an adapter= binding only
+            # means the same thing fleet-wide when every replica's
+            # bank has the same rank/capacity — a retry must be able
+            # to land anywhere (per-NAME registry homogeneity is
+            # enforced per dispatch; this is the structural half)
+            raise TypeError(
+                f"replicas must be LoRA-config-homogeneous, got "
+                f"{sorted(loras)} (replica capabilities: "
+                f"{self._fleet_capabilities(replicas)})")
         self._replicas = [_Replica(e, i) for i, e in enumerate(replicas)]
         self.max_retries = int(max_retries)
         self.breaker_threshold = max(1, int(breaker_threshold))
@@ -302,8 +327,43 @@ class Router:
         self._lock = threading.Lock()
         self._outstanding = 0
         self._tenant_out: dict = {}
+        #: router-level adapter pins: name -> count of in-flight
+        #: requests bound to it (pins survive retries — the engines'
+        #: per-replica pin only covers the replica actually serving,
+        #: but a cross-replica retry must be able to re-bind the
+        #: adapter on ANY replica, so a fleet unload defers while any
+        #: router request holds the name)
+        self._adapter_inflight: dict = {}
+        #: adapter names whose fleet-wide unload is deferred behind
+        #: the pins above (new submits with them are rejected now)
+        self._adapter_draining: set = set()
+        #: drained names whose rolling unload is waiting for the
+        #: prober thread (a stream-finish callback may hold an engine
+        #: worker's step lock, where running the roll inline could
+        #: deadlock against a load_adapter waiting on that engine's
+        #: step boundary under the roll lock)
+        self._adapter_drain_pending: set = set()
+        #: serializes fleet-wide adapter rolls — a concurrent
+        #: load_adapter/unload_adapter pair on one name must not
+        #: interleave per replica, or the two rolls can finish in
+        #: opposite orders on different replicas and leave the name
+        #: PERSISTENTLY heterogeneous with both calls reporting
+        #: success
+        self._adapter_roll_lock = threading.Lock()
         self._closed = False
         self._prober = _Prober(self, float(probe_interval_s))
+
+    @staticmethod
+    def _fleet_capabilities(engines):
+        """Per-replica capability summary for heterogeneity errors —
+        names what each engine actually does instead of leaving the
+        caller to diff constructors (the shared submit-kwarg-error
+        discipline, fleet-shaped)."""
+        caps = []
+        for i, e in enumerate(engines):
+            fn = getattr(e, "capabilities", None)
+            caps.append(f"[{i}] {fn() if callable(fn) else 'n/a'}")
+        return "; ".join(caps)
 
     # -- lifecycle -----------------------------------------------------
     @property
@@ -531,6 +591,21 @@ class Router:
             else:
                 rep.engine._fail_all(exc)
         telemetry.gauge("serving.router.healthy_replicas", healthy)
+        self._run_pending_drains()
+
+    def _run_pending_drains(self):
+        """Deferred fleet unloads whose last router pin dropped —
+        executed here on the prober thread, never inline in the
+        releasing thread (a stream-finish callback may hold an engine
+        worker's step lock, where blocking on the roll lock could
+        deadlock against a ``load_adapter`` waiting on that same
+        engine's step boundary)."""
+        while True:
+            with self._lock:
+                if not self._adapter_drain_pending:
+                    return
+                name = self._adapter_drain_pending.pop()
+            self._unload_adapter_now(name)
 
     def health(self) -> dict:
         """Snapshot per replica: ``{idx: {state, breaker, inflight,
@@ -570,10 +645,19 @@ class Router:
             return q.get(tenant)
         return int(q)
 
-    def _admit(self, tenant, priority, max_new):
-        """Shedding + quota gate; reserves one outstanding slot.
-        Returns the (possibly brownout-capped) generation budget."""
+    def _admit(self, tenant, priority, max_new, adapter=None):
+        """Shedding + quota gate; reserves one outstanding slot and —
+        atomically with it — the request's router-level adapter pin,
+        so an ``unload_adapter`` can never slip between validation and
+        admission (the pin defers the fleet unload until the last
+        bound request releases). Returns the (possibly
+        brownout-capped) generation budget."""
         with self._lock:
+            if adapter is not None and adapter in self._adapter_draining:
+                raise ValueError(
+                    f"submit() adapter={adapter!r} is unloading "
+                    f"fleet-wide (pinned by in-flight requests); it "
+                    f"no longer accepts new submits")
             out = self._outstanding
             if out >= self.queue_limit:
                 telemetry.counter("serving.router.rejected_shed")
@@ -602,6 +686,9 @@ class Router:
             self._outstanding = out + 1
             self._tenant_out[tenant] = \
                 self._tenant_out.get(tenant, 0) + 1
+            if adapter is not None:
+                self._adapter_inflight[adapter] = \
+                    self._adapter_inflight.get(adapter, 0) + 1
             telemetry.gauge("serving.router.outstanding",
                             self._outstanding)
         return max_new
@@ -610,7 +697,9 @@ class Router:
         """Undo the admission reservation; returns False if the
         request was already finished (idempotence — the single place
         the finished flag and the outstanding/tenant accounting
-        change together)."""
+        change together). Dropping the last router-level pin on a
+        draining adapter queues the deferred fleet-wide unload for
+        the prober thread."""
         with self._lock:
             if req.finished:
                 return False
@@ -621,6 +710,22 @@ class Router:
                 self._tenant_out.pop(req.tenant, None)
             else:
                 self._tenant_out[req.tenant] = n
+            if getattr(req, "adapter", None) is not None:
+                a = req.adapter
+                left = self._adapter_inflight.get(a, 1) - 1
+                if left <= 0:
+                    self._adapter_inflight.pop(a, None)
+                    if a in self._adapter_draining:
+                        # keep the draining mark (no submit can
+                        # re-pin the name) and hand the roll to the
+                        # prober thread: this release may run in a
+                        # stream-finish callback under an engine
+                        # worker's step lock, where taking the roll
+                        # lock could deadlock against a load_adapter
+                        # waiting on that engine's step boundary
+                        self._adapter_drain_pending.add(a)
+                else:
+                    self._adapter_inflight[a] = left
             telemetry.gauge("serving.router.outstanding",
                             self._outstanding)
         return True
@@ -629,7 +734,7 @@ class Router:
     def submit(self, *args, max_new_tokens=None, eos_id=None,
                timeout_ms=None, tenant: str = "default",
                priority: int = 0, prefix_key=None, temperature=None,
-               top_k=None, top_p=None, seed=None):
+               top_k=None, top_p=None, seed=None, adapter=None):
         """Queue one request on the fleet.
 
         Generation fleets take exactly one positional ``prompt`` and
@@ -648,6 +753,12 @@ class Router:
         stochastic request without an explicit seed gets one pinned at
         admission, so a cross-replica retry replays the identical
         stream and the prefix-skip stays token-identical.
+        ``adapter`` names a LoRA adapter the request decodes under
+        (generation fleets; ``Router.load_adapter`` installs it
+        fleet-wide): the name must resolve on EVERY live replica —
+        the fleet's registries are compared at dispatch and a
+        heterogeneous fleet is rejected, because a cross-replica
+        retry must be able to re-bind the same adapter anywhere.
         Raises :class:`EngineClosedError` / :class:`LoadShedError` /
         :class:`TenantQuotaError` / :class:`QueueFullError` /
         ``ValueError`` immediately, never via a hung stream."""
@@ -667,6 +778,8 @@ class Router:
                 args[0], max_new_tokens, eos_id)
             temp, tk, tp, seed = lead._validate_sampling(
                 temperature, top_k, top_p, seed)
+            if adapter is not None:
+                self._validate_adapter(adapter)
             sampling = None
             if temp > 0:
                 if seed is None:
@@ -675,18 +788,21 @@ class Router:
                     seed = int.from_bytes(os.urandom(4), "little")
                 sampling = {"temperature": temp, "top_k": tk,
                             "top_p": tp, "seed": seed}
-            max_new = self._admit(tenant, priority, max_new)
+            max_new = self._admit(tenant, priority, max_new,
+                                  adapter=adapter)
             sink = RouterStream(int(prompt.size), tenant, priority)
             req = _Req(prompt, max_new, eos, deadline, tenant, priority,
                        self.max_retries, sink, telemetry.clock(),
-                       prefix_key=prefix_key, sampling=sampling)
+                       prefix_key=prefix_key, sampling=sampling,
+                       adapter=adapter)
         else:
             if max_new_tokens is not None or eos_id is not None \
                     or temperature is not None or top_k is not None \
-                    or top_p is not None or seed is not None:
+                    or top_p is not None or seed is not None \
+                    or adapter is not None:
                 raise TypeError(
-                    "max_new_tokens/eos_id and the sampling knobs "
-                    "apply to generation fleets only")
+                    "max_new_tokens/eos_id, the sampling knobs and "
+                    "adapter= apply to generation fleets only")
             self._admit(tenant, priority, None)
             sink = Future()
             sink.tenant, sink.priority = tenant, priority
@@ -701,6 +817,42 @@ class Router:
             self._release(req)
             raise
         return sink
+
+    def _validate_adapter(self, adapter):
+        """Resolve an ``adapter=`` binding against the fleet at
+        dispatch time: the REQUESTED name must be loaded on every
+        LIVE replica (a cross-replica retry re-binds the name on
+        whichever replica catches it — a fleet where this name is
+        missing, or unloading, on some replicas cannot honor that).
+        The check is scoped to the requested name: an in-progress
+        rolling load/unload of an UNRELATED adapter must not shed
+        valid tenant traffic. Rejected requests raise here, at the
+        router edge, before any admission state is reserved."""
+        lead = self._replicas[0].engine
+        if not getattr(lead, "lora_enabled", False):
+            raise lead._submit_error(
+                "adapter", adapter, "this fleet has no LoRA bank "
+                "(replicas constructed without lora_rank=)")
+        live = [rep for rep in self._replicas if not self._dead(rep)]
+        # one dict lookup per replica (has_adapter) — the submit hot
+        # path never materializes/sorts whole registries; those are
+        # built only to compose a failing request's error message
+        have = {rep.idx for rep in live
+                if rep.engine.has_adapter(adapter)}
+        if have and len(have) < len(live):
+            raise TypeError(
+                f"adapter={adapter!r} rejected: the fleet's "
+                f"registries are heterogeneous for this name (loaded "
+                f"on replicas {sorted(have)!r}, missing on "
+                f"{sorted({r.idx for r in live} - have)!r}) — a "
+                f"cross-replica retry could not re-bind the adapter; "
+                f"roll the load fleet-wide via Router.load_adapter")
+        if not have:
+            loaded = sorted({n for rep in live
+                             for n in rep.engine.adapters})
+            raise ValueError(
+                f"unknown adapter {adapter!r}: not loaded on the "
+                f"fleet (loaded adapters: {loaded!r})")
 
     def generate(self, prompt, timeout=None, **kwargs):
         """Blocking convenience (generation fleets):
@@ -764,10 +916,12 @@ class Router:
                 if self._faults is not None:
                     self._faults.on_dispatch(rep.idx, rep.engine)
                 if self._mode == "generate":
+                    akw = {} if req.adapter is None \
+                        else {"adapter": req.adapter}
                     attempt = rep.engine.submit(
                         req.payload, max_new_tokens=req.max_new,
                         eos_id=req.eos_id, timeout_ms=rem_ms,
-                        **(req.sampling or {}))
+                        **(req.sampling or {}), **akw)
                 else:
                     attempt = rep.engine.submit(*req.payload,
                                                 timeout_ms=rem_ms)
@@ -956,3 +1110,134 @@ class Router:
                     rep.cordoned = False
         telemetry.counter("serving.router.rollovers")
         return swapped
+
+    # -- fleet-wide adapter management ----------------------------------
+    def load_adapter(self, name, params, alpha=1.0):
+        """Fleet-wide LoRA adapter rollover, one replica at a time —
+        the ``load_weights`` rolling pattern on the tenant axis:
+        cordon (new traffic prefers the others), install via the
+        engine's own zero-retrace ``load_adapter``, restore. No drain
+        wait is needed: a NEW adapter touches no in-flight request,
+        and a refresh of an existing one has the per-engine rollover
+        semantics (in-flight slots continue on the refreshed
+        factors). Returns the number of replicas that installed it.
+        ``submit(adapter=name)`` requires the name on EVERY live
+        replica, so route traffic at it only after this returns. A
+        per-replica rejection (e.g. one engine still draining the
+        name's previous unload) does NOT abort the roll — the rest of
+        the fleet still installs and the first error re-raises at the
+        end (aborting mid-roll would strand the fleet heterogeneous
+        on every replica AFTER the failed one; re-running converges,
+        refresh is idempotent)."""
+        if self._closed:
+            raise EngineClosedError("load_adapter on a closed Router")
+        with self._adapter_roll_lock:
+            # the roll lock serializes fleet rolls per name: a
+            # concurrent unload roll interleaving per replica could
+            # otherwise finish in opposite orders on different
+            # replicas and leave the name persistently heterogeneous
+            # with both calls reporting success
+            with self._lock:
+                if name in self._adapter_draining:
+                    # the engine-level rule, fleet-shaped: a reload
+                    # now would report success and then be silently
+                    # evicted when the pending deferred unload drains
+                    raise ValueError(
+                        f"adapter {name!r} is unloading fleet-wide "
+                        f"(pinned by in-flight requests); retry once "
+                        f"they finish")
+            swapped, first_err = 0, None
+            for rep in self._replicas:
+                if self._dead(rep):
+                    continue
+                with self._lock:
+                    rep.cordoned = True
+                try:
+                    rep.engine.load_adapter(name, params, alpha=alpha)
+                    swapped += 1
+                except EngineClosedError:
+                    continue  # keep rolling — the load_weights rule
+                except ValueError as e:
+                    if first_err is None:
+                        first_err = e
+                    continue
+                finally:
+                    with self._lock:
+                        rep.cordoned = False
+        if first_err is not None:
+            raise first_err
+        return swapped
+
+    def unload_adapter(self, name):
+        """Fleet-wide adapter unload. While ANY router request is
+        in flight bound to the name, the whole fleet keeps it loaded
+        and the unload DEFERS (returns 0): a cross-replica retry must
+        be able to re-bind the adapter on whichever replica catches
+        it, so no replica may free its slot while another still
+        serves the name — the engine-level pin generalized to the
+        fleet. The name stops accepting new submits immediately; the
+        last bound request's release runs the rolling per-replica
+        unload. With nothing in flight the unload rolls now; returns
+        the number of replicas that freed the slot immediately."""
+        if self._closed:
+            raise EngineClosedError("unload_adapter on a closed Router")
+        loaded = any(
+            rep.engine.has_adapter(name) for rep in self._replicas
+            if not self._dead(rep)
+            and getattr(rep.engine, "lora_enabled", False))
+        if not loaded:
+            raise ValueError(
+                f"unknown adapter {name!r}: not loaded on the fleet")
+        with self._lock:
+            # mark the name draining in BOTH paths before any slot is
+            # freed: a submit sitting between _validate_adapter and
+            # _admit must hit the draining rejection, not pin a name
+            # whose rolling unload is already freeing replicas
+            self._adapter_draining.add(name)
+            if self._adapter_inflight.get(name, 0) > 0:
+                return 0
+        return self._unload_adapter_now(name)
+
+    def _unload_adapter_now(self, name):
+        """The rolling per-replica unload (the ``load_adapter``
+        loop): called with the name already in ``_adapter_draining``
+        (set by ``unload_adapter``, or kept by the last bound
+        request's release) so no new submit can pin it mid-roll; the
+        draining mark clears when the roll finishes. Per replica the
+        engine's own deferred-unload semantics still apply."""
+        freed = 0
+        try:
+            with self._adapter_roll_lock:
+                with self._lock:
+                    if name not in self._adapter_draining:
+                        # another roll of this name ran while we
+                        # waited on the roll lock (e.g. a retried
+                        # inline unload beat the prober's queued
+                        # drain) — and a reload may have installed
+                        # fresh factors since; rolling now would
+                        # silently evict them
+                        return 0
+                for rep in self._replicas:
+                    if self._dead(rep):
+                        continue
+                    with self._lock:
+                        rep.cordoned = True
+                    try:
+                        if rep.engine.unload_adapter(name):
+                            freed += 1
+                    except (EngineClosedError, ValueError):
+                        # dead-mid-roll, or a replica that never had
+                        # the name (crashed and replaced mid-load) —
+                        # keep rolling
+                        continue
+                    finally:
+                        with self._lock:
+                            rep.cordoned = False
+        finally:
+            with self._lock:
+                self._adapter_draining.discard(name)
+                # a queued drain is satisfied by ANY roll of the
+                # name: a stale pending entry would later evict a
+                # freshly reloaded adapter
+                self._adapter_drain_pending.discard(name)
+        return freed

@@ -1,0 +1,565 @@
+"""Batched multi-tenant LoRA: ops/lora.py bank + the serving stack.
+
+Guarantees under test:
+- the batched bank apply equals a per-row loop over individual
+  adapters (the gather is indexing, never mixing), and adapter slot 0
+  is the reserved all-zeros base adapter — a base-model row's logits
+  are BITWISE the LoRA-free program's;
+- adapter load/unload/refresh causes ZERO retraces (``model.gpt.trace``
+  and ``ops.lora.trace`` stay flat — the banks are runtime arguments
+  of the jitted closures, the quant-table discipline);
+- per-tenant greedy engine output is TOKEN-IDENTICAL to a dedicated
+  single-adapter engine running the same unmerged LoRA path, across
+  the dense, paged, int8 and speculative compositions;
+- the unmerged batched path tracks a merged-weights
+  (``W + (alpha/r) * (A @ B)^T``) reference within a teacher-forced
+  divergence bound;
+- in-flight requests PIN their adapter: unload defers (the name
+  rejects new submits immediately, the bank slot frees when the last
+  pinned request finishes);
+- constructor/rank/adapter-params validation rejects bad
+  configurations before any state changes, and ``submit`` kwarg
+  errors name the offending argument plus the engine's configured
+  capabilities (the shared helper the bare TypeErrors grew into).
+"""
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.gluon.model_zoo.gpt import gpt_small
+from mxnet_tpu.ops import lora as lora_ops
+from mxnet_tpu.serving import GenerationEngine
+
+VOCAB, SLOTS, SMAX = 64, 4, 48
+UNITS, LAYERS, HEADS, RANK = 16, 2, 2, 2
+PROJS = ("q_proj", "k_proj", "v_proj", "out_proj")
+
+
+def _build_net(seed=1234):
+    mx.np.random.seed(seed)
+    onp.random.seed(seed)
+    net = gpt_small(vocab_size=VOCAB, units=UNITS, num_layers=LAYERS,
+                    num_heads=HEADS, max_length=SMAX)
+    net.initialize(mx.init.Xavier())
+    net(mx.np.array(onp.zeros((1, 4), "i4")))  # materialize params
+    return net
+
+
+@pytest.fixture(scope="module")
+def base():
+    """Reference net + its parameter mapping (every engine's weights)."""
+    net = _build_net()
+    params = {k: onp.asarray(p.data()._data)
+              for k, p in net.collect_params().items()}
+    return net, params
+
+
+def _adapter(seed, scale=0.4, alpha=None):
+    """Seeded LoRA factors covering the default include set; returns
+    (flat params dict, alpha)."""
+    r = onp.random.RandomState(seed)
+    params = {}
+    for li in range(LAYERS):
+        for p in PROJS:
+            params[f"layers.{li}.{p}.A"] = \
+                (r.randn(UNITS, RANK) * scale).astype("f4")
+            params[f"layers.{li}.{p}.B"] = \
+                (r.randn(RANK, UNITS) * scale).astype("f4")
+    return params, (float(alpha) if alpha is not None else float(RANK))
+
+
+def _mk_engine(params, lora=True, max_adapters=3, **kw):
+    eng = GenerationEngine(
+        _build_net(), max_slots=SLOTS, max_length=SMAX,
+        max_new_tokens=6, queue_limit=64,
+        **({"lora_rank": RANK, "max_adapters": max_adapters}
+           if lora else {}), **kw)
+    eng.load_weights(params)
+    return eng
+
+
+def _prompt(rng, n=5):
+    return rng.randint(0, VOCAB, size=n).astype("i4")
+
+
+# -- op level ----------------------------------------------------------
+
+def test_batched_apply_matches_per_row_loop():
+    """One bank apply over a mixed-index batch == looping each row
+    through its own adapter individually."""
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(0)
+    n, d_in, d_out, r, b, s = 4, 6, 5, 2, 5, 3
+    bank = lora_ops.init_bank(n, d_in, d_out, r)
+    for i in range(1, n):
+        bank = lora_ops.set_slot(
+            bank, i, rng.randn(d_in, r).astype("f4"),
+            rng.randn(r, d_out).astype("f4"), alpha=1.5 * i)
+    x = rng.randn(b, s, d_in).astype("f4")
+    y = rng.randn(b, s, d_out).astype("f4")
+    idx = onp.array([0, 2, 1, 3, 2], "i4")
+    got = onp.asarray(lora_ops.apply(jnp.asarray(y), jnp.asarray(x),
+                                     bank, idx))
+    for row in range(b):
+        a = onp.asarray(bank["A"][idx[row]])
+        bb = onp.asarray(bank["B"][idx[row]])
+        sc = float(bank["scale"][idx[row]])
+        want = y[row] + (x[row] @ a) @ bb * sc
+        onp.testing.assert_allclose(got[row], want, rtol=1e-5,
+                                    atol=1e-5)
+
+
+def test_slot0_identity_and_bank_validation():
+    """Slot 0 is the reserved all-zeros adapter — applying it returns
+    the base output BITWISE; writing it (or out-of-range slots, or
+    wrong factor shapes) is rejected."""
+    import jax.numpy as jnp
+    rng = onp.random.RandomState(1)
+    bank = lora_ops.init_bank(3, 4, 4, 2)
+    y = rng.randn(2, 3, 4).astype("f4")
+    x = rng.randn(2, 3, 4).astype("f4")
+    got = onp.asarray(lora_ops.apply(jnp.asarray(y), jnp.asarray(x),
+                                     bank, onp.zeros((2,), "i4")))
+    assert onp.array_equal(got, y)
+    a, b = onp.zeros((4, 2), "f4"), onp.zeros((2, 4), "f4")
+    with pytest.raises(ValueError, match="slot 0"):
+        lora_ops.set_slot(bank, 0, a, b, 1.0)
+    with pytest.raises(ValueError, match="out of range"):
+        lora_ops.set_slot(bank, 3, a, b, 1.0)
+    with pytest.raises(ValueError, match="A shape"):
+        lora_ops.set_slot(bank, 1, onp.zeros((5, 2), "f4"), b, 1.0)
+    with pytest.raises(ValueError, match="B shape"):
+        lora_ops.set_slot(bank, 1, a, onp.zeros((2, 5), "f4"), 1.0)
+    with pytest.raises(ValueError, match="rank"):
+        lora_ops.init_bank(3, 4, 4, 0)
+    with pytest.raises(ValueError, match="n_adapters"):
+        lora_ops.init_bank(1, 4, 4, 2)
+
+
+# -- model level -------------------------------------------------------
+
+def test_armed_model_slot0_bitwise_base(base):
+    """An armed model with only the reserved zero adapter produces
+    BITWISE the unarmed model's logits — base traffic rides the LoRA
+    program at zero cost to identity."""
+    net, params = base
+    plain = _build_net()
+    armed = _build_net()
+    from mxnet_tpu.checkpoint import swap_param_buffers
+    swap_param_buffers(plain.collect_params(), params)
+    swap_param_buffers(armed.collect_params(), params)
+    armed.arm_lora(3, rank=RANK)
+    toks = onp.random.RandomState(2).randint(
+        0, VOCAB, (1, 8)).astype("i4")
+    c0 = plain.init_cache(2, SMAX)
+    c1 = armed.init_cache(2, SMAX)
+    lg0, c0 = plain.prefill(toks, [6], c0, slots=[0])
+    lg1, c1 = armed.prefill(toks, [6], c1, slots=[0])
+    assert onp.array_equal(onp.asarray(lg0), onp.asarray(lg1))
+    d0, c0 = plain.decode_step(onp.zeros((2,), "i4"), c0)
+    d1, c1 = armed.decode_step(onp.zeros((2,), "i4"), c1)
+    assert onp.array_equal(onp.asarray(d0), onp.asarray(d1))
+
+
+def test_arm_lora_validation(base):
+    net = _build_net()
+    with pytest.raises(ValueError, match="rank"):
+        net.arm_lora(3, rank=0)
+    with pytest.raises(ValueError, match="n_adapters"):
+        net.arm_lora(1, rank=RANK)
+    with pytest.raises(ValueError, match="activation"):
+        net.arm_lora(3, rank=RANK, include=("ffn1",))
+    with pytest.raises(ValueError, match="unknown LoRA projection") as ei:
+        net.arm_lora(3, rank=RANK, include=("nope",))
+    # the message steers to VALID LoRA targets — not the quantization
+    # set, whose ffn1 the fused-activation check would then reject
+    assert "ffn2" in str(ei.value) and "q_proj" in str(ei.value)
+    assert "'ffn1'" not in str(ei.value)
+    with pytest.raises(RuntimeError, match="arm_lora"):
+        net.set_adapter(1, {})
+    net.arm_lora(3, rank=RANK)
+    good, alpha = _adapter(0)
+    bad = dict(good)
+    bad.pop(f"layers.0.q_proj.A")
+    with pytest.raises(ValueError, match="missing"):
+        net.set_adapter(1, bad)
+    bad = dict(good, extra_key=onp.zeros((1,), "f4"))
+    with pytest.raises(ValueError, match="unexpected"):
+        net.set_adapter(1, bad)
+    wrong = dict(good)
+    wrong[f"layers.0.q_proj.A"] = onp.zeros((UNITS, RANK + 1), "f4")
+    with pytest.raises(ValueError, match="A shape"):
+        net.set_adapter(1, wrong)
+    # validate-before-install covers finiteness too: a NaN factor
+    # would silently poison every request bound to the slot
+    nan = dict(good)
+    nan["layers.0.q_proj.A"] = onp.full((UNITS, RANK), onp.nan, "f4")
+    with pytest.raises(ValueError, match="non-finite"):
+        net.set_adapter(1, nan)
+
+
+def test_merged_weights_teacher_forced_divergence(base):
+    """The unmerged batched path (base matmul + low-rank delta) tracks
+    a model whose Dense weights were MERGED (``W += (alpha/r) *
+    (A @ B)^T``) within a teacher-forced logits bound — the two
+    parameterizations differ only in fp32 summation order."""
+    net, params = base
+    armed = _build_net()
+    from mxnet_tpu.checkpoint import swap_param_buffers
+    swap_param_buffers(armed.collect_params(), params)
+    armed.arm_lora(3, rank=RANK)
+    ad, alpha = _adapter(3, scale=0.3)
+    armed.set_adapter(1, ad, alpha=alpha)
+
+    merged = _build_net()
+    mparams = dict(params)
+    for li in range(LAYERS):
+        for p in PROJS:
+            key = f"layers.{li}.{p}.weight"
+            delta = (ad[f"layers.{li}.{p}.A"]
+                     @ ad[f"layers.{li}.{p}.B"]).T * (alpha / RANK)
+            mparams[key] = params[key] + delta
+    swap_param_buffers(merged.collect_params(), mparams)
+
+    rng = onp.random.RandomState(4)
+    toks = rng.randint(0, VOCAB, 10).astype("i4")
+    full = merged(mx.np.array(toks[None, :])).asnumpy()[0]
+    cache = armed.init_cache(2, SMAX)
+    lg, cache = armed.prefill(toks[None, :6], [6], cache, slots=[0],
+                              adapters=[1])
+    onp.testing.assert_allclose(onp.asarray(lg)[0], full[5],
+                                rtol=2e-3, atol=2e-4)
+    for t in range(6, 10):
+        step = onp.zeros((2,), "i4")
+        step[0] = toks[t]
+        lg, cache = armed.decode_step(step, cache, adapters=[1, 0])
+        onp.testing.assert_allclose(onp.asarray(lg)[0], full[t],
+                                    rtol=2e-3, atol=2e-4)
+
+
+# -- engine level ------------------------------------------------------
+
+def test_engine_constructor_validation(base):
+    net, params = base
+    with pytest.raises(ValueError, match="lora_rank must be"):
+        GenerationEngine(_build_net(), max_slots=2, max_length=SMAX,
+                         lora_rank=0)
+    with pytest.raises(ValueError, match="max_adapters must be"):
+        GenerationEngine(_build_net(), max_slots=2, max_length=SMAX,
+                         lora_rank=RANK, max_adapters=0)
+    with pytest.raises(ValueError, match="max_adapters without"):
+        GenerationEngine(_build_net(), max_slots=2, max_length=SMAX,
+                         max_adapters=4)
+    plain = _build_net()  # a decoder without the batched-LoRA API
+    held = plain.arm_lora
+    try:
+        plain.arm_lora = None
+        with pytest.raises(TypeError, match="arm_lora"):
+            GenerationEngine(plain, max_slots=2, max_length=SMAX,
+                             lora_rank=RANK)
+    finally:
+        plain.arm_lora = held
+
+
+def test_submit_kwarg_errors_name_argument_and_capabilities(base):
+    """The shared kwarg-validation helper: an unsupported ``adapter=``
+    names the argument AND the engine's capabilities (regression for
+    the bare TypeErrors submit used to raise)."""
+    net, params = base
+    eng = _mk_engine(params, lora=False)
+    rng = onp.random.RandomState(5)
+    with pytest.raises(TypeError) as ei:
+        eng.submit(_prompt(rng), adapter="t")
+    msg = str(ei.value)
+    assert "adapter=" in msg and "capabilities" in msg
+    assert "precision=fp32" in msg and "lora=off" in msg
+    # management-API errors name THEIR call site, not submit()
+    with pytest.raises(TypeError, match="load_adapter") as ei:
+        eng.load_adapter("t", {})
+    assert "capabilities" in str(ei.value)
+    assert "submit()" not in str(ei.value)
+    with pytest.raises(TypeError, match="unload_adapter") as ei:
+        eng.unload_adapter("t")
+    assert "capabilities" in str(ei.value)
+    eng.close()
+
+    eng2 = _mk_engine(params)
+    with pytest.raises(ValueError) as ei:
+        eng2.submit(_prompt(rng), adapter="ghost")
+    assert "ghost" in str(ei.value) and "capabilities" in str(ei.value)
+    eng2.close()
+
+
+def test_load_unload_refresh_zero_retrace(base):
+    """The zero-retrace contract: once warmed, adapter load, refresh,
+    use, and unload never trace a program (``model.gpt.trace`` and
+    ``ops.lora.trace`` flat, no cachedop misses)."""
+    net, params = base
+    eng = _mk_engine(params).warmup()
+    a1, alpha1 = _adapter(10)
+    eng.load_adapter("t1", a1, alpha=alpha1)
+    rng = onp.random.RandomState(6)
+    p = _prompt(rng)
+    first = eng.generate(p, adapter="t1", timeout=120).tokens
+    telemetry.reset()
+    a2, alpha2 = _adapter(11)
+    eng.load_adapter("t2", a2, alpha=alpha2)       # load
+    eng.load_adapter("t1", a2, alpha=alpha2)       # refresh in place
+    refreshed = eng.generate(p, adapter="t1", timeout=120).tokens
+    same = eng.generate(p, adapter="t2", timeout=120).tokens
+    eng.unload_adapter("t2")                       # unload
+    post = eng.generate(p, adapter="t1", timeout=120).tokens
+    snap = telemetry.snapshot()
+    assert telemetry.counter_value("model.gpt.trace") == 0, \
+        "adapter load/refresh/unload retraced a closure"
+    assert telemetry.counter_value("ops.lora.trace") == 0
+    assert "gluon.cachedop.cache_miss" not in snap["counters"]
+    assert refreshed == same == post  # t1 now holds t2's factors
+    assert refreshed != first         # and the refresh really landed
+    assert snap["counters"]["serving.generate.lora.adapters_loaded"] \
+        == 2
+    assert snap["counters"]["serving.generate.lora.adapters_evicted"] \
+        == 1
+    assert snap["counters"]["serving.generate.lora.requests"] == 3
+    assert snap["gauges"]["serving.generate.lora.active_adapters"][
+        "value"] == 1
+    eng.close()
+
+
+def _tenant_workload(rng, n_requests=6):
+    return [_prompt(rng, 3 + i % 5) for i in range(n_requests)]
+
+
+def _multi_vs_dedicated(params, adapters, multi_kw, ded_kw=None,
+                        max_new=6):
+    """Serve an interleaved tenant mix (base rows included) on ONE
+    multi-tenant engine, then each tenant on its own dedicated
+    single-adapter engine; returns (multi tokens, dedicated tokens)
+    keyed by (tenant, request)."""
+    ded_kw = multi_kw if ded_kw is None else ded_kw
+    rng = onp.random.RandomState(7)
+    prompts = _tenant_workload(rng)
+    names = [None] + list(adapters)          # None = base tenant
+    eng = _mk_engine(params, max_adapters=len(adapters), **multi_kw)
+    eng.warmup()
+    for name, (ad, alpha) in adapters.items():
+        eng.load_adapter(name, ad, alpha=alpha)
+    streams = [(t, i, eng.submit(
+        p, max_new_tokens=max_new,
+        **({} if t is None else {"adapter": t})))
+        for i, p in enumerate(prompts) for t in names]
+    multi = {(t, i): s.result(timeout=240).tokens
+             for t, i, s in streams}
+    eng.close()
+    ded = {}
+    for name in names:
+        deng = _mk_engine(params, max_adapters=1, **ded_kw)
+        if name is not None:
+            ad, alpha = adapters[name]
+            deng.load_adapter("only", ad, alpha=alpha)
+        for i, p in enumerate(prompts):
+            ded[(name, i)] = deng.generate(
+                p, max_new_tokens=max_new, timeout=240,
+                **({} if name is None
+                   else {"adapter": "only"})).tokens
+        deng.close()
+    return multi, ded
+
+
+@pytest.mark.parametrize("composition", ["dense", "paged", "int8"])
+def test_multi_tenant_token_identity(base, composition):
+    """Per-tenant greedy output through the multi-tenant engine is
+    TOKEN-IDENTICAL to a dedicated single-adapter engine running the
+    same unmerged LoRA path — dense, paged (adapter idx is per-slot,
+    orthogonal to pages) and int8 (the delta stays fp32 over the
+    dequant base) compositions, with base-model co-tenants in the
+    same batches."""
+    net, params = base
+    kw = {}
+    if composition == "paged":
+        kw = {"paged": True, "page_size": 8}
+    elif composition == "int8":
+        kw = {"quantize": "int8_weights", "kv_dtype": "int8"}
+    adapters = {"t1": _adapter(20), "t2": _adapter(21)}
+    multi, ded = _multi_vs_dedicated(params, adapters, kw)
+    assert multi == ded
+
+
+def test_multi_tenant_token_identity_speculative(base):
+    """Speculative composition: the draft proposes with the BASE
+    model, verify/commit runs ADAPTED — the greedy accept rule makes
+    every tenant's committed stream the adapted model's own, so the
+    speculative multi-tenant engine is token-identical to dedicated
+    NON-speculative adapted engines."""
+    net, params = base
+    mx.np.random.seed(77)
+    draft = gpt_small(vocab_size=VOCAB, units=UNITS, num_layers=1,
+                      num_heads=HEADS, max_length=SMAX)
+    draft.initialize(mx.init.Xavier())
+    adapters = {"t1": _adapter(22), "t2": _adapter(23)}
+    multi, ded = _multi_vs_dedicated(
+        params, adapters,
+        multi_kw={"draft_model": draft, "spec_k": 3}, ded_kw={})
+    assert multi == ded
+    assert telemetry.counter_value("serving.generate.spec.proposed") \
+        > 0
+
+
+def test_pinned_adapter_deferred_unload(base):
+    """An in-flight request pins its adapter: unload defers (False),
+    the name immediately rejects new submits, the stream finishes on
+    the adapter's weights, and the bank slot frees afterwards —
+    counted by ``lora.adapters_evicted``."""
+    net, params = base
+    eng = _mk_engine(params).warmup()
+    ad, alpha = _adapter(30)
+    eng.load_adapter("pinned", ad, alpha=alpha)
+    telemetry.reset()
+    rng = onp.random.RandomState(8)
+    p = _prompt(rng)
+    ref = eng.generate(p, adapter="pinned", max_new_tokens=4,
+                       timeout=120).tokens
+    s = eng.submit(p, adapter="pinned", max_new_tokens=30)
+    assert eng.unload_adapter("pinned") is False   # deferred
+    assert "pinned" not in eng.adapters
+    with pytest.raises(ValueError, match="pinned"):
+        eng.submit(p, adapter="pinned")
+    out = s.result(timeout=120)
+    assert out.tokens[:4] == ref  # finished on the adapter's weights
+    deadline = time.monotonic() + 10
+    while "pinned" in eng._lora_reg and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "pinned" not in eng._lora_reg, "deferred unload never ran"
+    assert telemetry.counter_value(
+        "serving.generate.lora.adapters_evicted") == 1
+    # the freed slot is reusable immediately
+    eng.load_adapter("next", ad, alpha=alpha)
+    assert eng.adapters == ["next"]
+    eng.close()
+
+
+def test_adapter_capacity_and_freed_slot_reuse(base):
+    net, params = base
+    eng = _mk_engine(params, max_adapters=2)
+    a, alpha = _adapter(40)
+    eng.load_adapter("a", a, alpha=alpha)
+    eng.load_adapter("b", a, alpha=alpha)
+    with pytest.raises(ValueError, match="capacity exhausted"):
+        eng.load_adapter("c", a, alpha=alpha)
+    assert eng.unload_adapter("a") is True
+    eng.load_adapter("c", a, alpha=alpha)   # freed slot reused
+    assert eng.adapters == ["b", "c"]
+    eng.close()
+
+
+def test_refresh_racing_deferred_unload_reregisters(base):
+    """REGRESSION: a refresh whose adapter vanishes between
+    ``load_adapter``'s two lock sections (a concurrent unload
+    completing via a pin drop — both take only the leaf lock) must
+    re-register the name on the slot it just wrote. The broken
+    behavior returned success while the name was gone from the
+    registry and the free list held a slot with live factors."""
+    net, params = base
+    eng = _mk_engine(params)
+    ad, alpha = _adapter(50)
+    eng.load_adapter("t", ad, alpha=alpha)
+    eng._pin_adapter("t")
+    orig = eng.model.set_adapter
+
+    def racing(idx, p, alpha=1.0):
+        orig(idx, p, alpha=alpha)
+        # between the lock sections: an unload arms (deferred behind
+        # our pin) and the last pin drops, evicting the name
+        assert eng.unload_adapter("t") is False
+        eng._unpin_adapter("t")
+        assert "t" not in eng._lora_reg
+
+    eng.model.set_adapter = racing
+    try:
+        eng.load_adapter("t", ad, alpha=alpha)   # the refresh
+    finally:
+        eng.model.set_adapter = orig
+    assert eng.adapters == ["t"], "the refresh silently vanished"
+    slot = eng._lora_reg["t"].idx
+    assert slot not in eng._lora_free, \
+        "a registered adapter's slot leaked onto the free list"
+    eng.close()
+
+
+def test_active_adapters_gauge_excludes_unload_pending(base):
+    """REGRESSION: the ``lora.active_adapters`` gauge tracks the
+    ``adapters`` property (unload-pending names excluded) and updates
+    AT the deferral, not only at the eventual eviction."""
+    net, params = base
+    eng = _mk_engine(params).warmup()
+    ad, alpha = _adapter(51)
+    eng.load_adapter("g1", ad, alpha=alpha)
+    eng.load_adapter("g2", ad, alpha=alpha)
+    gauge = lambda: telemetry.snapshot()["gauges"][  # noqa: E731
+        "serving.generate.lora.active_adapters"]["value"]
+    assert gauge() == 2
+    eng._pin_adapter("g2")
+    assert eng.unload_adapter("g2") is False      # deferred
+    assert gauge() == 1, \
+        "a deferred unload must drop the gauge when the name stops " \
+        "accepting submits, not when the slot frees"
+    eng._unpin_adapter("g2")                      # eviction completes
+    assert gauge() == 1 and eng.adapters == ["g1"]
+    eng.close()
+
+
+def test_unloaded_slot_factors_zeroed_at_next_swap(base):
+    """REGRESSION: an evicted tenant's factors must not linger in the
+    bank. Eviction paths run in stream-finish callbacks where
+    ``clear_adapter`` (a read-modify-write of the banks) cannot be
+    serialized against a concurrent ``set_adapter``, so freed slots
+    are zeroed lazily inside the NEXT ``load_adapter``'s swap
+    window."""
+    net, params = base
+    eng = _mk_engine(params)      # max_adapters=3
+    ad, alpha = _adapter(60)
+    eng.load_adapter("a", ad, alpha=alpha)
+    eng.load_adapter("b", _adapter(61)[0], alpha=alpha)
+    idx_a = eng._lora_reg["a"].idx
+    idx_b = eng._lora_reg["b"].idx
+    bank = eng.model._lora[0]["q_proj"]
+    assert float(onp.abs(onp.asarray(bank["A"][idx_b])).sum()) > 0
+    assert eng.unload_adapter("a") is True
+    assert eng.unload_adapter("b") is True
+    assert eng._lora_stale == {idx_a, idx_b}
+    eng.load_adapter("c", _adapter(62)[0], alpha=alpha)  # next swap
+    idx_c = eng._lora_reg["c"].idx
+    bank = eng.model._lora[0]["q_proj"]
+    for freed in {idx_a, idx_b} - {idx_c}:
+        assert float(onp.abs(onp.asarray(bank["A"][freed])).sum()) \
+            == 0, "an evicted tenant's factors lingered in the bank"
+    assert not eng._lora_stale
+    eng.close()
+
+
+def test_base_idx_vector_cached_per_batch(base):
+    """The adapters=None index vector is a constant — the model must
+    reuse one cached device array per batch size instead of minting a
+    fresh one on every decode tick (the non-LoRA hot path pays it
+    too)."""
+    net, _ = base
+    assert net._lora_idx(None, 4) is net._lora_idx(None, 4)
+    assert net._lora_idx(None, 2) is not net._lora_idx(None, 4)
+    assert net._lora_idx(None, 3).shape == (3,)
+
+
+def test_lora_rejects_tp_mesh(base):
+    """mesh_layout='tp' stays dense-fp32-only: the LoRA composition is
+    rejected with a clear error instead of a mid-trace failure."""
+    net, params = base
+    from mxnet_tpu import parallel
+    if len(__import__("jax").devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh = parallel.make_mesh(
+        (1, len(__import__("jax").devices())), ("dp", "tp"))
+    with pytest.raises(ValueError, match="LoRA"):
+        GenerationEngine(_build_net(), max_slots=2, max_length=SMAX,
+                         mesh_layout="tp", mesh=mesh, lora_rank=RANK)

@@ -629,3 +629,321 @@ def test_prefix_affinity_hint(base):
         moved.result(timeout=120)
     finally:
         router.close()
+
+
+# -- multi-tenant LoRA propagation (docs/SERVING.md "Multi-tenant
+# LoRA"): adapter= rides every dispatch and retry -----------------------
+
+LORA_RANK = 2
+
+
+def _lora_adapter(seed, units=16, layers=1, scale=0.4):
+    r = onp.random.RandomState(seed)
+    return {f"layers.{li}.{p}.{h}":
+            (r.randn(units, LORA_RANK) if h == "A"
+             else r.randn(LORA_RANK, units)).astype("f4") * scale
+            for li in range(layers)
+            for p in ("q_proj", "k_proj", "v_proj", "out_proj")
+            for h in ("A", "B")}
+
+
+def _mk_lora_engine(params, max_new=4, queue_limit=32):
+    eng = GenerationEngine(_build_net(), max_slots=SLOTS,
+                           max_length=SMAX, max_new_tokens=max_new,
+                           queue_limit=queue_limit,
+                           lora_rank=LORA_RANK, max_adapters=2)
+    eng.load_weights(params)
+    return eng
+
+
+def test_lora_config_heterogeneous_fleet_rejected(base):
+    """One LoRA-armed replica + one plain replica cannot form a fleet:
+    an adapter= retry could land where no bank exists. The error names
+    each replica's capabilities (the shared helper)."""
+    net, params = base
+    engines = [_mk_lora_engine(params), _mk_engine(params)]
+    with pytest.raises(TypeError, match="LoRA-config-homogeneous") as ei:
+        Router(engines)
+    assert "capabilities" in str(ei.value)
+    for e in engines:
+        e.close()
+
+
+def test_unknown_adapter_and_heterogeneous_registry_rejected(base):
+    """An adapter= submit resolves against the fleet AT DISPATCH: an
+    unknown name is rejected at the router edge, and registries that
+    diverged across replicas (a partial load) reject outright instead
+    of letting a retry land on a replica that lacks the adapter."""
+    net, params = base
+    router = Router([_mk_lora_engine(params), _mk_lora_engine(params)])
+    rng = onp.random.RandomState(41)
+    p = _prompt(rng)
+    try:
+        with pytest.raises(ValueError, match="unknown adapter"):
+            router.submit(p, adapter="ghost")
+        assert router.load_adapter("t1", _lora_adapter(1)) == 2
+        assert router.generate(p, adapter="t1", timeout=120).tokens
+        # skew one replica's registry with an UNRELATED adapter: t1
+        # resolves identically on every live replica, so its traffic
+        # still flows (an in-progress rolling load of another tenant
+        # must never shed valid traffic) — while a submit binding the
+        # PARTIALLY-loaded name rejects, naming the fleet-wide fix
+        router.replicas[0].load_adapter("skew", _lora_adapter(2))
+        assert router.generate(p, adapter="t1", timeout=120).tokens
+        with pytest.raises(TypeError, match="heterogeneous"):
+            router.submit(p, adapter="skew")
+        # adapter= on a plain fleet names the argument + capabilities
+        plain = Router([_mk_engine(params)])
+        with pytest.raises(TypeError, match="capabilities"):
+            plain.submit(p, adapter="t1")
+        plain.close()
+        # and an infer fleet rejects it like the other gen-only knobs
+        inf = Router([_mk_infer_engine()])
+        with pytest.raises(TypeError, match="generation fleets only"):
+            inf.submit(onp.zeros((1, 4), "f4"), adapter="t1")
+        inf.close()
+    finally:
+        router.close()
+
+
+def test_adapter_retry_on_crash_token_identical(base):
+    """A replica crash mid-decode re-dispatches the request WITH its
+    adapter binding: the retried stream (prefix skipped) is
+    token-identical to a dedicated single-adapter engine's output."""
+    net, params = base
+    injector = FaultInjector(
+        rules=[FaultRule("crash", replica=0, after_n=2)], seed=0)
+    router = Router([_mk_lora_engine(params), _mk_lora_engine(params)],
+                    max_retries=2, probe_interval_s=0.05,
+                    fault_injector=injector)
+    router.load_adapter("t1", _lora_adapter(3))
+    ded = _mk_lora_engine(params)
+    ded.load_adapter("t1", _lora_adapter(3))
+    rng = onp.random.RandomState(42)
+    prompts = [_prompt(rng) for _ in range(3)]
+    refs = [ded.generate(p, adapter="t1", max_new_tokens=20,
+                         timeout=120).tokens for p in prompts]
+    ded.close()
+    s1 = router.submit(prompts[0], adapter="t1", max_new_tokens=20)
+    deadline = time.monotonic() + 60
+    while not s1.tokens and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert s1.tokens, "first request never started decoding"
+    s2 = router.submit(prompts[1], adapter="t1", max_new_tokens=20)
+    s3 = router.submit(prompts[2], adapter="t1", max_new_tokens=20)
+    streams = [s1, s2, s3]
+    for p, s, ref in zip(prompts, streams, refs):
+        assert s.result(timeout=120).tokens == ref, \
+            f"adapter retry diverged (retries={s.retries})"
+    assert s1.retries == 1 and s1.replicas == [0, 1], \
+        "the crash must have re-dispatched s1 with its binding"
+    router.close()
+
+
+def test_fleet_unload_defers_while_request_in_flight(base):
+    """REGRESSION: Router.unload_adapter of a name bound by an
+    IN-FLIGHT request defers FLEET-WIDE (returns 0) — no replica
+    frees its slot, so a crash-retry can still re-bind the adapter on
+    the surviving replica (the module's stated invariant; the broken
+    behavior freed unpinned replicas immediately and the retry died
+    with 'not loaded'). The last bound request's release runs the
+    rolling unload."""
+    net, params = base
+    injector = FaultInjector(
+        rules=[FaultRule("crash", replica=0, after_n=2)], seed=0)
+    router = Router([_mk_lora_engine(params), _mk_lora_engine(params)],
+                    max_retries=2, probe_interval_s=0.05,
+                    fault_injector=injector)
+    router.load_adapter("t1", _lora_adapter(6))
+    ded = _mk_lora_engine(params)
+    ded.load_adapter("t1", _lora_adapter(6))
+    rng = onp.random.RandomState(45)
+    prompts = [_prompt(rng) for _ in range(3)]
+    ref = ded.generate(prompts[0], adapter="t1", max_new_tokens=20,
+                       timeout=120).tokens
+    ded.close()
+    s1 = router.submit(prompts[0], adapter="t1", max_new_tokens=20)
+    deadline = time.monotonic() + 60
+    while not s1.tokens and time.monotonic() < deadline:
+        time.sleep(0.001)
+    assert s1.tokens, "first request never started decoding"
+    # unload mid-flight: defers fleet-wide; EVERY replica keeps the
+    # adapter so the coming crash-retry can re-bind it anywhere
+    assert router.unload_adapter("t1") == 0
+    with pytest.raises(ValueError, match="unloading fleet-wide"):
+        router.submit(prompts[1], adapter="t1")
+    # a reload while the drain is pending would report success and
+    # then be silently evicted when the last pin drops — rejected
+    # like the engine-level rule
+    with pytest.raises(ValueError, match="unloading fleet-wide"):
+        router.load_adapter("t1", _lora_adapter(6))
+    assert all("t1" in e.adapters for e in router.replicas), \
+        "a replica freed its slot while the request was in flight"
+    # base traffic drives replica 0 to its crashing dispatch; s1
+    # retries on replica 1 — which must still hold the adapter
+    s2 = router.submit(prompts[1], max_new_tokens=20)
+    s3 = router.submit(prompts[2], max_new_tokens=20)
+    assert s1.result(timeout=120).tokens == ref, \
+        f"adapter retry diverged (retries={s1.retries})"
+    assert s1.retries == 1 and s1.replicas == [0, 1]
+    s2.result(timeout=120), s3.result(timeout=120)
+    # s1 was the last bound request: its release rolls the deferred
+    # unload across the surviving replica
+    deadline = time.monotonic() + 10
+    while "t1" in router.replicas[1].adapters \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert "t1" not in router.replicas[1].adapters, \
+        "the deferred fleet unload never drained"
+    with pytest.raises(ValueError, match="unknown adapter"):
+        router.submit(prompts[1], adapter="t1")
+    router.close()
+
+
+def test_immediate_unload_blocks_validate_admit_window(base):
+    """REGRESSION: an IMMEDIATE (nothing-in-flight) fleet unload
+    marks the name draining for the duration of the roll, so a
+    submit that already passed ``_validate_adapter`` cannot pin the
+    name while replicas are freeing their slots (it would decode on
+    a half-unloaded fleet and a retry could land where the slot is
+    gone). After the roll the mark clears and the name is simply
+    unknown."""
+    net, params = base
+    router = Router([_mk_lora_engine(params)])
+    router.load_adapter("t1", _lora_adapter(7))
+    eng = router.replicas[0]
+    orig, seen = eng.unload_adapter, {}
+
+    def mid_roll(name):
+        # a submit that validated BEFORE the roll reaches admission
+        # NOW — it must hit the draining rejection
+        with pytest.raises(ValueError, match="unloading fleet-wide"):
+            router._admit("default", 0, 4, adapter=name)
+        seen["checked"] = True
+        return orig(name)
+
+    eng.unload_adapter = mid_roll
+    try:
+        assert router.unload_adapter("t1") == 1
+    finally:
+        eng.unload_adapter = orig
+    assert seen.get("checked"), "the roll never consulted the engine"
+    assert not router._adapter_draining, "the draining mark leaked"
+    # post-roll: reloadable as usual
+    assert router.load_adapter("t1", _lora_adapter(7)) == 1
+    router.close()
+
+
+def test_fleet_load_adapter_partial_rejection_keeps_rolling(base):
+    """REGRESSION: a per-replica ValueError mid-roll (one engine
+    still draining the name's previous unload) must not abort
+    ``Router.load_adapter`` half-applied — the rest of the fleet
+    installs and the error re-raises at the end, so a re-run
+    converges instead of the fleet sticking heterogeneous."""
+    net, params = base
+    router = Router([_mk_lora_engine(params), _mk_lora_engine(params)])
+    rng = onp.random.RandomState(46)
+    p = _prompt(rng)
+    try:
+        router.load_adapter("X", _lora_adapter(8))
+        before = router.replicas[1].generate(
+            p, adapter="X", timeout=120).tokens
+        # park replica 0's engine registry in its engine-level
+        # draining state: the refresh will be rejected THERE FIRST
+        e0 = router.replicas[0]
+        e0._pin_adapter("X")
+        assert e0.unload_adapter("X") is False
+        with pytest.raises(ValueError, match="unloading"):
+            router.load_adapter("X", _lora_adapter(9))
+        after = router.replicas[1].generate(
+            p, adapter="X", timeout=120).tokens
+        assert after != before, \
+            "replica 0's rejection aborted the roll before replica 1"
+    finally:
+        router.close()
+
+
+def test_retried_unload_cancels_queued_drain(base):
+    """REGRESSION: a deferred fleet unload queues its drain for the
+    prober; when the caller retries unload_adapter after the pins
+    drop (natural after the deferred 0 return) and the inline roll
+    wins, the queued drain is STALE — it must not fire later and
+    silently evict a freshly reloaded adapter."""
+    net, params = base
+    router = Router([_mk_lora_engine(params)],
+                    probe_interval_s=30)      # prober parked
+    try:
+        router.load_adapter("t1", _lora_adapter(10))
+        rng = onp.random.RandomState(47)
+        p = _prompt(rng)
+        s = router.submit(p, adapter="t1", max_new_tokens=8)
+        assert router.unload_adapter("t1") == 0        # deferred
+        s.result(timeout=120)
+        dl = time.monotonic() + 10
+        while "t1" not in router._adapter_drain_pending \
+                and time.monotonic() < dl:
+            time.sleep(0.01)
+        assert "t1" in router._adapter_drain_pending
+        # the retried unload rolls inline and must cancel the
+        # queued drain with it
+        assert router.unload_adapter("t1") == 1
+        assert "t1" not in router._adapter_drain_pending
+        router.load_adapter("t1", _lora_adapter(11))
+        router._run_pending_drains()   # the prober path, by hand
+        assert router.replicas[0].has_adapter("t1"), \
+            "a stale queued drain evicted the reloaded adapter"
+        assert router.generate(p, adapter="t1", timeout=120).tokens
+    finally:
+        router.close()
+
+
+def test_adapter_sampled_stream_bitwise_reproducible(base):
+    """The PR 11 seeded-stream contract extended to adapter=: the same
+    seeds on a REPLAYED admission schedule (flood-submitted from one
+    thread, single replica) produce bitwise-identical streams across a
+    fleet rebuild — adapter bindings included."""
+    net, params = base
+
+    def run():
+        router = Router([_mk_lora_engine(params, max_new=8,
+                                         queue_limit=64)])
+        router.load_adapter("t1", _lora_adapter(4))
+        rng = onp.random.RandomState(43)
+        prompts = [_prompt(rng, 4 + i % 3) for i in range(6)]
+        streams = [router.submit(
+            p, adapter="t1" if i % 2 else None, temperature=0.8,
+            top_k=12, top_p=0.9, seed=500 + i, max_new_tokens=8)
+            for i, p in enumerate(prompts)]
+        out = [s.result(timeout=120).tokens for s in streams]
+        router.close()
+        return out
+
+    first, second = run(), run()
+    assert first == second, \
+        "seeded adapter streams diverged across a fleet rebuild"
+
+
+def test_fleet_load_unload_adapter_rollover(base):
+    """Router.load_adapter installs an adapter on every live replica
+    (the load_weights rolling pattern, zero retraces per engine);
+    unload_adapter rolls the eviction; traffic keeps flowing
+    throughout."""
+    net, params = base
+    router = Router([_mk_lora_engine(params), _mk_lora_engine(params)])
+    rng = onp.random.RandomState(44)
+    p = _prompt(rng)
+    try:
+        assert router.load_adapter("t1", _lora_adapter(5)) == 2
+        assert all(e.adapters == ["t1"] for e in router.replicas)
+        outs = {tuple(router.generate(p, adapter="t1",
+                                      timeout=120).tokens)
+                for _ in range(4)}
+        assert len(outs) == 1, "replicas disagreed on the adapter"
+        assert router.unload_adapter("t1") == 2
+        assert all(e.adapters == [] for e in router.replicas)
+        with pytest.raises(ValueError, match="unknown adapter"):
+            router.submit(p, adapter="t1")
+        # base traffic unaffected throughout
+        assert router.generate(p, timeout=120).tokens
+    finally:
+        router.close()

@@ -3820,8 +3820,13 @@ def _shd_batch():
     return mnp.array(x[:, :-1]), mnp.array(x[:, 1:])
 
 
-def _shd_train_run(layout):
-    """One training config: the seeded GPT under one layout."""
+def _shd_train_run(layout, mesh2=False):
+    """One training config: the seeded GPT under one layout. With
+    ``mesh2`` the run uses a 2x2 (dp, tp) sub-mesh of the box — the
+    BENCH_r18 apples-to-apples frame where dp / fsdp / tp / tp_fsdp
+    all see the SAME four devices, so the 2-D layout's per-device
+    bytes can be gated strictly below both 1-D layouts."""
+    import jax as _jax
     from mxnet_tpu import gluon, parallel, telemetry
     from mxnet_tpu.parallel import partition
 
@@ -3830,8 +3835,13 @@ def _shd_train_run(layout):
             return gluon.loss.SoftmaxCrossEntropyLoss()(
                 out.reshape(-1, out.shape[-1]), label.reshape(-1))
 
-    mesh = parallel.make_mesh((2, 4), ("dp", "tp")) if layout == "tp" \
-        else parallel.make_mesh((8,), ("dp",))
+    if mesh2:
+        mesh = parallel.make_mesh((2, 2), ("dp", "tp"),
+                                  devices=_jax.devices()[:4])
+    elif layout == "tp":
+        mesh = parallel.make_mesh((2, 4), ("dp", "tp"))
+    else:
+        mesh = parallel.make_mesh((8,), ("dp",))
     x, y = _shd_batch()
     with parallel.mesh_scope(mesh):
         net = _shd_model()
@@ -3853,12 +3863,15 @@ def _shd_train_run(layout):
         full = sum(int(a.nbytes) for a in leaves + opt_leaves)
         perdev = partition.per_device_bytes(leaves + opt_leaves)
     print(json.dumps({
-        "mode": f"train_{layout or 'dp'}",
+        "mode": f"train{'2' if mesh2 else ''}_{layout or 'dp'}",
         "model": f"gpt {SHD_LAYERS}L-{SHD_UNITS}u-{SHD_HEADS}h "
                  f"vocab={SHD_VOCAB} s_max={SHD_SMAX} "
                  f"batch={SHD_BATCH}x{SHD_SEQ}",
         "mesh": "x".join(map(str, mesh.devices.shape)),
         "losses": [round(l, 6) for l in losses],
+        # exact representations: the r18 bitwise gate compares hex,
+        # never rounded decimals
+        "losses_hex": [float.hex(l) for l in losses],
         "steps_per_sec": round(SHD_STEPS / dt, 2),
         "comm_bytes_per_step": int(step.comm_bytes_per_step),
         "full_footprint_bytes": full,
@@ -3879,22 +3892,36 @@ def _shd_workload():
             for _ in range(SHD_REQS)]
 
 
-def _shd_serve_run(tp):
+def _shd_serve_run(tp, paged=False):
     """One serving config: the tied-peaky GPT, unsharded or as one
-    tensor-parallel engine over the mesh."""
+    tensor-parallel engine over the (2, 4) mesh. ``paged=True`` is
+    the COMPOSED configuration (BENCH_r18): the full low-precision
+    paged stack — ``paged`` + ``quantize="int8_weights"`` +
+    ``kv_dtype="int8"``. Both paged configs run the IDENTICAL pool
+    geometry (same page count = equal effective sequence capacity),
+    so the A/B isolates what tp buys: each device holds 1/tp of the
+    KV pool (and of the int8 weights) at token-identical greedy
+    output. ONE runner for all four serve configs — the priming
+    protocol, timed window, digest scheme and footprint measurement
+    are load-bearing for the A/B gates and must not drift between
+    near-copies."""
     import hashlib
-    import numpy as onp
+    import jax as _jax
     from mxnet_tpu import parallel, telemetry
     from mxnet_tpu.parallel import partition
     from mxnet_tpu.serving import GenerationEngine
     mesh = parallel.make_mesh((2, 4), ("dp", "tp"))
+    ps = 16
+    kw = dict(paged=True, page_size=ps, prefill_chunk=2 * ps,
+              quantize="int8_weights", kv_dtype="int8") if paged \
+        else {}
     with parallel.mesh_scope(mesh):
         net = _shd_model(tied=True)
         eng = GenerationEngine(
             net, max_slots=8, max_length=SHD_SMAX,
             max_new_tokens=SHD_MAXNEW, queue_limit=SHD_REQS + 8,
             mesh_layout="tp" if tp else None,
-            mesh=mesh if tp else None).warmup()
+            mesh=mesh if tp else None, **kw).warmup()
         prompts = _shd_workload()
         for s in [eng.submit(p, max_new_tokens=2)
                   for p in prompts[:2]]:
@@ -3907,19 +3934,40 @@ def _shd_serve_run(tp):
         snap = telemetry.snapshot()["counters"]
         leaves = [p.data()._data
                   for p in net.collect_params().values()]
-        full = sum(int(a.nbytes) for a in leaves) \
-            + sum(int(a.nbytes)
-                  for a in __import__("jax").tree.leaves(eng._cache))
+        full = sum(int(a.nbytes) for a in leaves) + sum(
+            int(a.nbytes) for a in _jax.tree.leaves(eng._cache))
         perdev = partition.per_device_bytes(leaves + [eng._cache])
+        doc = {}
+        if paged:
+            pool = {k: eng._cache[k]
+                    for k in ("k", "v", "k_scale", "v_scale")
+                    if k in eng._cache}
+            doc.update({
+                "n_pages": int(eng._pool.n_pages),
+                "page_size": ps,
+                "pool_bytes": sum(int(a.nbytes)
+                                  for a in _jax.tree.leaves(pool)),
+                "pool_per_device_bytes":
+                    partition.per_device_bytes([pool]),
+                "collectives": {
+                    k.rsplit(".", 1)[1]: int(v)
+                    for k, v in snap.items()
+                    if k.startswith("parallel.collectives.")},
+            })
         eng.close()
     tokens = int(snap.get("serving.generate.tokens", 0))
+    mode = ("serve_paged" if paged else "serve_dense") \
+        + ("_tp" if tp else "")
+    if not paged and tp:
+        mode = "serve_tp"
     print(json.dumps({
-        "mode": "serve_tp" if tp else "serve_dense",
+        "mode": mode,
         "requests": SHD_REQS,
         "generated_tokens": tokens,
         "tokens_per_sec": round(tokens / makespan, 1),
         "full_footprint_bytes": full,
         "per_device_bytes": perdev,
+        **doc,
         "compiles_in_window":
             int(snap.get("model.gpt.trace", 0))
             + int(snap.get("gluon.cachedop.cache_miss", 0)),
@@ -3936,8 +3984,14 @@ def _shd_child():
     if cfg in ("train_dp", "train_fsdp", "train_tp"):
         layout = cfg.split("_", 1)[1]
         return _shd_train_run(None if layout == "dp" else layout)
+    if cfg.startswith("train2_"):
+        layout = cfg.split("_", 1)[1]
+        return _shd_train_run(None if layout == "dp" else layout,
+                              mesh2=True)
     if cfg in ("serve_dense", "serve_tp"):
         return _shd_serve_run(cfg == "serve_tp")
+    if cfg in ("serve_paged", "serve_paged_tp"):
+        return _shd_serve_run(cfg == "serve_paged_tp", paged=True)
     raise SystemExit(f"unknown BENCH_SHARD_CONFIG {cfg!r}")
 
 
@@ -3979,6 +4033,51 @@ def _shd_check_schema(doc):
                 and d["serve_tp"]["generated_tokens"] > 0)])
 
 
+def _shd18_check_schema(doc):
+    """BENCH_r18.json contract (spec for the shared _check_schema):
+    the mesh-parallel serving COMPOSITION — tp+paged+int8 A/B vs
+    single-device at equal pool geometry, and the 2-D tp_fsdp layout
+    vs dp/fsdp/tp on one 2x2 mesh."""
+    train_keys = ("losses_hex", "comm_bytes_per_step",
+                  "per_device_bytes", "full_footprint_bytes",
+                  "compiles_in_window")
+    serve_keys = ("tokens_digest", "pool_bytes",
+                  "pool_per_device_bytes", "per_device_bytes",
+                  "n_pages", "tokens_per_sec", "compiles_in_window")
+    return _check_schema(
+        "BENCH_r18", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool,
+            "train2_dp": dict, "train2_fsdp": dict, "train2_tp": dict,
+            "train2_tp_fsdp": dict,
+            "serve_paged": dict, "serve_paged_tp": dict,
+            "tp_paged_pool_fraction": float,
+            "tp_paged_token_identical": bool,
+            "tp_paged_pool_under_budget": bool,
+            "tpfsdp_bytes_below_both_1d": bool,
+            "tpfsdp_losses_bitwise_dp": bool,
+            "zero_compiles_in_window": bool,
+        },
+        nested={"train2_dp": train_keys, "train2_fsdp": train_keys,
+                "train2_tp": train_keys, "train2_tp_fsdp": train_keys,
+                "serve_paged": serve_keys,
+                "serve_paged_tp": serve_keys},
+        gates=[("the composed serving configs must share one pool "
+                "geometry (equal effective sequence capacity)",
+                lambda d: d["serve_paged"]["n_pages"]
+                == d["serve_paged_tp"]["n_pages"] > 0),
+               ("every 2x2 train config must run one equal-length, "
+                "non-empty loss sequence",
+                lambda d: len({len(d[c]["losses_hex"]) for c in
+                               ("train2_dp", "train2_fsdp",
+                                "train2_tp", "train2_tp_fsdp")})
+                == 1 and len(d["train2_dp"]["losses_hex"]) > 0),
+               ("the composed serving configs must generate tokens",
+                lambda d: d["serve_paged"]["generated_tokens"] > 0
+                and d["serve_paged_tp"]["generated_tokens"] > 0)])
+
+
 def _shard_main():
     import numpy as onp
     if os.environ.get("BENCH_SHARD_CONFIG"):
@@ -3988,7 +4087,9 @@ def _shard_main():
 
     results = {}
     for cfg in ("train_dp", "train_fsdp", "train_tp",
-                "serve_dense", "serve_tp"):
+                "serve_dense", "serve_tp",
+                "train2_dp", "train2_fsdp", "train2_tp",
+                "train2_tp_fsdp", "serve_paged", "serve_paged_tp"):
         _stage(f"shard: {cfg}")
         r = _ab_child("--shard", dict(env, BENCH_SHARD_CONFIG=cfg),
                       label=f"shard {cfg}")
@@ -4068,6 +4169,77 @@ def _shard_main():
               f"comm_ratio={comm_ratio} "
               f"fsdp_dev_bytes={fsdp['per_device_bytes']} "
               f"budget={budget})", file=sys.stderr, flush=True)
+        return 1
+
+    # -- BENCH_r18: the mesh-parallel serving COMPOSITION ---------------
+    t2dp, t2f, t2t, t2x = (results["train2_dp"], results["train2_fsdp"],
+                           results["train2_tp"],
+                           results["train2_tp_fsdp"])
+    spd, spt = results["serve_paged"], results["serve_paged_tp"]
+    pool_frac = round(spt["pool_per_device_bytes"]
+                      / max(spd["pool_per_device_bytes"], 1), 4)
+    zero18 = all(results[c]["compiles_in_window"] == 0 for c in
+                 ("train2_dp", "train2_fsdp", "train2_tp",
+                  "train2_tp_fsdp", "serve_paged", "serve_paged_tp"))
+    doc18 = _shd18_check_schema({
+        "metric": "compose_tp_paged_pool_per_device_fraction",
+        "value": pool_frac,
+        "unit": "per-device KV-pool bytes, tp+paged+int8 / "
+                "single-device paged+int8 (equal pool geometry)",
+        "model": t2dp.get("model", "gpt"),
+        "smoke": bool(smoke),
+        "composition": "serve: paged KV pool + int8 weights + int8 KV"
+                       " sharded over the heads axis of a (2, 4) "
+                       "(dp, tp) mesh, page table replicated; train: "
+                       "tp_fsdp = params+opt over BOTH axes of a 2x2 "
+                       "mesh, gather-compute (ZeRO) discipline",
+        "train2_dp": t2dp, "train2_fsdp": t2f, "train2_tp": t2t,
+        "train2_tp_fsdp": t2x,
+        "serve_paged": spd, "serve_paged_tp": spt,
+        "tp_paged_pool_fraction": pool_frac,
+        # per-device param+opt and comm-bytes table, tp_fsdp vs the
+        # 1-D layouts on the SAME 2x2 mesh (the headroom ROADMAP
+        # item 1 left open)
+        "per_device_bytes_2x2": {
+            "dp": t2dp["per_device_bytes"],
+            "fsdp": t2f["per_device_bytes"],
+            "tp": t2t["per_device_bytes"],
+            "tp_fsdp": t2x["per_device_bytes"]},
+        "comm_bytes_per_step_2x2": {
+            "dp": t2dp["comm_bytes_per_step"],
+            "fsdp": t2f["comm_bytes_per_step"],
+            "tp": t2t["comm_bytes_per_step"],
+            "tp_fsdp": t2x["comm_bytes_per_step"]},
+        "tp_paged_token_identical": bool(
+            spd["tokens_digest"] == spt["tokens_digest"]),
+        # the headline budget: a tp device's pool share must fit well
+        # under the single-device pool — <= 0.30x at tp=4 (0.25x pool
+        # + nothing else sharded into it; the slack absorbs the
+        # replicated table/len never counted here)
+        "tp_paged_pool_under_budget": bool(pool_frac <= 0.30),
+        "tpfsdp_bytes_below_both_1d": bool(
+            t2x["per_device_bytes"] < t2f["per_device_bytes"]
+            and t2x["per_device_bytes"] < t2t["per_device_bytes"]),
+        "tpfsdp_losses_bitwise_dp": bool(
+            t2x["losses_hex"] == t2dp["losses_hex"]),
+        "zero_compiles_in_window": zero18,
+    })
+    out18 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         os.environ.get("BENCH_SHARD18_OUT",
+                                        "BENCH_r18.json"))
+    if not smoke or "BENCH_SHARD18_OUT" in os.environ:
+        with open(out18, "w") as f:
+            json.dump(doc18, f, indent=2)
+    print(json.dumps(doc18))
+    failed18 = [g for g in (
+        "tp_paged_token_identical", "tp_paged_pool_under_budget",
+        "tpfsdp_bytes_below_both_1d", "tpfsdp_losses_bitwise_dp",
+        "zero_compiles_in_window") if not doc18[g]]
+    if failed18:
+        print(f"[bench] shard compose gates failed: "
+              f"{', '.join(failed18)} (pool_frac={pool_frac} "
+              f"bytes_2x2={doc18['per_device_bytes_2x2']})",
+              file=sys.stderr, flush=True)
         return 1
     return 0
 

@@ -791,6 +791,139 @@ class GPTModel(HybridBlock):
                 f"got shape {idx.shape}")
         return idx
 
+    # -- mesh-sharded generation state (docs/SHARDING.md) ---------------
+    def set_force_jnp_attention(self, on):
+        """Switch the generation closures' attention tracing mode:
+        ``True`` traces the jnp kernel paths (``ops.attention.
+        jnp_only`` — required inside SPMD programs, where a
+        ``pallas_call`` cannot ride without its own ``shard_map``),
+        ``False`` restores the backend default (Pallas on TPU). The
+        ONE place the flag and its closure invalidation live: a mode
+        flip invalidates every cached generation closure, because a
+        closure traced under the other mode would silently keep the
+        wrong kernel path. No-op (closures kept) when the mode is
+        already set."""
+        on = bool(on)
+        if getattr(self, "_force_jnp_attention", False) == on:
+            return self
+        self._force_jnp_attention = on
+        self._gen = None
+        self._paged = None
+        self._spec_jits = None
+        return self
+
+    def shard_generation_state(self, partitioner):
+        """Place the DERIVED generation-state runtime arguments onto
+        mesh shardings riding the same logical axes as the parameters
+        they scale (``GenerationEngine(mesh_layout="tp")`` calls this
+        after placing the parameters, and again after every rollover
+        re-quantize):
+
+        - int8 quant tables: ``wq`` follows its fp32 weight's resolved
+          spec exactly (same shape, same axes); the per-output-channel
+          ``scale`` vector follows the weight's dim-0 axis — a scale
+          must live WITH the channels it scales or every dequant
+          would gather it cross-device.
+        - LoRA banks: ``A (n, d_in, r)`` shards ``d_in`` on the
+          projection weight's input axis (the out-projection's heads
+          axis under tp), ``B (n, r, d_out)`` shards ``d_out`` on the
+          weight's output axis (q/k/v's heads axis), ``scale``
+          replicates — so the per-slot bank gather stays per-device
+          inside the one fixed-shape program.
+
+        Zero retraces: the tables/banks are runtime arguments and
+        ``device_put`` changes values' placement, not the pytree
+        structure."""
+        import jax as _jax
+        from jax.sharding import NamedSharding as _NS, \
+            PartitionSpec as _P
+        mesh = partitioner.mesh
+
+        def _wspec(blk, name):
+            d = getattr(blk, name).weight.data()._data
+            sh = getattr(d, "sharding", None)
+            spec = tuple(sh.spec) if isinstance(sh, _NS) else ()
+            return spec + (None,) * (d.ndim - len(spec))
+
+        if self._quant is not None:
+            tabs = []
+            for blk, tab in zip(self._blocks(), self._quant):
+                new = {}
+                for name, (wq, sc) in tab.items():
+                    spec = _wspec(blk, name)
+                    new[name] = (
+                        _jax.device_put(wq, _NS(mesh, _P(*spec))),
+                        _jax.device_put(sc, _NS(mesh, _P(spec[0]))))
+                tabs.append(new)
+            self._quant = tabs
+        if self._lora is not None:
+            tabs = []
+            for blk, tab in zip(self._blocks(), self._lora):
+                new = {}
+                for name, bank in tab.items():
+                    spec = _wspec(blk, name)     # (d_out, d_in)
+                    new[name] = {
+                        "A": _jax.device_put(
+                            bank["A"],
+                            _NS(mesh, _P(None, spec[1], None))),
+                        "B": _jax.device_put(
+                            bank["B"],
+                            _NS(mesh, _P(None, None, spec[0]))),
+                        "scale": _jax.device_put(bank["scale"],
+                                                 _NS(mesh, _P())),
+                    }
+                tabs.append(new)
+            self._lora = tabs
+        return self
+
+    def decode_hlo(self, tokens, cache, active=None, adapters=None):
+        """Compiled HLO text of the decode-step program serving these
+        argument avals (dense when ``active`` is None, paged
+        otherwise) — the serving analog of ``TrainStep.compiled_hlo``:
+        ``GenerationEngine.warmup()`` under ``mesh_layout="tp"`` feeds
+        it to ``partition.hlo_collectives`` to count the per-step
+        cross-device collectives the telemetry counters report. This
+        lowers/compiles a fresh executable for inspection (the live
+        jit entry is untouched), so call it OUTSIDE any timed
+        window."""
+        tokens = _as_i32(tokens)
+        b = tokens.shape[0]
+        args = [self._quant_arg(), self._lora_arg(),
+                self._lora_idx(adapters, b), tokens]
+        if active is None:
+            gen = self._ensure_gen()
+            param_nds, jitfn = gen[0], gen[2]
+        else:
+            p = self._ensure_paged()
+            param_nds, jitfn = p["params"], p["decode"]
+            args.append(_as_i32(active))
+        lowered = jitfn.lower(next_key(),
+                              [nd._data for nd in param_nds],
+                              *args, cache)
+        return lowered.compile().as_text()
+
+    def verify_commit_hlo(self, k, cache, paged=False, adapters=None):
+        """Compiled HLO text of the fused greedy ``verify_commit``
+        program — :meth:`decode_hlo`'s speculative sibling: a
+        speculative engine's steady state runs THIS program per
+        iteration, not the single-token decode, so its per-step
+        collective counts must be measured from it (the sampled
+        variant adds sampling ops on top of the same verify; the
+        greedy program is the collective-structure reference). Lowers
+        a fresh executable; call outside any timed window."""
+        b = int(cache["len"].shape[0])
+        kind = "verify_commit_paged" if paged else "verify_commit"
+        param_nds, jitted = self._ensure_spec(kind, int(k), False)
+        zb = jnp.zeros((b,), jnp.int32)
+        dt = jnp.zeros((b, int(k)), jnp.int32)
+        ones = jnp.ones((b,), jnp.int32)
+        lowered = jitted.lower(next_key(),
+                               [nd._data for nd in param_nds],
+                               self._quant_arg(), self._lora_arg(),
+                               self._lora_idx(adapters, b),
+                               zb, dt, ones, cache)
+        return lowered.compile().as_text()
+
     def init_cache(self, batch_size, max_length=None, dtype=None):
         """Preallocated fixed-shape KV cache pytree for ``batch_size``
         slots: ``{"k": tuple of L (B, H, S_max, Dh) arrays, "v": same,
@@ -832,7 +965,7 @@ class GPTModel(HybridBlock):
         return [p.data() for p in params]
 
     @staticmethod
-    def _make_bind(param_nds, blocks):
+    def _make_bind(param_nds, blocks, force_jnp=False):
         """Closure factory: run ``fn`` with the parameter NDArrays
         rebound to the traced buffers (gluon/block.py raw_fn idiom)
         and — for a quantized model — each block's ``_qbind`` table
@@ -841,7 +974,10 @@ class GPTModel(HybridBlock):
         LoRA-armed model additionally rebinds each block's ``_lbind``
         to its traced adapter banks plus the call's per-row adapter
         index vector. Shared by the dense and paged generation
-        closures."""
+        closures. ``force_jnp`` (a mesh-sharded serving engine sets
+        ``model._force_jnp_attention``) traces the attention ops on
+        their jnp paths — a ``pallas_call`` cannot ride inside an
+        SPMD program without its own ``shard_map``."""
         def _bind(fn):
             def wrapper(key, param_datas, quant_tabs, lora_tabs,
                         lora_idx, *args):
@@ -851,7 +987,10 @@ class GPTModel(HybridBlock):
                 saved_l = [blk._lbind for blk in blocks]
                 scope = _deferred.trace_scope()
                 rec = autograd._RecordingScope(False, False)
-                with scope, rec, trace_rng(key):
+                import contextlib as _ctx
+                att_ctx = _att.jnp_only() if force_jnp \
+                    else _ctx.nullcontext()
+                with scope, rec, trace_rng(key), att_ctx:
                     for nd, d in zip(param_nds, param_datas):
                         nd._data = d
                     for blk, tab in zip(
@@ -999,7 +1138,9 @@ class GPTModel(HybridBlock):
             return self._gen
         param_nds = self._gen_params()
         blocks = self._blocks()
-        _bind = self._make_bind(param_nds, blocks)
+        _bind = self._make_bind(
+            param_nds, blocks,
+            force_jnp=getattr(self, '_force_jnp_attention', False))
 
         def prefill_raw(tokens, valid_len, slots, cache):
             b, sb = tokens.shape
@@ -1192,7 +1333,9 @@ class GPTModel(HybridBlock):
             return hit
         param_nds = self._gen_params()
         blocks = self._blocks()
-        _bind = self._make_bind(param_nds, blocks)
+        _bind = self._make_bind(
+            param_nds, blocks,
+            force_jnp=getattr(self, '_force_jnp_attention', False))
         k = int(k)
 
         if kind == "propose":
@@ -1386,7 +1529,9 @@ class GPTModel(HybridBlock):
             return self._paged
         param_nds = self._gen_params()
         blocks = self._blocks()
-        _bind = self._make_bind(param_nds, blocks)
+        _bind = self._make_bind(
+            param_nds, blocks,
+            force_jnp=getattr(self, '_force_jnp_attention', False))
 
         def fresh_raw(tokens, n_valid, slot, pages, cache):
             """Whole-prompt prefill of one slot at bucket width W: the

@@ -32,8 +32,10 @@ on the sliced cache.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 import math
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -238,7 +240,34 @@ def flash_attention(q, k, v, causal=False, scale=None, kv_len=None):
     return _flash_fwd(q, k, v, causal, scale, kv_len)[0]
 
 
+#: threads currently tracing under jnp_only() — the SPMD-serving
+#: escape hatch (see below)
+_JNP_ONLY = threading.local()
+
+
+@contextlib.contextmanager
+def jnp_only():
+    """Force the jnp paths while tracing under this context.
+
+    Tensor-parallel serving compiles the generation closures SPMD over
+    the device mesh (params and KV sharded by heads); a ``pallas_call``
+    inside such a program would need an explicit ``shard_map`` wrapping
+    it per shard, which the decode kernels do not have — so a
+    mesh-sharded engine traces its closures under this context and the
+    kernels stay on the (numerically identical) jnp paths, partitioned
+    by GSPMD like any other op. Scoped per thread (trace-time only):
+    an unsharded engine tracing concurrently still takes Pallas."""
+    prev = getattr(_JNP_ONLY, "on", False)
+    _JNP_ONLY.on = True
+    try:
+        yield
+    finally:
+        _JNP_ONLY.on = prev
+
+
 def _use_pallas():
+    if getattr(_JNP_ONLY, "on", False):
+        return False
     try:
         return jax.default_backend() == "tpu"
     except Exception:

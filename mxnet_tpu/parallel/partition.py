@@ -38,6 +38,18 @@ Built-in layouts:
   the owning shard — reduce-scatter semantics, ``(N-1)/N`` of the
   bytes per direction of the full allreduce the ``"dp"`` layout pays
   (see ``kvstore.collective_wire_bytes`` for the byte model).
+- ``"tp_fsdp"`` — the 2-D composition over a ``(dp, tp)`` mesh:
+  every parameter (and its optimizer state) shards over BOTH axes —
+  the tp-sharded dim (heads/mlp/vocab) over ``tp`` and the embed dim
+  over ``dp`` — so per-device param+optimizer bytes shrink by the
+  whole mesh size, strictly below either 1-D layout. Compute keeps
+  the fsdp (ZeRO) discipline: the step all-gathers each weight
+  before use and the gradient reduce-scatters back into the owning
+  shard over the fsdp axis / all-reduces over the tp axis
+  (``gather_compute`` — ``TrainStep`` pins the in-step weight AND
+  gradient placements so the math is the dense program's, which is
+  what makes tp_fsdp losses BITWISE equal to dp on a deterministic
+  backend).
 
 Per-device footprint is MEASURED, not modeled: ``per_device_bytes``
 walks real ``jax.Array`` shards, so the bench gate "this model's
@@ -101,7 +113,30 @@ DP_RULES = (
     ("batch", "dp"),
 )
 
-LAYOUTS = {"dp": DP_RULES, "tp": TP_RULES, "fsdp": FSDP_RULES}
+#: 2-D tp×fsdp: the big projection dim over 'tp', the embed dim over
+#: 'dp' — a 2-D param shards over the WHOLE mesh (ordered first-match
+#: per dim, each mesh axis used once per param). Storage-only layout:
+#: TrainStep's gather_compute path all-gathers weights in-step and
+#: reduce-scatters grads back, so the math stays the dense program's.
+TP_FSDP_RULES = (
+    ("heads", "tp"),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("embed", "dp"),
+    ("kv", None),
+    ("batch", "dp"),
+)
+
+LAYOUTS = {"dp": DP_RULES, "tp": TP_RULES, "fsdp": FSDP_RULES,
+           "tp_fsdp": TP_FSDP_RULES}
+
+#: layouts whose in-step COMPUTE must run on the gathered (replicated)
+#: weights and gradients — the ZeRO discipline made explicit. 1-D fsdp
+#: gets there through GSPMD's own propagation (PR 12's committed
+#: bitwise result); the 2-D layout must pin it, because the 2-D output
+#: shardings otherwise back-propagate tp splits into the backward
+#: contractions and the partial-sum order drifts a ulp per step.
+_GATHER_COMPUTE_LAYOUTS = ("tp_fsdp",)
 
 
 def _axis_size(mesh: Mesh, axis) -> int:
@@ -192,7 +227,11 @@ class Partitioner:
                     if n <= 1:
                         continue
                     if int(dim) % n != 0:
-                        key = (name, d, mesh_axis)
+                        # warn ONCE per (logical axis, mesh axis) pair
+                        # — a model with 50 odd-sized heads params
+                        # must not emit 50 copies of the same fact
+                        # (the first offender is named in the message)
+                        key = (lax_name, mesh_axis)
                         if key not in self._warned:
                             self._warned.add(key)
                             warnings.warn(
@@ -200,7 +239,9 @@ class Partitioner:
                                 f"({lax_name}={dim}) is not divisible "
                                 f"by mesh axis {mesh_axis!r} "
                                 f"(size {n}); falling back to "
-                                f"replication for this dim")
+                                f"replication for this dim (warned "
+                                f"once per ({lax_name!r}, "
+                                f"{mesh_axis!r}) pair)")
                         continue
                     pick = mesh_axis
                     break
@@ -303,14 +344,30 @@ class Partitioner:
             return P(*entries)
         return P()
 
+    #: cache-pytree keys whose leaves shard by heads (dense caches,
+    #: paged pools, and their int8 scale tables). The page TABLE and
+    #: the ``len`` vector are host-logic state and stay replicated
+    #: even when their shapes coincide with a heads dim (a (B, P_max)
+    #: table with P_max == num_heads must never shard).
+    _CACHE_SHARDED_KEYS = frozenset(("k", "v", "k_scale", "v_scale"))
+
     def cache_shardings(self, cache, num_heads):
         """Pytree of ``NamedSharding``s matching a generation-cache
-        pytree (``init_cache``/``init_paged_cache`` layout)."""
+        pytree (``init_cache``/``init_paged_cache`` layout): K/V
+        buffers (and their int8 scale tables) shard over the heads
+        axis; the page table and lengths replicate — keyed by the
+        pytree path, not by shape coincidence."""
         mesh = self.mesh
-        return jax.tree.map(
-            lambda leaf: NamedSharding(
-                mesh, self.cache_spec(tuple(leaf.shape), num_heads)),
-            cache)
+        rep = NamedSharding(mesh, P())
+
+        def leaf_sh(path, leaf):
+            keys = {getattr(p, "key", None) for p in path}
+            if keys & self._CACHE_SHARDED_KEYS:
+                return NamedSharding(
+                    mesh, self.cache_spec(tuple(leaf.shape), num_heads))
+            return rep
+
+        return jax.tree_util.tree_map_with_path(leaf_sh, cache)
 
     def place_cache(self, cache, num_heads):
         """Commit a cache pytree onto the mesh with the heads axis
@@ -319,6 +376,17 @@ class Partitioner:
         pjit executable cache keys on)."""
         return jax.device_put(cache,
                               self.cache_shardings(cache, num_heads))
+
+    # -- in-step compute discipline ------------------------------------
+    @property
+    def gather_compute(self) -> bool:
+        """True when the layout's in-step compute must run on the
+        GATHERED weights and gradients (``TrainStep`` pins replicated
+        in-step placements): the 2-D ``tp_fsdp`` layout, whose 2-D
+        output shardings would otherwise back-propagate tp splits
+        into the backward contractions and drift the losses a ulp
+        per step away from dp."""
+        return self.layout in _GATHER_COMPUTE_LAYOUTS
 
     # -- grad-sync selection -------------------------------------------
     @property
@@ -364,10 +432,29 @@ def grad_sync_bytes(specs, params, mesh: Mesh, batch_axis="dp") -> int:
         flat = [a for e in spec if e is not None
                 for a in (e if isinstance(e, (tuple, list)) else (e,))]
         if batch_axis in flat:
+            # 2-D layouts: a param ALSO sharded over a non-batch axis
+            # (tp) reduce-scatters only its tp-shard's bytes over the
+            # fsdp axis — each tp group syncs 1/tp of the payload —
+            # but the in-step REGATHER (the ZeRO gather-compute
+            # discipline: the weight must be replicated before use)
+            # then also all-gathers the full payload over each
+            # non-batch axis. Net effect at 2x2: tp_fsdp wire bytes
+            # per param equal fsdp's — ZeRO comm is ~independent of
+            # the sharding factor; the 2-D win is MEMORY, and the
+            # model must not invent a comm saving that the executed
+            # HLO (more all-gathers, not fewer) does not show.
+            shard = nbytes
+            for e in flat:
+                if e != batch_axis:
+                    shard //= max(_axis_size(mesh, e), 1)
             total += _kv.collective_wire_bytes(
-                "reduce_scatter", nbytes, n_dp)
+                "reduce_scatter", shard, n_dp)
             total += _kv.collective_wire_bytes(
-                "all_gather", nbytes, n_dp)
+                "all_gather", shard, n_dp)
+            for e in flat:
+                if e != batch_axis:
+                    total += _kv.collective_wire_bytes(
+                        "all_gather", nbytes, _axis_size(mesh, e))
         elif n_dp > 1:
             shard = nbytes
             for e in flat:

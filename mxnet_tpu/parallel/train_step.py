@@ -237,14 +237,40 @@ class TrainStep:
         opt_cls = type(opt)
         n_diff = len(diff_nds)
 
+        # gather-compute layouts (tp_fsdp): weights AND gradients are
+        # pinned replicated INSIDE the step — the forward all-gathers
+        # each weight before use (ZeRO-3) and the backward reduces the
+        # gradient fully before the sharded optimizer update slices
+        # it. Without the gradient pin, the 2-D output shardings
+        # back-propagate tp splits into the backward contractions and
+        # the partial-sum order drifts the updates a ulp per step away
+        # from dp (losses stop being bitwise-comparable). The sharded
+        # placements remain the STORAGE layout via in/out_shardings.
+        gather_rep = None
+        if part is not None and part.gather_compute \
+                and self.mesh is not None:
+            gather_rep = NamedSharding(self.mesh, P())
+
         def step_fn(key, diff_datas, frozen_datas, opt_states, hypers,
                     input_datas, label_datas, n_valid):
+            if gather_rep is not None:
+                diff_datas = tuple(
+                    jax.lax.with_sharding_constraint(d, gather_rep)
+                    for d in diff_datas)
+                frozen_datas = tuple(
+                    jax.lax.with_sharding_constraint(d, gather_rep)
+                    for d in frozen_datas)
+
             def loss_f(dd):
                 return forward_loss(key, dd, frozen_datas,
                                     input_datas, label_datas, n_valid)
 
             (loss, aux), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(diff_datas)
+            if gather_rep is not None:
+                grads = tuple(
+                    jax.lax.with_sharding_constraint(g, gather_rep)
+                    for g in grads)
             new_ws, new_ss = [], []
             for k in range(n_diff):
                 w, g, s, h = (diff_datas[k], grads[k], opt_states[k],

@@ -683,17 +683,13 @@ class GenerationEngine:
         self._part = None
         self._tp_heads = 0
         self._cache_sh = None  # canonical TP cache shardings (lazy)
+        self._rep_sh = None    # replicated-over-mesh target (draft)
+        self._step_collectives = None  # per-decode collective counts
         if mesh_layout is not None:
             if mesh_layout != "tp":
                 raise ValueError(
                     f"unsupported mesh_layout={mesh_layout!r} (only "
                     f"'tp')")
-            if self.paged or self.speculative or quantize is not None \
-                    or cache_dtype is not None or self.lora_enabled:
-                raise ValueError(
-                    "mesh_layout='tp' currently composes with the "
-                    "dense fp32 engine only (paged / speculative / "
-                    "int8 / LoRA engines stay single-device)")
             from .. import parallel as _parallel
             from ..parallel import partition as _partition
             m = mesh if mesh is not None else _parallel.get_mesh()
@@ -719,12 +715,52 @@ class GenerationEngine:
                     f"axis size {tp}: the KV cache shards by heads")
             self._tp_heads = n_heads
             self._part = _partition.Partitioner("tp", mesh=m)
+            from jax.sharding import NamedSharding as _NS, \
+                PartitionSpec as _P
+            self._rep_sh = _NS(m, _P())
             # place the parameters over the mesh BEFORE any closure
             # traces: the jitted generation programs read the params'
-            # committed shardings and compile SPMD
+            # committed shardings and compile SPMD. The attention ops
+            # trace on their jnp paths (ops.attention.jnp_only — a
+            # pallas_call cannot ride inside an SPMD program), which
+            # requires rebuilding any closures a prior single-device
+            # user of this model left behind.
             if callable(getattr(model, "_gen_params", None)):
                 model._gen_params()   # materialize deferred shapes
             self._part.place(model.collect_params())
+            if callable(getattr(model, "set_force_jnp_attention",
+                                None)):
+                model.set_force_jnp_attention(True)
+            # derived generation state (int8 quant tables computed
+            # above from the then-unplaced weights; LoRA banks armed
+            # above) re-places onto shardings riding the weights' axes
+            if callable(getattr(model, "shard_generation_state",
+                                None)):
+                model.shard_generation_state(self._part)
+            if self.speculative:
+                # the DRAFT runs REPLICATED over the mesh while the
+                # target is tp: its params/cache are small (a draft is
+                # a truncation of the target), and replication keeps
+                # propose/verify_commit at their 3-dispatch shape —
+                # no cross-placement transfers inside the iteration
+                _partition.Partitioner("dp", mesh=m).place(
+                    draft_model.collect_params())
+                if callable(getattr(draft_model,
+                                    "set_force_jnp_attention", None)):
+                    draft_model.set_force_jnp_attention(True)
+            for axis, size in m.shape.items():
+                telemetry.gauge(f"parallel.mesh.axis_sizes.{axis}",
+                                int(size))
+        else:
+            # a single-device engine must UNDO a prior tp engine's
+            # jnp-only tracing mark on a reused model (and draft) —
+            # leaving it set would silently trace the slow jnp
+            # attention paths instead of Pallas on a TPU box, with no
+            # error or telemetry signal
+            for mdl in (model, draft_model):
+                if mdl is not None and callable(
+                        getattr(mdl, "set_force_jnp_attention", None)):
+                    mdl.set_force_jnp_attention(False)
         self.model = model
         self.max_slots = int(max_slots)
         self.max_new_tokens = int(max_new_tokens)
@@ -809,7 +845,7 @@ class GenerationEngine:
         #: fraction of one target layer's pool) and fp32 (its logits
         #: only steer proposals; the target's verify is what commits)
         self._draft_cache = None if not self.speculative \
-            else self._commit(
+            else self._commit_draft(
                 draft_model.init_cache(self.max_slots, self._s_max))
         #: per-slot sampling state, threaded as runtime (B,) vectors
         #: through the fixed-shape sampling/verify programs — a mixed
@@ -914,13 +950,29 @@ class GenerationEngine:
             return "off"
         return f"rank={self.lora_rank}:max={self.max_adapters}"
 
+    @property
+    def mesh_config(self) -> str:
+        """The replica's mesh-parallel configuration — ``"off"`` or
+        ``"tp:<axis>=<size>x..."``. Router fleets must be
+        mesh-homogeneous (the precision/speculation/LoRA rule's
+        sibling): a cross-replica retry must replay the IDENTICAL
+        numeric config, and a tp engine's logits differ from an
+        unsharded replica's in the partial-sum reduction order — a
+        mixed fleet would make a retried stream's tokens depend on
+        which replica caught it."""
+        if self._part is None:
+            return "off"
+        mesh = self._part.mesh
+        axes = "x".join(f"{a}={int(n)}" for a, n in mesh.shape.items())
+        return f"{self.mesh_layout}:{axes}"
+
     def capabilities(self) -> str:
         """One-line summary of the engine's configured capabilities —
         quoted by every ``submit`` kwarg-validation error so a caller
         holding the wrong engine sees what this one actually does."""
         return (f"precision={self.precision}, "
                 f"speculation={self.speculation}, lora={self.lora}, "
-                f"paged={self.paged}")
+                f"paged={self.paged}, mesh={self.mesh_config}")
 
     def _submit_error(self, arg, value, why):
         """The shared ``submit`` kwarg-validation error: names the
@@ -1170,6 +1222,34 @@ class GenerationEngine:
                                                         self._tp_heads)
         return jax.device_put(cache, self._cache_sh)
 
+    def _commit_draft(self, cache):
+        """Commit the DRAFT model's dense cache: replicated over the
+        whole mesh under ``mesh_layout="tp"`` (the replicated-draft
+        rule — every device holds the full draft state, so the fused
+        propose program runs SPMD with zero cross-device traffic),
+        one device otherwise."""
+        import jax
+        if self._part is not None:
+            return jax.device_put(cache, self._rep_sh)
+        return jax.device_put(cache, jax.devices()[0])
+
+    def _recommit_draft(self, cache):
+        """TP mode: pin a draft step's returned cache back onto the
+        replicated placement (the draft analog of :meth:`_recommit` —
+        one input-sharding signature per program)."""
+        if self._part is None:
+            return cache
+        import jax
+        return jax.device_put(cache, self._rep_sh)
+
+    def _emit_collectives(self):
+        """Bump the ``parallel.collectives.*`` counters by the decode
+        program's per-step collective counts (measured once from the
+        compiled HLO at warmup — ``GPTModel.decode_hlo``)."""
+        if self._step_collectives:
+            for kind, n in self._step_collectives.items():
+                telemetry.counter(f"parallel.collectives.{kind}", n)
+
     # -- lifecycle -----------------------------------------------------
     @contextlib.contextmanager
     def _gen_exclusive(self):
@@ -1207,6 +1287,7 @@ class GenerationEngine:
                 return self
             if self.paged:
                 self._warmup_paged()
+                self._warmup_telemetry()
                 return self
             cache = self._commit(self.model.init_cache(
                 self.max_slots, self._s_max, dtype=self._cache_dtype))
@@ -1221,10 +1302,48 @@ class GenerationEngine:
                     cache = self._recommit(cache)
             lg, cache = self.model.decode_step(
                 onp.zeros((self.max_slots,), "i4"), cache)
+            cache = self._recommit(cache)
             self._warm_samplers(int(lg.shape[-1]))
             if self.speculative:
                 self._warmup_spec(cache)
+            self._warmup_telemetry()
         return self
+
+    def _warmup_telemetry(self):
+        """Post-warmup measurements (outside any serving window):
+        the MEASURED per-device bytes of params + live cache
+        (``serving.generate.per_device_bytes`` — under
+        ``mesh_layout="tp"`` this is each device's SHARE; single-
+        device engines report the full footprint), and, for a
+        mesh-sharded engine, the decode program's per-step collective
+        counts (compiled-HLO evidence feeding the
+        ``parallel.collectives.*`` counters each tick)."""
+        from ..parallel import partition as _partition
+        if callable(getattr(self.model, "collect_params", None)):
+            leaves = [p.data()._data
+                      for p in self.model.collect_params().values()]
+            telemetry.gauge(
+                "serving.generate.per_device_bytes",
+                _partition.per_device_bytes(leaves + [self._cache]))
+        if self._part is not None \
+                and callable(getattr(self.model, "decode_hlo", None)):
+            if self.speculative and callable(
+                    getattr(self.model, "verify_commit_hlo", None)):
+                # a speculative engine's steady state runs the fused
+                # verify_commit per iteration, never the single-token
+                # decode — measure the program the counters describe
+                text = self.model.verify_commit_hlo(
+                    self.spec_k, self._cache, paged=self.paged)
+            else:
+                toks = onp.zeros((self.max_slots,), "i4")
+                kw = {}
+                if self.paged:
+                    kw["active"] = onp.ones((self.max_slots,), "i4")
+                text = self.model.decode_hlo(toks, self._cache, **kw)
+            colls = _partition.hlo_collectives(text)
+            self._step_collectives = {
+                kind.replace("-", "_"): int(v["count"])
+                for kind, v in colls.items()}
 
     def _warmup_spec(self, cache):
         """Compile the speculative steady state against throwaway
@@ -1239,17 +1358,23 @@ class GenerationEngine:
         keys = onp.zeros((b, 2), "u4")
         tf = onp.zeros((b,), "f4")
         pf = onp.ones((b,), "f4")
-        dcache = self._commit(self.draft.init_cache(b, self._s_max))
+        dcache = self._commit_draft(self.draft.init_cache(b,
+                                                          self._s_max))
         for sb in self.policy.sizes(self._s_cap - 1):
             _, dcache = self.draft.prefill(
                 onp.zeros((1, sb), "i4"), [sb], dcache, slots=[0])
+            dcache = self._recommit_draft(dcache)
         dt, dcache = self.draft.propose_tokens(zb, dcache, k)
+        dcache = self._recommit_draft(dcache)
         dt, q, _, dcache = self.draft.propose_tokens(
             zb, dcache, k, keys=keys, temps=tf, top_ks=zb, top_ps=pf)
-        dcache = self.draft.advance_len(zb, dcache)
+        dcache = self._recommit_draft(dcache)
+        dcache = self._recommit_draft(self.draft.advance_len(zb,
+                                                             dcache))
         vc = self.model.verify_commit_paged if self.paged \
             else self.model.verify_commit
         _, _, cache = vc(zb, dt, ones, cache)
+        cache = self._recommit(cache)
         _, _, _, cache = vc(zb, dt, ones, cache, q=q, keys=keys,
                             temps=tf, top_ks=zb, top_ps=pf)
 
@@ -1271,15 +1396,19 @@ class GenerationEngine:
             _, cache = self.model.prefill_paged(
                 onp.zeros((1, sb), "i4"), sb, 0, row, cache,
                 fresh=True)
+            cache = self._recommit(cache)
         for w in range(self._ps, self._chunk + 1, self._ps):
             _, cache = self.model.prefill_paged(
                 onp.zeros((1, w), "i4"), w, 0, row, cache, start=0)
+            cache = self._recommit(cache)
         lg, cache = self.model.decode_step_paged(
             onp.zeros((self.max_slots,), "i4"),
             onp.ones((self.max_slots,), "i4"), cache)
+        cache = self._recommit(cache)
         self.model.peek_logits_paged(0, 0, cache)
-        cache = self.model.bind_slot_paged(0, row, 1, cache)
-        cache = self.model.copy_page_paged(1, 1, cache)
+        cache = self._recommit(self.model.bind_slot_paged(0, row, 1,
+                                                          cache))
+        cache = self._recommit(self.model.copy_page_paged(1, 1, cache))
         self._warm_samplers(int(lg.shape[-1]))
         if self.speculative:
             self._warmup_spec(cache)
@@ -1327,6 +1456,11 @@ class GenerationEngine:
                 # never see new fp32 params with stale int8 tables
                 tq = telemetry.clock()
                 self.model.quantize_params()
+                if self._part is not None:
+                    # fresh tables follow the (still-placed) weights'
+                    # axes — re-pin explicitly so the closures keep
+                    # seeing the one canonical table sharding
+                    self.model.shard_generation_state(self._part)
                 telemetry.hist_since(
                     "serving.generate.quant.requantize", tq)
             if self.paged and self._prefix is not None:
@@ -1632,6 +1766,7 @@ class GenerationEngine:
             _, self._draft_cache = self.draft.prefill(
                 padded, onp.asarray([n], "i4"), self._draft_cache,
                 slots=onp.asarray([slot], "i4"))
+            self._draft_cache = self._recommit_draft(self._draft_cache)
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefills")
         tok = self._pick_first(slot, onp.asarray(logits)[0])
@@ -1787,8 +1922,8 @@ class GenerationEngine:
             self._slots[slot] = s
             self._n_active += 1
             t0 = telemetry.clock()
-            self._cache = self.model.bind_slot_paged(
-                slot, row, length, self._cache)
+            self._cache = self._recommit(self.model.bind_slot_paged(
+                slot, row, length, self._cache))
             logits = self.model.peek_logits_paged(
                 int(r.prompt[-1]), slot, self._cache,
                 **self._akw(self._adapter_idx[slot:slot + 1]))
@@ -1862,6 +1997,7 @@ class GenerationEngine:
             _, self._draft_cache = self.draft.prefill(
                 padded, onp.asarray([n], "i4"), self._draft_cache,
                 slots=onp.asarray([slot], "i4"))
+            self._draft_cache = self._recommit_draft(self._draft_cache)
             s.draft_prompt = None
         if s.key is not None:
             # decode entry is where the request's PRNG key goes live:
@@ -1911,6 +2047,7 @@ class GenerationEngine:
             toks, n_valid, best, s.row, self._cache, start=start,
             fresh=fresh,
             **self._akw(self._adapter_idx[best:best + 1]))
+        self._cache = self._recommit(self._cache)
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefill_chunks")
         self._chunks_this_iter += 1
@@ -1929,11 +2066,11 @@ class GenerationEngine:
             if s is not None and s.state == "decode" \
                     and s.cow_pending is not None:
                 src, dst, logical = s.cow_pending
-                self._cache = self.model.copy_page_paged(
-                    src, dst, self._cache)
+                self._cache = self._recommit(self.model.copy_page_paged(
+                    src, dst, self._cache))
                 s.row[logical] = dst
-                self._cache = self.model.bind_slot_paged(
-                    i, s.row, s.n_ctx, self._cache)
+                self._cache = self._recommit(self.model.bind_slot_paged(
+                    i, s.row, s.n_ctx, self._cache))
                 self._pool.release(src)
                 s.page_refs.remove(src)
                 s.cow_pending = None
@@ -1946,6 +2083,13 @@ class GenerationEngine:
         sampler call whose greedy rows are in-program argmax (the same
         ints) and whose stochastic rows consume their slot's key."""
         if self._n_sampling:
+            if self._part is not None:
+                # TP mode: hand the sampler HOST logits — the device
+                # logits carry a GSPMD-chosen (vocab-sharded) layout,
+                # and the sampler's pjit executable cache keys on
+                # input shardings; warmup fed host arrays, so the
+                # live path must too (one signature per program)
+                logits = onp.asarray(logits)
             tok, nk = self._ensure_samplers()["sample"](
                 self._keys, logits, self._temps, self._topks,
                 self._topps)
@@ -1971,6 +2115,8 @@ class GenerationEngine:
         logits, self._cache = self.model.decode_step_paged(
             toks, active, self._cache,
             **self._akw(self._adapter_idx))
+        self._cache = self._recommit(self._cache)
+        self._emit_collectives()
         telemetry.hist_since("serving.generate.decode", t0)
         step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()
@@ -2057,6 +2203,7 @@ class GenerationEngine:
             toks, self._cache, **self._akw(self._adapter_idx))
         if self._part is not None:
             self._cache = self._recommit(self._cache)
+        self._emit_collectives()
         telemetry.hist_since("serving.generate.decode", t0)
         step_toks = self._pick_step_tokens(logits)
         now = time.monotonic()
@@ -2122,6 +2269,7 @@ class GenerationEngine:
                 toks, self._draft_cache, k, keys=self._keys,
                 temps=self._temps, top_ks=self._topks,
                 top_ps=self._topps)
+            self._draft_cache = self._recommit_draft(self._draft_cache)
             commit, n_commit, keys, self._cache = (
                 self.model.verify_commit_paged if self.paged
                 else self.model.verify_commit)(
@@ -2132,11 +2280,14 @@ class GenerationEngine:
         else:
             dt, self._draft_cache = self.draft.propose_tokens(
                 toks, self._draft_cache, k)
+            self._draft_cache = self._recommit_draft(self._draft_cache)
             commit, n_commit, self._cache = (
                 self.model.verify_commit_paged if self.paged
                 else self.model.verify_commit)(
                 toks, dt, active, self._cache,
                 **self._akw(self._adapter_idx))
+        self._cache = self._recommit(self._cache)
+        self._emit_collectives()
         commit_h = onp.asarray(commit)    # the tick's one host sync
         n_h = onp.asarray(n_commit)
         if sampled:
@@ -2161,8 +2312,8 @@ class GenerationEngine:
             out = out[:min(len(out), s.left, self._s_cap - s.n_ctx)]
             emits[i] = (out, m)
             ddelta[i] += m
-        self._draft_cache = self.draft.advance_len(
-            ddelta, self._draft_cache)
+        self._draft_cache = self._recommit_draft(
+            self.draft.advance_len(ddelta, self._draft_cache))
         telemetry.counter("serving.generate.spec.proposed", proposed)
         telemetry.counter("serving.generate.spec.accepted", accepted)
         telemetry.counter("serving.generate.spec.rejected",

@@ -292,6 +292,18 @@ class Router:
                 f"replicas must be speculation-homogeneous, got "
                 f"{sorted(specs)} (replica capabilities: "
                 f"{self._fleet_capabilities(replicas)})")
+        meshes = {getattr(e, "mesh_config", "off") for e in replicas}
+        if len(meshes) > 1:
+            # and for the mesh layout (shape included): a retried
+            # request must replay the IDENTICAL numeric config, and a
+            # tensor-parallel replica's logits differ from an
+            # unsharded one's in the tp partial-sum reduction order —
+            # token-identity across a retry only holds when every
+            # replica computes the same way
+            raise TypeError(
+                f"replicas must be mesh-homogeneous (same mesh_layout "
+                f"and mesh shape), got {sorted(meshes)} (replica "
+                f"capabilities: {self._fleet_capabilities(replicas)})")
         loras = {getattr(e, "lora", "off") for e in replicas}
         if len(loras) > 1:
             # and for the LoRA bank config: an adapter= binding only

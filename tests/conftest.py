@@ -33,6 +33,13 @@ def pytest_configure(config):
         "markers", "requires_pallas: exercises a Pallas kernel in "
         "interpret mode; auto-skipped on boxes whose jax build cannot "
         "run pallas_call (keeps tier-1 green on minimal CI boxes)")
+    config.addinivalue_line(
+        "markers", "requires_mesh(n): needs at least n host devices "
+        "(the virtual CPU mesh this conftest forces via "
+        "tpu_platform.force_cpu / --xla_force_host_platform_device_"
+        "count). Auto-skipped when the process sees fewer — e.g. a "
+        "box whose XLA_FLAGS were pinned elsewhere, or a real-chip "
+        "run (MXTPU_TEST_PLATFORM=tpu) with fewer chips.")
 
 
 _PALLAS_OK = None
@@ -62,14 +69,55 @@ def _pallas_supported():
     return _PALLAS_OK
 
 
+def _device_count():
+    import jax
+    try:
+        return jax.device_count()
+    except Exception:
+        return 1
+
+
 def pytest_collection_modifyitems(config, items):
     marked = [it for it in items if "requires_pallas" in it.keywords]
-    if not marked or _pallas_supported():
-        return
-    skip = pytest.mark.skip(
-        reason="Pallas interpret mode unavailable on this box")
-    for item in marked:
-        item.add_marker(skip)
+    if marked and not _pallas_supported():
+        skip = pytest.mark.skip(
+            reason="Pallas interpret mode unavailable on this box")
+        for item in marked:
+            item.add_marker(skip)
+    # requires_mesh(n): mesh tests declare their device floor instead
+    # of probing jax.devices() ad hoc (the requires_pallas pattern)
+    mesh_marked = [(it, it.get_closest_marker("requires_mesh"))
+                   for it in items
+                   if it.get_closest_marker("requires_mesh")]
+    if mesh_marked:
+        have = _device_count()
+        for item, mark in mesh_marked:
+            need = int(mark.args[0]) if mark.args else 2
+            if have < need:
+                item.add_marker(pytest.mark.skip(
+                    reason=f"needs a {need}-device mesh; this "
+                           f"process sees {have} "
+                           f"(--xla_force_host_platform_device_count "
+                           f"is set before backend init by "
+                           f"tests/conftest.py via tpu_platform."
+                           f"force_cpu — it cannot change mid-run)"))
+
+
+@pytest.fixture(scope="session")
+def mesh_devices():
+    """THE documented way for mesh tests to get their host devices.
+
+    The virtual device count is fixed per process by
+    ``--xla_force_host_platform_device_count`` (XLA reads it once at
+    backend init), so this conftest sets it up front through
+    ``tpu_platform.force_cpu(n_devices=8)`` — a fixture cannot raise
+    it later, and tests must NEVER mangle ``XLA_FLAGS`` themselves
+    (a late mutation silently does nothing, or worse, leaks into a
+    subprocess with a different count). Mesh tests declare their
+    floor with ``@pytest.mark.requires_mesh(n)`` (auto-skip below n)
+    and take this fixture for the device list."""
+    import jax
+    return jax.devices()
 
 
 @pytest.fixture(autouse=True)

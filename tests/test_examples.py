@@ -8,16 +8,17 @@ import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EX = os.path.join(ROOT, "examples")
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+import tpu_platform  # noqa: E402
 
 
 def _run(script, *args, timeout=420):
-    env = dict(os.environ)
+    # examples must not try to grab the real TPU from CI; the virtual
+    # device count goes through the sanctioned helper (a raw append
+    # duplicates the flag when the parent already forced a count)
+    env = tpu_platform.cpu_child_env(n_devices=8)
     env["PYTHONPATH"] = ROOT + os.pathsep + env.get("PYTHONPATH", "")
-    # examples must not try to grab the real TPU from CI
-    env["JAX_PLATFORMS"] = "cpu"
-    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") +
-                        " --xla_force_host_platform_device_count=8") \
-        .strip()
     proc = subprocess.run(
         [sys.executable, os.path.join(EX, script), *args],
         capture_output=True, text=True, timeout=timeout, env=env,

@@ -16,6 +16,8 @@ from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
 from mxnet_tpu.parallel import partition
 
 
+pytestmark = pytest.mark.requires_mesh(8)
+
 VOCAB, UNITS, LAYERS, HEADS, SMAX = 64, 32, 2, 4, 32
 
 
@@ -424,9 +426,6 @@ def test_tp_engine_validation():
         GenerationEngine(_gpt(), mesh_layout="fsdp", mesh=mesh)
     with pytest.raises(ValueError, match="tp' axis"):
         GenerationEngine(_gpt(), mesh_layout="tp", mesh=dp_mesh)
-    with pytest.raises(ValueError, match="dense fp32"):
-        GenerationEngine(_gpt(), mesh_layout="tp", mesh=mesh,
-                         paged=True)
     # a model without _num_heads must fail LOUDLY at construction —
     # the cache shards by heads (regression: review round 1)
     class _Headless:

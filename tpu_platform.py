@@ -11,6 +11,16 @@ import os
 import re
 
 
+def _with_device_count(flags: str, n_devices: int) -> str:
+    """Set (replace, never duplicate) the virtual host-device-count
+    flag inside an XLA_FLAGS string."""
+    opt = f"--xla_force_host_platform_device_count={n_devices}"
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    if re.search(pat, flags):
+        return re.sub(pat, opt, flags)
+    return (flags + " " + opt).strip()
+
+
 def force_cpu(n_devices: int | None = None) -> None:
     """Pin JAX to host CPU, optionally with n virtual devices.
 
@@ -19,14 +29,22 @@ def force_cpu(n_devices: int | None = None) -> None:
     kept) so callers actually get the count they asked for.
     """
     if n_devices is not None:
-        flags = os.environ.get("XLA_FLAGS", "")
-        opt = f"--xla_force_host_platform_device_count={n_devices}"
-        pat = r"--xla_force_host_platform_device_count=\d+"
-        if re.search(pat, flags):
-            flags = re.sub(pat, opt, flags)
-        else:
-            flags = (flags + " " + opt).strip()
-        os.environ["XLA_FLAGS"] = flags
+        os.environ["XLA_FLAGS"] = _with_device_count(
+            os.environ.get("XLA_FLAGS", ""), n_devices)
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
     jax.config.update("jax_platforms", "cpu")
+
+
+def cpu_child_env(env=None, n_devices: int | None = None) -> dict:
+    """CPU-pinned environment for a SUBPROCESS — the child-process
+    counterpart of :func:`force_cpu`, and the one sanctioned way for
+    tests/benches to set the virtual device count for a child (an
+    ad-hoc ``env["XLA_FLAGS"] += ...`` append silently duplicates the
+    flag when the parent already forced a count). Returns a copy."""
+    env = dict(os.environ if env is None else env)
+    if n_devices is not None:
+        env["XLA_FLAGS"] = _with_device_count(
+            env.get("XLA_FLAGS", ""), n_devices)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env

@@ -4685,7 +4685,223 @@ def _lora_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --obs: observability-overhead benchmark (CPU-runnable; --smoke is the
+# tier-1-sized variant). ONE child process measures tracing off vs on
+# over interleaved reps on the SAME warm engine — deliberately NOT
+# subprocess-per-config, because the claim under test is in-process:
+# arming per-request tracing on a warm engine must not retrace the
+# fixed-shape programs and must cost <=3% throughput; with tracing off
+# it must allocate NOTHING (structurally 0% — zero Span objects).
+# Gates ENFORCED via exit code -> BENCH_r19.json:
+#   tokens_per_sec off/on, traced_ratio >= 0.97, zero span allocations
+#   in the off reps, zero compiles in the traced reps, a sampled
+#   traced request's span tree covers submit->finish with no gaps,
+#   export_prometheus() output parses.
+# ---------------------------------------------------------------------------
+OBS_SMOKE = os.environ.get("BENCH_OBS_SMOKE", "") not in ("", "0")
+OBS_VOCAB, OBS_SMAX = 97, 64
+if OBS_SMOKE:
+    OBS_UNITS, OBS_LAYERS, OBS_HEADS = 32, 2, 4
+    OBS_REQS, OBS_MAX_NEW, OBS_REPS, OBS_SLOTS = 32, 16, 4, 4
+else:
+    OBS_UNITS, OBS_LAYERS, OBS_HEADS = 64, 4, 4
+    OBS_REQS, OBS_MAX_NEW, OBS_REPS, OBS_SLOTS = 64, 24, 4, 4
+OBS_RATIO_MIN = 0.97
+
+
+def _obs_span_ok(spans, max_new):
+    """A traced request's span tree must reconstruct the lifecycle
+    with no gaps: every stage present in causal order, one decode tick
+    per post-prefill token, one emit per token, chronological t0s."""
+    names = [s["name"] for s in spans]
+    if not names or names[0] != "request" or names[-1] != "finish":
+        return False
+    try:
+        idxs = [names.index(n) for n in
+                ("submit", "queue", "admission", "prefill", "decode",
+                 "evict", "finish")]
+    except ValueError:
+        return False
+    if idxs != sorted(idxs):
+        return False
+    if names.count("decode") != max_new - 1:   # prefill emits token 1
+        return False
+    if names.count("emit") != max_new:
+        return False
+    t0s = [s["t0"] for s in spans[1:]]
+    return t0s == sorted(t0s)
+
+
+def _obs_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry, tracing
+    from mxnet_tpu.gluon.model_zoo.gpt import gpt_small
+    from mxnet_tpu.serving.generate import GenerationEngine
+
+    telemetry.set_enabled(True)
+    tracing.set_enabled(False)   # per-request trace= arms explicitly
+    onp.random.seed(7)
+    mx.np.random.seed(7)
+    net = gpt_small(vocab_size=OBS_VOCAB, units=OBS_UNITS,
+                    num_layers=OBS_LAYERS, num_heads=OBS_HEADS,
+                    max_length=128)
+    net.initialize(mx.init.Xavier())
+    eng = GenerationEngine(net, max_slots=OBS_SLOTS,
+                           max_length=OBS_SMAX,
+                           max_new_tokens=OBS_MAX_NEW,
+                           queue_limit=OBS_REQS + 8)
+    rng = onp.random.RandomState(11)
+    prompts = [rng.randint(0, OBS_VOCAB, size=rng.randint(4, 13))
+               .astype("i4") for _ in range(OBS_REQS)]
+
+    def run_once(trace):
+        t0 = time.perf_counter()
+        streams = [eng.submit(p, max_new_tokens=OBS_MAX_NEW,
+                              trace=trace) for p in prompts]
+        toks = sum(len(s.result().tokens) for s in streams)
+        return toks / (time.perf_counter() - t0), streams
+
+    # warm-up: compile the whole bucket ladder outside the window
+    run_once(False)
+
+    best = {"off": 0.0, "on": 0.0}
+    spans_off_delta = 0
+    compiles_traced = 0
+    tree_ok = True
+    sample_tree = []
+    for _ in range(OBS_REPS):
+        a0 = tracing.spans_allocated()
+        tps, _streams = run_once(False)
+        spans_off_delta += tracing.spans_allocated() - a0
+        best["off"] = max(best["off"], tps)
+
+        c0 = telemetry.counter_value("model.gpt.trace") \
+            + telemetry.counter_value("ops.sampling.trace")
+        tps, streams = run_once(True)
+        compiles_traced += (telemetry.counter_value("model.gpt.trace")
+                            + telemetry.counter_value(
+                                "ops.sampling.trace")) - c0
+        best["on"] = max(best["on"], tps)
+        sample_tree = streams[0].trace()
+        tree_ok = tree_ok and all(
+            _obs_span_ok(s.trace(), OBS_MAX_NEW) for s in streams)
+    eng.close()
+
+    prom = telemetry.export_prometheus()
+    prom_lines = 0
+    prom_ok = bool(prom)
+    try:
+        for line in prom.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            _name, val = line.rsplit(" ", 1)
+            float(val)
+            prom_lines += 1
+    except ValueError:
+        prom_ok = False
+
+    print(json.dumps({
+        "tokens_per_sec_off": round(best["off"], 2),
+        "tokens_per_sec_on": round(best["on"], 2),
+        "spans_off_delta": int(spans_off_delta),
+        "compiles_traced_window": int(compiles_traced),
+        "span_tree_ok": bool(tree_ok),
+        "span_tree_sample": [s["name"] for s in sample_tree],
+        "prometheus_ok": prom_ok,
+        "prometheus_lines": int(prom_lines),
+        "requests_per_rep": OBS_REQS,
+        "reps": OBS_REPS,
+        # the CHILD's actual sizing (smoke and full differ; the parent
+        # may not share the child's BENCH_OBS_SMOKE env)
+        "model": f"gpt {OBS_LAYERS}L-{OBS_UNITS}u-{OBS_HEADS}h "
+                 f"vocab={OBS_VOCAB} s_max={OBS_SMAX}",
+        "workload": f"flood-submitted, {OBS_REQS} greedy requests x "
+                    f"{OBS_MAX_NEW} tokens, {OBS_SLOTS} slots, "
+                    f"best-of-{OBS_REPS} interleaved off/on reps on "
+                    f"one warm engine (prompts 4-12, seed 11)",
+    }), flush=True)
+    return 0
+
+
+def _obs_check_schema(doc):
+    """BENCH_r19.json contract (spec for the shared _check_schema)."""
+    return _check_schema(
+        "BENCH_r19", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "run": dict, "traced_ratio": float,
+            "traced_overhead_le_3pct": bool,
+            "zero_spans_when_disabled": bool,
+            "zero_compiles_traced": bool,
+            "span_tree_ok": bool, "prometheus_ok": bool,
+        },
+        nested={"run": ("tokens_per_sec_off", "tokens_per_sec_on",
+                        "spans_off_delta", "compiles_traced_window",
+                        "span_tree_ok", "span_tree_sample",
+                        "prometheus_ok", "prometheus_lines")},
+        gates=[("the sampled span tree must open with the request root",
+                lambda d: d["run"]["span_tree_sample"][:1]
+                == ["request"]),
+               ("exporter must have emitted samples",
+                lambda d: d["run"]["prometheus_lines"] > 0)])
+
+
+def _obs_main():
+    if os.environ.get("BENCH_OBS_CONFIG"):
+        return _obs_child()
+    smoke = OBS_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_OBS_SMOKE": "1"} if smoke else {}
+    _stage("obs: off/on interleaved run")
+    r = _ab_child("--obs", dict(env, BENCH_OBS_CONFIG="run"),
+                  label="obs run")
+    if r is None:
+        return 1
+    ratio = round(r["tokens_per_sec_on"]
+                  / max(r["tokens_per_sec_off"], 1e-9), 4)
+    doc = _obs_check_schema({
+        "metric": "obs_traced_tokens_per_sec",
+        "value": float(r["tokens_per_sec_on"]),
+        "unit": "generated tokens/sec with every request traced",
+        "model": r.get("model", "gpt"),
+        "smoke": bool(smoke),
+        "workload": r.get("workload", ""),
+        "run": r,
+        "traced_ratio": float(ratio),
+        "traced_overhead_le_3pct": bool(ratio >= OBS_RATIO_MIN),
+        "zero_spans_when_disabled": bool(r["spans_off_delta"] == 0),
+        "zero_compiles_traced":
+            bool(r["compiles_traced_window"] == 0),
+        "span_tree_ok": bool(r["span_tree_ok"]),
+        "prometheus_ok": bool(r["prometheus_ok"]),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_OBS_OUT",
+                                           "BENCH_r19.json"))
+    if not smoke or "BENCH_OBS_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("traced_overhead_le_3pct", doc["traced_overhead_le_3pct"]),
+        ("zero_spans_when_disabled", doc["zero_spans_when_disabled"]),
+        ("zero_compiles_traced", doc["zero_compiles_traced"]),
+        ("span_tree_ok", doc["span_tree_ok"]),
+        ("prometheus_ok", doc["prometheus_ok"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] obs gates failed: {', '.join(failed)} "
+              f"(traced_ratio={ratio})", file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--obs" in sys.argv:
+        return _obs_main()
     if "--lora" in sys.argv:
         return _lora_main()
     if "--shard" in sys.argv:

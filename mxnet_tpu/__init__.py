@@ -81,8 +81,8 @@ for _mod in ("initializer", "init", "optimizer", "lr_scheduler", "gluon",
              "rtc", "contrib", "library", "visualization", "operator",
              "model", "callback", "name", "attribute", "registry",
              "error", "log", "misc", "dlpack", "executor", "telemetry",
-             "monitor", "bucketing", "compile_cache", "serving",
-             "checkpoint", "resilience"):
+             "tracing", "monitor", "bucketing", "compile_cache",
+             "serving", "checkpoint", "resilience"):
     try:
         globals()[_mod] = _importlib.import_module(f".{_mod}", __name__)
     except ModuleNotFoundError as _e:

@@ -83,7 +83,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ... import autograd, telemetry
+from ... import autograd, telemetry, tracing
 from ...ndarray.ndarray import NDArray
 from ...ops import attention as _att
 from ...ops import lora as _lora
@@ -982,6 +982,7 @@ class GPTModel(HybridBlock):
             def wrapper(key, param_datas, quant_tabs, lora_tabs,
                         lora_idx, *args):
                 telemetry.counter("model.gpt.trace")
+                tracing.flight.record("compile", what="model.gpt")
                 saved = [nd._data for nd in param_nds]
                 saved_q = [blk._qbind for blk in blocks]
                 saved_l = [blk._lbind for blk in blocks]

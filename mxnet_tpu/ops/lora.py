@@ -36,7 +36,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from .. import telemetry
+from .. import telemetry, tracing
 
 __all__ = ["init_bank", "set_slot", "clear_slot", "apply",
            "bank_bytes"]
@@ -117,6 +117,7 @@ def apply(y, x, bank, idx):
     base path (int8 engines keep the delta fp32 over the dequant
     base)."""
     telemetry.counter("ops.lora.trace")  # trace-time only
+    tracing.flight.record("compile", what="ops.lora")
     idx = jnp.asarray(idx, jnp.int32)
     a = bank["A"][idx]                          # (B, d_in, r)
     b = bank["B"][idx]                          # (B, r, d_out)

@@ -86,15 +86,41 @@ def dumps(reset=False, format="table", sort_by="total", ascending=False,
     ``reset=True`` clears the registry after rendering. Without
     aggregate stats, returns the Xprof trace location (the timeline
     lives in TensorBoard/Perfetto, not in a string).
+
+    When per-request tracing has produced finished traces
+    (``MXTPU_TRACING=1`` / ``submit(trace=True)``), the report grows a
+    spans section: the JSON document gains a ``"spans"`` key holding
+    ``tracing.recent_traces()``, the table gains a "Recent request
+    traces" listing.
     """
     if aggregate_stats is None:
         aggregate_stats = _config.get("aggregate_stats", False)
     if not aggregate_stats:
         return f"profiler traces under {_state['dir']}" \
             if _state["dir"] else ""
-    return telemetry.render(format=format, sort_by=sort_by,
-                            ascending=ascending, trace_dir=_state["dir"],
-                            reset_after=reset)
+    out = telemetry.render(format=format, sort_by=sort_by,
+                           ascending=ascending, trace_dir=_state["dir"],
+                           reset_after=reset)
+    from . import tracing
+    traces = tracing.recent_traces()
+    if not traces:
+        return out
+    if format == "json":
+        import json as _json
+        doc = _json.loads(out)
+        doc["spans"] = traces
+        return _json.dumps(doc, indent=2)
+    lines = [out, "", "Recent request traces", "====================="]
+    for t in traces:
+        dropped = f", {t['dropped']} dropped" if t["dropped"] else ""
+        lines.append(f"{t['trace_id']}  ({len(t['spans'])} spans"
+                     f"{dropped})")
+        for s in t["spans"]:
+            attrs = s.get("attrs") or {}
+            a = " ".join(f"{k}={v}" for k, v in attrs.items())
+            lines.append(f"  {s['t0']:10.3f}ms  {s['dur']:9.3f}ms  "
+                         f"{s['name']}{'  ' + a if a else ''}")
+    return "\n".join(lines)
 
 
 def pause(profile_process="worker"):

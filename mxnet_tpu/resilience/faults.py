@@ -47,7 +47,7 @@ import signal
 import threading
 import time
 
-from .. import telemetry
+from .. import telemetry, tracing
 
 __all__ = ["TrainFaultInjector", "TrainFaultRule", "InjectedTrainingFault"]
 
@@ -210,6 +210,8 @@ class TrainFaultInjector:
         ``step`` (1-based), inside the hang watchdog's armed window.
         May sleep, signal, or raise."""
         for rule in self._match(_STEP_KINDS, step=step):
+            tracing.flight.record("fault.train", fault=rule.kind,
+                                  step=step)
             if rule.kind == "slow":
                 telemetry.counter("resilience.faults.slow")
                 # chunked so an async abort (hang watchdog) lands at a
@@ -237,6 +239,7 @@ class TrainFaultInjector:
         if not fired:
             return False
         telemetry.counter("resilience.faults.nan_batches")
+        tracing.flight.record("fault.nan_batch", batch=batch_idx)
         for arr in arrays:
             arr[:] = float("nan")
         return True
@@ -248,6 +251,7 @@ class TrainFaultInjector:
         if not fired:
             return False
         telemetry.counter("resilience.faults.nan_grads")
+        tracing.flight.record("fault.nan_grad", batch=batch_idx)
         for p in params:
             if p.grad_req != "null" and p._data is not None and \
                     p._data._grad is not None:

@@ -40,7 +40,7 @@ import threading
 import time
 
 from .. import checkpoint as _ckpt
-from .. import telemetry
+from .. import telemetry, tracing
 from .watchdog import DivergenceWatchdog, HangWatchdog, StepHangError, \
     DivergenceError
 
@@ -310,6 +310,9 @@ class TrainSupervisor:
     # -- rewind ---------------------------------------------------------
     def _rewind(self, step_no: int, batch_idx: int):
         telemetry.counter("resilience.rewinds")
+        tracing.flight.record("train.rewind", step=step_no,
+                              batch=batch_idx,
+                              consecutive=self._consec_rewinds + 1)
         self._counts["rewinds"] += 1
         self._consec_rewinds += 1
         if self._consec_rewinds > self.max_consecutive_rewinds:
@@ -328,6 +331,8 @@ class TrainSupervisor:
     # -- preemption flush ----------------------------------------------
     def _flush_preempt(self):
         telemetry.counter("resilience.preemptions")
+        tracing.flight.record("train.preempt", step=self._step,
+                              signum=self._preempt_signum)
         self._counts["preemptions"] += 1
         self._save(self._step, sync=True)
 
@@ -360,6 +365,9 @@ class TrainSupervisor:
                         loss_host, params=self._param_datas(),
                         amp_overflow=amp_overflow):
                     telemetry.counter("resilience.watchdog.trips")
+                    tracing.flight.record("train.watchdog_trip",
+                                          step=step_no, batch=batch_idx,
+                                          loss=loss_host)
                     self._rewind(step_no, batch_idx)
                     continue
                 self._step = step_no
@@ -426,10 +434,18 @@ class TrainSupervisor:
                     telemetry.counter("resilience.restarts")
                     self._counts["restarts"] += 1
                     if restarts > self.max_restarts:
+                        tracing.flight.dump(
+                            "train.abort", step=self._step,
+                            restarts=restarts,
+                            error=f"{type(e).__name__}: {e}")
                         raise TrainingAborted(
                             f"restart budget ({self.max_restarts}) "
                             f"exhausted; last failure: "
                             f"{type(e).__name__}: {e}") from e
+                    tracing.flight.dump(
+                        "train.restart", step=self._step,
+                        restart=restarts,
+                        error=f"{type(e).__name__}: {e}")
                     time.sleep(self.restart_backoff_s
                                * (2 ** (restarts - 1)))
                     self._restore_latest()

@@ -31,7 +31,7 @@ import random
 import threading
 import time
 
-from .. import telemetry
+from .. import telemetry, tracing
 
 __all__ = ["FaultInjector", "FaultRule", "InjectedFault"]
 
@@ -143,11 +143,14 @@ class FaultInjector:
                     error = True
         if sleep_ms:
             telemetry.counter("serving.faults.stalls")
+            tracing.flight.record("fault.stall", replica=replica_idx,
+                                  sleep_ms=sleep_ms)
             time.sleep(sleep_ms / 1e3)
         if crash:
             self.crash(engine)
         if error:
             telemetry.counter("serving.faults.errors")
+            tracing.flight.record("fault.error", replica=replica_idx)
             raise InjectedFault(
                 f"injected dispatch error on replica {replica_idx}")
 
@@ -159,6 +162,7 @@ class FaultInjector:
         when it has one, so the kill lands at a decode-step boundary —
         deterministic, never mid-XLA-dispatch."""
         telemetry.counter("serving.faults.crashes")
+        tracing.flight.record("fault.crash")
         exc = InjectedFault("injected replica crash")
         exclusive = getattr(engine, "_gen_exclusive", None)
         if exclusive is not None:

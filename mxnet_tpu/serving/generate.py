@@ -104,7 +104,7 @@ import weakref
 
 import numpy as onp
 
-from .. import telemetry
+from .. import telemetry, tracing
 from ..random_state import request_key
 from .._bounded_worker import BoundedQueueWorker
 from ..bucketing import BucketingPolicy, as_policy
@@ -157,6 +157,9 @@ class GenerationStream:
         #: consumer thread racing the stream (bench.py --generate).
         self.first_token_at = None
         self.done_at = None
+        #: the request's tracing.Trace, or None (tracing off for this
+        #: request — the near-zero disabled path)
+        self._trace = None
 
     # -- producer side (generator thread) ------------------------------
     def _emit(self, token: int):
@@ -181,6 +184,9 @@ class GenerationStream:
                 self.first_token_at = time.perf_counter()
             toks = [int(t) for t in tokens]
             self._tokens.extend(toks)
+            if self._trace is not None:
+                self._trace.event("emit", n=len(toks),
+                                  total=len(self._tokens))
             self._cv.notify_all()
             for on_token, _fin in self._watchers:
                 for tok in toks:
@@ -193,6 +199,8 @@ class GenerationStream:
             self._reason = reason
             self._exc = exc
             self.done_at = time.perf_counter()
+            if self._trace is not None:
+                self._trace.finish(reason=reason, error=exc)
             self._cv.notify_all()
             watchers, self._watchers = self._watchers, []
             for _tok, on_finish in watchers:
@@ -217,6 +225,19 @@ class GenerationStream:
     def done(self) -> bool:
         with self._cv:
             return self._reason is not None or self._exc is not None
+
+    @property
+    def trace_id(self):
+        """The request's trace id, or None when untraced."""
+        return None if self._trace is None else self._trace.trace_id
+
+    def trace(self):
+        """The request's recorded spans (list of dicts — see
+        ``tracing.Span``), or None when the request was not traced
+        (tracing disabled and no ``submit(trace=True)``). Available
+        live (spans so far) and after completion (the full
+        queue→admission→prefill→decode→emit→finish lifecycle)."""
+        return None if self._trace is None else self._trace.spans()
 
     @property
     def tokens(self):
@@ -1166,6 +1187,8 @@ class GenerationEngine:
             def counted(fn):
                 def wrapper(*args):
                     telemetry.counter("ops.sampling.trace")
+                    tracing.flight.record("compile",
+                                          what="ops.sampling")
                     return fn(*args)
                 return wrapper
 
@@ -1571,7 +1594,8 @@ class GenerationEngine:
 
     def submit(self, prompt, max_new_tokens=None, eos_id=None,
                timeout_ms=None, temperature=None, top_k=None,
-               top_p=None, seed=None, adapter=None) -> GenerationStream:
+               top_p=None, seed=None, adapter=None,
+               trace=None) -> GenerationStream:
         """Queue one prompt; returns a :class:`GenerationStream`.
         Raises :class:`EngineClosedError` / :class:`QueueFullError` /
         ``ValueError`` immediately instead of returning a stream that
@@ -1590,7 +1614,14 @@ class GenerationEngine:
         request decodes under — per-slot runtime data, so any tenant
         mix shares the one compiled program; the adapter stays PINNED
         (unload defers) until the request finishes. Default: the base
-        model."""
+        model.
+
+        ``trace`` arms per-request tracing: ``True`` records the
+        request's full lifecycle as spans readable via the stream's
+        ``trace()``; ``False`` disables it even under
+        ``MXTPU_TRACING=1``; ``None`` (default) follows the module
+        flag; a ``tracing.Trace`` instance threads an existing trace
+        through (the Router's cross-replica retries)."""
         if self._failure is not None:
             telemetry.counter("serving.generate.rejected_closed")
             raise ReplicaFailedError(str(self._failure),
@@ -1618,6 +1649,11 @@ class GenerationEngine:
             telemetry.counter("serving.generate.lora.requests")
         telemetry.counter("serving.generate.requests")
         stream = GenerationStream(int(prompt.size))
+        tr = tracing.start_trace(trace)
+        if tr is not None:
+            stream._trace = tr
+            tr.event("submit", prompt_len=int(prompt.size),
+                     max_new=max_new)
         if adapter is not None:
             # every stream finishes exactly once on every engine path
             # (the no-hung-stream contract) — the finish callback is
@@ -1699,6 +1735,9 @@ class GenerationEngine:
                 if not self._try_admit_paged(r):
                     break
                 telemetry.hist("serving.generate.queue_wait", waited_ms)
+                if r.stream._trace is not None:
+                    r.stream._trace.add_ms("queue", waited_ms,
+                                           blocked=True)
                 self._blocked.popleft()
         while self._n_active < self.max_slots \
                 and not (self.paged and self._blocked):
@@ -1742,16 +1781,28 @@ class GenerationEngine:
             if self._try_admit_paged(r):
                 telemetry.hist("serving.generate.queue_wait",
                                waited_ms)
+                if r.stream._trace is not None:
+                    r.stream._trace.add_ms("queue", waited_ms)
             else:
+                if r.stream._trace is not None:
+                    r.stream._trace.event("deferred", why="kv_pages")
                 self._blocked.append(r)
             return
         telemetry.hist("serving.generate.queue_wait", waited_ms)
+        tr = r.stream._trace
+        if tr is not None:
+            tr.add_ms("queue", waited_ms)
         slot = self._slots.index(None)
         n = int(r.prompt.size)
+        if tr is not None:
+            tr.event("admission", slot=slot, mode="dense")
+        tracing.flight.record("gen.admit", slot=slot, mode="dense",
+                              trace_id=r.stream.trace_id)
         sb = self.policy.bucket(n)
         padded = onp.zeros((1, sb), "i4")
         padded[0, :n] = r.prompt
         self._arm_sampling(slot, r)
+        pt0 = time.perf_counter() if tr is not None else 0.0
         t0 = telemetry.clock()
         logits, self._cache = self.model.prefill(
             padded, onp.asarray([n], "i4"), self._cache,
@@ -1769,6 +1820,8 @@ class GenerationEngine:
             self._draft_cache = self._recommit_draft(self._draft_cache)
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefills")
+        if tr is not None:
+            tr.add("prefill", pt0, slot=slot, tokens=n)
         tok = self._pick_first(slot, onp.asarray(logits)[0])
         s = _Slot(r.stream, tok, r.max_new - 1, r.eos_id, r.deadline,
                   n_ctx=n)
@@ -1892,6 +1945,13 @@ class GenerationEngine:
             self._release_pages(refs)
             return False
         slot = self._slots.index(None)
+        tr = r.stream._trace
+        if tr is not None:
+            tr.event("admission", slot=slot, mode="paged", peek=peek,
+                     prefix_tokens=shared_tokens)
+        tracing.flight.record("gen.admit", slot=slot, mode="paged",
+                              peek=peek, prefix_tokens=shared_tokens,
+                              trace_id=r.stream.trace_id)
         row = onp.zeros((self._p_max,), "i4")   # scrap past the cap
         for i in range(n_shared):
             row[i] = shared_pages[i]
@@ -1921,6 +1981,7 @@ class GenerationEngine:
             telemetry.counter("serving.generate.prefix_hits")
             self._slots[slot] = s
             self._n_active += 1
+            pt0 = time.perf_counter() if tr is not None else 0.0
             t0 = telemetry.clock()
             self._cache = self._recommit(self.model.bind_slot_paged(
                 slot, row, length, self._cache))
@@ -1929,6 +1990,9 @@ class GenerationEngine:
                 **self._akw(self._adapter_idx[slot:slot + 1]))
             telemetry.hist_since("serving.generate.prefill", t0)
             telemetry.counter("serving.generate.prefills")
+            if tr is not None:
+                tr.add("prefill", pt0, slot=slot, tokens=length,
+                       peek=True)
             self._register_prefix(s)
             self._first_token(slot, s, onp.asarray(logits))
             return True
@@ -2042,6 +2106,8 @@ class GenerationEngine:
                 "request deadline expired during chunked prefill"))
             return 0
         toks, start, n_valid, fresh = s.chunks.popleft()
+        tr = s.stream._trace
+        pt0 = time.perf_counter() if tr is not None else 0.0
         t0 = telemetry.clock()
         logits, self._cache = self.model.prefill_paged(
             toks, n_valid, best, s.row, self._cache, start=start,
@@ -2050,6 +2116,9 @@ class GenerationEngine:
         self._cache = self._recommit(self._cache)
         telemetry.hist_since("serving.generate.prefill", t0)
         telemetry.counter("serving.generate.prefill_chunks")
+        if tr is not None:
+            tr.add("prefill_chunk", pt0, slot=best, start=start,
+                   tokens=n_valid)
         self._chunks_this_iter += 1
         if not s.chunks:
             telemetry.counter("serving.generate.prefills")
@@ -2066,6 +2135,8 @@ class GenerationEngine:
             if s is not None and s.state == "decode" \
                     and s.cow_pending is not None:
                 src, dst, logical = s.cow_pending
+                tr = s.stream._trace
+                pt0 = time.perf_counter() if tr is not None else 0.0
                 self._cache = self._recommit(self.model.copy_page_paged(
                     src, dst, self._cache))
                 s.row[logical] = dst
@@ -2075,6 +2146,8 @@ class GenerationEngine:
                 s.page_refs.remove(src)
                 s.cow_pending = None
                 telemetry.counter("serving.generate.pages.cow_copies")
+                if tr is not None:
+                    tr.add("cow_copy", pt0, slot=i, src=src, dst=dst)
 
     def _pick_step_tokens(self, logits):
         """Per-slot next tokens from a decode step's raw (B, V)
@@ -2107,10 +2180,14 @@ class GenerationEngine:
         self._cow_sweep()
         toks = onp.zeros((self.max_slots,), "i4")
         active = onp.zeros((self.max_slots,), "i4")
+        any_trace = False
         for i, s in enumerate(self._slots):
             if s is not None and s.state == "decode":
                 toks[i] = s.last
                 active[i] = 1
+                if s.stream._trace is not None:
+                    any_trace = True
+        tt0 = time.perf_counter() if any_trace else 0.0
         t0 = telemetry.clock()
         logits, self._cache = self.model.decode_step_paged(
             toks, active, self._cache,
@@ -2128,6 +2205,8 @@ class GenerationEngine:
             s.last = tok
             s.left -= 1
             s.n_ctx += 1
+            if s.stream._trace is not None:
+                s.stream._trace.add("decode", tt0, slot=i, token=tok)
             s.stream._emit(tok)
             n_emitted += 1
             if s.eos_id is not None and tok == s.eos_id:
@@ -2145,7 +2224,14 @@ class GenerationEngine:
         """Reject a slot whose stream has delivered nothing yet (a
         prefill-phase deadline): an exception, not a truncated
         result."""
-        self._slots[slot].stream._finish(exc=exc)
+        s = self._slots[slot]
+        if s.stream._trace is not None:
+            s.stream._trace.event("evict", slot=slot,
+                                  error=f"{type(exc).__name__}: {exc}")
+        tracing.flight.record("gen.evict", slot=slot,
+                              error=type(exc).__name__,
+                              trace_id=s.stream.trace_id)
+        s.stream._finish(exc=exc)
         self._free_slot(slot)
 
     def _release_slot_refs(self, s):
@@ -2195,9 +2281,13 @@ class GenerationEngine:
             self._spec_tick()
             return
         toks = onp.zeros((self.max_slots,), "i4")
+        any_trace = False
         for i, s in enumerate(self._slots):
             if s is not None:
                 toks[i] = s.last
+                if s.stream._trace is not None:
+                    any_trace = True
+        tt0 = time.perf_counter() if any_trace else 0.0
         t0 = telemetry.clock()
         logits, self._cache = self.model.decode_step(
             toks, self._cache, **self._akw(self._adapter_idx))
@@ -2215,6 +2305,8 @@ class GenerationEngine:
             s.last = tok
             s.left -= 1
             s.n_ctx += 1
+            if s.stream._trace is not None:
+                s.stream._trace.add("decode", tt0, slot=i, token=tok)
             s.stream._emit(tok)
             n_emitted += 1
             if s.eos_id is not None and tok == s.eos_id:
@@ -2253,9 +2345,13 @@ class GenerationEngine:
         b = self.max_slots
         toks = onp.zeros((b,), "i4")
         active = onp.zeros((b,), "i4")
+        any_trace = False
         for i in idxs:
             toks[i] = self._slots[i].last
             active[i] = 1
+            if self._slots[i].stream._trace is not None:
+                any_trace = True
+        tt0 = time.perf_counter() if any_trace else 0.0
         sampled = bool(self._n_sampling)
         t0 = telemetry.clock()
         # three dispatches + one host sync per iteration: the fused
@@ -2326,6 +2422,9 @@ class GenerationEngine:
         for i in idxs:
             s = self._slots[i]
             out, m = emits[i]
+            if s.stream._trace is not None:
+                s.stream._trace.add("verify", tt0, slot=i, proposed=k,
+                                    committed=len(out))
             s.stream._emit_many(out)
             n_emitted += len(out)
             if not out:   # can only mean an exhausted slot the evict
@@ -2349,7 +2448,12 @@ class GenerationEngine:
         telemetry.gauge("serving.generate.slots", self._n_active)
 
     def _evict(self, slot: int, reason: str):
-        self._slots[slot].stream._finish(reason=reason)
+        s = self._slots[slot]
+        if s.stream._trace is not None:
+            s.stream._trace.event("evict", slot=slot, reason=reason)
+        tracing.flight.record("gen.evict", slot=slot, reason=reason,
+                              trace_id=s.stream.trace_id)
+        s.stream._finish(reason=reason)
         self._free_slot(slot)
 
     def _close_active(self, reason: str):
@@ -2401,6 +2505,8 @@ class GenerationEngine:
             failure.__cause__ = exc
         self._failure = failure
         self._closed = True
+        tracing.flight.dump("engine.fail_all",
+                            error=f"{type(exc).__name__}: {exc}")
         for i, s in enumerate(self._slots):
             if s is not None:
                 s.stream._finish(exc=failure)

@@ -38,7 +38,7 @@ from __future__ import annotations
 import collections
 import hashlib
 
-from .. import telemetry
+from .. import telemetry, tracing
 
 __all__ = ["PagePool", "PrefixIndex"]
 
@@ -223,6 +223,8 @@ class PrefixIndex:
         if not self._records:
             return False
         _full, rec = self._records.popitem(last=False)
+        tracing.flight.record("paging.prefix_evict",
+                              pages=len(rec.pages), tokens=rec.length)
         for key in rec.keys:
             e = self._chain.get(key)
             if e is not None:

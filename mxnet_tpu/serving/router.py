@@ -75,7 +75,7 @@ import time
 import weakref
 from concurrent.futures import Future
 
-from .. import telemetry
+from .. import telemetry, tracing
 from .engine import (
     EngineClosedError, InferenceEngine, QueueFullError,
     ReplicaFailedError, RequestTimeoutError,
@@ -472,6 +472,8 @@ class Router:
                     rep.half_open_trial = 0
                     telemetry.counter(
                         "serving.router.breaker_half_opens")
+                    tracing.flight.record("router.breaker_half_open",
+                                          replica=rep.idx)
                 if rep.breaker == _HALF and rep.half_open_trial == 0:
                     if half is None:
                         half = rep
@@ -511,6 +513,7 @@ class Router:
     def _record_failure(self, rep: _Replica, exc):
         telemetry.counter("serving.router.replica_failures")
         now = time.monotonic()
+        opened = False
         with self._lock:
             rep.failures += 1
             rep.consec += 1
@@ -521,11 +524,20 @@ class Router:
                 rep.opened_at = now
                 rep.half_open_trial = 0
                 telemetry.counter("serving.router.breaker_opens")
+                opened = True
             elif rep.breaker == _CLOSED \
                     and rep.consec >= self.breaker_threshold:
                 rep.breaker = _OPEN
                 rep.opened_at = now
                 telemetry.counter("serving.router.breaker_opens")
+                opened = True
+        if opened:
+            # incident post-mortem — dumped OUTSIDE the router lock
+            # (the dump may write a file when MXTPU_FLIGHT_DIR is set)
+            tracing.flight.dump(
+                "router.breaker_open", replica=rep.idx,
+                consecutive_failures=rep.consec,
+                error=f"{type(exc).__name__}: {exc}")
 
     def _record_success(self, rep: _Replica):
         with self._lock:
@@ -538,6 +550,8 @@ class Router:
                 rep.breaker = _CLOSED
                 rep.half_open_trial = 0
                 telemetry.counter("serving.router.breaker_closes")
+                tracing.flight.record("router.breaker_close",
+                                      replica=rep.idx)
 
     def _record_timeout(self, rep: _Replica):
         # a deadline miss marks the replica DEGRADED (slow) but never
@@ -588,6 +602,8 @@ class Router:
                     rep.breaker = _HALF
                     rep.half_open_trial = 0
                     telemetry.counter("serving.router.breaker_half_opens")
+                    tracing.flight.record("router.breaker_half_open",
+                                          replica=rep.idx)
                 if rep.breaker == _CLOSED and not self._dead(rep) \
                         and not dead_now:
                     healthy += 1
@@ -746,7 +762,8 @@ class Router:
     def submit(self, *args, max_new_tokens=None, eos_id=None,
                timeout_ms=None, tenant: str = "default",
                priority: int = 0, prefix_key=None, temperature=None,
-               top_k=None, top_p=None, seed=None, adapter=None):
+               top_k=None, top_p=None, seed=None, adapter=None,
+               trace=None):
         """Queue one request on the fleet.
 
         Generation fleets take exactly one positional ``prompt`` and
@@ -771,6 +788,12 @@ class Router:
         the fleet's registries are compared at dispatch and a
         heterogeneous fleet is rejected, because a cross-replica
         retry must be able to re-bind the same adapter anywhere.
+        ``trace`` arms per-request tracing (generation fleets):
+        ``True`` forces a span trace for this request, ``False``
+        suppresses it, ``None`` defers to the ``MXTPU_TRACING``
+        process default. The ONE trace object follows the request
+        across replica retry hops, so ``stream.trace()`` reconstructs
+        the full fleet-level lifecycle including the hop.
         Raises :class:`EngineClosedError` / :class:`LoadShedError` /
         :class:`TenantQuotaError` / :class:`QueueFullError` /
         ``ValueError`` immediately, never via a hung stream."""
@@ -803,6 +826,12 @@ class Router:
             max_new = self._admit(tenant, priority, max_new,
                                   adapter=adapter)
             sink = RouterStream(int(prompt.size), tenant, priority)
+            tr = tracing.start_trace(trace, source="router",
+                                     tenant=tenant,
+                                     prompt_len=int(prompt.size),
+                                     max_new=max_new)
+            if tr is not None:
+                sink._trace = tr
             req = _Req(prompt, max_new, eos, deadline, tenant, priority,
                        self.max_retries, sink, telemetry.clock(),
                        prefix_key=prefix_key, sampling=sampling,
@@ -924,15 +953,24 @@ class Router:
                     f"no available replica in the fleet "
                     f"({len(self._replicas)} total: down, circuit-open, "
                     f"or already tried)"), inline)
+            tr = getattr(req.sink, "_trace", None)
             try:
                 if self._faults is not None:
                     self._faults.on_dispatch(rep.idx, rep.engine)
                 if self._mode == "generate":
                     akw = {} if req.adapter is None \
                         else {"adapter": req.adapter}
+                    if tr is not None:
+                        tr.event("dispatch", replica=rep.idx)
+                    # the ONE trace object rides along to the replica
+                    # engine (its spans accumulate under this request);
+                    # an untraced router request must also suppress any
+                    # process-default engine trace, so the replica
+                    # stream never grows a second, router-invisible one
                     attempt = rep.engine.submit(
                         req.payload, max_new_tokens=req.max_new,
                         eos_id=req.eos_id, timeout_ms=rem_ms,
+                        trace=tr if tr is not None else False,
                         **(req.sampling or {}), **akw)
                 else:
                     attempt = rep.engine.submit(*req.payload,
@@ -959,6 +997,13 @@ class Router:
                     req.retries_left -= 1
                     req.sink.retries += 1
                     telemetry.counter("serving.router.retries")
+                    if tr is not None:
+                        tr.event("retry", replica=rep.idx,
+                                 error=f"{type(e).__name__}: {e}")
+                    tracing.flight.record(
+                        "router.retry", replica=rep.idx,
+                        error=type(e).__name__,
+                        trace_id=None if tr is None else tr.trace_id)
                     exclude.add(rep.idx)
                     continue
                 return self._fail(req, e, inline)
@@ -1041,6 +1086,15 @@ class Router:
             req.retries_left -= 1
             req.sink.retries += 1
             telemetry.counter("serving.router.retries")
+            tr = getattr(req.sink, "_trace", None)
+            if tr is not None:
+                tr.event("retry", replica=rep.idx,
+                         error=f"{type(exc).__name__}: {exc}"
+                         if exc is not None else reason)
+            tracing.flight.record(
+                "router.retry", replica=rep.idx,
+                error=type(exc).__name__ if exc is not None else reason,
+                trace_id=None if tr is None else tr.trace_id)
             return self._dispatch(req, frozenset({rep.idx}))
         if reason is not None and self._mode == "generate":
             return self._finish_req(req, reason=reason)

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Pretty-print a flight-recorder dump file.
+
+The serving stack's flight recorder (mxnet_tpu/tracing.py) writes one
+JSON file per incident when ``MXTPU_FLIGHT_DIR`` is set — on engine
+``_fail_all``, Router breaker-open, and TrainSupervisor restart/abort.
+This renders the event timeline human-first: relative timestamps,
+the triggering event (always last) highlighted, one line per event.
+
+Usage:
+    python scripts/obs_dump.py DUMP.json [DUMP2.json ...]
+    python scripts/obs_dump.py --last DIR    # newest dump in DIR
+
+Pure stdlib — no mxnet_tpu import, so it runs anywhere the dump file
+landed (the incident box may not have the repo installed).
+"""
+import glob
+import json
+import os
+import sys
+import time
+
+
+def _fmt_fields(fields):
+    return "  ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+
+
+def render(doc):
+    events = doc.get("events", [])
+    dumped_at = doc.get("dumped_at")
+    lines = []
+    when = time.strftime("%Y-%m-%d %H:%M:%S",
+                         time.localtime(dumped_at)) \
+        if dumped_at else "?"
+    lines.append(f"flight dump · trigger={doc.get('trigger', '?')} "
+                 f"· {when} · {len(events)} events "
+                 f"(version {doc.get('version', '?')})")
+    lines.append("-" * 72)
+    t_end = events[-1]["ts"] if events else 0.0
+    for i, ev in enumerate(events):
+        fields = {k: v for k, v in ev.items()
+                  if k not in ("ts", "kind")}
+        rel = ev["ts"] - t_end
+        mark = ">>" if i == len(events) - 1 else "  "
+        lines.append(f"{mark} {rel:+10.3f}s  {ev['kind']:<24s} "
+                     f"{_fmt_fields(fields)}".rstrip())
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0 if argv else 2
+    if argv[0] == "--last":
+        if len(argv) != 2:
+            print("--last takes exactly one directory", file=sys.stderr)
+            return 2
+        dumps = sorted(glob.glob(os.path.join(argv[1], "flight-*.json")),
+                       key=os.path.getmtime)
+        if not dumps:
+            print(f"no flight-*.json under {argv[1]}", file=sys.stderr)
+            return 1
+        argv = dumps[-1:]
+    rc = 0
+    for i, path in enumerate(argv):
+        if i:
+            print()
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        print(f"== {path}")
+        print(render(doc))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,19 +1,26 @@
-"""Tier-1 guards on the telemetry fast paths: the disabled path must
-record NOTHING, and the enabled pure-counter path must stay in the
-single-digit-microsecond range (regressions here tax every engine op)."""
+"""Tier-1 guards on the observability fast paths: the disabled
+telemetry AND tracing paths must record NOTHING (no entries, no span
+objects allocated), the enabled pure-counter / span paths must stay in
+the single-digit-microsecond range (regressions here tax every engine
+op), and arming a trace must not compile anything beyond the untraced
+baseline (spans are host-side only)."""
 import time
 
+import numpy as onp
 import pytest
 
-from mxnet_tpu import telemetry
+from mxnet_tpu import telemetry, tracing
 
 
 @pytest.fixture(autouse=True)
 def _restore_state():
     prev = telemetry.enabled()
+    prev_tr = tracing.enabled()
     telemetry.reset()
     yield
     telemetry.set_enabled(prev)
+    tracing.set_enabled(prev_tr)
+    tracing.clear_recent()
     telemetry.reset()
 
 
@@ -26,8 +33,10 @@ def test_disabled_path_records_nothing():
     telemetry.hist("h", 1.5)
     telemetry.hist_since("h2", telemetry.clock())
     snap = telemetry.snapshot()
-    assert snap == {"durations": {}, "counters": {}, "gauges": {},
-                    "histograms": {}}
+    assert snap["version"] == telemetry.SNAPSHOT_VERSION
+    assert tuple(snap["hist_bounds"]) == telemetry.hist_bounds()
+    assert snap["durations"] == {} and snap["counters"] == {}
+    assert snap["gauges"] == {} and snap["histograms"] == {}
     assert telemetry.names() == []
     # clock() short-circuits too: no syscall, sentinel 0.0
     assert telemetry.clock() == 0.0
@@ -64,3 +73,76 @@ def test_enabled_disabled_roundtrip_keeps_data():
     telemetry.counter("kept", 100)   # ignored
     telemetry.set_enabled(True)
     assert telemetry.snapshot()["counters"]["kept"] == 3
+
+
+# -- tracing fast paths -------------------------------------------------
+
+def test_tracing_disabled_allocates_no_spans():
+    """The off path must be ``trace is None`` everywhere: not one Span
+    object constructed, not even the root span of a would-be trace."""
+    tracing.set_enabled(False)
+    a0 = tracing.spans_allocated()
+    assert tracing.start_trace(None) is None   # process default: off
+    assert tracing.start_trace(False) is None  # explicit off
+    assert tracing.spans_allocated() == a0
+
+
+def test_tracing_disabled_engine_run_allocates_no_spans():
+    from mxnet_tpu.gluon.model_zoo.gpt import gpt_small
+    from mxnet_tpu.serving.generate import GenerationEngine
+    tracing.set_enabled(False)
+    net = gpt_small(vocab_size=97, units=32, num_layers=2,
+                    num_heads=4, max_length=128)
+    net.initialize()
+    eng = GenerationEngine(net, max_slots=2, max_length=64)
+    try:
+        prompt = onp.arange(5, dtype="i4")
+        a0 = tracing.spans_allocated()
+        stream = eng.submit(prompt, max_new_tokens=4)
+        stream.result()
+        assert stream.trace() is None and stream.trace_id is None
+        assert tracing.spans_allocated() == a0
+    finally:
+        eng.close()
+
+
+def test_tracing_enabled_span_overhead_under_10us():
+    n = 20000
+    tr = tracing.Trace(max_spans=n + 16)
+    t0 = tr.clock()
+    tr.add("warm", t0)
+    t_start = time.perf_counter()
+    for _ in range(n):
+        tr.event("tick", slot=1)
+    per_span = (time.perf_counter() - t_start) / n
+    assert len(tr) == n + 2 and tr.dropped == 0
+    # budget: ~10µs/span (a perf_counter read + object + list append
+    # under a lock is ~1µs; 10µs leaves CI headroom without masking an
+    # accidental O(n) or I/O regression)
+    assert per_span < 10e-6, f"span path took {per_span * 1e6:.2f}µs"
+
+
+def test_traced_engine_run_compiles_nothing_extra():
+    """Arming a trace must not retrace the fixed-shape programs: the
+    compile counters stay FLAT between an untraced warm-up request and
+    a traced request on the same engine (spans record host-side only,
+    never inside a jitted closure)."""
+    from mxnet_tpu.gluon.model_zoo.gpt import gpt_small
+    from mxnet_tpu.serving.generate import GenerationEngine
+    telemetry.set_enabled(True)
+    net = gpt_small(vocab_size=97, units=32, num_layers=2,
+                    num_heads=4, max_length=128)
+    net.initialize()
+    eng = GenerationEngine(net, max_slots=2, max_length=64)
+    try:
+        prompt = onp.arange(5, dtype="i4")
+        eng.submit(prompt, max_new_tokens=4).result()   # warm
+        before = telemetry.counter_value("model.gpt.trace")
+        before_s = telemetry.counter_value("ops.sampling.trace")
+        stream = eng.submit(prompt, max_new_tokens=4, trace=True)
+        stream.result()
+        assert stream.trace() is not None   # the trace really armed
+        assert telemetry.counter_value("model.gpt.trace") == before
+        assert telemetry.counter_value("ops.sampling.trace") == before_s
+    finally:
+        eng.close()

@@ -4899,7 +4899,341 @@ def _obs_main():
     return 0
 
 
+# ---------------------------------------------------------------------------
+# --latency: multi-tick fused-decode + bf16-train benchmark
+# (CPU-runnable; --smoke is the tier-1-sized variant). Subprocess-
+# isolated configs, gates ENFORCED via exit code -> BENCH_r20.json:
+#
+#   k1 / k4 / k8 : the BENCH_r15 operating point (same tied-peaky
+#            damped target model, same seed-61 closed-loop workload,
+#            2 client threads x greedy requests with 24-40 token
+#            budgets, 8 slots) served with decode_ticks = 1 / 4 / 8.
+#            Per config: decode tokens/sec, host syncs and syncs per
+#            token (serving.generate.host_syncs — the tick's ONE
+#            device->host block), dispatch count (1 program launch
+#            per fused tick), and a lone-request phase gating the
+#            EXACT sync arithmetic: a single 25-token request costs
+#            ceil(24/k) decode syncs (token 1 rides the prefill
+#            sync). Gates: tokens/sec >= 1.15x k1 at k in {4, 8},
+#            greedy output token-identical across every config and
+#            rep (cross-subprocess sha256), dispatches == host_syncs,
+#            closed-loop syncs/token within 1.35x of the ideal
+#            spt(k1)/k, 0 in-window compiles.
+#   train_fp32 / train_bf16 : TrainStep steady-state step time on the
+#            same model shape (adam, LM loss), fp32 vs
+#            compute_dtype="bfloat16". REPORTED, not gated: this CPU
+#            box emulates bf16 (no native matmul win) — the ratio is
+#            plumbing evidence; the TPU win is the native-format
+#            matmul. The fp32/bf16 loss gap is reported alongside.
+# ---------------------------------------------------------------------------
+LAT_SMOKE = os.environ.get("BENCH_LAT_SMOKE", "") not in ("", "0")
+LAT_KS = (1, 4, 8)
+LAT_THR_MIN = 1.15           # tokens/sec over k1 at k >= 4 (the gate)
+LAT_SPT_SLACK = 1.35         # closed-loop syncs/token vs ideal 1/k
+LAT_CLIENTS = 2
+LAT_PER_CLIENT = 6 if LAT_SMOKE else 12
+LAT_REPS = 2 if LAT_SMOKE else 3
+LAT_LONE_NEW = 25            # lone-request phase token budget
+LAT_TRAIN_WARM = 3
+LAT_TRAIN_STEPS = 6 if LAT_SMOKE else 20
+LAT_TRAIN_BATCH, LAT_TRAIN_SEQ = 16, 16
+
+
+def _lat_workload():
+    """The BENCH_r15 seed-61 request list (prompts 4-12, budgets
+    24-40) at LAT_PER_CLIENT requests per client — decode-dominated
+    interactive traffic; smoke only cuts the request count."""
+    import numpy as onp
+    rng = onp.random.RandomState(61)
+    return [[(rng.randint(0, SPC_VOCAB,
+                          int(rng.randint(4, 13))).astype("i4"),
+              int(rng.randint(24, 41)))
+             for _ in range(LAT_PER_CLIENT)]
+            for _ in range(LAT_CLIENTS)]
+
+
+def _lat_decode_run(k):
+    """One decode config: the BENCH_r15 target served with
+    decode_ticks=k. A lone-request phase gates the exact sync
+    arithmetic before the closed-loop A/B window."""
+    import hashlib
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import GenerationEngine
+
+    target, _draft = _spc_models()
+    eng = GenerationEngine(target, max_slots=SPC_BASE_SLOTS,
+                           max_length=SPC_SMAX, queue_limit=64,
+                           decode_ticks=k).warmup()
+    work = _lat_workload()
+    # priming: both admission paths, outside every measured window
+    eng.generate(work[0][0][0], max_new_tokens=2, timeout=600)
+    eng.generate(work[0][1][0], max_new_tokens=2, timeout=600)
+
+    # lone-request sync arithmetic (the acceptance gate): N tokens ->
+    # ceil((N-1)/k) decode host syncs, first token on prefill's sync
+    telemetry.reset()
+    lone = eng.generate(work[0][0][0], max_new_tokens=LAT_LONE_NEW,
+                        timeout=600)
+    lone_snap = telemetry.snapshot()["counters"]
+    lone_syncs = int(lone_snap.get("serving.generate.host_syncs", 0))
+    lone_want = -(-(len(lone.tokens) - 1) // k)
+
+    telemetry.reset()
+    all_tokens = [None] * LAT_CLIENTS
+
+    def client(ci):
+        all_tokens[ci] = [
+            eng.generate(p, max_new_tokens=m, timeout=600).tokens
+            for p, m in work[ci]]
+
+    threads = [_BoxedThread(lambda ci=ci: client(ci),
+                            name=f"lat-client-{ci}")
+               for ci in range(LAT_CLIENTS)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join_or_raise(600)
+    wall = time.perf_counter() - t0
+    snap = telemetry.snapshot()
+    eng.close()
+    c = snap["counters"]
+    tokens = int(c.get("serving.generate.tokens", 0))
+    syncs = int(c.get("serving.generate.host_syncs", 0))
+    disp = int(c.get("serving.generate.dispatches", 0))
+    print(json.dumps({
+        "config": f"k{k}",
+        "decode_ticks": k,
+        "clients": LAT_CLIENTS,
+        "requests": LAT_CLIENTS * LAT_PER_CLIENT,
+        "slots": SPC_BASE_SLOTS,
+        "generated_tokens": tokens,
+        "tokens_per_sec": round(tokens / wall, 1),
+        "host_syncs": syncs,
+        "syncs_per_token": round(syncs / max(tokens, 1), 4),
+        "dispatches": disp,
+        "ticks_per_sync": int(
+            snap["gauges"]["serving.generate.ticks_per_sync"]
+            ["value"]),
+        "lone_request_tokens": len(lone.tokens),
+        "lone_host_syncs": lone_syncs,
+        "lone_want_syncs": lone_want,
+        "compiles_in_window":
+            int(c.get("model.gpt.trace", 0))
+            + int(c.get("gluon.cachedop.cache_miss", 0))
+            + int(c.get("ops.sampling.trace", 0)),
+        "tokens_digest": hashlib.sha256(json.dumps(
+            all_tokens).encode()).hexdigest(),
+    }), flush=True)
+    return 0
+
+
+def _lat_train_run(compute_dtype):
+    """One train config: steady-state TrainStep step time on the
+    BENCH_r15 model shape, fp32 masters either way."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu import np as mnp
+
+    class LmLoss:
+        def __call__(self, out, label):
+            return gluon.loss.SoftmaxCrossEntropyLoss()(
+                out.reshape(-1, out.shape[-1]), label.reshape(-1))
+
+    mx.np.random.seed(0)
+    from mxnet_tpu.gluon.model_zoo.gpt import GPTModel
+    net = GPTModel(vocab_size=SPC_VOCAB, units=SPC_TU,
+                   num_layers=SPC_TL, num_heads=SPC_HEADS,
+                   max_length=SPC_SMAX)
+    net.initialize(mx.init.Xavier())
+    rng = onp.random.RandomState(11)
+    x = rng.randint(0, SPC_VOCAB,
+                    (LAT_TRAIN_BATCH, LAT_TRAIN_SEQ + 1)).astype("i4")
+    data, label = mnp.array(x[:, :-1]), mnp.array(x[:, 1:])
+    step = parallel.TrainStep(net, LmLoss(), "adam",
+                              {"learning_rate": 1e-3},
+                              compute_dtype=compute_dtype)
+    losses = [float(step(data, label)) for _ in range(LAT_TRAIN_WARM)]
+    t0 = time.perf_counter()
+    losses += [float(step(data, label))
+               for _ in range(LAT_TRAIN_STEPS)]
+    dt = time.perf_counter() - t0
+    master_dtypes = sorted({str(p.data()._data.dtype)
+                            for p in net.collect_params().values()})
+    print(json.dumps({
+        "config": f"train_{'bf16' if compute_dtype else 'fp32'}",
+        "compute_dtype": compute_dtype or "float32",
+        "model": f"gpt {SPC_TL}L-{SPC_TU}u-{SPC_HEADS}h "
+                 f"vocab={SPC_VOCAB} "
+                 f"batch={LAT_TRAIN_BATCH}x{LAT_TRAIN_SEQ}",
+        "step_ms": round(dt / LAT_TRAIN_STEPS * 1e3, 3),
+        "steps_per_sec": round(LAT_TRAIN_STEPS / dt, 2),
+        "loss_first": round(losses[0], 6),
+        "loss_last": round(losses[-1], 6),
+        "master_dtypes": master_dtypes,
+    }), flush=True)
+    return 0
+
+
+def _lat_child():
+    import tpu_platform
+    tpu_platform.force_cpu(n_devices=8)
+    cfg = os.environ["BENCH_LAT_CONFIG"]
+    if cfg.startswith("train"):
+        return _lat_train_run("bfloat16" if cfg == "train_bf16"
+                              else None)
+    return _lat_decode_run(int(cfg[1:]))
+
+
+def _lat_check_schema(doc):
+    """BENCH_r20.json contract (spec for the shared _check_schema)."""
+    dec_keys = ("tokens_per_sec", "host_syncs", "syncs_per_token",
+                "dispatches", "ticks_per_sync", "lone_host_syncs",
+                "lone_want_syncs", "compiles_in_window",
+                "tokens_digest", "slots")
+    trn_keys = ("step_ms", "steps_per_sec", "loss_first", "loss_last",
+                "master_dtypes")
+    return _check_schema(
+        "BENCH_r20", doc,
+        required={
+            "metric": str, "value": float, "unit": str, "model": str,
+            "smoke": bool, "k1": dict, "k4": dict, "k8": dict,
+            "train_fp32": dict, "train_bf16": dict,
+            "throughput_ratio_k4": float,
+            "throughput_ratio_k8": float,
+            "bf16_step_time_ratio": float,
+            "token_identical": bool,
+            "sync_arithmetic_exact": bool,
+            "one_dispatch_per_sync": bool,
+            "sync_amortized": bool,
+            "zero_compiles_in_window": bool,
+            "throughput_ge_1_15x_k4": bool,
+            "throughput_ge_1_15x_k8": bool,
+        },
+        nested={"k1": dec_keys, "k4": dec_keys, "k8": dec_keys,
+                "train_fp32": trn_keys, "train_bf16": trn_keys},
+        gates=[("every config must serve the full workload",
+                lambda d: d["k1"]["generated_tokens"]
+                == d["k4"]["generated_tokens"]
+                == d["k8"]["generated_tokens"] > 0),
+               ("ticks_per_sync must equal the configured k",
+                lambda d: all(d[f"k{k}"]["ticks_per_sync"] == k
+                              for k in LAT_KS)),
+               ("bf16 masters must stay fp32",
+                lambda d: d["train_bf16"]["master_dtypes"]
+                == ["float32"])])
+
+
+def _latency_main():
+    if os.environ.get("BENCH_LAT_CONFIG"):
+        return _lat_child()
+    smoke = LAT_SMOKE or "--smoke" in sys.argv
+    env = {"BENCH_LAT_SMOKE": "1"} if smoke else {}
+    reps = 2 if smoke else LAT_REPS
+    per_client = 6 if smoke else 12  # mirror the child's smoke
+    # constants (the parent may run without BENCH_LAT_SMOKE in its
+    # own environment — only the doc strings need these)
+    results = {}
+    digests = set()
+    # interleaved best-of-N reps (the BENCH_r15 A/B discipline: this
+    # box's cpu-shares swing between windows; a degraded window
+    # landing on one config would invert the A/B)
+    for rep in range(reps):
+        for k in LAT_KS:
+            _stage(f"latency: k{k} (rep {rep + 1}/{reps})")
+            r = _ab_child("--latency",
+                          dict(env, BENCH_LAT_CONFIG=f"k{k}"),
+                          label=f"latency k{k} rep{rep}")
+            if r is None:
+                return 1
+            digests.add(r["tokens_digest"])
+            best = results.get(f"k{k}")
+            if best is None \
+                    or r["tokens_per_sec"] > best["tokens_per_sec"]:
+                results[f"k{k}"] = r
+    for cfg in ("train_fp32", "train_bf16"):
+        _stage(f"latency: {cfg}")
+        r = _ab_child("--latency", dict(env, BENCH_LAT_CONFIG=cfg),
+                      label=f"latency {cfg}")
+        if r is None:
+            return 1
+        results[cfg] = r
+    k1, k4, k8 = results["k1"], results["k4"], results["k8"]
+    thr4 = round(k4["tokens_per_sec"]
+                 / max(k1["tokens_per_sec"], 1e-9), 2)
+    thr8 = round(k8["tokens_per_sec"]
+                 / max(k1["tokens_per_sec"], 1e-9), 2)
+    bf_ratio = round(results["train_bf16"]["step_ms"]
+                     / max(results["train_fp32"]["step_ms"], 1e-9), 2)
+    spt1 = max(k1["syncs_per_token"], 1e-9)
+    doc = _lat_check_schema({
+        "metric": "multitick_decode_tokens_per_sec",
+        "value": float(k4["tokens_per_sec"]),
+        "unit": "greedy decode tokens/sec at decode_ticks=4 "
+                "(closed-loop interactive, BENCH_r15 operating "
+                "point)",
+        "model": f"gpt {SPC_TL}L-{SPC_TU}u-{SPC_HEADS}h "
+                 f"vocab={SPC_VOCAB} s_max={SPC_SMAX} tied-head "
+                 f"damp={SPC_DAMP}",
+        "smoke": bool(smoke),
+        "reps_best_of": reps,
+        "workload": f"closed loop, {LAT_CLIENTS} client threads x "
+                    f"{per_client} greedy requests (prompts "
+                    f"4-12, budgets 24-40, seed 61), "
+                    f"{SPC_BASE_SLOTS} slots",
+        "k1": k1, "k4": k4, "k8": k8,
+        "train_fp32": results["train_fp32"],
+        "train_bf16": results["train_bf16"],
+        "throughput_ratio_k4": thr4,
+        "throughput_ratio_k8": thr8,
+        # REPORTED, not gated: CPU emulates bf16 — the native-format
+        # matmul win is a TPU property (docs/PERFORMANCE.md)
+        "bf16_step_time_ratio": bf_ratio,
+        "token_identical": bool(len(digests) == 1),
+        "sync_arithmetic_exact": bool(all(
+            results[f"k{k}"]["lone_host_syncs"]
+            == results[f"k{k}"]["lone_want_syncs"]
+            for k in LAT_KS)),
+        "one_dispatch_per_sync": bool(all(
+            results[f"k{k}"]["dispatches"]
+            == results[f"k{k}"]["host_syncs"] for k in LAT_KS)),
+        "sync_amortized": bool(all(
+            results[f"k{k}"]["syncs_per_token"]
+            <= spt1 / k * LAT_SPT_SLACK for k in (4, 8))),
+        "zero_compiles_in_window": bool(all(
+            results[f"k{k}"]["compiles_in_window"] == 0
+            for k in LAT_KS)),
+        "throughput_ge_1_15x_k4": bool(thr4 >= LAT_THR_MIN),
+        "throughput_ge_1_15x_k8": bool(thr8 >= LAT_THR_MIN),
+    })
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.environ.get("BENCH_LAT_OUT",
+                                           "BENCH_r20.json"))
+    if not smoke or "BENCH_LAT_OUT" in os.environ:
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    print(json.dumps(doc))
+    failed = [g for g, ok in [
+        ("throughput_ge_1_15x_k4", doc["throughput_ge_1_15x_k4"]),
+        ("throughput_ge_1_15x_k8", doc["throughput_ge_1_15x_k8"]),
+        ("token_identical", doc["token_identical"]),
+        ("sync_arithmetic_exact", doc["sync_arithmetic_exact"]),
+        ("one_dispatch_per_sync", doc["one_dispatch_per_sync"]),
+        ("sync_amortized", doc["sync_amortized"]),
+        ("zero_compiles_in_window", doc["zero_compiles_in_window"]),
+    ] if not ok]
+    if failed:
+        print(f"[bench] latency gates failed: {', '.join(failed)} "
+              f"(ratio_k4={thr4} ratio_k8={thr8})",
+              file=sys.stderr, flush=True)
+        return 1
+    return 0
+
+
 def main():
+    if "--latency" in sys.argv:
+        return _latency_main()
     if "--obs" in sys.argv:
         return _obs_main()
     if "--lora" in sys.argv:

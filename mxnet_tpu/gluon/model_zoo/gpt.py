@@ -269,8 +269,8 @@ class GPTBlock(HybridBlock):
                                       k_scale=k_scale, v_scale=v_scale),
                 ctx=x.ctx)
             return self._finish(x, attn), kc, vc
-        kc = _cache_insert(k_cache, k._data, pos)
-        vc = _cache_insert(v_cache, v._data, pos)
+        kc = _cache_insert(k_cache, k._data.astype(k_cache.dtype), pos)
+        vc = _cache_insert(v_cache, v._data.astype(v_cache.dtype), pos)
         attn = NDArray(_att.decode_attention(q._data, kc, vc, att_len),
                        ctx=x.ctx)
         return self._finish(x, attn), kc, vc
@@ -299,8 +299,10 @@ class GPTBlock(HybridBlock):
             kf = kc.astype(jnp.float32) * k_scale[:, :, None, None]
             vf = vc.astype(jnp.float32) * v_scale[:, :, None, None]
         else:
-            kc = _cache_insert(k_cache, k._data, pos)
-            vc = _cache_insert(v_cache, v._data, pos)
+            kc = _cache_insert(k_cache, k._data.astype(k_cache.dtype),
+                               pos)
+            vc = _cache_insert(v_cache, v._data.astype(v_cache.dtype),
+                               pos)
             kf, vf = kc, vc
         attn = NDArray(_att.chunked_prefill_attention(
             q._data, kf.astype(q._data.dtype), vf.astype(q._data.dtype),
@@ -506,6 +508,14 @@ class GPTModel(HybridBlock):
         #: arguments (so a rollover re-quantize installs new values
         #: without retracing — the dense-engine swap discipline).
         self._quant = None
+        #: reduced-precision compute buffers (``cast_compute_params``):
+        #: a shadow list of the parameter buffers cast to bf16, passed
+        #: to the jitted closures as RUNTIME arguments in place of the
+        #: fp32 masters — a rollover re-cast installs new values with
+        #: zero retraces (the int8 quant-table discipline). The fp32
+        #: parameters stay the source of truth.
+        self._cast = None
+        self._cast_dtype = None
         #: batched-LoRA adapter banks (``arm_lora``): one dict per
         #: block, ``{proj_name: {"A", "B", "scale"} stacked bank}``
         #: (ops/lora.py), passed to the jitted closures as RUNTIME
@@ -597,6 +607,9 @@ class GPTModel(HybridBlock):
         # NOTE: self._lora survives too — adapter banks are tenant
         # state, not derived from the base parameters; a weight
         # rollover keeps the loaded adapters armed
+        # NOTE: self._cast survives for the same reason as _quant —
+        # an explicit cast_compute_params() refresh owns it (the
+        # engine re-casts under the load_weights swap lock)
 
     def quantize_params(self, include=_QUANTIZED_PROJECTIONS):
         """Arm (or refresh) weight-only int8 decode: quantize every
@@ -635,6 +648,69 @@ class GPTModel(HybridBlock):
             self._paged = None
             self._spec_jits = None
         return self
+
+    @property
+    def compute_dtype(self) -> str:
+        """The generation closures' parameter/activation compute dtype:
+        ``"float32"`` (default — the fp32 masters run as-is) or
+        ``"bfloat16"`` once :meth:`cast_compute_params` armed the
+        reduced-precision path."""
+        return self._cast_dtype or "float32"
+
+    def cast_compute_params(self, dtype="bfloat16"):
+        """Arm (or refresh) the reduced-precision compute path: cast
+        every floating parameter buffer to ``dtype`` into a shadow
+        list the generation closures consume IN PLACE of the fp32
+        masters, which remain the source of truth (training, plain
+        ``forward``, checkpoints and re-casts all read fp32).
+
+        The cast buffers are RUNTIME arguments of the jitted closures,
+        so calling this again after a weight swap
+        (``GenerationEngine.load_weights``) installs freshly-cast
+        values with ZERO retraces; the first call (or a dtype change)
+        invalidates the closures — cast before ``warmup()``.
+        ``cast_compute_params(None)`` disarms. Softmax and LayerNorm
+        still accumulate in fp32 (``ops.nn.accum_dtype``), attention
+        scores likewise, and every closure returns fp32 logits — the
+        host sampler/argmax contract is dtype-invariant. Composes
+        with an int8 KV cache (bf16 K/V quantize against the same
+        per-slot scales) and with weight-only int8 (quantized
+        projections dequantize to their own compute path; the
+        remaining fp32 parameters are what this casts)."""
+        if dtype is None:
+            if self._cast is not None:
+                self._cast = None
+                self._cast_dtype = None
+                self._gen = None
+                self._paged = None
+                self._spec_jits = None
+            return self
+        dt = jnp.zeros((), dtype).dtype   # canonicalize str/np/jnp
+        if dt not in (jnp.bfloat16, jnp.float16):
+            raise ValueError(
+                f"compute dtype {dtype!r} not supported (bfloat16 or "
+                f"float16)")
+        params = self._gen_params()
+        self._cast = [
+            p._data.astype(dt)
+            if jnp.issubdtype(p._data.dtype, jnp.floating) else p._data
+            for p in params]
+        fresh = self._cast_dtype != dt.name
+        self._cast_dtype = dt.name
+        if fresh:   # param avals changed: closures must retrace
+            self._gen = None
+            self._paged = None
+            self._spec_jits = None
+        return self
+
+    def _param_call_datas(self, param_nds):
+        """The parameter buffers a generation-closure CALL carries:
+        the bf16 shadow list when :meth:`cast_compute_params` is
+        armed, else the fp32 masters. One helper so every call site
+        (dense/paged/spec/multi/HLO) agrees."""
+        if self._cast is not None:
+            return self._cast
+        return [nd._data for nd in param_nds]
 
     def quantized_param_stats(self):
         """``(n_elements, bytes_saved)`` of the current quant tables
@@ -898,7 +974,7 @@ class GPTModel(HybridBlock):
             param_nds, jitfn = p["params"], p["decode"]
             args.append(_as_i32(active))
         lowered = jitfn.lower(next_key(),
-                              [nd._data for nd in param_nds],
+                              self._param_call_datas(param_nds),
                               *args, cache)
         return lowered.compile().as_text()
 
@@ -918,7 +994,7 @@ class GPTModel(HybridBlock):
         dt = jnp.zeros((b, int(k)), jnp.int32)
         ones = jnp.ones((b,), jnp.int32)
         lowered = jitted.lower(next_key(),
-                               [nd._data for nd in param_nds],
+                               self._param_call_datas(param_nds),
                                self._quant_arg(), self._lora_arg(),
                                self._lora_idx(adapters, b),
                                zb, dt, ones, cache)
@@ -1048,7 +1124,7 @@ class GPTModel(HybridBlock):
         if quant_kv:
             new_cache["k_scale"] = cache["k_scale"]
             new_cache["v_scale"] = cache["v_scale"]
-        return logits._data, new_cache
+        return logits._data.astype(jnp.float32), new_cache
 
     def _verify_body_paged(self, blocks, tokens, active, cache):
         """The paged k-token verify computation (shared by the
@@ -1104,11 +1180,18 @@ class GPTModel(HybridBlock):
         if quant_kv:
             new_cache["k_scale"] = tuple(kscs)
             new_cache["v_scale"] = tuple(vscs)
-        return logits._data, new_cache
+        return logits._data.astype(jnp.float32), new_cache
 
-    def _decode_body(self, blocks, tokens, cache):
+    def _decode_body(self, blocks, tokens, cache, live=None):
         """One decode step's computation (shared by the ``decode_step``
-        closure and the fused k-step ``propose_tokens`` loop)."""
+        closure, the fused k-step ``propose_tokens`` loop and the
+        multi-tick ``decode_multi`` scan). ``live`` (B,) bool, when
+        given, freezes dead rows IN-PROGRAM: their ``len`` stands
+        still, so their (unavoidable — fixed shape) cache write lands
+        at the frozen waterline, above which nothing is ever attended
+        (the speculative rejected-tail discipline); without it every
+        row advances (the classic single-step contract, where the
+        HOST masks dead rows by ignoring them)."""
         s_max = cache["k"][0].shape[2]
         quant_kv = cache["k"][0].dtype == jnp.int8
         ln = cache["len"]
@@ -1128,11 +1211,67 @@ class GPTModel(HybridBlock):
             ks.append(kc)
             vs.append(vc)
         logits = self.lm_head(self.ln_f(x))             # (B, 1, V)
-        new_cache = {"k": tuple(ks), "v": tuple(vs), "len": ln + 1}
+        new_len = ln + 1 if live is None \
+            else ln + live.astype(jnp.int32)
+        new_cache = {"k": tuple(ks), "v": tuple(vs), "len": new_len}
         if quant_kv:   # per-slot scales are fixed at prefill
             new_cache["k_scale"] = cache["k_scale"]
             new_cache["v_scale"] = cache["v_scale"]
-        return logits._data[:, 0, :], new_cache
+        return logits._data[:, 0, :].astype(jnp.float32), new_cache
+
+    def _decode_body_paged(self, blocks, tokens, active, cache):
+        """One PAGED decode step's computation (shared by the
+        ``decode_step_paged`` closure and the fused multi-tick
+        ``decode_multi_paged`` scan). ``active`` (B,) int32 masks
+        rows: an inactive row runs the same fixed-shape program but
+        its write is redirected into scrap page 0 and its ``len``
+        stands still — which is exactly how the multi-tick scan
+        freezes rows that hit eos/budget mid-scan."""
+        ps = cache["k"][0].shape[2]
+        s_max = cache["table"].shape[1] * ps
+        quant_kv = cache["k"][0].dtype == jnp.int8
+        ln = cache["len"]
+        b = ln.shape[0]
+        pos = jnp.minimum(ln, s_max - 1)
+        att_len = pos + 1
+        live = active > 0
+        # inactive rows write into scrap page 0 (their table rows
+        # may alias pages now owned by OTHER slots — a masked-out
+        # result is not enough, the write itself must be redirected)
+        page = jnp.where(
+            live, cache["table"][jnp.arange(b), pos // ps], 0)
+        offset = jnp.where(live, pos % ps, 0)
+        # the previous page (scale inheritance for a page whose
+        # first token this step writes); same scrap redirection
+        prev_page = jnp.where(
+            live,
+            cache["table"][jnp.arange(b),
+                           jnp.maximum(pos // ps - 1, 0)], 0)
+        emb = self.word_embed(NDArray(tokens))
+        pw = self.position_weight.data()._data
+        x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        ks, vs, kscs, vscs = [], [], [], []
+        for li, blk in enumerate(blocks):
+            x, kp, vp, ksp, vsp = blk.decode_paged(
+                x, cache["k"][li], cache["v"][li], cache["table"],
+                page, offset, att_len,
+                k_scale=cache["k_scale"][li] if quant_kv else None,
+                v_scale=cache["v_scale"][li] if quant_kv else None,
+                prev_page=prev_page if quant_kv else None)
+            ks.append(kp)
+            vs.append(vp)
+            kscs.append(ksp)
+            vscs.append(vsp)
+        logits = self.lm_head(self.ln_f(x))
+        new_cache = {"k": tuple(ks), "v": tuple(vs),
+                     "table": cache["table"],
+                     "len": ln + live.astype(jnp.int32)}
+        if quant_kv:
+            new_cache["k_scale"] = tuple(kscs)
+            new_cache["v_scale"] = tuple(vscs)
+        return logits._data[:, 0, :].astype(jnp.float32), new_cache
 
     def _ensure_gen(self):
         if self._gen is not None:
@@ -1187,7 +1326,7 @@ class GPTModel(HybridBlock):
                                for c, v in zip(cache["v"], vs)),
                     "len": cache["len"].at[slots].set(valid_len),
                 }
-            return logits._data[:, 0, :], new_cache
+            return logits._data[:, 0, :].astype(jnp.float32), new_cache
 
         def decode_raw(tokens, cache):
             return self._decode_body(blocks, tokens, cache)
@@ -1250,7 +1389,8 @@ class GPTModel(HybridBlock):
             slots = jnp.arange(tokens.shape[0], dtype=jnp.int32)
         else:
             slots = _as_i32(slots)
-        return prefill_jit(next_key(), [nd._data for nd in param_nds],
+        return prefill_jit(next_key(),
+                           self._param_call_datas(param_nds),
                            self._quant_arg(), self._lora_arg(),
                            self._lora_idx(adapters, tokens.shape[0]),
                            tokens, valid_length, slots, cache)
@@ -1267,7 +1407,8 @@ class GPTModel(HybridBlock):
         runtime data gathered inside the one fixed-shape program."""
         param_nds, _, decode_jit = self._ensure_gen()[:3]
         tokens = _as_i32(tokens)
-        return decode_jit(next_key(), [nd._data for nd in param_nds],
+        return decode_jit(next_key(),
+                          self._param_call_datas(param_nds),
                           self._quant_arg(), self._lora_arg(),
                           self._lora_idx(adapters, tokens.shape[0]),
                           tokens, cache)
@@ -1290,7 +1431,8 @@ class GPTModel(HybridBlock):
         if tokens.ndim != 2:
             raise ValueError(f"verify tokens must be (batch, R), got "
                              f"shape {tokens.shape}")
-        return verify_jit(next_key(), [nd._data for nd in param_nds],
+        return verify_jit(next_key(),
+                          self._param_call_datas(param_nds),
                           self._quant_arg(), self._lora_arg(),
                           self._lora_idx(adapters, tokens.shape[0]),
                           tokens, cache)
@@ -1302,7 +1444,8 @@ class GPTModel(HybridBlock):
         point with a negative delta). Cache donated."""
         gen = self._ensure_gen()
         param_nds, advance_jit = gen[0], gen[4]
-        return advance_jit(next_key(), [nd._data for nd in param_nds],
+        return advance_jit(next_key(),
+                           self._param_call_datas(param_nds),
                            self._quant_arg(), self._lora_arg(),
                            self._lora_idx(None, 1),  # no compute
                            _as_i32(delta), cache)
@@ -1325,6 +1468,12 @@ class GPTModel(HybridBlock):
           commit count, all in one program. Rows the engine will
           evict (budget/eos/capacity clip) keep the full-commit
           ``len`` — they are dead rows whose counter nobody reads.
+        - ``decode_multi`` / ``decode_multi_paged``: k PLAIN decode
+          iterations fused into one ``lax.scan`` with per-row
+          eos/budget stop handling IN-PROGRAM — the multi-tick decode
+          path (:meth:`decode_multi`). Cached here so every existing
+          invalidation site (``_clear_cached_op``, quantize refresh,
+          ``arm_lora``, attention-path flips) covers it for free.
         """
         if self._spec_jits is None:
             self._spec_jits = {}
@@ -1400,6 +1549,61 @@ class GPTModel(HybridBlock):
                         + n_commit * (active > 0)
                     return commit, n_commit, new
                 jitted = jax.jit(_bind(raw), donate_argnums=(8,))
+        elif kind in ("decode_multi", "decode_multi_paged"):
+            paged = kind == "decode_multi_paged"
+
+            def raw(tokens, keys, temps, tks, tps, eos_ids, budgets,
+                    cache):
+                """k fused decode iterations under ``lax.scan``. A
+                row goes dead in-trace when it emits its eos or
+                exhausts its budget; dead rows keep scanning (fixed
+                shape) but their ``len`` is frozen, their cache write
+                lands at/above the frozen waterline (dense) or in
+                scrap page 0 (paged) where nothing ever attends it,
+                and their emissions are masked out of ``emitted``.
+                Mixed greedy/stochastic batches are runtime DATA
+                (temp <= 0 rows argmax raw logits, bit-equal to the
+                host-side greedy pick), so they compile nothing."""
+                def step(carry, _):
+                    cur, live, budget, ks_, cache = carry
+                    if paged:
+                        logits, cache = self._decode_body_paged(
+                            blocks, cur, live.astype(jnp.int32),
+                            cache)
+                    else:
+                        logits, cache = self._decode_body(
+                            blocks, cur, cache, live=live)
+                    # the sampler's sort-based top-k/top-p warp is
+                    # ~50x an argmax on small batches; an all-greedy
+                    # batch (the common case) must not pay it every
+                    # scanned step. Runtime cond, not a trace fork:
+                    # mixed batches still compile ONE program. Key
+                    # semantics match the k=1 engine exactly — keys
+                    # advance per step iff ANY batch row samples
+                    # (greedy rows' keys are never consumed).
+                    tok, ks_ = lax.cond(
+                        jnp.any(temps > 0.0),
+                        lambda ks: _smp.sample_tokens(ks, logits,
+                                                      temps, tks, tps),
+                        lambda ks: (jnp.argmax(logits, axis=-1)
+                                    .astype(jnp.int32), ks),
+                        ks_)
+                    # a dead row re-feeds its last token: its logits
+                    # are garbage and its pick must not leak out
+                    tok = jnp.where(live, tok, cur)
+                    budget = budget - live.astype(jnp.int32)
+                    live_n = live & (tok != eos_ids) & (budget > 0)
+                    return (tok, live_n, budget, ks_, cache), \
+                        (tok, live)
+                live0 = budgets > 0
+                carry = (tokens, live0, budgets, keys, cache)
+                (_, _, _, keys, cache), (toks, emits) = lax.scan(
+                    step, carry, None, length=k)
+                # scan stacks along axis 0 (k, B) — callers commit
+                # per-slot (B, k) blocks
+                return (jnp.transpose(toks), jnp.transpose(emits),
+                        keys, cache)
+            jitted = jax.jit(_bind(raw), donate_argnums=(12,))
         else:
             raise ValueError(f"unknown speculative closure {kind!r}")
         entry = (param_nds, jitted)
@@ -1408,7 +1612,7 @@ class GPTModel(HybridBlock):
 
     def _spec_call(self, kind, k, sampled, adapters, batch, *args):
         param_nds, jitted = self._ensure_spec(kind, k, sampled)
-        return jitted(next_key(), [nd._data for nd in param_nds],
+        return jitted(next_key(), self._param_call_datas(param_nds),
                       self._quant_arg(), self._lora_arg(),
                       self._lora_idx(adapters, batch), *args)
 
@@ -1480,6 +1684,56 @@ class GPTModel(HybridBlock):
             jnp.asarray(temps, jnp.float32),
             jnp.asarray(top_ks, jnp.int32),
             jnp.asarray(top_ps, jnp.float32), _as_i32(active), cache)
+
+    # -- fused multi-tick decode ----------------------------------------
+    def decode_multi(self, tokens, budgets, cache, k, keys, temps,
+                     top_ks, top_ps, eos_ids, adapters=None):
+        """``k`` PLAIN decode iterations for every cache slot fused
+        into ONE jitted ``lax.scan`` program — the multi-tick decode
+        path: one dispatch and one host sync amortize over up to k
+        emitted tokens per row. Per-row stop handling runs IN-PROGRAM:
+        a row stops (stays in the scan with ``len`` frozen, write
+        masked to its inactive position, emissions masked) once it
+        emits ``eos_ids[row]`` (pass -1 for no eos) or its
+        ``budgets[row]`` remaining-token budget hits zero; a row whose
+        budget is 0 AT ENTRY never runs (free slots). Sampling knobs
+        are per-row runtime data exactly as in :meth:`propose_tokens`
+        — a temp<=0 row argmaxes raw logits, bit-equal to the
+        single-step host-side greedy pick, so greedy multi-tick output
+        is token-identical to k=1. Returns ``(tokens (B, k) int32,
+        emitted (B, k) bool, advanced keys, cache)``: row i's emitted
+        tokens are the prefix ``tokens[i, :emitted[i].sum()]`` (the
+        live mask is monotone — once dead, dead). Every row's key
+        advances once per scan step (the k=1 engine tick's sampler
+        contract), so seeded streams are bitwise-reproducible across
+        tick sizes. Cache donated. ``adapters`` (B,) selects each
+        row's LoRA bank slot."""
+        tokens = _as_i32(tokens)
+        b = tokens.shape[0]
+        return self._spec_call(
+            "decode_multi", k, True, adapters, b, tokens,
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32),
+            _as_i32(eos_ids), _as_i32(budgets), cache)
+
+    def decode_multi_paged(self, tokens, budgets, cache, k, keys,
+                           temps, top_ks, top_ps, eos_ids,
+                           adapters=None):
+        """Paged-cache :meth:`decode_multi`: identical scan and stop
+        semantics, with dead rows' writes redirected into scrap page
+        0 through the ``decode_step_paged`` active-mask discipline
+        (``len`` frozen, table untouched). Cache donated."""
+        tokens = _as_i32(tokens)
+        b = tokens.shape[0]
+        return self._spec_call(
+            "decode_multi_paged", k, True, adapters, b, tokens,
+            jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32),
+            _as_i32(eos_ids), _as_i32(budgets), cache)
 
     # -- paged-cache generation API -------------------------------------
     def init_paged_cache(self, batch_size, n_pages, page_size,
@@ -1586,7 +1840,7 @@ class GPTModel(HybridBlock):
                     "table": cache["table"].at[slot].set(pages),
                     "len": cache["len"].at[slot].set(n_valid),
                 }
-            return logits._data[:, 0, :], new_cache
+            return logits._data[:, 0, :].astype(jnp.float32), new_cache
 
         def chunk_raw(tokens, start, n_valid, slot, pages, cache):
             """One fixed-width prefill chunk of one slot, appended at
@@ -1625,54 +1879,11 @@ class GPTModel(HybridBlock):
             if quant_kv:
                 new_cache["k_scale"] = tuple(kscs)
                 new_cache["v_scale"] = tuple(vscs)
-            return logits._data[:, 0, :], new_cache
+            return logits._data[:, 0, :].astype(jnp.float32), new_cache
 
         def decode_raw(tokens, active, cache):
-            ps = cache["k"][0].shape[2]
-            s_max = cache["table"].shape[1] * ps
-            quant_kv = cache["k"][0].dtype == jnp.int8
-            ln = cache["len"]
-            b = ln.shape[0]
-            pos = jnp.minimum(ln, s_max - 1)
-            att_len = pos + 1
-            live = active > 0
-            # inactive rows write into scrap page 0 (their table rows
-            # may alias pages now owned by OTHER slots — a masked-out
-            # result is not enough, the write itself must be redirected)
-            page = jnp.where(
-                live, cache["table"][jnp.arange(b), pos // ps], 0)
-            offset = jnp.where(live, pos % ps, 0)
-            # the previous page (scale inheritance for a page whose
-            # first token this step writes); same scrap redirection
-            prev_page = jnp.where(
-                live,
-                cache["table"][jnp.arange(b),
-                               jnp.maximum(pos // ps - 1, 0)], 0)
-            emb = self.word_embed(NDArray(tokens))
-            pw = self.position_weight.data()._data
-            x = NDArray((emb._data + jnp.take(pw, pos, axis=0))[:, None, :])
-            if self.embed_drop is not None:
-                x = self.embed_drop(x)
-            ks, vs, kscs, vscs = [], [], [], []
-            for li, blk in enumerate(blocks):
-                x, kp, vp, ksp, vsp = blk.decode_paged(
-                    x, cache["k"][li], cache["v"][li], cache["table"],
-                    page, offset, att_len,
-                    k_scale=cache["k_scale"][li] if quant_kv else None,
-                    v_scale=cache["v_scale"][li] if quant_kv else None,
-                    prev_page=prev_page if quant_kv else None)
-                ks.append(kp)
-                vs.append(vp)
-                kscs.append(ksp)
-                vscs.append(vsp)
-            logits = self.lm_head(self.ln_f(x))
-            new_cache = {"k": tuple(ks), "v": tuple(vs),
-                         "table": cache["table"],
-                         "len": ln + live.astype(jnp.int32)}
-            if quant_kv:
-                new_cache["k_scale"] = tuple(kscs)
-                new_cache["v_scale"] = tuple(vscs)
-            return logits._data[:, 0, :], new_cache
+            return self._decode_body_paged(blocks, tokens, active,
+                                           cache)
 
         def spec_verify_raw(tokens, active, cache):
             """Speculative verify against the paged pool: write each
@@ -1709,7 +1920,7 @@ class GPTModel(HybridBlock):
                     k_scale=cache["k_scale"][li] if quant_kv else None,
                     v_scale=cache["v_scale"][li] if quant_kv else None)
             logits = self.lm_head(self.ln_f(x))
-            return logits._data[0, 0, :]
+            return logits._data[0, 0, :].astype(jnp.float32)
 
         def bind_raw(slot, pages, length, cache):
             new = dict(cache)   # int8 scale pools ride along untouched
@@ -1747,7 +1958,8 @@ class GPTModel(HybridBlock):
 
     def _paged_call(self, name, adapters, batch, *args):
         p = self._ensure_paged()
-        return p[name](next_key(), [nd._data for nd in p["params"]],
+        return p[name](next_key(),
+                       self._param_call_datas(p["params"]),
                        self._quant_arg(), self._lora_arg(),
                        self._lora_idx(adapters, batch), *args)
 

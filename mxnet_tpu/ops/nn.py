@@ -234,6 +234,20 @@ def pooling(x, kernel=1, pool_type="max", stride=None, pad=0,
 # ---------------------------------------------------------------------------
 # normalization
 # ---------------------------------------------------------------------------
+def accum_dtype(dtype):
+    """The ONE accumulation-dtype policy for reduced-precision inputs:
+    normalization statistics (mean/var) and softmax-style reductions
+    accumulate in fp32 when the input is a 16-bit float, and in the
+    input's own dtype otherwise (fp32/fp64 stay put — for fp32 inputs
+    every ``astype`` this implies is an identity, keeping the fp32
+    path bitwise unchanged). Every norm below routes through this
+    helper so the bf16 compute path upcasts exactly once instead of
+    each op hand-rolling (and potentially double-casting) its own
+    rule."""
+    return jnp.float32 if dtype in (jnp.bfloat16, jnp.float16) \
+        else dtype
+
+
 def batch_norm_train(x, gamma, beta, axis=1, eps=1e-5):
     """Returns (out, batch_mean, batch_var). Caller updates running stats.
 
@@ -241,7 +255,7 @@ def batch_norm_train(x, gamma, beta, axis=1, eps=1e-5):
     biased (population) variance like the reference.
     """
     axes = tuple(i for i in range(x.ndim) if i != axis)
-    compute_dtype = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    compute_dtype = accum_dtype(x.dtype)
     xc = x.astype(compute_dtype)
     mean = jnp.mean(xc, axis=axes)
     var = jnp.var(xc, axis=axes)
@@ -258,7 +272,7 @@ def batch_norm_inference(x, gamma, beta, moving_mean, moving_var, axis=1,
                          eps=1e-5):
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    compute_dtype = jnp.float32 if x.dtype in (jnp.bfloat16, jnp.float16) else x.dtype
+    compute_dtype = accum_dtype(x.dtype)
     xc = x.astype(compute_dtype)
     inv = lax.rsqrt(moving_var.astype(compute_dtype) + eps).reshape(shape)
     out = (xc - moving_mean.astype(compute_dtype).reshape(shape)) * inv
@@ -268,15 +282,23 @@ def batch_norm_inference(x, gamma, beta, moving_mean, moving_var, axis=1,
 
 
 def layer_norm(x, gamma, beta, axis=-1, eps=1e-5):
-    """Parity: src/operator/nn/layer_norm.cc."""
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
-    out = (x - mean) * lax.rsqrt(var + eps)
+    """Parity: src/operator/nn/layer_norm.cc. Statistics accumulate
+    per the :func:`accum_dtype` policy (fp32 for 16-bit inputs —
+    mean/var of a bf16 residual stream in bf16 loses the mantissa
+    the normalization exists to use); output returns in ``x``'s
+    dtype so the reduced-precision activation flow is preserved."""
+    compute_dtype = accum_dtype(x.dtype)
+    xc = x.astype(compute_dtype)
+    mean = jnp.mean(xc, axis=axis, keepdims=True)
+    var = jnp.var(xc, axis=axis, keepdims=True)
+    out = (xc - mean) * lax.rsqrt(var + eps)
     if axis < 0:
         axis += x.ndim
     shape = [1] * x.ndim
     shape[axis] = x.shape[axis]
-    return out * gamma.reshape(shape) + beta.reshape(shape)
+    out = out * gamma.astype(compute_dtype).reshape(shape) + \
+        beta.astype(compute_dtype).reshape(shape)
+    return out.astype(x.dtype)
 
 
 def group_norm(x, gamma, beta, num_groups=1, eps=1e-5):

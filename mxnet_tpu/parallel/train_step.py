@@ -44,6 +44,30 @@ def _as_tuple(x):
     return (x,)
 
 
+_LAYER_RE = re.compile(r"layers\.(\d+)\.")
+
+
+def _layer_groups(diff_names, frozen_names):
+    """Group parameter positions by transformer-layer index for the
+    gather/compute overlap chain.
+
+    Returns an ordered list of groups, each a list of ``('d'|'f',
+    position)`` entries indexing into the step's diff/frozen data
+    tuples. Params whose name carries a ``layers.<N>.`` prefix land in
+    group ``N``; everything else (embeddings, final LN, output head)
+    lands in a leading group — their gathers are small and issuing
+    them up front keeps the per-layer chain clean. Returns None when
+    fewer than two groups exist (nothing to stagger)."""
+    groups = {}
+    for tag, names in (("d", diff_names), ("f", frozen_names)):
+        for pos, name in enumerate(names):
+            m = _LAYER_RE.search(name)
+            key = int(m.group(1)) if m else -1
+            groups.setdefault(key, []).append((tag, pos))
+    ordered = [groups[k] for k in sorted(groups)]
+    return ordered if len(ordered) >= 2 else None
+
+
 class TrainStep:
     """Compile `loss_fn(net(data), label)` + grad + optimizer update into
     one jitted, donation-friendly XLA program, optionally sharded over a
@@ -80,11 +104,33 @@ class TrainStep:
         None (default) inherits the process-global
         `mxnet_tpu.bucketing` policy; ``False`` opts this step out of
         even the global policy (exact unpadded behavior).
+    compute_dtype : str, optional
+        ``"bfloat16"`` runs forward/backward math in bf16 while the
+        MASTER weights, gradients, and optimizer state stay fp32:
+        params and floating inputs are cast to bf16 INSIDE the
+        differentiated loss (so the cast's transpose returns fp32
+        cotangents to the masters), the loss is reported in fp32, and
+        LN/softmax accumulate fp32 via the ``ops.nn.accum_dtype``
+        policy. None / ``"float32"`` (default) is bitwise-identical
+        to today's fp32 path. Composes with every layout: the casts
+        sit downstream of the gather pins.
+    overlap_gather : bool
+        On gather-compute layouts (``tp_fsdp``), chain
+        ``lax.optimization_barrier`` across per-layer parameter groups
+        so layer ``k``'s compute cannot be scheduled before layer
+        ``k+1``'s all-gather has issued — double-buffering the ZeRO
+        weight gathers against the matmuls instead of trusting the
+        latency-hiding scheduler to find the overlap. Numerically the
+        barrier is identity (losses stay bitwise equal to dp);
+        structurally it is visible as ``opt-barrier`` ops in
+        ``compiled_hlo``. Default True; ignored on layouts that do
+        not gather in-step.
     """
 
     def __init__(self, net, loss_fn, optimizer, optimizer_params=None,
                  mesh=None, batch_axis=AXIS_DP, param_rules=None,
-                 layout=None, donate=True, bucketing=None):
+                 layout=None, donate=True, bucketing=None,
+                 compute_dtype=None, overlap_gather=True):
         from .. import optimizer as opt_mod
         self.net = net
         self.loss_fn = loss_fn
@@ -101,6 +147,17 @@ class TrainStep:
         #: layout (kvstore.collective_wire_bytes model); set at build
         self.comm_bytes_per_step = 0
         self.donate = donate
+        if compute_dtype is None or str(compute_dtype) == "float32":
+            self.compute_dtype = "float32"
+            self._cast_dt = None
+        elif str(compute_dtype) == "bfloat16":
+            self.compute_dtype = "bfloat16"
+            self._cast_dt = jnp.bfloat16
+        else:
+            raise ValueError(
+                f"TrainStep compute_dtype must be None, 'float32' or "
+                f"'bfloat16', got {compute_dtype!r}")
+        self.overlap_gather = bool(overlap_gather)
         # False is a distinct value: "no bucketing, not even the
         # global policy" (as_policy would collapse it to None = inherit)
         self.bucketing = False if bucketing is False \
@@ -231,7 +288,12 @@ class TrainStep:
                     for nd, s in zip(all_nds, saved):
                         nd._data = s
             out_box["aux_targets"] = [nd for nd, _ in scope.state_updates]
-            aux = tuple(t for _, t in scope.state_updates)
+            # pin aux (BN running stats) to the target's STORED dtype:
+            # a bf16 compute_dtype forward must not narrow the fp32
+            # stat buffers (that would change the entry's avals and
+            # drift the accumulators)
+            aux = tuple(jnp.asarray(t, nd._data.dtype)
+                        for nd, t in scope.state_updates)
             return loss._data, aux
 
         opt_cls = type(opt)
@@ -251,6 +313,21 @@ class TrainStep:
                 and self.mesh is not None:
             gather_rep = NamedSharding(self.mesh, P())
 
+        # gather/compute overlap: per-layer barrier chain staggering
+        # layer k+1's weight all-gather against layer k's compute
+        overlap_groups = None
+        if gather_rep is not None and self.overlap_gather:
+            overlap_groups = _layer_groups(
+                [names[i] for i in diff_idx],
+                [names[i] for i in frozen_idx])
+
+        cast_dt = self._cast_dt
+
+        def _cast_leaves(datas):
+            return tuple(d.astype(cast_dt)
+                         if jnp.issubdtype(d.dtype, jnp.floating)
+                         else d for d in datas)
+
         def step_fn(key, diff_datas, frozen_datas, opt_states, hypers,
                     input_datas, label_datas, n_valid):
             if gather_rep is not None:
@@ -260,10 +337,50 @@ class TrainStep:
                 frozen_datas = tuple(
                     jax.lax.with_sharding_constraint(d, gather_rep)
                     for d in frozen_datas)
+            if overlap_groups is not None:
+                # chain pairwise: bundling layer k's (post-gather)
+                # weights with layer k+1's inside one barrier makes
+                # every consumer of layer k's weights depend on layer
+                # k+1's gather — XLA must issue gather k+1 no later
+                # than compute k (the prefetch). Identity on values.
+                dd, fz = list(diff_datas), list(frozen_datas)
+                for prev, nxt in zip(overlap_groups,
+                                     overlap_groups[1:]):
+                    pick = prev + nxt
+                    vals = tuple(dd[p] if t == "d" else fz[p]
+                                 for t, p in pick)
+                    vals = jax.lax.optimization_barrier(vals)
+                    for (t, p), v in zip(pick, vals):
+                        if t == "d":
+                            dd[p] = v
+                        else:
+                            fz[p] = v
+                # re-pin: the SPMD partitioner propagates shardings
+                # THROUGH the barrier and would otherwise re-shard its
+                # outputs back to the storage layout, silently undoing
+                # the gather-compute pin (and its bitwise-vs-dp
+                # guarantee)
+                diff_datas = tuple(
+                    jax.lax.with_sharding_constraint(d, gather_rep)
+                    for d in dd)
+                frozen_datas = tuple(
+                    jax.lax.with_sharding_constraint(d, gather_rep)
+                    for d in fz)
 
             def loss_f(dd):
-                return forward_loss(key, dd, frozen_datas,
-                                    input_datas, label_datas, n_valid)
+                fz, ins = frozen_datas, input_datas
+                if cast_dt is not None:
+                    # cast INSIDE the differentiated function: the
+                    # astype's transpose casts cotangents back, so
+                    # grads land fp32 on the fp32 masters
+                    dd = _cast_leaves(dd)
+                    fz = _cast_leaves(fz)
+                    ins = _cast_leaves(ins)
+                loss, aux = forward_loss(key, dd, fz, ins,
+                                         label_datas, n_valid)
+                if cast_dt is not None:
+                    loss = loss.astype(jnp.float32)
+                return loss, aux
 
             (loss, aux), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(diff_datas)
@@ -725,14 +842,20 @@ class TrainStep:
         return NDArray(engine.track(loss))
 
     # -- introspection -------------------------------------------------
-    def compiled_hlo(self, data, label):
+    def compiled_hlo(self, data, label, optimized=True):
         """Compiled HLO text of the entry serving this batch signature
         — the bench's structural-evidence hook: ``bench.py --shard``
         feeds it to ``partition.hlo_collectives`` to show the fsdp
         program really contains the per-layer all-gathers (and the dp
         program contains none). Build the entry (run one step) first;
         this lowers/compiles a fresh executable for inspection, so
-        call it OUTSIDE any timed window."""
+        call it OUTSIDE any timed window.
+
+        ``optimized=False`` returns the LOWERED (pre-optimization)
+        StableHLO instead — the hook for asserting program STRUCTURE
+        the backend is allowed to fold, e.g. the ``overlap_gather``
+        chain's ``optimization_barrier`` ops (the CPU backend erases
+        ``opt-barrier`` late in its pipeline; TPU keeps it)."""
         data_leaves, data_spec = _flatten_arrays(_as_tuple(data))
         label_leaves, label_spec = _flatten_arrays(_as_tuple(label))
         data_leaves, label_leaves, _pad = self._apply_bucketing(
@@ -753,6 +876,8 @@ class TrainStep:
             tuple(nd._data for nd in entry["frozen_nds"]),
             tuple(self._opt_states), hypers,
             tuple(abstract), tuple(labstract), onp.int32(bsz))
+        if not optimized:
+            return lowered.as_text()
         return lowered.compile().as_text()
 
     # -- AOT warmup ----------------------------------------------------

@@ -582,6 +582,28 @@ class GenerationEngine:
         Loadable adapter slots in the bank (default 8; bank slot 0 is
         the reserved all-zeros base adapter on top of these). Only
         meaningful with ``lora_rank``.
+    decode_ticks : int, optional
+        Fuse ``k`` decode iterations into one jitted scan per engine
+        tick (docs/SERVING.md "Multi-tick decode"): one host sync and
+        one dispatch amortize over up to k tokens per slot, with
+        per-slot eos/budget stop handling moved IN-PROGRAM. Default 1
+        is bitwise today's single-step path. Greedy output is
+        token-identical across tick sizes; seeded sampling is
+        bitwise-reproducible on a replayed admission schedule.
+        Composes with ``paged``/int8 KV/LoRA/per-request sampling;
+        rejected alongside ``speculative`` (that path already
+        amortizes its sync over ``spec_k + 1`` tokens). Trades tail
+        latency granularity for throughput: deadlines and eviction
+        run at block (k-token) granularity.
+    compute_dtype : str, optional
+        ``"bfloat16"`` runs the generation programs with bf16
+        parameters and activations (fp32 master weights stay the
+        source of truth; rollovers re-cast with zero retraces) —
+        softmax/LayerNorm statistics and the returned logits stay
+        fp32, and the KV cache defaults to bf16 (int8 KV still
+        composes via ``kv_dtype``). Held to the same teacher-forced
+        bounded-divergence contract as int8. Default/``"float32"``
+        is bitwise today's fp32 path.
     """
 
     def __init__(self, model, max_slots: int = 8, max_length=None,
@@ -593,7 +615,8 @@ class GenerationEngine:
                  prefix_cache: bool = True, quantize=None,
                  kv_dtype=None, draft_model=None, spec_k: int = 4,
                  speculative=None, mesh_layout=None, mesh=None,
-                 lora_rank=None, max_adapters=None):
+                 lora_rank=None, max_adapters=None,
+                 decode_ticks: int = 1, compute_dtype=None):
         self.paged = bool(paged)
         if speculative is None:
             speculative = draft_model is not None
@@ -608,6 +631,16 @@ class GenerationEngine:
                 "drop it or pass speculative=True")
         self.draft = draft_model
         self.spec_k = int(spec_k)
+        self.decode_ticks = int(decode_ticks)
+        if self.decode_ticks < 1:
+            raise ValueError(f"decode_ticks must be >= 1, got "
+                             f"{decode_ticks}")
+        if self.decode_ticks > 1 and self.speculative:
+            raise ValueError(
+                "decode_ticks > 1 does not compose with speculative "
+                "decoding: the speculative iteration already amortizes "
+                "one host sync over up to spec_k+1 tokens — pick one "
+                "amortization scheme")
         if quantize not in (None, "int8_weights"):
             raise ValueError(
                 f"unsupported quantize={quantize!r} (only "
@@ -638,6 +671,32 @@ class GenerationEngine:
             telemetry.counter("serving.generate.quant.params", n)
             telemetry.counter("serving.generate.quant.bytes_saved",
                               saved)
+        if compute_dtype not in (None, "float32", "bfloat16"):
+            raise ValueError(
+                f"unsupported compute_dtype={compute_dtype!r} (only "
+                f"'float32' or 'bfloat16')")
+        self.compute_dtype = "float32" if compute_dtype is None \
+            else str(compute_dtype)
+        if self.compute_dtype == "bfloat16":
+            if mesh_layout is not None:
+                raise ValueError(
+                    "compute_dtype='bfloat16' does not compose with "
+                    "mesh_layout yet: the cast shadow buffers are not "
+                    "re-placed over the mesh")
+            if not callable(getattr(model, "cast_compute_params",
+                                    None)):
+                raise TypeError(
+                    "compute_dtype='bfloat16' needs a model exposing "
+                    "cast_compute_params() "
+                    "(gluon.model_zoo.gpt.GPTModel)")
+            # master weights stay fp32; the closures consume a bf16
+            # shadow list installed as runtime arguments (the int8
+            # quant-table discipline — load_weights re-casts with
+            # zero retraces). The draft model, if any, stays fp32:
+            # its logits only steer proposals.
+            t0 = telemetry.clock()
+            model.cast_compute_params("bfloat16")
+            telemetry.hist_since("serving.generate.cast.cast", t0)
         self.lora_enabled = lora_rank is not None
         if max_adapters is not None and not self.lora_enabled:
             raise ValueError(
@@ -673,6 +732,9 @@ class GenerationEngine:
         if self.speculative:
             api += (("verify_commit_paged",)
                     if self.paged else ("verify_commit",))
+        if self.decode_ticks > 1:
+            api += (("decode_multi_paged",)
+                    if self.paged else ("decode_multi",))
         for attr in api:
             if not callable(getattr(model, attr, None)):
                 raise TypeError(
@@ -803,6 +865,11 @@ class GenerationEngine:
                 f"max_length {self._s_max} leaves no usable capacity "
                 f"after the spec_k={self.spec_k} verify margin")
         policy = as_policy(prefill_bucketing)
+        if cache_dtype is None and self.compute_dtype == "bfloat16":
+            # bf16 compute writes bf16 K/V — default the cache to
+            # match (half the HBM and bandwidth); int8 KV still
+            # composes by passing cache_dtype/kv_dtype="int8"
+            cache_dtype = "bfloat16"
         self._cache_dtype = cache_dtype
         if self.paged:
             ps = int(page_size)
@@ -931,11 +998,14 @@ class GenerationEngine:
     @property
     def precision(self) -> str:
         """The replica's numeric configuration — ``"fp32"``,
-        ``"int8_weights"``, ``"int8_kv"`` or ``"int8_weights+int8_kv"``.
-        Router fleets must be precision-homogeneous: retries re-run a
-        request on another replica and the bounded-divergence contract
-        only holds within ONE quantization configuration."""
+        ``"int8_weights"``, ``"int8_kv"``, ``"bf16"`` or a ``+``-join
+        of the armed reductions. Router fleets must be
+        precision-homogeneous: retries re-run a request on another
+        replica and the bounded-divergence contract only holds within
+        ONE reduced-precision configuration."""
         parts = []
+        if self.compute_dtype == "bfloat16":
+            parts.append("bf16")
         if self.quantize is not None:
             parts.append(self.quantize)
         if self._kv_int8:
@@ -1326,11 +1396,28 @@ class GenerationEngine:
             lg, cache = self.model.decode_step(
                 onp.zeros((self.max_slots,), "i4"), cache)
             cache = self._recommit(cache)
+            if self.decode_ticks > 1:
+                cache = self._warmup_multi(cache)
             self._warm_samplers(int(lg.shape[-1]))
             if self.speculative:
                 self._warmup_spec(cache)
             self._warmup_telemetry()
         return self
+
+    def _warmup_multi(self, cache):
+        """Compile the fused multi-tick decode scan against the
+        throwaway cache. ONE program serves every traffic mix — the
+        budget/eos/sampling vectors are runtime data — so this single
+        warm call is the whole multi-tick steady state."""
+        b, k = self.max_slots, self.decode_ticks
+        fn = self.model.decode_multi_paged if self.paged \
+            else self.model.decode_multi
+        _, _, _, cache = fn(
+            onp.zeros((b,), "i4"), onp.full((b,), k, "i4"), cache, k,
+            onp.zeros((b, 2), "u4"), onp.zeros((b,), "f4"),
+            onp.zeros((b,), "i4"), onp.ones((b,), "f4"),
+            onp.full((b,), -1, "i4"))
+        return self._recommit(cache)
 
     def _warmup_telemetry(self):
         """Post-warmup measurements (outside any serving window):
@@ -1428,6 +1515,8 @@ class GenerationEngine:
             onp.zeros((self.max_slots,), "i4"),
             onp.ones((self.max_slots,), "i4"), cache)
         cache = self._recommit(cache)
+        if self.decode_ticks > 1:
+            cache = self._warmup_multi(cache)
         self.model.peek_logits_paged(0, 0, cache)
         cache = self._recommit(self.model.bind_slot_paged(0, row, 1,
                                                           cache))
@@ -1486,6 +1575,15 @@ class GenerationEngine:
                     self.model.shard_generation_state(self._part)
                 telemetry.hist_since(
                     "serving.generate.quant.requantize", tq)
+            if self.compute_dtype == "bfloat16":
+                # re-cast the bf16 shadow buffers from the fresh fp32
+                # masters INSIDE the swap window — same avals, so zero
+                # retraces (the quant-table discipline); a decode step
+                # may never see stale bf16 params after the swap
+                tc = telemetry.clock()
+                self.model.cast_compute_params("bfloat16")
+                telemetry.hist_since(
+                    "serving.generate.cast.recast", tc)
             if self.paged and self._prefix is not None:
                 # the prefix cache holds K/V computed with the OLD
                 # weights: a post-swap prefix hit would silently serve
@@ -2173,52 +2271,171 @@ class GenerationEngine:
             return onp.asarray(tok)
         return onp.asarray(logits).argmax(axis=-1)
 
-    def _decode_tick(self):
-        """One fixed-shape paged decode step over all DECODING slots
-        (prefilling slots ride along masked out — their writes are
-        redirected to the scrap page and their ``len`` stands still)."""
-        self._cow_sweep()
-        toks = onp.zeros((self.max_slots,), "i4")
-        active = onp.zeros((self.max_slots,), "i4")
-        any_trace = False
-        for i, s in enumerate(self._slots):
-            if s is not None and s.state == "decode":
-                toks[i] = s.last
-                active[i] = 1
-                if s.stream._trace is not None:
-                    any_trace = True
-        tt0 = time.perf_counter() if any_trace else 0.0
-        t0 = telemetry.clock()
-        logits, self._cache = self.model.decode_step_paged(
-            toks, active, self._cache,
-            **self._akw(self._adapter_idx))
-        self._cache = self._recommit(self._cache)
-        self._emit_collectives()
-        telemetry.hist_since("serving.generate.decode", t0)
-        step_toks = self._pick_step_tokens(logits)
+    def _decode_idxs(self):
+        """The slots a decode/spec tick serves this iteration: every
+        occupied slot (dense mode — dense slots are always decoding)
+        or every slot in its decode phase (paged mode — prefilling
+        slots ride the fixed-shape program masked out)."""
+        return [i for i, s in enumerate(self._slots)
+                if s is not None
+                and (not self.paged or s.state == "decode")]
+
+    def _tick_counters(self, dispatches, fused):
+        """Amortization telemetry, bumped once per decode/spec tick:
+        the tick materialized its outputs in ONE host sync
+        (``host_syncs``), dispatched ``dispatches`` jitted programs
+        to produce them, and fused ``fused`` decode iterations behind
+        that sync (``ticks_per_sync`` — the ``decode_ticks`` knob's
+        live readout; 1 on a plain tick). ``bench.py --latency``
+        gates host-syncs/token and dispatch counts from these
+        counters, so the amortization is measured, never asserted."""
+        telemetry.counter("serving.generate.host_syncs")
+        telemetry.counter("serving.generate.dispatches",
+                          int(dispatches))
+        telemetry.gauge("serving.generate.ticks_per_sync", int(fused))
+
+    def _commit_outputs(self, idxs, outs, span_cb, clipped=None):
+        """The ONE host-commit bookkeeping loop every tick flavor
+        (plain, multi-tick, speculative) funnels through: record the
+        slot's tracing span (``span_cb(slot, s, out)``), emit its
+        token block, advance its budget/length counters, and apply
+        the eviction ladder — eos first, then budget/capacity
+        (``clipped`` marks speculative slots whose emission was
+        clipped short of the in-program commit: exhausted even when
+        the counters alone would not say so), then deadline (checked
+        once per BLOCK — a multi-token tick times out at block
+        granularity). Returns the number of tokens emitted."""
         now = time.monotonic()
         n_emitted = 0
-        for i, s in enumerate(self._slots):
-            if s is None or s.state != "decode" or not active[i]:
-                continue
-            tok = int(step_toks[i])
-            s.last = tok
-            s.left -= 1
-            s.n_ctx += 1
-            if s.stream._trace is not None:
-                s.stream._trace.add("decode", tt0, slot=i, token=tok)
-            s.stream._emit(tok)
-            n_emitted += 1
-            if s.eos_id is not None and tok == s.eos_id:
+        for i in idxs:
+            s = self._slots[i]
+            out = outs[i]
+            span_cb(i, s, out)
+            s.stream._emit_many(out)
+            n_emitted += len(out)
+            if not out:   # can only mean an exhausted slot the evict
+                self._evict(i, "length")     # checks below would have
+                continue                     # caught last tick
+            s.last = out[-1]
+            s.left -= len(out)
+            s.n_ctx += len(out)
+            if s.eos_id is not None and out[-1] == s.eos_id:
                 self._evict(i, "eos")
-            elif s.left <= 0 or s.n_ctx >= self._s_cap:
+            elif s.left <= 0 or s.n_ctx >= self._s_cap \
+                    or (clipped is not None and clipped.get(i)):
                 self._evict(i, "length")
             elif s.deadline is not None and now > s.deadline:
                 telemetry.counter("serving.generate.timeouts")
                 self._evict(i, "timeout")
-        if n_emitted:
+        if n_emitted:  # one delta per tick, not one call per token
             telemetry.counter("serving.generate.tokens", n_emitted)
         telemetry.gauge("serving.generate.slots", self._n_active)
+        return n_emitted
+
+    def _decode_tick(self):
+        """One decode tick over all DECODING slots — dense and paged
+        (prefilling paged slots ride along masked out: their writes
+        are redirected to the scrap page and their ``len`` stands
+        still). With ``decode_ticks > 1`` the tick runs the fused
+        multi-tick scan instead of the single-step program
+        (docs/SERVING.md "Multi-tick decode"): one host sync commits
+        up to k tokens per slot."""
+        if self.paged:
+            self._cow_sweep()
+        idxs = self._decode_idxs()
+        if not idxs:
+            return
+        if self.decode_ticks > 1:
+            self._decode_tick_multi(idxs)
+            return
+        toks = onp.zeros((self.max_slots,), "i4")
+        active = onp.zeros((self.max_slots,), "i4")
+        any_trace = False
+        for i in idxs:
+            s = self._slots[i]
+            toks[i] = s.last
+            active[i] = 1
+            if s.stream._trace is not None:
+                any_trace = True
+        tt0 = time.perf_counter() if any_trace else 0.0
+        t0 = telemetry.clock()
+        if self.paged:
+            logits, self._cache = self.model.decode_step_paged(
+                toks, active, self._cache,
+                **self._akw(self._adapter_idx))
+            self._cache = self._recommit(self._cache)
+        else:
+            logits, self._cache = self.model.decode_step(
+                toks, self._cache, **self._akw(self._adapter_idx))
+            if self._part is not None:
+                self._cache = self._recommit(self._cache)
+        self._emit_collectives()
+        telemetry.hist_since("serving.generate.decode", t0)
+        step_toks = self._pick_step_tokens(logits)
+        self._tick_counters(1, 1)
+        outs = {i: [int(step_toks[i])] for i in idxs}
+
+        def span(i, s, out):
+            if s.stream._trace is not None:
+                s.stream._trace.add("decode", tt0, slot=i,
+                                    token=out[-1])
+        self._commit_outputs(idxs, outs, span)
+
+    def _decode_tick_multi(self, idxs):
+        """One MULTI-TICK decode tick: ``decode_ticks`` fused decode
+        iterations in ONE jitted scan, committed through one host
+        sync. Per-slot eos/budget stop handling runs IN-PROGRAM — a
+        finished slot keeps scanning against its frozen/scrap
+        position with its emissions masked — so the host receives a
+        finished (B, k) token block plus its emission mask and
+        commits each slot's prefix in one ``_emit_many``. Budgets
+        are clamped host-side to each slot's remaining token budget
+        and capacity headroom, so the scan can never over-emit; mixed
+        greedy/stochastic batches and every per-request knob are
+        runtime vectors (keys split per scan step in-trace), so
+        steady-state traffic compiles nothing."""
+        k = self.decode_ticks
+        b = self.max_slots
+        toks = onp.zeros((b,), "i4")
+        budgets = onp.zeros((b,), "i4")
+        eos_ids = onp.full((b,), -1, "i4")
+        any_trace = False
+        for i in idxs:
+            s = self._slots[i]
+            toks[i] = s.last
+            budgets[i] = min(k, s.left, self._s_cap - s.n_ctx)
+            if s.eos_id is not None:
+                eos_ids[i] = s.eos_id
+            if s.stream._trace is not None:
+                any_trace = True
+        tt0 = time.perf_counter() if any_trace else 0.0
+        t0 = telemetry.clock()
+        fn = self.model.decode_multi_paged if self.paged \
+            else self.model.decode_multi
+        tok_blk, emit_blk, keys, self._cache = fn(
+            toks, budgets, self._cache, k, self._keys, self._temps,
+            self._topks, self._topps, eos_ids,
+            **self._akw(self._adapter_idx))
+        if self.paged or self._part is not None:
+            self._cache = self._recommit(self._cache)
+        self._emit_collectives()
+        tok_h = onp.asarray(tok_blk)   # the (B, k) block's ONE sync
+        emit_h = onp.asarray(emit_blk)
+        # onp.array, not asarray: a jax array converts to a READ-ONLY
+        # numpy view, and _arm_sampling assigns into this buffer
+        self._keys = onp.array(keys, dtype="u4")
+        telemetry.hist_since("serving.generate.decode", t0)
+        self._tick_counters(1, k)
+        outs = {i: [int(t) for t in tok_h[i, :int(emit_h[i].sum())]]
+                for i in idxs}
+
+        def span(i, s, out):
+            # ONE span covering the whole k-token block (never k
+            # spans, never zero) — the flight/trace contract
+            if s.stream._trace is not None:
+                s.stream._trace.add("decode", tt0, slot=i,
+                                    tokens=len(out))
+        self._commit_outputs(idxs, outs, span)
 
     def _evict_exc(self, slot: int, exc):
         """Reject a slot whose stream has delivered nothing yet (a
@@ -2280,45 +2497,7 @@ class GenerationEngine:
         if self.speculative:
             self._spec_tick()
             return
-        toks = onp.zeros((self.max_slots,), "i4")
-        any_trace = False
-        for i, s in enumerate(self._slots):
-            if s is not None:
-                toks[i] = s.last
-                if s.stream._trace is not None:
-                    any_trace = True
-        tt0 = time.perf_counter() if any_trace else 0.0
-        t0 = telemetry.clock()
-        logits, self._cache = self.model.decode_step(
-            toks, self._cache, **self._akw(self._adapter_idx))
-        if self._part is not None:
-            self._cache = self._recommit(self._cache)
-        self._emit_collectives()
-        telemetry.hist_since("serving.generate.decode", t0)
-        step_toks = self._pick_step_tokens(logits)
-        now = time.monotonic()
-        n_emitted = 0
-        for i, s in enumerate(self._slots):
-            if s is None:
-                continue
-            tok = int(step_toks[i])
-            s.last = tok
-            s.left -= 1
-            s.n_ctx += 1
-            if s.stream._trace is not None:
-                s.stream._trace.add("decode", tt0, slot=i, token=tok)
-            s.stream._emit(tok)
-            n_emitted += 1
-            if s.eos_id is not None and tok == s.eos_id:
-                self._evict(i, "eos")
-            elif s.left <= 0 or s.n_ctx >= self._s_cap:
-                self._evict(i, "length")
-            elif s.deadline is not None and now > s.deadline:
-                telemetry.counter("serving.generate.timeouts")
-                self._evict(i, "timeout")
-        if n_emitted:  # one delta for the step, not one call per token
-            telemetry.counter("serving.generate.tokens", n_emitted)
-        telemetry.gauge("serving.generate.slots", self._n_active)
+        self._decode_tick()
 
     # -- speculative decoding (docs/SERVING.md) -------------------------
     def _spec_tick(self):
@@ -2336,9 +2515,7 @@ class GenerationEngine:
         sample from exactly the warped target distribution."""
         if self.paged:
             self._cow_sweep()
-        idxs = [i for i, s in enumerate(self._slots)
-                if s is not None
-                and (not self.paged or s.state == "decode")]
+        idxs = self._decode_idxs()
         if not idxs:
             return
         k = self.spec_k
@@ -2395,7 +2572,8 @@ class GenerationEngine:
         # row's counter; the draft rolls back by the same arithmetic
         # (it ran k steps on every row — fixed shape).
         ddelta = onp.full((b,), -k, "i4")
-        emits = {}
+        outs = {}
+        clipped = {}
         proposed = len(idxs) * k
         accepted = 0
         for i in idxs:
@@ -2406,7 +2584,8 @@ class GenerationEngine:
             if s.eos_id is not None and s.eos_id in out:
                 out = out[:out.index(s.eos_id) + 1]
             out = out[:min(len(out), s.left, self._s_cap - s.n_ctx)]
-            emits[i] = (out, m)
+            outs[i] = out
+            clipped[i] = len(out) < m
             ddelta[i] += m
         self._draft_cache = self._recommit_draft(
             self.draft.advance_len(ddelta, self._draft_cache))
@@ -2417,35 +2596,18 @@ class GenerationEngine:
         if proposed:
             telemetry.gauge("serving.generate.spec.accept_rate",
                             accepted / proposed)
-        now = time.monotonic()
-        n_emitted = 0
-        for i in idxs:
-            s = self._slots[i]
-            out, m = emits[i]
+        # propose + verify_commit + draft advance = 3 dispatches; the
+        # one host sync amortizes over up to k+1 tokens per slot
+        self._tick_counters(3, k + 1)
+
+        def span(i, s, out):
             if s.stream._trace is not None:
                 s.stream._trace.add("verify", tt0, slot=i, proposed=k,
                                     committed=len(out))
-            s.stream._emit_many(out)
-            n_emitted += len(out)
-            if not out:   # can only mean an exhausted slot the evict
-                self._evict(i, "length")     # checks below would have
-                continue                     # caught last tick
-            s.last = out[-1]
-            s.left -= len(out)
-            s.n_ctx += len(out)
-            if s.eos_id is not None and out[-1] == s.eos_id:
-                self._evict(i, "eos")
-            elif s.left <= 0 or s.n_ctx >= self._s_cap \
-                    or len(out) < m:
-                self._evict(i, "length")
-            elif s.deadline is not None and now > s.deadline:
-                telemetry.counter("serving.generate.timeouts")
-                self._evict(i, "timeout")
-        if n_emitted:
-            telemetry.counter("serving.generate.tokens", n_emitted)
+        n_emitted = self._commit_outputs(idxs, outs, span,
+                                         clipped=clipped)
         telemetry.gauge("serving.generate.spec.tokens_per_step",
                         n_emitted)
-        telemetry.gauge("serving.generate.slots", self._n_active)
 
     def _evict(self, slot: int, reason: str):
         s = self._slots[slot]
